@@ -1,0 +1,23 @@
+"""kubeflow_tpu: a TPU-native distributed-training control plane.
+
+A ground-up rebuild of the capabilities of Kubeflow's distributed-training
+stack (training-operator, Katib, KServe; see SURVEY.md) designed TPU-first:
+
+- Declarative job specs (JAXJob/TFJob/PyTorchJob/MPIJob shapes) with a
+  reconciler that gang-schedules whole TPU slices all-or-nothing and
+  injects ``jax.distributed`` coordinator environment (the ICI/DCN-world
+  equivalent of Kubeflow's NCCL MASTER_ADDR/RANK wiring).
+- An in-runtime training stack (flax/pjit models over a
+  ``jax.sharding.Mesh`` with data/fsdp/tensor/sequence axes) that the
+  reference delegates to user containers.
+- An HPO loop (experiments -> suggestions -> trials -> scraped metrics ->
+  early stopping) equivalent to Katib.
+- A serving path (InferenceService -> PJRT-driven JAX model server,
+  V1/V2 inference protocols, scale-to-zero) equivalent to KServe.
+
+Reference parity map lives in SURVEY.md section 3; note /root/reference was
+empty at survey time (SURVEY.md section 0), so parity citations are to the
+survey's component inventory (T*/K*/S* ids), not to reference file:line.
+"""
+
+__version__ = "0.1.0"

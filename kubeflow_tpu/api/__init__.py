@@ -1,0 +1,29 @@
+"""API types for the control plane: job specs, statuses, conditions.
+
+Equivalent of training-operator's CRD Go structs (SURVEY.md section 3.1, T1):
+TFJob/PyTorchJob/MPIJob/JAXJob types, ReplicaSpec, RunPolicy, JobStatus.
+Here they are pydantic models: YAML specs are validated/defaulted on
+submit, exactly as the reference's defaulting+validating webhooks (T8) do.
+"""
+
+from kubeflow_tpu.api.types import (  # noqa: F401
+    CheckpointPolicy,
+    CleanPodPolicy,
+    Condition,
+    ConditionType,
+    ElasticPolicy,
+    JobKind,
+    JobPhase,
+    JobSpec,
+    JobStatus,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaStatus,
+    ReplicaType,
+    Resources,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+    TrainJob,
+)
+from kubeflow_tpu.api.validation import apply_defaults, validate_job  # noqa: F401

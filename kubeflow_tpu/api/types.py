@@ -1,0 +1,340 @@
+"""Job API types.
+
+The declarative surface of the control plane. Mirrors the capability set of
+training-operator's ``kubeflow.org/v1`` API (SURVEY.md 3.1 T1):
+
+- ``TrainJob`` is the envelope object (kind + metadata + spec + status),
+  playing the role of a CRD instance.
+- ``ReplicaSpec`` ~ the reference's ``ReplicaSpec{replicas, template,
+  restartPolicy}``; the pod template becomes a ``ProcessTemplate`` because
+  workloads here are host processes, not containers.
+- ``RunPolicy`` carries cleanPodPolicy / ttlSecondsAfterFinished /
+  activeDeadlineSeconds / backoffLimit / schedulingPolicy with the same
+  semantics as the reference.
+- ``JobStatus`` is the conditions + replicaStatuses state machine users
+  watch, same shape as the reference's status subresource.
+
+TPU-first deltas (SURVEY.md 3.5, 5.3):
+
+- ``Resources.tpu`` counts chips; gang admission is all-or-nothing at
+  slice granularity (a slice is indivisible on TPU).
+- ``ElasticPolicy`` means *slice-count* elasticity: resize happens by
+  quiesce -> checkpoint -> respawn with a new process count -> resharded
+  restore, not per-chip join/leave as in torch elastic.
+- ``CheckpointPolicy`` is first-class (the reference leaves checkpointing
+  to user code; our runtime owns it via orbax).
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import Any, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+
+class JobKind(str, enum.Enum):
+    """Supported job kinds.
+
+    JAXJob is the native kind. TFJob/PyTorchJob/MPIJob keep the reference's
+    replica vocabularies and env-injection contracts (SURVEY.md 3.1 T3-T5)
+    so specs written against the reference's API shape port over.
+    """
+
+    JAXJob = "JAXJob"
+    TFJob = "TFJob"
+    PyTorchJob = "PyTorchJob"
+    MPIJob = "MPIJob"
+    XGBoostJob = "XGBoostJob"
+    PaddleJob = "PaddleJob"
+
+
+class ReplicaType(str, enum.Enum):
+    """Union of replica vocabularies across kinds.
+
+    Per-kind valid sets are enforced in validation.py (the reference does
+    this in per-controller ValidateV1*JobSpec functions).
+    """
+
+    Worker = "Worker"
+    Master = "Master"
+    Chief = "Chief"
+    PS = "PS"
+    Evaluator = "Evaluator"
+    Launcher = "Launcher"
+
+
+class RestartPolicy(str, enum.Enum):
+    """Per-replica restart policy (reference: Never/OnFailure/Always/ExitCode).
+
+    ExitCode: only exit codes classified as transient (see
+    ``controller.restarts.is_retryable_exit``) trigger a restart.
+    """
+
+    Never = "Never"
+    OnFailure = "OnFailure"
+    Always = "Always"
+    ExitCode = "ExitCode"
+
+
+class CleanPodPolicy(str, enum.Enum):
+    """What to tear down on job completion (reference default: Running)."""
+
+    Running = "Running"
+    All = "All"
+    NoneP = "None"
+
+
+class ConditionType(str, enum.Enum):
+    Created = "Created"
+    Running = "Running"
+    Restarting = "Restarting"
+    Succeeded = "Succeeded"
+    Failed = "Failed"
+    Suspended = "Suspended"
+
+
+class JobPhase(str, enum.Enum):
+    """Condensed single-value phase derived from conditions."""
+
+    Pending = "Pending"
+    Running = "Running"
+    Restarting = "Restarting"
+    Succeeded = "Succeeded"
+    Failed = "Failed"
+    Suspended = "Suspended"
+
+
+class Resources(BaseModel):
+    """Per-replica resource request.
+
+    ``tpu`` counts chips (the google.com/tpu resource of the north star);
+    admission treats the chips of one replica as an indivisible unit.
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    tpu: int = 0
+    cpu: float = 1.0
+    memory_gb: float = 1.0
+
+
+class ProcessTemplate(BaseModel):
+    """Process template, standing in for the reference's pod template.
+
+    ``entrypoint`` is a python module path run as ``python -m <module>``
+    (or an executable path when ``exec_`` is true). The controller appends
+    rendezvous env (coordinator address, process id/count) per job kind at
+    spawn time -- the analog of TF_CONFIG / MASTER_ADDR / hostfile wiring.
+    """
+
+    model_config = ConfigDict(extra="forbid", populate_by_name=True)
+
+    entrypoint: str
+    args: list[str] = Field(default_factory=list)
+    env: dict[str, str] = Field(default_factory=dict)
+    workdir: Optional[str] = None
+    exec_: bool = Field(default=False, alias="exec")
+
+
+class ReplicaSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    replicas: int = Field(default=1, ge=0)
+    template: ProcessTemplate
+    restart_policy: RestartPolicy = RestartPolicy.OnFailure
+    resources: Resources = Field(default_factory=Resources)
+
+
+class SchedulingPolicy(BaseModel):
+    """Gang-scheduling knobs (reference: RunPolicy.schedulingPolicy, T7).
+
+    ``min_available`` defaults to the full gang (sum of replicas); smaller
+    values permit partial gangs only for non-TPU replicas -- TPU replicas
+    are always all-or-nothing (slice atomicity).
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    min_available: Optional[int] = None
+    queue: str = "default"
+    priority: int = 0
+
+
+class ElasticPolicy(BaseModel):
+    """Slice-count elasticity (SURVEY.md 5.3).
+
+    min/max replicas bound the worker count the reconciler may re-form the
+    job at after failures or capacity changes. ``max_restarts`` bounds
+    re-formations. On TPU, resize granularity is whole replicas (slices),
+    re-formed via checkpoint/restore with resharding.
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    min_replicas: int = Field(default=1, ge=1)
+    max_replicas: int = Field(default=1, ge=1)
+    max_restarts: int = Field(default=3, ge=0)
+
+
+class CheckpointPolicy(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    dir: Optional[str] = None
+    interval_steps: int = Field(default=100, ge=1)
+    keep: int = Field(default=3, ge=1)
+    resume: bool = True
+
+
+class RunPolicy(BaseModel):
+    """Job-level lifecycle policy; same field semantics as the reference."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    clean_pod_policy: CleanPodPolicy = CleanPodPolicy.Running
+    ttl_seconds_after_finished: Optional[int] = Field(default=None, ge=0)
+    active_deadline_seconds: Optional[int] = Field(default=None, ge=1)
+    backoff_limit: int = Field(default=3, ge=0)
+    scheduling: SchedulingPolicy = Field(default_factory=SchedulingPolicy)
+    suspend: bool = False
+
+
+class JobSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    replica_specs: dict[ReplicaType, ReplicaSpec]
+    run_policy: RunPolicy = Field(default_factory=RunPolicy)
+    elastic: Optional[ElasticPolicy] = None
+    checkpoint: CheckpointPolicy = Field(default_factory=CheckpointPolicy)
+    # Process count per replica when one replica hosts multiple JAX
+    # processes (== nproc_per_node in torch terms). Almost always 1 here:
+    # one process per host, all local chips visible to it.
+    nproc_per_replica: int = Field(default=1, ge=1)
+
+
+class Condition(BaseModel):
+    type: ConditionType
+    status: bool = True
+    reason: str = ""
+    message: str = ""
+    last_transition: float = Field(default_factory=time.time)
+
+
+class ReplicaStatus(BaseModel):
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+class JobStatus(BaseModel):
+    conditions: list[Condition] = Field(default_factory=list)
+    replica_statuses: dict[ReplicaType, ReplicaStatus] = Field(default_factory=dict)
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+    restart_count: int = 0
+    # Observed worker count the job is currently formed at (elastic).
+    formed_replicas: Optional[int] = None
+
+    @property
+    def phase(self) -> JobPhase:
+        order = [
+            ConditionType.Failed,
+            ConditionType.Succeeded,
+            ConditionType.Suspended,
+            ConditionType.Restarting,
+            ConditionType.Running,
+            ConditionType.Created,
+        ]
+        active = {c.type for c in self.conditions if c.status}
+        for t in order:
+            if t in active:
+                return {
+                    ConditionType.Created: JobPhase.Pending,
+                    ConditionType.Running: JobPhase.Running,
+                    ConditionType.Restarting: JobPhase.Restarting,
+                    ConditionType.Succeeded: JobPhase.Succeeded,
+                    ConditionType.Failed: JobPhase.Failed,
+                    ConditionType.Suspended: JobPhase.Suspended,
+                }[t]
+        return JobPhase.Pending
+
+    def set_condition(self, ctype: ConditionType, reason: str = "", message: str = "") -> None:
+        """Set ``ctype`` true, flipping mutually-exclusive conditions false.
+
+        Mirrors the reference's util.UpdateJobConditions: Running/Restarting
+        /Succeeded/Failed are mutually exclusive; Created stays true forever.
+        """
+        exclusive = {
+            ConditionType.Running,
+            ConditionType.Restarting,
+            ConditionType.Succeeded,
+            ConditionType.Failed,
+            ConditionType.Suspended,
+        }
+        now = time.time()
+        found = False
+        for c in self.conditions:
+            if c.type == ctype:
+                if not c.status or c.reason != reason or c.message != message:
+                    c.status, c.reason, c.message, c.last_transition = True, reason, message, now
+                found = True
+            elif ctype in exclusive and c.type in exclusive and c.status:
+                c.status, c.last_transition = False, now
+        if not found:
+            self.conditions.append(
+                Condition(type=ctype, reason=reason, message=message, last_transition=now)
+            )
+
+    def has_condition(self, ctype: ConditionType) -> bool:
+        return any(c.type == ctype and c.status for c in self.conditions)
+
+
+class ObjectMeta(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    name: str
+    namespace: str = "default"
+    labels: dict[str, str] = Field(default_factory=dict)
+    annotations: dict[str, str] = Field(default_factory=dict)
+    uid: Optional[str] = None
+    creation_time: Optional[float] = None
+    generation: int = 0
+
+
+class TrainJob(BaseModel):
+    """The envelope object: one CRD-instance equivalent."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    kind: JobKind = JobKind.JAXJob
+    metadata: ObjectMeta
+    spec: JobSpec
+    status: JobStatus = Field(default_factory=JobStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def total_replicas(self) -> int:
+        return sum(rs.replicas for rs in self.spec.replica_specs.values())
+
+    def total_tpu_chips(self) -> int:
+        return sum(
+            rs.replicas * rs.resources.tpu for rs in self.spec.replica_specs.values()
+        )
+
+    @classmethod
+    def from_dict(cls, obj: dict[str, Any]) -> "TrainJob":
+        return cls.model_validate(obj)
+
+    def to_dict(self) -> dict[str, Any]:
+        return self.model_dump(mode="json", by_alias=True)

@@ -1,0 +1,116 @@
+"""Defaulting + validation for job specs.
+
+Equivalent of the reference's admission webhooks (SURVEY.md 3.1 T8): the
+mutating webhook's defaults are applied at submit time so the stored spec
+is complete; the validating webhook's per-kind rules are enforced here.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api.types import (
+    ElasticPolicy,
+    JobKind,
+    ReplicaType,
+    TrainJob,
+)
+
+# Valid replica vocabularies per kind, mirroring the per-controller
+# validation in the reference (T3: TFJob PS/Worker/Chief/Evaluator/Master;
+# T4: PyTorchJob Master/Worker; T5: MPIJob Launcher/Worker).
+VALID_REPLICA_TYPES: dict[JobKind, set[ReplicaType]] = {
+    JobKind.JAXJob: {ReplicaType.Worker},
+    JobKind.TFJob: {
+        ReplicaType.Chief,
+        ReplicaType.Master,
+        ReplicaType.Worker,
+        ReplicaType.PS,
+        ReplicaType.Evaluator,
+    },
+    JobKind.PyTorchJob: {ReplicaType.Master, ReplicaType.Worker},
+    JobKind.MPIJob: {ReplicaType.Launcher, ReplicaType.Worker},
+    JobKind.XGBoostJob: {ReplicaType.Master, ReplicaType.Worker},
+    JobKind.PaddleJob: {ReplicaType.Master, ReplicaType.Worker},
+}
+
+# Replica types whose rank-0 success decides job success (reference: TFJob
+# succeeds on chief/worker-0; PyTorchJob on master/worker-0; MPIJob on the
+# launcher's exit code).
+SUCCESS_POLICY_REPLICA: dict[JobKind, list[ReplicaType]] = {
+    JobKind.JAXJob: [ReplicaType.Worker],
+    JobKind.TFJob: [ReplicaType.Chief, ReplicaType.Master, ReplicaType.Worker],
+    JobKind.PyTorchJob: [ReplicaType.Master, ReplicaType.Worker],
+    JobKind.MPIJob: [ReplicaType.Launcher],
+    JobKind.XGBoostJob: [ReplicaType.Master, ReplicaType.Worker],
+    JobKind.PaddleJob: [ReplicaType.Master, ReplicaType.Worker],
+}
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def apply_defaults(job: TrainJob) -> TrainJob:
+    """Fill derived defaults; stored spec becomes complete (SURVEY.md 5.6)."""
+    sched = job.spec.run_policy.scheduling
+    if sched.min_available is None and job.total_replicas() > 0:
+        # None on an all-zero-replica job (suspended/scaled-to-zero shape)
+        # stays None, meaning "full gang, whatever size it forms at".
+        sched.min_available = job.total_replicas()
+    if job.spec.elastic is None and job.kind == JobKind.JAXJob:
+        n = job.spec.replica_specs[ReplicaType.Worker].replicas if (
+            ReplicaType.Worker in job.spec.replica_specs
+        ) else 1
+        if n >= 1:  # zero-replica (suspended) jobs get no elastic default
+            job.spec.elastic = ElasticPolicy(min_replicas=n, max_replicas=n)
+    return job
+
+
+def validate_job(job: TrainJob) -> None:
+    """Raise ValidationError on an invalid spec."""
+    if not job.metadata.name or "/" in job.metadata.name:
+        raise ValidationError(f"invalid job name {job.metadata.name!r}")
+    if not job.spec.replica_specs:
+        raise ValidationError("job has no replica specs")
+
+    valid = VALID_REPLICA_TYPES[job.kind]
+    for rtype, rspec in job.spec.replica_specs.items():
+        if rtype not in valid:
+            raise ValidationError(
+                f"{job.kind.value} does not allow replica type {rtype.value}; "
+                f"allowed: {sorted(t.value for t in valid)}"
+            )
+        if rspec.replicas < 0:
+            raise ValidationError(f"{rtype.value}.replicas must be >= 0")
+        if rspec.resources.tpu < 0:
+            raise ValidationError(f"{rtype.value}.resources.tpu must be >= 0")
+        if not rspec.template.entrypoint:
+            raise ValidationError(f"{rtype.value}.template.entrypoint is required")
+
+    # Kind-specific structural rules.
+    if job.kind == JobKind.PyTorchJob:
+        masters = job.spec.replica_specs.get(ReplicaType.Master)
+        if masters and masters.replicas > 1:
+            raise ValidationError("PyTorchJob allows at most 1 Master replica")
+    if job.kind == JobKind.MPIJob:
+        launcher = job.spec.replica_specs.get(ReplicaType.Launcher)
+        if launcher is None:
+            raise ValidationError("MPIJob requires a Launcher replica")
+        if launcher.replicas != 1:
+            raise ValidationError("MPIJob requires exactly 1 Launcher replica")
+
+    el = job.spec.elastic
+    if el is not None:
+        if not (1 <= el.min_replicas <= el.max_replicas):
+            raise ValidationError(
+                f"elastic policy requires 1 <= min ({el.min_replicas}) <= max "
+                f"({el.max_replicas})"
+            )
+
+    sched = job.spec.run_policy.scheduling
+    if sched.min_available is not None and sched.min_available < 1:
+        raise ValidationError("scheduling.min_available must be >= 1")
+    if sched.min_available is not None and sched.min_available > job.total_replicas():
+        raise ValidationError(
+            f"scheduling.min_available ({sched.min_available}) exceeds total "
+            f"replicas ({job.total_replicas()})"
+        )

@@ -1,0 +1,6 @@
+"""Persistent watchable object store -- the etcd/apiserver equivalent.
+
+SURVEY.md 7.1 step 2: "a tiny persistent store (JSONL/SQLite) as the etcd".
+"""
+
+from kubeflow_tpu.store.store import Event, EventType, ObjectStore  # noqa: F401

@@ -1,0 +1,270 @@
+"""SQLite-backed object store with watch semantics.
+
+Plays the role of the reference's L2 dependency (API server + etcd,
+SURVEY.md section 2): typed objects are stored as JSON documents keyed by
+(kind, namespace, name), mutations bump a monotonically increasing
+revision, and in-process watchers receive ADDED/MODIFIED/DELETED events on
+asyncio queues -- the informer pattern the reference's controllers are
+built on, without the network hop.
+
+Optimistic concurrency: ``put(obj, expect_generation=...)`` fails on
+generation mismatch, like resourceVersion conflicts in the reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import json
+import logging
+import sqlite3
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+
+class EventType(str, enum.Enum):
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+
+@dataclass
+class Event:
+    type: EventType
+    kind: str
+    namespace: str
+    name: str
+    obj: dict[str, Any]
+    revision: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+class ConflictError(RuntimeError):
+    """Generation mismatch on put() -- caller must re-read and retry."""
+
+
+class ObjectStore:
+    """Thread-safe persistent store; watchers are asyncio queues.
+
+    The store is shared by the reconciler (asyncio), CLI server handlers,
+    and tests. SQLite connections are per-thread via check_same_thread=False
+    plus a lock -- write volume is control-plane scale (SURVEY.md 7.4 #6:
+    the 1-vCPU host demands a nearly-free control plane).
+    """
+
+    def __init__(self, path: str = ":memory:") -> None:
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        self._watchers: list[tuple[Optional[str], asyncio.Queue, asyncio.AbstractEventLoop]] = []
+        self._sync_watchers: list[tuple[Optional[str], Callable[[Event], None]]] = []
+        with self._lock:
+            self._db.execute(
+                """CREATE TABLE IF NOT EXISTS objects (
+                    kind TEXT NOT NULL,
+                    namespace TEXT NOT NULL,
+                    name TEXT NOT NULL,
+                    generation INTEGER NOT NULL,
+                    revision INTEGER NOT NULL,
+                    data TEXT NOT NULL,
+                    PRIMARY KEY (kind, namespace, name)
+                )"""
+            )
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS meta (k TEXT PRIMARY KEY, v TEXT)"
+            )
+            self._db.commit()
+
+    # -- revision counter -------------------------------------------------
+
+    def _next_revision(self) -> int:
+        cur = self._db.execute("SELECT v FROM meta WHERE k='revision'")
+        row = cur.fetchone()
+        rev = int(row[0]) + 1 if row else 1
+        self._db.execute(
+            "INSERT INTO meta(k, v) VALUES('revision', ?) "
+            "ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+            (str(rev),),
+        )
+        return rev
+
+    # -- CRUD -------------------------------------------------------------
+
+    def put(
+        self,
+        kind: str,
+        obj: dict[str, Any],
+        expect_generation: Optional[int] = None,
+    ) -> dict[str, Any]:
+        """Create or update. Returns the stored object (with bumped meta)."""
+        meta = obj.setdefault("metadata", {})
+        name = meta.get("name")
+        if not name:
+            raise ValueError("object has no metadata.name")
+        namespace = meta.setdefault("namespace", "default")
+
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT generation, data FROM objects WHERE kind=? AND namespace=? AND name=?",
+                (kind, namespace, name),
+            )
+            row = cur.fetchone()
+            if row is None:
+                if expect_generation not in (None, 0):
+                    raise ConflictError(f"{kind} {namespace}/{name} does not exist")
+                meta.setdefault("uid", uuid.uuid4().hex)
+                meta.setdefault("creation_time", time.time())
+                meta["generation"] = 1
+                etype = EventType.ADDED
+            else:
+                if expect_generation is not None and row[0] != expect_generation:
+                    raise ConflictError(
+                        f"{kind} {namespace}/{name}: generation {row[0]} != "
+                        f"expected {expect_generation}"
+                    )
+                # uid/creation_time are assigned once at create; a declarative
+                # re-apply from a fresh dict must not erase them.
+                old_meta = json.loads(row[1]).get("metadata", {})
+                if not meta.get("uid") and old_meta.get("uid"):
+                    meta["uid"] = old_meta["uid"]
+                if not meta.get("creation_time") and old_meta.get("creation_time"):
+                    meta["creation_time"] = old_meta["creation_time"]
+                meta["generation"] = row[0] + 1
+                etype = EventType.MODIFIED
+            rev = self._next_revision()
+            data = json.dumps(obj)
+            self._db.execute(
+                "INSERT INTO objects(kind, namespace, name, generation, revision, data) "
+                "VALUES(?,?,?,?,?,?) ON CONFLICT(kind, namespace, name) DO UPDATE SET "
+                "generation=excluded.generation, revision=excluded.revision, "
+                "data=excluded.data",
+                (kind, namespace, name, meta["generation"], rev, data),
+            )
+            self._db.commit()
+            # Notify while holding the (reentrant) lock so watchers observe
+            # events in revision order; the event carries a snapshot, not the
+            # caller's live dict.
+            self._notify(Event(etype, kind, namespace, name, json.loads(data), rev))
+        return obj
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Optional[dict[str, Any]]:
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT data FROM objects WHERE kind=? AND namespace=? AND name=?",
+                (kind, namespace, name),
+            )
+            row = cur.fetchone()
+        return json.loads(row[0]) if row else None
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> list[dict[str, Any]]:
+        with self._lock:
+            if namespace is None:
+                cur = self._db.execute(
+                    "SELECT data FROM objects WHERE kind=? ORDER BY namespace, name",
+                    (kind,),
+                )
+            else:
+                cur = self._db.execute(
+                    "SELECT data FROM objects WHERE kind=? AND namespace=? ORDER BY name",
+                    (kind, namespace),
+                )
+            return [json.loads(r[0]) for r in cur.fetchall()]
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> bool:
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT data FROM objects WHERE kind=? AND namespace=? AND name=?",
+                (kind, namespace, name),
+            )
+            row = cur.fetchone()
+            if row is None:
+                return False
+            rev = self._next_revision()
+            self._db.execute(
+                "DELETE FROM objects WHERE kind=? AND namespace=? AND name=?",
+                (kind, namespace, name),
+            )
+            self._db.commit()
+            self._notify(
+                Event(EventType.DELETED, kind, namespace, name, json.loads(row[0]), rev)
+            )
+        return True
+
+    # -- watch ------------------------------------------------------------
+
+    def watch(
+        self, kind: Optional[str] = None, maxsize: int = 1024
+    ) -> asyncio.Queue:
+        """Register an asyncio watcher; returns its event queue.
+
+        Must be called from a running event loop. ``kind=None`` watches all
+        kinds. Like an informer, callers typically pair this with a
+        ``list()`` for the initial sync.
+        """
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+        with self._lock:
+            self._watchers.append((kind, q, loop))
+        return q
+
+    def unwatch(self, q: asyncio.Queue) -> None:
+        with self._lock:
+            self._watchers = [(k, w, l) for (k, w, l) in self._watchers if w is not q]
+
+    def subscribe(self, fn: Callable[[Event], None], kind: Optional[str] = None) -> None:
+        """Synchronous subscriber (tests, metrics)."""
+        self._sync_watchers.append((kind, fn))
+
+    def _notify(self, ev: Event) -> None:
+        for kind, fn in list(self._sync_watchers):
+            if kind is None or kind == ev.kind:
+                try:
+                    fn(ev)
+                except Exception:
+                    # The write is already committed; a broken subscriber must
+                    # not fail the writer or starve later watchers.
+                    logging.getLogger(__name__).exception(
+                        "store subscriber raised on %s %s", ev.type.value, ev.key
+                    )
+        for kind, q, loop in list(self._watchers):
+            if kind is not None and kind != ev.kind:
+                continue
+            try:
+                loop.call_soon_threadsafe(self._offer, q, ev)
+            except RuntimeError:
+                # Event loop closed; drop the watcher.
+                self.unwatch(q)
+
+    @staticmethod
+    def _offer(q: asyncio.Queue, ev: Event) -> None:
+        """Enqueue on the loop thread; on overflow drop the oldest event.
+
+        A watcher that falls behind loses its oldest events rather than the
+        newest (level-triggered consumers re-list on resync anyway).
+        """
+        while True:
+            try:
+                q.put_nowait(ev)
+                return
+            except asyncio.QueueFull:
+                try:
+                    q.get_nowait()
+                except asyncio.QueueEmpty:  # racing consumers
+                    pass
+
+    # -- misc -------------------------------------------------------------
+
+    def kinds(self) -> list[str]:
+        with self._lock:
+            cur = self._db.execute("SELECT DISTINCT kind FROM objects ORDER BY kind")
+            rows = cur.fetchall()
+        return [k for (k,) in rows]
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()

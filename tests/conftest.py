@@ -1,0 +1,46 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+SURVEY.md 7.3: unit/integration tests run on the CPU backend with
+``--xla_force_host_platform_device_count=8`` to fake an 8-device slice in
+one process (the reference's analog is fake clientsets + envtest: test the
+control plane as an object transformer, no real accelerator needed).
+bench.py and __graft_entry__ run outside pytest on the real chip.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pathlib
+import sys
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+
+@pytest.fixture()
+def store():
+    from kubeflow_tpu.store import ObjectStore
+
+    s = ObjectStore(":memory:")
+    yield s
+    s.close()
+
+
+@pytest.fixture()
+def tmp_store(tmp_path):
+    from kubeflow_tpu.store import ObjectStore
+
+    s = ObjectStore(str(tmp_path / "state.db"))
+    yield s
+    s.close()

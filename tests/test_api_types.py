@@ -1,0 +1,130 @@
+"""API types + validation tests (reference analog: webhook table tests, T8)."""
+
+import pytest
+
+from kubeflow_tpu.api import (
+    JobKind,
+    JobPhase,
+    JobSpec,
+    ConditionType,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    Resources,
+    TrainJob,
+    apply_defaults,
+    validate_job,
+)
+from kubeflow_tpu.api.types import ObjectMeta
+from kubeflow_tpu.api.validation import ValidationError
+
+
+def make_job(kind=JobKind.JAXJob, replicas=4, tpu=4, name="j1", **spec_kw):
+    return TrainJob(
+        kind=kind,
+        metadata=ObjectMeta(name=name),
+        spec=JobSpec(
+            replica_specs={
+                ReplicaType.Worker: ReplicaSpec(
+                    replicas=replicas,
+                    template=ProcessTemplate(entrypoint="kubeflow_tpu.runtime.worker"),
+                    resources=Resources(tpu=tpu),
+                )
+            },
+            **spec_kw,
+        ),
+    )
+
+
+class TestValidation:
+    def test_valid_jaxjob(self):
+        job = apply_defaults(make_job())
+        validate_job(job)
+        assert job.spec.run_policy.scheduling.min_available == 4
+        assert job.spec.elastic.min_replicas == 4
+
+    def test_jaxjob_rejects_ps(self):
+        job = make_job()
+        job.spec.replica_specs[ReplicaType.PS] = ReplicaSpec(
+            template=ProcessTemplate(entrypoint="x")
+        )
+        with pytest.raises(ValidationError, match="does not allow replica type PS"):
+            validate_job(job)
+
+    def test_tfjob_allows_ps(self):
+        job = TrainJob(
+            kind=JobKind.TFJob,
+            metadata=ObjectMeta(name="tf1"),
+            spec=JobSpec(
+                replica_specs={
+                    ReplicaType.PS: ReplicaSpec(
+                        template=ProcessTemplate(entrypoint="m")
+                    ),
+                    ReplicaType.Worker: ReplicaSpec(
+                        replicas=2, template=ProcessTemplate(entrypoint="m")
+                    ),
+                }
+            ),
+        )
+        validate_job(job)
+
+    def test_mpijob_requires_launcher(self):
+        job = make_job(kind=JobKind.MPIJob)
+        with pytest.raises(ValidationError, match="requires a Launcher"):
+            validate_job(job)
+
+    def test_pytorchjob_single_master(self):
+        job = TrainJob(
+            kind=JobKind.PyTorchJob,
+            metadata=ObjectMeta(name="pt"),
+            spec=JobSpec(
+                replica_specs={
+                    ReplicaType.Master: ReplicaSpec(
+                        replicas=2, template=ProcessTemplate(entrypoint="m")
+                    )
+                }
+            ),
+        )
+        with pytest.raises(ValidationError, match="at most 1 Master"):
+            validate_job(job)
+
+    def test_bad_name(self):
+        job = make_job(name="a/b")
+        with pytest.raises(ValidationError, match="invalid job name"):
+            validate_job(job)
+
+    def test_elastic_bounds(self):
+        from kubeflow_tpu.api import ElasticPolicy
+
+        job = make_job(elastic=ElasticPolicy(min_replicas=5, max_replicas=2))
+        with pytest.raises(ValidationError, match="elastic"):
+            validate_job(job)
+
+    def test_counts(self):
+        job = make_job(replicas=4, tpu=4)
+        assert job.total_replicas() == 4
+        assert job.total_tpu_chips() == 16
+
+
+class TestConditions:
+    def test_phase_machine(self):
+        job = make_job()
+        assert job.status.phase == JobPhase.Pending
+        job.status.set_condition(ConditionType.Created, "JobCreated")
+        assert job.status.phase == JobPhase.Pending
+        job.status.set_condition(ConditionType.Running, "JobRunning")
+        assert job.status.phase == JobPhase.Running
+        job.status.set_condition(ConditionType.Succeeded, "JobSucceeded")
+        assert job.status.phase == JobPhase.Succeeded
+        # Running flipped false, Created stays true.
+        assert not job.status.has_condition(ConditionType.Running)
+        assert job.status.has_condition(ConditionType.Created)
+
+    def test_roundtrip(self):
+        job = apply_defaults(make_job())
+        job.status.set_condition(ConditionType.Created)
+        d = job.to_dict()
+        back = TrainJob.from_dict(d)
+        assert back.key == job.key
+        assert back.status.has_condition(ConditionType.Created)
+        assert back.spec.replica_specs[ReplicaType.Worker].resources.tpu == 4

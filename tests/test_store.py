@@ -1,0 +1,92 @@
+"""Object store tests: CRUD, optimistic concurrency, watch."""
+
+import asyncio
+
+import pytest
+
+from kubeflow_tpu.store import EventType, ObjectStore
+from kubeflow_tpu.store.store import ConflictError
+
+
+def obj(name, **kw):
+    return {"metadata": {"name": name}, **kw}
+
+
+class TestCrud:
+    def test_put_get(self, store):
+        store.put("JAXJob", obj("a", x=1))
+        got = store.get("JAXJob", "a")
+        assert got["x"] == 1
+        assert got["metadata"]["generation"] == 1
+        assert got["metadata"]["uid"]
+
+    def test_update_bumps_generation(self, store):
+        store.put("JAXJob", obj("a"))
+        o = store.get("JAXJob", "a")
+        o["x"] = 2
+        store.put("JAXJob", o)
+        assert store.get("JAXJob", "a")["metadata"]["generation"] == 2
+
+    def test_conflict(self, store):
+        store.put("JAXJob", obj("a"))
+        o = store.get("JAXJob", "a")
+        store.put("JAXJob", dict(o))
+        with pytest.raises(ConflictError):
+            store.put("JAXJob", o, expect_generation=1)
+
+    def test_list_namespaced(self, store):
+        store.put("JAXJob", {"metadata": {"name": "a", "namespace": "ns1"}})
+        store.put("JAXJob", {"metadata": {"name": "b", "namespace": "ns2"}})
+        assert len(store.list("JAXJob")) == 2
+        assert [o["metadata"]["name"] for o in store.list("JAXJob", "ns1")] == ["a"]
+
+    def test_delete(self, store):
+        store.put("JAXJob", obj("a"))
+        assert store.delete("JAXJob", "a")
+        assert store.get("JAXJob", "a") is None
+        assert not store.delete("JAXJob", "a")
+
+    def test_persistence(self, tmp_path):
+        p = str(tmp_path / "s.db")
+        s1 = ObjectStore(p)
+        s1.put("JAXJob", obj("a", x=42))
+        s1.close()
+        s2 = ObjectStore(p)
+        assert s2.get("JAXJob", "a")["x"] == 42
+        s2.close()
+
+
+class TestWatch:
+    def test_async_watch(self, store):
+        async def run():
+            q = store.watch("JAXJob")
+            store.put("JAXJob", obj("a"))
+            ev = await asyncio.wait_for(q.get(), 2)
+            assert ev.type == EventType.ADDED and ev.name == "a"
+            o = store.get("JAXJob", "a")
+            store.put("JAXJob", o)
+            ev = await asyncio.wait_for(q.get(), 2)
+            assert ev.type == EventType.MODIFIED
+            store.delete("JAXJob", "a")
+            ev = await asyncio.wait_for(q.get(), 2)
+            assert ev.type == EventType.DELETED
+
+        asyncio.run(run())
+
+    def test_kind_filter(self, store):
+        async def run():
+            q = store.watch("JAXJob")
+            store.put("Experiment", obj("e"))
+            store.put("JAXJob", obj("a"))
+            ev = await asyncio.wait_for(q.get(), 2)
+            assert ev.kind == "JAXJob"
+            assert q.empty()
+
+        asyncio.run(run())
+
+    def test_sync_subscribe(self, store):
+        seen = []
+        store.subscribe(lambda ev: seen.append((ev.type, ev.name)))
+        store.put("JAXJob", obj("a"))
+        store.delete("JAXJob", "a")
+        assert seen == [(EventType.ADDED, "a"), (EventType.DELETED, "a")]
