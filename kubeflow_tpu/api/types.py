@@ -150,9 +150,10 @@ class ReplicaSpec(BaseModel):
 class SchedulingPolicy(BaseModel):
     """Gang-scheduling knobs (reference: RunPolicy.schedulingPolicy, T7).
 
-    ``min_available`` defaults to the full gang (sum of replicas); smaller
-    values permit partial gangs only for non-TPU replicas -- TPU replicas
-    are always all-or-nothing (slice atomicity).
+    ``min_available`` mirrors the reference's minMember and defaults to the
+    full gang. Admission itself is always all-or-nothing at the formed gang
+    size (TPU slice atomicity); forming *below* spec size is expressed via
+    ``ElasticPolicy.min_replicas``, not this field.
     """
 
     model_config = ConfigDict(extra="forbid")

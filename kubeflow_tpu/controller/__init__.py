@@ -1,0 +1,18 @@
+"""The control plane: reconciler, gang scheduler, process launcher.
+
+Equivalent of training-operator's JobController + gang-scheduling adapter
+(SURVEY.md 3.1 T2/T7) and the Volcano PodGroup admission layer (layer L3),
+collapsed into one asyncio process. Workloads are host processes instead of
+pods; the gang scheduler models TPU chips as an indivisible-slice capacity
+pool.
+"""
+
+from kubeflow_tpu.controller.gang import GangScheduler, Reservation  # noqa: F401
+from kubeflow_tpu.controller.launcher import (  # noqa: F401
+    BaseLauncher,
+    FakeLauncher,
+    ProcessLauncher,
+    SpawnRequest,
+    WorkerRef,
+)
+from kubeflow_tpu.controller.reconciler import JobController  # noqa: F401

@@ -1,0 +1,117 @@
+"""Per-kind rendezvous environment injection.
+
+The heart of what the reference's per-framework controllers do (SURVEY.md
+3.1 T3-T5, 3.5): turn a replica topology into the env vars the in-process
+runtime needs to form its communication world.
+
+TPU-first: the JAXJob contract is just ``jax.distributed.initialize()``'s
+three inputs (coordinator address, process count, process id) -- XLA
+compiles the actual collectives over ICI/DCN, so there is no NCCL-style
+transport config to inject (SURVEY.md 5.8). The legacy kinds keep their
+reference-shaped env (TF_CONFIG JSON, MASTER_ADDR/RANK, hostfile) so
+reference workloads port unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+
+from kubeflow_tpu.api.types import JobKind, ReplicaType, TrainJob
+
+# Env names for the JAXJob contract, read by kubeflow_tpu.runtime.bootstrap.
+ENV_COORDINATOR = "JAX_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+ENV_PROCESS_ID = "JAX_PROCESS_ID"
+ENV_JOB_NAME = "KFTPU_JOB_NAME"
+ENV_JOB_NAMESPACE = "KFTPU_JOB_NAMESPACE"
+ENV_REPLICA_TYPE = "KFTPU_REPLICA_TYPE"
+ENV_REPLICA_INDEX = "KFTPU_REPLICA_INDEX"
+ENV_CHECKPOINT_DIR = "KFTPU_CHECKPOINT_DIR"
+ENV_RESUME = "KFTPU_RESUME"
+
+
+def _flat_ranks(job: TrainJob, replicas_override: dict[ReplicaType, int]) -> list[tuple[ReplicaType, int]]:
+    """Global rank order: replica types sorted (Master/Chief/Launcher first),
+    then index -- stable across respawns so rank assignment is deterministic."""
+    lead = [ReplicaType.Master, ReplicaType.Chief, ReplicaType.Launcher]
+    order = lead + [t for t in job.spec.replica_specs if t not in lead]
+    out: list[tuple[ReplicaType, int]] = []
+    for rtype in order:
+        if rtype not in job.spec.replica_specs:
+            continue
+        n = replicas_override.get(rtype, job.spec.replica_specs[rtype].replicas)
+        out.extend((rtype, i) for i in range(n))
+    return out
+
+
+def rendezvous_env(
+    job: TrainJob,
+    rtype: ReplicaType,
+    index: int,
+    coordinator_port: int,
+    replicas_override: dict[ReplicaType, int] | None = None,
+) -> dict[str, str]:
+    """Env for worker (rtype, index). Coordinator is always the rank-0
+    process on localhost (single-host control plane; multi-host uses the
+    worker-0 address the same way the reference uses headless-service DNS)."""
+    override = replicas_override or {}
+    ranks = _flat_ranks(job, override)
+    world = len(ranks)
+    rank = ranks.index((rtype, index))
+    coord = f"127.0.0.1:{coordinator_port}"
+
+    env = {
+        ENV_JOB_NAME: job.name,
+        ENV_JOB_NAMESPACE: job.namespace,
+        ENV_REPLICA_TYPE: rtype.value,
+        ENV_REPLICA_INDEX: str(index),
+    }
+    if job.spec.checkpoint.dir:
+        env[ENV_CHECKPOINT_DIR] = job.spec.checkpoint.dir
+        env[ENV_RESUME] = "1" if job.spec.checkpoint.resume else "0"
+
+    if job.kind == JobKind.JAXJob:
+        env.update(
+            {
+                ENV_COORDINATOR: coord,
+                ENV_NUM_PROCESSES: str(world),
+                ENV_PROCESS_ID: str(rank),
+            }
+        )
+    elif job.kind == JobKind.TFJob:
+        cluster: dict[str, list[str]] = {}
+        for r, i in ranks:
+            cluster.setdefault(r.value.lower(), []).append(
+                f"127.0.0.1:{coordinator_port + 1 + ranks.index((r, i))}"
+            )
+        env["TF_CONFIG"] = json.dumps(
+            {
+                "cluster": cluster,
+                "task": {"type": rtype.value.lower(), "index": index},
+            }
+        )
+    elif job.kind in (JobKind.PyTorchJob, JobKind.XGBoostJob, JobKind.PaddleJob):
+        env.update(
+            {
+                "MASTER_ADDR": "127.0.0.1",
+                "MASTER_PORT": str(coordinator_port),
+                "WORLD_SIZE": str(world),
+                "RANK": str(rank),
+                "LOCAL_RANK": "0",
+                # torch_xla/PJRT path (BASELINE config #3): select the TPU
+                # PJRT device rather than CUDA.
+                "PJRT_DEVICE": "TPU",
+            }
+        )
+    elif job.kind == JobKind.MPIJob:
+        workers = [f"127.0.0.1 slots=1" for r, _ in ranks if r == ReplicaType.Worker]
+        env.update(
+            {
+                "KFTPU_HOSTFILE": "\n".join(workers),
+                "OMPI_MCA_orte_default_hostfile": "",  # hostfile passed via env
+                "KFTPU_WORLD_SIZE": str(world - 1),  # exclude launcher
+                "KFTPU_RANK": str(max(rank - 1, 0)),
+                ENV_COORDINATOR: coord,
+            }
+        )
+    return env

@@ -1,0 +1,201 @@
+"""Gang scheduler: all-or-nothing slice admission.
+
+Equivalent of the Volcano/Kueue PodGroup layer the reference delegates to
+(SURVEY.md layer L3, component T7): a job's replica gang is admitted only
+when the whole gang fits, otherwise it queues. TPU-first semantics
+(SURVEY.md 7.4 #3): chips requested by a replica are an indivisible slice,
+and the gang is admitted atomically -- there is no partial placement state
+at all, which is what prevents the deadlocks gang scheduling exists to
+solve (two jobs each holding half their pods' resources).
+
+The capacity model is deliberately simple: one pool of ``total_chips``
+TPU chips plus a host-process budget, with priority + FIFO ordering and
+per-queue accounting. This matches what the reference actually guarantees
+(minMember admission), without reimplementing Volcano's full queue/
+preemption machinery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Optional
+
+from kubeflow_tpu.api.types import TrainJob
+
+
+@dataclasses.dataclass
+class Reservation:
+    """An admitted gang's hold on capacity."""
+
+    job_key: str
+    chips: int
+    processes: int
+    queue: str
+    priority: int
+    admitted_at: float = dataclasses.field(default_factory=time.time)
+
+
+@dataclasses.dataclass(order=True)
+class _Pending:
+    # Sort key: higher priority first, then FIFO.
+    sort_key: tuple = dataclasses.field(init=False)
+    job_key: str = dataclasses.field(compare=False)
+    chips: int = dataclasses.field(compare=False)
+    processes: int = dataclasses.field(compare=False)
+    queue: str = dataclasses.field(compare=False)
+    priority: int = dataclasses.field(compare=False)
+    seq: int = dataclasses.field(compare=False)
+
+    def __post_init__(self) -> None:
+        self.sort_key = (-self.priority, self.seq)
+
+
+class GangScheduler:
+    """Tracks chip capacity; admits whole gangs or queues them."""
+
+    def __init__(self, total_chips: int, max_processes: int = 256) -> None:
+        self.total_chips = total_chips
+        self.max_processes = max_processes
+        self._reserved: dict[str, Reservation] = {}
+        self._pending: dict[str, _Pending] = {}
+        self._seq = itertools.count()
+
+    # -- capacity ---------------------------------------------------------
+
+    @property
+    def used_chips(self) -> int:
+        return sum(r.chips for r in self._reserved.values())
+
+    @property
+    def free_chips(self) -> int:
+        return self.total_chips - self.used_chips
+
+    @property
+    def used_processes(self) -> int:
+        return sum(r.processes for r in self._reserved.values())
+
+    def _fits(self, chips: int, processes: int) -> bool:
+        return (
+            chips <= self.free_chips
+            and processes <= self.max_processes - self.used_processes
+        )
+
+    # -- admission --------------------------------------------------------
+
+    def demand(self, job: TrainJob, replicas_override: Optional[int] = None) -> tuple[int, int]:
+        """(chips, processes) a job's gang needs.
+
+        ``replicas_override`` supports elastic re-formation at a different
+        worker count (applies to the Worker replica type).
+        """
+        chips = 0
+        processes = 0
+        for rtype, rs in job.spec.replica_specs.items():
+            n = rs.replicas
+            if replicas_override is not None and rtype.value == "Worker":
+                n = replicas_override
+            chips += n * rs.resources.tpu
+            processes += n
+        return chips, processes
+
+    def try_admit(
+        self, job: TrainJob, replicas_override: Optional[int] = None
+    ) -> Optional[Reservation]:
+        """Atomically admit the whole gang, or enqueue and return None.
+
+        An unfittable-by-definition gang (more chips than the cluster has,
+        even at elastic minimum) raises ValueError so the caller can fail
+        the job instead of queueing it forever.
+        """
+        key = job.key
+        if key in self._reserved:
+            return self._reserved[key]
+        chips, processes = self.demand(job, replicas_override)
+        min_chips = chips
+        if job.spec.elastic is not None and replicas_override is None:
+            min_chips, _ = self.demand(job, job.spec.elastic.min_replicas)
+        if min_chips > self.total_chips or processes > self.max_processes:
+            raise ValueError(
+                f"gang for {key} needs {min_chips} chips / {processes} processes; "
+                f"cluster has {self.total_chips} chips / {self.max_processes} processes"
+            )
+        sched = job.spec.run_policy.scheduling
+        # A gang may not jump past pending gangs that sort before it
+        # (priority, then FIFO): without this, small jobs backfill forever
+        # and big slices starve.
+        mine = self._pending.get(key)
+        blocked = any(
+            (p.sort_key < mine.sort_key if mine is not None
+             else p.priority >= sched.priority)
+            for p in self._pending.values()
+            if p.job_key != key
+        )
+        if not blocked and self._fits(chips, processes):
+            res = Reservation(
+                job_key=key,
+                chips=chips,
+                processes=processes,
+                queue=sched.queue,
+                priority=sched.priority,
+            )
+            self._reserved[key] = res
+            self._pending.pop(key, None)
+            return res
+        if key not in self._pending:
+            self._pending[key] = _Pending(
+                job_key=key,
+                chips=chips,
+                processes=processes,
+                queue=sched.queue,
+                priority=sched.priority,
+                seq=next(self._seq),
+            )
+        return None
+
+    def best_fit_workers(self, job: TrainJob) -> Optional[int]:
+        """Largest Worker count in [elastic.min, spec replicas) whose gang
+        fits free capacity right now; None if even the minimum doesn't fit
+        (or the job isn't elastic)."""
+        el = job.spec.elastic
+        if el is None:
+            return None
+        from kubeflow_tpu.api.types import ReplicaType
+
+        spec_n = job.spec.replica_specs.get(ReplicaType.Worker)
+        if spec_n is None:
+            return None
+        for n in range(min(spec_n.replicas - 1, el.max_replicas), el.min_replicas - 1, -1):
+            chips, procs = self.demand(job, n)
+            if self._fits(chips, procs):
+                return n
+        return None
+
+    def release(self, job_key: str) -> None:
+        self._reserved.pop(job_key, None)
+        self._pending.pop(job_key, None)
+
+    def admissible(self) -> list[str]:
+        """Pending job keys that would fit right now, in scheduling order.
+
+        Strict priority+FIFO: a large gang at the head of the queue blocks
+        smaller later gangs (no backfill), matching gang semantics -- the
+        alternative starves big slices forever.
+        """
+        out = []
+        free_c, free_p = self.free_chips, self.max_processes - self.used_processes
+        for p in sorted(self._pending.values()):
+            if p.chips <= free_c and p.processes <= free_p:
+                out.append(p.job_key)
+                free_c -= p.chips
+                free_p -= p.processes
+            else:
+                break
+        return out
+
+    def pending(self) -> list[str]:
+        return [p.job_key for p in sorted(self._pending.values())]
+
+    def reservation(self, job_key: str) -> Optional[Reservation]:
+        return self._reserved.get(job_key)

@@ -1,0 +1,237 @@
+"""Worker process launchers.
+
+The reconciler's "kubelet": turns a ProcessTemplate into a running local
+process with injected env. Two implementations:
+
+- ``ProcessLauncher``: real asyncio subprocesses, stdout/stderr captured to
+  per-worker log files (the ``kubectl logs`` data source).
+- ``FakeLauncher``: records spawn/kill requests and lets tests script exit
+  codes -- the analog of the reference's fake clientsets (SURVEY.md 7.3:
+  controllers are tested as pure object transformers with a fake process
+  launcher).
+
+Both deliver exits through an exit callback, so the reconciler is purely
+event-driven (no polling on the 1-vCPU host).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import os
+import signal
+import sys
+from typing import Awaitable, Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+ExitCallback = Callable[["WorkerRef", int], Awaitable[None]]
+
+
+@dataclasses.dataclass(frozen=True)
+class SpawnRequest:
+    """Everything needed to start one worker process."""
+
+    job_key: str  # namespace/name
+    replica_type: str
+    index: int
+    entrypoint: str  # python module path, or executable when exec_
+    args: tuple[str, ...] = ()
+    env: tuple[tuple[str, str], ...] = ()  # injected env (sorted tuples: hashable)
+    workdir: Optional[str] = None
+    exec_: bool = False
+    log_path: Optional[str] = None
+
+    @property
+    def worker_id(self) -> str:
+        return f"{self.job_key}/{self.replica_type.lower()}-{self.index}"
+
+
+@dataclasses.dataclass
+class WorkerRef:
+    """Handle to a spawned worker."""
+
+    req: SpawnRequest
+    pid: int
+    # Monotonic spawn generation: a restarted worker gets a new ref; late
+    # exit callbacks for old generations are ignored by the reconciler.
+    generation: int = 0
+    alive: bool = True
+    exit_code: Optional[int] = None
+
+    @property
+    def worker_id(self) -> str:
+        return self.req.worker_id
+
+
+class BaseLauncher:
+    """Interface shared by real and fake launchers."""
+
+    def __init__(self) -> None:
+        self._exit_cb: Optional[ExitCallback] = None
+
+    def set_exit_callback(self, cb: ExitCallback) -> None:
+        self._exit_cb = cb
+
+    async def spawn(self, req: SpawnRequest) -> WorkerRef:
+        raise NotImplementedError
+
+    async def kill(self, ref: WorkerRef, grace_seconds: float = 5.0) -> None:
+        raise NotImplementedError
+
+    async def shutdown(self) -> None:
+        """Kill everything still running (controller teardown)."""
+        raise NotImplementedError
+
+
+class ProcessLauncher(BaseLauncher):
+    """Real subprocess launcher.
+
+    Workers run ``python -m <entrypoint> <args>`` (or the raw executable for
+    exec templates) with the parent env plus the injected rendezvous env.
+    Each worker's exit is awaited by a dedicated task that fires the exit
+    callback -- event-driven, like kubelet pod-phase updates feeding the
+    reference's informers.
+    """
+
+    def __init__(self, log_dir: Optional[str] = None) -> None:
+        super().__init__()
+        self.log_dir = log_dir
+        self._procs: dict[str, tuple[WorkerRef, asyncio.subprocess.Process]] = {}
+        self._waiters: set[asyncio.Task] = set()
+        self._generation = 0
+
+    async def spawn(self, req: SpawnRequest) -> WorkerRef:
+        if req.exec_:
+            cmd = [req.entrypoint, *req.args]
+        else:
+            cmd = [sys.executable, "-m", req.entrypoint, *req.args]
+        env = dict(os.environ)
+        env.update(dict(req.env))
+
+        log_path = req.log_path
+        if log_path is None and self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            safe = req.worker_id.replace("/", "_")
+            log_path = os.path.join(self.log_dir, f"{safe}.log")
+        if log_path:
+            os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+            out = open(log_path, "ab")
+        else:
+            out = None
+
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                *cmd,
+                env=env,
+                cwd=req.workdir,
+                stdout=out or asyncio.subprocess.DEVNULL,
+                stderr=asyncio.subprocess.STDOUT,
+                start_new_session=True,  # own process group: clean gang kill
+            )
+        finally:
+            if out is not None:
+                out.close()  # subprocess holds its own fd now
+
+        self._generation += 1
+        ref = WorkerRef(req=req, pid=proc.pid, generation=self._generation)
+        self._procs[ref.worker_id] = (ref, proc)
+        logger.info("spawned %s pid=%d cmd=%s", ref.worker_id, proc.pid, cmd[:4])
+
+        task = asyncio.create_task(self._wait(ref, proc))
+        self._waiters.add(task)
+        task.add_done_callback(self._waiters.discard)
+        return ref
+
+    async def _wait(self, ref: WorkerRef, proc: asyncio.subprocess.Process) -> None:
+        code = await proc.wait()
+        ref.alive = False
+        ref.exit_code = code
+        cur = self._procs.get(ref.worker_id)
+        if cur is not None and cur[0] is ref:
+            del self._procs[ref.worker_id]
+        logger.info("worker %s exited code=%s", ref.worker_id, code)
+        if self._exit_cb is not None:
+            await self._exit_cb(ref, code)
+
+    async def kill(self, ref: WorkerRef, grace_seconds: float = 5.0) -> None:
+        entry = self._procs.get(ref.worker_id)
+        if entry is None or entry[0] is not ref or not ref.alive:
+            return
+        _, proc = entry
+        try:
+            # Kill the whole process group: workers may fork (data loaders).
+            os.killpg(proc.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            return
+        try:
+            await asyncio.wait_for(proc.wait(), grace_seconds)
+        except asyncio.TimeoutError:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            await proc.wait()
+
+    async def shutdown(self) -> None:
+        refs = [ref for ref, _ in self._procs.values()]
+        await asyncio.gather(
+            *(self.kill(r, grace_seconds=2.0) for r in refs), return_exceptions=True
+        )
+        for t in list(self._waiters):
+            if not t.done():
+                try:
+                    await asyncio.wait_for(t, 5.0)
+                except asyncio.TimeoutError:
+                    t.cancel()
+
+    def running(self) -> list[WorkerRef]:
+        return [ref for ref, _ in self._procs.values()]
+
+
+class FakeLauncher(BaseLauncher):
+    """Test launcher: records requests; tests script worker exits.
+
+    ``spawned`` / ``killed`` are the assertion surface. ``exit(worker_id,
+    code)`` simulates a worker finishing, firing the same callback path the
+    real launcher uses.
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.spawned: list[SpawnRequest] = []
+        self.killed: list[str] = []
+        self._live: dict[str, WorkerRef] = {}
+        self._next_pid = 1000
+
+    async def spawn(self, req: SpawnRequest) -> WorkerRef:
+        self.spawned.append(req)
+        self._next_pid += 1
+        ref = WorkerRef(req=req, pid=self._next_pid, generation=self._next_pid)
+        self._live[req.worker_id] = ref
+        return ref
+
+    async def kill(self, ref: WorkerRef, grace_seconds: float = 5.0) -> None:
+        if self._live.get(ref.worker_id) is ref and ref.alive:
+            self.killed.append(ref.worker_id)
+            ref.alive = False
+            ref.exit_code = -signal.SIGTERM
+            del self._live[ref.worker_id]
+            # Killed workers also report an exit, as real ones do.
+            if self._exit_cb is not None:
+                await self._exit_cb(ref, ref.exit_code)
+
+    async def exit(self, worker_id: str, code: int) -> None:
+        ref = self._live.pop(worker_id)
+        ref.alive = False
+        ref.exit_code = code
+        if self._exit_cb is not None:
+            await self._exit_cb(ref, code)
+
+    async def shutdown(self) -> None:
+        for ref in list(self._live.values()):
+            await self.kill(ref)
+
+    def running(self) -> list[WorkerRef]:
+        return list(self._live.values())

@@ -1,0 +1,557 @@
+"""JobController: the reconciler at the heart of the control plane.
+
+Equivalent of training-operator's shared JobController (SURVEY.md 3.1 T2 +
+call stack 4.1): watches job objects, admits their gang through the
+GangScheduler, spawns worker processes with injected rendezvous env,
+aggregates worker exits into JobStatus conditions, and drives restart /
+backoff / deadline / TTL policies.
+
+Event-driven by construction (SURVEY.md 7.4 #6: 1-vCPU host): the loop
+wakes on store watch events, worker exit callbacks, and explicitly
+scheduled timers (backoff requeues, deadlines) -- never on a poll.
+
+Gang failure semantics (TPU-first, SURVEY.md 7.4 #3): for kinds whose
+communication world is formed once at start (JAXJob, PyTorchJob, MPIJob,
+XGBoost/Paddle), one worker's retryable failure restarts the *whole gang*
+atomically -- a jax.distributed world cannot re-admit a single process.
+TFJob keeps the reference's per-replica restart (PS architecture tolerates
+worker churn). Elastic resize = spec update -> quiesce gang -> re-admit at
+the new size -> respawn with resume env (SURVEY.md 5.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kubeflow_tpu.api.types import (
+    CleanPodPolicy,
+    ConditionType,
+    JobKind,
+    ReplicaStatus,
+    ReplicaType,
+    TrainJob,
+)
+from kubeflow_tpu.api.validation import SUCCESS_POLICY_REPLICA
+from kubeflow_tpu.controller.envvars import rendezvous_env
+from kubeflow_tpu.controller.gang import GangScheduler
+from kubeflow_tpu.controller.launcher import BaseLauncher, SpawnRequest, WorkerRef
+from kubeflow_tpu.controller.restarts import should_restart
+from kubeflow_tpu.utils.ports import allocate_port
+
+logger = logging.getLogger(__name__)
+
+JOB_KINDS = [k.value for k in JobKind]
+
+# Kinds whose distributed world is formed once: worker failure => gang restart.
+GANG_RESTART_KINDS = {
+    JobKind.JAXJob,
+    JobKind.PyTorchJob,
+    JobKind.MPIJob,
+    JobKind.XGBoostJob,
+    JobKind.PaddleJob,
+}
+
+
+@dataclass
+class _JobRuntime:
+    """Controller-side state for one live job (never persisted)."""
+
+    key: str
+    coordinator_port: int
+    workers: dict[str, WorkerRef] = field(default_factory=dict)
+    succeeded: set[str] = field(default_factory=set)
+    failed: dict[str, int] = field(default_factory=dict)  # worker_id -> exit code
+    # World per the spec at formation time (detects user resizes) and the
+    # world actually formed (may be smaller under elastic reduced-size
+    # admission, SURVEY.md 5.3).
+    spec_world: tuple = ()
+    formed_world: tuple = ()
+    # Worker-count override the gang was formed at; None = full spec size.
+    formed_replicas: Optional[int] = None
+
+
+class JobController:
+    def __init__(
+        self,
+        store,
+        launcher: BaseLauncher,
+        gang: GangScheduler,
+        log_dir: Optional[str] = None,
+        backoff_base_seconds: float = 1.0,
+        backoff_max_seconds: float = 30.0,
+    ) -> None:
+        self.store = store
+        self.launcher = launcher
+        self.gang = gang
+        self.log_dir = log_dir
+        self.backoff_base = backoff_base_seconds
+        self.backoff_max = backoff_max_seconds
+        self._runtimes: dict[str, _JobRuntime] = {}
+        self._queue: asyncio.Queue[tuple[str, str, str]] = asyncio.Queue()
+        self._queued: set[tuple[str, str, str]] = set()
+        self._stopped = asyncio.Event()
+        self._event_seq = 0
+        # Gang-restart crash-loop protection: no respawn before this time.
+        self._backoff_until: dict[str, float] = {}
+        launcher.set_exit_callback(self._on_worker_exit)
+
+    # -- public lifecycle -------------------------------------------------
+
+    async def run(self) -> None:
+        """Main loop: initial sync, then process watch events + requeues."""
+        watch_q = self.store.watch()
+        for kind in JOB_KINDS:
+            for obj in self.store.list(kind):
+                self._enqueue(kind, obj["metadata"]["namespace"], obj["metadata"]["name"])
+        watcher = asyncio.create_task(self._pump_watch(watch_q))
+        try:
+            while not self._stopped.is_set():
+                get = asyncio.create_task(self._queue.get())
+                stop = asyncio.create_task(self._stopped.wait())
+                done, pending = await asyncio.wait(
+                    {get, stop}, return_when=asyncio.FIRST_COMPLETED
+                )
+                for t in pending:
+                    t.cancel()
+                if get in done:
+                    item = get.result()
+                    self._queued.discard(item)
+                    kind, ns, name = item
+                    try:
+                        await self._reconcile(kind, ns, name)
+                    except Exception:
+                        logger.exception("reconcile %s %s/%s failed", kind, ns, name)
+                        self._enqueue_later(2.0, kind, ns, name)
+        finally:
+            watcher.cancel()
+            self.store.unwatch(watch_q)
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        await self.launcher.shutdown()
+
+    async def _pump_watch(self, q: asyncio.Queue) -> None:
+        while True:
+            ev = await q.get()
+            if ev.kind in JOB_KINDS:
+                self._enqueue(ev.kind, ev.namespace, ev.name)
+
+    def _enqueue(self, kind: str, namespace: str, name: str) -> None:
+        item = (kind, namespace, name)
+        if item not in self._queued:
+            self._queued.add(item)
+            self._queue.put_nowait(item)
+
+    def _enqueue_later(self, delay: float, kind: str, namespace: str, name: str) -> None:
+        asyncio.get_running_loop().call_later(
+            delay, self._enqueue, kind, namespace, name
+        )
+
+    # -- exit callback (from launcher) ------------------------------------
+
+    async def _on_worker_exit(self, ref: WorkerRef, code: int) -> None:
+        rt = self._runtimes.get(ref.req.job_key)
+        if rt is None or rt.workers.get(ref.worker_id) is not ref:
+            return  # stale generation (already restarted / torn down)
+        del rt.workers[ref.worker_id]
+        if code == 0:
+            rt.succeeded.add(ref.worker_id)
+        else:
+            rt.failed[ref.worker_id] = code
+        ns, name = ref.req.job_key.split("/", 1)
+        # Kind is recoverable from the stored object; enqueue all kinds is
+        # wasteful, so look it up directly.
+        for kind in JOB_KINDS:
+            if self.store.get(kind, name, ns) is not None:
+                self._enqueue(kind, ns, name)
+                return
+
+    # -- reconcile --------------------------------------------------------
+
+    async def _reconcile(self, kind: str, namespace: str, name: str) -> None:
+        obj = self.store.get(kind, name, namespace)
+        key = f"{namespace}/{name}"
+        if obj is None:
+            await self._teardown(key, release=True)
+            return
+        job = TrainJob.from_dict(obj)
+        status_before = job.status.model_dump(mode="json")
+
+        if job.spec.run_policy.suspend:
+            await self._teardown(key, release=True)
+            job.status.set_condition(
+                ConditionType.Suspended, "JobSuspended", "spec.run_policy.suspend=true"
+            )
+            self._persist(kind, job, status_before)
+            return
+
+        if not job.status.has_condition(ConditionType.Created):
+            job.status.set_condition(ConditionType.Created, "JobCreated")
+            self._record_event(job, "JobCreated", "job accepted by controller")
+
+        if job.status.phase.value in ("Succeeded", "Failed"):
+            await self._handle_finished(kind, job, status_before)
+            return
+
+        # Deadline.
+        rp = job.spec.run_policy
+        if rp.active_deadline_seconds and job.status.start_time:
+            elapsed = time.time() - job.status.start_time
+            if elapsed > rp.active_deadline_seconds:
+                await self._fail_job(
+                    kind, job, status_before, "DeadlineExceeded",
+                    f"active for {elapsed:.0f}s > {rp.active_deadline_seconds}s",
+                )
+                return
+            self._enqueue_later(
+                rp.active_deadline_seconds - elapsed + 0.1, kind, namespace, name
+            )
+
+        rt = self._runtimes.get(key)
+        desired_full = self._desired_world(job)
+
+        if rt is not None and rt.spec_world and rt.spec_world != desired_full:
+            # User resized the spec: quiesce and re-form (SURVEY.md 5.3).
+            self._record_event(
+                job, "Resizing",
+                f"world {len(rt.spec_world)} -> {len(desired_full)} workers",
+            )
+            await self._teardown(key, release=True)
+            rt = None
+            job.status.set_condition(ConditionType.Restarting, "Resizing")
+            job.status.formed_replicas = None
+        elif rt is not None and rt.formed_replicas is not None and self._can_grow(job, rt):
+            # Formed at reduced size (elastic); full size now fits: grow.
+            self._record_event(
+                job, "ScalingUp",
+                f"capacity available: re-forming at {len(desired_full)} workers",
+            )
+            await self._teardown(key, release=True)
+            rt = None
+            job.status.set_condition(ConditionType.Restarting, "ScalingUp")
+
+        if rt is None:
+            admitted = await self._try_admit_and_spawn(kind, job)
+            if not admitted:
+                self._persist(kind, job, status_before)
+                return
+            rt = self._runtimes.get(key)
+            if rt is None:  # spawn failed and job was failed
+                return
+
+        await self._sync_status(kind, job, rt, status_before)
+
+    def _desired_world(
+        self, job: TrainJob, workers_override: Optional[int] = None
+    ) -> tuple:
+        out = []
+        for rtype, rs in sorted(
+            job.spec.replica_specs.items(), key=lambda kv: kv[0].value
+        ):
+            n = rs.replicas
+            if workers_override is not None and rtype == ReplicaType.Worker:
+                n = workers_override
+            out.extend((rtype.value, i) for i in range(n))
+        return tuple(out)
+
+    def _can_grow(self, job: TrainJob, rt: _JobRuntime) -> bool:
+        """Full-size gang would fit if this job's reservation were released."""
+        res = self.gang.reservation(job.key)
+        freed = res.chips if res else 0
+        chips, _ = self.gang.demand(job)
+        return chips <= self.gang.free_chips + freed
+
+    async def _try_admit_and_spawn(self, kind: str, job: TrainJob) -> bool:
+        desired = self._desired_world(job)
+        if not desired:
+            return False  # zero-replica job: nothing to run (suspended shape)
+        if time.time() < self._backoff_until.get(job.key, 0.0):
+            return False  # crash-loop backoff window; a timer re-enqueues us
+        try:
+            res = self.gang.try_admit(job)
+        except ValueError as e:
+            await self._fail_job(
+                kind, job, job.status.model_dump(mode="json"), "Unschedulable", str(e)
+            )
+            return False
+        workers_override: Optional[int] = None
+        if res is None and job.spec.elastic is not None:
+            # Elastic reduced-size admission: form at the largest worker
+            # count in [min_replicas, spec) that fits right now.
+            n = self.gang.best_fit_workers(job)
+            if n is not None:
+                res = self.gang.try_admit(job, replicas_override=n)
+                workers_override = n if res is not None else None
+        if res is None:
+            self._record_event(
+                job, "GangPending",
+                f"waiting for {self.gang.demand(job)[0]} chips "
+                f"(free: {self.gang.free_chips})",
+            )
+            return False
+
+        world = self._desired_world(job, workers_override)
+        port = allocate_port()
+        rt = _JobRuntime(
+            key=job.key,
+            coordinator_port=port,
+            spec_world=desired,
+            formed_world=world,
+            formed_replicas=workers_override,
+        )
+        self._runtimes[job.key] = rt
+        override_map = (
+            {ReplicaType.Worker: workers_override}
+            if workers_override is not None else None
+        )
+        try:
+            for rtype_s, i in world:
+                rtype = ReplicaType(rtype_s)
+                ref = await self._spawn_worker(job, rtype, i, port, override_map)
+                rt.workers[ref.worker_id] = ref
+        except Exception as e:
+            logger.exception("spawn failed for %s", job.key)
+            await self._teardown(job.key, release=True)
+            await self._fail_job(
+                kind, job, job.status.model_dump(mode="json"),
+                "SpawnFailed", f"{type(e).__name__}: {e}",
+            )
+            return False
+
+        if job.status.start_time is None:
+            job.status.start_time = time.time()
+        job.status.formed_replicas = len(world)
+        reason = "GangAdmitted" if workers_override is None else "GangAdmittedReduced"
+        job.status.set_condition(ConditionType.Running, reason)
+        self._record_event(
+            job, reason, f"spawned {len(world)} workers, coordinator :{port}"
+        )
+        return True
+
+    async def _spawn_worker(
+        self,
+        job: TrainJob,
+        rtype: ReplicaType,
+        index: int,
+        port: int,
+        replicas_override: Optional[dict[ReplicaType, int]] = None,
+    ) -> WorkerRef:
+        rs = job.spec.replica_specs[rtype]
+        env = dict(rs.template.env)
+        env.update(rendezvous_env(job, rtype, index, port, replicas_override))
+        req = SpawnRequest(
+            job_key=job.key,
+            replica_type=rtype.value,
+            index=index,
+            entrypoint=rs.template.entrypoint,
+            args=tuple(rs.template.args),
+            env=tuple(sorted(env.items())),
+            workdir=rs.template.workdir,
+            exec_=rs.template.exec_,
+        )
+        return await self.launcher.spawn(req)
+
+    async def _sync_status(
+        self, kind: str, job: TrainJob, rt: _JobRuntime, status_before: dict
+    ) -> None:
+        # Aggregate replica statuses.
+        for rtype, rs in job.spec.replica_specs.items():
+            st = ReplicaStatus()
+            for i in range(rs.replicas):
+                wid = f"{job.key}/{rtype.value.lower()}-{i}"
+                if wid in rt.succeeded:
+                    st.succeeded += 1
+                elif wid in rt.failed:
+                    st.failed += 1
+                elif wid in rt.workers:
+                    st.active += 1
+            job.status.replica_statuses[rtype] = st
+
+        # Success policy: rank 0 of the first success-deciding replica type.
+        success_types = SUCCESS_POLICY_REPLICA[job.kind]
+        lead = next(
+            (t for t in success_types if t in job.spec.replica_specs), None
+        )
+        lead_id = f"{job.key}/{lead.value.lower()}-0" if lead else None
+
+        if lead_id and lead_id in rt.succeeded:
+            job.status.set_condition(ConditionType.Succeeded, "JobSucceeded")
+            job.status.completion_time = time.time()
+            self._record_event(job, "JobSucceeded", f"{lead_id} exited 0")
+            await self._cleanup_finished(job, rt)
+            self._persist(kind, job, status_before)
+            return
+
+        if rt.failed:
+            await self._handle_failures(kind, job, rt, status_before)
+            return
+
+        self._persist(kind, job, status_before)
+
+    async def _handle_failures(
+        self, kind: str, job: TrainJob, rt: _JobRuntime, status_before: dict
+    ) -> None:
+        # Scan ALL failures deterministically (sorted by worker id): any
+        # worker whose own restart policy forbids restart fails the job,
+        # regardless of exit arrival order.
+        failures = sorted(rt.failed.items())
+        for wid, code in failures:
+            policy = job.spec.replica_specs[self._rtype_of(wid)].restart_policy
+            if not should_restart(policy, code):
+                await self._fail_job(
+                    kind, job, status_before, "WorkerFailed",
+                    f"{wid} exited {code} (policy {policy.value})",
+                )
+                return
+
+        wid, code = failures[0]
+        max_restarts = job.spec.run_policy.backoff_limit
+        if job.spec.elastic is not None:
+            max_restarts = max(max_restarts, job.spec.elastic.max_restarts)
+        if job.status.restart_count >= max_restarts:
+            await self._fail_job(
+                kind, job, status_before, "BackoffLimitExceeded",
+                f"{wid} exited {code}; restart {job.status.restart_count} >= "
+                f"limit {max_restarts}",
+            )
+            return
+
+        job.status.restart_count += 1
+        delay = min(
+            self.backoff_max,
+            self.backoff_base * (2 ** (job.status.restart_count - 1)),
+        )
+        if job.kind in GANG_RESTART_KINDS:
+            # Atomic gang restart: kill survivors, keep the reservation
+            # (the slice is ours), respawn after the backoff window --
+            # enforced via _backoff_until because persisting Restarting
+            # status immediately re-triggers reconcile via our own watch.
+            await self._teardown(job.key, release=False)
+            self._backoff_until[job.key] = time.time() + delay
+            job.status.set_condition(
+                ConditionType.Restarting, "GangRestart",
+                f"{wid} exited {code}; restart {job.status.restart_count}",
+            )
+            self._record_event(
+                job, "GangRestart", f"{wid} exited {code}; restarting whole gang"
+            )
+            self._enqueue_later(delay + 0.01, kind, job.namespace, job.name)
+        else:
+            # Per-replica restart (TFJob-style): respawn only the failed
+            # ones, immediately (kubelet-style container restart).
+            job.status.set_condition(
+                ConditionType.Restarting, "ReplicaRestart", f"{wid} exited {code}",
+            )
+            override_map = (
+                {ReplicaType.Worker: rt.formed_replicas}
+                if rt.formed_replicas is not None else None
+            )
+            for fwid, _ in failures:
+                frtype = self._rtype_of(fwid)
+                index = int(fwid.rsplit("-", 1)[1])
+                del rt.failed[fwid]
+                ref = await self._spawn_worker(
+                    job, frtype, index, rt.coordinator_port, override_map
+                )
+                rt.workers[ref.worker_id] = ref
+            job.status.set_condition(ConditionType.Running, "ReplicaRestarted")
+        self._persist(kind, job, status_before)
+
+    @staticmethod
+    def _rtype_of(worker_id: str) -> ReplicaType:
+        # worker_id = ns/name/type-index
+        stem = worker_id.rsplit("/", 1)[1].rsplit("-", 1)[0]
+        return ReplicaType(stem.capitalize() if stem != "ps" else "PS")
+
+    async def _fail_job(
+        self, kind: str, job: TrainJob, status_before: dict, reason: str, msg: str
+    ) -> None:
+        job.status.set_condition(ConditionType.Failed, reason, msg)
+        job.status.completion_time = time.time()
+        self._record_event(job, reason, msg)
+        rt = self._runtimes.get(job.key)
+        if rt:
+            await self._cleanup_finished(job, rt)
+        else:
+            self.gang.release(job.key)
+        self._persist(kind, job, status_before)
+
+    async def _cleanup_finished(self, job: TrainJob, rt: _JobRuntime) -> None:
+        policy = job.spec.run_policy.clean_pod_policy
+        if policy in (CleanPodPolicy.Running, CleanPodPolicy.All):
+            await self._teardown(job.key, release=True)
+        else:
+            # None: leave processes; still release capacity when all exit.
+            if not rt.workers:
+                self.gang.release(job.key)
+                self._runtimes.pop(job.key, None)
+
+    async def _handle_finished(self, kind: str, job: TrainJob, status_before: dict) -> None:
+        rt = self._runtimes.get(job.key)
+        if rt is not None:
+            await self._cleanup_finished(job, rt)
+        ttl = job.spec.run_policy.ttl_seconds_after_finished
+        if ttl is not None and job.status.completion_time:
+            remaining = job.status.completion_time + ttl - time.time()
+            if remaining <= 0:
+                self._record_event(job, "TTLExpired", "garbage-collecting job")
+                self.store.delete(kind, job.name, job.namespace)
+                return
+            self._enqueue_later(remaining + 0.1, kind, job.namespace, job.name)
+        self._persist(kind, job, status_before)
+
+    async def _teardown(self, key: str, release: bool) -> None:
+        rt = self._runtimes.pop(key, None)
+        if rt is not None:
+            refs = list(rt.workers.values())
+            rt.workers.clear()  # mark refs stale before killing
+            for ref in refs:
+                await self.launcher.kill(ref)
+        if release:
+            self.gang.release(key)
+            self._backoff_until.pop(key, None)
+        # Capacity freed: someone in the queue may now fit, and elastic jobs
+        # formed below spec size may be able to grow.
+        candidates = list(self.gang.admissible())
+        candidates += [
+            r.key for r in self._runtimes.values()
+            if r.formed_replicas is not None and r.key != key
+        ]
+        for cand in candidates:
+            ns, name = cand.split("/", 1)
+            for kind in JOB_KINDS:
+                if self.store.get(kind, name, ns) is not None:
+                    self._enqueue(kind, ns, name)
+                    break
+
+    # -- persistence helpers ----------------------------------------------
+
+    def _persist(self, kind: str, job: TrainJob, status_before: dict) -> None:
+        status_now = job.status.model_dump(mode="json")
+        if status_now == status_before:
+            return
+        obj = self.store.get(kind, job.name, job.namespace)
+        if obj is None:
+            return
+        obj["status"] = status_now
+        self.store.put(kind, obj)
+
+    def _record_event(self, job: TrainJob, reason: str, message: str) -> None:
+        self._event_seq += 1
+        self.store.put(
+            "Event",
+            {
+                "metadata": {
+                    "name": f"{job.name}-{self._event_seq}",
+                    "namespace": job.namespace,
+                },
+                "involved": job.key,
+                "reason": reason,
+                "message": message,
+                "time": time.time(),
+            },
+        )

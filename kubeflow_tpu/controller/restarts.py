@@ -1,0 +1,34 @@
+"""Restart policy helpers.
+
+Mirrors the reference's ExitCode restart-policy convention (SURVEY.md 5.3):
+a fixed set of exit codes is treated as transient/retryable; anything else
+under RestartPolicy.ExitCode is permanent.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api.types import RestartPolicy
+
+# Convention (reference pkg/controller.v1/common/pod.go [unverified]):
+# 1, 2: generic transient; 126-128: env/command issues that can heal on a
+# clean node; 130 (SIGINT), 137 (SIGKILL/OOM), 143 (SIGTERM): external kills
+# treated as preemption-like transients.
+RETRYABLE_EXIT_CODES = frozenset({1, 2, 126, 127, 128, 130, 137, 143})
+
+
+def is_retryable_exit(code: int) -> bool:
+    # Negative codes are -signum from the process runner: external signals
+    # are transient (preemption / fault injection).
+    return code < 0 or code in RETRYABLE_EXIT_CODES
+
+
+def should_restart(policy: RestartPolicy, exit_code: int) -> bool:
+    if policy == RestartPolicy.Always:
+        return True
+    if policy == RestartPolicy.Never:
+        return False
+    if policy == RestartPolicy.OnFailure:
+        return exit_code != 0
+    if policy == RestartPolicy.ExitCode:
+        return exit_code != 0 and is_retryable_exit(exit_code)
+    raise ValueError(f"unknown restart policy {policy}")

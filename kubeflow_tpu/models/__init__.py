@@ -1,0 +1,28 @@
+"""Model zoo for the BASELINE configs (SURVEY.md section 6):
+
+- mnist: CNN, config #1 (TFJob CPU baseline)
+- llama: Llama-3 family, config #2 (JAXJob) and #5 (serving)
+- bert: BERT-large, config #3 (PyTorchJob-shaped, runs on JAX runtime)
+- vit: ViT-B, config #4 (Katib HPO trials)
+
+All flax.linen, written mesh-agnostic with logical-axis annotations
+(kubeflow_tpu.parallel.sharding); bf16 activations on TPU.
+"""
+
+TASK_REGISTRY = {}
+
+
+def register_task(name):
+    def deco(fn):
+        TASK_REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def get_task(name, **kw):
+    # Import for registration side effects.
+    from kubeflow_tpu.models import bert, llama, mnist, vit  # noqa: F401
+
+    if name not in TASK_REGISTRY:
+        raise KeyError(f"unknown task {name!r}; have {sorted(TASK_REGISTRY)}")
+    return TASK_REGISTRY[name](**kw)

@@ -1,0 +1,1 @@
+"""BERT-large -- BASELINE config #3. Implemented in the bert milestone."""

@@ -1,0 +1,5 @@
+"""Llama-3 family -- BASELINE configs #2 (training) and #5 (serving).
+
+Implemented in the llama milestone; this module registers the task once
+the model lands.
+"""

@@ -1,0 +1,102 @@
+"""MNIST CNN -- BASELINE config #1 (the 1-worker CPU-baseline TFJob).
+
+Small flax CNN + data-parallel train step. Exists to exercise the full
+control-plane path (apply -> gang -> spawn -> train -> Succeeded) at
+trivial cost, exactly the role the MNIST TFJob plays in the reference's
+e2e suite (SURVEY.md 7.2).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models import register_task
+from kubeflow_tpu.runtime import data as datalib
+from kubeflow_tpu.runtime.task import TrainTask, host_to_global
+
+
+class CNN(nn.Module):
+    n_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(32, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.n_classes)(x)
+
+
+class MnistTask(TrainTask):
+    name = "mnist"
+
+    def __init__(self, batch_size: int = 64, lr: float = 1e-3) -> None:
+        self.batch_size = batch_size
+        self.lr = lr
+        self.tokens_per_step = batch_size  # examples/step
+        self.flops_per_token = None
+        self.model = CNN()
+
+    def init_state(self, rng: jax.Array, mesh: Mesh):
+        params = self.model.init(rng, jnp.zeros((1, 28, 28, 1), jnp.float32))
+        state = train_state.TrainState.create(
+            apply_fn=self.model.apply, params=params, tx=optax.adam(self.lr)
+        )
+        # Tiny model: replicate everywhere.
+        return jax.device_put(state, NamedSharding(mesh, P()))
+
+    def train_step_fn(self, mesh: Mesh):
+        batch_spec = NamedSharding(mesh, P(("data", "fsdp")))
+        repl = NamedSharding(mesh, P())
+
+        def step(state, images, labels):
+            def loss_fn(params):
+                logits = state.apply_fn(params, images)
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels
+                ).mean()
+                acc = (logits.argmax(-1) == labels).mean()
+                return loss, acc
+
+            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params
+            )
+            return state.apply_gradients(grads=grads), {"loss": loss, "accuracy": acc}
+
+        return jax.jit(
+            step,
+            in_shardings=(repl, batch_spec, batch_spec),
+            out_shardings=(repl, repl),
+            donate_argnums=(0,),
+        )
+
+    def data_iter(
+        self, num_processes: int, process_id: int, mesh: Mesh, seed: int = 0
+    ) -> Iterator[tuple[jax.Array, ...]]:
+        it = datalib.synthetic_images(
+            self.batch_size, num_processes=num_processes,
+            process_id=process_id, seed=seed,
+        )
+        img_spec = P(("data", "fsdp"))
+        for b in it:
+            yield (
+                host_to_global(mesh, img_spec, b.inputs),
+                host_to_global(mesh, img_spec, b.targets),
+            )
+
+
+@register_task("mnist")
+def make_mnist(**kw) -> MnistTask:
+    return MnistTask(**kw)

@@ -1,0 +1,1 @@
+"""ViT-B -- BASELINE config #4 (Katib trials). Implemented in the hpo milestone."""

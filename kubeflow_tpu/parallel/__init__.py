@@ -1,0 +1,15 @@
+"""Parallelism: mesh construction, sharding rules, context parallelism.
+
+TPU-first design (SURVEY.md 3.1 "parallelism strategies", 5.7): DP/FSDP/
+TP/SP are axes of one ``jax.sharding.Mesh``, not separate subsystems; XLA
+inserts the collectives (psum/all-gather/reduce-scatter) over ICI. The
+control plane's only parallelism job is injecting the coordinator env --
+everything else lives here, in the runtime.
+"""
+
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh  # noqa: F401
+from kubeflow_tpu.parallel.sharding import (  # noqa: F401
+    LogicalAxisRules,
+    logical_sharding,
+    with_logical_constraint,
+)

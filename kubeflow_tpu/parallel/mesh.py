@@ -1,0 +1,91 @@
+"""Device mesh construction.
+
+One mesh, four axes (SURVEY.md 7.1 step 1 + 5.7):
+
+- ``data``     -- pure data parallelism (batch split; gradients psum).
+- ``fsdp``     -- data parallelism with parameter sharding (ZeRO-3 style:
+                  params/optimizer sharded, all-gathered per layer).
+- ``tensor``   -- tensor/model parallelism (megatron-style within attention
+                  and MLP blocks; rides ICI's highest bandwidth).
+- ``sequence`` -- context parallelism slot for ring attention; reserved and
+                  defaulting to 1 (SURVEY.md 5.7).
+
+Multi-slice/multi-host DCN parallelism maps onto the ``data`` axis being
+outermost, which is XLA's expectation for the cheap-collective axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "fsdp", "sequence", "tensor")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Mesh axis sizes. -1 for ``data`` means "absorb remaining devices"."""
+
+    data: int = -1
+    fsdp: int = 1
+    sequence: int = 1
+    tensor: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        fixed = self.fsdp * self.sequence * self.tensor
+        if self.data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fsdp*sequence*tensor={fixed}"
+                )
+            return (n_devices // fixed, self.fsdp, self.sequence, self.tensor)
+        total = self.data * fixed
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {self.data}x{self.fsdp}x{self.sequence}x{self.tensor} "
+                f"needs {total} devices, have {n_devices}"
+            )
+        return (self.data, self.fsdp, self.sequence, self.tensor)
+
+
+def build_mesh(
+    config: MeshConfig = MeshConfig(),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the global mesh over all (or the given) devices.
+
+    Axis order is (data, fsdp, sequence, tensor) outer-to-inner: ``tensor``
+    varies fastest so it lands on directly-connected neighbor chips (ICI
+    torus locality); ``data`` is outermost so multi-slice DCN traffic is
+    restricted to the gradient all-reduce.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    shape = config.resolve(len(devs))
+    arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def single_device_mesh() -> Mesh:
+    """1x1x1x1 mesh: lets all model code be written mesh-agnostic."""
+    return build_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+
+
+def mesh_for(n_devices: int, *, fsdp: int = 1, tensor: int = 1, sequence: int = 1) -> Mesh:
+    return build_mesh(
+        MeshConfig(data=-1, fsdp=fsdp, sequence=sequence, tensor=tensor),
+        devices=jax.devices()[:n_devices],
+    )
+
+
+def validate_divisibility(global_batch: int, seq_len: int, mesh: Mesh) -> None:
+    data = mesh.shape["data"] * mesh.shape["fsdp"]
+    if global_batch % data != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by data*fsdp={data}")
+    seq = mesh.shape["sequence"]
+    if seq_len % max(seq, 1) != 0:
+        raise ValueError(f"seq len {seq_len} not divisible by sequence axis {seq}")

@@ -1,0 +1,80 @@
+"""Logical axis rules -> NamedSharding.
+
+Models annotate arrays with *logical* axis names ("batch", "embed",
+"heads", ...); one rules table maps logical names to mesh axes. Changing
+the parallelism layout means changing the table, not the model -- the
+idiomatic JAX replacement for the reference ecosystem's per-strategy
+launcher plumbing (SURVEY.md 3.1).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None for replicated)
+LogicalAxisRules = dict[str, Union[str, tuple[str, ...], None]]
+
+# Default rules for transformer training:
+# - batch over (data, fsdp): every data-parallel rank sees a batch shard.
+# - embed over fsdp: ZeRO-3-style parameter sharding.
+# - mlp/heads/kv over tensor: megatron partitioning.
+# - length over sequence: ring-attention context parallelism.
+DEFAULT_RULES: LogicalAxisRules = {
+    "batch": ("data", "fsdp"),
+    "length": "sequence",
+    "embed": "fsdp",
+    "mlp": "tensor",
+    "heads": "tensor",
+    "kv": None,
+    "vocab": "tensor",
+    "layers": None,
+}
+
+
+def spec_for(
+    logical_axes: Sequence[Optional[str]], rules: Optional[LogicalAxisRules] = None
+) -> P:
+    rules = DEFAULT_RULES if rules is None else rules
+    parts = []
+    used: set[str] = set()
+    for ax in logical_axes:
+        mesh_ax = rules.get(ax) if ax is not None else None
+        # A mesh axis may appear at most once in a spec; later duplicates
+        # fall back to replication.
+        if mesh_ax is None:
+            parts.append(None)
+            continue
+        axes = (mesh_ax,) if isinstance(mesh_ax, str) else tuple(mesh_ax)
+        fresh = tuple(a for a in axes if a not in used)
+        used.update(fresh)
+        if not fresh:
+            parts.append(None)
+        elif len(fresh) == 1:
+            parts.append(fresh[0])
+        else:
+            parts.append(fresh)
+    return P(*parts)
+
+
+def logical_sharding(
+    mesh: Mesh,
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[LogicalAxisRules] = None,
+) -> NamedSharding:
+    return NamedSharding(mesh, spec_for(logical_axes, rules))
+
+
+def with_logical_constraint(
+    x: jax.Array,
+    logical_axes: Sequence[Optional[str]],
+    mesh: Optional[Mesh] = None,
+    rules: Optional[LogicalAxisRules] = None,
+) -> jax.Array:
+    """Annotate an intermediate with a sharding constraint inside jit."""
+    spec = spec_for(logical_axes, rules)
+    if mesh is not None:
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    return jax.lax.with_sharding_constraint(x, spec)

@@ -1,0 +1,7 @@
+"""In-worker training runtime.
+
+What the reference leaves to user containers (SURVEY.md section 1: Kubeflow
+never touches tensors), this framework owns: distributed bootstrap from the
+injected env, mesh construction, the training loop with MFU/throughput
+metric lines, and orbax checkpoint/resume.
+"""

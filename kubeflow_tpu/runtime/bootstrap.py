@@ -1,0 +1,73 @@
+"""Worker bootstrap: from injected env to an initialized JAX world.
+
+The in-container half of the rendezvous contract (SURVEY.md 3.5, 5.8): the
+controller injects JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+JAX_PROCESS_ID (kubeflow_tpu.controller.envvars); this module reads them
+and calls ``jax.distributed.initialize`` -- the entire replacement for
+NCCL world-building. Intra-slice collectives need zero further setup: XLA
+compiles them over ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerContext:
+    job_name: str
+    namespace: str
+    replica_type: str
+    replica_index: int
+    num_processes: int
+    process_id: int
+    coordinator: Optional[str]
+    checkpoint_dir: Optional[str]
+    resume: bool
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def read_context() -> WorkerContext:
+    env = os.environ
+    return WorkerContext(
+        job_name=env.get("KFTPU_JOB_NAME", "standalone"),
+        namespace=env.get("KFTPU_JOB_NAMESPACE", "default"),
+        replica_type=env.get("KFTPU_REPLICA_TYPE", "Worker"),
+        replica_index=int(env.get("KFTPU_REPLICA_INDEX", "0")),
+        num_processes=int(env.get("JAX_NUM_PROCESSES", "1")),
+        process_id=int(env.get("JAX_PROCESS_ID", "0")),
+        coordinator=env.get("JAX_COORDINATOR_ADDRESS"),
+        checkpoint_dir=env.get("KFTPU_CHECKPOINT_DIR") or None,
+        resume=env.get("KFTPU_RESUME", "1") == "1",
+    )
+
+
+def initialize(ctx: Optional[WorkerContext] = None) -> WorkerContext:
+    """Form the JAX world. Idempotent; safe for single-process jobs.
+
+    Multi-process: dial the coordinator (worker-0) exactly as the reference's
+    torch workers dial MASTER_ADDR -- but afterwards there is no per-op
+    transport to configure; the mesh + pjit handle the rest.
+    """
+    ctx = ctx or read_context()
+    if ctx.num_processes > 1:
+        import jax
+
+        logger.info(
+            "jax.distributed.initialize coordinator=%s procs=%d id=%d",
+            ctx.coordinator, ctx.num_processes, ctx.process_id,
+        )
+        jax.distributed.initialize(
+            coordinator_address=ctx.coordinator,
+            num_processes=ctx.num_processes,
+            process_id=ctx.process_id,
+        )
+    return ctx

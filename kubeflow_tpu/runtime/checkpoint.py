@@ -1,0 +1,79 @@
+"""Checkpoint/resume via orbax (SURVEY.md 5.4).
+
+First-class in this framework (the reference delegates checkpointing to
+user code): the runtime saves sharded checkpoints on an interval and the
+reconciler's restart path simply re-runs the worker, which restores the
+latest step here -- including *resharding* restores after an elastic
+resize (orbax restores to whatever sharding the new mesh dictates).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class Checkpointer:
+    """Thin orbax CheckpointManager wrapper bound to one job's directory."""
+
+    def __init__(self, directory: Optional[str], interval_steps: int = 100,
+                 keep: int = 3, enable_async: bool = True) -> None:
+        self.directory = directory
+        self._mgr = None
+        if directory:
+            import orbax.checkpoint as ocp
+
+            os.makedirs(directory, exist_ok=True)
+            self._mgr = ocp.CheckpointManager(
+                os.path.abspath(directory),
+                options=ocp.CheckpointManagerOptions(
+                    save_interval_steps=interval_steps,
+                    max_to_keep=keep,
+                    enable_async_checkpointing=enable_async,
+                    create=True,
+                ),
+            )
+
+    @property
+    def enabled(self) -> bool:
+        return self._mgr is not None
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step() if self._mgr else None
+
+    def maybe_save(self, step: int, state: Any, force: bool = False) -> bool:
+        """Save if the interval policy says so. Async: returns immediately."""
+        if not self._mgr:
+            return False
+        import orbax.checkpoint as ocp
+
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+
+    def restore(self, step: Optional[int], target: Any) -> Any:
+        """Restore ``step`` (or latest) into the sharding/structure of
+        ``target`` -- the resharding path for elastic resize."""
+        if not self._mgr:
+            return target
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return target
+        import orbax.checkpoint as ocp
+
+        logger.info("restoring checkpoint step=%d from %s", step, self.directory)
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(target)
+        )
+
+    def wait(self) -> None:
+        if self._mgr:
+            self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        if self._mgr:
+            self._mgr.wait_until_finished()
+            self._mgr.close()

@@ -1,0 +1,67 @@
+"""Data pipelines.
+
+Synthetic, deterministic, infinite iterators -- the target environment has
+zero egress (SURVEY.md 7.0), so benchmark/training data is generated on
+host and staged to device. Each pipeline yields process-local shards: with
+N data-parallel processes, process i gets the i-th slice of the global
+batch, matching how jax.make_array_from_process_local_data assembles the
+global array.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Batch:
+    """Host-side numpy batch; .inputs/.targets semantics per task."""
+
+    inputs: np.ndarray
+    targets: np.ndarray
+
+
+def synthetic_images(
+    global_batch: int,
+    shape: tuple[int, ...] = (28, 28, 1),
+    n_classes: int = 10,
+    num_processes: int = 1,
+    process_id: int = 0,
+    seed: int = 0,
+) -> Iterator[Batch]:
+    """MNIST-shaped synthetic data with a learnable signal: the label is
+    encoded in the mean brightness, so loss decreases if training works."""
+    if global_batch % num_processes:
+        raise ValueError(f"batch {global_batch} % processes {num_processes} != 0")
+    local = global_batch // num_processes
+    rng = np.random.default_rng(seed * 1000003 + process_id)
+    while True:
+        labels = rng.integers(0, n_classes, size=(local,))
+        imgs = rng.normal(0.0, 0.3, size=(local, *shape)).astype(np.float32)
+        imgs += (labels / n_classes).reshape((local,) + (1,) * len(shape))
+        yield Batch(inputs=imgs, targets=labels.astype(np.int32))
+
+
+def synthetic_tokens(
+    global_batch: int,
+    seq_len: int,
+    vocab_size: int,
+    num_processes: int = 1,
+    process_id: int = 0,
+    seed: int = 0,
+) -> Iterator[Batch]:
+    """LM token streams with local structure (next token correlates with
+    current), so cross-entropy is reducible below log(V)."""
+    if global_batch % num_processes:
+        raise ValueError(f"batch {global_batch} % processes {num_processes} != 0")
+    local = global_batch // num_processes
+    rng = np.random.default_rng(seed * 7340033 + process_id)
+    while True:
+        base = rng.integers(0, vocab_size, size=(local, 1))
+        steps = rng.integers(0, 17, size=(local, seq_len))
+        toks = (base + np.cumsum(steps, axis=1)) % vocab_size
+        toks = toks.astype(np.int32)
+        yield Batch(inputs=toks[:, :-1], targets=toks[:, 1:])

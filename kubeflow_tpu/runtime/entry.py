@@ -1,0 +1,131 @@
+"""Generic training entrypoint: ``python -m kubeflow_tpu.runtime.entry``.
+
+What runs inside every training worker (the analog of the user container's
+torchrun script in the reference, SURVEY.md call stack 4.1): bootstrap the
+world from injected env, build the mesh, run the task's train loop with
+metric lines and orbax checkpointing, exit 0 on completion.
+
+Fault injection (SURVEY.md 5.3): KFTPU_FAULT_STEP/KFTPU_FAULT_RANK make a
+chosen rank die with exit code 137 at a chosen step -- the deterministic
+stand-in for a preempted worker in restart/resume tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+logger = logging.getLogger(__name__)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("kubeflow_tpu worker")
+    p.add_argument("--model", required=True)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--tensor", type=int, default=1)
+    p.add_argument("--sequence", type=int, default=1)
+    p.add_argument(
+        "--arg", action="append", default=[],
+        help="task kwargs, key=value (int/float autocast)", metavar="K=V",
+    )
+    return p.parse_args(argv)
+
+
+def _cast(v: str):
+    for t in (int, float):
+        try:
+            return t(v)
+        except ValueError:
+            pass
+    return v
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    args = parse_args(argv)
+
+    from kubeflow_tpu.runtime import bootstrap
+
+    ctx = bootstrap.initialize()
+
+    import jax
+
+    from kubeflow_tpu.models import get_task
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubeflow_tpu.runtime.checkpoint import Checkpointer
+    from kubeflow_tpu.runtime.metrics import MetricLogger
+
+    task_kwargs = dict(kv.split("=", 1) for kv in args.arg)
+    task_kwargs = {k: _cast(v) for k, v in task_kwargs.items()}
+    task = get_task(args.model, **task_kwargs)
+
+    mesh = build_mesh(
+        MeshConfig(data=-1, fsdp=args.fsdp, sequence=args.sequence, tensor=args.tensor)
+    )
+    n_chips = len(jax.devices())
+    logger.info(
+        "worker %s/%s rank %d/%d mesh %s devices %d",
+        ctx.job_name, ctx.replica_index, ctx.process_id, ctx.num_processes,
+        dict(mesh.shape), n_chips,
+    )
+
+    fault_step = int(os.environ.get("KFTPU_FAULT_STEP", "-1"))
+    fault_rank = int(os.environ.get("KFTPU_FAULT_RANK", "0"))
+
+    with mesh:
+        rng = jax.random.PRNGKey(args.seed)
+        state = task.init_state(rng, mesh)
+        step_fn = task.train_step_fn(mesh)
+        ckpt = Checkpointer(
+            ctx.checkpoint_dir,
+            interval_steps=int(os.environ.get("KFTPU_CKPT_INTERVAL", "100")),
+            keep=int(os.environ.get("KFTPU_CKPT_KEEP", "3")),
+        )
+        start_step = 0
+        if ckpt.enabled and ctx.resume and ckpt.latest_step() is not None:
+            state = ckpt.restore(None, state)
+            start_step = int(ckpt.latest_step()) + 1
+            logger.info("resumed from checkpoint at step %d", start_step)
+
+        mlog = MetricLogger(
+            enabled=ctx.process_id == 0,
+            flops_per_token=task.flops_per_token,
+            n_chips=jax.device_count(),  # global chips across the world
+        )
+        mlog.emit(event="train_start", model=task.name, start_step=start_step,
+                  steps=args.steps, world=ctx.num_processes)
+
+        data = task.data_iter(ctx.num_processes, ctx.process_id, mesh, args.seed)
+        metrics = {}
+        for step in range(start_step, args.steps):
+            batch = next(data)
+            if step == fault_step and ctx.process_id == fault_rank:
+                logger.error("fault injection: rank %d dying at step %d",
+                             ctx.process_id, step)
+                ckpt.wait()
+                os._exit(137)
+            state, metrics = step_fn(state, *batch)
+            ckpt.maybe_save(step, state)
+            if step % args.log_every == 0 or step == args.steps - 1:
+                mlog.log_step(
+                    step, float(metrics["loss"]),
+                    tokens=task.tokens_per_step,
+                    **{k: f"{float(v):.4f}" for k, v in metrics.items()
+                       if k != "loss"},
+                )
+        if ckpt.enabled:
+            ckpt.maybe_save(args.steps - 1, state, force=True)
+            ckpt.close()
+        final_loss = float(metrics["loss"]) if metrics else float("nan")
+        mlog.emit(event="train_end", final_step=args.steps - 1,
+                  final_loss=f"{final_loss:.6f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

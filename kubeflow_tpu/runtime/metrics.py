@@ -1,0 +1,110 @@
+"""Machine-parsable training metrics.
+
+One line per step on stdout (SURVEY.md 5.5): this is simultaneously the
+user-facing progress log, the HPO metrics-collector input (scraped by
+regex exactly as Katib's stdout collector K5 does), and the source of the
+north-star numbers (tokens/sec, MFU).
+
+Format: ``KFTPU-METRIC key=value key=value ...`` -- floats in repr form.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+import time
+from typing import Optional, TextIO
+
+PREFIX = "KFTPU-METRIC"
+_LINE_RE = re.compile(rf"^{PREFIX}\s+(.*)$")
+_KV_RE = re.compile(r"([A-Za-z0-9_./-]+)=([^\s]+)")
+
+# Peak dense bf16 FLOP/s per chip, for MFU accounting. v5e ("TPU v5 lite"):
+# 197 TFLOP/s bf16; v5p: 459. Selected by device_kind at runtime.
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "cpu": 1e11,  # nominal, keeps MFU finite in CPU tests
+}
+
+
+def peak_flops_per_chip() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for name, flops in PEAK_FLOPS.items():
+        if name.lower() in kind.lower():
+            return flops
+    return 197e12
+
+
+class MetricLogger:
+    """Emits metric lines; rank-0 only by default (one line per step/job)."""
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        stream: Optional[TextIO] = None,
+        flops_per_token: Optional[float] = None,
+        n_chips: int = 1,
+    ) -> None:
+        self.enabled = enabled
+        self.stream = stream or sys.stdout
+        self.flops_per_token = flops_per_token
+        self.n_chips = max(n_chips, 1)
+        self.peak = None
+        self._last_time: Optional[float] = None
+        self._last_step: Optional[int] = None
+
+    def log_step(self, step: int, loss: float, tokens: int = 0, **extra) -> None:
+        """``tokens`` is tokens (or examples) consumed *per step*; the
+        logger scales by the number of steps since the previous call."""
+        if not self.enabled:
+            return
+        now = time.perf_counter()
+        fields = {"step": step, "loss": f"{loss:.6f}"}
+        if self._last_time is not None and self._last_step is not None and tokens:
+            dsteps = max(step - self._last_step, 1)
+            dt = now - self._last_time
+            tps = tokens * dsteps / dt
+            fields["tokens_per_sec"] = f"{tps:.1f}"
+            fields["tokens_per_sec_per_chip"] = f"{tps / self.n_chips:.1f}"
+            fields["step_time_ms"] = f"{dt * 1e3 / dsteps:.1f}"
+            if self.flops_per_token:
+                if self.peak is None:
+                    self.peak = peak_flops_per_chip()
+                mfu = (tps * self.flops_per_token) / (self.peak * self.n_chips)
+                fields["mfu"] = f"{mfu:.4f}"
+        self._last_time = now
+        self._last_step = step
+        fields.update({k: v for k, v in extra.items()})
+        self.emit(**fields)
+
+    def emit(self, **fields) -> None:
+        if not self.enabled:
+            return
+        body = " ".join(f"{k}={v}" for k, v in fields.items())
+        print(f"{PREFIX} {body}", file=self.stream, flush=True)
+
+
+def parse_metric_line(line: str) -> Optional[dict[str, str]]:
+    """Parse one stdout line; None if it is not a metric line."""
+    m = _LINE_RE.match(line.strip())
+    if not m:
+        return None
+    return dict(_KV_RE.findall(m.group(1)))
+
+
+def transformer_flops_per_token(n_params: int, seq_len: int = 0, n_layers: int = 0,
+                                hidden: int = 0, with_attention: bool = True) -> float:
+    """Standard 6N + attention FLOPs-per-token accounting (training:
+    forward + backward). Attention term: 12 * L * H * S per token."""
+    flops = 6.0 * n_params
+    if with_attention and n_layers and hidden and seq_len:
+        flops += 12.0 * n_layers * hidden * seq_len
+    return flops

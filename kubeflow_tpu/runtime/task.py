@@ -1,0 +1,49 @@
+"""TrainTask: the contract between models and the generic train loop.
+
+A task owns its model, optimizer, data, and sharded train step; the entry
+loop (runtime.entry) owns bootstrap, checkpoint cadence, metrics, and exit
+codes. Adding a model family = implementing this class + registering it
+(models.register_task), nothing else.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class TrainTask(abc.ABC):
+    name: str = "task"
+    #: tokens (LM) or examples (classification) consumed per global step.
+    tokens_per_step: int = 0
+    #: FLOPs per token for MFU accounting; None disables MFU.
+    flops_per_token: Optional[float] = None
+
+    @abc.abstractmethod
+    def init_state(self, rng: jax.Array, mesh: Mesh) -> Any:
+        """Build the (sharded) train state on the mesh."""
+
+    @abc.abstractmethod
+    def train_step_fn(self, mesh: Mesh) -> Callable[..., tuple[Any, dict]]:
+        """Return the jitted step: (state, *batch_arrays) -> (state, metrics)."""
+
+    @abc.abstractmethod
+    def data_iter(
+        self, num_processes: int, process_id: int, mesh: Mesh, seed: int = 0
+    ) -> Iterator[tuple[jax.Array, ...]]:
+        """Yield device-ready global batch arrays."""
+
+
+def host_to_global(mesh: Mesh, spec: P, local_arr) -> jax.Array:
+    """Assemble a global array from this process's local shard.
+
+    Single-process: a plain device_put with the sharding (all shards local).
+    Multi-process: each process contributes its slice of the ``data`` axis.
+    """
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(local_arr, sharding)
+    return jax.make_array_from_process_local_data(sharding, local_arr)

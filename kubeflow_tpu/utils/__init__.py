@@ -1,0 +1,1 @@
+"""Shared utilities: ports, structured logging, metric-line format."""

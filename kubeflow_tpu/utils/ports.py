@@ -1,0 +1,24 @@
+"""Free-port allocation for coordinator rendezvous endpoints."""
+
+from __future__ import annotations
+
+import socket
+
+_allocated: set[int] = set()
+
+
+def allocate_port() -> int:
+    """Pick a free TCP port on localhost.
+
+    The OS-assigned ephemeral port is released before the worker binds it,
+    so there is a benign TOCTOU window; we additionally avoid handing out
+    the same port twice within this process (concurrent jobs).
+    """
+    for _ in range(16):
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        if port not in _allocated:
+            _allocated.add(port)
+            return port
+    raise RuntimeError("could not allocate a free port")

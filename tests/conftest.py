@@ -9,7 +9,18 @@ bench.py and __graft_entry__ run outside pytest on the real chip.
 
 import os
 
-# Must be set before jax is imported anywhere.
+import pathlib
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+# The axon sitecustomize (PYTHONPATH=/root/.axon_site) registers the TPU
+# PJRT plugin at interpreter startup and pins the platform, so setting
+# JAX_PLATFORMS=cpu here is too late for THIS process -- override via
+# jax.config instead. Worker subprocesses get a PYTHONPATH without the
+# axon site dir, so their env vars work normally.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -17,15 +28,15 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ["PYTHONPATH"] = str(REPO_ROOT)
 
-import pathlib
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.default_backend()
+assert len(jax.devices()) == 8, jax.devices()
 
 import pytest
-
-REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
-if str(REPO_ROOT) not in sys.path:
-    sys.path.insert(0, str(REPO_ROOT))
 
 
 @pytest.fixture()

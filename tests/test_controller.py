@@ -1,0 +1,471 @@
+"""Reconciler + gang scheduler tests with a fake launcher.
+
+Reference analog (SURVEY.md 7.3): controllers tested as object
+transformers against fake clientsets -- here, the FakeLauncher records
+spawns/kills and tests script worker exits.
+"""
+
+import asyncio
+
+import pytest
+
+from kubeflow_tpu.api import (
+    JobKind,
+    JobSpec,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    Resources,
+    TrainJob,
+    apply_defaults,
+    validate_job,
+)
+from kubeflow_tpu.api.types import ConditionType, ObjectMeta, RestartPolicy
+from kubeflow_tpu.controller import FakeLauncher, GangScheduler, JobController
+from kubeflow_tpu.store import ObjectStore
+
+
+def make_job(name="j1", kind=JobKind.JAXJob, replicas=2, tpu=1, **kw):
+    job = TrainJob(
+        kind=kind,
+        metadata=ObjectMeta(name=name),
+        spec=JobSpec(
+            replica_specs={
+                ReplicaType.Worker: ReplicaSpec(
+                    replicas=replicas,
+                    template=ProcessTemplate(entrypoint="fake.worker"),
+                    resources=Resources(tpu=tpu),
+                    restart_policy=kw.pop("restart_policy", RestartPolicy.OnFailure),
+                )
+            },
+            **kw,
+        ),
+    )
+    job = apply_defaults(job)
+    validate_job(job)
+    return job
+
+
+class Harness:
+    """Runs a JobController inside the test's event loop."""
+
+    def __init__(self, total_chips=8):
+        self.store = ObjectStore(":memory:")
+        self.launcher = FakeLauncher()
+        self.gang = GangScheduler(total_chips=total_chips)
+        self.ctl = JobController(
+            self.store, self.launcher, self.gang,
+            backoff_base_seconds=0.01, backoff_max_seconds=0.05,
+        )
+        self.task = None
+
+    async def __aenter__(self):
+        self.task = asyncio.create_task(self.ctl.run())
+        await asyncio.sleep(0)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.ctl.stop()
+        try:
+            await asyncio.wait_for(self.task, 2)
+        except asyncio.TimeoutError:
+            self.task.cancel()
+        self.store.close()
+
+    def submit(self, job):
+        self.store.put(job.kind.value, job.to_dict())
+
+    def job(self, name, kind="JAXJob", ns="default"):
+        obj = self.store.get(kind, name, ns)
+        return TrainJob.from_dict(obj) if obj else None
+
+    async def wait_phase(self, name, phase, kind="JAXJob", timeout=5.0):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            j = self.job(name, kind)
+            if j is not None and j.status.phase.value == phase:
+                return j
+            await asyncio.sleep(0.01)
+        j = self.job(name, kind)
+        raise AssertionError(
+            f"{name} never reached {phase}; now "
+            f"{j.status.phase.value if j else 'absent'}"
+        )
+
+    async def wait(self, pred, timeout=5.0, msg="condition"):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if pred():
+                return
+            await asyncio.sleep(0.01)
+        raise AssertionError(f"timeout waiting for {msg}")
+
+
+class TestAdmissionAndSpawn:
+    def test_spawn_env_injection(self):
+        async def run():
+            async with Harness() as h:
+                h.submit(make_job(replicas=3))
+                await h.wait_phase("j1", "Running")
+                assert len(h.launcher.spawned) == 3
+                envs = [dict(r.env) for r in h.launcher.spawned]
+                ids = sorted(int(e["JAX_PROCESS_ID"]) for e in envs)
+                assert ids == [0, 1, 2]
+                assert all(e["JAX_NUM_PROCESSES"] == "3" for e in envs)
+                coords = {e["JAX_COORDINATOR_ADDRESS"] for e in envs}
+                assert len(coords) == 1 and coords.pop().startswith("127.0.0.1:")
+                j = h.job("j1")
+                assert j.status.replica_statuses[ReplicaType.Worker].active == 3
+
+        asyncio.run(run())
+
+    def test_gang_queueing_fifo(self):
+        async def run():
+            async with Harness(total_chips=4) as h:
+                h.submit(make_job("big", replicas=4, tpu=1))
+                await h.wait_phase("big", "Running")
+                h.submit(make_job("next", replicas=4, tpu=1))
+                await h.wait(
+                    lambda: "default/next" in h.gang.pending(), msg="next queued"
+                )
+                assert h.job("next").status.phase.value == "Pending"
+                # Finish 'big': worker-0 exits 0 -> teardown frees chips ->
+                # 'next' admitted.
+                await h.launcher.exit("default/big/worker-0", 0)
+                await h.wait_phase("big", "Succeeded")
+                await h.wait_phase("next", "Running")
+
+        asyncio.run(run())
+
+    def test_unschedulable_fails(self):
+        async def run():
+            async with Harness(total_chips=4) as h:
+                h.submit(make_job("huge", replicas=16, tpu=1))
+                j = await h.wait_phase("huge", "Failed")
+                assert any(
+                    c.reason == "Unschedulable" for c in j.status.conditions
+                )
+
+        asyncio.run(run())
+
+
+class TestCompletion:
+    def test_success_on_worker0(self):
+        async def run():
+            async with Harness() as h:
+                h.submit(make_job(replicas=2))
+                await h.wait_phase("j1", "Running")
+                await h.launcher.exit("default/j1/worker-0", 0)
+                j = await h.wait_phase("j1", "Succeeded")
+                # cleanPodPolicy=Running: survivor killed.
+                assert "default/j1/worker-1" in h.launcher.killed
+                assert j.status.completion_time is not None
+                assert h.gang.free_chips == 8
+
+        asyncio.run(run())
+
+    def test_nonzero_exhausts_backoff_then_fails(self):
+        async def run():
+            async with Harness() as h:
+                job = make_job(replicas=2)
+                job.spec.run_policy.backoff_limit = 1
+                job.spec.elastic = None
+                h.submit(job)
+                await h.wait_phase("j1", "Running")
+                await h.launcher.exit("default/j1/worker-1", 1)
+                # Gang restart: both respawned.
+                await h.wait(
+                    lambda: len(h.launcher.spawned) == 4, msg="gang respawn"
+                )
+                await h.wait_phase("j1", "Running")
+                await h.launcher.exit("default/j1/worker-0", 1)
+                j = await h.wait_phase("j1", "Failed")
+                assert j.status.restart_count == 1
+                assert any(
+                    c.reason == "BackoffLimitExceeded" for c in j.status.conditions
+                )
+                assert h.gang.free_chips == 8
+
+        asyncio.run(run())
+
+    def test_restart_policy_never(self):
+        async def run():
+            async with Harness() as h:
+                h.submit(make_job(restart_policy=RestartPolicy.Never))
+                await h.wait_phase("j1", "Running")
+                await h.launcher.exit("default/j1/worker-1", 1)
+                j = await h.wait_phase("j1", "Failed")
+                assert any(c.reason == "WorkerFailed" for c in j.status.conditions)
+
+        asyncio.run(run())
+
+    def test_gang_restart_respawns_whole_world(self):
+        async def run():
+            async with Harness() as h:
+                h.submit(make_job(replicas=3))
+                await h.wait_phase("j1", "Running")
+                await h.launcher.exit("default/j1/worker-2", 137)
+                await h.wait(
+                    lambda: len(h.launcher.spawned) == 6, msg="full respawn"
+                )
+                j = await h.wait_phase("j1", "Running")
+                assert j.status.restart_count == 1
+                # Survivors were killed before respawn (gang atomicity).
+                assert "default/j1/worker-0" in h.launcher.killed
+                assert "default/j1/worker-1" in h.launcher.killed
+
+        asyncio.run(run())
+
+
+class TestTFJobPerReplicaRestart:
+    def test_worker_restart_keeps_others(self):
+        async def run():
+            async with Harness() as h:
+                job = TrainJob(
+                    kind=JobKind.TFJob,
+                    metadata=ObjectMeta(name="tf"),
+                    spec=JobSpec(
+                        replica_specs={
+                            ReplicaType.Chief: ReplicaSpec(
+                                replicas=1,
+                                template=ProcessTemplate(entrypoint="fake.tf"),
+                            ),
+                            ReplicaType.Worker: ReplicaSpec(
+                                replicas=2,
+                                template=ProcessTemplate(entrypoint="fake.tf"),
+                            ),
+                        }
+                    ),
+                )
+                h.submit(apply_defaults(job))
+                await h.wait_phase("tf", "Running", kind="TFJob")
+                assert len(h.launcher.spawned) == 3
+                await h.launcher.exit("default/tf/worker-1", 1)
+                await h.wait(
+                    lambda: len(h.launcher.spawned) == 4, msg="replica respawn"
+                )
+                # Only the failed worker respawned; chief/worker-0 untouched.
+                assert h.launcher.killed == []
+                j = await h.wait_phase("tf", "Running", kind="TFJob")
+                assert j.status.restart_count == 1
+                # TF_CONFIG injected.
+                env = dict(h.launcher.spawned[0].env)
+                assert "TF_CONFIG" in env
+                # Chief success finishes the job.
+                await h.launcher.exit("default/tf/chief-0", 0)
+                await h.wait_phase("tf", "Succeeded", kind="TFJob")
+
+        asyncio.run(run())
+
+
+class TestLifecycle:
+    def test_suspend_resumes(self):
+        async def run():
+            async with Harness() as h:
+                h.submit(make_job())
+                await h.wait_phase("j1", "Running")
+                j = h.job("j1")
+                j.spec.run_policy.suspend = True
+                h.submit(j)
+                await h.wait_phase("j1", "Suspended")
+                assert h.launcher.running() == []
+                assert h.gang.free_chips == 8
+                j = h.job("j1")
+                j.spec.run_policy.suspend = False
+                h.submit(j)
+                await h.wait_phase("j1", "Running")
+
+        asyncio.run(run())
+
+    def test_delete_tears_down(self):
+        async def run():
+            async with Harness() as h:
+                h.submit(make_job())
+                await h.wait_phase("j1", "Running")
+                h.store.delete("JAXJob", "j1")
+                await h.wait(
+                    lambda: h.launcher.running() == [], msg="teardown"
+                )
+                assert h.gang.free_chips == 8
+
+        asyncio.run(run())
+
+    def test_elastic_resize_reforms_world(self):
+        async def run():
+            async with Harness() as h:
+                from kubeflow_tpu.api import ElasticPolicy
+
+                job = make_job(replicas=2, elastic=ElasticPolicy(
+                    min_replicas=1, max_replicas=4, max_restarts=3
+                ))
+                h.submit(job)
+                await h.wait_phase("j1", "Running")
+                j = h.job("j1")
+                j.spec.replica_specs[ReplicaType.Worker].replicas = 4
+                h.submit(j)
+                await h.wait(
+                    lambda: len([
+                        r for r in h.launcher.spawned
+                        if dict(r.env).get("JAX_NUM_PROCESSES") == "4"
+                    ]) == 4,
+                    msg="re-formed at 4",
+                )
+                j = await h.wait_phase("j1", "Running")
+                assert j.status.formed_replicas == 4
+
+        asyncio.run(run())
+
+    def test_ttl_garbage_collects(self):
+        async def run():
+            async with Harness() as h:
+                job = make_job()
+                job.spec.run_policy.ttl_seconds_after_finished = 0
+                h.submit(job)
+                await h.wait_phase("j1", "Running")
+                await h.launcher.exit("default/j1/worker-0", 0)
+                await h.wait(lambda: h.job("j1") is None, msg="ttl delete")
+
+        asyncio.run(run())
+
+
+class TestGangScheduler:
+    def test_atomic_no_partial(self):
+        g = GangScheduler(total_chips=8)
+        j1 = make_job("a", replicas=6, tpu=1)
+        j2 = make_job("b", replicas=6, tpu=1)
+        assert g.try_admit(j1) is not None
+        assert g.try_admit(j2) is None  # queued, NOT partially placed
+        assert g.used_chips == 6
+        g.release("default/a")
+        assert g.admissible() == ["default/b"]
+
+    def test_priority_order(self):
+        g = GangScheduler(total_chips=4)
+        g.try_admit(make_job("hold", replicas=4, tpu=1))
+        low = make_job("low", replicas=2, tpu=1)
+        hi = make_job("hi", replicas=2, tpu=1)
+        hi.spec.run_policy.scheduling.priority = 10
+        assert g.try_admit(low) is None
+        assert g.try_admit(hi) is None
+        assert g.pending() == ["default/hi", "default/low"]
+
+    def test_no_backfill_past_head(self):
+        g = GangScheduler(total_chips=4)
+        g.try_admit(make_job("hold", replicas=2, tpu=1))
+        assert g.try_admit(make_job("big", replicas=4, tpu=1)) is None
+        assert g.try_admit(make_job("small", replicas=1, tpu=1)) is None
+        # 'small' would fit, but the gang at the head must not be starved.
+        assert g.admissible() == []
+
+
+class TestFailureSemantics:
+    def test_backoff_actually_delays_respawn(self):
+        async def run():
+            async with Harness() as h:
+                h.ctl.backoff_base = 0.3  # restart 1 -> 0.3s window
+                h.ctl.backoff_max = 0.3
+                h.submit(make_job(replicas=2))
+                await h.wait_phase("j1", "Running")
+                t0 = asyncio.get_event_loop().time()
+                await h.launcher.exit("default/j1/worker-0", 1)
+                await h.wait(
+                    lambda: len(h.launcher.spawned) == 4, msg="respawn"
+                )
+                elapsed = asyncio.get_event_loop().time() - t0
+                assert elapsed >= 0.25, f"respawned after only {elapsed:.3f}s"
+
+        asyncio.run(run())
+
+    def test_mixed_restart_policies_fail_deterministically(self):
+        async def run():
+            async with Harness() as h:
+                job = TrainJob(
+                    kind=JobKind.TFJob,
+                    metadata=ObjectMeta(name="tf"),
+                    spec=JobSpec(
+                        replica_specs={
+                            ReplicaType.PS: ReplicaSpec(
+                                replicas=1,
+                                template=ProcessTemplate(entrypoint="m"),
+                                restart_policy=RestartPolicy.Never,
+                            ),
+                            ReplicaType.Worker: ReplicaSpec(
+                                replicas=2,
+                                template=ProcessTemplate(entrypoint="m"),
+                                restart_policy=RestartPolicy.OnFailure,
+                            ),
+                        }
+                    ),
+                )
+                h.submit(apply_defaults(job))
+                await h.wait_phase("tf", "Running", kind="TFJob")
+                # Both fail before reconcile sees either; PS policy=Never
+                # must fail the job regardless of arrival order.
+                await h.launcher.exit("default/tf/worker-1", 1)
+                await h.launcher.exit("default/tf/ps-0", 1)
+                j = await h.wait_phase("tf", "Failed", kind="TFJob")
+                assert any(
+                    "ps-0" in c.message for c in j.status.conditions
+                    if c.reason == "WorkerFailed"
+                )
+
+        asyncio.run(run())
+
+    def test_spawn_failure_fails_job(self):
+        async def run():
+            async with Harness() as h:
+                # FakeLauncher that raises on the second spawn.
+                orig = h.launcher.spawn
+                calls = {"n": 0}
+
+                async def flaky(req):
+                    calls["n"] += 1
+                    if calls["n"] == 2:
+                        raise FileNotFoundError("no such entrypoint")
+                    return await orig(req)
+
+                h.launcher.spawn = flaky
+                h.submit(make_job(replicas=3))
+                j = await h.wait_phase("j1", "Failed")
+                assert any(
+                    c.reason == "SpawnFailed" for c in j.status.conditions
+                )
+                # No orphan processes, capacity fully released.
+                assert h.launcher.running() == []
+                assert h.gang.free_chips == 8
+
+        asyncio.run(run())
+
+
+class TestElasticAdmission:
+    def test_reduced_size_admission_then_grow(self):
+        async def run():
+            from kubeflow_tpu.api import ElasticPolicy
+
+            async with Harness(total_chips=8) as h:
+                h.submit(make_job("hog", replicas=6, tpu=1))
+                await h.wait_phase("hog", "Running")
+                # Elastic job wants 4 chips but only 2 free: forms at 2.
+                el = make_job("flex", replicas=4, tpu=1, elastic=ElasticPolicy(
+                    min_replicas=2, max_replicas=4, max_restarts=3
+                ))
+                h.submit(el)
+                j = await h.wait_phase("flex", "Running")
+                assert j.status.formed_replicas == 2
+                envs = [
+                    dict(r.env) for r in h.launcher.spawned
+                    if r.job_key == "default/flex"
+                ]
+                assert all(e["JAX_NUM_PROCESSES"] == "2" for e in envs)
+                # Hog finishes -> capacity frees -> flex grows to 4.
+                await h.launcher.exit("default/hog/worker-0", 0)
+                await h.wait_phase("hog", "Succeeded")
+                await h.wait(
+                    lambda: (lambda jj: jj is not None and
+                             jj.status.formed_replicas == 4)(h.job("flex")),
+                    msg="grow to 4",
+                )
+                j = h.job("flex")
+                assert j.status.has_condition(ConditionType.Running)
+
+        asyncio.run(run())

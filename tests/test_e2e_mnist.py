@@ -1,0 +1,89 @@
+"""E2E: the minimum end-to-end slice (SURVEY.md 7.2).
+
+Real ProcessLauncher: apply an MNIST job -> reconciler admits -> spawns a
+real worker subprocess running the training entrypoint -> metric lines in
+the worker log -> job Succeeded. Exercises spec -> store -> reconcile ->
+spawn -> env-inject -> runtime-bootstrap -> train -> status.
+"""
+
+import asyncio
+import pathlib
+
+import pytest
+
+from kubeflow_tpu.api import (
+    JobKind,
+    JobSpec,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    TrainJob,
+    apply_defaults,
+)
+from kubeflow_tpu.api.types import ObjectMeta
+from kubeflow_tpu.controller import GangScheduler, JobController, ProcessLauncher
+from kubeflow_tpu.runtime.metrics import parse_metric_line
+from kubeflow_tpu.store import ObjectStore
+
+
+@pytest.mark.e2e
+def test_mnist_job_end_to_end(tmp_path):
+    async def run():
+        store = ObjectStore(":memory:")
+        log_dir = str(tmp_path / "logs")
+        launcher = ProcessLauncher(log_dir=log_dir)
+        ctl = JobController(store, launcher, GangScheduler(total_chips=8))
+        task = asyncio.create_task(ctl.run())
+
+        job = apply_defaults(TrainJob(
+            kind=JobKind.TFJob,  # config #1 is TFJob-shaped
+            metadata=ObjectMeta(name="mnist-cnn"),
+            spec=JobSpec(
+                replica_specs={
+                    ReplicaType.Worker: ReplicaSpec(
+                        replicas=1,
+                        template=ProcessTemplate(
+                            entrypoint="kubeflow_tpu.runtime.entry",
+                            args=["--model", "mnist", "--steps", "6",
+                                  "--log-every", "2",
+                                  "--arg", "batch_size=16"],
+                        ),
+                    )
+                }
+            ),
+        ))
+        store.put("TFJob", job.to_dict())
+
+        deadline = asyncio.get_event_loop().time() + 120
+        phase = None
+        while asyncio.get_event_loop().time() < deadline:
+            obj = store.get("TFJob", "mnist-cnn")
+            phase = obj.get("status", {}).get("conditions", [])
+            j = TrainJob.from_dict(obj)
+            phase = j.status.phase.value
+            if phase in ("Succeeded", "Failed"):
+                break
+            await asyncio.sleep(0.2)
+
+        await ctl.stop()
+        try:
+            await asyncio.wait_for(task, 5)
+        except asyncio.TimeoutError:
+            task.cancel()
+
+        assert phase == "Succeeded", f"job ended {phase}"
+        # Worker log contains parseable metric lines with decreasing loss.
+        logs = list(pathlib.Path(log_dir).glob("*.log"))
+        assert logs, "no worker log written"
+        text = logs[0].read_text()
+        metrics = [m for m in map(parse_metric_line, text.splitlines()) if m]
+        steps = [m for m in metrics if "loss" in m and "step" in m]
+        assert len(steps) >= 3, text
+        assert float(steps[-1]["loss"]) < float(steps[0]["loss"]) * 1.5
+        # Events recorded: created, admitted, succeeded.
+        events = store.list("Event")
+        reasons = {e["reason"] for e in events}
+        assert {"JobCreated", "GangAdmitted", "JobSucceeded"} <= reasons
+        store.close()
+
+    asyncio.run(run())

@@ -1,0 +1,149 @@
+"""Runtime unit tests: mesh, sharding rules, metrics, checkpoint, task."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel import MeshConfig, build_mesh
+from kubeflow_tpu.parallel.sharding import spec_for
+from kubeflow_tpu.runtime.metrics import MetricLogger, parse_metric_line
+
+
+class TestMesh:
+    def test_resolve_absorbs_data(self):
+        mesh = build_mesh(MeshConfig(data=-1, fsdp=2, tensor=2))
+        assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "sequence": 1, "tensor": 2}
+
+    def test_bad_divisibility(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            build_mesh(MeshConfig(data=-1, fsdp=3))
+
+    def test_explicit_shape_mismatch(self):
+        with pytest.raises(ValueError, match="needs"):
+            build_mesh(MeshConfig(data=4, fsdp=4))
+
+    def test_axis_order(self):
+        mesh = build_mesh(MeshConfig())
+        assert mesh.axis_names == ("data", "fsdp", "sequence", "tensor")
+
+
+class TestShardingRules:
+    def test_default_rules(self):
+        # batch consumes fsdp, so a later embed (also fsdp) must replicate:
+        # a mesh axis may appear at most once per spec.
+        assert spec_for(("batch", "length", "embed")) == P(("data", "fsdp"), "sequence", None)
+        assert spec_for(("batch", None, "heads", "kv")) == P(("data", "fsdp"), None, "tensor", None)
+        # Without batch in the spec, embed shards over fsdp (parameters).
+        assert spec_for(("embed", "mlp")) == P("fsdp", "tensor")
+
+    def test_duplicate_mesh_axis_replicates(self):
+        # embed and vocab both map to axes already used -> later ones None.
+        spec = spec_for(("embed", "embed"))
+        assert spec == P("fsdp", None)
+
+    def test_sharded_matmul_runs(self):
+        mesh = build_mesh(MeshConfig(data=-1, fsdp=2, tensor=2))
+        x = jnp.ones((8, 16))
+        w = jnp.ones((16, 32))
+
+        @jax.jit
+        def f(x, w):
+            return x @ w
+
+        from jax.sharding import NamedSharding
+
+        xs = jax.device_put(x, NamedSharding(mesh, spec_for(("batch", "embed"))))
+        ws = jax.device_put(w, NamedSharding(mesh, spec_for(("embed", "mlp"))))
+        out = f(xs, ws)
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 32), 16.0))
+
+
+class TestMetrics:
+    def test_roundtrip(self):
+        buf = io.StringIO()
+        m = MetricLogger(stream=buf, n_chips=4)
+        m.log_step(0, 1.5, tokens=1000)
+        m.log_step(10, 1.2, tokens=1000, accuracy="0.5")
+        lines = buf.getvalue().strip().splitlines()
+        assert len(lines) == 2
+        d0 = parse_metric_line(lines[0])
+        assert d0["step"] == "0" and float(d0["loss"]) == 1.5
+        assert "tokens_per_sec" not in d0  # no interval yet
+        d1 = parse_metric_line(lines[1])
+        assert "tokens_per_sec" in d1 and "tokens_per_sec_per_chip" in d1
+        # 10 steps of 1000 tokens each within dt.
+        assert float(d1["tokens_per_sec"]) > 0
+        assert abs(
+            float(d1["tokens_per_sec_per_chip"]) - float(d1["tokens_per_sec"]) / 4
+        ) < 1.0
+        assert d1["accuracy"] == "0.5"
+
+    def test_parse_ignores_other_lines(self):
+        assert parse_metric_line("hello world") is None
+        assert parse_metric_line("KFTPU-METRIC step=1 loss=0.1")["step"] == "1"
+
+    def test_disabled_rank(self):
+        buf = io.StringIO()
+        m = MetricLogger(enabled=False, stream=buf)
+        m.log_step(0, 1.0)
+        assert buf.getvalue() == ""
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        from kubeflow_tpu.runtime.checkpoint import Checkpointer
+
+        state = {"w": jnp.arange(8, dtype=jnp.float32), "step": jnp.int32(7)}
+        c = Checkpointer(str(tmp_path / "ckpt"), interval_steps=1, enable_async=False)
+        assert c.enabled and c.latest_step() is None
+        c.maybe_save(7, state, force=True)
+        c.wait()
+        assert c.latest_step() == 7
+        target = {"w": jnp.zeros(8, dtype=jnp.float32), "step": jnp.int32(0)}
+        restored = c.restore(None, target)
+        np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(8))
+        assert int(restored["step"]) == 7
+        c.close()
+
+    def test_disabled_without_dir(self):
+        from kubeflow_tpu.runtime.checkpoint import Checkpointer
+
+        c = Checkpointer(None)
+        assert not c.enabled
+        assert c.maybe_save(0, {}) is False
+        assert c.restore(None, {"x": 1}) == {"x": 1}
+
+    def test_keep_policy(self, tmp_path):
+        from kubeflow_tpu.runtime.checkpoint import Checkpointer
+
+        c = Checkpointer(str(tmp_path / "ck"), interval_steps=1, keep=2,
+                         enable_async=False)
+        s = {"w": jnp.zeros(2)}
+        for i in range(5):
+            c.maybe_save(i, s, force=True)
+        c.wait()
+        assert c.latest_step() == 4
+        c.close()
+
+
+class TestMnistTask:
+    def test_loss_decreases(self):
+        from kubeflow_tpu.models import get_task
+        from kubeflow_tpu.parallel.mesh import build_mesh, MeshConfig
+
+        task = get_task("mnist", batch_size=32)
+        mesh = build_mesh(MeshConfig())
+        with mesh:
+            state = task.init_state(jax.random.PRNGKey(0), mesh)
+            step = task.train_step_fn(mesh)
+            it = task.data_iter(1, 0, mesh)
+            first = None
+            for i in range(30):
+                state, m = step(state, *next(it))
+                if first is None:
+                    first = float(m["loss"])
+            assert float(m["loss"]) < first * 0.8
