@@ -1,5 +1,449 @@
-"""Llama-3 family -- BASELINE configs #2 (training) and #5 (serving).
+"""Llama-3 family -- BASELINE configs #2 (JAXJob training) and #5 (serving).
 
-Implemented in the llama milestone; this module registers the task once
-the model lands.
+TPU-first transformer (SURVEY.md 5.7, 7.4 #2):
+
+- flax.linen with *logical* axis names on every parameter
+  (nn.with_logical_partitioning); one rules table maps them onto the
+  (data, fsdp, sequence, tensor) mesh -- DP/FSDP/TP/SP are mesh axes, not
+  code paths.
+- ``nn.scan`` over decoder layers: one compiled layer body, O(1) compile
+  time in depth.
+- ``nn.remat`` with a dots-saveable policy: rematerialize activations,
+  keep matmul outputs -- the standard HBM/FLOPs trade.
+- bf16 activations; fp32 params by default (master weights) with bf16
+  compute; GQA attention via kubeflow_tpu.ops.
+
+Architecture follows the public Llama-3 description (RMSNorm, RoPE,
+SwiGLU, GQA, no biases); presets cover 8B plus scaled-down variants for
+single-chip benches and CPU tests.
 """
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models import register_task
+from kubeflow_tpu.ops.attention import dot_product_attention
+from kubeflow_tpu.runtime import data as datalib
+from kubeflow_tpu.runtime.metrics import transformer_flops_per_token
+from kubeflow_tpu.runtime.task import TrainTask, host_to_global
+
+# Logical-axis -> mesh-axis rules in flax pair form, derived from the one
+# source of truth so model and activation shardings cannot diverge.
+from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
+
+LOGICAL_RULES = tuple(DEFAULT_RULES.items())
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    intermediate: int = 14336
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"          # activation/compute dtype
+    param_dtype: str = "float32"     # master weight dtype
+    remat: bool = True
+    scan_layers: bool = True
+    attention_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.n_heads
+
+    def n_params(self) -> int:
+        emb = self.vocab_size * self.hidden * 2  # in + out (untied)
+        attn = self.hidden * (
+            self.hidden  # q
+            + 2 * self.n_kv_heads * self.head_dim  # k, v
+            + self.hidden  # o
+        )
+        mlp = 3 * self.hidden * self.intermediate
+        norms = 2 * self.hidden * self.n_layers + self.hidden
+        return emb + self.n_layers * (attn + mlp) + norms
+
+    def flops_per_token(self, seq_len: int) -> float:
+        return transformer_flops_per_token(
+            self.n_params(), seq_len, self.n_layers, self.hidden
+        )
+
+
+PRESETS: dict[str, LlamaConfig] = {
+    # Public Llama-3 8B geometry.
+    "llama3-8b": LlamaConfig(),
+    # Depth-reduced 8B proxy: identical layer geometry (so per-layer MXU
+    # behavior matches 8B), 8 of 32 layers -> fits one v5e for benching.
+    "llama3-8b-proxy": LlamaConfig(n_layers=8, param_dtype="bfloat16"),
+    # ~1B-class config.
+    "llama3-1b": LlamaConfig(
+        hidden=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        intermediate=5504, vocab_size=32768,
+    ),
+    # Tiny configs for CPU tests.
+    "llama-tiny": LlamaConfig(
+        vocab_size=256, hidden=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        intermediate=128, max_seq=128, remat=False,
+    ),
+}
+
+
+def _dt(name: str):
+    return jnp.dtype(name)
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),
+            (x.shape[-1],),
+            jnp.float32,
+        )
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + self.eps)
+        return (y * scale).astype(self.dtype)
+
+
+def rope_frequencies(head_dim: int, max_seq: int, theta: float) -> jax.Array:
+    """[max_seq, head_dim//2] complex rotation angles (fp32)."""
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+    t = np.arange(max_seq)
+    freqs = np.outer(t, inv)
+    return jnp.asarray(freqs, dtype=jnp.float32)
+
+
+def apply_rope(x: jax.Array, freqs: jax.Array, positions: jax.Array) -> jax.Array:
+    """Rotate [B, S, H, D] by position-dependent angles (fp32 math)."""
+    f = freqs[positions]  # [B, S, D/2] or [S, D/2]
+    if f.ndim == 2:
+        f = f[None]
+    cos, sin = jnp.cos(f), jnp.sin(f)
+    cos = cos[:, :, None, :].astype(jnp.float32)
+    sin = sin[:, :, None, :].astype(jnp.float32)
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., 0::2], x32[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, freqs, positions):
+        cfg = self.cfg
+        dtype = _dt(cfg.dtype)
+        dense = partial(
+            nn.DenseGeneral,
+            use_bias=False,
+            dtype=dtype,
+            param_dtype=_dt(cfg.param_dtype),
+        )
+        q = dense(
+            features=(cfg.n_heads, cfg.head_dim),
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "heads", "kv")
+            ),
+            name="q_proj",
+        )(x)
+        k = dense(
+            features=(cfg.n_kv_heads, cfg.head_dim),
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "heads", "kv")
+            ),
+            name="k_proj",
+        )(x)
+        v = dense(
+            features=(cfg.n_kv_heads, cfg.head_dim),
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "heads", "kv")
+            ),
+            name="v_proj",
+        )(x)
+        q = apply_rope(q, freqs, positions)
+        k = apply_rope(k, freqs, positions)
+
+        # Training/prefill path only; the serving engine owns the KV-cache
+        # decode step (kubeflow_tpu.serving.engine) with proper position
+        # masking rather than threading cache state through linen.
+        out = dot_product_attention(
+            q, k, v, causal=True, impl=cfg.attention_impl
+        )
+        out = nn.DenseGeneral(
+            features=cfg.hidden,
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=dtype,
+            param_dtype=_dt(cfg.param_dtype),
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("heads", "kv", "embed")
+            ),
+            name="o_proj",
+        )(out)
+        return out
+
+
+class MLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dtype = _dt(cfg.dtype)
+        dense = partial(
+            nn.DenseGeneral, use_bias=False, dtype=dtype,
+            param_dtype=_dt(cfg.param_dtype),
+        )
+        gate = dense(
+            features=cfg.intermediate,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "mlp")
+            ),
+            name="gate_proj",
+        )(x)
+        up = dense(
+            features=cfg.intermediate,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "mlp")
+            ),
+            name="up_proj",
+        )(x)
+        return dense(
+            features=cfg.hidden,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("mlp", "embed")
+            ),
+            name="down_proj",
+        )(nn.silu(gate) * up)
+
+
+class DecoderLayer(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, freqs, positions):
+        cfg = self.cfg
+        h = Attention(cfg, name="attn")(
+            RMSNorm(cfg.norm_eps, _dt(cfg.dtype), name="attn_norm")(x),
+            freqs, positions,
+        )
+        x = x + h
+        h = MLP(cfg, name="mlp")(
+            RMSNorm(cfg.norm_eps, _dt(cfg.dtype), name="mlp_norm")(x)
+        )
+        return x + h
+
+
+class _ScanLayer(nn.Module):
+    """DecoderLayer wrapped for nn.scan (carry = hidden states)."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, freqs, positions = carry
+        x = DecoderLayer(self.cfg, name="layer")(x, freqs, positions)
+        return (x, freqs, positions), None
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array, positions: Optional[jax.Array] = None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])[None, :]
+        emb = nn.Embed(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.hidden,
+            dtype=_dt(cfg.dtype),
+            param_dtype=_dt(cfg.param_dtype),
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="embed",
+        )
+        x = emb(tokens)
+        freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+
+        remat_policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        if cfg.scan_layers:
+            layer_cls = _ScanLayer
+            if cfg.remat:
+                layer_cls = nn.remat(
+                    _ScanLayer, policy=remat_policy, prevent_cse=False
+                )
+            (x, _, _), _ = nn.scan(
+                layer_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")((x, freqs, positions), None)
+        else:
+            layer_cls = DecoderLayer
+            if cfg.remat:
+                layer_cls = nn.remat(
+                    DecoderLayer, policy=remat_policy, prevent_cse=False
+                )
+            for i in range(cfg.n_layers):
+                x = layer_cls(cfg, name=f"layer_{i}")(x, freqs, positions)
+
+        x = RMSNorm(cfg.norm_eps, _dt(cfg.dtype), name="final_norm")(x)
+        logits = nn.DenseGeneral(
+            features=cfg.vocab_size,
+            use_bias=False,
+            dtype=_dt(cfg.dtype),
+            param_dtype=_dt(cfg.param_dtype),
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")
+            ),
+            name="lm_head",
+        )(x)
+        return logits
+
+
+# ---------------------------------------------------------------------------
+# Training task
+# ---------------------------------------------------------------------------
+
+
+def state_shardings(mesh: Mesh, abstract_state):
+    """Map flax logical annotations to a pytree of NamedShardings (same
+    structure as ``abstract_state``) over the mesh."""
+    logical = nn.get_partition_spec(abstract_state)
+    return nn.logical_to_mesh_sharding(logical, mesh, LOGICAL_RULES)
+
+
+def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return (logz - gold).mean()
+
+
+class LlamaTask(TrainTask):
+    name = "llama"
+
+    def __init__(
+        self,
+        preset: str = "llama3-8b",
+        batch_size: int = 8,
+        seq_len: int = 2048,
+        lr: float = 3e-4,
+        weight_decay: float = 0.1,
+        optimizer: str = "adamw",
+        grad_clip: float = 1.0,
+        **overrides,
+    ) -> None:
+        cfg = PRESETS[preset]
+        if overrides:
+            cfg = dataclasses.replace(
+                cfg, **{k: v for k, v in overrides.items()}
+            )
+        self.cfg = cfg
+        self.preset = preset
+        self.batch_size = batch_size
+        self.seq_len = min(seq_len, cfg.max_seq)
+        self.lr = lr
+        self.model = Llama(cfg)
+        self.tokens_per_step = batch_size * self.seq_len
+        self.flops_per_token = cfg.flops_per_token(self.seq_len)
+        if optimizer == "adamw":
+            tx = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay)
+        elif optimizer == "adafactor":
+            tx = optax.adafactor(lr)
+        else:
+            raise ValueError(f"unknown optimizer {optimizer}")
+        self.tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+
+    # -- state ------------------------------------------------------------
+
+    def _init_fn(self, rng):
+        tokens = jnp.zeros((1, self.seq_len), jnp.int32)
+        params = self.model.init(rng, tokens)
+        return train_state.TrainState.create(
+            apply_fn=self.model.apply, params=params, tx=self.tx
+        )
+
+    def _shardings(self, mesh: Mesh):
+        # The abstract init trace is expensive at 8B scale; compute once
+        # per (task, mesh) and reuse for init_state + train_step_fn.
+        if getattr(self, "_sharding_cache", None) is None or (
+            self._sharding_cache[0] is not mesh
+        ):
+            abstract = jax.eval_shape(self._init_fn, jax.random.PRNGKey(0))
+            self._sharding_cache = (mesh, state_shardings(mesh, abstract))
+        return self._sharding_cache[1]
+
+    def init_state(self, rng: jax.Array, mesh: Mesh):
+        from kubeflow_tpu.parallel.mesh import validate_divisibility
+
+        validate_divisibility(self.batch_size, self.seq_len, mesh)
+        shardings = self._shardings(mesh)
+        with mesh:
+            return jax.jit(self._init_fn, out_shardings=shardings)(rng)
+
+    # -- step -------------------------------------------------------------
+
+    def train_step_fn(self, mesh: Mesh):
+        shardings = self._shardings(mesh)
+        batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), "sequence"))
+
+        def step(state, tokens, targets):
+            def loss_fn(params):
+                logits = state.apply_fn(params, tokens)
+                return cross_entropy(logits, targets)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            new_state = state.apply_gradients(grads=grads)
+            return new_state, {"loss": loss}
+
+        return jax.jit(
+            step,
+            in_shardings=(shardings, batch_sharding, batch_sharding),
+            out_shardings=(shardings, NamedSharding(mesh, P())),
+            donate_argnums=(0,),
+        )
+
+    # -- data -------------------------------------------------------------
+
+    def data_iter(
+        self, num_processes: int, process_id: int, mesh: Mesh, seed: int = 0
+    ) -> Iterator[tuple[jax.Array, ...]]:
+        it = datalib.synthetic_tokens(
+            self.batch_size, self.seq_len + 1, self.cfg.vocab_size,
+            num_processes=num_processes, process_id=process_id, seed=seed,
+        )
+        spec = P(("data", "fsdp"), "sequence")
+        for b in it:
+            yield (
+                host_to_global(mesh, spec, b.inputs),
+                host_to_global(mesh, spec, b.targets),
+            )
+
+
+@register_task("llama")
+def make_llama(**kw) -> LlamaTask:
+    return LlamaTask(**kw)

@@ -1,0 +1,9 @@
+"""TPU-friendly ops: attention, rotary embeddings, norms.
+
+The hot-path building blocks for the model zoo. Everything here is written
+to map onto the MXU (large batched matmuls, bf16) and to let XLA fuse the
+elementwise epilogues; the Pallas flash-attention kernel is selected at
+runtime when available (SURVEY.md 7.4 #2).
+"""
+
+from kubeflow_tpu.ops.attention import dot_product_attention  # noqa: F401

@@ -1,0 +1,80 @@
+"""Attention kernels.
+
+``dot_product_attention`` is the single entry point; it dispatches to:
+
+- ``xla``: plain einsum attention -- correct everywhere (CPU tests), XLA
+  fuses softmax; O(S^2) memory.
+- ``flash``: Pallas TPU flash attention (tiled online-softmax, O(S) HBM
+  traffic) -- used on TPU for long sequences.
+
+GQA (grouped-query attention) is supported natively: K/V have
+``n_kv_heads`` heads, queries have ``n_heads``; kv heads are broadcast in
+groups of ``n_heads // n_kv_heads``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, Hkv, D] -> [B, S, Hkv*n_rep, D] broadcasting kv heads."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    x = jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d))
+    return x.reshape(b, s, h * n_rep, d)
+
+
+def xla_attention(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, Hkv, D]
+    v: jax.Array,  # [B, Sk, Hkv, D]
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    depth = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(depth).astype(q.dtype)
+    sq, sk = q.shape[1], k.shape[1]
+    if causal:
+        # Offset supports decode (Sq < Sk with query at the tail).
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(mask[None, None], scores, jnp.finfo(scores.dtype).min)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        scores = jnp.where(
+            seg_mask[:, None, -sq:, :], scores, jnp.finfo(scores.dtype).min
+        )
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Attention entry point. impl: auto | xla | flash."""
+    if impl == "auto":
+        impl = "flash" if _flash_available(q) else "xla"
+    if impl == "flash":
+        from kubeflow_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+
+
+def _flash_available(q: jax.Array) -> bool:
+    if jax.default_backend() != "tpu":
+        return False
+    # Flash tiles need seq multiples of the block size; fall back otherwise.
+    return q.shape[1] >= 128 and q.shape[1] % 128 == 0

@@ -1,0 +1,23 @@
+"""Pallas TPU flash attention (placeholder until the kernel milestone).
+
+Falls back to XLA attention; replaced by the tiled online-softmax Pallas
+kernel in the long-context milestone.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    from kubeflow_tpu.ops.attention import xla_attention
+
+    return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
