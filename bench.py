@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Benchmark: Llama training throughput on the local TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Methodology (BASELINE.md: north star is tokens/sec/chip at 8B scale):
+- Model: llama3-8b-proxy -- exact Llama-3-8B layer geometry (hidden 4096,
+  GQA 32/8 heads, ffn 14336, vocab 128256) at 8 of 32 layers, so per-layer
+  MXU behavior matches the 8B model while fitting one v5e's 16 GB HBM.
+  The full 8B needs the v5e-8 slice the target config names; one chip
+  cannot hold it (16 GB of bf16 weights alone).
+- Real train steps (adafactor, bf16 activations, remat, donated state),
+  synthetic token batches, steady-state timing over N steps.
+- Sync via host transfer of the loss: on this axon backend,
+  block_until_ready does not synchronize (measured), transfers do.
+- vs_baseline: measured MFU / 0.50 -- the reference publishes no numbers
+  (BASELINE.json.published == {}), so the north-star ">=50% MFU" target is
+  the baseline. MFU uses honest FLOPs (no input-embed lookup FLOPs).
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.expanduser("~/.cache/kftpu-xla")
+)
+
+BATCH = int(os.environ.get("BENCH_BATCH", "4"))
+SEQ = int(os.environ.get("BENCH_SEQ", "1024"))
+STEPS = int(os.environ.get("BENCH_STEPS", "10"))
+PRESET = os.environ.get("BENCH_PRESET", "llama3-8b-proxy")
+
+
+def main() -> int:
+    import jax
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from kubeflow_tpu.models import get_task
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubeflow_tpu.runtime.metrics import peak_flops_per_chip
+
+    task = get_task(
+        "llama", preset=PRESET, batch_size=BATCH, seq_len=SEQ,
+        optimizer="adafactor",
+    )
+    mesh = build_mesh(MeshConfig(data=-1))
+    n_chips = len(jax.devices())
+    with mesh:
+        state = task.init_state(jax.random.PRNGKey(0), mesh)
+        step = task.train_step_fn(mesh)
+        it = task.data_iter(1, 0, mesh)
+        batches = [next(it) for _ in range(STEPS + 2)]
+        # Warmup: compile + one steady step.
+        for b in batches[:2]:
+            state, m = step(state, *b)
+        float(m["loss"])  # transfer = real sync on axon
+        t0 = time.perf_counter()
+        for b in batches[2:]:
+            state, m = step(state, *b)
+        final_loss = float(m["loss"])
+        dt = (time.perf_counter() - t0) / STEPS
+
+    tokens_per_sec = task.tokens_per_step / dt
+    per_chip = tokens_per_sec / n_chips
+    mfu = tokens_per_sec * task.flops_per_token / (peak_flops_per_chip() * n_chips)
+    print(
+        json.dumps(
+            {
+                "metric": f"{PRESET}_train_tokens_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(mfu / 0.50, 3),
+                "extra": {
+                    "mfu": round(mfu, 4),
+                    "step_time_ms": round(dt * 1e3, 1),
+                    "batch": BATCH,
+                    "seq_len": SEQ,
+                    "n_chips": n_chips,
+                    "params_b": round(task.cfg.n_params() / 1e9, 3),
+                    "final_loss": round(final_loss, 3),
+                    "device": jax.devices()[0].device_kind,
+                },
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -78,8 +78,11 @@ class LlamaConfig:
         return emb + self.n_layers * (attn + mlp) + norms
 
     def flops_per_token(self, seq_len: int) -> float:
+        # Honest MFU accounting: the input embedding is a lookup, not a
+        # matmul, so its params contribute no FLOPs (the lm_head does).
+        matmul_params = self.n_params() - self.vocab_size * self.hidden
         return transformer_flops_per_token(
-            self.n_params(), seq_len, self.n_layers, self.hidden
+            matmul_params, seq_len, self.n_layers, self.hidden
         )
 
 
@@ -255,15 +258,15 @@ class DecoderLayer(nn.Module):
 
 
 class _ScanLayer(nn.Module):
-    """DecoderLayer wrapped for nn.scan (carry = hidden states)."""
+    """DecoderLayer wrapped for nn.scan: carry is the hidden states only;
+    freqs/positions ride as broadcast (loop-invariant) inputs."""
 
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, carry, _):
-        x, freqs, positions = carry
+    def __call__(self, x, freqs, positions):
         x = DecoderLayer(self.cfg, name="layer")(x, freqs, positions)
-        return (x, freqs, positions), None
+        return x, None
 
 
 class Llama(nn.Module):
@@ -294,13 +297,14 @@ class Llama(nn.Module):
                 layer_cls = nn.remat(
                     _ScanLayer, policy=remat_policy, prevent_cse=False
                 )
-            (x, _, _), _ = nn.scan(
+            x, _ = nn.scan(
                 layer_cls,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast),
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, name="layers")((x, freqs, positions), None)
+            )(cfg, name="layers")(x, freqs, positions)
         else:
             layer_cls = DecoderLayer
             if cfg.remat:
@@ -331,16 +335,35 @@ class Llama(nn.Module):
 
 def state_shardings(mesh: Mesh, abstract_state):
     """Map flax logical annotations to a pytree of NamedShardings (same
-    structure as ``abstract_state``) over the mesh."""
+    structure as ``abstract_state``) over the mesh.
+
+    Reduced-rank optimizer leaves (adafactor's factored v_row/v_col drop an
+    axis of their param) inherit the param's full-rank logical spec from
+    flax metadata; those leaves are replicated instead -- they are O(dim),
+    not O(dim^2), so replication costs nothing.
+    """
     logical = nn.get_partition_spec(abstract_state)
-    return nn.logical_to_mesh_sharding(logical, mesh, LOGICAL_RULES)
+    shardings = nn.logical_to_mesh_sharding(logical, mesh, LOGICAL_RULES)
+
+    def fix(sh, leaf):
+        ndim = getattr(leaf, "ndim", None)
+        if (
+            isinstance(sh, NamedSharding)
+            and ndim is not None
+            and len(sh.spec) > ndim
+        ):
+            return NamedSharding(mesh, P())
+        return sh
+
+    # Unbox flax Partitioned wrappers so both trees have plain leaves.
+    return jax.tree.map(fix, shardings, nn.meta.unbox(abstract_state))
 
 
 def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
-    logits = logits.astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return (logz - gold).mean()
+    # fp32 upcast before the softmax: bf16 logsumexp loses training signal.
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets
+    ).mean()
 
 
 class LlamaTask(TrainTask):
@@ -359,13 +382,16 @@ class LlamaTask(TrainTask):
     ) -> None:
         cfg = PRESETS[preset]
         if overrides:
-            cfg = dataclasses.replace(
-                cfg, **{k: v for k, v in overrides.items()}
-            )
+            cfg = dataclasses.replace(cfg, **overrides)
         self.cfg = cfg
         self.preset = preset
         self.batch_size = batch_size
-        self.seq_len = min(seq_len, cfg.max_seq)
+        if seq_len > cfg.max_seq:
+            raise ValueError(
+                f"seq_len {seq_len} exceeds {preset} max_seq {cfg.max_seq}; "
+                "raise max_seq explicitly if intended"
+            )
+        self.seq_len = seq_len
         self.lr = lr
         self.model = Llama(cfg)
         self.tokens_per_step = batch_size * self.seq_len

@@ -55,3 +55,40 @@ def tmp_store(tmp_path):
     s = ObjectStore(str(tmp_path / "state.db"))
     yield s
     s.close()
+
+
+async def run_job_to_completion(store, job, log_dir, timeout=300.0, total_chips=8):
+    """Shared e2e harness: run a controller, submit the job, wait for a
+    terminal phase, stop cleanly. Returns (phase, worker_logs)."""
+    import asyncio
+
+    from kubeflow_tpu.api import TrainJob
+    from kubeflow_tpu.controller import (
+        GangScheduler,
+        JobController,
+        ProcessLauncher,
+    )
+
+    launcher = ProcessLauncher(log_dir=str(log_dir))
+    ctl = JobController(store, launcher, GangScheduler(total_chips=total_chips))
+    task = asyncio.create_task(ctl.run())
+    store.put(job.kind.value, job.to_dict())
+    phase = None
+    deadline = asyncio.get_event_loop().time() + timeout
+    try:
+        while asyncio.get_event_loop().time() < deadline:
+            obj = store.get(job.kind.value, job.name, job.namespace)
+            phase = TrainJob.from_dict(obj).status.phase.value
+            if phase in ("Succeeded", "Failed"):
+                break
+            await asyncio.sleep(0.25)
+    finally:
+        await ctl.stop()
+        try:
+            await asyncio.wait_for(task, 5)
+        except asyncio.TimeoutError:
+            task.cancel()
+    logs = {
+        p.name: p.read_text() for p in pathlib.Path(log_dir).glob("*.log")
+    }
+    return phase, logs

@@ -1,9 +1,8 @@
 """E2E config #2 shape: multi-worker JAXJob with jax.distributed rendezvous.
 
 Two real worker processes form a world via the controller-injected
-coordinator env (Gloo CPU collectives standing in for ICI, SURVEY.md 7.3b),
-train a tiny Llama data-parallel, and the job completes. This is the
-whole north-star path at miniature scale: apply -> gang -> env-inject ->
+coordinator env (Gloo CPU collectives standing in for ICI), train a tiny
+Llama data-parallel, and the job completes: apply -> gang -> env-inject ->
 jax.distributed.initialize -> sharded training -> Succeeded.
 """
 
@@ -11,6 +10,7 @@ import asyncio
 
 import pytest
 
+from conftest import run_job_to_completion
 from kubeflow_tpu.api import (
     JobKind,
     JobSpec,
@@ -22,7 +22,6 @@ from kubeflow_tpu.api import (
     apply_defaults,
 )
 from kubeflow_tpu.api.types import ObjectMeta
-from kubeflow_tpu.controller import GangScheduler, JobController, ProcessLauncher
 from kubeflow_tpu.runtime.metrics import parse_metric_line
 from kubeflow_tpu.store import ObjectStore
 
@@ -31,11 +30,6 @@ from kubeflow_tpu.store import ObjectStore
 def test_two_worker_jaxjob(tmp_path):
     async def run():
         store = ObjectStore(":memory:")
-        log_dir = str(tmp_path / "logs")
-        launcher = ProcessLauncher(log_dir=log_dir)
-        ctl = JobController(store, launcher, GangScheduler(total_chips=8))
-        task = asyncio.create_task(ctl.run())
-
         job = apply_defaults(TrainJob(
             kind=JobKind.JAXJob,
             metadata=ObjectMeta(name="llama-dp"),
@@ -56,29 +50,12 @@ def test_two_worker_jaxjob(tmp_path):
                 }
             ),
         ))
-        store.put("JAXJob", job.to_dict())
-
-        deadline = asyncio.get_event_loop().time() + 300
-        phase = None
-        while asyncio.get_event_loop().time() < deadline:
-            obj = store.get("JAXJob", "llama-dp")
-            j = TrainJob.from_dict(obj)
-            phase = j.status.phase.value
-            if phase in ("Succeeded", "Failed"):
-                break
-            await asyncio.sleep(0.3)
-
-        await ctl.stop()
-        try:
-            await asyncio.wait_for(task, 5)
-        except asyncio.TimeoutError:
-            task.cancel()
-
-        logs = {p.name: p.read_text() for p in (tmp_path / "logs").glob("*.log")}
+        phase, logs = await run_job_to_completion(
+            store, job, tmp_path / "logs", timeout=300
+        )
         assert phase == "Succeeded", f"phase={phase}\n" + "\n---\n".join(
             f"{n}:\n{t[-2000:]}" for n, t in logs.items()
         )
-        # Rank 0 logged metrics for a 2-process world.
         rank0 = next(t for n, t in logs.items() if "worker-0" in n)
         metrics = [m for m in map(parse_metric_line, rank0.splitlines()) if m]
         start = next(m for m in metrics if m.get("event") == "train_start")

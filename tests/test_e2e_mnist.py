@@ -7,10 +7,10 @@ spawn -> env-inject -> runtime-bootstrap -> train -> status.
 """
 
 import asyncio
-import pathlib
 
 import pytest
 
+from conftest import run_job_to_completion
 from kubeflow_tpu.api import (
     JobKind,
     JobSpec,
@@ -21,7 +21,6 @@ from kubeflow_tpu.api import (
     apply_defaults,
 )
 from kubeflow_tpu.api.types import ObjectMeta
-from kubeflow_tpu.controller import GangScheduler, JobController, ProcessLauncher
 from kubeflow_tpu.runtime.metrics import parse_metric_line
 from kubeflow_tpu.store import ObjectStore
 
@@ -30,11 +29,6 @@ from kubeflow_tpu.store import ObjectStore
 def test_mnist_job_end_to_end(tmp_path):
     async def run():
         store = ObjectStore(":memory:")
-        log_dir = str(tmp_path / "logs")
-        launcher = ProcessLauncher(log_dir=log_dir)
-        ctl = JobController(store, launcher, GangScheduler(total_chips=8))
-        task = asyncio.create_task(ctl.run())
-
         job = apply_defaults(TrainJob(
             kind=JobKind.TFJob,  # config #1 is TFJob-shaped
             metadata=ObjectMeta(name="mnist-cnn"),
@@ -52,35 +46,16 @@ def test_mnist_job_end_to_end(tmp_path):
                 }
             ),
         ))
-        store.put("TFJob", job.to_dict())
-
-        deadline = asyncio.get_event_loop().time() + 120
-        phase = None
-        while asyncio.get_event_loop().time() < deadline:
-            obj = store.get("TFJob", "mnist-cnn")
-            phase = obj.get("status", {}).get("conditions", [])
-            j = TrainJob.from_dict(obj)
-            phase = j.status.phase.value
-            if phase in ("Succeeded", "Failed"):
-                break
-            await asyncio.sleep(0.2)
-
-        await ctl.stop()
-        try:
-            await asyncio.wait_for(task, 5)
-        except asyncio.TimeoutError:
-            task.cancel()
-
-        assert phase == "Succeeded", f"job ended {phase}"
-        # Worker log contains parseable metric lines with decreasing loss.
-        logs = list(pathlib.Path(log_dir).glob("*.log"))
+        phase, logs = await run_job_to_completion(
+            store, job, tmp_path / "logs", timeout=120
+        )
+        assert phase == "Succeeded", f"job ended {phase}: {logs}"
         assert logs, "no worker log written"
-        text = logs[0].read_text()
+        text = next(iter(logs.values()))
         metrics = [m for m in map(parse_metric_line, text.splitlines()) if m]
         steps = [m for m in metrics if "loss" in m and "step" in m]
         assert len(steps) >= 3, text
         assert float(steps[-1]["loss"]) < float(steps[0]["loss"]) * 1.5
-        # Events recorded: created, admitted, succeeded.
         events = store.list("Event")
         reasons = {e["reason"] for e in events}
         assert {"JobCreated", "GangAdmitted", "JobSucceeded"} <= reasons
