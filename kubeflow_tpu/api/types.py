@@ -291,6 +291,22 @@ class JobStatus(BaseModel):
         return any(c.type == ctype and c.status for c in self.conditions)
 
 
+def phase_of_obj(obj: dict) -> str:
+    """Condensed phase from a raw (dict) object's status conditions.
+
+    The single source of the condition-priority ordering for clients that
+    work with plain JSON (CLI tables, SDK polling); JobStatus.phase is the
+    typed equivalent.
+    """
+    conds = obj.get("status", {}).get("conditions", [])
+    active = {c.get("type") for c in conds if c.get("status")}
+    for t in ("Failed", "Succeeded", "Suspended", "Restarting", "Running",
+              "Created"):
+        if t in active:
+            return "Pending" if t == "Created" else t
+    return "Pending"
+
+
 class ObjectMeta(BaseModel):
     model_config = ConfigDict(extra="forbid")
 

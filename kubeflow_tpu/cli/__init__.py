@@ -1,0 +1,1 @@
+"""kftpu CLI -- the kubectl-shaped user surface (SURVEY.md 7.1 step 5)."""

@@ -1,0 +1,246 @@
+"""kftpu: kubectl-shaped CLI against the control-plane server.
+
+``kftpu serve`` runs the control plane; every other command is an HTTP
+client of it (KFTPU_SERVER env or --server flag), exactly the kubectl/API-
+server split of the reference (call stack 4.1).
+
+    kftpu serve --chips 8 &
+    kftpu apply -f examples/llama_jaxjob.yaml
+    kftpu get jaxjob
+    kftpu logs llama-dp --replica worker-0 --follow
+    kftpu delete jaxjob llama-dp
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import yaml
+
+from kubeflow_tpu.api.types import phase_of_obj
+from kubeflow_tpu.sdk.client import (
+    ApiError,
+    ControlPlaneUnreachable,
+    TrainingClient,
+)
+
+DEFAULT_SERVER = os.environ.get("KFTPU_SERVER", "http://127.0.0.1:7450")
+
+KIND_ALIASES = {
+    "jaxjob": "JAXJob", "jaxjobs": "JAXJob", "jj": "JAXJob",
+    "tfjob": "TFJob", "tfjobs": "TFJob",
+    "pytorchjob": "PyTorchJob", "pytorchjobs": "PyTorchJob", "ptj": "PyTorchJob",
+    "mpijob": "MPIJob", "mpijobs": "MPIJob",
+    "xgboostjob": "XGBoostJob", "paddlejob": "PaddleJob",
+    "experiment": "Experiment", "experiments": "Experiment", "exp": "Experiment",
+    "trial": "Trial", "trials": "Trial",
+    "inferenceservice": "InferenceService", "inferenceservices": "InferenceService",
+    "isvc": "InferenceService",
+    "event": "Event", "events": "Event",
+}
+
+
+def resolve_kind(k: str) -> str:
+    return KIND_ALIASES.get(k.lower(), k)
+
+
+def age_of(obj: dict) -> str:
+    created = obj.get("metadata", {}).get("creation_time")
+    if not created:
+        return "?"
+    s = int(time.time() - created)
+    for div, unit in ((86400, "d"), (3600, "h"), (60, "m")):
+        if s >= div:
+            return f"{s // div}{unit}"
+    return f"{s}s"
+
+
+def cmd_apply(args, client: TrainingClient) -> int:
+    for path in args.filename:
+        try:
+            f = sys.stdin if path == "-" else open(path)
+        except OSError as e:
+            raise SystemExit(f"error: cannot read {path}: {e.strerror}")
+        with f:
+            try:
+                docs = [d for d in yaml.safe_load_all(f) if d]
+            except yaml.YAMLError as e:
+                raise SystemExit(f"error: invalid YAML in {path}: {e}")
+        for doc in docs:
+            kind = doc.get("kind")
+            if not kind:
+                raise SystemExit(f"error: document in {path} has no kind")
+            saved = client.apply(kind, doc)
+            meta = saved["metadata"]
+            print(f"{kind.lower()}/{meta['name']} applied "
+                  f"(generation {meta['generation']})")
+    return 0
+
+
+def cmd_get(args, client: TrainingClient) -> int:
+    kind = resolve_kind(args.kind)
+    if args.name:
+        obj = client.get(kind, args.name, args.namespace)
+        if args.output == "json":
+            print(json.dumps(obj, indent=2))
+        else:
+            print(yaml.safe_dump(obj, sort_keys=False))
+        return 0
+    items = client.list(kind, args.namespace)
+    if args.output == "json":
+        print(json.dumps(items, indent=2))
+        return 0
+    if args.output == "yaml":
+        print(yaml.safe_dump(items, sort_keys=False))
+        return 0
+    if not items:
+        print(f"No {kind} objects found")
+        return 0
+    rows = [("NAMESPACE", "NAME", "PHASE", "RESTARTS", "AGE")]
+    for o in items:
+        rows.append((
+            o["metadata"].get("namespace", "default"),
+            o["metadata"]["name"],
+            phase_of_obj(o),
+            str(o.get("status", {}).get("restart_count", 0)),
+            age_of(o),
+        ))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return 0
+
+
+def cmd_describe(args, client: TrainingClient) -> int:
+    kind = resolve_kind(args.kind)
+    obj = client.get(kind, args.name, args.namespace)
+    print(yaml.safe_dump({k: v for k, v in obj.items() if k != "status"},
+                         sort_keys=False))
+    print("status:")
+    print(yaml.safe_dump(obj.get("status", {}), sort_keys=False, indent=2))
+    events = client.events(args.name, args.namespace)
+    if events:
+        print("events:")
+        for e in events:
+            ts = time.strftime("%H:%M:%S", time.localtime(e.get("time", 0)))
+            print(f"  {ts}  {e['reason']:24s} {e['message']}")
+    return 0
+
+
+def cmd_logs(args, client: TrainingClient) -> int:
+    if not args.follow:
+        print(client.logs(args.name, args.namespace, args.replica, args.tail))
+        return 0
+    seen = None
+    while True:
+        text = client.logs(args.name, args.namespace, args.replica, 0)
+        lines = text.splitlines()
+        if seen is None:
+            # First fetch honors --tail, like kubectl logs -f --tail.
+            start = max(len(lines) - args.tail, 0) if args.tail else 0
+        else:
+            start = seen
+        for line in lines[start:]:
+            print(line, flush=True)
+        seen = len(lines)
+        obj = None
+        for kind in ("JAXJob", "TFJob", "PyTorchJob", "MPIJob", "Trial"):
+            try:
+                obj = client.get(kind, args.name, args.namespace)
+                break
+            except ApiError:
+                continue
+        if obj is not None and phase_of_obj(obj) in ("Succeeded", "Failed"):
+            return 0
+        time.sleep(1.0)
+
+
+def cmd_delete(args, client: TrainingClient) -> int:
+    kind = resolve_kind(args.kind)
+    deleted = client.delete(kind, args.name, args.namespace)
+    print(f"{kind.lower()}/{args.name} {'deleted' if deleted else 'not found'}")
+    return 0
+
+
+def cmd_events(args, client: TrainingClient) -> int:
+    for e in client.events(args.name, args.namespace):
+        ts = time.strftime("%H:%M:%S", time.localtime(e.get("time", 0)))
+        print(f"{ts}  {e['reason']:24s} {e['message']}")
+    return 0
+
+
+def cmd_serve(args, _client) -> int:
+    from kubeflow_tpu.server.app import main as server_main
+
+    argv = ["--state-dir", args.state_dir, "--port", str(args.port)]
+    if args.chips is not None:
+        argv += ["--chips", str(args.chips)]
+    return server_main(argv)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="kftpu", description="TPU-native training control plane CLI"
+    )
+    p.add_argument("--server", default=DEFAULT_SERVER)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("apply", help="apply object(s) from YAML")
+    sp.add_argument("-f", "--filename", action="append", required=True)
+    sp.set_defaults(fn=cmd_apply)
+
+    sp = sub.add_parser("get", help="list/get objects")
+    sp.add_argument("kind")
+    sp.add_argument("name", nargs="?")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.add_argument("-o", "--output", choices=("table", "json", "yaml"),
+                    default="table")
+    sp.set_defaults(fn=cmd_get)
+
+    sp = sub.add_parser("describe", help="object details + events")
+    sp.add_argument("kind")
+    sp.add_argument("name")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.set_defaults(fn=cmd_describe)
+
+    sp = sub.add_parser("logs", help="worker logs")
+    sp.add_argument("name")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.add_argument("--replica", default="worker-0")
+    sp.add_argument("--tail", type=int, default=0)
+    sp.add_argument("-f", "--follow", action="store_true")
+    sp.set_defaults(fn=cmd_logs)
+
+    sp = sub.add_parser("delete", help="delete an object")
+    sp.add_argument("kind")
+    sp.add_argument("name")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.set_defaults(fn=cmd_delete)
+
+    sp = sub.add_parser("events", help="events for an object")
+    sp.add_argument("name")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.set_defaults(fn=cmd_events)
+
+    sp = sub.add_parser("serve", help="run the control-plane server")
+    sp.add_argument("--state-dir", default=os.path.expanduser("~/.kftpu"))
+    sp.add_argument("--port", type=int, default=7450)
+    sp.add_argument("--chips", type=int, default=None)
+    sp.set_defaults(fn=cmd_serve)
+
+    args = p.parse_args(argv)
+    client = TrainingClient(args.server) if args.cmd != "serve" else None
+    try:
+        return args.fn(args, client)
+    except ApiError as e:
+        raise SystemExit(f"error: {e} (HTTP {e.status})")
+    except ControlPlaneUnreachable as e:
+        raise SystemExit(f"error: {e}; start it with: kftpu serve")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,8 @@
+"""Python SDK (SURVEY.md 3.1 T9): TrainingClient over the HTTP API."""
+
+from kubeflow_tpu.sdk.client import (  # noqa: F401
+    ApiError,
+    ControlPlaneUnreachable,
+    JobFailedError,
+    TrainingClient,
+)

@@ -1,0 +1,184 @@
+"""TrainingClient: the kubeflow-training SDK shape (SURVEY.md 3.1 T9, 4.6).
+
+One Python call == one declarative job: ``train()`` builds a JAXJob for a
+registered model task and submits it; ``create_job`` takes a full spec;
+``wait_for_job_conditions`` / ``get_job_logs`` mirror the reference's API
+names so SDK users port over mechanically.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional, Sequence
+
+
+class JobFailedError(RuntimeError):
+    pass
+
+
+class ApiError(RuntimeError):
+    """Server rejected the request (4xx/5xx)."""
+
+    def __init__(self, message: str, status: int) -> None:
+        super().__init__(message)
+        self.status = status
+
+
+class ControlPlaneUnreachable(ConnectionError):
+    pass
+
+
+class TrainingClient:
+    """Also the transport the CLI rides on -- one HTTP client, one place
+    the wire format lives."""
+
+    def __init__(self, server: str = "http://127.0.0.1:7450") -> None:
+        self.base = server.rstrip("/")
+
+    # -- transport --------------------------------------------------------
+
+    def _req(self, method: str, path: str, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                text = resp.read().decode()
+        except urllib.error.HTTPError as e:
+            body = e.read().decode()
+            try:
+                msg = json.loads(body).get("error", body)
+            except json.JSONDecodeError:
+                msg = body
+            raise ApiError(msg, e.code)
+        except urllib.error.URLError as e:
+            raise ControlPlaneUnreachable(
+                f"cannot reach control plane at {self.base} ({e.reason})"
+            )
+        try:
+            return json.loads(text)
+        except json.JSONDecodeError:
+            return text
+
+    # -- API --------------------------------------------------------------
+
+    def apply(self, kind: str, obj: dict) -> dict:
+        obj.setdefault("kind", kind)
+        return self._req("POST", f"/apis/{kind}", obj)
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> list[dict]:
+        q = f"?namespace={namespace}" if namespace else ""
+        return self._req("GET", f"/apis/{kind}{q}")["items"]
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> dict:
+        return self._req("GET", f"/apis/{kind}/{namespace}/{name}")
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> bool:
+        return self._req("DELETE", f"/apis/{kind}/{namespace}/{name}")["deleted"]
+
+    def events(self, name: str, namespace: str = "default") -> list[dict]:
+        return self._req("GET", f"/events/{namespace}/{name}")["items"]
+
+    def logs(self, name: str, namespace: str = "default",
+             replica: str = "worker-0", tail: int = 0) -> str:
+        q = urllib.parse.urlencode({"replica": replica, "tail": tail})
+        return self._req("GET", f"/logs/{namespace}/{name}?{q}")
+
+    def create_job(self, job: dict, kind: Optional[str] = None) -> dict:
+        return self.apply(kind or job.get("kind", "JAXJob"), job)
+
+    def train(
+        self,
+        name: str,
+        model: str = "llama",
+        num_workers: int = 1,
+        tpu_per_worker: int = 0,
+        steps: int = 100,
+        namespace: str = "default",
+        model_args: Optional[dict] = None,
+        mesh: Optional[dict] = None,
+        checkpoint_dir: Optional[str] = None,
+        env: Optional[dict] = None,
+    ) -> dict:
+        """High-level one-call training (reference: TrainingClient.train)."""
+        args = ["--model", model, "--steps", str(steps)]
+        for ax, n in (mesh or {}).items():
+            args += [f"--{ax}", str(n)]
+        for k, v in (model_args or {}).items():
+            args += ["--arg", f"{k}={v}"]
+        job = {
+            "kind": "JAXJob",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {
+                "replica_specs": {
+                    "Worker": {
+                        "replicas": num_workers,
+                        "template": {
+                            "entrypoint": "kubeflow_tpu.runtime.entry",
+                            "args": args,
+                            "env": env or {},
+                        },
+                        "resources": {"tpu": tpu_per_worker},
+                    }
+                },
+                "checkpoint": (
+                    {"dir": checkpoint_dir} if checkpoint_dir else {}
+                ),
+            },
+        }
+        return self.create_job(job)
+
+    def get_job(self, name: str, namespace: str = "default",
+                kind: str = "JAXJob") -> dict:
+        return self.get(kind, name, namespace)
+
+    def list_jobs(self, kind: str = "JAXJob",
+                  namespace: Optional[str] = None) -> list[dict]:
+        return self.list(kind, namespace)
+
+    def delete_job(self, name: str, namespace: str = "default",
+                   kind: str = "JAXJob") -> bool:
+        return self.delete(kind, name, namespace)
+
+    def get_job_logs(self, name: str, namespace: str = "default",
+                     replica: str = "worker-0", tail: int = 0) -> str:
+        return self.logs(name, namespace, replica, tail)
+
+    def job_phase(self, name: str, namespace: str = "default",
+                  kind: str = "JAXJob") -> str:
+        from kubeflow_tpu.api.types import phase_of_obj
+
+        return phase_of_obj(self.get_job(name, namespace, kind))
+
+    def wait_for_job_conditions(
+        self,
+        name: str,
+        namespace: str = "default",
+        kind: str = "JAXJob",
+        expected: Sequence[str] = ("Succeeded",),
+        timeout: float = 600.0,
+        poll: float = 1.0,
+    ) -> dict:
+        """Block until the job reaches one of ``expected`` phases."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            phase = self.job_phase(name, namespace, kind)
+            if phase in expected:
+                return self.get_job(name, namespace, kind)
+            if phase == "Failed" and "Failed" not in expected:
+                raise JobFailedError(
+                    f"{kind} {namespace}/{name} failed: "
+                    + json.dumps(
+                        self.get_job(name, namespace, kind).get("status", {})
+                    )[:500]
+                )
+            time.sleep(poll)
+        raise TimeoutError(
+            f"{kind} {namespace}/{name} did not reach {expected} in {timeout}s"
+        )

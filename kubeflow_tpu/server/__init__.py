@@ -1,0 +1,9 @@
+"""Control-plane server: HTTP API over the store + controllers.
+
+The 'API server' face of the mini control plane (SURVEY.md 7.0): the CLI
+and SDK talk HTTP to this daemon exactly as kubectl talks to the k8s API
+server; the JobController (and later HPO/serving controllers) run inside
+it on the same event loop.
+"""
+
+from kubeflow_tpu.server.app import ControlPlane, main  # noqa: F401

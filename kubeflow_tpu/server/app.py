@@ -1,0 +1,255 @@
+"""aiohttp control-plane server.
+
+Routes (kind is a CRD-like name: JAXJob, TFJob, ..., Experiment,
+InferenceService):
+
+- ``POST   /apis/{kind}``                 apply (defaulted + validated)
+- ``GET    /apis/{kind}``                 list (?namespace=)
+- ``GET    /apis/{kind}/{ns}/{name}``     get
+- ``DELETE /apis/{kind}/{ns}/{name}``     delete
+- ``GET    /logs/{ns}/{name}``            worker log (?replica=worker-0)
+- ``GET    /events/{ns}/{name}``          events for an object
+- ``GET    /healthz``, ``GET /metrics``   liveness + control-plane metrics
+
+Validation/defaulting happens server-side on POST, mirroring the
+reference's admission webhooks: the stored spec is always complete.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from kubeflow_tpu.api import TrainJob, apply_defaults, validate_job
+from kubeflow_tpu.api.types import JobKind
+from kubeflow_tpu.api.validation import ValidationError
+from kubeflow_tpu.controller import GangScheduler, JobController, ProcessLauncher
+from kubeflow_tpu.store import ObjectStore
+
+logger = logging.getLogger(__name__)
+
+JOB_KINDS = {k.value for k in JobKind}
+
+
+class ControlPlane:
+    """Store + controllers + HTTP app, one event loop."""
+
+    def __init__(
+        self,
+        state_dir: str,
+        total_chips: int = 8,
+        launcher: Optional[object] = None,
+    ) -> None:
+        os.makedirs(state_dir, exist_ok=True)
+        self.state_dir = state_dir
+        self.store = ObjectStore(os.path.join(state_dir, "state.db"))
+        self.log_dir = os.path.join(state_dir, "logs")
+        self.launcher = launcher or ProcessLauncher(log_dir=self.log_dir)
+        self.gang = GangScheduler(total_chips=total_chips)
+        self.controller = JobController(
+            self.store, self.launcher, self.gang, log_dir=self.log_dir
+        )
+        self.extra_controllers: list = []  # HPO/serving controllers join here
+        self._tasks: list[asyncio.Task] = []
+        self.started_at = time.time()
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> None:
+        self._tasks.append(asyncio.create_task(self.controller.run()))
+        for c in self.extra_controllers:
+            self._tasks.append(asyncio.create_task(c.run()))
+
+    async def stop(self) -> None:
+        for c in self.extra_controllers:
+            stop = getattr(c, "stop", None)
+            if stop:
+                await stop()
+        await self.controller.stop()
+        for t in self._tasks:
+            try:
+                await asyncio.wait_for(t, 5)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                t.cancel()
+        self.store.close()
+
+    # -- HTTP app ---------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.add_routes(
+            [
+                web.post("/apis/{kind}", self.h_apply),
+                web.get("/apis/{kind}", self.h_list),
+                web.get("/apis/{kind}/{ns}/{name}", self.h_get),
+                web.delete("/apis/{kind}/{ns}/{name}", self.h_delete),
+                web.get("/logs/{ns}/{name}", self.h_logs),
+                web.get("/events/{ns}/{name}", self.h_events),
+                web.get("/healthz", self.h_healthz),
+                web.get("/metrics", self.h_metrics),
+            ]
+        )
+
+        async def on_startup(app):
+            await self.start()
+
+        async def on_cleanup(app):
+            await self.stop()
+
+        app.on_startup.append(on_startup)
+        app.on_cleanup.append(on_cleanup)
+        return app
+
+    # -- handlers ---------------------------------------------------------
+
+    async def h_apply(self, req: web.Request) -> web.Response:
+        kind = req.match_info["kind"]
+        try:
+            obj = await req.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "body is not JSON"}, status=400)
+        if kind in JOB_KINDS:
+            try:
+                obj.setdefault("kind", kind)
+                if obj["kind"] != kind:
+                    raise ValidationError(
+                        f"body kind {obj['kind']} != URL kind {kind}"
+                    )
+                job = apply_defaults(TrainJob.from_dict(obj))
+                validate_job(job)
+                stored = obj_with_preserved_status(
+                    self.store, kind, job.to_dict()
+                )
+            except (ValidationError, ValueError) as e:
+                return web.json_response({"error": str(e)}, status=422)
+        else:
+            # Non-job kinds (Experiment, InferenceService) are validated by
+            # their controllers; only structural metadata is checked here.
+            if not obj.get("metadata", {}).get("name"):
+                return web.json_response(
+                    {"error": "metadata.name is required"}, status=422
+                )
+            stored = obj
+        try:
+            saved = self.store.put(kind, stored)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=422)
+        return web.json_response(saved)
+
+    async def h_list(self, req: web.Request) -> web.Response:
+        kind = req.match_info["kind"]
+        ns = req.query.get("namespace")
+        return web.json_response({"items": self.store.list(kind, ns)})
+
+    async def h_get(self, req: web.Request) -> web.Response:
+        kind = req.match_info["kind"]
+        obj = self.store.get(
+            kind, req.match_info["name"], req.match_info["ns"]
+        )
+        if obj is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(obj)
+
+    async def h_delete(self, req: web.Request) -> web.Response:
+        kind = req.match_info["kind"]
+        ok = self.store.delete(
+            kind, req.match_info["name"], req.match_info["ns"]
+        )
+        # 200 either way: "wasn't there" is a successful delete outcome the
+        # client inspects via the body, not an HTTP error.
+        return web.json_response({"deleted": ok})
+
+    async def h_logs(self, req: web.Request) -> web.Response:
+        ns, name = req.match_info["ns"], req.match_info["name"]
+        replica = req.query.get("replica", "worker-0")
+        path = os.path.join(
+            self.log_dir, f"{ns}_{name}_{replica}.log"
+        )
+        if not os.path.exists(path):
+            return web.json_response(
+                {"error": f"no log for {ns}/{name}/{replica}"}, status=404
+            )
+        tail = int(req.query.get("tail", "0"))
+        with open(path, "r", errors="replace") as f:
+            text = f.read()
+        if tail:
+            text = "\n".join(text.splitlines()[-tail:])
+        return web.Response(text=text)
+
+    async def h_events(self, req: web.Request) -> web.Response:
+        ns, name = req.match_info["ns"], req.match_info["name"]
+        key = f"{ns}/{name}"
+        events = [
+            e for e in self.store.list("Event", ns) if e.get("involved") == key
+        ]
+        events.sort(key=lambda e: e.get("time", 0))
+        return web.json_response({"items": events})
+
+    async def h_healthz(self, req: web.Request) -> web.Response:
+        return web.json_response({"ok": True, "uptime": time.time() - self.started_at})
+
+    async def h_metrics(self, req: web.Request) -> web.Response:
+        lines = [
+            f"kftpu_chips_total {self.gang.total_chips}",
+            f"kftpu_chips_used {self.gang.used_chips}",
+            f"kftpu_gangs_pending {len(self.gang.pending())}",
+            f"kftpu_uptime_seconds {time.time() - self.started_at:.0f}",
+        ]
+        for kind in self.store.kinds():
+            lines.append(
+                f'kftpu_objects{{kind="{kind}"}} {len(self.store.list(kind))}'
+            )
+        return web.Response(text="\n".join(lines) + "\n")
+
+
+def obj_with_preserved_status(store: ObjectStore, kind: str, obj: dict) -> dict:
+    """Re-apply keeps the controller-owned status, like a spec-only PATCH."""
+    existing = store.get(
+        kind, obj["metadata"]["name"], obj["metadata"].get("namespace", "default")
+    )
+    if existing and "status" in existing:
+        obj = dict(obj)
+        obj["status"] = existing["status"]
+    return obj
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("kftpu control-plane server")
+    p.add_argument("--state-dir", default=os.path.expanduser("~/.kftpu"))
+    p.add_argument("--port", type=int, default=7450)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--chips", type=int, default=None,
+                   help="TPU chip capacity (default: autodetect)")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    chips = args.chips
+    if chips is None:
+        try:
+            import jax
+
+            chips = max(len(jax.devices()), 1)
+        except Exception:
+            chips = 1
+
+    cp = ControlPlane(args.state_dir, total_chips=chips)
+    app = cp.build_app()
+    logger.info(
+        "control plane on http://%s:%d (state %s, %d chips)",
+        args.host, args.port, args.state_dir, chips,
+    )
+    web.run_app(app, host=args.host, port=args.port, print=None)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -117,8 +117,12 @@ class ObjectStore:
             if row is None:
                 if expect_generation not in (None, 0):
                     raise ConflictError(f"{kind} {namespace}/{name} does not exist")
-                meta.setdefault("uid", uuid.uuid4().hex)
-                meta.setdefault("creation_time", time.time())
+                # Not setdefault: clients constructed from typed models post
+                # explicit nulls for unset uid/creation_time.
+                if not meta.get("uid"):
+                    meta["uid"] = uuid.uuid4().hex
+                if not meta.get("creation_time"):
+                    meta["creation_time"] = time.time()
                 meta["generation"] = 1
                 etype = EventType.ADDED
             else:

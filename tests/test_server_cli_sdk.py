@@ -1,0 +1,171 @@
+"""E2E for the user surfaces: HTTP server, kubectl-shaped CLI, SDK.
+
+Real control-plane server subprocess; CLI driven via subprocess (the
+actual user interface); SDK driven in-process against the same server.
+"""
+
+import json
+import pathlib
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    port = free_port()
+    state = tmp_path_factory.mktemp("state")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu.cli", "serve",
+         "--state-dir", str(state), "--port", str(port), "--chips", "8"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    base = f"http://127.0.0.1:{port}"
+    # Wait for healthz.
+    import urllib.request
+
+    for _ in range(100):
+        try:
+            with urllib.request.urlopen(base + "/healthz", timeout=1):
+                break
+        except Exception:
+            if proc.poll() is not None:
+                out = proc.stdout.read().decode()
+                raise RuntimeError(f"server died:\n{out}")
+            time.sleep(0.1)
+    else:
+        raise RuntimeError("server never became healthy")
+    yield base
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def kftpu(server, *args, check=True):
+    r = subprocess.run(
+        [sys.executable, "-m", "kubeflow_tpu.cli", "--server", server, *args],
+        capture_output=True, text=True,
+    )
+    if check and r.returncode != 0:
+        raise AssertionError(f"kftpu {args} failed: {r.stdout}\n{r.stderr}")
+    return r
+
+
+@pytest.mark.e2e
+class TestCliFlow:
+    def test_apply_get_logs_delete(self, server, tmp_path):
+        spec = tmp_path / "job.yaml"
+        spec.write_text(
+            """
+kind: JAXJob
+metadata: {name: cli-mnist}
+spec:
+  replica_specs:
+    Worker:
+      replicas: 1
+      template:
+        entrypoint: kubeflow_tpu.runtime.entry
+        args: ["--model", "mnist", "--steps", "4", "--log-every", "1"]
+"""
+        )
+        out = kftpu(server, "apply", "-f", str(spec)).stdout
+        assert "jaxjob/cli-mnist applied" in out
+
+        # get table shows the job.
+        out = kftpu(server, "get", "jaxjob").stdout
+        assert "cli-mnist" in out
+
+        # Wait for success via SDK (shares the server).
+        from kubeflow_tpu.sdk import TrainingClient
+
+        tc = TrainingClient(server)
+        tc.wait_for_job_conditions("cli-mnist", timeout=120)
+
+        # logs reach the CLI.
+        out = kftpu(server, "logs", "cli-mnist", "--replica", "worker-0").stdout
+        assert "KFTPU-METRIC" in out
+
+        # describe shows events.
+        out = kftpu(server, "describe", "jaxjob", "cli-mnist").stdout
+        assert "GangAdmitted" in out and "JobSucceeded" in out
+
+        out = kftpu(server, "delete", "jaxjob", "cli-mnist").stdout
+        assert "deleted" in out
+        out = kftpu(server, "get", "jaxjob").stdout
+        assert "cli-mnist" not in out
+
+    def test_invalid_spec_rejected(self, server, tmp_path):
+        spec = tmp_path / "bad.yaml"
+        spec.write_text(
+            """
+kind: JAXJob
+metadata: {name: bad}
+spec:
+  replica_specs:
+    PS:
+      replicas: 1
+      template: {entrypoint: x}
+"""
+        )
+        r = kftpu(server, "apply", "-f", str(spec), check=False)
+        assert r.returncode != 0
+        assert "does not allow replica type PS" in r.stdout + r.stderr
+
+    def test_unreachable_server_message(self):
+        r = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.cli",
+             "--server", "http://127.0.0.1:1", "get", "jaxjob"],
+            capture_output=True, text=True,
+        )
+        assert r.returncode != 0
+        assert "kftpu serve" in r.stderr + r.stdout
+
+
+@pytest.mark.e2e
+class TestSdk:
+    def test_train_one_call(self, server):
+        from kubeflow_tpu.sdk import TrainingClient
+
+        tc = TrainingClient(server)
+        tc.train(
+            "sdk-mnist", model="mnist", num_workers=1, steps=4,
+            model_args={"batch_size": 16},
+        )
+        job = tc.wait_for_job_conditions("sdk-mnist", timeout=120)
+        assert job["status"]["completion_time"] is not None
+        logs = tc.get_job_logs("sdk-mnist")
+        assert "train_end" in logs
+        assert tc.delete_job("sdk-mnist")
+
+    def test_failed_job_raises(self, server):
+        from kubeflow_tpu.sdk import JobFailedError, TrainingClient
+
+        tc = TrainingClient(server)
+        tc.create_job({
+            "kind": "JAXJob",
+            "metadata": {"name": "sdk-bad"},
+            "spec": {
+                "replica_specs": {
+                    "Worker": {
+                        "replicas": 1,
+                        "restart_policy": "Never",
+                        "template": {
+                            "entrypoint": "kubeflow_tpu.nonexistent_module",
+                        },
+                    }
+                }
+            },
+        })
+        with pytest.raises(JobFailedError):
+            tc.wait_for_job_conditions("sdk-bad", timeout=60)
+        tc.delete_job("sdk-bad")
