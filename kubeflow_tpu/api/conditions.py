@@ -1,0 +1,51 @@
+"""Shared condition-list machinery for dict-based statuses.
+
+One implementation of the reference's UpdateJobConditions semantics
+(mutually-exclusive active conditions, sticky Created, no-op writes do not
+bump last_transition) used by every non-TrainJob status (Experiment,
+Trial, InferenceService). JobStatus has the typed equivalent in types.py;
+the no-op guard here is load-bearing: a condition write that always
+changes the status would make reconcile -> persist -> watch-event ->
+reconcile a self-triggering hot loop.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Iterable
+
+
+def set_condition(
+    conditions: list[dict[str, Any]],
+    ctype: str,
+    exclusive: Iterable[str],
+    reason: str = "",
+    message: str = "",
+) -> None:
+    exclusive = set(exclusive)
+    now = time.time()
+    found = False
+    for c in conditions:
+        if c["type"] == ctype:
+            if not c["status"] or c["reason"] != reason or c["message"] != message:
+                c.update(status=True, reason=reason, message=message,
+                         last_transition=now)
+            found = True
+        elif ctype in exclusive and c["type"] in exclusive and c["status"]:
+            c["status"], c["last_transition"] = False, now
+    if not found:
+        conditions.append({
+            "type": ctype, "status": True, "reason": reason,
+            "message": message, "last_transition": now,
+        })
+
+
+def has_condition(conditions: list[dict[str, Any]], ctype: str) -> bool:
+    return any(c["type"] == ctype and c["status"] for c in conditions)
+
+
+def phase_of(conditions: list[dict[str, Any]], order: tuple[str, ...]) -> str:
+    for t in order:
+        if has_condition(conditions, t):
+            return "Pending" if t == "Created" else t
+    return "Pending"

@@ -1,0 +1,29 @@
+"""HPO pillar: Katib-equivalent hyperparameter optimization.
+
+Experiment/Trial objects, in-process suggestion algorithms, stdout metrics
+collection, and median-stop early stopping (SURVEY.md 3.2 K1-K8).
+"""
+
+from kubeflow_tpu.hpo.algorithms import get_suggester
+from kubeflow_tpu.hpo.controller import HPOController
+from kubeflow_tpu.hpo.types import (
+    AlgorithmSpec,
+    Experiment,
+    ExperimentSpec,
+    ObjectiveSpec,
+    ParameterSpec,
+    Trial,
+    TrialSpec,
+)
+
+__all__ = [
+    "AlgorithmSpec",
+    "Experiment",
+    "ExperimentSpec",
+    "HPOController",
+    "ObjectiveSpec",
+    "ParameterSpec",
+    "Trial",
+    "TrialSpec",
+    "get_suggester",
+]

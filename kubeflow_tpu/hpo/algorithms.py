@@ -1,0 +1,444 @@
+"""Suggestion algorithms (Katib-equivalent, SURVEY.md 3.2 K3).
+
+The reference runs one gRPC suggestion service per algorithm (hyperopt /
+optuna / skopt wrappers); here each algorithm is an in-process ask-style
+suggester with the same contract: given the experiment spec and the trial
+history, produce the next parameter assignments.
+
+All suggesters are *pure functions of (spec, history, n_created)* with a
+seeded RNG: the controller can be restarted at any point and suggestions
+continue deterministically -- the analog of the reference persisting
+suggestion state in the Suggestion CR.
+
+Algorithms: random, grid, sobol (quasi-random), tpe (Tree-structured
+Parzen Estimator, hyperopt-style univariate Parzen mixtures), bayesopt
+(GP + expected improvement, sklearn), cmaes (simplified
+diagonal-covariance evolution strategy), hyperband (ASHA-style
+asynchronous successive halving over a resource parameter).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from kubeflow_tpu.hpo.types import (
+    ExperimentSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    ParamValue,
+)
+
+
+@dataclass
+class TrialResult:
+    """One historical trial as seen by a suggester."""
+
+    assignments: dict[str, ParamValue]
+    # Objective value, already sign-normalized so LOWER IS BETTER;
+    # None while running or if the trial failed without reporting.
+    value: Optional[float]
+    finished: bool
+
+
+# -- parameter encoding ------------------------------------------------------
+
+
+def _to_unit(p: ParameterSpec, v: ParamValue) -> float:
+    """Map a parameter value into [0, 1] (categoricals -> index fraction)."""
+    fs = p.feasible_space
+    if p.type in (ParameterType.categorical, ParameterType.discrete):
+        vals = [str(x) for x in fs.list or []]
+        try:
+            i = vals.index(str(v))
+        except ValueError:
+            i = 0
+        return (i + 0.5) / max(len(vals), 1)
+    lo, hi = float(fs.min), float(fs.max)
+    x = float(v)
+    if fs.log_scale:
+        return (math.log(x) - math.log(lo)) / (math.log(hi) - math.log(lo))
+    return (x - lo) / (hi - lo)
+
+
+def _from_unit(p: ParameterSpec, u: float) -> ParamValue:
+    """Inverse of _to_unit, with clamping, int rounding and step snapping."""
+    u = min(max(u, 0.0), 1.0)
+    fs = p.feasible_space
+    if p.type in (ParameterType.categorical, ParameterType.discrete):
+        vals = fs.list or []
+        i = min(int(u * len(vals)), len(vals) - 1)
+        return vals[i]
+    lo, hi = float(fs.min), float(fs.max)
+    if fs.log_scale:
+        x = math.exp(math.log(lo) + u * (math.log(hi) - math.log(lo)))
+    else:
+        x = lo + u * (hi - lo)
+    if fs.step:
+        x = lo + round((x - lo) / fs.step) * fs.step
+        x = min(max(x, lo), hi)
+    if p.type == ParameterType.int_:
+        return int(round(x))
+    return float(x)
+
+
+def normalize_objective(spec: ExperimentSpec, raw: Optional[float]) -> Optional[float]:
+    """Sign-normalize so lower is better for every suggester."""
+    if raw is None:
+        return None
+    return raw if spec.objective.type == ObjectiveType.minimize else -raw
+
+
+# -- suggesters --------------------------------------------------------------
+
+
+class Suggester:
+    def __init__(self, spec: ExperimentSpec) -> None:
+        self.spec = spec
+        self.params = spec.parameters
+        self.settings = spec.algorithm.settings
+        self.seed = int(self.settings.get("seed", "0"))
+
+    def _rng(self, n_created: int) -> np.random.Generator:
+        # Offset by n_created: restart-safe determinism without repeats.
+        return np.random.default_rng((self.seed, n_created))
+
+    def suggest(
+        self, history: Sequence[TrialResult], n_created: int, count: int
+    ) -> list[dict[str, ParamValue]]:
+        raise NotImplementedError
+
+    def _random_one(self, rng: np.random.Generator) -> dict[str, ParamValue]:
+        return {p.name: _from_unit(p, rng.random()) for p in self.params}
+
+
+class RandomSuggester(Suggester):
+    def suggest(self, history, n_created, count):
+        rng = self._rng(n_created)
+        return [self._random_one(rng) for _ in range(count)]
+
+
+class GridSuggester(Suggester):
+    """Cartesian grid in deterministic order; numeric axes use ``step`` if
+    set, else ``grid_points_per_axis`` (default 3). Exhausted grid =>
+    no more suggestions (experiment completes at grid size)."""
+
+    def _axis(self, p: ParameterSpec) -> list[ParamValue]:
+        fs = p.feasible_space
+        if p.type in (ParameterType.categorical, ParameterType.discrete):
+            return list(fs.list or [])
+        if fs.step:
+            n = int(math.floor((fs.max - fs.min) / fs.step + 1e-9)) + 1
+            vals = [fs.min + i * fs.step for i in range(n)]
+        else:
+            k = int(self.settings.get("grid_points_per_axis", "3"))
+            vals = [_from_unit(p, (i + 0.5) / k if k > 1 else 0.5) for i in range(k)]
+            # _from_unit already handles log/int; dedupe keeps ints sane
+            out: list[ParamValue] = []
+            for v in vals:
+                if v not in out:
+                    out.append(v)
+            return out
+        if p.type == ParameterType.int_:
+            vals = [int(round(v)) for v in vals]
+        return vals
+
+    def grid(self) -> list[dict[str, ParamValue]]:
+        axes = [self._axis(p) for p in self.params]
+        names = [p.name for p in self.params]
+        return [dict(zip(names, combo)) for combo in itertools.product(*axes)]
+
+    def suggest(self, history, n_created, count):
+        g = self.grid()
+        return g[n_created : n_created + count]
+
+
+class SobolSuggester(Suggester):
+    """Scrambled Sobol quasi-random (the reference exposes this via
+    optuna's QMCSampler)."""
+
+    def suggest(self, history, n_created, count):
+        if count <= 0:
+            return []
+        from scipy.stats import qmc
+
+        sob = qmc.Sobol(d=len(self.params), scramble=True, seed=self.seed)
+        if n_created:
+            sob.fast_forward(n_created)
+        pts = sob.random(count)
+        return [
+            {p.name: _from_unit(p, float(u)) for p, u in zip(self.params, row)}
+            for row in pts
+        ]
+
+
+class TPESuggester(Suggester):
+    """Tree-structured Parzen Estimator, hyperopt-style.
+
+    Observations are split at the gamma quantile into good/bad sets; per
+    parameter a 1-d Parzen mixture models each set; candidates are drawn
+    from the good mixture and ranked by the joint density ratio
+    prod_d l_d(x)/g_d(x). Falls back to random until n_startup completed
+    trials exist.
+    """
+
+    def suggest(self, history, n_created, count):
+        n_startup = int(self.settings.get("n_startup_trials", "5"))
+        n_cand = int(self.settings.get("n_ei_candidates", "24"))
+        gamma = float(self.settings.get("gamma", "0.25"))
+        done = [t for t in history if t.finished and t.value is not None]
+        rng = self._rng(n_created)
+        out = []
+        for _ in range(count):
+            if len(done) < n_startup:
+                out.append(self._random_one(rng))
+                continue
+            done_sorted = sorted(done, key=lambda t: t.value)
+            n_good = max(1, int(math.ceil(gamma * len(done_sorted))))
+            good, bad = done_sorted[:n_good], done_sorted[n_good:]
+            best_score, best_asg = -math.inf, None
+            for _ in range(n_cand):
+                asg, score = {}, 0.0
+                for p in self.params:
+                    gu = [_to_unit(p, t.assignments[p.name]) for t in good
+                          if p.name in t.assignments]
+                    bu = [_to_unit(p, t.assignments[p.name]) for t in bad
+                          if p.name in t.assignments]
+                    u = self._sample_parzen(rng, gu)
+                    score += math.log(self._parzen_pdf(u, gu) + 1e-12)
+                    score -= math.log(self._parzen_pdf(u, bu) + 1e-12)
+                    asg[p.name] = _from_unit(p, u)
+                if score > best_score:
+                    best_score, best_asg = score, asg
+            out.append(best_asg)
+        return out
+
+    @staticmethod
+    def _bandwidth(obs: list[float]) -> float:
+        if len(obs) < 2:
+            return 0.25
+        sd = float(np.std(obs))
+        # Silverman-ish, floored so the mixture keeps exploring.
+        return max(1.06 * sd * len(obs) ** -0.2, 0.05)
+
+    def _sample_parzen(self, rng: np.random.Generator, obs: list[float]) -> float:
+        if not obs:
+            return float(rng.random())
+        h = self._bandwidth(obs)
+        center = obs[rng.integers(len(obs))]
+        return float(np.clip(rng.normal(center, h), 0.0, 1.0))
+
+    def _parzen_pdf(self, u: float, obs: list[float]) -> float:
+        if not obs:
+            return 1.0  # uniform prior on [0,1]
+        h = self._bandwidth(obs)
+        z = (u - np.asarray(obs)) / h
+        # +uniform component: the prior smooths empty regions.
+        kde = float(np.mean(np.exp(-0.5 * z * z) / (h * math.sqrt(2 * math.pi))))
+        return 0.9 * kde + 0.1
+
+
+class BayesOptSuggester(Suggester):
+    """GP + expected improvement (the reference's skopt service). Numeric
+    params live on the unit cube; categoricals are one-hot encoded."""
+
+    def _encode(self, asg: dict[str, ParamValue]) -> list[float]:
+        x: list[float] = []
+        for p in self.params:
+            if p.type in (ParameterType.categorical, ParameterType.discrete):
+                vals = [str(v) for v in p.feasible_space.list or []]
+                onehot = [0.0] * len(vals)
+                if str(asg.get(p.name)) in vals:
+                    onehot[vals.index(str(asg[p.name]))] = 1.0
+                x.extend(onehot)
+            else:
+                x.append(_to_unit(p, asg[p.name]))
+        return x
+
+    def suggest(self, history, n_created, count):
+        n_startup = int(self.settings.get("n_startup_trials", "3"))
+        n_cand = int(self.settings.get("n_candidates", "256"))
+        xi = float(self.settings.get("xi", "0.01"))
+        done = [t for t in history if t.finished and t.value is not None]
+        rng = self._rng(n_created)
+        if len(done) < n_startup:
+            return [self._random_one(rng) for _ in range(count)]
+
+        from scipy.stats import norm
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import Matern
+
+        # One O(n^3) fit per suggest() call: the observations don't change
+        # within a batch, only the candidate draws do.
+        X = np.array([self._encode(t.assignments) for t in done])
+        y = np.array([t.value for t in done], dtype=float)
+        y_mean, y_std = float(y.mean()), float(y.std()) or 1.0
+        gp = GaussianProcessRegressor(
+            kernel=Matern(nu=2.5), alpha=1e-6, normalize_y=False,
+            random_state=self.seed + n_created,
+        )
+        gp.fit(X, (y - y_mean) / y_std)
+        best = float((y.min() - y_mean) / y_std)
+        out = []
+        for _ in range(count):
+            cands = [self._random_one(rng) for _ in range(n_cand)]
+            Xc = np.array([self._encode(c) for c in cands])
+            mu, sigma = gp.predict(Xc, return_std=True)
+            imp = best - mu - xi
+            with np.errstate(divide="ignore", invalid="ignore"):
+                z = np.where(sigma > 0, imp / sigma, 0.0)
+            ei = imp * norm.cdf(z) + sigma * norm.pdf(z)
+            ei = np.where(sigma > 1e-12, ei, 0.0)
+            out.append(cands[int(np.argmax(ei))])
+        return out
+
+
+class CMAESSuggester(Suggester):
+    """Simplified diagonal-covariance (mu, lambda) evolution strategy.
+
+    NOT full CMA-ES (no covariance path adaptation); a separable variant:
+    each generation samples around the weighted mean of the best mu of the
+    last ``population`` completed trials, with per-dimension sigma from the
+    weighted spread. Categoricals are resampled from the best trials'
+    empirical distribution. Good enough for low-dim HPO; the reference
+    delegates to optuna's CMA sampler similarly behind the same API.
+    """
+
+    def suggest(self, history, n_created, count):
+        pop = int(self.settings.get("population", "8"))
+        mu = max(1, pop // 2)
+        done = [t for t in history if t.finished and t.value is not None]
+        rng = self._rng(n_created)
+        if len(done) < pop:
+            return [self._random_one(rng) for _ in range(count)]
+        gen = sorted(done[-pop:], key=lambda t: t.value)[:mu]
+        w = np.array([math.log(mu + 0.5) - math.log(i + 1) for i in range(mu)])
+        w /= w.sum()
+        out = []
+        for _ in range(count):
+            asg: dict[str, ParamValue] = {}
+            for p in self.params:
+                if p.type in (ParameterType.categorical, ParameterType.discrete):
+                    vals = [t.assignments[p.name] for t in gen if p.name in t.assignments]
+                    asg[p.name] = vals[rng.integers(len(vals))] if vals else \
+                        self._random_one(rng)[p.name]
+                    continue
+                us = np.array([_to_unit(p, t.assignments[p.name]) for t in gen])
+                m = float(w @ us)
+                sd = max(float(np.sqrt(w @ (us - m) ** 2)), 0.02)
+                asg[p.name] = _from_unit(p, float(rng.normal(m, sd)))
+            out.append(asg)
+        return out
+
+
+class HyperbandSuggester(Suggester):
+    """ASHA-style asynchronous successive halving (the reference's
+    hyperband service, made asynchronous so it fits ask-style suggestions).
+
+    Settings: ``resource_parameter`` (must be one of spec.parameters, int),
+    ``eta`` (default 3). Rungs are resource budgets min*eta^k <= max. A new
+    suggestion either PROMOTES the best unpromoted trial of a completed
+    rung (same assignments, next budget) or samples a fresh config at the
+    base rung.
+    """
+
+    def _cfg(self):
+        rname = self.settings.get("resource_parameter")
+        if not rname:
+            raise ValueError("hyperband requires settings.resource_parameter")
+        rp = next((p for p in self.params if p.name == rname), None)
+        if rp is None:
+            raise ValueError(f"resource_parameter {rname!r} not in parameters")
+        eta = float(self.settings.get("eta", "3"))
+        if eta <= 1:
+            raise ValueError("hyperband eta must be > 1")
+        lo, hi = float(rp.feasible_space.min), float(rp.feasible_space.max)
+        if lo <= 0:
+            raise ValueError(
+                f"resource_parameter {rname!r} needs min > 0 (rungs are min*eta^k)"
+            )
+        rungs = []
+        r = lo
+        while r < hi - 1e-9:
+            rungs.append(r)
+            r *= eta
+        rungs.append(hi)
+        return rname, eta, rungs
+
+    @staticmethod
+    def _cfg_key(asg: dict[str, ParamValue], rname: str) -> tuple:
+        return tuple(sorted((k, str(v)) for k, v in asg.items() if k != rname))
+
+    def suggest(self, history, n_created, count):
+        rname, eta, rungs = self._cfg()
+        rng = self._rng(n_created)
+
+        def rung_of(asg):
+            r = float(asg.get(rname, rungs[0]))
+            return min(range(len(rungs)), key=lambda i: abs(rungs[i] - r))
+
+        # Configs present per rung (running or done) — promotion targets
+        # must not be re-promoted.
+        present: dict[int, set] = {}
+        for t in history:
+            present.setdefault(rung_of(t.assignments), set()).add(
+                self._cfg_key(t.assignments, rname)
+            )
+
+        out = []
+        for _ in range(count):
+            promoted = None
+            for k in range(len(rungs) - 2, -1, -1):  # highest promotable first
+                done_k = [
+                    t for t in history
+                    if t.finished and t.value is not None
+                    and rung_of(t.assignments) == k
+                ]
+                n_promote = int(len(done_k) / eta)
+                best = sorted(done_k, key=lambda t: t.value)[:n_promote]
+                for t in best:
+                    key = self._cfg_key(t.assignments, rname)
+                    if key not in present.get(k + 1, set()):
+                        asg = dict(t.assignments)
+                        rp = next(p for p in self.params if p.name == rname)
+                        asg[rname] = (
+                            int(round(rungs[k + 1]))
+                            if rp.type == ParameterType.int_ else rungs[k + 1]
+                        )
+                        present.setdefault(k + 1, set()).add(key)
+                        promoted = asg
+                        break
+                if promoted:
+                    break
+            if promoted is not None:
+                out.append(promoted)
+            else:
+                asg = self._random_one(rng)
+                rp = next(p for p in self.params if p.name == rname)
+                asg[rname] = (
+                    int(round(rungs[0]))
+                    if rp.type == ParameterType.int_ else rungs[0]
+                )
+                present.setdefault(0, set()).add(self._cfg_key(asg, rname))
+                out.append(asg)
+        return out
+
+
+ALGORITHMS: dict[str, type[Suggester]] = {
+    "random": RandomSuggester,
+    "grid": GridSuggester,
+    "sobol": SobolSuggester,
+    "tpe": TPESuggester,
+    "bayesopt": BayesOptSuggester,
+    "cmaes": CMAESSuggester,
+    "hyperband": HyperbandSuggester,
+}
+
+
+def get_suggester(spec: ExperimentSpec) -> Suggester:
+    return ALGORITHMS[spec.algorithm.name](spec)

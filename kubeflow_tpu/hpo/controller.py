@@ -1,0 +1,503 @@
+"""Experiment + Trial controllers (Katib-equivalent K2/K4, call stack 4.4).
+
+One event-driven loop reconciles both kinds:
+
+- **Experiment**: counts child trials, asks the suggestion algorithm for
+  new assignments (K3), renders the trial template, creates Trial objects,
+  applies the early-stopping rule across running trials (K7), and
+  completes on goal / budget / failure threshold.
+- **Trial**: materializes its rendered job as a TrainJob (delegating to the
+  JobController, exactly as the reference's trials delegate to the
+  training-operator, call stack 4.4), scrapes metrics from the primary
+  replica's log (K5), and mirrors job completion into trial conditions.
+
+The reference's suggestion services are separate gRPC processes; here they
+are in-process pure functions (see algorithms.py) -- the 1-vCPU host makes
+process-per-algorithm a cost, not an isolation win.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import re
+import time
+from typing import Optional
+
+from kubeflow_tpu.api.types import JobKind, phase_of_obj
+from kubeflow_tpu.hpo.algorithms import TrialResult, get_suggester, normalize_objective
+from kubeflow_tpu.hpo.metrics import (
+    median_should_stop,
+    observation_of,
+    scrape,
+    worker_log_path,
+)
+from kubeflow_tpu.hpo.types import (
+    Experiment,
+    ObjectiveType,
+    OptimalTrial,
+    Trial,
+    TrialSpec,
+    render_template,
+    validate_experiment,
+)
+logger = logging.getLogger(__name__)
+
+JOB_KINDS = {k.value for k in JobKind}
+EXPERIMENT_LABEL = "hpo.kftpu/experiment"
+TRIAL_LABEL = "hpo.kftpu/trial"
+
+
+class HPOController:
+    def __init__(
+        self,
+        store,
+        log_dir: Optional[str] = None,
+        poll_interval: float = 1.0,
+    ) -> None:
+        self.store = store
+        self.log_dir = log_dir
+        self.poll = poll_interval
+        self._queue: asyncio.Queue[tuple[str, str, str]] = asyncio.Queue()
+        self._queued: set[tuple[str, str, str]] = set()
+        self._stopped = asyncio.Event()
+        self._event_seq = 0
+        # Incremental log scraping: trial key -> (byte offset, series,
+        # auto_step). In-memory only; a restart re-reads from byte 0 once.
+        self._scrape_cache: dict[str, tuple[int, dict, int]] = {}
+
+    # -- loop (same shape as JobController) --------------------------------
+
+    async def run(self) -> None:
+        watch_q = self.store.watch()
+        for kind in ("Experiment", "Trial"):
+            for obj in self.store.list(kind):
+                self._enqueue(kind, obj["metadata"]["namespace"], obj["metadata"]["name"])
+        watcher = asyncio.create_task(self._pump_watch(watch_q))
+        try:
+            while not self._stopped.is_set():
+                get = asyncio.create_task(self._queue.get())
+                stop = asyncio.create_task(self._stopped.wait())
+                done, pending = await asyncio.wait(
+                    {get, stop}, return_when=asyncio.FIRST_COMPLETED
+                )
+                for t in pending:
+                    t.cancel()
+                if get in done:
+                    item = get.result()
+                    self._queued.discard(item)
+                    kind, ns, name = item
+                    try:
+                        if kind == "Experiment":
+                            await self._reconcile_experiment(ns, name)
+                        else:
+                            await self._reconcile_trial(ns, name)
+                    except Exception:
+                        logger.exception("hpo reconcile %s %s/%s failed", kind, ns, name)
+                        self._enqueue_later(2.0, kind, ns, name)
+        finally:
+            watcher.cancel()
+            self.store.unwatch(watch_q)
+
+    async def stop(self) -> None:
+        self._stopped.set()
+
+    async def _pump_watch(self, q: asyncio.Queue) -> None:
+        while True:
+            ev = await q.get()
+            if ev.kind == "Experiment":
+                self._enqueue("Experiment", ev.namespace, ev.name)
+            elif ev.kind == "Trial":
+                self._enqueue("Trial", ev.namespace, ev.name)
+                exp = ev.obj.get("spec", {}).get("experiment") if ev.obj else None
+                if exp:
+                    self._enqueue("Experiment", ev.namespace, exp)
+            elif ev.kind in JOB_KINDS and ev.obj:
+                labels = ev.obj.get("metadata", {}).get("labels", {})
+                trial = labels.get(TRIAL_LABEL)
+                if trial:
+                    self._enqueue("Trial", ev.namespace, trial)
+
+    def _enqueue(self, kind: str, ns: str, name: str) -> None:
+        item = (kind, ns, name)
+        if item not in self._queued:
+            self._queued.add(item)
+            self._queue.put_nowait(item)
+
+    def _enqueue_later(self, delay: float, kind: str, ns: str, name: str) -> None:
+        asyncio.get_running_loop().call_later(delay, self._enqueue, kind, ns, name)
+
+    # -- experiment --------------------------------------------------------
+
+    def _child_trials(self, ns: str, exp_name: str) -> list[Trial]:
+        out = []
+        for obj in self.store.list("Trial", ns):
+            if obj.get("spec", {}).get("experiment") == exp_name:
+                out.append(Trial.from_dict(obj))
+        out.sort(key=lambda t: t.metadata.name)
+        return out
+
+    async def _reconcile_experiment(self, ns: str, name: str) -> None:
+        obj = self.store.get("Experiment", name, ns)
+        if obj is None:
+            # Cascade delete: trials clean up their jobs in their own
+            # reconcile when they observe the deletion.
+            for t in self._child_trials(ns, name):
+                self.store.delete("Trial", t.metadata.name, ns)
+            return
+        try:
+            exp = Experiment.from_dict(obj)
+            validate_experiment(exp)
+        except ValueError as e:  # pydantic ValidationError subclasses ValueError
+            self._fail_raw_experiment(obj, f"invalid spec: {e}")
+            return
+        status_before = exp.status.model_dump(mode="json")
+
+        if not exp.status.has_condition("Created"):
+            exp.status.set_condition("Created", "ExperimentCreated")
+            exp.status.start_time = time.time()
+            self._record_event(ns, name, "ExperimentCreated",
+                               f"algorithm={exp.spec.algorithm.name}")
+
+        if exp.status.phase in ("Succeeded", "Failed"):
+            self._persist_experiment(exp, status_before)
+            return
+
+        trials = self._child_trials(ns, name)
+        running = [t for t in trials if not t.status.finished]
+        succeeded = [t for t in trials if t.status.phase == "Succeeded"]
+        failed = [t for t in trials if t.status.phase == "Failed"]
+        stopped = [t for t in trials if t.status.phase == "EarlyStopped"]
+        exp.status.trials_created = len(trials)
+        exp.status.trials_running = len(running)
+        exp.status.trials_succeeded = len(succeeded)
+        exp.status.trials_failed = len(failed)
+        exp.status.trials_early_stopped = len(stopped)
+
+        self._update_optimal(exp, succeeded + stopped)
+
+        # -- completion checks --------------------------------------------
+        goal = exp.spec.objective.goal
+        best = exp.status.current_optimal_trial.observation.value_of(
+            exp.spec.objective.objective_metric_name
+        )
+        minimize = exp.spec.objective.type == ObjectiveType.minimize
+        if goal is not None and best is not None and (
+            (minimize and best <= goal) or (not minimize and best >= goal)
+        ):
+            await self._complete_experiment(
+                exp, running, "Succeeded", "GoalReached",
+                f"objective {best} reached goal {goal}", status_before,
+            )
+            return
+        if len(failed) > exp.spec.max_failed_trial_count:
+            await self._complete_experiment(
+                exp, running, "Failed", "TooManyFailedTrials",
+                f"{len(failed)} trials failed > {exp.spec.max_failed_trial_count}",
+                status_before,
+            )
+            return
+        if len(trials) >= exp.spec.max_trial_count and not running:
+            await self._complete_experiment(
+                exp, running, "Succeeded", "MaxTrialsReached",
+                f"all {len(trials)} trials finished", status_before,
+            )
+            return
+
+        # -- early stopping -----------------------------------------------
+        es = exp.spec.early_stopping
+        if es is not None and es.name == "medianstop":
+            completed_histories = [
+                [(int(s), float(v)) for s, v in t.status.objective_history]
+                for t in succeeded
+            ]
+            for t in running:
+                hist = [(int(s), float(v)) for s, v in t.status.objective_history]
+                if median_should_stop(
+                    hist, completed_histories, minimize,
+                    es.min_trials_required, es.start_step,
+                ):
+                    await self._stop_trial(
+                        t, "MedianStop",
+                        "objective below median of completed trials",
+                    )
+                    self._record_event(ns, name, "TrialEarlyStopped",
+                                       t.metadata.name)
+
+        # -- spawn new trials ---------------------------------------------
+        need = min(
+            exp.spec.parallel_trial_count - len(running),
+            exp.spec.max_trial_count - len(trials),
+        )
+        if need > 0:
+            history = [
+                TrialResult(
+                    assignments=dict(t.spec.assignments),
+                    value=normalize_objective(
+                        exp.spec,
+                        t.status.observation.value_of(
+                            exp.spec.objective.objective_metric_name
+                        ),
+                    ),
+                    finished=t.status.finished,
+                )
+                for t in trials
+            ]
+            # Next index is max(existing)+1, NOT len(trials): deleting a
+            # trial must never make a new one overwrite a live sibling.
+            # Non-matching names (hand-made Trials pointed at this
+            # experiment) simply don't advance the counter.
+            next_index = 1 + max(
+                (int(m.group(1)) for m in (
+                    re.fullmatch(r".*-t(\d+)", t.metadata.name) for t in trials
+                ) if m),
+                default=-1,
+            )
+            try:
+                suggester = get_suggester(exp.spec)
+                assignments = suggester.suggest(history, next_index, need)
+            except ValueError as e:
+                # Algorithm rejected its settings at runtime: fail the
+                # experiment rather than retry-looping forever.
+                await self._complete_experiment(
+                    exp, running, "Failed", "AlgorithmError", str(e), status_before,
+                )
+                return
+            if not assignments and not running:
+                # Search space exhausted (finite algorithms like grid).
+                await self._complete_experiment(
+                    exp, running, "Succeeded", "SearchSpaceExhausted",
+                    f"algorithm produced no more suggestions after "
+                    f"{len(trials)} trials", status_before,
+                )
+                return
+            for i, asg in enumerate(assignments):
+                self._create_trial(exp, next_index + i, asg)
+            if assignments:
+                exp.status.trials_created = len(trials) + len(assignments)
+                exp.status.trials_running += len(assignments)
+                exp.status.set_condition("Running", "TrialsRunning")
+
+        if trials or exp.status.trials_created:
+            exp.status.set_condition("Running", "TrialsRunning")
+        self._persist_experiment(exp, status_before)
+
+    def _create_trial(self, exp: Experiment, index: int, assignments) -> None:
+        tname = f"{exp.metadata.name}-t{index:04d}"
+        job = render_template(exp.spec.trial_template.job, assignments)
+        trial = Trial(
+            metadata={
+                "name": tname,
+                "namespace": exp.metadata.namespace,
+                "labels": {EXPERIMENT_LABEL: exp.metadata.name},
+            },
+            spec=TrialSpec(
+                experiment=exp.metadata.name,
+                assignments=assignments,
+                job=job,
+                primary_replica=exp.spec.trial_template.primary_replica,
+                objective_metric_name=exp.spec.objective.objective_metric_name,
+                additional_metric_names=list(
+                    exp.spec.objective.additional_metric_names
+                ),
+                metrics_collector=exp.spec.metrics_collector,
+            ),
+        )
+        self.store.put("Trial", trial.to_dict())
+        self._record_event(
+            exp.metadata.namespace, exp.metadata.name, "TrialCreated",
+            f"{tname}: {assignments}",
+        )
+
+    def _update_optimal(self, exp: Experiment, finished: list[Trial]) -> None:
+        mname = exp.spec.objective.objective_metric_name
+        minimize = exp.spec.objective.type == ObjectiveType.minimize
+        best: Optional[Trial] = None
+        best_v: Optional[float] = None
+        for t in finished:
+            v = t.status.observation.value_of(mname)
+            if v is None:
+                continue
+            if best_v is None or (v < best_v if minimize else v > best_v):
+                best, best_v = t, v
+        if best is not None:
+            exp.status.current_optimal_trial = OptimalTrial(
+                name=best.metadata.name,
+                assignments=dict(best.spec.assignments),
+                observation=best.status.observation,
+            )
+
+    async def _complete_experiment(
+        self, exp: Experiment, running: list[Trial],
+        ctype: str, reason: str, msg: str, status_before: dict,
+    ) -> None:
+        for t in running:
+            await self._stop_trial(t, "ExperimentComplete", reason)
+        exp.status.set_condition(ctype, reason, msg)
+        exp.status.completion_time = time.time()
+        exp.status.trials_running = 0
+        exp.status.trials_early_stopped += len(running)
+        self._record_event(
+            exp.metadata.namespace, exp.metadata.name, reason, msg
+        )
+        self._persist_experiment(exp, status_before)
+
+    async def _stop_trial(self, trial: Trial, reason: str, msg: str) -> None:
+        job_kind = trial.spec.job.get("kind", "JAXJob")
+        self.store.delete(job_kind, trial.metadata.name, trial.metadata.namespace)
+        obj = self.store.get("Trial", trial.metadata.name, trial.metadata.namespace)
+        if obj is None:
+            return
+        t = Trial.from_dict(obj)
+        t.status.set_condition("EarlyStopped", reason, msg)
+        t.status.completion_time = time.time()
+        obj["status"] = t.status.model_dump(mode="json")
+        self.store.put("Trial", obj)
+
+    def _fail_raw_experiment(self, obj: dict, msg: str) -> None:
+        status = obj.setdefault("status", {})
+        conds = status.setdefault("conditions", [])
+        if not any(c.get("type") == "Failed" and c.get("status") for c in conds):
+            conds.append({
+                "type": "Failed", "status": True, "reason": "InvalidSpec",
+                "message": msg, "last_transition": time.time(),
+            })
+            self.store.put("Experiment", obj)
+
+    def _persist_experiment(self, exp: Experiment, status_before: dict) -> None:
+        now = exp.status.model_dump(mode="json")
+        if now == status_before:
+            return
+        obj = self.store.get("Experiment", exp.metadata.name, exp.metadata.namespace)
+        if obj is None:
+            return
+        obj["status"] = now
+        self.store.put("Experiment", obj)
+
+    # -- trial -------------------------------------------------------------
+
+    async def _reconcile_trial(self, ns: str, name: str) -> None:
+        obj = self.store.get("Trial", name, ns)
+        if obj is None:
+            # Trial deleted: tear down its job (all kinds share the name).
+            self._scrape_cache.pop(f"{ns}/{name}", None)
+            for kind in JOB_KINDS:
+                if self.store.get(kind, name, ns) is not None:
+                    self.store.delete(kind, name, ns)
+            return
+        trial = Trial.from_dict(obj)
+        status_before = trial.status.model_dump(mode="json")
+        if trial.status.finished:
+            self._scrape_cache.pop(f"{ns}/{name}", None)
+            return
+
+        job_kind = trial.spec.job.get("kind", "JAXJob")
+        job = self.store.get(job_kind, name, ns)
+        if job is None:
+            if trial.status.has_condition("Created"):
+                # Job vanished under a non-finished trial: treat as failure.
+                trial.status.set_condition("Failed", "JobDeleted",
+                                           "underlying job was deleted")
+                trial.status.completion_time = time.time()
+                self._persist_trial(trial, status_before)
+                return
+            job = dict(trial.spec.job)
+            job["kind"] = job_kind
+            meta = job.setdefault("metadata", {})
+            meta["name"] = name
+            meta["namespace"] = ns
+            meta.setdefault("labels", {})[TRIAL_LABEL] = name
+            meta["labels"][EXPERIMENT_LABEL] = trial.spec.experiment
+            # Server-side defaulting path: reuse the API model to complete
+            # the spec like h_apply does. An invalid rendered job fails THIS
+            # trial (not an infinite reconcile retry); the experiment's
+            # max_failed_trial_count then decides its fate.
+            try:
+                from kubeflow_tpu.api import TrainJob, apply_defaults, validate_job
+
+                tj = apply_defaults(TrainJob.from_dict(job))
+                validate_job(tj)
+            except ValueError as e:
+                trial.status.set_condition(
+                    "Failed", "InvalidJob", f"rendered job invalid: {e}"
+                )
+                trial.status.completion_time = time.time()
+                self._persist_trial(trial, status_before)
+                return
+            self.store.put(job_kind, tj.to_dict())
+            trial.status.set_condition("Created", "JobCreated", f"{job_kind}/{name}")
+            trial.status.start_time = time.time()
+            self._persist_trial(trial, status_before)
+            return
+
+        phase = phase_of_obj(job)
+        self._scrape_metrics(trial, ns, name)
+
+        if phase == "Running":
+            trial.status.set_condition("Running", "JobRunning")
+            # Poll while running: metrics only move when the log grows.
+            self._enqueue_later(self.poll, "Trial", ns, name)
+        elif phase == "Succeeded":
+            if trial.status.observation.value_of(trial.spec.objective_metric_name) is None:
+                trial.status.set_condition(
+                    "Failed", "MetricsUnavailable",
+                    f"objective metric {trial.spec.objective_metric_name!r} "
+                    "was never reported",
+                )
+            else:
+                trial.status.set_condition("Succeeded", "JobSucceeded")
+            trial.status.completion_time = time.time()
+        elif phase == "Failed":
+            trial.status.set_condition("Failed", "JobFailed")
+            trial.status.completion_time = time.time()
+        self._persist_trial(trial, status_before)
+
+    def _scrape_metrics(self, trial: Trial, ns: str, name: str) -> None:
+        if self.log_dir is None:
+            return
+        mc = trial.spec.metrics_collector
+        if mc.kind == "file" and mc.file_path:
+            path = mc.file_path
+        else:
+            path = worker_log_path(
+                self.log_dir, ns, name, trial.spec.primary_replica, 0
+            )
+        names = [trial.spec.objective_metric_name] + list(
+            trial.spec.additional_metric_names
+        )
+        key = f"{ns}/{name}"
+        offset, series, auto_step = self._scrape_cache.get(
+            key, (0, {n: [] for n in names}, 0)
+        )
+        _, delta, new_offset, auto_step = scrape(mc, path, names, offset, auto_step)
+        if new_offset == offset:
+            return
+        for n in names:
+            series.setdefault(n, []).extend(delta.get(n, []))
+        self._scrape_cache[key] = (new_offset, series, auto_step)
+        obs = observation_of(series)
+        if obs.metrics:
+            trial.status.observation = obs
+            trial.status.objective_history = [
+                (s, v) for s, v in series[trial.spec.objective_metric_name]
+            ]
+
+    def _persist_trial(self, trial: Trial, status_before: dict) -> None:
+        now = trial.status.model_dump(mode="json")
+        if now == status_before:
+            return
+        obj = self.store.get("Trial", trial.metadata.name, trial.metadata.namespace)
+        if obj is None:
+            return
+        obj["status"] = now
+        self.store.put("Trial", obj)
+
+    def _record_event(self, ns: str, name: str, reason: str, message: str) -> None:
+        self._event_seq += 1
+        self.store.put("Event", {
+            "metadata": {"name": f"{name}-hpo-{self._event_seq}", "namespace": ns},
+            "involved": f"{ns}/{name}",
+            "reason": reason,
+            "message": message,
+            "time": time.time(),
+        })

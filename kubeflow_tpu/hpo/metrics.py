@@ -1,0 +1,128 @@
+"""Trial metrics collection (Katib-equivalent K5).
+
+The reference injects a sidecar that tails stdout / metric files and pushes
+observation logs to a DB-manager over gRPC. Here worker stdout is already
+persisted by the launcher (one log file per worker), so collection is a
+read-side parse: scrape ``KFTPU-METRIC key=value`` lines (stdout kind) or a
+JSON-lines metrics file (file kind) into per-metric time series.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from kubeflow_tpu.hpo.types import MetricsCollectorSpec, MetricValue, Observation
+from kubeflow_tpu.runtime.metrics import parse_metric_line
+
+
+def worker_log_path(log_dir: str, namespace: str, job_name: str,
+                    replica_type: str, index: int = 0) -> str:
+    """Path convention shared with ProcessLauncher (worker_id with '/'->'_')."""
+    return os.path.join(
+        log_dir, f"{namespace}_{job_name}_{replica_type.lower()}-{index}.log"
+    )
+
+
+def scrape(
+    spec: MetricsCollectorSpec,
+    log_path: str,
+    metric_names: list[str],
+    offset: int = 0,
+    auto_step: int = 0,
+) -> tuple[Observation, dict[str, list[tuple[int, float]]], int, int]:
+    """Parse a worker log from ``offset`` into (observation-of-delta,
+    per-metric step history delta, new byte offset, new auto_step).
+
+    Incremental by design: the controller polls running trials every
+    second, so each pass must read only appended bytes -- a full re-parse
+    would be O(log^2) over a training run on the 1-vCPU host. History
+    entries are (step, value); lines without a parsable ``step`` get
+    sequential pseudo-steps so early stopping still has an x-axis --
+    ``auto_step`` carries that counter across incremental calls (pass the
+    previous call's return value, or the counter restarts at 0 and the
+    x-axis goes non-monotonic).
+    """
+    series: dict[str, list[tuple[int, float]]] = {n: [] for n in metric_names}
+    if not os.path.exists(log_path):
+        return Observation(), series, offset, auto_step
+    with open(log_path, "rb") as fb:
+        fb.seek(offset)
+        chunk = fb.read()
+        # Hold back a trailing partial line (no newline yet) for next poll.
+        last_nl = chunk.rfind(b"\n")
+        if last_nl < 0:
+            return Observation(), series, offset, auto_step
+        new_offset = offset + last_nl + 1
+        text = chunk[: last_nl + 1].decode(errors="replace")
+    for line in text.splitlines():
+        kv = _parse_line(spec, line)
+        if kv is None:
+            continue
+        auto_step += 1
+        try:
+            step = int(float(kv.get("step", auto_step)))
+        except ValueError:
+            step = auto_step
+        for name in metric_names:
+            if name in kv:
+                try:
+                    series[name].append((step, float(kv[name])))
+                except ValueError:
+                    pass
+    return observation_of(series), series, new_offset, auto_step
+
+
+def observation_of(series: dict[str, list[tuple[int, float]]]) -> Observation:
+    metrics = []
+    for name, hist in series.items():
+        if hist:
+            vals = [v for _, v in hist]
+            metrics.append(MetricValue(
+                name=name, latest=vals[-1], min=min(vals), max=max(vals)
+            ))
+    return Observation(metrics=metrics)
+
+
+def _parse_line(spec: MetricsCollectorSpec, line: str) -> Optional[dict[str, str]]:
+    if spec.kind == "file":
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            return None
+        if isinstance(obj, dict) and "name" in obj and "value" in obj:
+            out = {str(obj["name"]): str(obj["value"])}
+            if "step" in obj:
+                out["step"] = str(obj["step"])
+            return out
+        return {k: str(v) for k, v in obj.items()} if isinstance(obj, dict) else None
+    return parse_metric_line(line)
+
+
+def median_should_stop(
+    history: list[tuple[int, float]],
+    completed_histories: list[list[tuple[int, float]]],
+    minimize: bool,
+    min_trials_required: int = 3,
+    start_step: int = 1,
+) -> bool:
+    """medianstop rule (K7): stop if the trial's best objective so far is
+    worse than the median of completed trials' best-so-far at the same step."""
+    if not history or len(completed_histories) < min_trials_required:
+        return False
+    step, _ = history[-1]
+    if step < start_step:
+        return False
+    sign = 1.0 if minimize else -1.0
+    mine = min(sign * v for _, v in history)
+    peers = []
+    for h in completed_histories:
+        upto = [sign * v for s, v in h if s <= step]
+        if upto:
+            peers.append(min(upto))
+    if len(peers) < min_trials_required:
+        return False
+    peers.sort()
+    median = peers[len(peers) // 2]
+    return mine > median

@@ -1,0 +1,358 @@
+"""Experiment / Trial API types (Katib-equivalent, SURVEY.md 3.2 K1).
+
+Shape mirrors Katib's v1beta1 Experiment CRD: objective + algorithm +
+parameter feasible spaces + a trial template, plus trial-count budgets and
+an optional early-stopping rule. A Trial is one sampled assignment bound to
+one training job; the rendered job is a TrainJob-shaped dict produced by
+substituting ``${trialParameters.<name>}`` placeholders in the template,
+exactly the reference's substitution contract.
+
+TPU-first delta: trials are gang-scheduled TrainJobs, so one trial's
+resource demand is a whole slice; parallel_trial_count therefore throttles
+slice consumption, not just pod count.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import Any, List, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from kubeflow_tpu.api import conditions
+from kubeflow_tpu.api.types import ObjectMeta
+
+ParamValue = Union[float, int, str]
+
+
+class ParameterType(str, enum.Enum):
+    double = "double"
+    int_ = "int"
+    categorical = "categorical"
+    discrete = "discrete"
+
+
+class FeasibleSpace(BaseModel):
+    """min/max (+optional step) for numeric types, list for categorical/
+    discrete. ``log_scale`` samples numeric params in log space."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    min: Optional[float] = None
+    max: Optional[float] = None
+    step: Optional[float] = None
+    # Field is named ``list`` for parity with the reference's API; the
+    # typing.List spelling dodges the builtin shadowed by the field name.
+    list: Optional[List[ParamValue]] = None
+    log_scale: bool = False
+
+
+class ParameterSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    name: str
+    type: ParameterType
+    feasible_space: FeasibleSpace
+
+
+class ObjectiveType(str, enum.Enum):
+    minimize = "minimize"
+    maximize = "maximize"
+
+
+class ObjectiveSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    type: ObjectiveType = ObjectiveType.minimize
+    objective_metric_name: str = "loss"
+    additional_metric_names: list[str] = Field(default_factory=list)
+    # Stop the experiment once the best observed objective crosses goal.
+    goal: Optional[float] = None
+
+
+class AlgorithmSpec(BaseModel):
+    """Algorithm name + opaque string settings (the reference passes
+    settings the same way: map[string]string interpreted per-service)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    name: str = "random"
+    settings: dict[str, str] = Field(default_factory=dict)
+
+
+class EarlyStoppingSpec(BaseModel):
+    """medianstop (K7): prune a running trial whose objective at step s is
+    worse than the median of completed trials' objectives at steps <= s."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    name: str = "medianstop"
+    # Do not prune before this many trials have completed.
+    min_trials_required: int = Field(default=3, ge=1)
+    # Do not prune before the trial has reported at this step.
+    start_step: int = Field(default=1, ge=0)
+
+
+class MetricsCollectorSpec(BaseModel):
+    """Stdout scraping config (K5). ``kind=stdout`` parses KFTPU-METRIC
+    key=value lines from the primary replica's log; ``kind=file`` tails a
+    JSON-lines file of {"name":..., "value":..., "step":...} records."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    kind: str = "stdout"
+    file_path: Optional[str] = None
+
+
+class TrialTemplate(BaseModel):
+    """Job template with ``${trialParameters.<name>}`` placeholders.
+
+    ``job`` is a TrainJob-shaped dict (kind + spec); metadata.name is
+    assigned per-trial by the controller. ``primary_replica`` names the
+    replica type whose rank-0 log feeds the metrics collector.
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    job: dict[str, Any]
+    primary_replica: str = "Worker"
+
+
+class ExperimentSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    objective: ObjectiveSpec = Field(default_factory=ObjectiveSpec)
+    algorithm: AlgorithmSpec = Field(default_factory=AlgorithmSpec)
+    parameters: list[ParameterSpec] = Field(default_factory=list)
+    trial_template: TrialTemplate
+    parallel_trial_count: int = Field(default=2, ge=1)
+    max_trial_count: int = Field(default=10, ge=1)
+    max_failed_trial_count: int = Field(default=3, ge=0)
+    metrics_collector: MetricsCollectorSpec = Field(
+        default_factory=MetricsCollectorSpec
+    )
+    early_stopping: Optional[EarlyStoppingSpec] = None
+    # LongRunning: keep the experiment object after budget (reference's
+    # resumePolicy); Never: mark Succeeded when budget is exhausted.
+    resume_policy: str = "Never"
+
+
+class ExperimentConditionType(str, enum.Enum):
+    Created = "Created"
+    Running = "Running"
+    Succeeded = "Succeeded"
+    Failed = "Failed"
+
+
+class MetricValue(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    name: str
+    latest: float
+    min: float
+    max: float
+
+
+class Observation(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    metrics: list[MetricValue] = Field(default_factory=list)
+
+    def value_of(self, name: str) -> Optional[float]:
+        for m in self.metrics:
+            if m.name == name:
+                return m.latest
+        return None
+
+
+class OptimalTrial(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    name: str = ""
+    assignments: dict[str, ParamValue] = Field(default_factory=dict)
+    observation: Observation = Field(default_factory=Observation)
+
+
+class ExperimentStatus(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    conditions: list[dict[str, Any]] = Field(default_factory=list)
+    trials_created: int = 0
+    trials_running: int = 0
+    trials_succeeded: int = 0
+    trials_failed: int = 0
+    trials_early_stopped: int = 0
+    current_optimal_trial: OptimalTrial = Field(default_factory=OptimalTrial)
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+
+    _EXCLUSIVE = ("Running", "Succeeded", "Failed")
+
+    def set_condition(self, ctype: str, reason: str = "", message: str = "") -> None:
+        conditions.set_condition(self.conditions, ctype, self._EXCLUSIVE,
+                                 reason, message)
+
+    def has_condition(self, ctype: str) -> bool:
+        return conditions.has_condition(self.conditions, ctype)
+
+    @property
+    def phase(self) -> str:
+        return conditions.phase_of(
+            self.conditions, ("Failed", "Succeeded", "Running", "Created")
+        )
+
+
+class Experiment(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    kind: str = "Experiment"
+    metadata: ObjectMeta
+    spec: ExperimentSpec
+    status: ExperimentStatus = Field(default_factory=ExperimentStatus)
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @classmethod
+    def from_dict(cls, obj: dict[str, Any]) -> "Experiment":
+        return cls.model_validate(obj)
+
+    def to_dict(self) -> dict[str, Any]:
+        return self.model_dump(mode="json", by_alias=True)
+
+
+# -- Trial -----------------------------------------------------------------
+
+
+class TrialSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    experiment: str
+    assignments: dict[str, ParamValue] = Field(default_factory=dict)
+    # Rendered TrainJob-shaped dict (template with assignments substituted).
+    job: dict[str, Any] = Field(default_factory=dict)
+    primary_replica: str = "Worker"
+    objective_metric_name: str = "loss"
+    additional_metric_names: List[str] = Field(default_factory=list)
+    metrics_collector: MetricsCollectorSpec = Field(
+        default_factory=MetricsCollectorSpec
+    )
+
+
+class TrialStatus(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    conditions: list[dict[str, Any]] = Field(default_factory=list)
+    observation: Observation = Field(default_factory=Observation)
+    # (step, value) history of the objective metric, for early stopping.
+    objective_history: list[tuple[int, float]] = Field(default_factory=list)
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+
+    _EXCLUSIVE = ("Running", "Succeeded", "Failed", "EarlyStopped")
+
+    def set_condition(self, ctype: str, reason: str = "", message: str = "") -> None:
+        conditions.set_condition(self.conditions, ctype, self._EXCLUSIVE,
+                                 reason, message)
+
+    def has_condition(self, ctype: str) -> bool:
+        return conditions.has_condition(self.conditions, ctype)
+
+    @property
+    def phase(self) -> str:
+        return conditions.phase_of(
+            self.conditions,
+            ("Failed", "EarlyStopped", "Succeeded", "Running", "Created"),
+        )
+
+    @property
+    def finished(self) -> bool:
+        return self.phase in ("Succeeded", "Failed", "EarlyStopped")
+
+
+class Trial(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    kind: str = "Trial"
+    metadata: ObjectMeta
+    spec: TrialSpec
+    status: TrialStatus = Field(default_factory=TrialStatus)
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @classmethod
+    def from_dict(cls, obj: dict[str, Any]) -> "Trial":
+        return cls.model_validate(obj)
+
+    def to_dict(self) -> dict[str, Any]:
+        return self.model_dump(mode="json", by_alias=True)
+
+
+def render_template(template: dict[str, Any], assignments: dict[str, ParamValue]) -> dict[str, Any]:
+    """Substitute ``${trialParameters.<name>}`` through every string leaf.
+
+    All substitution is textual (``str(value)``), exactly the reference's
+    template-engine contract: placeholders belong in string-typed fields
+    (args, env); the rendered job is then re-validated so a placeholder
+    smuggled into a numeric field fails that trial loudly.
+    """
+    def subst(v: Any) -> Any:
+        if isinstance(v, str):
+            for name, val in assignments.items():
+                ph = "${trialParameters." + name + "}"
+                if v == ph:
+                    return str(val)
+                if ph in v:
+                    v = v.replace(ph, str(val))
+            return v
+        if isinstance(v, dict):
+            return {k: subst(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [subst(x) for x in v]
+        return v
+
+    return subst(template)
+
+
+def validate_experiment(exp: Experiment) -> None:
+    """Structural validation beyond pydantic types (server-side, K1).
+
+    Raises ValueError with a user-facing message, mirroring the reference's
+    validating webhook.
+    """
+    if not exp.spec.parameters:
+        raise ValueError("spec.parameters must be non-empty")
+    seen: set[str] = set()
+    for p in exp.spec.parameters:
+        if p.name in seen:
+            raise ValueError(f"duplicate parameter name {p.name!r}")
+        seen.add(p.name)
+        fs = p.feasible_space
+        if p.type in (ParameterType.double, ParameterType.int_):
+            if fs.min is None or fs.max is None:
+                raise ValueError(f"parameter {p.name}: numeric types need min and max")
+            if fs.min >= fs.max:
+                raise ValueError(f"parameter {p.name}: min must be < max")
+            if fs.log_scale and fs.min <= 0:
+                raise ValueError(f"parameter {p.name}: log_scale needs min > 0")
+        else:
+            if not fs.list:
+                raise ValueError(f"parameter {p.name}: {p.type.value} needs a list")
+    if exp.spec.parallel_trial_count > exp.spec.max_trial_count:
+        raise ValueError("parallel_trial_count must be <= max_trial_count")
+    if not exp.spec.trial_template.job.get("spec"):
+        raise ValueError("trial_template.job must have a spec")
+    from kubeflow_tpu.hpo.algorithms import ALGORITHMS, HyperbandSuggester
+
+    if exp.spec.algorithm.name not in ALGORITHMS:
+        raise ValueError(
+            f"unknown algorithm {exp.spec.algorithm.name!r}; "
+            f"available: {sorted(ALGORITHMS)}"
+        )
+    if exp.spec.algorithm.name == "hyperband":
+        # Surface bad resource/eta settings at admission, not mid-experiment.
+        HyperbandSuggester(exp.spec)._cfg()

@@ -156,6 +156,111 @@ class TrainingClient:
 
         return phase_of_obj(self.get_job(name, namespace, kind))
 
+    # -- HPO (the kubeflow-katib KatibClient shape, SURVEY.md 3.2 K8) ------
+
+    def tune(
+        self,
+        name: str,
+        parameters: dict,
+        base_job: Optional[dict] = None,
+        objective_metric_name: str = "loss",
+        objective_type: str = "minimize",
+        objective_goal: Optional[float] = None,
+        algorithm: str = "random",
+        algorithm_settings: Optional[dict] = None,
+        max_trial_count: int = 10,
+        parallel_trial_count: int = 2,
+        max_failed_trial_count: int = 3,
+        namespace: str = "default",
+        early_stopping: bool = False,
+    ) -> dict:
+        """One-call HPO (reference: KatibClient.tune).
+
+        ``parameters`` maps a name to a search-space dict, e.g.
+        ``{"lr": {"type": "double", "min": 1e-4, "max": 1e-1, "log_scale":
+        True}, "opt": {"type": "categorical", "list": ["adam", "sgd"]}}``.
+        Each trial runs ``base_job`` (default: a 1-worker JAXJob running the
+        training runtime) with ``${trialParameters.<name>}`` substituted;
+        pass placeholders in the base job's args/env where values go. If
+        ``base_job`` is omitted, every parameter is forwarded as
+        ``--arg name=value``.
+        """
+        specs = []
+        for pname, p in parameters.items():
+            fs = {k: v for k, v in p.items() if k != "type"}
+            specs.append({
+                "name": pname,
+                "type": p.get("type", "double"),
+                "feasible_space": fs,
+            })
+        if base_job is None:
+            args = ["--model", "mnist", "--steps", "50"]
+            for pname in parameters:
+                args += ["--arg", f"{pname}=${{trialParameters.{pname}}}"]
+            base_job = {
+                "kind": "JAXJob",
+                "spec": {
+                    "replica_specs": {
+                        "Worker": {
+                            "replicas": 1,
+                            "template": {
+                                "entrypoint": "kubeflow_tpu.runtime.entry",
+                                "args": args,
+                            },
+                        }
+                    }
+                },
+            }
+        exp = {
+            "kind": "Experiment",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {
+                "objective": {
+                    "type": objective_type,
+                    "objective_metric_name": objective_metric_name,
+                    **({"goal": objective_goal} if objective_goal is not None else {}),
+                },
+                "algorithm": {
+                    "name": algorithm,
+                    "settings": {
+                        k: str(v) for k, v in (algorithm_settings or {}).items()
+                    },
+                },
+                "parameters": specs,
+                "trial_template": {"job": base_job},
+                "max_trial_count": max_trial_count,
+                "parallel_trial_count": parallel_trial_count,
+                "max_failed_trial_count": max_failed_trial_count,
+                **({"early_stopping": {"name": "medianstop"}}
+                   if early_stopping else {}),
+            },
+        }
+        return self.apply("Experiment", exp)
+
+    def get_optimal_trial(self, name: str, namespace: str = "default") -> dict:
+        return self.get("Experiment", name, namespace).get("status", {}).get(
+            "current_optimal_trial", {}
+        )
+
+    def wait_for_experiment(
+        self, name: str, namespace: str = "default",
+        timeout: float = 600.0, poll: float = 1.0,
+    ) -> dict:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            obj = self.get("Experiment", name, namespace)
+            conds = obj.get("status", {}).get("conditions", [])
+            active = {c["type"] for c in conds if c.get("status")}
+            if "Succeeded" in active:
+                return obj
+            if "Failed" in active:
+                raise JobFailedError(
+                    f"experiment {namespace}/{name} failed: "
+                    + json.dumps(obj.get("status", {}))[:500]
+                )
+            time.sleep(poll)
+        raise TimeoutError(f"experiment {namespace}/{name} did not finish in {timeout}s")
+
     def wait_for_job_conditions(
         self,
         name: str,

@@ -31,6 +31,8 @@ from kubeflow_tpu.api import TrainJob, apply_defaults, validate_job
 from kubeflow_tpu.api.types import JobKind
 from kubeflow_tpu.api.validation import ValidationError
 from kubeflow_tpu.controller import GangScheduler, JobController, ProcessLauncher
+from kubeflow_tpu.hpo import HPOController
+from kubeflow_tpu.hpo.types import Experiment, validate_experiment
 from kubeflow_tpu.store import ObjectStore
 
 logger = logging.getLogger(__name__)
@@ -56,7 +58,8 @@ class ControlPlane:
         self.controller = JobController(
             self.store, self.launcher, self.gang, log_dir=self.log_dir
         )
-        self.extra_controllers: list = []  # HPO/serving controllers join here
+        self.hpo = HPOController(self.store, log_dir=self.log_dir)
+        self.extra_controllers: list = [self.hpo]  # serving controllers join here
         self._tasks: list[asyncio.Task] = []
         self.started_at = time.time()
 
@@ -129,8 +132,18 @@ class ControlPlane:
                 )
             except (ValidationError, ValueError) as e:
                 return web.json_response({"error": str(e)}, status=422)
+        elif kind == "Experiment":
+            try:
+                obj.setdefault("kind", kind)
+                exp = Experiment.from_dict(obj)
+                validate_experiment(exp)
+                stored = obj_with_preserved_status(self.store, kind, exp.to_dict())
+            # pydantic's ValidationError subclasses ValueError, so one
+            # clause covers model parsing and semantic validation.
+            except (ValidationError, ValueError) as e:
+                return web.json_response({"error": str(e)}, status=422)
         else:
-            # Non-job kinds (Experiment, InferenceService) are validated by
+            # Other non-job kinds (InferenceService) are validated by
             # their controllers; only structural metadata is checked here.
             if not obj.get("metadata", {}).get("name"):
                 return web.json_response(

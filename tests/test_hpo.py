@@ -1,0 +1,586 @@
+"""HPO pillar tests (SURVEY.md 3.2, 7.3).
+
+Mirrors the reference's Katib test strategy: suggestion algorithms tested
+directly with fixed seeds (per-algorithm gRPC tests in the reference),
+controllers tested as object transformers over the fake launcher, plus one
+real-subprocess e2e experiment optimizing a known quadratic.
+"""
+
+import asyncio
+import math
+import sys
+
+import pytest
+
+from kubeflow_tpu.controller import FakeLauncher, GangScheduler, JobController
+from kubeflow_tpu.hpo import HPOController
+from kubeflow_tpu.hpo.algorithms import (
+    ALGORITHMS,
+    TrialResult,
+    get_suggester,
+)
+from kubeflow_tpu.hpo.metrics import median_should_stop, scrape, worker_log_path
+from kubeflow_tpu.hpo.types import (
+    Experiment,
+    MetricsCollectorSpec,
+    render_template,
+    validate_experiment,
+)
+from kubeflow_tpu.store import ObjectStore
+
+
+def make_exp_spec(algorithm="random", settings=None, params=None, **kw):
+    return Experiment.from_dict({
+        "metadata": {"name": "e1"},
+        "spec": {
+            "algorithm": {"name": algorithm, "settings": settings or {}},
+            "parameters": params or [
+                {"name": "lr", "type": "double",
+                 "feasible_space": {"min": 1e-4, "max": 1.0, "log_scale": True}},
+                {"name": "layers", "type": "int",
+                 "feasible_space": {"min": 1, "max": 8}},
+                {"name": "opt", "type": "categorical",
+                 "feasible_space": {"list": ["adam", "sgd", "lion"]}},
+            ],
+            "trial_template": {"job": {"kind": "JAXJob", "spec": {"x": 1}}},
+            **kw,
+        },
+    }).spec
+
+
+def quad(asg):
+    """Toy objective: minimized at lr=0.03, layers=4."""
+    return (math.log10(float(asg["lr"])) - math.log10(0.03)) ** 2 + \
+        0.1 * (int(asg["layers"]) - 4) ** 2
+
+
+class TestAlgorithms:
+    @pytest.mark.parametrize("name", ["random", "sobol", "tpe", "bayesopt", "cmaes"])
+    def test_bounds_and_types(self, name):
+        spec = make_exp_spec(algorithm=name)
+        s = get_suggester(spec)
+        history = []
+        for i in range(12):
+            got = s.suggest(history, len(history), 2)
+            assert len(got) == 2
+            for asg in got:
+                assert 1e-4 <= asg["lr"] <= 1.0
+                assert isinstance(asg["layers"], int) and 1 <= asg["layers"] <= 8
+                assert asg["opt"] in ("adam", "sgd", "lion")
+                history.append(TrialResult(asg, quad(asg), True))
+
+    def test_random_deterministic_no_repeat(self):
+        spec = make_exp_spec("random", settings={"seed": "7"})
+        a = get_suggester(spec).suggest([], 0, 3)
+        b = get_suggester(spec).suggest([], 0, 3)
+        assert a == b  # restart-safe determinism
+        c = get_suggester(spec).suggest([], 3, 3)
+        assert a != c  # stream advances with n_created
+
+    def test_grid_enumerates_exactly(self):
+        spec = make_exp_spec("grid", params=[
+            {"name": "a", "type": "int",
+             "feasible_space": {"min": 0, "max": 2, "step": 1}},
+            {"name": "b", "type": "categorical",
+             "feasible_space": {"list": ["x", "y"]}},
+        ])
+        s = get_suggester(spec)
+        got = s.suggest([], 0, 100)
+        assert len(got) == 6
+        assert {(g["a"], g["b"]) for g in got} == {
+            (i, c) for i in (0, 1, 2) for c in ("x", "y")
+        }
+        assert s.suggest([], 6, 10) == []  # exhausted
+
+    @pytest.mark.parametrize("name", ["tpe", "bayesopt", "cmaes"])
+    def test_model_based_beats_random(self, name):
+        """After warmup, model-based samplers should concentrate near the
+        optimum more than fresh random sampling does."""
+        params = [{"name": "lr", "type": "double",
+                   "feasible_space": {"min": 1e-4, "max": 1.0, "log_scale": True}},
+                  {"name": "layers", "type": "int",
+                   "feasible_space": {"min": 1, "max": 8}}]
+        spec = make_exp_spec(name, settings={"seed": "3", "population": "6"},
+                             params=params)
+        s = get_suggester(spec)
+        history = []
+        for _ in range(30):
+            (asg,) = s.suggest(history, len(history), 1)
+            history.append(TrialResult(asg, quad(asg), True))
+        model_tail = [t.value for t in history[-10:]]
+        rspec = make_exp_spec("random", settings={"seed": "3"}, params=params)
+        rand = [TrialResult(a, quad(a), True)
+                for a in get_suggester(rspec).suggest([], 0, 10)]
+        assert min(model_tail) <= min(t.value for t in rand) * 1.5
+        assert sorted(model_tail)[4] < sorted(t.value for t in rand)[4]
+
+    def test_hyperband_promotes(self):
+        params = [
+            {"name": "lr", "type": "double",
+             "feasible_space": {"min": 0.001, "max": 1.0, "log_scale": True}},
+            {"name": "epochs", "type": "int",
+             "feasible_space": {"min": 1, "max": 9}},
+        ]
+        spec = make_exp_spec(
+            "hyperband",
+            settings={"resource_parameter": "epochs", "eta": "3", "seed": "1"},
+            params=params,
+        )
+        s = get_suggester(spec)
+        history = []
+        # While base-rung trials are still RUNNING, no promotion happens:
+        # the base rung fills with fresh epochs=1 configs.
+        for _ in range(6):
+            (asg,) = s.suggest(history, len(history), 1)
+            assert asg["epochs"] == 1
+            history.append(TrialResult(asg, None, False))
+        # They complete; with 6 done at rung 0 and eta=3, the best 2 promote.
+        history = [TrialResult(t.assignments, quad_lr(t.assignments), True)
+                   for t in history]
+        promoted = []
+        for _ in range(2):
+            (asg,) = s.suggest(history, len(history), 1)
+            if asg["epochs"] == 3:
+                promoted.append(asg)
+            history.append(TrialResult(asg, quad_lr(asg), True))
+        assert len(promoted) == 2, "expected both suggestions to be promotions"
+        best_lr = sorted(history[:6], key=lambda t: t.value)[0].assignments["lr"]
+        assert any(abs(p["lr"] - best_lr) < 1e-12 for p in promoted)
+
+    def test_all_registered(self):
+        assert set(ALGORITHMS) == {
+            "random", "grid", "sobol", "tpe", "bayesopt", "cmaes", "hyperband"
+        }
+
+
+def quad_lr(asg):
+    return (math.log10(float(asg["lr"])) - math.log10(0.03)) ** 2
+
+
+class TestTemplateAndValidation:
+    def test_render_types_and_embedding(self):
+        tpl = {
+            "spec": {
+                "args": ["--lr", "${trialParameters.lr}"],
+                "env": {"OPT": "opt-${trialParameters.opt}"},
+            }
+        }
+        out = render_template(tpl, {"lr": 0.01, "opt": "adam"})
+        assert out["spec"]["args"] == ["--lr", "0.01"]
+        assert out["spec"]["env"]["OPT"] == "opt-adam"
+
+    def test_validation_rejects(self):
+        with pytest.raises(ValueError, match="non-empty"):
+            validate_experiment(Experiment.from_dict({
+                "metadata": {"name": "e"},
+                "spec": {"trial_template": {"job": {"spec": {}}},
+                         "parameters": []},
+            }))
+        exp = Experiment.from_dict({
+            "metadata": {"name": "e"},
+            "spec": {
+                "parameters": [{"name": "x", "type": "double",
+                                "feasible_space": {"min": 1, "max": 0}}],
+                "trial_template": {"job": {"spec": {"a": 1}}},
+            },
+        })
+        with pytest.raises(ValueError, match="min must be"):
+            validate_experiment(exp)
+        exp2 = Experiment.from_dict({
+            "metadata": {"name": "e"},
+            "spec": {
+                "algorithm": {"name": "nope"},
+                "parameters": [{"name": "x", "type": "double",
+                                "feasible_space": {"min": 0, "max": 1}}],
+                "trial_template": {"job": {"spec": {"a": 1}}},
+            },
+        })
+        with pytest.raises(ValueError, match="unknown algorithm"):
+            validate_experiment(exp2)
+
+
+class TestMetrics:
+    def test_scrape_stdout(self, tmp_path):
+        log = tmp_path / "default_t1_worker-0.log"
+        log.write_text(
+            "booting\n"
+            "KFTPU-METRIC step=1 loss=0.9 acc=0.1\n"
+            "noise line loss=bogus\n"
+            "KFTPU-METRIC step=2 loss=0.5 acc=0.4\n"
+        )
+        obs, series, off, _ = scrape(MetricsCollectorSpec(), str(log), ["loss", "acc"])
+        assert obs.value_of("loss") == 0.5
+        assert obs.value_of("acc") == 0.4
+        m = next(x for x in obs.metrics if x.name == "loss")
+        assert (m.min, m.max) == (0.5, 0.9)
+        assert series["loss"] == [(1, 0.9), (2, 0.5)]
+        assert off == log.stat().st_size
+        # Incremental: re-scrape from the returned offset sees only new lines.
+        with open(log, "a") as f:
+            f.write("KFTPU-METRIC step=3 loss=0.3\npartial line without newline")
+        obs2, series2, off2, _ = scrape(
+            MetricsCollectorSpec(), str(log), ["loss", "acc"], offset=off
+        )
+        assert series2["loss"] == [(3, 0.3)]
+        assert obs2.value_of("loss") == 0.3
+        # The trailing partial line is held back until it gets a newline.
+        _, series3, off3, _ = scrape(
+            MetricsCollectorSpec(), str(log), ["loss"], offset=off2
+        )
+        assert series3["loss"] == [] and off3 == off2
+        assert worker_log_path(str(tmp_path), "default", "t1", "Worker").endswith(
+            "default_t1_worker-0.log"
+        )
+
+    def test_scrape_file_kind(self, tmp_path):
+        f = tmp_path / "metrics.jsonl"
+        f.write_text('{"name": "loss", "value": 0.25, "step": 3}\nnot json\n')
+        obs, series, _, _ = scrape(
+            MetricsCollectorSpec(kind="file", file_path=str(f)), str(f), ["loss"]
+        )
+        assert obs.value_of("loss") == 0.25
+        assert series["loss"] == [(3, 0.25)]
+
+    def test_auto_step_continues_across_incremental_scrapes(self, tmp_path):
+        f = tmp_path / "m.jsonl"
+        spec = MetricsCollectorSpec(kind="file", file_path=str(f))
+        f.write_text('{"name": "loss", "value": 1.0}\n{"name": "loss", "value": 0.9}\n')
+        _, s1, off, astep = scrape(spec, str(f), ["loss"])
+        assert s1["loss"] == [(1, 1.0), (2, 0.9)]
+        with open(f, "a") as fh:
+            fh.write('{"name": "loss", "value": 0.8}\n')
+        _, s2, _, _ = scrape(spec, str(f), ["loss"], offset=off, auto_step=astep)
+        # Pseudo-steps stay monotonic across polls (early stopping's x-axis).
+        assert s2["loss"] == [(3, 0.8)]
+
+    def test_set_condition_noop_is_stable(self):
+        """Re-asserting an unchanged condition must not touch the status:
+        a timestamp bump would make reconcile->persist->watch->reconcile a
+        self-triggering hot loop."""
+        from kubeflow_tpu.hpo.types import ExperimentStatus, TrialStatus
+
+        for status in (ExperimentStatus(), TrialStatus()):
+            status.set_condition("Running", "TrialsRunning")
+            before = status.model_dump(mode="json")
+            status.set_condition("Running", "TrialsRunning")
+            assert status.model_dump(mode="json") == before
+
+    def test_medianstop(self):
+        done = [[(1, 1.0), (2, 0.5)], [(1, 0.9), (2, 0.4)], [(1, 1.1), (2, 0.6)]]
+        # Running trial much worse than the median at step 2 -> stop.
+        assert median_should_stop([(1, 2.0), (2, 1.9)], done, True)
+        # Better than median -> keep.
+        assert not median_should_stop([(1, 0.8), (2, 0.3)], done, True)
+        # Too few completed -> keep.
+        assert not median_should_stop([(1, 9.9)], done[:2], True)
+
+
+def mk_experiment_obj(name="exp1", max_trials=4, parallel=2, algorithm="random",
+                      goal=None, early=False, settings=None):
+    spec = {
+        "objective": {"type": "minimize", "objective_metric_name": "loss",
+                      **({"goal": goal} if goal is not None else {})},
+        "algorithm": {"name": algorithm,
+                      "settings": settings or {"seed": "5"}},
+        "parameters": [
+            {"name": "lr", "type": "double",
+             "feasible_space": {"min": 0.001, "max": 0.1, "log_scale": True}},
+        ],
+        "trial_template": {"job": {
+            "kind": "JAXJob",
+            "spec": {"replica_specs": {"Worker": {
+                "replicas": 1,
+                "template": {
+                    "entrypoint": "fake.trial",
+                    "args": ["--lr", "${trialParameters.lr}"],
+                },
+                "resources": {"tpu": 1},
+            }}},
+        }},
+        "max_trial_count": max_trials,
+        "parallel_trial_count": parallel,
+        "max_failed_trial_count": 1,
+    }
+    if early:
+        spec["early_stopping"] = {"name": "medianstop", "min_trials_required": 2,
+                                  "start_step": 1}
+    return {"kind": "Experiment", "metadata": {"name": name}, "spec": spec}
+
+
+class HPOHarness:
+    """JobController (fake launcher) + HPOController over one store."""
+
+    def __init__(self, tmp_path, total_chips=8):
+        self.store = ObjectStore(":memory:")
+        self.launcher = FakeLauncher()
+        self.log_dir = str(tmp_path)
+        self.ctl = JobController(
+            self.store, self.launcher, GangScheduler(total_chips=total_chips),
+            backoff_base_seconds=0.01,
+        )
+        self.hpo = HPOController(self.store, log_dir=self.log_dir,
+                                 poll_interval=0.05)
+        self.tasks = []
+
+    async def __aenter__(self):
+        self.tasks = [
+            asyncio.create_task(self.ctl.run()),
+            asyncio.create_task(self.hpo.run()),
+        ]
+        await asyncio.sleep(0)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.hpo.stop()
+        await self.ctl.stop()
+        for t in self.tasks:
+            try:
+                await asyncio.wait_for(t, 2)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                t.cancel()
+        self.store.close()
+
+    async def wait(self, pred, timeout=10.0):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if pred():
+                return True
+            await asyncio.sleep(0.02)
+        return False
+
+    def write_trial_log(self, trial_name, lines):
+        import pathlib
+
+        p = pathlib.Path(self.log_dir) / f"default_{trial_name}_worker-0.log"
+        p.write_text(lines)
+
+    async def finish_trial(self, trial_name, loss, code=0):
+        self.write_trial_log(
+            trial_name,
+            f"KFTPU-METRIC step=1 loss={loss * 2}\n"
+            f"KFTPU-METRIC step=2 loss={loss}\n",
+        )
+        await self.launcher.exit(f"default/{trial_name}/worker-0", code)
+
+    def trials(self):
+        return sorted(
+            self.store.list("Trial"), key=lambda t: t["metadata"]["name"]
+        )
+
+    def exp(self, name="exp1"):
+        return self.store.get("Experiment", name)
+
+
+def test_experiment_runs_to_max_trials(tmp_path):
+    async def run():
+        async with HPOHarness(tmp_path) as h:
+            h.store.put("Experiment", mk_experiment_obj(max_trials=4, parallel=2))
+            assert await h.wait(lambda: len(h.launcher.running()) == 2)
+            # Finish trials as they appear, best loss at t0002.
+            losses = {0: 0.9, 1: 0.5, 2: 0.1, 3: 0.7}
+            for i in range(4):
+                name = f"exp1-t{i:04d}"
+                assert await h.wait(
+                    lambda n=name: any(
+                        r.worker_id == f"default/{n}/worker-0"
+                        for r in h.launcher.running()
+                    )
+                ), f"worker for {name} never spawned"
+                await h.finish_trial(name, losses[i])
+            assert await h.wait(
+                lambda: h.exp()["status"]["conditions"]
+                and any(c["type"] == "Succeeded" and c["status"]
+                        for c in h.exp()["status"]["conditions"])
+            ), h.exp()["status"]
+            st = h.exp()["status"]
+            assert st["trials_succeeded"] == 4
+            assert st["current_optimal_trial"]["name"] == "exp1-t0002"
+            assert abs(
+                st["current_optimal_trial"]["observation"]["metrics"][0]["latest"] - 0.1
+            ) < 1e-9
+            # Trials carry the substituted lr in job args.
+            t0 = h.trials()[0]
+            args = t0["spec"]["job"]["spec"]["replica_specs"]["Worker"]["template"]["args"]
+            assert args[0] == "--lr" and 0.001 <= float(args[1]) <= 0.1
+
+
+
+    asyncio.run(run())
+
+def test_experiment_goal_stops_running_trials(tmp_path):
+    async def run():
+        async with HPOHarness(tmp_path) as h:
+            h.store.put("Experiment", mk_experiment_obj(
+                max_trials=10, parallel=2, goal=0.2))
+            assert await h.wait(lambda: len(h.launcher.running()) == 2)
+            await h.finish_trial("exp1-t0000", 0.15)  # crosses goal
+            assert await h.wait(
+                lambda: any(c["type"] == "Succeeded" and c["status"]
+                            for c in h.exp()["status"].get("conditions", []))
+            ), h.exp()["status"]
+            # The still-running sibling was stopped and its job deleted.
+            assert await h.wait(lambda: not h.launcher.running())
+            assert h.store.get("JAXJob", "exp1-t0001") is None
+            phases = {t["metadata"]["name"]: t for t in h.trials()}
+            assert any(
+                c["type"] == "EarlyStopped" and c["status"]
+                for c in phases["exp1-t0001"]["status"]["conditions"]
+            )
+
+
+
+    asyncio.run(run())
+
+def test_experiment_fails_on_failed_trials(tmp_path):
+    async def run():
+        async with HPOHarness(tmp_path) as h:
+            exp = mk_experiment_obj(max_trials=6, parallel=2)
+            exp["spec"]["max_failed_trial_count"] = 1
+            # Trials fail fast: worker exits nonzero with restartPolicy Never.
+            exp["spec"]["trial_template"]["job"]["spec"]["replica_specs"]["Worker"][
+                "restart_policy"] = "Never"
+            h.store.put("Experiment", exp)
+            for i in range(2):
+                name = f"exp1-t{i:04d}"
+                assert await h.wait(
+                    lambda n=name: any(
+                        r.worker_id == f"default/{n}/worker-0"
+                        for r in h.launcher.running())
+                )
+                await h.launcher.exit(f"default/{name}/worker-0", 1)
+            assert await h.wait(
+                lambda: any(c["type"] == "Failed" and c["status"]
+                            for c in h.exp()["status"].get("conditions", []))
+            ), h.exp()["status"]
+
+
+
+    asyncio.run(run())
+
+def test_trial_missing_metrics_fails(tmp_path):
+    async def run():
+        async with HPOHarness(tmp_path) as h:
+            h.store.put("Experiment", mk_experiment_obj(max_trials=1, parallel=1))
+            name = "exp1-t0000"
+            assert await h.wait(lambda: h.launcher.running())
+            # Exit 0 without ever reporting the objective metric.
+            await h.launcher.exit(f"default/{name}/worker-0", 0)
+            assert await h.wait(
+                lambda: any(
+                    c["type"] == "Failed" and c["status"]
+                    and c["reason"] == "MetricsUnavailable"
+                    for c in (h.store.get("Trial", name) or {"status": {"conditions": []}})
+                    ["status"]["conditions"]
+                )
+            )
+
+
+
+    asyncio.run(run())
+
+def test_experiment_delete_cascades(tmp_path):
+    async def run():
+        async with HPOHarness(tmp_path) as h:
+            h.store.put("Experiment", mk_experiment_obj(max_trials=4, parallel=2))
+            assert await h.wait(lambda: len(h.launcher.running()) == 2)
+            h.store.delete("Experiment", "exp1")
+            assert await h.wait(lambda: not h.store.list("Trial"))
+            assert await h.wait(lambda: not h.launcher.running())
+            assert h.store.get("JAXJob", "exp1-t0000") is None
+
+
+
+    asyncio.run(run())
+
+def test_medianstop_prunes_bad_trial(tmp_path):
+    async def run():
+        async with HPOHarness(tmp_path) as h:
+            h.store.put("Experiment", mk_experiment_obj(
+                max_trials=8, parallel=2, early=True))
+            # Complete two good trials to establish the median.
+            for i in range(2):
+                name = f"exp1-t{i:04d}"
+                assert await h.wait(
+                    lambda n=name: any(
+                        r.worker_id == f"default/{n}/worker-0"
+                        for r in h.launcher.running())
+                )
+                await h.finish_trial(name, 0.1)
+            # Third trial reports a terrible objective and keeps running.
+            name = "exp1-t0002"
+            assert await h.wait(
+                lambda: any(r.worker_id == f"default/{name}/worker-0"
+                            for r in h.launcher.running())
+            )
+            h.write_trial_log(name, "KFTPU-METRIC step=2 loss=5.0\n")
+            assert await h.wait(
+                lambda: any(
+                    c["type"] == "EarlyStopped" and c["status"]
+                    for c in (h.store.get("Trial", name) or {"status": {"conditions": []}})
+                    ["status"]["conditions"]
+                ), timeout=15,
+            ), h.store.get("Trial", name)["status"]
+            st = h.exp()["status"]
+            assert st["trials_early_stopped"] >= 1
+
+
+
+    asyncio.run(run())
+
+def test_e2e_experiment_real_processes(tmp_path):
+    async def run():
+        """Real subprocesses optimize a quadratic; TPE finds lr near 0.03."""
+        from kubeflow_tpu.controller import ProcessLauncher
+
+        store = ObjectStore(":memory:")
+        log_dir = tmp_path / "logs"
+        launcher = ProcessLauncher(log_dir=str(log_dir))
+        ctl = JobController(store, launcher, GangScheduler(total_chips=8))
+        hpo = HPOController(store, log_dir=str(log_dir), poll_interval=0.1)
+        tasks = [asyncio.create_task(ctl.run()), asyncio.create_task(hpo.run())]
+
+        script = (
+            "import sys, math\n"
+            "lr = float(sys.argv[sys.argv.index('--lr') + 1])\n"
+            "v = (math.log10(lr) - math.log10(0.03)) ** 2\n"
+            "for s in (1, 2):\n"
+            "    print(f'KFTPU-METRIC step={s} loss={v:.6f}', flush=True)\n"
+        )
+        exp = mk_experiment_obj(max_trials=6, parallel=2, algorithm="tpe",
+                                settings={"seed": "11", "n_startup_trials": "3"})
+        exp["spec"]["trial_template"]["job"]["spec"]["replica_specs"]["Worker"][
+            "template"] = {
+            "exec": True,
+            "entrypoint": sys.executable,
+            "args": ["-c", script, "--lr", "${trialParameters.lr}"],
+        }
+        store.put("Experiment", exp)
+        try:
+            deadline = asyncio.get_event_loop().time() + 60
+            done = False
+            while asyncio.get_event_loop().time() < deadline:
+                obj = store.get("Experiment", "exp1")
+                conds = obj.get("status", {}).get("conditions", [])
+                if any(c["type"] == "Succeeded" and c["status"] for c in conds):
+                    done = True
+                    break
+                assert not any(c["type"] == "Failed" and c["status"] for c in conds), obj
+                await asyncio.sleep(0.2)
+            assert done, store.get("Experiment", "exp1")
+            st = store.get("Experiment", "exp1")["status"]
+            assert st["trials_succeeded"] == 6
+            best = st["current_optimal_trial"]
+            assert best["name"]
+            assert best["observation"]["metrics"][0]["latest"] < 1.0
+        finally:
+            await hpo.stop()
+            await ctl.stop()
+            for t in tasks:
+                try:
+                    await asyncio.wait_for(t, 2)
+                except (asyncio.TimeoutError, asyncio.CancelledError):
+                    t.cancel()
+            store.close()
+
+    asyncio.run(run())
+
