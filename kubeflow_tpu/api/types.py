@@ -301,7 +301,7 @@ def phase_of_obj(obj: dict) -> str:
     conds = obj.get("status", {}).get("conditions", [])
     active = {c.get("type") for c in conds if c.get("status")}
     for t in ("Failed", "Succeeded", "Suspended", "Restarting", "Running",
-              "Created"):
+              "Ready", "Unready", "Created"):
         if t in active:
             return "Pending" if t == "Created" else t
     return "Pending"

@@ -41,14 +41,14 @@ class TrainingClient:
 
     # -- transport --------------------------------------------------------
 
-    def _req(self, method: str, path: str, body=None):
+    def _req(self, method: str, path: str, body=None, timeout: float = 30):
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
             self.base + path, data=data, method=method,
             headers={"Content-Type": "application/json"},
         )
         try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
                 text = resp.read().decode()
         except urllib.error.HTTPError as e:
             body = e.read().decode()
@@ -287,3 +287,42 @@ class TrainingClient:
         raise TimeoutError(
             f"{kind} {namespace}/{name} did not reach {expected} in {timeout}s"
         )
+
+    # -- serving (KServe-client analog, SURVEY.md 3.3) ---------------------
+
+    def create_inference_service(self, isvc: dict) -> dict:
+        return self.apply("InferenceService", isvc)
+
+    def wait_for_inference_service(
+        self, name: str, namespace: str = "default",
+        timeout: float = 300.0, poll: float = 0.5,
+    ) -> dict:
+        """Block until the ISVC has a Ready condition (or Failed -> raise)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            obj = self.get("InferenceService", name, namespace)
+            conds = obj.get("status", {}).get("conditions", [])
+            if any(c["type"] == "Ready" and c["status"] for c in conds):
+                return obj
+            failed = [c for c in conds if c["type"] == "Failed" and c["status"]]
+            if failed:
+                raise JobFailedError(
+                    f"InferenceService {namespace}/{name}: {failed[0]['message']}"
+                )
+            time.sleep(poll)
+        raise TimeoutError(
+            f"InferenceService {namespace}/{name} not ready in {timeout}s"
+        )
+
+    def predict(self, name: str, instances: list, namespace: str = "default",
+                model: Optional[str] = None, timeout: float = 300.0) -> list:
+        """V1 predict through the activator; cold-starts scale-to-zero
+        services transparently (the request is held, not rejected), hence
+        the long default timeout."""
+        model = model or name
+        return self._req(
+            "POST",
+            f"/serving/{namespace}/{name}/v1/models/{model}:predict",
+            {"instances": instances},
+            timeout=timeout,
+        )["predictions"]

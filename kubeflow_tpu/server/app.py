@@ -33,6 +33,12 @@ from kubeflow_tpu.api.validation import ValidationError
 from kubeflow_tpu.controller import GangScheduler, JobController, ProcessLauncher
 from kubeflow_tpu.hpo import HPOController
 from kubeflow_tpu.hpo.types import Experiment, validate_experiment
+from kubeflow_tpu.serving.controller import Activator, ISVCController
+from kubeflow_tpu.serving.types import (
+    InferenceService,
+    ServingValidationError,
+    validate_isvc,
+)
 from kubeflow_tpu.store import ObjectStore
 
 logger = logging.getLogger(__name__)
@@ -59,7 +65,22 @@ class ControlPlane:
             self.store, self.launcher, self.gang, log_dir=self.log_dir
         )
         self.hpo = HPOController(self.store, log_dir=self.log_dir)
-        self.extra_controllers: list = [self.hpo]  # serving controllers join here
+        self.isvc = ISVCController(
+            self.store, self.launcher, log_dir=self.log_dir, state_dir=state_dir
+        )
+        self.activator = Activator(self.isvc)
+
+        # Worker exits fan out: serving replicas first (on_worker_exit
+        # returns False for non-server workers), then training jobs. Bound
+        # to the controllers directly -- independent of who called
+        # set_exit_callback first.
+        async def dispatch_exit(ref, code):
+            if await self.isvc.on_worker_exit(ref, code):
+                return
+            await self.controller._on_worker_exit(ref, code)
+
+        self.launcher.set_exit_callback(dispatch_exit)
+        self.extra_controllers: list = [self.hpo, self.isvc]
         self._tasks: list[asyncio.Task] = []
         self.started_at = time.time()
 
@@ -97,6 +118,9 @@ class ControlPlane:
                 web.get("/events/{ns}/{name}", self.h_events),
                 web.get("/healthz", self.h_healthz),
                 web.get("/metrics", self.h_metrics),
+                # Activator: data-plane ingress for InferenceServices.
+                web.route("*", "/serving/{ns}/{name}/{tail:.*}",
+                          self.activator.handle),
             ]
         )
 
@@ -142,9 +166,21 @@ class ControlPlane:
             # clause covers model parsing and semantic validation.
             except (ValidationError, ValueError) as e:
                 return web.json_response({"error": str(e)}, status=422)
+        elif kind == "InferenceService":
+            try:
+                obj.setdefault("kind", kind)
+                if obj["kind"] != kind:
+                    raise ValidationError(
+                        f"body kind {obj['kind']} != URL kind {kind}"
+                    )
+                isvc = InferenceService.from_dict(obj)
+                validate_isvc(isvc)
+                stored = obj_with_preserved_status(self.store, kind, isvc.to_dict())
+            except (ServingValidationError, ValueError) as e:
+                return web.json_response({"error": str(e)}, status=422)
         else:
-            # Other non-job kinds (InferenceService) are validated by
-            # their controllers; only structural metadata is checked here.
+            # Other non-job kinds are validated by their controllers; only
+            # structural metadata is checked here.
             if not obj.get("metadata", {}).get("name"):
                 return web.json_response(
                     {"error": "metadata.name is required"}, status=422

@@ -1,0 +1,30 @@
+"""Serving pillar (KServe-equivalent, SURVEY.md 3.3 + 7.1 step 7).
+
+- ``types``     InferenceService/runtime-registry API types (S1)
+- ``storage``   storage initializer (S3)
+- ``model``     Model base class, repository, batcher (S4 + S6 batcher)
+- ``server``    aiohttp V1/V2 protocol server (S4)
+- ``runtimes``  bundled format runtimes: sklearn, jax LLM, echo (S5)
+- ``controller``ISVC reconciler + autoscaler + scale-to-zero activator (S2)
+"""
+
+from kubeflow_tpu.serving.model import Batcher, InferenceError, Model, ModelRepository
+from kubeflow_tpu.serving.server import ModelServer
+from kubeflow_tpu.serving.types import (
+    InferenceService,
+    ModelFormat,
+    ServingValidationError,
+    validate_isvc,
+)
+
+__all__ = [
+    "Batcher",
+    "InferenceError",
+    "InferenceService",
+    "Model",
+    "ModelFormat",
+    "ModelRepository",
+    "ModelServer",
+    "ServingValidationError",
+    "validate_isvc",
+]

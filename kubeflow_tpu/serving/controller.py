@@ -1,0 +1,536 @@
+"""InferenceService controller + autoscaler + scale-to-zero activator
+(KServe-equivalent S2 + Knative KPA/activator semantics, SURVEY.md 4.5).
+
+Reconcile loop (same event-driven shape as JobController/HPOController):
+
+ISVC applied -> validate -> for each component, converge actual replica
+server processes to the desired count -> probe /healthz until Ready ->
+status conditions. Replica processes are spawned through the same
+ProcessLauncher the training reconciler uses (the "kubelet").
+
+Autoscaling: desired = clamp(ceil(in_flight / target_concurrency),
+min_replicas, max_replicas); when min_replicas=0 and the service has been
+idle past the grace period, desired drops to 0 (scale-to-zero). The
+activator buffers requests that arrive with zero ready replicas, triggers
+a scale-up, and replays once a replica reports ready -- the reference's
+activator->KPA cold-start path (SURVEY.md 7.4 #5).
+
+TPU note: replica processes on this host share the one visible chip; the
+jit compile cache makes the cold-start path survivable. Chip-capacity
+accounting for serving (contending with training gangs) is a later round.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+import aiohttp
+from aiohttp import web
+
+from kubeflow_tpu.controller.launcher import BaseLauncher, SpawnRequest, WorkerRef
+from kubeflow_tpu.serving.types import (
+    KIND,
+    ComponentSpec,
+    InferenceService,
+    ModelFormat,
+    ReplicaInfo,
+    ReplicaState,
+    RUNTIMES,
+    ServingValidationError,
+    set_condition,
+    validate_isvc,
+)
+from kubeflow_tpu.utils.ports import allocate_port
+
+logger = logging.getLogger(__name__)
+
+PRIMARY = "predictor"  # component the activator routes to
+
+
+class _Replica:
+    """Controller-side record of one running server process."""
+
+    def __init__(self, index: int, port: int, ref: WorkerRef) -> None:
+        self.index = index
+        self.port = port
+        self.ref = ref
+        self.ready = False
+        self.in_flight = 0  # proxied requests on this replica (drain gate)
+        self.started_at = time.time()
+
+    def info(self) -> ReplicaInfo:
+        return ReplicaInfo(
+            index=self.index,
+            port=self.port,
+            pid=self.ref.pid,
+            state=ReplicaState.Ready if self.ready else ReplicaState.Pending,
+            started_at=self.started_at,
+        )
+
+
+class _Service:
+    """In-memory state for one ISVC (the controller's expectations)."""
+
+    def __init__(self) -> None:
+        self.replicas: Dict[int, _Replica] = {}
+        self.desired: int = 0
+        self.in_flight: int = 0
+        self.last_request: float = time.time()
+        self.next_index: int = 0
+        self.rr: int = 0  # round-robin cursor
+        self.ready_event = asyncio.Event()
+        self.failure_count = 0
+        self.spec_fingerprint: Optional[str] = None
+
+    def ready_replicas(self) -> List[_Replica]:
+        return [r for r in self.replicas.values() if r.ready]
+
+
+class ISVCController:
+    CRASH_LOOP_LIMIT = 5
+
+    def __init__(
+        self,
+        store,
+        launcher: BaseLauncher,
+        log_dir: Optional[str] = None,
+        state_dir: Optional[str] = None,
+        probe_interval: float = 0.25,
+        autoscale_interval: float = 2.0,
+    ) -> None:
+        self.store = store
+        self.launcher = launcher
+        self.log_dir = log_dir
+        self.state_dir = state_dir or "."
+        self.probe_interval = probe_interval
+        self.autoscale_interval = autoscale_interval
+        self.services: Dict[str, _Service] = {}
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._queued: set = set()
+        self._stopped = asyncio.Event()
+        self._http: Optional[aiohttp.ClientSession] = None
+        self._probe_tasks: Dict[str, asyncio.Task] = {}
+
+    # -- loop -------------------------------------------------------------
+
+    async def run(self) -> None:
+        self._http = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=600)
+        )
+        watch_q = self.store.watch(KIND)
+        for obj in self.store.list(KIND):
+            self._enqueue(obj["metadata"]["namespace"], obj["metadata"]["name"])
+        watcher = asyncio.create_task(self._pump_watch(watch_q))
+        scaler = asyncio.create_task(self._autoscale_loop())
+        try:
+            while not self._stopped.is_set():
+                get = asyncio.create_task(self._queue.get())
+                stop = asyncio.create_task(self._stopped.wait())
+                done, pending = await asyncio.wait(
+                    {get, stop}, return_when=asyncio.FIRST_COMPLETED
+                )
+                for t in pending:
+                    t.cancel()
+                if get in done:
+                    key = get.result()
+                    self._queued.discard(key)
+                    try:
+                        await self._reconcile(*key.split("/", 1))
+                    except Exception:
+                        logger.exception("reconcile %s failed", key)
+        finally:
+            watcher.cancel()
+            scaler.cancel()
+            self.store.unwatch(watch_q)
+            for t in self._probe_tasks.values():
+                t.cancel()
+            for key in list(self.services):
+                await self._scale_to(key, 0)
+            await self._http.close()
+
+    async def stop(self) -> None:
+        self._stopped.set()
+
+    async def _pump_watch(self, q: asyncio.Queue) -> None:
+        while True:
+            ev = await q.get()
+            self._enqueue(ev.namespace, ev.name)
+
+    def _enqueue(self, ns: str, name: str) -> None:
+        key = f"{ns}/{name}"
+        if key not in self._queued:
+            self._queued.add(key)
+            self._queue.put_nowait(key)
+
+    # -- reconcile --------------------------------------------------------
+
+    async def _reconcile(self, ns: str, name: str) -> None:
+        key = f"{ns}/{name}"
+        raw = self.store.get(KIND, name, ns)
+        if raw is None:
+            # Deleted: tear down replicas.
+            if key in self.services:
+                await self._scale_to(key, 0)
+                self.services.pop(key, None)
+            return
+        try:
+            isvc = InferenceService.from_dict(raw)
+            validate_isvc(isvc)
+        except (ServingValidationError, ValueError) as e:
+            self._write_failed(ns, name, "InvalidSpec", str(e))
+            return
+
+        svc = self.services.setdefault(key, _Service())
+        comp = isvc.spec.predictor
+        # A changed spec resets the crash-loop counter so a corrected
+        # re-apply recovers without delete+recreate (generation can't be
+        # the key: status writes bump it too).
+        fingerprint = json.dumps(
+            isvc.spec.model_dump(mode="json"), sort_keys=True
+        )
+        if svc.spec_fingerprint != fingerprint:
+            svc.spec_fingerprint = fingerprint
+            svc.failure_count = 0
+        if svc.failure_count >= self.CRASH_LOOP_LIMIT:
+            # Crash-looping: stay down until the spec changes.
+            await self._scale_to(key, 0)
+            return
+        if svc.desired == 0 and not svc.replicas:
+            # First reconcile (or post scale-to-zero restart): start at
+            # min_replicas; the activator bumps desired on traffic.
+            svc.desired = max(svc.desired, comp.min_replicas)
+        svc.desired = max(min(svc.desired, comp.max_replicas),
+                         comp.min_replicas)
+        try:
+            await self._converge(key, isvc, comp, svc)
+        except Exception as e:  # noqa: BLE001 - spec/spawn errors -> Failed
+            logger.exception("isvc %s: converge failed", key)
+            self._write_failed(ns, name, "SpawnError", str(e))
+            return
+        self._write_status(isvc, svc)
+
+    def _write_failed(self, ns: str, name: str, reason: str,
+                      message: str) -> None:
+        """Set a Failed condition; no-op when already set identically (a
+        status write fires a watch event that re-reconciles, so an
+        unconditional write here would be a self-triggering hot loop)."""
+
+        raw = self.store.get(KIND, name, ns)
+        if raw is None:
+            return
+        conds = raw.get("status", {}).get("conditions", [])
+        for c in conds:
+            if (c.get("type") == "Failed" and c.get("status")
+                    and c.get("reason") == reason
+                    and c.get("message") == message):
+                return
+        raw.setdefault("status", {})["conditions"] = [{
+            "type": "Failed", "status": True, "reason": reason,
+            "message": message, "last_transition": time.time(),
+        }]
+        self.store.put(KIND, raw)
+
+    async def _converge(self, key: str, isvc: InferenceService,
+                        comp: ComponentSpec, svc: _Service) -> None:
+        # Scale up.
+        while len(svc.replicas) < svc.desired:
+            index = svc.next_index
+            svc.next_index += 1
+            port = allocate_port()
+            req = self._spawn_request(isvc, comp, index, port)
+            ref = await self.launcher.spawn(req)
+            svc.replicas[index] = _Replica(index, port, ref)
+            probe_key = f"{key}#{index}"
+            self._probe_tasks[probe_key] = asyncio.create_task(
+                self._probe_ready(key, index)
+            )
+            logger.info("isvc %s: spawned replica %d on port %d", key, index, port)
+        # Scale down (highest index first; KServe reaps newest too).
+        while len(svc.replicas) > svc.desired:
+            index = max(svc.replicas)
+            rep = svc.replicas.pop(index)
+            t = self._probe_tasks.pop(f"{key}#{index}", None)
+            if t:
+                t.cancel()
+            await self._drain_and_kill(key, rep)
+        if not svc.ready_replicas():
+            svc.ready_event.clear()
+
+    async def _drain_and_kill(self, key: str, rep: _Replica,
+                              drain_timeout: float = 30.0) -> None:
+        """Stop routing to the replica, let in-flight requests finish, then
+        kill. The drain runs as a background task so reconcile never blocks
+        behind a slow request."""
+
+        rep.ready = False  # out of the activator's rotation immediately
+
+        async def drain():
+            deadline = time.monotonic() + drain_timeout
+            while rep.in_flight > 0 and time.monotonic() < deadline:
+                await asyncio.sleep(0.1)
+            await self.launcher.kill(rep.ref)
+            logger.info("isvc %s: reaped replica %d (drained)", key, rep.index)
+
+        asyncio.create_task(drain())
+
+    async def _scale_to(self, key: str, n: int) -> None:
+        svc = self.services.get(key)
+        if svc is None:
+            return
+        svc.desired = n
+        while len(svc.replicas) > n:
+            index = max(svc.replicas)
+            rep = svc.replicas.pop(index)
+            t = self._probe_tasks.pop(f"{key}#{index}", None)
+            if t:
+                t.cancel()
+            await self.launcher.kill(rep.ref)
+        if not svc.ready_replicas():
+            svc.ready_event.clear()
+
+    def _spawn_request(self, isvc: InferenceService, comp: ComponentSpec,
+                       index: int, port: int) -> SpawnRequest:
+        ns, name = isvc.metadata.namespace, isvc.metadata.name
+        env = {"PORT": str(port)}
+        if comp.custom is not None:
+            entrypoint = comp.custom.entrypoint
+            args = list(comp.custom.args)
+            env.update(comp.custom.env)
+        else:
+            m = comp.model
+            if m.format == ModelFormat.custom:
+                raise ServingValidationError("custom format needs custom spec")
+            entrypoint = RUNTIMES[m.format]
+            model_dir = os.path.join(
+                self.state_dir, "models", ns, name
+            )
+            args = [
+                "--model-name", m.name or name,
+                "--port", str(port),
+                "--model-dir", model_dir,
+                "--options-json", json.dumps(m.options),
+            ]
+            if m.storage_uri:
+                args += ["--storage-uri", m.storage_uri]
+        return SpawnRequest(
+            job_key=f"{ns}/{name}",
+            replica_type="server",
+            index=index,
+            entrypoint=entrypoint,
+            args=tuple(args),
+            env=tuple(sorted(env.items())),
+        )
+
+    async def _probe_ready(self, key: str, index: int) -> None:
+        """Poll the replica's /healthz until it reports ready."""
+
+        while not self._stopped.is_set():
+            svc = self.services.get(key)
+            if svc is None or index not in svc.replicas:
+                return
+            rep = svc.replicas[index]
+            try:
+                async with self._http.get(
+                    f"http://127.0.0.1:{rep.port}/healthz",
+                    timeout=aiohttp.ClientTimeout(total=2),
+                ) as resp:
+                    body = await resp.json()
+                    if body.get("ready"):
+                        rep.ready = True
+                        svc.failure_count = 0
+                        svc.ready_event.set()
+                        self._enqueue(*key.split("/", 1))
+                        return
+            except Exception:
+                pass
+            await asyncio.sleep(self.probe_interval)
+
+    async def on_worker_exit(self, ref: WorkerRef, code: int) -> bool:
+        """Called by the shared exit dispatcher for server replicas.
+
+        Returns True if the exit belonged to a serving replica."""
+
+        key = ref.req.job_key
+        svc = self.services.get(key)
+        if svc is None or ref.req.replica_type != "server":
+            return False
+        index = ref.req.index
+        rep = svc.replicas.get(index)
+        if rep is None or rep.ref.generation != ref.generation:
+            return True  # stale exit for an already-replaced replica
+        svc.replicas.pop(index, None)
+        self._probe_tasks.pop(f"{key}#{index}", None)
+        if not svc.ready_replicas():
+            svc.ready_event.clear()
+        svc.failure_count += 1
+        logger.warning(
+            "isvc %s replica %d exited code=%d (failures=%d)",
+            key, index, code, svc.failure_count,
+        )
+        # Crash-looping guard: stop respawning after repeated failures;
+        # the status shows Failed with the failure count.
+        if svc.failure_count < self.CRASH_LOOP_LIMIT:
+            self._enqueue(*key.split("/", 1))
+        elif svc.failure_count == self.CRASH_LOOP_LIMIT:
+            ns, name = key.split("/", 1)
+            self._write_failed(
+                ns, name, "CrashLoop",
+                f"replica exited {svc.failure_count} times (last code {code})",
+            )
+        return True
+
+    # -- autoscaler -------------------------------------------------------
+
+    async def _autoscale_loop(self) -> None:
+        while not self._stopped.is_set():
+            await asyncio.sleep(self.autoscale_interval)
+            for key, svc in list(self.services.items()):
+                ns, name = key.split("/", 1)
+                raw = self.store.get(KIND, name, ns)
+                if raw is None:
+                    continue
+                try:
+                    comp = InferenceService.from_dict(raw).spec.predictor
+                except ValueError:
+                    continue
+                import math
+
+                want = math.ceil(svc.in_flight / comp.target_concurrency)
+                want = min(max(want, comp.min_replicas), comp.max_replicas)
+                idle = time.time() - svc.last_request
+                if (comp.min_replicas == 0 and svc.in_flight == 0
+                        and idle > comp.scale_to_zero_grace_seconds):
+                    want = 0
+                elif want == 0 and (svc.in_flight > 0 or svc.desired > 0):
+                    want = max(want, 1 if svc.in_flight else svc.desired)
+                if want != svc.desired:
+                    logger.info(
+                        "isvc %s: autoscale %d -> %d (in_flight=%d idle=%.0fs)",
+                        key, svc.desired, want, svc.in_flight, idle,
+                    )
+                    svc.desired = want
+                    self._enqueue(ns, name)
+
+    # -- status -----------------------------------------------------------
+
+    def _write_status(self, isvc: InferenceService, svc: _Service) -> None:
+        raw = self.store.get(KIND, isvc.metadata.name, isvc.metadata.namespace)
+        if raw is None:
+            return
+        status = isvc.status
+        ready = svc.ready_replicas()
+        status.predictor.desired_replicas = svc.desired
+        status.predictor.ready_replicas = len(ready)
+        status.predictor.replicas = [r.info() for r in svc.replicas.values()]
+        status.in_flight = svc.in_flight
+        status.last_request_time = svc.last_request
+        status.url = (
+            f"/serving/{isvc.metadata.namespace}/{isvc.metadata.name}"
+        )
+        set_condition(status, "Created", "Reconciled")
+        if ready:
+            set_condition(status, "Ready", "MinimumReplicasAvailable",
+                          f"{len(ready)}/{svc.desired} replicas ready")
+        elif svc.desired == 0:
+            set_condition(status, "Unready", "ScaledToZero",
+                          "scaled to zero; activator buffers requests")
+        else:
+            set_condition(status, "Unready", "WaitingForReplicas",
+                          f"0/{svc.desired} replicas ready")
+        new = dict(raw)
+        new["status"] = status.model_dump(mode="json", exclude_none=True)
+        if new["status"] != raw.get("status"):
+            self.store.put(KIND, new)
+
+
+class Activator:
+    """Routing + scale-from-zero buffer, mounted on the control-plane app.
+
+    ``/serving/{ns}/{name}/{tail}`` proxies to a ready predictor replica
+    (round-robin). With zero ready replicas it bumps desired, waits on the
+    service's ready_event (holding the request, as Knative's activator
+    does), then replays.
+    """
+
+    def __init__(self, controller: ISVCController,
+                 cold_start_timeout: float = 180.0) -> None:
+        self.controller = controller
+        self.cold_start_timeout = cold_start_timeout
+
+    async def handle(self, req: web.Request) -> web.StreamResponse:
+        ns, name = req.match_info["ns"], req.match_info["name"]
+        tail = req.match_info.get("tail", "")
+        key = f"{ns}/{name}"
+        ctrl = self.controller
+        raw = ctrl.store.get(KIND, name, ns)
+        if raw is None:
+            return web.json_response(
+                {"error": f"inference service {key} not found"}, status=404
+            )
+        # Fail fast on a Failed (crash-looping / invalid) service instead of
+        # holding the request for the whole cold-start timeout.
+        failed = [
+            c for c in raw.get("status", {}).get("conditions", [])
+            if c.get("type") == "Failed" and c.get("status")
+        ]
+        if failed:
+            return web.json_response(
+                {"error": f"service failed ({failed[0].get('reason')}): "
+                          f"{failed[0].get('message')}"},
+                status=503,
+            )
+        svc = ctrl.services.setdefault(key, _Service())
+        svc.last_request = time.time()
+        svc.in_flight += 1
+        replica = None
+        try:
+            replica = await self._get_replica(key, svc)
+            if replica is None:
+                return web.json_response(
+                    {"error": "no replica became ready in time"}, status=503
+                )
+            replica.in_flight += 1
+            url = f"http://127.0.0.1:{replica.port}/{tail}"
+            if req.query_string:
+                url += f"?{req.query_string}"
+            body = await req.read()
+            async with ctrl._http.request(
+                req.method, url, data=body if body else None,
+                headers={"Content-Type": req.content_type or "application/json"},
+            ) as resp:
+                payload = await resp.read()
+                return web.Response(
+                    body=payload, status=resp.status,
+                    content_type=resp.content_type,
+                )
+        except aiohttp.ClientError as e:
+            return web.json_response({"error": f"upstream: {e}"}, status=502)
+        finally:
+            if replica is not None:
+                replica.in_flight -= 1
+            svc.in_flight -= 1
+            svc.last_request = time.time()
+
+    async def _get_replica(self, key: str, svc: _Service) -> Optional[_Replica]:
+        ready = svc.ready_replicas()
+        if not ready:
+            # Cold start: ask for at least one replica and hold the request.
+            if svc.desired < 1:
+                svc.desired = 1
+            self.controller._enqueue(*key.split("/", 1))
+            try:
+                await asyncio.wait_for(
+                    svc.ready_event.wait(), self.cold_start_timeout
+                )
+            except asyncio.TimeoutError:
+                return None
+            ready = svc.ready_replicas()
+            if not ready:
+                return None
+        svc.rr = (svc.rr + 1) % len(ready)
+        return ready[svc.rr]

@@ -1,0 +1,445 @@
+"""TPU generation engine: jitted prefill/decode with continuous batching.
+
+The serving-side counterpart of models/llama.py (which owns the training
+forward). The reference's GPU LLM path is huggingfaceserver+vLLM (SURVEY.md
+3.3 S5); the TPU-native replacement is built around what XLA wants:
+
+- **Static shapes everywhere.** The KV cache is a fixed [L, B, Smax, KV, D]
+  buffer; prompts pad to a small set of prefill buckets, so there are
+  O(#buckets) compiles, not O(#lengths). Decode is one fixed-shape program.
+- **Slot-based continuous batching.** New requests prefill into a free
+  cache slot while other slots keep decoding; one decode step advances all
+  active slots (vLLM's iteration-level scheduling, minus paging -- slab
+  slots beat paged KV under XLA because dynamic gather/scatter of pages
+  defeats fusion; Smax bounds the slab).
+- **Donated cache buffers.** decode/insert donate the cache so XLA updates
+  it in place in HBM -- no per-token cache copies.
+- **Layer-stacked params + lax.scan** over layers: mirrors the training
+  model's nn.scan layout, so orbax training checkpoints drop straight in;
+  one compiled layer body.
+
+Weight math reimplements the Llama forward as pure functions over the
+training param pytree (scan layout) rather than threading a cache through
+linen -- inference wants explicit state, not module state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+from concurrent.futures import Future
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.llama import (
+    LlamaConfig,
+    PRESETS,
+    Llama,
+    rope_frequencies,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def default_buckets(max_seq: int) -> tuple[int, ...]:
+    out, b = [], 32
+    while b < max_seq:
+        out.append(b)
+        b *= 2
+    out.append(max_seq)
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Pure forward math over the training param pytree (scan layout).
+# ---------------------------------------------------------------------------
+
+
+def _rms(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(jnp.mean(jnp.square(x32), -1, keepdims=True) + eps)
+    return (y * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope(x, freqs, positions):
+    # x [B,S,H,D]; positions [B,S]; freqs [Smax, D/2] fp32.
+    f = freqs[positions]  # [B,S,D/2]
+    cos = jnp.cos(f)[:, :, None, :]
+    sin = jnp.sin(f)[:, :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., 0::2], x32[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def _gqa_attend(q, k, v, mask):
+    """q [B,S,N,D] over k/v [B,T,KV,D]; mask [B,S,T] True=visible."""
+    b, s, n, d = q.shape
+    kv = k.shape[2]
+    q = q.reshape(b, s, kv, n // kv, d)
+    scores = jnp.einsum("bskgd,btkd->bkgst", q, k).astype(jnp.float32)
+    scores = scores / np.sqrt(d)
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    return out.reshape(b, s, n, d)
+
+
+def _cast(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        tree,
+    )
+
+
+def pack_weights(params: dict, cfg: LlamaConfig) -> dict:
+    """params: the ``{"params": ...}`` pytree from Llama.init / orbax
+    restore (scan layout required), flax metadata already unboxed.
+
+    Returns a plain-dict pytree so it can be a jit *argument* -- closing
+    over multi-GB weights would bake them into the jaxpr as constants.
+    """
+
+    p = params["params"] if "params" in params else params
+    if "layers" not in p:
+        raise ValueError("engine requires scan_layers=True checkpoints")
+    dt = jnp.dtype(cfg.dtype)
+    return {
+        "embed": _cast(p["embed"]["embedding"], dt),           # [V, H]
+        "final_scale": p["final_norm"]["scale"].astype(jnp.float32),
+        "lm_head": _cast(p["lm_head"]["kernel"], dt),          # [H, V]
+        "layers": _cast(p["layers"]["layer"], dt),             # leaves [L, ...]
+    }
+
+
+def _layer_forward(cfg: LlamaConfig, lp: dict, x, freqs, positions, mask):
+    """One decoder layer, self-attention over the current tokens only (the
+    prefill path; decode attends over the cache, see _decode). Returns
+    (x, k, v) with k/v the current tokens' cache rows."""
+
+    attn, mlp = lp["attn"], lp["mlp"]
+    h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps)
+    q = jnp.einsum("bsh,hnd->bsnd", h, attn["q_proj"]["kernel"])
+    k = jnp.einsum("bsh,hnd->bsnd", h, attn["k_proj"]["kernel"])
+    v = jnp.einsum("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
+    q = _rope(q, freqs, positions)
+    k = _rope(k, freqs, positions)
+    out = _gqa_attend(q, k, v, mask)
+    out = jnp.einsum("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
+    x = x + out
+    h = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
+    gate = jnp.einsum("bsh,hi->bsi", h, mlp["gate_proj"]["kernel"])
+    up = jnp.einsum("bsh,hi->bsi", h, mlp["up_proj"]["kernel"])
+    down = jnp.einsum("bsi,ih->bsh", jax.nn.silu(gate) * up,
+                      mlp["down_proj"]["kernel"])
+    return x + down, k, v
+
+
+def _prefill(cfg: LlamaConfig, w: dict, tokens, length):
+    """Causal self-attention over one padded prompt [1, S].
+
+    Returns (next_token_logits [1, V], k_seq, v_seq [L, 1, S, KV, D]).
+    """
+
+    s = tokens.shape[1]
+    positions = jnp.arange(s)[None, :]
+    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    x = w["embed"][tokens]
+    causal = jnp.tril(jnp.ones((s, s), bool))[None]
+
+    def body(x, lp):
+        x, k, v = _layer_forward(cfg, lp, x, freqs, positions, causal)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, w["layers"])
+    x = _rms(x, w["final_scale"], cfg.norm_eps)
+    # Logits only for the last real token (length-1): one row of lm_head.
+    last = jax.lax.dynamic_index_in_dim(x, length - 1, axis=1, keepdims=False)
+    logits = (last.astype(jnp.float32) @ w["lm_head"].astype(jnp.float32))
+    return logits, ks, vs
+
+
+def _insert(cache_k, cache_v, k_seq, v_seq, slot):
+    """Write a prefilled sequence into cache slot ``slot``.
+
+    cache [L,B,Smax,KV,D]; k_seq [L,1,S,KV,D]. Donated buffers."""
+
+    start = (0, slot, 0, 0, 0)
+    return (
+        jax.lax.dynamic_update_slice(cache_k, k_seq, start),
+        jax.lax.dynamic_update_slice(cache_v, v_seq, start),
+    )
+
+
+def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths):
+    """One decode step for all slots.
+
+    tokens [B] (last sampled token per slot), lengths [B] (tokens already
+    in cache; the new token's position). Returns (logits [B, V], caches).
+    """
+
+    b = tokens.shape[0]
+    smax = cache_k.shape[2]
+    positions = lengths[:, None]  # [B,1]
+    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    x = w["embed"][tokens][:, None, :]  # [B,1,H]
+    # Visible: key position <= query position. Everything earlier in the
+    # slot was written by the current occupant, so this is exact.
+    mask = jnp.arange(smax)[None, None, :] <= positions[:, :, None]  # [B,1,Smax]
+    batch_idx = jnp.arange(b)[:, None]
+
+    def body(carry, layer):
+        x = carry
+        lp, ck, cv = layer
+        # Write current k/v into the cache *then* attend over it.
+        h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps)
+        q = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["q_proj"]["kernel"])
+        k = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["k_proj"]["kernel"])
+        v = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["v_proj"]["kernel"])
+        q = _rope(q, freqs, positions)
+        k = _rope(k, freqs, positions)
+        ck = ck.at[batch_idx, positions].set(k)
+        cv = cv.at[batch_idx, positions].set(v)
+        out = _gqa_attend(q, ck, cv, mask)
+        out = jnp.einsum("bsnd,ndh->bsh", out, lp["attn"]["o_proj"]["kernel"])
+        x = x + out
+        h = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
+        gate = jnp.einsum("bsh,hi->bsi", h, lp["mlp"]["gate_proj"]["kernel"])
+        up = jnp.einsum("bsh,hi->bsi", h, lp["mlp"]["up_proj"]["kernel"])
+        x = x + jnp.einsum(
+            "bsi,ih->bsh", jax.nn.silu(gate) * up, lp["mlp"]["down_proj"]["kernel"]
+        )
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (w["layers"], cache_k, cache_v))
+    x = _rms(x, w["final_scale"], cfg.norm_eps)
+    logits = (x[:, 0].astype(jnp.float32) @ w["lm_head"].astype(jnp.float32))
+    return logits, new_k, new_v
+
+
+def _sample(logits, rng, temps):
+    """Per-slot sampling: temp<=0 means greedy. logits [B,V], temps [B]."""
+
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Request:
+    """One in-flight generation."""
+
+    prompt: List[int]
+    max_new_tokens: int = 64
+    temperature: float = 0.0
+    eos_id: Optional[int] = None
+    future: Optional[Future] = None
+    # Filled by the scheduler:
+    slot: int = -1
+    generated: List[int] = dataclasses.field(default_factory=list)
+
+
+class GenerationEngine:
+    """Slot-based continuous-batching generation over a Llama checkpoint.
+
+    Synchronous core (``submit`` + ``step``) driven by a scheduler thread
+    (``start``); jit dispatch blocks, so the thread model matches JAX's
+    execution model rather than fighting asyncio.
+    """
+
+    def __init__(
+        self,
+        preset: str = "llama-tiny",
+        params: Optional[dict] = None,
+        max_slots: int = 8,
+        max_seq: Optional[int] = None,
+        seed: int = 0,
+        config: Optional[LlamaConfig] = None,
+    ) -> None:
+        cfg = config or PRESETS[preset]
+        if max_seq is not None:
+            cfg = dataclasses.replace(cfg, max_seq=max_seq)
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.buckets = default_buckets(cfg.max_seq)
+        if params is None:
+            # Demo mode: random init (serving tests; real use loads orbax).
+            import flax.linen as nn
+
+            model = Llama(dataclasses.replace(cfg, remat=False))
+            raw = jax.jit(model.init)(
+                jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32)
+            )
+            params = nn.meta.unbox(raw)
+        self.weights = pack_weights(params, cfg)
+
+        kvshape = (cfg.n_layers, max_slots, cfg.max_seq, cfg.n_kv_heads,
+                   cfg.head_dim)
+        dt = jnp.dtype(cfg.dtype)
+        self.cache_k = jnp.zeros(kvshape, dt)
+        self.cache_v = jnp.zeros(kvshape, dt)
+        self.lengths = np.zeros(max_slots, np.int64)  # host-side bookkeeping
+        self.free_slots = list(range(max_slots))
+        self.active: Dict[int, Request] = {}
+        self.pending: "queue.Queue[Request]" = queue.Queue()
+        self._rng = jax.random.PRNGKey(seed + 1)
+
+        # cfg is a static closure (hashable primitives); weights are
+        # ARGUMENTS so multi-GB params are buffers, not jaxpr constants.
+        prefill_jit = jax.jit(partial(_prefill, cfg))
+        decode_jit = jax.jit(partial(_decode, cfg), donate_argnums=(1, 2))
+        insert_jit = jax.jit(_insert, donate_argnums=(0, 1))
+        sample_jit = jax.jit(_sample)
+        self._prefill = lambda tokens, n: prefill_jit(self.weights, tokens, n)
+        self._decode = lambda ck, cv, t, l: decode_jit(self.weights, ck, cv, t, l)
+        self._insert = insert_jit
+        self._sample = sample_jit
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self.tokens_generated = 0
+
+    # -- scheduling core ---------------------------------------------------
+
+    def submit(self, req: Request) -> Future:
+        req.future = req.future or Future()
+        if len(req.prompt) >= self.cfg.max_seq:
+            req.future.set_exception(
+                ValueError(
+                    f"prompt length {len(req.prompt)} >= max_seq {self.cfg.max_seq}"
+                )
+            )
+            return req.future
+        self.pending.put(req)
+        self._wake.set()
+        return req.future
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _admit(self) -> None:
+        while self.free_slots and not self.pending.empty():
+            try:
+                req = self.pending.get_nowait()
+            except queue.Empty:
+                return
+            if req.future.cancelled():
+                continue
+            slot = self.free_slots.pop()
+            n = len(req.prompt)
+            bucket = self._bucket(n)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = req.prompt
+            logits, ks, vs = self._prefill(jnp.asarray(padded), n)
+            self.cache_k, self.cache_v = self._insert(
+                self.cache_k, self.cache_v, ks, vs, slot
+            )
+            first = self._sample(
+                logits, self._next_rng(), jnp.array([req.temperature], jnp.float32)
+            )
+            req.slot = slot
+            self.lengths[slot] = n
+            self.active[slot] = req
+            self._emit(req, int(first[0]))
+
+    def _emit(self, req: Request, token: int) -> None:
+        req.generated.append(token)
+        self.tokens_generated += 1
+        self.lengths[req.slot] += 1
+        done = (
+            (req.eos_id is not None and token == req.eos_id)
+            or len(req.generated) >= req.max_new_tokens
+            or self.lengths[req.slot] >= self.cfg.max_seq
+        )
+        if done:
+            self._finish(req)
+
+    def _finish(self, req: Request) -> None:
+        slot = req.slot
+        self.active.pop(slot, None)
+        self.lengths[slot] = 0
+        self.free_slots.append(slot)
+        if not req.future.done():
+            req.future.set_result(req.generated)
+
+    def step(self) -> bool:
+        """Admit pending + run one decode round. Returns True if work ran."""
+
+        self._admit()
+        if not self.active:
+            return False
+        tokens = np.zeros(self.max_slots, np.int32)
+        for slot, req in self.active.items():
+            tokens[slot] = req.generated[-1]
+        # lengths[slot] already counts the last generated token, whose K/V
+        # is not in the cache yet: its position is lengths-1.
+        positions = jnp.asarray(
+            np.maximum(self.lengths - 1, 0), jnp.int32
+        )
+        logits, self.cache_k, self.cache_v = self._decode(
+            self.cache_k, self.cache_v, jnp.asarray(tokens), positions
+        )
+        temps = np.zeros(self.max_slots, np.float32)
+        for slot, req in self.active.items():
+            temps[slot] = req.temperature
+        nxt = np.asarray(self._sample(logits, self._next_rng(), jnp.asarray(temps)))
+        for slot in list(self.active):
+            self._emit(self.active[slot], int(nxt[slot]))
+        return True
+
+    # -- convenience / threaded driver ------------------------------------
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int = 64,
+                 temperature: float = 0.0,
+                 eos_id: Optional[int] = None) -> List[int]:
+        """Synchronous single-request generation (drives step() inline)."""
+
+        req = Request(list(prompt), max_new_tokens, temperature, eos_id)
+        fut = self.submit(req)
+        if self._thread is not None:
+            return fut.result(timeout=600)
+        while not fut.done():
+            if not self.step():
+                break
+        return fut.result()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                if not self.step():
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="kftpu-engine")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._wake.set()
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,168 @@
+"""Model-server library (KServe-equivalent, SURVEY.md 3.3 S4).
+
+``Model`` is the user-facing base class with the reference's lifecycle
+{load, preprocess, predict, postprocess}; ``ModelRepository`` holds served
+models with dynamic load/unload (V2 repository API); ``Batcher`` coalesces
+concurrent predict calls into one batched call (S6's batcher sidecar,
+in-process here).
+
+TPU-first notes: ``predict`` receives the *batched* input list so a JAX
+runtime can run one jitted call per batch (static shapes + MXU-sized
+batches beat per-request dispatch); the batcher's max_batch/max_latency
+trade HBM-resident batch growth against tail latency.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class InferenceError(RuntimeError):
+    """Server-visible failure; mapped to HTTP 4xx/5xx by the server."""
+
+    def __init__(self, message: str, status: int = 500) -> None:
+        super().__init__(message)
+        self.status = status
+
+
+class Model:
+    """One served model. Subclass and override the lifecycle hooks.
+
+    ``predict`` takes a list of instances and returns a list of outputs of
+    the same length -- the server batches; the model sees batches.
+    """
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.ready = False
+
+    def load(self) -> None:
+        """Read weights, build/jit the compute fn; set ``self.ready``."""
+
+        self.ready = True
+
+    def unload(self) -> None:
+        self.ready = False
+
+    def preprocess(self, payload: Any) -> Any:
+        return payload
+
+    def predict(self, instances: Sequence[Any]) -> List[Any]:
+        raise NotImplementedError
+
+    def postprocess(self, outputs: Any) -> Any:
+        return outputs
+
+    # V2 metadata (optional override).
+    def metadata(self) -> Dict[str, Any]:
+        return {"name": self.name, "platform": "kftpu", "inputs": [], "outputs": []}
+
+
+class Batcher:
+    """Coalesce concurrent single-instance predicts into batched calls.
+
+    Requests queue up; a worker drains up to ``max_batch`` instances or
+    whatever arrived within ``max_latency_ms`` and issues one
+    ``model.predict(batch)``. With max_batch=1 this degenerates to
+    pass-through (still serialized, which is what a single-chip TPU wants).
+    """
+
+    def __init__(self, model: Model, max_batch: int = 32,
+                 max_latency_ms: float = 5.0) -> None:
+        self.model = model
+        self.max_batch = max(1, max_batch)
+        self.max_latency = max_latency_ms / 1000.0
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def predict(self, instance: Any) -> Any:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put((instance, fut))
+        return await fut
+
+    async def _run(self) -> None:
+        while True:
+            batch = [await self._queue.get()]
+            deadline = time.monotonic() + self.max_latency
+            while len(batch) < self.max_batch:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    batch.append(await asyncio.wait_for(self._queue.get(), timeout))
+                except asyncio.TimeoutError:
+                    break
+            instances = [b[0] for b in batch]
+            try:
+                # predict is sync (jit dispatch); run in default executor so
+                # the event loop keeps accepting requests during compute.
+                outputs = await asyncio.get_running_loop().run_in_executor(
+                    None, self.model.predict, instances
+                )
+                if len(outputs) != len(instances):
+                    raise InferenceError(
+                        f"model returned {len(outputs)} outputs for "
+                        f"{len(instances)} instances"
+                    )
+                for (_, fut), out in zip(batch, outputs):
+                    if not fut.done():
+                        fut.set_result(out)
+            except Exception as e:  # noqa: BLE001 - failures propagate per-request
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+
+class ModelRepository:
+    """Name -> Model registry with dynamic load/unload (V2 repository API)."""
+
+    def __init__(self) -> None:
+        self._models: Dict[str, Model] = {}
+        self._batchers: Dict[str, Batcher] = {}
+
+    def register(self, model: Model, max_batch: int = 32,
+                 max_latency_ms: float = 5.0) -> None:
+        self._models[model.name] = model
+        self._batchers[model.name] = Batcher(model, max_batch, max_latency_ms)
+
+    def get(self, name: str) -> Model:
+        if name not in self._models:
+            raise InferenceError(f"model {name} not found", status=404)
+        return self._models[name]
+
+    def batcher(self, name: str) -> Batcher:
+        self.get(name)
+        return self._batchers[name]
+
+    def names(self) -> List[str]:
+        return sorted(self._models)
+
+    def load(self, name: str) -> None:
+        self.get(name).load()
+
+    def unload(self, name: str) -> None:
+        m = self.get(name)
+        m.unload()
+
+    def start(self) -> None:
+        for b in self._batchers.values():
+            b.start()
+
+    async def stop(self) -> None:
+        for b in self._batchers.values():
+            await b.stop()

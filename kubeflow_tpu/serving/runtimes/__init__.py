@@ -1,0 +1,6 @@
+"""Bundled server runtimes (KServe-equivalent S5).
+
+Each runtime is a ``python -m`` entrypoint the ISVC controller spawns as a
+replica process, with a common flag contract (see ``common.serve_main``):
+``--model-name --storage-uri --model-dir --port --options-json``.
+"""

@@ -1,0 +1,63 @@
+"""Shared runtime-process scaffolding.
+
+The flag contract between the ISVC controller (which spawns replica
+processes) and every bundled runtime. Mirrors the reference's
+ServingRuntime container contract (args: --model_name --model_dir
+--http_port; storage-initializer as initContainer) collapsed into one
+process: initialize storage, construct the model, load, serve.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+from typing import Any, Callable, Dict, Optional
+
+from kubeflow_tpu.serving.model import Model, ModelRepository
+from kubeflow_tpu.serving.server import ModelServer
+from kubeflow_tpu.serving.storage import model_path
+
+ModelFactory = Callable[[str, Optional[str], Dict[str, Any]], Model]
+
+
+def serve_main(factory: ModelFactory, argv=None) -> int:
+    """Run one runtime process: flags -> storage init -> load -> serve.
+
+    ``factory(model_name, local_model_path, options) -> Model``.
+    """
+
+    p = argparse.ArgumentParser("kftpu model runtime")
+    p.add_argument("--model-name", required=True)
+    p.add_argument("--storage-uri", default=None)
+    p.add_argument("--model-dir", default=None,
+                   help="where storage is materialized (default: ./models)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=int(os.environ.get("PORT", "8080")))
+    p.add_argument("--options-json", default="{}",
+                   help="format-specific options (ModelSpec.options)")
+    p.add_argument("--max-batch", type=int, default=32)
+    p.add_argument("--max-latency-ms", type=float, default=5.0)
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    options = json.loads(args.options_json)
+    model_dir = args.model_dir or os.path.abspath("./models")
+    path = model_path(args.storage_uri, model_dir)
+
+    model = factory(args.model_name, path, options)
+    repo = ModelRepository()
+    repo.register(model, max_batch=args.max_batch, max_latency_ms=args.max_latency_ms)
+    model.load()
+
+    server = ModelServer(repository=repo)
+    logging.getLogger(__name__).info(
+        "serving %s on %s:%d (model path %s)",
+        args.model_name, args.host, args.port, path,
+    )
+    server.run(host=args.host, port=args.port)
+    return 0

@@ -1,0 +1,42 @@
+"""Echo runtime: protocol-conformance and controller-test runtime.
+
+Plays the role of the reference's "custom predictor" example images in
+e2e tests -- a trivially fast model so tests exercise the serving path
+(storage init, readiness, V1/V2, batching, scaling) without model weights.
+Options: ``delay_ms`` (sleep per batch, for autoscale tests), ``fail``
+(predict raises, for failure-path tests).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from kubeflow_tpu.serving.model import InferenceError, Model
+from kubeflow_tpu.serving.runtimes.common import serve_main
+
+
+class EchoModel(Model):
+    def __init__(self, name: str, path: Optional[str],
+                 options: Dict[str, Any]) -> None:
+        super().__init__(name)
+        self.path = path
+        self.options = options
+        self.batch_sizes: List[int] = []  # inspected by in-process tests
+
+    def predict(self, instances: Sequence[Any]) -> List[Any]:
+        if self.options.get("fail"):
+            raise InferenceError("echo runtime configured to fail", 500)
+        delay = float(self.options.get("delay_ms", 0)) / 1000.0
+        if delay:
+            time.sleep(delay)
+        self.batch_sizes.append(len(instances))
+        return [{"echo": i, "model_path": self.path} for i in instances]
+
+
+def main(argv=None) -> int:
+    return serve_main(EchoModel, argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

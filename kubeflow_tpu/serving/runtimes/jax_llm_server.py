@@ -1,0 +1,174 @@
+"""JAX/PJRT LLM serving runtime (north-star config #5, SURVEY.md 3.3 S5 delta).
+
+The TPU replacement for the reference's huggingfaceserver+vLLM GPU path:
+orbax/msgpack checkpoint -> GenerationEngine (jitted prefill/decode,
+continuous batching) -> V1/V2 protocol.
+
+Request shapes (V1 instances / V2 input rows):
+- ``{"prompt": "...", "max_new_tokens": N, "temperature": T}`` -- text in,
+  text out (requires a tokenizer).
+- ``{"token_ids": [...], ...}`` -- pre-tokenized; returns token ids.
+
+Options (ModelSpec.options):
+- ``preset``: llama preset name (default llama-tiny)
+- ``max_slots``: concurrent sequences in the KV cache (default 8)
+- ``max_seq``: override cache length
+- ``tokenizer``: "byte" (default; ids = utf-8 bytes, self-contained) or a
+  HF tokenizer name resolved from the local cache only (zero egress)
+- ``checkpoint``: "orbax" (TrainState dir from the training runtime) or
+  "none" (random init -- demo/e2e mode)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from kubeflow_tpu.serving.model import InferenceError, Model
+from kubeflow_tpu.serving.runtimes.common import serve_main
+
+logger = logging.getLogger(__name__)
+
+
+class ByteTokenizer:
+    """utf-8 bytes as token ids: zero-dependency, works with any vocab>=256.
+
+    Not a language model tokenizer -- it exists so the serving path is fully
+    exercisable (and benchable) without staged tokenizer assets.
+    """
+
+    eos_id: Optional[int] = None
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    def __init__(self, name_or_path: str) -> None:
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(name_or_path, local_files_only=True)
+        self.eos_id = self._tok.eos_token_id
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids))
+
+
+def load_params_from_checkpoint(path: str, cfg) -> dict:
+    """Restore model params from a training checkpoint directory.
+
+    Accepts either a raw orbax step dir or a job checkpoint dir (picks the
+    latest step). Restores on the serving host's devices with the engine's
+    single-process sharding.
+    """
+
+    import jax
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    mgr = ocp.CheckpointManager(path)
+    step = mgr.latest_step()
+    if step is None:
+        raise InferenceError(f"no checkpoint steps under {path}", 500)
+    restored = mgr.restore(step)
+    mgr.close()
+    # TrainState layout: {"params": ...}; engine wants the params pytree.
+    tree = restored
+    for key in ("params",):
+        if isinstance(tree, dict) and key in tree:
+            return {"params": tree[key]}
+    if hasattr(tree, "params"):
+        return {"params": tree.params}
+    raise InferenceError(f"checkpoint at {path} has no params", 500)
+
+
+class JaxLLMModel(Model):
+    def __init__(self, name: str, path: Optional[str],
+                 options: Dict[str, Any]) -> None:
+        super().__init__(name)
+        self.path = path
+        self.options = options
+        self.engine = None
+        self.tokenizer = None
+
+    def load(self) -> None:
+        from kubeflow_tpu.serving.engine import GenerationEngine
+
+        opts = self.options
+        tok = opts.get("tokenizer", "byte")
+        self.tokenizer = ByteTokenizer() if tok == "byte" else HFTokenizer(tok)
+
+        params = None
+        ckpt_mode = opts.get("checkpoint", "orbax" if self.path else "none")
+        preset = opts.get("preset", "llama-tiny")
+        if ckpt_mode == "orbax":
+            if not self.path:
+                raise InferenceError("checkpoint=orbax requires storage_uri", 500)
+            from kubeflow_tpu.models.llama import PRESETS
+
+            params = load_params_from_checkpoint(self.path, PRESETS[preset])
+        self.engine = GenerationEngine(
+            preset=preset,
+            params=params,
+            max_slots=int(opts.get("max_slots", 8)),
+            max_seq=opts.get("max_seq"),
+        )
+        # Warm both programs so first request latency is serving-time, not
+        # compile-time (SURVEY.md 7.4 #5).
+        self.engine.generate([1, 2, 3], max_new_tokens=2)
+        self.engine.start()
+        self.ready = True
+
+    def unload(self) -> None:
+        if self.engine is not None:
+            self.engine.stop()
+            self.engine = None
+        self.ready = False
+
+    def predict(self, instances: Sequence[Any]) -> List[Any]:
+        from kubeflow_tpu.serving.engine import Request
+
+        futs, meta = [], []
+        for inst in instances:
+            if not isinstance(inst, dict):
+                inst = {"prompt": str(inst)}
+            if "token_ids" in inst:
+                ids, text_out = list(inst["token_ids"]), False
+            elif "prompt" in inst:
+                ids, text_out = self.tokenizer.encode(inst["prompt"]), True
+            else:
+                raise InferenceError(
+                    'instance needs "prompt" or "token_ids"', 400
+                )
+            req = Request(
+                prompt=ids,
+                max_new_tokens=int(inst.get("max_new_tokens", 64)),
+                temperature=float(inst.get("temperature", 0.0)),
+                eos_id=inst.get("eos_id", self.tokenizer.eos_id),
+            )
+            futs.append(self.engine.submit(req))
+            meta.append(text_out)
+        out = []
+        for fut, text_out in zip(futs, meta):
+            ids = fut.result(timeout=600)
+            if text_out:
+                out.append({"text": self.tokenizer.decode(ids),
+                            "token_ids": ids})
+            else:
+                out.append({"token_ids": ids})
+        return out
+
+
+def main(argv=None) -> int:
+    return serve_main(JaxLLMModel, argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,60 @@
+"""sklearn runtime (KServe sklearnserver equivalent, SURVEY.md 3.3 S5).
+
+Loads a joblib/pickle-serialized estimator and serves ``predict`` (and
+``predict_proba`` when the options ask for probabilities). Numeric work is
+numpy on host -- sklearn models don't belong on the MXU; this runtime
+exists for protocol parity and as the simple end of the S5 matrix.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from kubeflow_tpu.serving.model import InferenceError, Model
+from kubeflow_tpu.serving.runtimes.common import serve_main
+
+_SUFFIXES = (".joblib", ".pkl", ".pickle")
+
+
+class SKLearnModel(Model):
+    def __init__(self, name: str, path: Optional[str],
+                 options: Dict[str, Any]) -> None:
+        super().__init__(name)
+        self.path = path
+        self.options = options
+        self._model = None
+
+    def load(self) -> None:
+        import joblib
+
+        path = self.path
+        if path is None:
+            raise InferenceError("sklearn runtime requires storage_uri", 500)
+        if os.path.isdir(path):
+            cands = [f for f in sorted(os.listdir(path)) if f.endswith(_SUFFIXES)]
+            if not cands:
+                raise InferenceError(f"no {_SUFFIXES} file in {path}", 500)
+            path = os.path.join(path, cands[0])
+        self._model = joblib.load(path)
+        self.ready = True
+
+    def unload(self) -> None:
+        self._model = None
+        self.ready = False
+
+    def predict(self, instances: Sequence[Any]) -> List[Any]:
+        x = np.asarray(instances)
+        if self.options.get("probabilities") and hasattr(self._model, "predict_proba"):
+            return self._model.predict_proba(x).tolist()
+        return np.asarray(self._model.predict(x)).tolist()
+
+
+def main(argv=None) -> int:
+    return serve_main(SKLearnModel, argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

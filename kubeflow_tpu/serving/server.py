@@ -1,0 +1,223 @@
+"""aiohttp ModelServer speaking the V1 and V2 inference protocols.
+
+Routes (KServe-equivalent, SURVEY.md 3.3 S4 / call stack 4.5):
+
+V1:
+- ``GET  /v1/models/{m}``            readiness {"name", "ready"}
+- ``POST /v1/models/{m}:predict``    {"instances": [...]} -> {"predictions": [...]}
+
+V2 (Open Inference Protocol):
+- ``GET  /v2``                        server metadata
+- ``GET  /v2/health/live|ready``
+- ``GET  /v2/models/{m}``             model metadata
+- ``GET  /v2/models/{m}/ready``
+- ``POST /v2/models/{m}/infer``       {"inputs": [{name, shape, datatype, data}]}
+- ``POST /v2/repository/models/{m}/load|unload``
+
+Plus ``GET /healthz`` (controller readiness probe) and ``GET /metrics``.
+
+The server process is what an ISVC replica runs; the controller spawns it
+via the same ProcessLauncher that runs training workers, with --port/
+--model-dir injected (the reference's container args).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from kubeflow_tpu.serving.model import InferenceError, ModelRepository
+
+logger = logging.getLogger(__name__)
+
+
+class ModelServer:
+    def __init__(self, repository: Optional[ModelRepository] = None,
+                 name: str = "kftpu-modelserver") -> None:
+        self.name = name
+        self.repository = repository or ModelRepository()
+        self.started_at = time.time()
+        self.request_count = 0
+        self.error_count = 0
+        self.predict_seconds = 0.0
+
+    # -- app --------------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app.add_routes([
+            web.get("/healthz", self.h_healthz),
+            web.get("/metrics", self.h_metrics),
+            # V1
+            web.get("/v1/models/{m}", self.h_v1_status),
+            web.post("/v1/models/{m:[^:]+}:predict", self.h_v1_predict),
+            # V2
+            web.get("/v2", self.h_v2_server),
+            web.get("/v2/health/live", self.h_v2_live),
+            web.get("/v2/health/ready", self.h_v2_ready),
+            web.get("/v2/models/{m}", self.h_v2_model_meta),
+            web.get("/v2/models/{m}/ready", self.h_v2_model_ready),
+            web.post("/v2/models/{m}/infer", self.h_v2_infer),
+            web.post("/v2/repository/models/{m}/load", self.h_v2_load),
+            web.post("/v2/repository/models/{m}/unload", self.h_v2_unload),
+        ])
+
+        async def on_startup(app):
+            self.repository.start()
+
+        async def on_cleanup(app):
+            await self.repository.stop()
+
+        app.on_startup.append(on_startup)
+        app.on_cleanup.append(on_cleanup)
+        return app
+
+    def run(self, host: str = "127.0.0.1", port: int = 8080) -> None:
+        web.run_app(self.build_app(), host=host, port=port, print=None)
+
+    # -- helpers ----------------------------------------------------------
+
+    def _ready(self) -> bool:
+        names = self.repository.names()
+        return bool(names) and all(self.repository.get(n).ready for n in names)
+
+    @staticmethod
+    def _err(e: Exception) -> web.Response:
+        status = e.status if isinstance(e, InferenceError) else 500
+        return web.json_response({"error": str(e)}, status=status)
+
+    # -- health / metrics --------------------------------------------------
+
+    async def h_healthz(self, req: web.Request) -> web.Response:
+        return web.json_response({
+            "ok": True, "ready": self._ready(),
+            "models": self.repository.names(),
+            "uptime": time.time() - self.started_at,
+        })
+
+    async def h_metrics(self, req: web.Request) -> web.Response:
+        lines = [
+            f"kftpu_server_requests_total {self.request_count}",
+            f"kftpu_server_errors_total {self.error_count}",
+            f"kftpu_server_predict_seconds_total {self.predict_seconds:.6f}",
+        ]
+        return web.Response(text="\n".join(lines) + "\n")
+
+    # -- V1 ----------------------------------------------------------------
+
+    async def h_v1_status(self, req: web.Request) -> web.Response:
+        name = req.match_info["m"]
+        try:
+            model = self.repository.get(name)
+        except InferenceError as e:
+            return self._err(e)
+        return web.json_response({"name": name, "ready": model.ready})
+
+    async def h_v1_predict(self, req: web.Request) -> web.Response:
+        name = req.match_info["m"]
+        self.request_count += 1
+        t0 = time.monotonic()
+        try:
+            model = self.repository.get(name)
+            if not model.ready:
+                raise InferenceError(f"model {name} is not ready", status=503)
+            body = await req.json()
+            instances = body.get("instances")
+            if not isinstance(instances, list):
+                raise InferenceError('body must have "instances": [...]', status=400)
+            batcher = self.repository.batcher(name)
+            pre = [model.preprocess(i) for i in instances]
+            outs = await asyncio.gather(*(batcher.predict(i) for i in pre))
+            preds = [model.postprocess(o) for o in outs]
+            return web.json_response({"predictions": preds})
+        except json.JSONDecodeError:
+            self.error_count += 1
+            return web.json_response({"error": "body is not JSON"}, status=400)
+        except Exception as e:  # noqa: BLE001
+            self.error_count += 1
+            return self._err(e)
+        finally:
+            self.predict_seconds += time.monotonic() - t0
+
+    # -- V2 ----------------------------------------------------------------
+
+    async def h_v2_server(self, req: web.Request) -> web.Response:
+        return web.json_response({
+            "name": self.name, "version": "2",
+            "extensions": ["model_repository"],
+        })
+
+    async def h_v2_live(self, req: web.Request) -> web.Response:
+        return web.json_response({"live": True})
+
+    async def h_v2_ready(self, req: web.Request) -> web.Response:
+        return web.json_response({"ready": self._ready()})
+
+    async def h_v2_model_meta(self, req: web.Request) -> web.Response:
+        try:
+            return web.json_response(self.repository.get(req.match_info["m"]).metadata())
+        except InferenceError as e:
+            return self._err(e)
+
+    async def h_v2_model_ready(self, req: web.Request) -> web.Response:
+        try:
+            model = self.repository.get(req.match_info["m"])
+        except InferenceError as e:
+            return self._err(e)
+        return web.json_response({"name": model.name, "ready": model.ready})
+
+    async def h_v2_infer(self, req: web.Request) -> web.Response:
+        name = req.match_info["m"]
+        self.request_count += 1
+        t0 = time.monotonic()
+        try:
+            model = self.repository.get(name)
+            if not model.ready:
+                raise InferenceError(f"model {name} is not ready", status=503)
+            body = await req.json()
+            inputs = body.get("inputs")
+            if not isinstance(inputs, list) or not inputs:
+                raise InferenceError('body must have "inputs": [...]', status=400)
+            batcher = self.repository.batcher(name)
+            # V2 tensors ride through preprocess/predict as dicts; simple
+            # models treat input.data as the instance list.
+            pre = model.preprocess({"inputs": inputs})
+            instances = pre["inputs"] if isinstance(pre, dict) and "inputs" in pre else pre
+            outs = await asyncio.gather(*(batcher.predict(i) for i in instances))
+            outputs = model.postprocess(outs)
+            if not (isinstance(outputs, list) and outputs
+                    and isinstance(outputs[0], dict) and "data" in outputs[0]):
+                outputs = [{
+                    "name": "output_0", "datatype": "FP32",
+                    "shape": [len(outs)], "data": outputs,
+                }]
+            return web.json_response({
+                "model_name": name, "id": body.get("id", ""), "outputs": outputs,
+            })
+        except json.JSONDecodeError:
+            self.error_count += 1
+            return web.json_response({"error": "body is not JSON"}, status=400)
+        except Exception as e:  # noqa: BLE001
+            self.error_count += 1
+            return self._err(e)
+        finally:
+            self.predict_seconds += time.monotonic() - t0
+
+    async def h_v2_load(self, req: web.Request) -> web.Response:
+        try:
+            self.repository.load(req.match_info["m"])
+            return web.json_response({"name": req.match_info["m"], "ready": True})
+        except Exception as e:  # noqa: BLE001
+            return self._err(e)
+
+    async def h_v2_unload(self, req: web.Request) -> web.Response:
+        try:
+            self.repository.unload(req.match_info["m"])
+            return web.json_response({"name": req.match_info["m"], "ready": False})
+        except Exception as e:  # noqa: BLE001
+            return self._err(e)

@@ -1,0 +1,90 @@
+"""Storage initializer (KServe-equivalent, SURVEY.md 3.3 S3).
+
+The reference runs an initContainer that downloads ``storageUri`` to
+``/mnt/models`` before the server starts; here ``initialize()`` is called
+by the runtime process at boot (same sequencing: weights are local before
+the server binds its port).
+
+Supported schemes in this environment (zero egress, SURVEY.md 7.0):
+
+- bare paths and ``file://``  -- local files/directories, symlinked into
+  the model dir (copy-free: checkpoints are GBs).
+- ``hf://org/name``           -- resolved against the local HF cache only
+  (``HF_HOME``); a cache miss raises instead of attempting network.
+- ``s3://``/``gs://``/``http(s)://`` -- recognized and rejected with a
+  clear error (egress-gated; the reference's downloaders have no offline
+  mode to emulate).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class StorageError(RuntimeError):
+    pass
+
+
+_GATED = ("s3://", "gs://", "http://", "https://")
+
+
+def initialize(storage_uri: str, dest_dir: str) -> str:
+    """Materialize ``storage_uri`` under ``dest_dir``; returns the model path.
+
+    Directories and files are symlinked (not copied) -- local storage plays
+    the role of the reference's object store, and the serving process never
+    mutates model artifacts.
+    """
+
+    os.makedirs(dest_dir, exist_ok=True)
+    for scheme in _GATED:
+        if storage_uri.startswith(scheme):
+            raise StorageError(
+                f"scheme {scheme} requires network egress, which this "
+                f"environment gates; stage the model locally and use file://"
+            )
+    if storage_uri.startswith("hf://"):
+        return _resolve_hf(storage_uri[len("hf://"):], dest_dir)
+
+    path = storage_uri[len("file://"):] if storage_uri.startswith("file://") else storage_uri
+    path = os.path.abspath(os.path.expanduser(path))
+    if not os.path.exists(path):
+        raise StorageError(f"storage uri {storage_uri} -> {path}: does not exist")
+
+    link = os.path.join(dest_dir, os.path.basename(path.rstrip("/")))
+    if os.path.islink(link):
+        if os.path.realpath(link) == os.path.realpath(path):
+            return link
+        os.remove(link)
+    elif os.path.exists(link):
+        raise StorageError(f"{link} exists and is not a symlink; refusing to clobber")
+    os.symlink(path, link)
+    return link
+
+
+def _resolve_hf(repo_id: str, dest_dir: str) -> str:
+    """Find ``repo_id`` in the local HF hub cache; never touches network."""
+
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as e:  # pragma: no cover - hub ships with transformers
+        raise StorageError("huggingface_hub not installed") from e
+    try:
+        path = snapshot_download(repo_id, local_files_only=True)
+    except Exception as e:
+        raise StorageError(
+            f"hf://{repo_id} not in local cache and network egress is "
+            f"gated ({e}); pre-stage the snapshot or use file://"
+        ) from e
+    link = os.path.join(dest_dir, repo_id.replace("/", "--"))
+    if not os.path.exists(link):
+        os.symlink(path, link)
+    return link
+
+
+def model_path(storage_uri: Optional[str], dest_dir: str) -> Optional[str]:
+    """``initialize`` if a uri is given, else None (custom servers may not
+    take weights at all)."""
+
+    return initialize(storage_uri, dest_dir) if storage_uri else None

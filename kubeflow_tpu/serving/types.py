@@ -1,0 +1,213 @@
+"""InferenceService / ServingRuntime API types (KServe-equivalent, SURVEY.md 3.3 S1).
+
+Shape mirrors KServe's v1beta1 InferenceService: a predictor (plus optional
+transformer) described either by a model {format, storage_uri} resolved
+against a runtime registry, or by a custom process template; scaling with
+``min_replicas=0`` meaning scale-to-zero behind the activator.
+
+TPU-first deltas vs the reference:
+
+- The runtime registry maps model formats to in-repo Python server modules
+  (reference: ServingRuntime CRs naming container images); the ``jax``
+  format is the PJRT/StableHLO LLM path (SURVEY.md 3.3 delta, config #5).
+- Replicas are local server processes gang-free (serving replicas are
+  independent, unlike training gangs); TPU chips are still counted against
+  the shared capacity model so serving and training contend for the same
+  slice, as they do on a real cell.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import Any, Dict, List, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from kubeflow_tpu.api import conditions
+from kubeflow_tpu.api.types import ObjectMeta, Resources
+
+KIND = "InferenceService"
+
+
+class ModelFormat(str, enum.Enum):
+    """Built-in model formats with bundled server runtimes (S5)."""
+
+    sklearn = "sklearn"
+    jax = "jax"  # JAX/StableHLO LLM predictor on PJRT (north-star config #5)
+    custom = "custom"
+
+
+class ModelSpec(BaseModel):
+    """What to serve: a format + where the weights live."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    format: ModelFormat = ModelFormat.custom
+    storage_uri: Optional[str] = None  # file://, hf://, or bare path
+    name: Optional[str] = None  # served model name; defaults to ISVC name
+    # Format-specific options passed to the runtime verbatim (e.g. the jax
+    # runtime's preset/max_batch/max_seq_len). Reference analog: the
+    # opaque args/env of a ServingRuntime container.
+    options: Dict[str, Any] = Field(default_factory=dict)
+
+
+class CustomSpec(BaseModel):
+    """Custom server process (reference: custom predictor container)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    entrypoint: str  # python module run as ``python -m entrypoint``
+    args: List[str] = Field(default_factory=list)
+    env: Dict[str, str] = Field(default_factory=dict)
+
+
+class ComponentSpec(BaseModel):
+    """One ISVC component (predictor or transformer)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    model: Optional[ModelSpec] = None
+    custom: Optional[CustomSpec] = None
+    resources: Resources = Field(default_factory=Resources)
+    min_replicas: int = 1  # 0 = scale-to-zero
+    max_replicas: int = 1
+    # Autoscaling target: mean in-flight requests per replica (KServe's
+    # default KPA metric is concurrency; same here).
+    target_concurrency: float = 4.0
+    # Idle seconds before the last replica is reaped when min_replicas=0.
+    scale_to_zero_grace_seconds: float = 30.0
+
+
+class InferenceServiceSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    predictor: ComponentSpec
+    transformer: Optional[ComponentSpec] = None
+    # Percent of traffic to the newest generation during a rollout
+    # (reference: canaryTrafficPercent). 100 = all traffic to latest.
+    canary_traffic_percent: int = 100
+
+
+class ReplicaState(str, enum.Enum):
+    Pending = "Pending"
+    Ready = "Ready"
+    Terminating = "Terminating"
+
+
+class ReplicaInfo(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    index: int
+    port: int
+    pid: Optional[int] = None
+    state: ReplicaState = ReplicaState.Pending
+    started_at: float = 0.0
+
+
+class ComponentStatus(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    desired_replicas: int = 0
+    ready_replicas: int = 0
+    replicas: List[ReplicaInfo] = Field(default_factory=list)
+
+
+class InferenceServiceStatus(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    conditions: List[dict] = Field(default_factory=list)
+    url: Optional[str] = None
+    predictor: ComponentStatus = Field(default_factory=ComponentStatus)
+    transformer: Optional[ComponentStatus] = None
+    # Activator-observed load, persisted for visibility (kftpu get isvc).
+    in_flight: int = 0
+    last_request_time: float = 0.0
+
+
+class InferenceService(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    kind: str = KIND
+    metadata: ObjectMeta
+    spec: InferenceServiceSpec
+    status: InferenceServiceStatus = Field(default_factory=InferenceServiceStatus)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "InferenceService":
+        return cls.model_validate(d)
+
+    def to_dict(self) -> dict:
+        return self.model_dump(mode="json", exclude_none=True)
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+class ServingValidationError(ValueError):
+    pass
+
+
+def validate_isvc(isvc: InferenceService) -> None:
+    """Semantic validation beyond pydantic shape checks (webhook analog)."""
+
+    for label, comp in (("predictor", isvc.spec.predictor),
+                        ("transformer", isvc.spec.transformer)):
+        if comp is None:
+            continue
+        if (comp.model is None) == (comp.custom is None):
+            raise ServingValidationError(
+                f"{label}: exactly one of model/custom must be set"
+            )
+        if comp.model is not None:
+            if comp.model.format == ModelFormat.custom:
+                raise ServingValidationError(
+                    f"{label}: format=custom has no bundled runtime; use the "
+                    f"custom: process spec instead of model:"
+                )
+            if comp.model.format not in RUNTIMES:
+                raise ServingValidationError(
+                    f"{label}: no runtime for format {comp.model.format}"
+                )
+        if comp.min_replicas < 0 or comp.max_replicas < 1:
+            raise ServingValidationError(
+                f"{label}: min_replicas>=0 and max_replicas>=1 required"
+            )
+        if comp.min_replicas > comp.max_replicas:
+            raise ServingValidationError(
+                f"{label}: min_replicas {comp.min_replicas} > "
+                f"max_replicas {comp.max_replicas}"
+            )
+        if comp.target_concurrency <= 0:
+            raise ServingValidationError(f"{label}: target_concurrency must be > 0")
+    if not 0 <= isvc.spec.canary_traffic_percent <= 100:
+        raise ServingValidationError("canary_traffic_percent must be in [0, 100]")
+    if isvc.spec.transformer is not None:
+        # Rejected loudly rather than silently dropped: the controller does
+        # not yet spawn transformer replicas or chain traffic through them.
+        raise ServingValidationError(
+            "transformer components are not supported yet; put pre/post "
+            "processing in the predictor's Model.preprocess/postprocess"
+        )
+
+
+# Runtime registry: model format -> server entry module (ServingRuntime CR
+# analog; see serving/runtimes/). Custom formats bypass the registry.
+RUNTIMES: Dict[ModelFormat, str] = {
+    ModelFormat.sklearn: "kubeflow_tpu.serving.runtimes.sklearn_server",
+    ModelFormat.jax: "kubeflow_tpu.serving.runtimes.jax_llm_server",
+}
+
+
+# Ready/Unready/Failed are mutually exclusive; Created is sticky.
+_EXCLUSIVE = ("Ready", "Unready", "Failed")
+
+
+def set_condition(status: InferenceServiceStatus, ctype: str,
+                  reason: str = "", message: str = "") -> None:
+    conditions.set_condition(status.conditions, ctype, _EXCLUSIVE, reason, message)
+
+
+def now() -> float:
+    return time.time()

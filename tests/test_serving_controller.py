@@ -1,0 +1,267 @@
+"""ISVC controller e2e: real control plane, real replica subprocesses.
+
+Mirrors the reference's serving e2e (SURVEY.md 4.5): apply an
+InferenceService, wait Ready, predict through the activator route,
+autoscale under load, scale to zero, cold-start replay, crash-loop
+detection. Replicas run the echo runtime (no jax import: fast boot).
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeflow_tpu.server.app import ControlPlane
+
+
+def isvc(name, *, min_r=1, max_r=1, grace=30.0, target=4.0, options=None,
+         custom=None):
+    comp = {
+        "min_replicas": min_r, "max_replicas": max_r,
+        "scale_to_zero_grace_seconds": grace,
+        "target_concurrency": target,
+    }
+    comp["custom"] = custom or {
+        "entrypoint": "kubeflow_tpu.serving.runtimes.echo_server",
+        "args": ["--model-name", name, "--options-json",
+                 json.dumps(options or {})],
+    }
+    return {"metadata": {"name": name}, "spec": {"predictor": comp}}
+
+
+async def wait_for(fn, timeout=30.0, interval=0.1, msg="condition"):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture
+def cp_client(tmp_path):
+    loop = asyncio.new_event_loop()
+
+    async def make():
+        cp = ControlPlane(str(tmp_path / "state"), total_chips=8)
+        cp.isvc.autoscale_interval = 0.3
+        client = TestClient(TestServer(cp.build_app()))
+        await client.start_server()
+        return cp, client
+
+    cp, client = loop.run_until_complete(make())
+    yield cp, client, loop
+    loop.run_until_complete(client.close())
+    loop.close()
+
+
+def _status(cp, name):
+    obj = cp.store.get("InferenceService", name, "default")
+    return (obj or {}).get("status", {})
+
+
+def test_isvc_lifecycle_and_predict(cp_client):
+    cp, client, loop = cp_client
+
+    async def run():
+        # The custom entrypoint requires --port; the controller passes PORT
+        # via env, and the runtimes also accept --port. The echo runtime
+        # reads PORT from env (common.serve_main default).
+        r = await client.post("/apis/InferenceService", json=isvc("echo"))
+        assert r.status == 200, await r.text()
+
+        await wait_for(
+            lambda: _status(cp, "echo").get("predictor", {}).get("ready_replicas"),
+            msg="replica ready",
+        )
+        st = _status(cp, "echo")
+        assert st["url"] == "/serving/default/echo"
+        assert any(c["type"] == "Ready" and c["status"]
+                   for c in st["conditions"])
+
+        # Predict through the activator (V1 protocol end to end).
+        r = await client.post(
+            "/serving/default/echo/v1/models/echo:predict",
+            json={"instances": [1, 2, 3]},
+        )
+        assert r.status == 200, await r.text()
+        body = await r.json()
+        assert [p["echo"] for p in body["predictions"]] == [1, 2, 3]
+
+        # Delete tears replicas down.
+        r = await client.delete("/apis/InferenceService/default/echo")
+        assert (await r.json())["deleted"]
+        await wait_for(
+            lambda: not cp.isvc.services.get("default/echo", None)
+            or not cp.isvc.services["default/echo"].replicas,
+            msg="replicas reaped",
+        )
+
+    loop.run_until_complete(run())
+
+
+def test_isvc_validation_rejected(cp_client):
+    cp, client, loop = cp_client
+
+    async def run():
+        bad = isvc("bad")
+        bad["spec"]["predictor"]["min_replicas"] = 5
+        bad["spec"]["predictor"]["max_replicas"] = 2
+        r = await client.post("/apis/InferenceService", json=bad)
+        assert r.status == 422
+
+    loop.run_until_complete(run())
+
+
+def test_scale_to_zero_and_cold_start(cp_client):
+    cp, client, loop = cp_client
+
+    async def run():
+        spec = isvc("s0", min_r=0, max_r=1, grace=1.0)
+        r = await client.post("/apis/InferenceService", json=spec)
+        assert r.status == 200, await r.text()
+
+        # First request arrives with zero replicas: activator cold-starts.
+        r = await client.post(
+            "/serving/default/s0/v1/models/s0:predict",
+            json={"instances": ["cold"]},
+        )
+        assert r.status == 200, await r.text()
+        assert (await r.json())["predictions"][0]["echo"] == "cold"
+
+        # After the grace period the autoscaler reaps to zero. Generous
+        # timeout: the suite shares one vCPU with worker subprocesses.
+        await wait_for(
+            lambda: not cp.isvc.services["default/s0"].replicas,
+            timeout=90, msg="scale to zero",
+        )
+        st = _status(cp, "s0")
+        assert any(c["type"] == "Unready" and c["status"]
+                   for c in st["conditions"])
+
+        # And a second request cold-starts again.
+        r = await client.post(
+            "/serving/default/s0/v1/models/s0:predict",
+            json={"instances": ["warm-again"]},
+        )
+        assert r.status == 200, await r.text()
+
+    loop.run_until_complete(run())
+
+
+def test_autoscale_up_under_load(cp_client):
+    cp, client, loop = cp_client
+
+    async def run():
+        spec = isvc("hot", min_r=1, max_r=3, target=1.0,
+                     options={"delay_ms": 300})
+        r = await client.post("/apis/InferenceService", json=spec)
+        assert r.status == 200, await r.text()
+        await wait_for(
+            lambda: _status(cp, "hot").get("predictor", {}).get("ready_replicas"),
+            msg="first replica",
+        )
+
+        # 6 concurrent slow requests vs target_concurrency=1 -> scale up.
+        tasks = [
+            asyncio.create_task(client.post(
+                "/serving/default/hot/v1/models/hot:predict",
+                json={"instances": [i]},
+            ))
+            for i in range(6)
+        ]
+        await wait_for(
+            lambda: cp.isvc.services["default/hot"].desired > 1,
+            timeout=15, msg="autoscale up",
+        )
+        for t in tasks:
+            resp = await t
+            assert resp.status == 200
+
+    loop.run_until_complete(run())
+
+
+def test_jax_llm_isvc_end_to_end(cp_client):
+    """BASELINE config #5 shape: jax-format ISVC -> GenerationEngine replica
+    -> V1 predict through the activator (tiny preset, random init)."""
+    cp, client, loop = cp_client
+
+    async def run():
+        spec = {
+            "metadata": {"name": "llm"},
+            "spec": {"predictor": {
+                "model": {
+                    "format": "jax",
+                    "options": {"preset": "llama-tiny", "max_slots": 2,
+                                "checkpoint": "none"},
+                },
+                "min_replicas": 1, "max_replicas": 1,
+            }},
+        }
+        r = await client.post("/apis/InferenceService", json=spec)
+        assert r.status == 200, await r.text()
+        await wait_for(
+            lambda: _status(cp, "llm").get("predictor", {}).get("ready_replicas"),
+            timeout=240, msg="jax replica ready (compiles prefill+decode)",
+        )
+        r = await client.post(
+            "/serving/default/llm/v1/models/llm:predict",
+            json={"instances": [
+                {"prompt": "hello tpu", "max_new_tokens": 4},
+                {"token_ids": [3, 1, 4], "max_new_tokens": 3},
+            ]},
+        )
+        assert r.status == 200, await r.text()
+        preds = (await r.json())["predictions"]
+        assert len(preds[0]["token_ids"]) == 4
+        assert isinstance(preds[0]["text"], str)
+        assert len(preds[1]["token_ids"]) == 3
+
+    loop.run_until_complete(run())
+
+
+def test_crash_loop_marks_failed(cp_client):
+    cp, client, loop = cp_client
+
+    async def run():
+        spec = isvc("crash", custom={
+            "entrypoint": "kubeflow_tpu.serving.runtimes.echo_server",
+            "args": ["--bogus-flag"],  # argparse exits 2 immediately
+        })
+        r = await client.post("/apis/InferenceService", json=spec)
+        assert r.status == 200, await r.text()
+        await wait_for(
+            lambda: any(
+                c["type"] == "Failed" and c["status"] and c["reason"] == "CrashLoop"
+                for c in _status(cp, "crash").get("conditions", [])
+            ),
+            timeout=30, msg="crash-loop Failed condition",
+        )
+
+        # Requests to a Failed service fail fast (no cold-start hold).
+        t0 = asyncio.get_running_loop().time()
+        r = await client.post(
+            "/serving/default/crash/v1/models/crash:predict",
+            json={"instances": [1]},
+        )
+        assert r.status == 503
+        assert asyncio.get_running_loop().time() - t0 < 5
+
+        # A corrected re-apply resets the crash loop and recovers.
+        good = isvc("crash")
+        r = await client.post("/apis/InferenceService", json=good)
+        assert r.status == 200, await r.text()
+        await wait_for(
+            lambda: _status(cp, "crash").get("predictor", {}).get("ready_replicas"),
+            timeout=60, msg="recovery after re-apply",
+        )
+        r = await client.post(
+            "/serving/default/crash/v1/models/crash:predict",
+            json={"instances": ["back"]},
+        )
+        assert r.status == 200, await r.text()
+
+    loop.run_until_complete(run())

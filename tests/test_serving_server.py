@@ -1,0 +1,228 @@
+"""Serving library tests: types validation, storage init, batcher, V1/V2.
+
+Reference analog (SURVEY.md 7.3): KServe's python unit tests hit the model
+server with an in-process test client -- same here via aiohttp's
+TestClient; no subprocess, no accelerator.
+"""
+
+import asyncio
+import os
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeflow_tpu.serving.model import Batcher, InferenceError, Model, ModelRepository
+from kubeflow_tpu.serving.runtimes.echo_server import EchoModel
+from kubeflow_tpu.serving.server import ModelServer
+from kubeflow_tpu.serving.storage import StorageError, initialize
+from kubeflow_tpu.serving.types import (
+    InferenceService,
+    ServingValidationError,
+    validate_isvc,
+)
+
+
+# -- types ----------------------------------------------------------------
+
+
+def isvc_dict(**comp):
+    base = {"model": {"format": "sklearn", "storage_uri": "/tmp/m"}}
+    base.update(comp)
+    return {
+        "metadata": {"name": "demo"},
+        "spec": {"predictor": base},
+    }
+
+
+def test_isvc_roundtrip_and_validate():
+    isvc = InferenceService.from_dict(isvc_dict())
+    validate_isvc(isvc)
+    assert isvc.key == "default/demo"
+    again = InferenceService.from_dict(isvc.to_dict())
+    assert again.spec.predictor.model.format.value == "sklearn"
+
+
+def test_isvc_rejects_both_model_and_custom():
+    d = isvc_dict(custom={"entrypoint": "x"})
+    with pytest.raises(ServingValidationError):
+        validate_isvc(InferenceService.from_dict(d))
+
+
+def test_isvc_rejects_custom_format_via_model():
+    d = isvc_dict()
+    d["spec"]["predictor"]["model"]["format"] = "custom"
+    with pytest.raises(ServingValidationError):
+        validate_isvc(InferenceService.from_dict(d))
+
+
+def test_isvc_rejects_transformer_component():
+    d = isvc_dict()
+    d["spec"]["transformer"] = {
+        "custom": {"entrypoint": "x"},
+    }
+    with pytest.raises(ServingValidationError, match="transformer"):
+        validate_isvc(InferenceService.from_dict(d))
+
+
+def test_isvc_rejects_bad_scaling():
+    d = isvc_dict()
+    d["spec"]["predictor"]["min_replicas"] = 3
+    d["spec"]["predictor"]["max_replicas"] = 1
+    with pytest.raises(ServingValidationError):
+        validate_isvc(InferenceService.from_dict(d))
+
+
+# -- storage --------------------------------------------------------------
+
+
+def test_storage_local_symlink(tmp_path):
+    src = tmp_path / "weights"
+    src.mkdir()
+    (src / "model.joblib").write_bytes(b"x")
+    dest = tmp_path / "mnt"
+    out = initialize(str(src), str(dest))
+    assert os.path.islink(out)
+    assert os.path.realpath(out) == str(src)
+    # Idempotent.
+    assert initialize(f"file://{src}", str(dest)) == out
+
+
+def test_storage_gated_schemes(tmp_path):
+    for uri in ("s3://b/m", "gs://b/m", "https://x/m"):
+        with pytest.raises(StorageError):
+            initialize(uri, str(tmp_path))
+
+
+def test_storage_missing_path(tmp_path):
+    with pytest.raises(StorageError):
+        initialize(str(tmp_path / "nope"), str(tmp_path / "mnt"))
+
+
+# -- batcher --------------------------------------------------------------
+
+
+def test_batcher_coalesces():
+    async def run():
+        model = EchoModel("m", None, {"delay_ms": 5})
+        model.load()
+        b = Batcher(model, max_batch=8, max_latency_ms=20)
+        b.start()
+        outs = await asyncio.gather(*(b.predict(i) for i in range(10)))
+        await b.stop()
+        assert [o["echo"] for o in outs] == list(range(10))
+        # 10 concurrent requests must not have run as 10 singleton batches.
+        assert max(model.batch_sizes) > 1
+        assert sum(model.batch_sizes) == 10
+
+    asyncio.run(run())
+
+
+def test_batcher_propagates_failure():
+    async def run():
+        model = EchoModel("m", None, {"fail": True})
+        model.load()
+        b = Batcher(model, max_batch=4)
+        b.start()
+        with pytest.raises(InferenceError):
+            await b.predict(1)
+        await b.stop()
+
+    asyncio.run(run())
+
+
+# -- server protocols ------------------------------------------------------
+
+
+@pytest.fixture
+def client(event_loop=None):
+    async def make():
+        repo = ModelRepository()
+        model = EchoModel("demo", "/models/demo", {})
+        repo.register(model)
+        model.load()
+        server = ModelServer(repository=repo)
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        return client
+
+    loop = asyncio.new_event_loop()
+    c = loop.run_until_complete(make())
+    yield c, loop
+    loop.run_until_complete(c.close())
+    loop.close()
+
+
+def test_v1_protocol(client):
+    c, loop = client
+
+    async def run():
+        r = await c.get("/v1/models/demo")
+        assert (await r.json()) == {"name": "demo", "ready": True}
+        r = await c.post("/v1/models/demo:predict", json={"instances": [1, 2]})
+        assert r.status == 200
+        body = await r.json()
+        assert [p["echo"] for p in body["predictions"]] == [1, 2]
+        # Unknown model -> 404; bad body -> 400.
+        r = await c.post("/v1/models/nope:predict", json={"instances": []})
+        assert r.status == 404
+        r = await c.post("/v1/models/demo:predict", json={"bad": 1})
+        assert r.status == 400
+
+    loop.run_until_complete(run())
+
+
+def test_v2_protocol(client):
+    c, loop = client
+
+    async def run():
+        r = await c.get("/v2")
+        assert (await r.json())["version"] == "2"
+        r = await c.get("/v2/health/ready")
+        assert (await r.json())["ready"] is True
+        r = await c.get("/v2/models/demo/ready")
+        assert (await r.json())["ready"] is True
+        r = await c.post(
+            "/v2/models/demo/infer",
+            json={"inputs": [{"name": "x", "shape": [2], "datatype": "FP32",
+                              "data": [1, 2]}]},
+        )
+        assert r.status == 200
+        body = await r.json()
+        assert body["model_name"] == "demo"
+        assert body["outputs"][0]["data"]
+
+        # Repository API: unload flips readiness, load restores it.
+        r = await c.post("/v2/repository/models/demo/unload")
+        assert (await r.json())["ready"] is False
+        r = await c.get("/v2/health/ready")
+        assert (await r.json())["ready"] is False
+        r = await c.post("/v2/models/demo/infer", json={"inputs": [{"data": [1]}]})
+        assert r.status == 503
+        r = await c.post("/v2/repository/models/demo/load")
+        assert (await r.json())["ready"] is True
+
+    loop.run_until_complete(run())
+
+
+def test_sklearn_runtime(tmp_path):
+    import joblib
+    import numpy as np
+    from sklearn.linear_model import LogisticRegression
+
+    from kubeflow_tpu.serving.runtimes.sklearn_server import SKLearnModel
+
+    x = np.array([[0.0], [1.0], [2.0], [3.0]])
+    y = np.array([0, 0, 1, 1])
+    est = LogisticRegression().fit(x, y)
+    joblib.dump(est, tmp_path / "model.joblib")
+
+    m = SKLearnModel("clf", str(tmp_path), {})
+    m.load()
+    assert m.ready
+    preds = m.predict([[0.0], [3.0]])
+    assert preds == [0, 1]
+
+    proba = SKLearnModel("clf", str(tmp_path), {"probabilities": True})
+    proba.load()
+    out = proba.predict([[0.0]])
+    assert len(out[0]) == 2 and abs(sum(out[0]) - 1.0) < 1e-6
