@@ -424,16 +424,18 @@ class LlamaTask(TrainTask):
         return self._sharding_cache[1]
 
     def init_state(self, rng: jax.Array, mesh: Mesh):
-        from kubeflow_tpu.parallel.mesh import validate_divisibility
+        from kubeflow_tpu.parallel.mesh import mesh_context, validate_divisibility
 
         validate_divisibility(self.batch_size, self.seq_len, mesh)
         shardings = self._shardings(mesh)
-        with mesh:
+        with mesh, mesh_context(mesh):
             return jax.jit(self._init_fn, out_shardings=shardings)(rng)
 
     # -- step -------------------------------------------------------------
 
     def train_step_fn(self, mesh: Mesh):
+        from kubeflow_tpu.parallel.mesh import mesh_context
+
         shardings = self._shardings(mesh)
         batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), "sequence"))
 
@@ -446,12 +448,20 @@ class LlamaTask(TrainTask):
             new_state = state.apply_gradients(grads=grads)
             return new_state, {"loss": loss}
 
-        return jax.jit(
+        jitted = jax.jit(
             step,
             in_shardings=(shardings, batch_sharding, batch_sharding),
             out_shardings=(shardings, NamedSharding(mesh, P())),
             donate_argnums=(0,),
         )
+
+        # mesh_context makes the mesh visible to ring attention at trace
+        # time (the first call traces; later calls hit the jit cache).
+        def wrapped(state, tokens, targets):
+            with mesh_context(mesh):
+                return jitted(state, tokens, targets)
+
+        return wrapped
 
     # -- data -------------------------------------------------------------
 

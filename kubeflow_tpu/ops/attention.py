@@ -63,7 +63,37 @@ def dot_product_attention(
     segment_ids: Optional[jax.Array] = None,
     impl: str = "auto",
 ) -> jax.Array:
-    """Attention entry point. impl: auto | xla | flash."""
+    """Attention entry point. impl: auto | xla | flash | ring.
+
+    ``ring`` shards the sequence dim over the mesh's ``sequence`` axis via
+    shard_map + ppermute (context parallelism); ``auto`` picks it whenever
+    the active mesh has a non-trivial sequence axis, because otherwise
+    GSPMD would all-gather K/V for the S x S einsum.
+    """
+    if impl in ("auto", "ring"):
+        from kubeflow_tpu.parallel.mesh import active_mesh
+
+        mesh = active_mesh()
+        # Segment packing across a ring is not implemented; packed batches
+        # fall back to GSPMD attention (correct, just not ring-overlapped).
+        # Shapes that don't divide the mesh (e.g. the batch-1 dummy of
+        # model.init traces) also fall back.
+        seq_parallel = (
+            mesh is not None
+            and "sequence" in mesh.shape
+            and mesh.shape["sequence"] > 1
+            and segment_ids is None
+            and _ring_shardable(q, k, mesh)
+        )
+        if impl == "ring" or seq_parallel:
+            if not seq_parallel:
+                # ring requested but no sequence axis: plain attention is
+                # the n=1 special case of the ring.
+                return xla_attention(q, k, v, causal=causal,
+                                     segment_ids=segment_ids)
+            from kubeflow_tpu.ops.ring_attention import ring_attention_sharded
+
+            return ring_attention_sharded(q, k, v, mesh, causal=causal)
     if impl == "auto":
         impl = "flash" if _flash_available(q) else "xla"
     if impl == "flash":
@@ -71,6 +101,18 @@ def dot_product_attention(
 
         return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids)
     return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+
+
+def _ring_shardable(q: jax.Array, k: jax.Array, mesh) -> bool:
+    batch = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+    seq = mesh.shape["sequence"]
+    heads = mesh.shape.get("tensor", 1)
+    return (
+        q.shape[0] % batch == 0
+        and q.shape[1] % seq == 0
+        and q.shape[2] % heads == 0
+        and k.shape[2] % heads == 0
+    )
 
 
 def _flash_available(q: jax.Array) -> bool:

@@ -1,0 +1,138 @@
+"""Ring attention: context parallelism over the ``sequence`` mesh axis.
+
+Long-context training shards the sequence dimension across devices
+(SURVEY.md 5.7). GSPMD alone would all-gather K/V for the attention
+einsum -- O(S) memory spike per device, defeating the point of sharding.
+Ring attention instead keeps K/V sharded and rotates blocks around the
+``sequence`` axis with ``ppermute`` (ICI neighbor traffic), accumulating
+the softmax online exactly as flash attention does across tiles:
+
+    step s: device r attends its local Q block against the K/V block
+    originally owned by device (r - s) mod n, then passes K/V to r+1.
+
+Compute and the collective permute overlap on TPU (async collectives), so
+the ring costs ~one K/V block of HBM and hides the wire time behind the
+per-block matmuls.
+
+Causality is exact across blocks: masks are built from *global* positions
+(block_index * block_len + offset), so a fully-masked future block simply
+contributes zero probability mass (the online-softmax ``where`` keeps
+those rows finite).
+
+Entry points:
+- ``ring_attention``         -- per-shard body; call inside shard_map.
+- ``ring_attention_sharded`` -- shard_map wrapper over a mesh; drop-in for
+  ``xla_attention`` on [B, S, H, D] global arrays.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeflow_tpu.ops.attention import _repeat_kv
+
+_NEG_INF = -1e30  # finite "minus infinity": exp() underflows cleanly
+
+
+def ring_attention(
+    q: jax.Array,  # [B, Sq_local, H, D]
+    k: jax.Array,  # [B, Sk_local, Hkv, D]
+    v: jax.Array,  # [B, Sk_local, Hkv, D]
+    axis_name: str = "sequence",
+    causal: bool = True,
+) -> jax.Array:
+    """Per-shard ring attention; must run inside shard_map over
+    ``axis_name``. Local blocks are contiguous slices of the global
+    sequence in axis order (device r owns positions [r*C, (r+1)*C))."""
+
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    q32 = q.astype(jnp.float32)
+
+    q_pos = my_idx * sq + jnp.arange(sq)  # global query positions
+
+    # Online-softmax state (fp32): running max, normalizer, weighted sum.
+    # Derived from 0*q (not jnp.zeros): fresh constants are device-INvariant
+    # under shard_map's varying-axes tracking, but the loop writes
+    # device-varying values into them and fori_loop requires carry types to
+    # agree; inheriting q's variance sidesteps hand-listing mesh axes.
+    zero_bhq = 0.0 * q32[..., 0].transpose(0, 2, 1)  # [B, H, Sq]
+    m0 = zero_bhq + _NEG_INF
+    l0 = zero_bhq
+    acc0 = 0.0 * q32
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(s, carry):
+        k_blk, v_blk, m, l, acc = carry
+        src = (my_idx - s) % n  # original owner of the block now held
+        scores = (
+            jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32))
+            * scale
+        )
+        if causal:
+            k_pos = src * sk + jnp.arange(sk)
+            visible = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk] global causal
+            scores = jnp.where(visible[None, None], scores, _NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        # where (not bare exp): when every key so far is masked, m_new is
+        # still _NEG_INF and exp(scores - m_new) would be exp(0)=1 for
+        # masked entries -- probability mass out of thin air.
+        p = jnp.where(
+            scores > _NEG_INF / 2, jnp.exp(scores - m_new[..., None]), 0.0
+        )
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+        )
+        # Rotate K/V to the next device; skip the final (useless) hop.
+        k_blk, v_blk = jax.lax.cond(
+            s < n - 1,
+            lambda kv: tuple(
+                jax.lax.ppermute(x, axis_name, perm) for x in kv
+            ),
+            lambda kv: kv,
+            (k_blk, v_blk),
+        )
+        return k_blk, v_blk, m_new, l_new, acc_new
+
+    _, _, _, l, acc = jax.lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,  # [B, S, H, D] global
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = True,
+    axis_name: str = "sequence",
+    batch_axes=("data", "fsdp"),
+    head_axis: str = "tensor",
+) -> jax.Array:
+    """shard_map wrapper: global [B, S, H, D] arrays -> ring attention with
+    S sharded over ``axis_name``, heads over ``head_axis``, batch over
+    ``batch_axes``. Falls through to the per-shard body with n=1 when the
+    sequence axis is trivial."""
+
+    qspec = P(batch_axes, axis_name, head_axis, None)
+    fn = partial(ring_attention, axis_name=axis_name, causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(qspec, qspec, qspec),
+        out_specs=qspec,
+    )(q, k, v)

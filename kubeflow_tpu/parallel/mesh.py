@@ -16,6 +16,8 @@ outermost, which is XLA's expectation for the cheap-collective axis.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
 import math
 from typing import Optional, Sequence
@@ -25,6 +27,26 @@ import numpy as np
 from jax.sharding import Mesh
 
 AXES = ("data", "fsdp", "sequence", "tensor")
+
+# Trace-time mesh handoff: ops that need an explicit mesh (shard_map ring
+# attention) read it here, so flax modules stay mesh-agnostic. Set by the
+# task around jit tracing/calls, not by model code.
+_ACTIVE_MESH: contextvars.ContextVar[Optional[Mesh]] = contextvars.ContextVar(
+    "kftpu_active_mesh", default=None
+)
+
+
+def active_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH.get()
+
+
+@contextlib.contextmanager
+def mesh_context(mesh: Optional[Mesh]):
+    token = _ACTIVE_MESH.set(mesh)
+    try:
+        yield mesh
+    finally:
+        _ACTIVE_MESH.reset(token)
 
 
 @dataclasses.dataclass(frozen=True)

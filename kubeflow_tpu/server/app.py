@@ -107,7 +107,9 @@ class ControlPlane:
     # -- HTTP app ---------------------------------------------------------
 
     def build_app(self) -> web.Application:
-        app = web.Application()
+        # Sized to match ModelServer's limit: the activator proxies predict
+        # bodies, so the ingress must accept what the replicas accept.
+        app = web.Application(client_max_size=256 * 1024 * 1024)
         app.add_routes(
             [
                 web.post("/apis/{kind}", self.h_apply),
@@ -142,44 +144,43 @@ class ControlPlane:
             obj = await req.json()
         except json.JSONDecodeError:
             return web.json_response({"error": "body is not JSON"}, status=400)
-        if kind in JOB_KINDS:
-            try:
-                obj.setdefault("kind", kind)
-                if obj["kind"] != kind:
-                    raise ValidationError(
-                        f"body kind {obj['kind']} != URL kind {kind}"
-                    )
-                job = apply_defaults(TrainJob.from_dict(obj))
-                validate_job(job)
-                stored = obj_with_preserved_status(
-                    self.store, kind, job.to_dict()
-                )
-            except (ValidationError, ValueError) as e:
-                return web.json_response({"error": str(e)}, status=422)
-        elif kind == "Experiment":
-            try:
-                obj.setdefault("kind", kind)
-                exp = Experiment.from_dict(obj)
-                validate_experiment(exp)
-                stored = obj_with_preserved_status(self.store, kind, exp.to_dict())
+
+        def parse_job(o):
+            job = apply_defaults(TrainJob.from_dict(o))
+            validate_job(job)
+            return job.to_dict()
+
+        def parse_experiment(o):
+            exp = Experiment.from_dict(o)
+            validate_experiment(exp)
+            return exp.to_dict()
+
+        def parse_isvc(o):
+            isvc = InferenceService.from_dict(o)
+            validate_isvc(isvc)
+            return isvc.to_dict()
+
+        parser = (
+            parse_job if kind in JOB_KINDS
+            else {"Experiment": parse_experiment,
+                  "InferenceService": parse_isvc}.get(kind)
+        )
+        if parser is not None:
+            # Admission-webhook analog: parse + default + validate, then
+            # preserve the controller-owned status across re-applies.
             # pydantic's ValidationError subclasses ValueError, so one
             # clause covers model parsing and semantic validation.
-            except (ValidationError, ValueError) as e:
-                return web.json_response({"error": str(e)}, status=422)
-        elif kind == "InferenceService":
             try:
                 obj.setdefault("kind", kind)
                 if obj["kind"] != kind:
                     raise ValidationError(
                         f"body kind {obj['kind']} != URL kind {kind}"
                     )
-                isvc = InferenceService.from_dict(obj)
-                validate_isvc(isvc)
-                stored = obj_with_preserved_status(self.store, kind, isvc.to_dict())
-            except (ServingValidationError, ValueError) as e:
+                stored = obj_with_preserved_status(self.store, kind, parser(obj))
+            except (ValidationError, ServingValidationError, ValueError) as e:
                 return web.json_response({"error": str(e)}, status=422)
         else:
-            # Other non-job kinds are validated by their controllers; only
+            # Unknown kinds are validated by their controllers; only
             # structural metadata is checked here.
             if not obj.get("metadata", {}).get("name"):
                 return web.json_response(

@@ -315,6 +315,9 @@ class GenerationEngine:
 
     def submit(self, req: Request) -> Future:
         req.future = req.future or Future()
+        if not req.prompt:
+            req.future.set_exception(ValueError("empty prompt"))
+            return req.future
         if len(req.prompt) >= self.cfg.max_seq:
             req.future.set_exception(
                 ValueError(

@@ -101,6 +101,11 @@ class JaxLLMModel(Model):
     def load(self) -> None:
         from kubeflow_tpu.serving.engine import GenerationEngine
 
+        if self.engine is not None:
+            # Repository re-load: stop the old scheduler thread and drop its
+            # KV cache before building a new engine (else both stay live).
+            self.engine.stop()
+            self.engine = None
         opts = self.options
         tok = opts.get("tokenizer", "byte")
         self.tokenizer = ByteTokenizer() if tok == "byte" else HFTokenizer(tok)
@@ -135,7 +140,10 @@ class JaxLLMModel(Model):
     def predict(self, instances: Sequence[Any]) -> List[Any]:
         from kubeflow_tpu.serving.engine import Request
 
-        futs, meta = [], []
+        # Per-instance errors become per-instance results: one malformed
+        # instance must not fail (or orphan) the other requests the batcher
+        # coalesced with it.
+        slots: List[Any] = []  # (future, text_out) | {"error": ...}
         for inst in instances:
             if not isinstance(inst, dict):
                 inst = {"prompt": str(inst)}
@@ -144,20 +152,29 @@ class JaxLLMModel(Model):
             elif "prompt" in inst:
                 ids, text_out = self.tokenizer.encode(inst["prompt"]), True
             else:
-                raise InferenceError(
-                    'instance needs "prompt" or "token_ids"', 400
-                )
+                slots.append({"error": 'instance needs "prompt" or "token_ids"'})
+                continue
+            if not ids:
+                slots.append({"error": "empty prompt"})
+                continue
             req = Request(
                 prompt=ids,
                 max_new_tokens=int(inst.get("max_new_tokens", 64)),
                 temperature=float(inst.get("temperature", 0.0)),
                 eos_id=inst.get("eos_id", self.tokenizer.eos_id),
             )
-            futs.append(self.engine.submit(req))
-            meta.append(text_out)
+            slots.append((self.engine.submit(req), text_out))
         out = []
-        for fut, text_out in zip(futs, meta):
-            ids = fut.result(timeout=600)
+        for slot in slots:
+            if isinstance(slot, dict):
+                out.append(slot)
+                continue
+            fut, text_out = slot
+            try:
+                ids = fut.result(timeout=600)
+            except Exception as e:  # noqa: BLE001 - isolate per request
+                out.append({"error": str(e)})
+                continue
             if text_out:
                 out.append({"text": self.tokenizer.decode(ids),
                             "token_ids": ids})
