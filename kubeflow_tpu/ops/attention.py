@@ -108,7 +108,11 @@ def _ring_shardable(q: jax.Array, k: jax.Array, mesh) -> bool:
     seq = mesh.shape["sequence"]
     heads = mesh.shape.get("tensor", 1)
     return (
-        q.shape[0] % batch == 0
+        # Self-attention only: the ring's causal mask is zero-aligned,
+        # while xla_attention tail-aligns cross-length (decode) masks --
+        # different semantics, so Sq != Sk must not ride the ring.
+        q.shape[1] == k.shape[1]
+        and q.shape[0] % batch == 0
         and q.shape[1] % seq == 0
         and q.shape[2] % heads == 0
         and k.shape[2] % heads == 0

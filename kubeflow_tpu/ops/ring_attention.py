@@ -52,9 +52,10 @@ def ring_attention(
 
     n = jax.lax.axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
+    # GQA expansion happens per-block INSIDE the loop: the ppermute carry
+    # rotates the narrow [.., Hkv, D] blocks, so the wire/HBM cost keeps
+    # GQA's n_rep-fold savings.
     n_rep = q.shape[2] // k.shape[2]
-    k = _repeat_kv(k, n_rep)
-    v = _repeat_kv(v, n_rep)
 
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -78,10 +79,9 @@ def ring_attention(
     def body(s, carry):
         k_blk, v_blk, m, l, acc = carry
         src = (my_idx - s) % n  # original owner of the block now held
-        scores = (
-            jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32))
-            * scale
-        )
+        k_use = _repeat_kv(k_blk, n_rep).astype(jnp.float32)
+        v_use = _repeat_kv(v_blk, n_rep).astype(jnp.float32)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k_use) * scale
         if causal:
             k_pos = src * sk + jnp.arange(sk)
             visible = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk] global causal
@@ -96,7 +96,7 @@ def ring_attention(
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=-1)
         acc_new = acc * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
-            "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+            "bhqk,bkhd->bqhd", p, v_use
         )
         # Rotate K/V to the next device; skip the final (useless) hop.
         k_blk, v_blk = jax.lax.cond(

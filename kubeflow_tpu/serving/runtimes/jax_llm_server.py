@@ -172,9 +172,15 @@ class JaxLLMModel(Model):
             fut, text_out = slot
             try:
                 ids = fut.result(timeout=600)
-            except Exception as e:  # noqa: BLE001 - isolate per request
+            except ValueError as e:
+                # Engine-side request validation (too long, etc.): a client
+                # error for this one instance.
                 out.append({"error": str(e)})
                 continue
+            except Exception as e:  # noqa: BLE001
+                # Timeouts / dead scheduler are systemic: surface as 5xx so
+                # health checks and retry layers see the failure.
+                raise InferenceError(f"generation engine failure: {e}", 500)
             if text_out:
                 out.append({"text": self.tokenizer.decode(ids),
                             "token_ids": ids})

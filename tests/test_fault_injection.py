@@ -1,0 +1,187 @@
+"""Fault injection e2e (SURVEY.md 5.3, 7.3(d), 7.4 #3).
+
+A worker dies abruptly mid-training (exit 137, the OOM-kill/SIGKILL code);
+the gang restarts atomically and training resumes from the last orbax
+checkpoint, not step 0. This is the TPU analog of the reference's
+pod-kill e2e: failure of one member must fail/restart the whole gang
+without leaking processes or losing more than checkpoint-interval steps.
+"""
+
+import asyncio
+import pathlib
+import re
+
+import pytest
+
+from conftest import run_job_to_completion
+from kubeflow_tpu.api import (
+    JobKind,
+    JobSpec,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    Resources,
+    RestartPolicy,
+    RunPolicy,
+    TrainJob,
+    apply_defaults,
+)
+from kubeflow_tpu.api.types import CheckpointPolicy, ObjectMeta
+from kubeflow_tpu.store import ObjectStore
+
+
+def fault_job(name, ckpt_dir, *, fault_step, fault_rank=0, replicas=2,
+              steps=8, restart_policy=RestartPolicy.OnFailure,
+              backoff_limit=2, ckpt_interval=2, resume=True):
+    return apply_defaults(TrainJob(
+        kind=JobKind.JAXJob,
+        metadata=ObjectMeta(name=name),
+        spec=JobSpec(
+            replica_specs={
+                ReplicaType.Worker: ReplicaSpec(
+                    replicas=replicas,
+                    restart_policy=restart_policy,
+                    template=ProcessTemplate(
+                        entrypoint="kubeflow_tpu.runtime.entry",
+                        args=["--model", "llama", "--steps", str(steps),
+                              "--log-every", "1",
+                              "--arg", "preset=llama-tiny",
+                              "--arg", "batch_size=16",
+                              "--arg", "seq_len=32"],
+                        env={
+                            "KFTPU_FAULT_STEP": str(fault_step),
+                            "KFTPU_FAULT_RANK": str(fault_rank),
+                            "KFTPU_CKPT_INTERVAL": str(ckpt_interval),
+                        },
+                    ),
+                    resources=Resources(tpu=2),
+                )
+            },
+            run_policy=RunPolicy(backoff_limit=backoff_limit),
+            checkpoint=CheckpointPolicy(
+                dir=str(ckpt_dir), interval_steps=ckpt_interval, resume=resume
+            ),
+        ),
+    ))
+
+
+@pytest.mark.e2e
+def test_worker_death_gang_restart_and_resume(tmp_path):
+    """Rank 1 dies at step 4; the gang restarts and resumes from the last
+    checkpoint, reaching Succeeded with restart_count == 1."""
+
+    async def run():
+        store = ObjectStore(":memory:")
+        job = fault_job("fault-resume", tmp_path / "ckpt",
+                        fault_step=4, fault_rank=1, steps=8)
+        phase, logs = await run_job_to_completion(
+            store, job, tmp_path / "logs", timeout=420
+        )
+        assert phase == "Succeeded", f"phase={phase}\n" + "\n---\n".join(
+            f"{n}:\n{t[-1500:]}" for n, t in logs.items()
+        )
+        obj = store.get("JAXJob", "fault-resume", "default")
+        assert obj["status"]["restart_count"] == 1
+        rank0 = next(t for n, t in logs.items() if "worker-0" in n)
+        # The restarted run announces a resume from a checkpointed step > 0.
+        m = re.search(r"resumed from checkpoint at step (\d+)", rank0)
+        assert m, rank0[-2000:]
+        assert int(m.group(1)) > 0
+        # After restart, training continued to the final step.
+        assert re.search(r"train_end final_step=7", rank0), rank0[-1500:]
+        # The fault actually fired.
+        killed = next(t for n, t in logs.items() if "worker-1" in n)
+        assert "fault injection" in killed
+        store.close()
+
+    asyncio.run(run())
+
+
+@pytest.mark.e2e
+def test_elastic_resize_with_real_processes(tmp_path):
+    """Live elastic downsize: a 2-worker job is resized to 1 mid-run; the
+    gang quiesces, re-forms at world=1, resumes from checkpoint, and
+    completes (SURVEY.md 7.4 #4: quiesce -> checkpoint -> respawn -> resume)."""
+
+    async def run():
+        from kubeflow_tpu.api import ElasticPolicy
+        from kubeflow_tpu.controller import (
+            GangScheduler,
+            JobController,
+            ProcessLauncher,
+        )
+
+        store = ObjectStore(":memory:")
+        job = fault_job("elastic-live", tmp_path / "ckpt3",
+                        fault_step=-1, steps=60, ckpt_interval=2)
+        job.spec.replica_specs[ReplicaType.Worker].replicas = 2
+        job.spec.elastic = ElasticPolicy(
+            min_replicas=1, max_replicas=2, max_restarts=3
+        )
+        launcher = ProcessLauncher(log_dir=str(tmp_path / "logs"))
+        ctl = JobController(store, launcher, GangScheduler(total_chips=8))
+        ctl_task = asyncio.create_task(ctl.run())
+        try:
+            store.put("JAXJob", job.to_dict())
+
+            async def wait(cond, timeout, msg):
+                deadline = asyncio.get_event_loop().time() + timeout
+                while asyncio.get_event_loop().time() < deadline:
+                    if cond():
+                        return
+                    await asyncio.sleep(0.5)
+                raise AssertionError(f"timed out: {msg}")
+
+            def phase():
+                obj = store.get("JAXJob", "elastic-live", "default")
+                return TrainJob.from_dict(obj).status.phase.value
+
+            def log_text(idx):
+                p = tmp_path / "logs" / f"default_elastic-live_worker-{idx}.log"
+                return p.read_text() if p.exists() else ""
+
+            await wait(lambda: phase() == "Running", 120, "job Running")
+            # Let it take some steps and cut a checkpoint before resizing.
+            await wait(lambda: "step=4" in log_text(0), 240, "progress")
+
+            obj = store.get("JAXJob", "elastic-live", "default")
+            j = TrainJob.from_dict(obj)
+            j.spec.replica_specs[ReplicaType.Worker].replicas = 1
+            store.put("JAXJob", j.to_dict())
+
+            await wait(lambda: phase() == "Succeeded", 420, "Succeeded after resize")
+            rank0 = log_text(0)
+            # Two incarnations logged to the same file: world 2 then world 1.
+            assert "world=2" in rank0, rank0[-1500:]
+            assert "world=1" in rank0, rank0[-1500:]
+            assert "resumed from checkpoint" in rank0
+        finally:
+            await ctl.stop()
+            try:
+                await asyncio.wait_for(ctl_task, 5)
+            except asyncio.TimeoutError:
+                ctl_task.cancel()
+        store.close()
+
+    asyncio.run(run())
+
+
+@pytest.mark.e2e
+def test_worker_death_restart_policy_never_fails_gang(tmp_path):
+    """RestartPolicy=Never: the gang is torn down and the job Fails; no
+    respawn, no leaked survivors."""
+
+    async def run():
+        store = ObjectStore(":memory:")
+        job = fault_job("fault-never", tmp_path / "ckpt2",
+                        fault_step=2, fault_rank=0, steps=50,
+                        restart_policy=RestartPolicy.Never, backoff_limit=0)
+        phase, logs = await run_job_to_completion(
+            store, job, tmp_path / "logs", timeout=420
+        )
+        assert phase == "Failed", phase
+        obj = store.get("JAXJob", "fault-never", "default")
+        assert obj["status"]["restart_count"] == 0
+        store.close()
+
+    asyncio.run(run())
