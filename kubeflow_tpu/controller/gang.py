@@ -61,6 +61,46 @@ class GangScheduler:
         self._reserved: dict[str, Reservation] = {}
         self._pending: dict[str, _Pending] = {}
         self._seq = itertools.count()
+        # Per-namespace quotas from Profiles (SURVEY.md 3.4 P1): ns ->
+        # (max chips held, max admitted jobs); None = unlimited.
+        self._ns_quotas: dict[str, tuple[Optional[int], Optional[int]]] = {}
+
+    # -- namespace quotas (Profile enforcement) ---------------------------
+
+    def set_namespace_quota(self, ns: str, tpu: Optional[int] = None,
+                            max_jobs: Optional[int] = None) -> None:
+        self._ns_quotas[ns] = (tpu, max_jobs)
+
+    def clear_namespace_quota(self, ns: str) -> None:
+        self._ns_quotas.pop(ns, None)
+
+    def namespace_usage(self, ns: str) -> tuple[int, int]:
+        """(chips held, admitted jobs) for a namespace."""
+        res = [r for k, r in self._reserved.items() if k.startswith(ns + "/")]
+        return sum(r.chips for r in res), len(res)
+
+    def _quota_allows(self, ns: str, chips: int) -> bool:
+        quota = self._ns_quotas.get(ns)
+        if quota is None:
+            return True
+        max_chips, max_jobs = quota
+        used_chips, used_jobs = self.namespace_usage(ns)
+        if max_chips is not None and used_chips + chips > max_chips:
+            return False
+        if max_jobs is not None and used_jobs + 1 > max_jobs:
+            return False
+        return True
+
+    def _quota_can_ever_allow(self, ns: str, chips: int) -> bool:
+        quota = self._ns_quotas.get(ns)
+        if quota is None:
+            return True
+        max_chips, max_jobs = quota
+        if max_chips is not None and chips > max_chips:
+            return False
+        if max_jobs is not None and max_jobs < 1:
+            return False
+        return True
 
     # -- capacity ---------------------------------------------------------
 

@@ -1,0 +1,26 @@
+"""Platform glue (SURVEY.md 3.4 P1/P4, 7.1 step 8).
+
+- ``types``      Profile (namespace + chip quota) and PodDefault
+                 (admission-time spec mutation) API types
+- ``controller`` PlatformController syncing Profile quotas into the gang
+                 scheduler; PodDefault application lives in apply-time
+                 admission (server/app.py), like the reference's webhook
+"""
+
+from kubeflow_tpu.platform.types import (
+    PlatformValidationError,
+    PodDefault,
+    Profile,
+    apply_pod_defaults,
+    validate_pod_default,
+    validate_profile,
+)
+
+__all__ = [
+    "PlatformValidationError",
+    "PodDefault",
+    "Profile",
+    "apply_pod_defaults",
+    "validate_pod_default",
+    "validate_profile",
+]

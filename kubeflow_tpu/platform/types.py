@@ -1,0 +1,150 @@
+"""Profile and PodDefault API types (kubeflow/kubeflow P1 + P4 analogs).
+
+The reference's Profile CRD materializes a namespace with RBAC, Istio
+policy, and ResourceQuotas per user; here a Profile declares a namespace
+plus a TPU-chip quota the gang scheduler enforces (the meaningful quota
+on a TPU cell -- chips, not CPU shares). PodDefault mirrors the
+admission-webhook mutation: label-selected jobs in a namespace get env
+(and annotation) defaults injected at apply time, before the spec is
+stored -- the stored spec is complete, exactly the reference's
+mutating-webhook contract.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from kubeflow_tpu.api.types import ObjectMeta
+
+PROFILE_KIND = "Profile"
+PODDEFAULT_KIND = "PodDefault"
+
+
+class PlatformValidationError(ValueError):
+    pass
+
+
+class QuotaSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    # Max TPU chips the namespace's admitted gangs may hold concurrently.
+    # None = unlimited (profile exists for namespace identity only).
+    tpu: Optional[int] = None
+    # Max concurrently running (admitted) jobs.
+    max_jobs: Optional[int] = None
+
+
+class ProfileSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    owner: Optional[str] = None
+    quota: QuotaSpec = Field(default_factory=QuotaSpec)
+
+
+class Profile(BaseModel):
+    """A Profile's name IS the namespace it governs (cluster-scoped, like
+    the reference's Profile -> namespace binding)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    kind: str = PROFILE_KIND
+    metadata: ObjectMeta
+    spec: ProfileSpec = Field(default_factory=ProfileSpec)
+    status: dict = Field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Profile":
+        return cls.model_validate(d)
+
+    def to_dict(self) -> dict:
+        return self.model_dump(mode="json", exclude_none=True)
+
+    @property
+    def namespace_governed(self) -> str:
+        return self.metadata.name
+
+
+def validate_profile(p: Profile) -> None:
+    q = p.spec.quota
+    if q.tpu is not None and q.tpu < 0:
+        raise PlatformValidationError("quota.tpu must be >= 0")
+    if q.max_jobs is not None and q.max_jobs < 0:
+        raise PlatformValidationError("quota.max_jobs must be >= 0")
+
+
+class PodDefaultSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    # Jobs whose metadata.labels contain ALL selector pairs are mutated.
+    # Empty selector matches every job in the namespace.
+    selector: Dict[str, str] = Field(default_factory=dict)
+    # Env merged into every replica template (existing keys win: defaults
+    # must never override explicit spec values).
+    env: Dict[str, str] = Field(default_factory=dict)
+    annotations: Dict[str, str] = Field(default_factory=dict)
+
+
+class PodDefault(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    kind: str = PODDEFAULT_KIND
+    metadata: ObjectMeta
+    spec: PodDefaultSpec = Field(default_factory=PodDefaultSpec)
+    status: dict = Field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PodDefault":
+        return cls.model_validate(d)
+
+    def to_dict(self) -> dict:
+        return self.model_dump(mode="json", exclude_none=True)
+
+
+def validate_pod_default(pd: PodDefault) -> None:
+    for k in pd.spec.env:
+        if not k or "=" in k:
+            raise PlatformValidationError(f"invalid env name {k!r}")
+
+
+def _matches(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def apply_pod_defaults(store, job_dict: dict) -> dict:
+    """Mutate a parsed job dict with every matching PodDefault in its
+    namespace (admission-webhook analog; runs server-side at apply).
+
+    Deterministic: defaults apply in name order; spec-explicit env always
+    wins over defaults; earlier defaults win over later ones.
+    """
+
+    ns = job_dict.get("metadata", {}).get("namespace", "default")
+    labels = job_dict.get("metadata", {}).get("labels", {}) or {}
+    defaults = sorted(
+        (PodDefault.from_dict(d) for d in store.list(PODDEFAULT_KIND, ns)),
+        key=lambda pd: pd.metadata.name,
+    )
+    matched = [pd for pd in defaults if _matches(pd.spec.selector, labels)]
+    if not matched:
+        return job_dict
+    merged_env: Dict[str, str] = {}
+    merged_ann: Dict[str, str] = {}
+    applied: List[str] = []
+    for pd in matched:
+        for k, v in pd.spec.env.items():
+            merged_env.setdefault(k, v)
+        for k, v in pd.spec.annotations.items():
+            merged_ann.setdefault(k, v)
+        applied.append(pd.metadata.name)
+    for spec in job_dict.get("spec", {}).get("replica_specs", {}).values():
+        tmpl = spec.setdefault("template", {})
+        env = tmpl.setdefault("env", {})
+        for k, v in merged_env.items():
+            env.setdefault(k, v)
+    ann = job_dict["metadata"].setdefault("annotations", {})
+    for k, v in merged_ann.items():
+        ann.setdefault(k, v)
+    ann.setdefault("platform.kftpu/pod-defaults", ",".join(applied))
+    return job_dict
