@@ -91,17 +91,6 @@ class GangScheduler:
             return False
         return True
 
-    def _quota_can_ever_allow(self, ns: str, chips: int) -> bool:
-        quota = self._ns_quotas.get(ns)
-        if quota is None:
-            return True
-        max_chips, max_jobs = quota
-        if max_chips is not None and chips > max_chips:
-            return False
-        if max_jobs is not None and max_jobs < 1:
-            return False
-        return True
-
     # -- capacity ---------------------------------------------------------
 
     @property
@@ -161,6 +150,11 @@ class GangScheduler:
                 f"gang for {key} needs {min_chips} chips / {processes} processes; "
                 f"cluster has {self.total_chips} chips / {self.max_processes} processes"
             )
+        # Over-quota gangs QUEUE rather than fail, even when the demand
+        # exceeds the whole namespace quota: unlike cluster capacity (fixed
+        # at boot -> ValueError above), quotas are mutable Profile state --
+        # an admin raising the quota must un-stick the queue.
+        ns = key.split("/", 1)[0]
         sched = job.spec.run_policy.scheduling
         # A gang may not jump past pending gangs that sort before it
         # (priority, then FIFO): without this, small jobs backfill forever
@@ -172,7 +166,8 @@ class GangScheduler:
             for p in self._pending.values()
             if p.job_key != key
         )
-        if not blocked and self._fits(chips, processes):
+        if not blocked and self._fits(chips, processes) \
+                and self._quota_allows(ns, chips):
             res = Reservation(
                 job_key=key,
                 chips=chips,
@@ -226,6 +221,11 @@ class GangScheduler:
         out = []
         free_c, free_p = self.free_chips, self.max_processes - self.used_processes
         for p in sorted(self._pending.values()):
+            # A namespace-quota-blocked gang is skipped, not a barrier: the
+            # quota is namespace-local, so holding up other namespaces'
+            # gangs behind it would export one tenant's limit to everyone.
+            if not self._quota_allows(p.job_key.split("/", 1)[0], p.chips):
+                continue
             if p.chips <= free_c and p.processes <= free_p:
                 out.append(p.job_key)
                 free_c -= p.chips

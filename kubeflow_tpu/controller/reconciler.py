@@ -516,12 +516,21 @@ class JobController:
             self._backoff_until.pop(key, None)
         # Capacity freed: someone in the queue may now fit, and elastic jobs
         # formed below spec size may be able to grow.
-        candidates = list(self.gang.admissible())
+        self.kick_pending(exclude=key)
+
+    def kick_pending(self, exclude: str = "") -> None:
+        """Re-enqueue every gang that might now be admissible (called on
+        capacity release and on namespace-quota changes)."""
+        candidates = list(self.gang.admissible()) + list(self.gang.pending())
         candidates += [
             r.key for r in self._runtimes.values()
-            if r.formed_replicas is not None and r.key != key
+            if r.formed_replicas is not None and r.key != exclude
         ]
+        seen: set[str] = set()
         for cand in candidates:
+            if cand in seen or cand == exclude:
+                continue
+            seen.add(cand)
             ns, name = cand.split("/", 1)
             for kind in JOB_KINDS:
                 if self.store.get(kind, name, ns) is not None:

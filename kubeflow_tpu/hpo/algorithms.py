@@ -14,7 +14,11 @@ Algorithms: random, grid, sobol (quasi-random), tpe (Tree-structured
 Parzen Estimator, hyperopt-style univariate Parzen mixtures), bayesopt
 (GP + expected improvement, sklearn), cmaes (simplified
 diagonal-covariance evolution strategy), hyperband (ASHA-style
-asynchronous successive halving over a resource parameter).
+asynchronous successive halving over a resource parameter), anneal
+(simulated annealing around observed good points), pbt (population based
+training: truncation selection + perturb/resample), enas (REINFORCE-updated
+categorical policies over architecture decisions), darts (dispatches a
+differentiable-NAS supernet trial, see kubeflow_tpu/models/nas.py).
 """
 
 from __future__ import annotations
@@ -429,6 +433,190 @@ class HyperbandSuggester(Suggester):
         return out
 
 
+class AnnealSuggester(Suggester):
+    """Simulated-annealing sampler (the reference's hyperopt ``anneal``
+    service): each suggestion is drawn around a previously observed good
+    point, with the neighborhood radius shrinking as evidence accumulates,
+    so the search anneals from exploration to exploitation."""
+
+    def suggest(self, history, n_created, count):
+        shrink = float(self.settings.get("shrink", "0.1"))
+        done = [t for t in history if t.finished and t.value is not None]
+        rng = self._rng(n_created)
+        out = []
+        for _ in range(count):
+            if not done:
+                out.append(self._random_one(rng))
+                continue
+            ranked = sorted(done, key=lambda t: t.value)
+            # Geometric preference toward better centers; radius ~ 1/(1+kn).
+            idx = min(int(rng.geometric(0.5)) - 1, len(ranked) - 1)
+            center = ranked[idx].assignments
+            radius = 1.0 / (1.0 + shrink * len(done))
+            asg: dict[str, ParamValue] = {}
+            for p in self.params:
+                if p.name not in center or rng.random() < 0.1:
+                    asg[p.name] = self._random_one(rng)[p.name]
+                    continue
+                u = _to_unit(p, center[p.name])
+                asg[p.name] = _from_unit(p, float(rng.normal(u, radius / 2)))
+            out.append(asg)
+        return out
+
+
+class PBTSuggester(Suggester):
+    """Population based training, ask-style (the reference's pbt service).
+
+    The first ``population`` suggestions initialize the population at
+    random. Afterwards each suggestion is exploit+explore: truncation
+    selection picks a parent uniformly from the top ``truncation`` fraction
+    of the last generation (most recent ``population`` finished trials),
+    then numeric hyperparameters are perturbed by x ``perturb`` or
+    / ``perturb`` (or fully resampled with prob ``resample_prob``) and
+    categoricals are kept or resampled. Weight inheritance is carried by the
+    trial template's checkpoint dir: children of the same experiment share
+    the experiment checkpoint root, so a child resumes the best parent's
+    weights where the template wires ``${trialParameters.<ckpt>}``.
+    """
+
+    def suggest(self, history, n_created, count):
+        pop = int(self.settings.get("population", "8"))
+        trunc = float(self.settings.get("truncation", "0.25"))
+        perturb = float(self.settings.get("perturb", "1.2"))
+        resample_prob = float(self.settings.get("resample_prob", "0.25"))
+        done = [t for t in history if t.finished and t.value is not None]
+        rng = self._rng(n_created)
+        out = []
+        for _ in range(count):
+            if n_created + len(out) < pop or not done:
+                out.append(self._random_one(rng))
+                continue
+            gen = sorted(done[-pop:], key=lambda t: t.value)
+            top = gen[: max(1, int(math.ceil(trunc * len(gen))))]
+            parent = top[rng.integers(len(top))].assignments
+            asg: dict[str, ParamValue] = {}
+            for p in self.params:
+                if p.name not in parent:
+                    asg[p.name] = self._random_one(rng)[p.name]
+                    continue
+                if p.type in (ParameterType.categorical, ParameterType.discrete):
+                    keep = rng.random() >= resample_prob
+                    asg[p.name] = (
+                        parent[p.name] if keep else self._random_one(rng)[p.name]
+                    )
+                    continue
+                if rng.random() < resample_prob:
+                    asg[p.name] = self._random_one(rng)[p.name]
+                    continue
+                factor = perturb if rng.random() < 0.5 else 1.0 / perturb
+                fs = p.feasible_space
+                x = float(parent[p.name]) * factor
+                x = min(max(x, float(fs.min)), float(fs.max))
+                asg[p.name] = (
+                    int(round(x)) if p.type == ParameterType.int_ else x
+                )
+            out.append(asg)
+        return out
+
+
+class ENASSuggester(Suggester):
+    """ENAS-style neural-architecture search over categorical/discrete
+    parameters (the reference's NAS/ENAS service).
+
+    The reference trains an RNN controller with REINFORCE to emit
+    architecture decisions. The ask-style equivalent keeps the same learning
+    rule without the RNN: per decision (parameter) a categorical policy is
+    maintained as logits, updated by replaying the trial history in order
+    with REINFORCE (advantage = moving-baseline reward, reward = -value
+    since lower is better). Suggestions sample the resulting softmax, so
+    good operations are chosen more often as evidence accumulates, exactly
+    the controller's exploitation mechanism. Numeric parameters (e.g.
+    learning rate alongside the architecture) fall back to TPE-free random
+    sampling. State is recomputed from history each call: restart-safe.
+    """
+
+    def suggest(self, history, n_created, count):
+        lr = float(self.settings.get("controller_lr", "0.35"))
+        baseline_decay = float(self.settings.get("baseline_decay", "0.8"))
+        temp = float(self.settings.get("temperature", "1.0"))
+        cat_params = [
+            p for p in self.params
+            if p.type in (ParameterType.categorical, ParameterType.discrete)
+        ]
+        logits = {
+            p.name: np.zeros(len(p.feasible_space.list or [])) for p in cat_params
+        }
+        baseline: Optional[float] = None
+        for t in history:
+            if not t.finished or t.value is None:
+                continue
+            reward = -t.value
+            if baseline is None:
+                baseline = reward
+            advantage = reward - baseline
+            baseline = baseline_decay * baseline + (1 - baseline_decay) * reward
+            for p in cat_params:
+                if p.name not in t.assignments:
+                    continue
+                vals = [str(v) for v in p.feasible_space.list or []]
+                try:
+                    i = vals.index(str(t.assignments[p.name]))
+                except ValueError:
+                    continue
+                # REINFORCE: d/dlogits log softmax(i) = onehot(i) - probs.
+                probs = _softmax(logits[p.name] / temp)
+                grad = -probs
+                grad[i] += 1.0
+                logits[p.name] += lr * advantage * grad
+        rng = self._rng(n_created)
+        out = []
+        for _ in range(count):
+            asg: dict[str, ParamValue] = {}
+            for p in self.params:
+                if p.name in logits:
+                    probs = _softmax(logits[p.name] / temp)
+                    i = int(rng.choice(len(probs), p=probs))
+                    asg[p.name] = (p.feasible_space.list or [])[i]
+                else:
+                    asg[p.name] = self._random_one(rng)[p.name]
+            out.append(asg)
+        return out
+
+
+class DartsSuggester(Suggester):
+    """DARTS dispatch (the reference's NAS/DARTS service).
+
+    In the reference, the darts suggestion service emits a single trial
+    whose container runs the differentiable architecture search itself
+    (the gradient-based bilevel optimization cannot be driven from an
+    ask/tell loop). Mirrored here: each suggestion carries the search-space
+    assignments plus a distinct ``seed``; the trial template points the job
+    at the ``nas`` runtime task (kubeflow_tpu/models/nas.py), which trains
+    the supernet with architecture weights and logs the searched genotype
+    and its validation objective.
+    """
+
+    def suggest(self, history, n_created, count):
+        rng = self._rng(n_created)
+        out = []
+        for k in range(count):
+            asg = self._random_one(rng)
+            # A dedicated integer seed parameter, if declared, gets a
+            # distinct deterministic value per trial.
+            for p in self.params:
+                if p.name == "seed" and p.type == ParameterType.int_:
+                    asg["seed"] = n_created + k
+            out.append(asg)
+        return out
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    if x.size == 0:
+        return x
+    z = np.exp(x - x.max())
+    return z / z.sum()
+
+
 ALGORITHMS: dict[str, type[Suggester]] = {
     "random": RandomSuggester,
     "grid": GridSuggester,
@@ -437,6 +625,10 @@ ALGORITHMS: dict[str, type[Suggester]] = {
     "bayesopt": BayesOptSuggester,
     "cmaes": CMAESSuggester,
     "hyperband": HyperbandSuggester,
+    "anneal": AnnealSuggester,
+    "pbt": PBTSuggester,
+    "enas": ENASSuggester,
+    "darts": DartsSuggester,
 }
 
 

@@ -54,10 +54,14 @@ class HPOController:
         store,
         log_dir: Optional[str] = None,
         poll_interval: float = 1.0,
+        obs_db=None,
     ) -> None:
         self.store = store
         self.log_dir = log_dir
         self.poll = poll_interval
+        # Optional ObservationDB (K6): scrape deltas are mirrored into it so
+        # full metric history outlives the in-memory scrape cache.
+        self.obs_db = obs_db
         self._queue: asyncio.Queue[tuple[str, str, str]] = asyncio.Queue()
         self._queued: set[tuple[str, str, str]] = set()
         self._stopped = asyncio.Event()
@@ -379,8 +383,12 @@ class HPOController:
     async def _reconcile_trial(self, ns: str, name: str) -> None:
         obj = self.store.get("Trial", name, ns)
         if obj is None:
-            # Trial deleted: tear down its job (all kinds share the name).
+            # Trial deleted: tear down its job (all kinds share the name)
+            # and purge its observation history, or a later trial reusing
+            # the name would inherit a dead trial's metric points.
             self._scrape_cache.pop(f"{ns}/{name}", None)
+            if self.obs_db is not None:
+                self.obs_db.delete_observation_log(f"{ns}/{name}")
             for kind in JOB_KINDS:
                 if self.store.get(kind, name, ns) is not None:
                     self.store.delete(kind, name, ns)
@@ -472,6 +480,8 @@ class HPOController:
         _, delta, new_offset, auto_step = scrape(mc, path, names, offset, auto_step)
         if new_offset == offset:
             return
+        if self.obs_db is not None:
+            self.obs_db.report_observation_log(key, delta)
         for n in names:
             series.setdefault(n, []).extend(delta.get(n, []))
         self._scrape_cache[key] = (new_offset, series, auto_step)

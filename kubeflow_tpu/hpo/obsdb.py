@@ -1,0 +1,122 @@
+"""Observation-log database (Katib-equivalent K6: katib-db-manager).
+
+The reference runs a gRPC facade (``ReportObservationLog`` /
+``GetObservationLog``) over MySQL that the metrics-collector sidecars push
+to and the suggestion/early-stopping services read from. Here the same
+facade is a SQLite table (WAL mode -- the control plane is a single-host
+asyncio process, SURVEY.md 7.0), written by the HPO controller's scrape
+pass and readable by anything that wants full per-trial metric history
+rather than the latest/min/max digest stored on Trial.status.
+
+Schema: one row per (trial, metric, step) observation, append-only.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+import time
+from typing import Optional
+
+
+class ObservationDB:
+    """Append-only observation log, keyed by trial (``namespace/name``)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        # check_same_thread off: aiohttp handlers may hop threads; a lock
+        # serializes writes (SQLite does its own file locking anyway).
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute(
+                """CREATE TABLE IF NOT EXISTS observation_logs (
+                       trial_key TEXT NOT NULL,
+                       metric_name TEXT NOT NULL,
+                       step INTEGER NOT NULL,
+                       value REAL NOT NULL,
+                       timestamp REAL NOT NULL
+                   )"""
+            )
+            # UNIQUE so a control-plane restart (which re-scrapes worker
+            # logs from byte 0) re-reports the same points idempotently.
+            self._conn.execute(
+                "CREATE UNIQUE INDEX IF NOT EXISTS idx_obs_trial "
+                "ON observation_logs (trial_key, metric_name, step, value)"
+            )
+            self._conn.commit()
+
+    def report_observation_log(
+        self, trial_key: str, series: dict[str, list[tuple[int, float]]]
+    ) -> int:
+        """Append a batch of (step, value) points per metric; returns rows
+        offered. Duplicate (trial, metric, step, value) rows are ignored,
+        so replays after a restart don't double the history."""
+        now = time.time()
+        rows = [
+            (trial_key, name, int(step), float(value), now)
+            for name, points in series.items()
+            for step, value in points
+        ]
+        if not rows:
+            return 0
+        with self._lock:
+            self._conn.executemany(
+                "INSERT OR IGNORE INTO observation_logs VALUES (?, ?, ?, ?, ?)",
+                rows,
+            )
+            self._conn.commit()
+        return len(rows)
+
+    def get_observation_log(
+        self,
+        trial_key: str,
+        metric_name: Optional[str] = None,
+        start_step: Optional[int] = None,
+        end_step: Optional[int] = None,
+    ) -> list[dict]:
+        """Full history for a trial, optionally filtered, step-ordered."""
+        q = ("SELECT metric_name, step, value, timestamp FROM observation_logs"
+             " WHERE trial_key = ?")
+        args: list = [trial_key]
+        if metric_name is not None:
+            q += " AND metric_name = ?"
+            args.append(metric_name)
+        if start_step is not None:
+            q += " AND step >= ?"
+            args.append(int(start_step))
+        if end_step is not None:
+            q += " AND step <= ?"
+            args.append(int(end_step))
+        q += " ORDER BY step, timestamp"
+        with self._lock:
+            cur = self._conn.execute(q, args)
+            rows = cur.fetchall()
+        return [
+            {"metric_name": m, "step": s, "value": v, "timestamp": t}
+            for m, s, v, t in rows
+        ]
+
+    def delete_observation_log(self, trial_key: str) -> int:
+        """Drop a trial's history (reference: trial GC path)."""
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM observation_logs WHERE trial_key = ?", (trial_key,)
+            )
+            self._conn.commit()
+        return cur.rowcount
+
+    def trial_keys(self) -> list[str]:
+        with self._lock:
+            cur = self._conn.execute(
+                "SELECT DISTINCT trial_key FROM observation_logs ORDER BY trial_key"
+            )
+            return [r[0] for r in cur.fetchall()]
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

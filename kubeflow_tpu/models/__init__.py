@@ -21,7 +21,7 @@ def register_task(name):
 
 def get_task(name, **kw):
     # Import for registration side effects.
-    from kubeflow_tpu.models import bert, llama, mnist, vit  # noqa: F401
+    from kubeflow_tpu.models import bert, llama, mnist, nas, vit  # noqa: F401
 
     if name not in TASK_REGISTRY:
         raise KeyError(f"unknown task {name!r}; have {sorted(TASK_REGISTRY)}")

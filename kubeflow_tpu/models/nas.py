@@ -1,0 +1,186 @@
+"""Differentiable NAS supernet (DARTS) -- the in-trial half of the
+reference's NAS story (SURVEY.md 3.2 K3: Katib's darts suggestion service
+emits a trial that runs the search inside the training container).
+
+TPU-first design: the whole bilevel step -- weight gradients on the train
+batch, architecture gradients on the validation batch (first-order DARTS)
+-- is one jitted function. Mixed ops are a weighted SUM over candidate
+branches (softmax over per-layer alphas), so the supernet stays a static
+dataflow graph XLA can fuse; there is no data-dependent branch selection
+at trace time. Arch/weight partitioning uses optax.multi_transform over
+one param tree instead of two optimizers with manual bookkeeping.
+
+The searched genotype (argmax alpha per layer) is exposed per step in the
+metrics dict (``op<k>``), alongside ``val_loss`` -- the objective the HPO
+controller scrapes when the `darts` algorithm dispatches this task.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models import register_task
+from kubeflow_tpu.runtime import data as datalib
+from kubeflow_tpu.runtime.task import TrainTask, host_to_global
+
+#: Candidate operations per mixed layer, all shape-preserving.
+OPS = ("conv3", "conv5", "avgpool", "skip")
+
+
+class MixedLayer(nn.Module):
+    """Softmax-weighted sum of the candidate ops (one DARTS mixed edge)."""
+
+    channels: int
+
+    @nn.compact
+    def __call__(self, x, w):  # w: (len(OPS),) softmax weights
+        branches = [
+            nn.relu(nn.Conv(self.channels, (3, 3), padding="SAME")(x)),
+            nn.relu(nn.Conv(self.channels, (5, 5), padding="SAME")(x)),
+            nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME"),
+            x,  # skip
+        ]
+        stacked = jnp.stack(branches)  # (n_ops, B, H, W, C)
+        return jnp.einsum("o,obhwc->bhwc", w, stacked)
+
+
+class Supernet(nn.Module):
+    num_layers: int = 4
+    channels: int = 16
+    n_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        alpha = self.param(
+            "alpha", nn.initializers.zeros, (self.num_layers, len(OPS))
+        )
+        x = nn.Conv(self.channels, (3, 3), padding="SAME")(x)  # stem
+        weights = jax.nn.softmax(alpha, axis=-1)
+        for k in range(self.num_layers):
+            x = MixedLayer(self.channels)(x, weights[k])
+        x = x.mean(axis=(1, 2))  # global average pool
+        return nn.Dense(self.n_classes)(x), alpha
+
+
+def _is_alpha(path) -> bool:
+    return any(getattr(k, "key", None) == "alpha" for k in path)
+
+
+def genotype(params) -> list[str]:
+    """Searched architecture: argmax op per layer."""
+    alpha = params["params"]["alpha"]
+    return [OPS[int(i)] for i in jnp.argmax(alpha, axis=-1)]
+
+
+class DartsTask(TrainTask):
+    name = "nas"
+
+    def __init__(
+        self,
+        num_layers: int = 4,
+        channels: int = 16,
+        batch_size: int = 64,
+        lr: float = 1e-3,
+        arch_lr: float = 3e-3,
+    ) -> None:
+        self.num_layers = num_layers
+        self.batch_size = batch_size
+        self.tokens_per_step = batch_size
+        self.flops_per_token = None
+        self.lr = lr
+        self.arch_lr = arch_lr
+        self.model = Supernet(num_layers=num_layers, channels=channels)
+
+    def _tx(self, params):
+        labels = jax.tree_util.tree_map_with_path(
+            lambda path, _: "arch" if _is_alpha(path) else "weights", params
+        )
+        return optax.multi_transform(
+            {"weights": optax.adam(self.lr), "arch": optax.adam(self.arch_lr)},
+            labels,
+        )
+
+    def init_state(self, rng: jax.Array, mesh: Mesh):
+        params = self.model.init(rng, jnp.zeros((1, 28, 28, 1), jnp.float32))
+        state = train_state.TrainState.create(
+            apply_fn=self.model.apply, params=params, tx=self._tx(params)
+        )
+        return jax.device_put(state, NamedSharding(mesh, P()))
+
+    def train_step_fn(self, mesh: Mesh):
+        batch_spec = NamedSharding(mesh, P(("data", "fsdp")))
+        repl = NamedSharding(mesh, P())
+
+        def loss_fn(params, images, labels):
+            logits, alpha = self.model.apply(params, images)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            ).mean()
+            acc = (logits.argmax(-1) == labels).mean()
+            return loss, (acc, alpha)
+
+        def step(state, ti, tl, vi, vl):
+            # First-order DARTS: weight grads from the train batch, arch
+            # grads from the val batch, merged leaf-wise so one optimizer
+            # update covers both subtrees.
+            (loss, (acc, _)), g_train = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params, ti, tl)
+            (val_loss, (val_acc, alpha)), g_val = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params, vi, vl)
+            grads = jax.tree_util.tree_map_with_path(
+                lambda path, gt, gv: gv if _is_alpha(path) else gt,
+                g_train, g_val,
+            )
+            new_state = state.apply_gradients(grads=grads)
+            w = jax.nn.softmax(alpha, axis=-1)
+            entropy = -(w * jnp.log(w + 1e-9)).sum(-1).mean()
+            metrics = {
+                "loss": loss, "accuracy": acc,
+                "val_loss": val_loss, "val_accuracy": val_acc,
+                "arch_entropy": entropy,
+            }
+            ops = jnp.argmax(alpha, axis=-1)
+            for k in range(self.num_layers):
+                metrics[f"op{k}"] = ops[k].astype(jnp.float32)
+            return new_state, metrics
+
+        return jax.jit(
+            step,
+            in_shardings=(repl,) + (batch_spec,) * 4,
+            out_shardings=(repl, repl),
+            donate_argnums=(0,),
+        )
+
+    def data_iter(
+        self, num_processes: int, process_id: int, mesh: Mesh, seed: int = 0
+    ) -> Iterator[tuple[jax.Array, ...]]:
+        train_it = datalib.synthetic_images(
+            self.batch_size, num_processes=num_processes,
+            process_id=process_id, seed=seed,
+        )
+        val_it = datalib.synthetic_images(
+            self.batch_size, num_processes=num_processes,
+            process_id=process_id, seed=seed + 10_000,
+        )
+        spec = P(("data", "fsdp"))
+        for tb, vb in zip(train_it, val_it):
+            yield (
+                host_to_global(mesh, spec, tb.inputs),
+                host_to_global(mesh, spec, tb.targets),
+                host_to_global(mesh, spec, vb.inputs),
+                host_to_global(mesh, spec, vb.targets),
+            )
+
+
+@register_task("nas")
+def make_nas(**kw) -> DartsTask:
+    return DartsTask(**kw)

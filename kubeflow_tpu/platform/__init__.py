@@ -20,7 +20,17 @@ __all__ = [
     "PlatformValidationError",
     "PodDefault",
     "Profile",
+    "PlatformController",
     "apply_pod_defaults",
     "validate_pod_default",
     "validate_profile",
 ]
+
+
+def __getattr__(name):
+    # Lazy: controller pulls in asyncio machinery types.py users don't need.
+    if name == "PlatformController":
+        from kubeflow_tpu.platform.controller import PlatformController
+
+        return PlatformController
+    raise AttributeError(name)

@@ -1,0 +1,75 @@
+"""PlatformController: syncs Profile quotas into the gang scheduler.
+
+The reference's profile-controller materializes a Profile into namespace
+RBAC + ResourceQuota objects that the (external) scheduler then enforces
+(SURVEY.md 3.4 P1). Here the enforced resource is TPU chips, and the
+enforcement point is the gang scheduler's admission check, so the
+controller's whole job is: watch Profile objects, mirror their quota
+specs into ``GangScheduler.set_namespace_quota``, and kick pending gangs
+whenever a quota changes (a raised quota can make a queued gang
+admissible without any capacity being released).
+
+PodDefault needs no controller: it mutates specs at apply time
+(server/app.py h_apply), like the reference's mutating webhook.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from kubeflow_tpu.platform.types import PROFILE_KIND, Profile
+
+logger = logging.getLogger(__name__)
+
+
+class PlatformController:
+    def __init__(self, store, gang, job_controller=None) -> None:
+        self.store = store
+        self.gang = gang
+        self.job_controller = job_controller
+        self._stopped = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+
+    def sync(self) -> None:
+        """Mirror all Profiles into the scheduler's namespace quotas."""
+        desired: dict[str, tuple] = {}
+        for obj in self.store.list(PROFILE_KIND):
+            try:
+                p = Profile.from_dict(obj)
+            except ValueError:
+                logger.warning("ignoring malformed Profile %s",
+                               obj.get("metadata", {}).get("name"))
+                continue
+            desired[p.namespace_governed] = (p.spec.quota.tpu,
+                                             p.spec.quota.max_jobs)
+        current = dict(self.gang._ns_quotas)
+        if desired == current:
+            return
+        for ns in current.keys() - desired.keys():
+            self.gang.clear_namespace_quota(ns)
+        for ns, (tpu, max_jobs) in desired.items():
+            self.gang.set_namespace_quota(ns, tpu=tpu, max_jobs=max_jobs)
+        if self.job_controller is not None:
+            self.job_controller.kick_pending()
+
+    async def run(self) -> None:
+        watch_q = self.store.watch()
+        self.sync()
+        while not self._stopped.is_set():
+            get = asyncio.ensure_future(watch_q.get())
+            stop = asyncio.ensure_future(self._stopped.wait())
+            done, pending = await asyncio.wait(
+                {get, stop}, return_when=asyncio.FIRST_COMPLETED
+            )
+            for t in pending:
+                t.cancel()
+            if stop in done:
+                break
+            event = get.result()
+            if event.kind == PROFILE_KIND:
+                self.sync()
+
+    async def stop(self) -> None:
+        self._stopped.set()

@@ -32,7 +32,17 @@ from kubeflow_tpu.api.types import JobKind
 from kubeflow_tpu.api.validation import ValidationError
 from kubeflow_tpu.controller import GangScheduler, JobController, ProcessLauncher
 from kubeflow_tpu.hpo import HPOController
+from kubeflow_tpu.hpo.obsdb import ObservationDB
 from kubeflow_tpu.hpo.types import Experiment, validate_experiment
+from kubeflow_tpu.platform import (
+    PlatformValidationError,
+    PodDefault,
+    Profile,
+    apply_pod_defaults,
+    validate_pod_default,
+    validate_profile,
+)
+from kubeflow_tpu.platform.controller import PlatformController
 from kubeflow_tpu.serving.controller import Activator, ISVCController
 from kubeflow_tpu.serving.types import (
     InferenceService,
@@ -64,11 +74,17 @@ class ControlPlane:
         self.controller = JobController(
             self.store, self.launcher, self.gang, log_dir=self.log_dir
         )
-        self.hpo = HPOController(self.store, log_dir=self.log_dir)
+        self.obs_db = ObservationDB(os.path.join(state_dir, "observations.db"))
+        self.hpo = HPOController(
+            self.store, log_dir=self.log_dir, obs_db=self.obs_db
+        )
         self.isvc = ISVCController(
             self.store, self.launcher, log_dir=self.log_dir, state_dir=state_dir
         )
         self.activator = Activator(self.isvc)
+        self.platform = PlatformController(
+            self.store, self.gang, job_controller=self.controller
+        )
 
         # Worker exits fan out: serving replicas first (on_worker_exit
         # returns False for non-server workers), then training jobs. Bound
@@ -80,7 +96,7 @@ class ControlPlane:
             await self.controller._on_worker_exit(ref, code)
 
         self.launcher.set_exit_callback(dispatch_exit)
-        self.extra_controllers: list = [self.hpo, self.isvc]
+        self.extra_controllers: list = [self.hpo, self.isvc, self.platform]
         self._tasks: list[asyncio.Task] = []
         self.started_at = time.time()
 
@@ -102,6 +118,7 @@ class ControlPlane:
                 await asyncio.wait_for(t, 5)
             except (asyncio.TimeoutError, asyncio.CancelledError):
                 t.cancel()
+        self.obs_db.close()
         self.store.close()
 
     # -- HTTP app ---------------------------------------------------------
@@ -118,6 +135,7 @@ class ControlPlane:
                 web.delete("/apis/{kind}/{ns}/{name}", self.h_delete),
                 web.get("/logs/{ns}/{name}", self.h_logs),
                 web.get("/events/{ns}/{name}", self.h_events),
+                web.get("/observations/{ns}/{name}", self.h_observations),
                 web.get("/healthz", self.h_healthz),
                 web.get("/metrics", self.h_metrics),
                 # Activator: data-plane ingress for InferenceServices.
@@ -146,6 +164,9 @@ class ControlPlane:
             return web.json_response({"error": "body is not JSON"}, status=400)
 
         def parse_job(o):
+            # Mutating-webhook analog: PodDefaults first, then defaulting
+            # and validation on the mutated spec (reference's P4 ordering).
+            o = apply_pod_defaults(self.store, o)
             job = apply_defaults(TrainJob.from_dict(o))
             validate_job(job)
             return job.to_dict()
@@ -160,10 +181,22 @@ class ControlPlane:
             validate_isvc(isvc)
             return isvc.to_dict()
 
+        def parse_profile(o):
+            prof = Profile.from_dict(o)
+            validate_profile(prof)
+            return prof.to_dict()
+
+        def parse_pod_default(o):
+            pd = PodDefault.from_dict(o)
+            validate_pod_default(pd)
+            return pd.to_dict()
+
         parser = (
             parse_job if kind in JOB_KINDS
             else {"Experiment": parse_experiment,
-                  "InferenceService": parse_isvc}.get(kind)
+                  "InferenceService": parse_isvc,
+                  "Profile": parse_profile,
+                  "PodDefault": parse_pod_default}.get(kind)
         )
         if parser is not None:
             # Admission-webhook analog: parse + default + validate, then
@@ -177,7 +210,8 @@ class ControlPlane:
                         f"body kind {obj['kind']} != URL kind {kind}"
                     )
                 stored = obj_with_preserved_status(self.store, kind, parser(obj))
-            except (ValidationError, ServingValidationError, ValueError) as e:
+            except (ValidationError, ServingValidationError,
+                    PlatformValidationError, ValueError) as e:
                 return web.json_response({"error": str(e)}, status=422)
         else:
             # Unknown kinds are validated by their controllers; only
@@ -241,6 +275,26 @@ class ControlPlane:
         ]
         events.sort(key=lambda e: e.get("time", 0))
         return web.json_response({"items": events})
+
+    async def h_observations(self, req: web.Request) -> web.Response:
+        """Full metric history for a trial (K6's GetObservationLog)."""
+        key = f"{req.match_info['ns']}/{req.match_info['name']}"
+        try:
+            start_step = (int(req.query["start_step"])
+                          if "start_step" in req.query else None)
+            end_step = (int(req.query["end_step"])
+                        if "end_step" in req.query else None)
+        except ValueError:
+            return web.json_response(
+                {"error": "start_step/end_step must be integers"}, status=400
+            )
+        rows = self.obs_db.get_observation_log(
+            key,
+            metric_name=req.query.get("metric"),
+            start_step=start_step,
+            end_step=end_step,
+        )
+        return web.json_response({"trial": key, "observations": rows})
 
     async def h_healthz(self, req: web.Request) -> web.Response:
         return web.json_response({"ok": True, "uptime": time.time() - self.started_at})

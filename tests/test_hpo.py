@@ -55,7 +55,11 @@ def quad(asg):
 
 
 class TestAlgorithms:
-    @pytest.mark.parametrize("name", ["random", "sobol", "tpe", "bayesopt", "cmaes"])
+    @pytest.mark.parametrize(
+        "name",
+        ["random", "sobol", "tpe", "bayesopt", "cmaes", "anneal", "pbt",
+         "enas", "darts"],
+    )
     def test_bounds_and_types(self, name):
         spec = make_exp_spec(algorithm=name)
         s = get_suggester(spec)
@@ -149,8 +153,79 @@ class TestAlgorithms:
 
     def test_all_registered(self):
         assert set(ALGORITHMS) == {
-            "random", "grid", "sobol", "tpe", "bayesopt", "cmaes", "hyperband"
+            "random", "grid", "sobol", "tpe", "bayesopt", "cmaes", "hyperband",
+            "anneal", "pbt", "enas", "darts",
         }
+
+    @pytest.mark.parametrize("name", ["anneal", "pbt"])
+    def test_anneal_pbt_concentrate(self, name):
+        """Both exploit history: late suggestions should cluster nearer the
+        optimum than the random initial generation did."""
+        params = [{"name": "lr", "type": "double",
+                   "feasible_space": {"min": 1e-4, "max": 1.0, "log_scale": True}}]
+        spec = make_exp_spec(name, settings={"seed": "5", "population": "6"},
+                             params=params)
+        s = get_suggester(spec)
+        history = []
+        for _ in range(40):
+            (asg,) = s.suggest(history, len(history), 1)
+            history.append(TrialResult(asg, quad_lr(asg), True))
+        early = [t.value for t in history[:10]]
+        late = [t.value for t in history[-10:]]
+        assert sorted(late)[4] < sorted(early)[4]
+
+    def test_pbt_children_perturb_parents(self):
+        params = [{"name": "lr", "type": "double",
+                   "feasible_space": {"min": 0.001, "max": 1.0}}]
+        spec = make_exp_spec(
+            "pbt",
+            settings={"seed": "2", "population": "4", "resample_prob": "0.0"},
+            params=params,
+        )
+        s = get_suggester(spec)
+        history = []
+        for _ in range(4):  # init generation: random
+            (asg,) = s.suggest(history, len(history), 1)
+            history.append(TrialResult(asg, quad_lr(asg), True))
+        parents = {round(t.assignments["lr"], 12) for t in history}
+        (child,) = s.suggest(history, len(history), 1)
+        # With resample off, a child is parent*1.2 or parent/1.2 (clamped).
+        ok = any(
+            abs(child["lr"] - min(max(p * f, 0.001), 1.0)) < 1e-9
+            for p in parents for f in (1.2, 1 / 1.2)
+        )
+        assert ok, (child, parents)
+
+    def test_enas_learns_categorical_policy(self):
+        """REINFORCE policy should pick the rewarded op most of the time."""
+        params = [{"name": f"op{k}", "type": "categorical",
+                   "feasible_space": {"list": ["conv3", "conv5", "skip"]}}
+                  for k in range(3)]
+        spec = make_exp_spec("enas", settings={"seed": "1"}, params=params)
+        s = get_suggester(spec)
+        history = []
+        rng_vals = {"conv3": 0.1, "conv5": 0.9, "skip": 0.5}
+        for _ in range(60):
+            (asg,) = s.suggest(history, len(history), 1)
+            # Objective: conv3 everywhere is best (lower is better).
+            val = sum(rng_vals[asg[f"op{k}"]] for k in range(3))
+            history.append(TrialResult(asg, val, True))
+        tail = s.suggest(history, len(history), 30)
+        frac_conv3 = sum(
+            a[f"op{k}"] == "conv3" for a in tail for k in range(3)
+        ) / (30 * 3)
+        assert frac_conv3 > 0.5, frac_conv3
+
+    def test_darts_distinct_seeds(self):
+        params = [
+            {"name": "arch_lr", "type": "double",
+             "feasible_space": {"min": 1e-4, "max": 1e-1, "log_scale": True}},
+            {"name": "seed", "type": "int",
+             "feasible_space": {"min": 0, "max": 10_000}},
+        ]
+        spec = make_exp_spec("darts", params=params)
+        got = get_suggester(spec).suggest([], 0, 3)
+        assert [g["seed"] for g in got] == [0, 1, 2]
 
 
 def quad_lr(asg):

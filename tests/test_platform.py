@@ -1,0 +1,161 @@
+"""Platform glue tests: Profile quotas enforced by the gang scheduler,
+PodDefault admission mutation, and the PlatformController sync loop
+(SURVEY.md 3.4 P1/P4)."""
+
+import asyncio
+
+import pytest
+
+from kubeflow_tpu.api import TrainJob
+from kubeflow_tpu.platform import (
+    PlatformValidationError,
+    PodDefault,
+    Profile,
+    apply_pod_defaults,
+    validate_pod_default,
+    validate_profile,
+)
+from kubeflow_tpu.platform.controller import PlatformController
+from kubeflow_tpu.store import ObjectStore
+from tests.test_controller import Harness, make_job
+
+
+def profile_obj(ns, tpu=None, max_jobs=None):
+    return {
+        "kind": "Profile",
+        "metadata": {"name": ns},
+        "spec": {"quota": {"tpu": tpu, "max_jobs": max_jobs}},
+    }
+
+
+class TestTypes:
+    def test_profile_validation(self):
+        with pytest.raises(PlatformValidationError):
+            validate_profile(Profile.from_dict(profile_obj("a", tpu=-5)))
+        validate_profile(Profile.from_dict(profile_obj("a", tpu=4)))
+
+    def test_pod_default_validation(self):
+        bad = PodDefault.from_dict({
+            "kind": "PodDefault", "metadata": {"name": "d"},
+            "spec": {"env": {"A=B": "x"}},
+        })
+        with pytest.raises(PlatformValidationError):
+            validate_pod_default(bad)
+
+    def test_apply_pod_defaults_merge_order(self):
+        store = ObjectStore(":memory:")
+        store.put("PodDefault", {
+            "kind": "PodDefault", "metadata": {"name": "a", "namespace": "default"},
+            "spec": {"env": {"X": "from-a", "Y": "ya"}},
+        })
+        store.put("PodDefault", {
+            "kind": "PodDefault", "metadata": {"name": "b", "namespace": "default"},
+            "spec": {"env": {"X": "from-b", "Z": "zb"},
+                     "selector": {"team": "ml"}},
+        })
+        job = make_job().to_dict()
+        job["metadata"]["labels"] = {"team": "ml"}
+        job["spec"]["replica_specs"]["Worker"]["template"]["env"] = {"X": "explicit"}
+        out = apply_pod_defaults(store, job)
+        env = out["spec"]["replica_specs"]["Worker"]["template"]["env"]
+        # Explicit spec wins; earlier default (a) wins over later (b).
+        assert env == {"X": "explicit", "Y": "ya", "Z": "zb"}
+        assert out["metadata"]["annotations"]["platform.kftpu/pod-defaults"] == "a,b"
+        # Non-matching selector: untouched job.
+        job2 = make_job("j2").to_dict()
+        out2 = apply_pod_defaults(store, job2)
+        env2 = out2["spec"]["replica_specs"]["Worker"]["template"].get("env", {})
+        assert "Z" not in env2 and env2.get("X") == "from-a"
+        store.close()
+
+
+class TestQuotaEnforcement:
+    def test_quota_blocks_then_raised_quota_admits(self):
+        async def run():
+            async with Harness(total_chips=8) as h:
+                plat = PlatformController(h.store, h.gang, job_controller=h.ctl)
+                ptask = asyncio.create_task(plat.run())
+                h.store.put("Profile", profile_obj("default", tpu=2))
+                await h.wait(lambda: h.gang._ns_quotas.get("default") == (2, None),
+                             msg="quota synced")
+                # 4 chips wanted > quota 2: queues (capacity 8 is free).
+                h.submit(make_job("big", replicas=4, tpu=1))
+                await h.wait(lambda: "default/big" in h.gang.pending(),
+                             msg="job pending on quota")
+                assert h.gang.used_chips == 0
+                # Raise the quota: controller must kick the queue.
+                h.store.put("Profile", profile_obj("default", tpu=8))
+                await h.wait_phase("big", "Running")
+                await plat.stop()
+                await asyncio.wait_for(ptask, 2)
+
+        asyncio.run(run())
+
+    def test_over_quota_queues_until_profile_deleted(self):
+        """Even a gang larger than the whole quota queues (quotas are
+        mutable Profile state, unlike cluster capacity); deleting the
+        Profile un-sticks it."""
+
+        async def run():
+            async with Harness(total_chips=8) as h:
+                plat = PlatformController(h.store, h.gang, job_controller=h.ctl)
+                ptask = asyncio.create_task(plat.run())
+                h.store.put("Profile", profile_obj("default", tpu=1))
+                await h.wait(lambda: h.gang._ns_quotas.get("default") == (1, None),
+                             msg="quota synced")
+                h.submit(make_job("big", replicas=4, tpu=1))
+                await h.wait(lambda: "default/big" in h.gang.pending(),
+                             msg="job pending on quota")
+                h.store.delete("Profile", "default", "default")
+                await h.wait_phase("big", "Running")
+                await plat.stop()
+                await asyncio.wait_for(ptask, 2)
+
+        asyncio.run(run())
+
+    def test_quota_is_namespace_local(self):
+        async def run():
+            async with Harness(total_chips=8) as h:
+                h.gang.set_namespace_quota("default", tpu=0)
+                job = make_job("other", replicas=2, tpu=1)
+                job.metadata.namespace = "teamb"
+                h.submit(job)
+                await h.wait(
+                    lambda: (h.store.get("JAXJob", "other", "teamb") or {})
+                    .get("status", {}).get("replica_statuses", {})
+                    .get("Worker", {}).get("active", 0) == 2,
+                    msg="teamb job running despite default-ns quota",
+                )
+
+        asyncio.run(run())
+
+    def test_profile_delete_clears_quota(self):
+        store = ObjectStore(":memory:")
+        from kubeflow_tpu.controller import GangScheduler
+
+        gang = GangScheduler(total_chips=8)
+        plat = PlatformController(store, gang)
+        store.put("Profile", profile_obj("default", tpu=2))
+        plat.sync()
+        assert gang._ns_quotas == {"default": (2, None)}
+        store.delete("Profile", "default", "default")
+        plat.sync()
+        assert gang._ns_quotas == {}
+        store.close()
+
+
+class TestObsDbReplay:
+    def test_restart_replay_is_idempotent(self, tmp_path):
+        from kubeflow_tpu.hpo.obsdb import ObservationDB
+
+        path = str(tmp_path / "obs.db")
+        db = ObservationDB(path)
+        series = {"loss": [(0, 1.0), (1, 0.5)]}
+        db.report_observation_log("ns/t", series)
+        db.close()
+        # "Restarted" control plane re-scrapes from byte 0 and re-reports.
+        db2 = ObservationDB(path)
+        db2.report_observation_log("ns/t", series)
+        rows = db2.get_observation_log("ns/t")
+        assert [(r["step"], r["value"]) for r in rows] == [(0, 1.0), (1, 0.5)]
+        db2.close()
