@@ -40,7 +40,11 @@ from kubeflow_tpu.runtime.task import TrainTask, host_to_global
 
 # Logical-axis -> mesh-axis rules in flax pair form, derived from the one
 # source of truth so model and activation shardings cannot diverge.
-from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
+from kubeflow_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    spec_for,
+    with_logical_constraint,
+)
 
 LOGICAL_RULES = tuple(DEFAULT_RULES.items())
 
@@ -61,10 +65,24 @@ class LlamaConfig:
     remat: bool = True
     scan_layers: bool = True
     attention_impl: str = "auto"
+    # MoE (Mixtral-style: every layer's FFN is a router + n_experts SwiGLU
+    # experts when n_experts > 1; token-choice top-k with static capacity).
+    n_experts: int = 1
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
         return self.hidden // self.n_heads
+
+    def _mlp_params_per_layer(self, active: bool = False) -> int:
+        per_expert = 3 * self.hidden * self.intermediate
+        if self.n_experts <= 1:
+            return per_expert
+        router = self.hidden * self.n_experts
+        n = self.experts_per_token if active else self.n_experts
+        return router + n * per_expert
 
     def n_params(self) -> int:
         emb = self.vocab_size * self.hidden * 2  # in + out (untied)
@@ -73,14 +91,22 @@ class LlamaConfig:
             + 2 * self.n_kv_heads * self.head_dim  # k, v
             + self.hidden  # o
         )
-        mlp = 3 * self.hidden * self.intermediate
+        mlp = self._mlp_params_per_layer()
         norms = 2 * self.hidden * self.n_layers + self.hidden
         return emb + self.n_layers * (attn + mlp) + norms
 
+    def n_active_params(self) -> int:
+        """Params touched per token (= n_params for dense; MoE counts only
+        the top-k experts). This is the MFU-relevant count."""
+        return self.n_params() - self.n_layers * (
+            self._mlp_params_per_layer() - self._mlp_params_per_layer(active=True)
+        )
+
     def flops_per_token(self, seq_len: int) -> float:
         # Honest MFU accounting: the input embedding is a lookup, not a
-        # matmul, so its params contribute no FLOPs (the lm_head does).
-        matmul_params = self.n_params() - self.vocab_size * self.hidden
+        # matmul, so its params contribute no FLOPs (the lm_head does);
+        # MoE counts only active-expert FLOPs.
+        matmul_params = self.n_active_params() - self.vocab_size * self.hidden
         return transformer_flops_per_token(
             matmul_params, seq_len, self.n_layers, self.hidden
         )
@@ -101,6 +127,17 @@ PRESETS: dict[str, LlamaConfig] = {
     "llama-tiny": LlamaConfig(
         vocab_size=256, hidden=64, n_layers=2, n_heads=4, n_kv_heads=2,
         intermediate=128, max_seq=128, remat=False,
+    ),
+    # Tiny MoE (Mixtral-shaped) for CPU tests of expert parallelism.
+    "llama-tiny-moe": LlamaConfig(
+        vocab_size=256, hidden=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        intermediate=128, max_seq=128, remat=False,
+        n_experts=4, experts_per_token=2,
+    ),
+    # 8B-proxy geometry with 8 experts: the Mixtral-8x7B-style bench/dryrun
+    # config for expert-parallel meshes.
+    "llama3-8b-proxy-moe": LlamaConfig(
+        n_layers=8, param_dtype="bfloat16", n_experts=8, experts_per_token=2,
     ),
 }
 
@@ -240,6 +277,117 @@ class MLP(nn.Module):
         )(nn.silu(gate) * up)
 
 
+def _top_k_dispatch(gates: jax.Array, k: int, capacity: int):
+    """GShard-style token-choice top-k routing with static capacity.
+
+    gates: [G, S, E] fp32 router probabilities. Returns (dispatch, combine)
+    both [G, S, E, C]: dispatch is the 0/1 token->(expert, slot) assignment,
+    combine carries the (renormalized) top-k gate weights. Tokens past an
+    expert's capacity are dropped (their combine weight is 0) -- the static
+    shape that keeps the whole MoE block one XLA program.
+    """
+    g, s, e = gates.shape
+    dispatch = jnp.zeros((g, s, e, capacity), jnp.float32)
+    combine = jnp.zeros((g, s, e, capacity), jnp.float32)
+    masked = gates
+    expert_count = jnp.zeros((g, 1, e), jnp.float32)
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)                       # [G, S]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)      # [G, S, E]
+        gate_i = jnp.sum(gates * onehot, axis=-1)               # [G, S]
+        # Slot index of each token within its chosen expert's buffer:
+        # earlier tokens (and earlier routing passes) fill earlier slots.
+        pos_e = jnp.cumsum(onehot, axis=1) - onehot + expert_count
+        pos = jnp.sum(pos_e * onehot, axis=-1)                  # [G, S]
+        keep = (pos < capacity).astype(jnp.float32)
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                dtype=jnp.float32)              # [G, S, C]
+        d = onehot[..., None] * pos_oh[:, :, None, :] * keep[..., None, None]
+        dispatch = dispatch + d
+        combine = combine + d * gate_i[..., None, None]
+        expert_count = expert_count + jnp.sum(onehot, axis=1, keepdims=True)
+        masked = masked * (1.0 - onehot)
+    # Renormalize the surviving top-k weights per token (Mixtral-style).
+    denom = jnp.sum(combine, axis=(-2, -1), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+    return dispatch, combine
+
+
+class MoEMLP(nn.Module):
+    """Mixtral-style sparse FFN: top-k routed SwiGLU experts.
+
+    TPU-first design: token dispatch/combine are one-hot einsums with
+    static capacity (no sorts, no dynamic shapes), so GSPMD turns the
+    layout change batch-sharded -> expert-sharded into a single all-to-all
+    over the ``expert`` mesh axis. Expert weights carry an ``expert``
+    logical axis and shard over (expert, fsdp, tensor).
+
+    Returns (out, aux_loss): aux is the Switch/GShard load-balancing loss,
+    summed into the training objective by LlamaTask.
+    """
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dtype = _dt(cfg.dtype)
+        g, s, h = x.shape
+        e, k = cfg.n_experts, cfg.experts_per_token
+        capacity = max(1, int(round(s * k * cfg.capacity_factor / e)))
+
+        router_w = self.param(
+            "router",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "moe_router")
+            ),
+            (h, e),
+            _dt(cfg.param_dtype),
+        )
+        logits = jnp.einsum(
+            "gsh,he->gse", x.astype(jnp.float32), router_w.astype(jnp.float32)
+        )
+        gates = jax.nn.softmax(logits, axis=-1)
+        dispatch, combine = _top_k_dispatch(gates, k, capacity)
+
+        # Load-balancing aux loss: E * sum_e fraction_dispatched * mean_prob.
+        frac = jnp.mean(jnp.sum(dispatch, axis=-1), axis=(0, 1)) / k  # [E]
+        prob = jnp.mean(gates, axis=(0, 1))                           # [E]
+        aux = cfg.moe_aux_coef * e * jnp.sum(frac * prob)
+
+        def pexpert(name, shape, axes):
+            return self.param(
+                name,
+                nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(batch_axis=(0,)), axes
+                ),
+                shape,
+                _dt(cfg.param_dtype),
+            ).astype(dtype)
+
+        w_gate = pexpert("gate_proj", (e, h, cfg.intermediate),
+                         ("expert", "embed", "mlp"))
+        w_up = pexpert("up_proj", (e, h, cfg.intermediate),
+                       ("expert", "embed", "mlp"))
+        w_down = pexpert("down_proj", (e, cfg.intermediate, h),
+                         ("expert", "mlp", "embed"))
+
+        # Dispatch: batch-sharded tokens -> expert-sharded buffers
+        # [E, G, C, H]; GSPMD emits the all-to-all over ``expert``.
+        xin = jnp.einsum("gsec,gsh->egch", dispatch.astype(dtype), x)
+        xin = with_logical_constraint(xin, ("expert", "batch", None, "embed"))
+        gate = jnp.einsum("egch,ehi->egci", xin, w_gate)
+        up = jnp.einsum("egch,ehi->egci", xin, w_up)
+        act = nn.silu(gate) * up
+        act = with_logical_constraint(act, ("expert", "batch", None, "mlp"))
+        out_e = jnp.einsum("egci,eih->egch", act, w_down)
+        out_e = with_logical_constraint(out_e, ("expert", "batch", None, "embed"))
+        # Combine: expert-sharded results -> batch-sharded tokens (the
+        # reverse all-to-all), weighted by the top-k gate probabilities.
+        out = jnp.einsum("gsec,egch->gsh", combine.astype(dtype), out_e)
+        return out, aux
+
+
 class DecoderLayer(nn.Module):
     cfg: LlamaConfig
 
@@ -251,22 +399,25 @@ class DecoderLayer(nn.Module):
             freqs, positions,
         )
         x = x + h
-        h = MLP(cfg, name="mlp")(
-            RMSNorm(cfg.norm_eps, _dt(cfg.dtype), name="mlp_norm")(x)
-        )
-        return x + h
+        normed = RMSNorm(cfg.norm_eps, _dt(cfg.dtype), name="mlp_norm")(x)
+        if cfg.n_experts > 1:
+            h, aux = MoEMLP(cfg, name="moe")(normed)
+        else:
+            h, aux = MLP(cfg, name="mlp")(normed), jnp.float32(0.0)
+        return x + h, aux
 
 
 class _ScanLayer(nn.Module):
     """DecoderLayer wrapped for nn.scan: carry is the hidden states only;
-    freqs/positions ride as broadcast (loop-invariant) inputs."""
+    freqs/positions ride as broadcast (loop-invariant) inputs; the per-layer
+    MoE aux loss comes out as the scan's stacked y-output."""
 
     cfg: LlamaConfig
 
     @nn.compact
     def __call__(self, x, freqs, positions):
-        x = DecoderLayer(self.cfg, name="layer")(x, freqs, positions)
-        return x, None
+        x, aux = DecoderLayer(self.cfg, name="layer")(x, freqs, positions)
+        return x, aux
 
 
 class Llama(nn.Module):
@@ -291,13 +442,14 @@ class Llama(nn.Module):
         freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
 
         remat_policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        aux_total = jnp.float32(0.0)
         if cfg.scan_layers:
             layer_cls = _ScanLayer
             if cfg.remat:
                 layer_cls = nn.remat(
                     _ScanLayer, policy=remat_policy, prevent_cse=False
                 )
-            x, _ = nn.scan(
+            x, aux_stack = nn.scan(
                 layer_cls,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
@@ -305,6 +457,7 @@ class Llama(nn.Module):
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="layers")(x, freqs, positions)
+            aux_total = jnp.sum(aux_stack)
         else:
             layer_cls = DecoderLayer
             if cfg.remat:
@@ -312,7 +465,12 @@ class Llama(nn.Module):
                     DecoderLayer, policy=remat_policy, prevent_cse=False
                 )
             for i in range(cfg.n_layers):
-                x = layer_cls(cfg, name=f"layer_{i}")(x, freqs, positions)
+                x, aux = layer_cls(cfg, name=f"layer_{i}")(x, freqs, positions)
+                aux_total = aux_total + aux
+        # Surface the MoE load-balance loss without changing the return
+        # type: training asks for it via mutable=("losses",); serving
+        # doesn't, and flax silently drops unrequested sows.
+        self.sow("losses", "moe_aux", aux_total)
 
         x = RMSNorm(cfg.norm_eps, _dt(cfg.dtype), name="final_norm")(x)
         logits = nn.DenseGeneral(
@@ -378,8 +536,10 @@ class LlamaTask(TrainTask):
         weight_decay: float = 0.1,
         optimizer: str = "adamw",
         grad_clip: float = 1.0,
+        n_microbatches: Optional[int] = None,
         **overrides,
     ) -> None:
+        self.n_microbatches = n_microbatches
         cfg = PRESETS[preset]
         if overrides:
             cfg = dataclasses.replace(cfg, **overrides)
@@ -408,7 +568,10 @@ class LlamaTask(TrainTask):
 
     def _init_fn(self, rng):
         tokens = jnp.zeros((1, self.seq_len), jnp.int32)
-        params = self.model.init(rng, tokens)
+        variables = self.model.init(rng, tokens)
+        # Keep only trainable params: init also materializes the "losses"
+        # collection (MoE aux sow), which must not reach the optimizer.
+        params = {"params": variables["params"]}
         return train_state.TrainState.create(
             apply_fn=self.model.apply, params=params, tx=self.tx
         )
@@ -419,7 +582,10 @@ class LlamaTask(TrainTask):
         if getattr(self, "_sharding_cache", None) is None or (
             self._sharding_cache[0] is not mesh
         ):
-            abstract = jax.eval_shape(self._init_fn, jax.random.PRNGKey(0))
+            from kubeflow_tpu.parallel.mesh import mesh_context
+
+            with mesh_context(mesh):
+                abstract = jax.eval_shape(self._init_fn, jax.random.PRNGKey(0))
             self._sharding_cache = (mesh, state_shardings(mesh, abstract))
         return self._sharding_cache[1]
 
@@ -433,14 +599,79 @@ class LlamaTask(TrainTask):
 
     # -- step -------------------------------------------------------------
 
+    # -- pipelined apply (pipe axis > 1) ----------------------------------
+
+    def _apply_pipelined(self, params, tokens, mesh: Mesh):
+        """Forward pass with the layer stack run as a GPipe pipeline over
+        the ``pipe`` mesh axis. Embedding / final norm / lm_head are cheap
+        and run replicated across pipe ranks; only the decoder stack is
+        staged. Returns (logits, aux)."""
+        from kubeflow_tpu.parallel.pipeline import gpipe
+
+        cfg = self.cfg
+        n_stages = mesh.shape["pipe"]
+        if not cfg.scan_layers:
+            raise ValueError("pipeline parallelism requires scan_layers=True")
+        if cfg.n_layers % n_stages != 0:
+            raise ValueError(
+                f"n_layers {cfg.n_layers} not divisible by pipe={n_stages}"
+            )
+        n_micro = self.n_microbatches or n_stages
+        raw = nn.meta.unbox(params["params"])
+        dtype = _dt(cfg.dtype)
+
+        x = jnp.take(raw["embed"]["embedding"], tokens, axis=0).astype(dtype)
+        positions = jnp.arange(tokens.shape[1])[None, :]
+        freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+        layer = DecoderLayer(cfg)
+
+        def body(h, lp):
+            h, aux = layer.apply({"params": lp}, h, freqs, positions)
+            return h, aux
+
+        if cfg.remat:
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            )
+
+        def stage_fn(local_stack, h):
+            h, auxs = jax.lax.scan(body, h, local_stack)
+            return h, jnp.sum(auxs)
+
+        x, aux = gpipe(
+            stage_fn, raw["layers"]["layer"], x,
+            mesh=mesh, n_microbatches=n_micro,
+        )
+
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        x = (
+            x32 * jax.lax.rsqrt(var + cfg.norm_eps) * raw["final_norm"]["scale"]
+        ).astype(dtype)
+        logits = x @ raw["lm_head"]["kernel"].astype(dtype)
+        return logits, aux
+
     def train_step_fn(self, mesh: Mesh):
         from kubeflow_tpu.parallel.mesh import mesh_context
 
         shardings = self._shardings(mesh)
-        batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), "sequence"))
+        batch_sharding = NamedSharding(mesh, spec_for(("batch", "length")))
+
+        moe = self.cfg.n_experts > 1
+        pipelined = mesh.shape.get("pipe", 1) > 1
 
         def step(state, tokens, targets):
             def loss_fn(params):
+                if pipelined:
+                    logits, aux = self._apply_pipelined(params, tokens, mesh)
+                    return cross_entropy(logits, targets) + aux
+                if moe:
+                    logits, mut = state.apply_fn(
+                        params, tokens, mutable=("losses",)
+                    )
+                    aux = sum(mut["losses"]["moe_aux"])
+                    return cross_entropy(logits, targets) + aux
                 logits = state.apply_fn(params, tokens)
                 return cross_entropy(logits, targets)
 
@@ -472,7 +703,7 @@ class LlamaTask(TrainTask):
             self.batch_size, self.seq_len + 1, self.cfg.vocab_size,
             num_processes=num_processes, process_id=process_id, seed=seed,
         )
-        spec = P(("data", "fsdp"), "sequence")
+        spec = spec_for(("batch", "length"))
         for b in it:
             yield (
                 host_to_global(mesh, spec, b.inputs),

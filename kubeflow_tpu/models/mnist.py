@@ -58,7 +58,7 @@ class MnistTask(TrainTask):
         return jax.device_put(state, NamedSharding(mesh, P()))
 
     def train_step_fn(self, mesh: Mesh):
-        batch_spec = NamedSharding(mesh, P(("data", "fsdp")))
+        batch_spec = NamedSharding(mesh, P(("data", "fsdp", "expert")))
         repl = NamedSharding(mesh, P())
 
         def step(state, images, labels):
@@ -89,7 +89,7 @@ class MnistTask(TrainTask):
             self.batch_size, num_processes=num_processes,
             process_id=process_id, seed=seed,
         )
-        img_spec = P(("data", "fsdp"))
+        img_spec = P(("data", "fsdp", "expert"))
         for b in it:
             yield (
                 host_to_global(mesh, img_spec, b.inputs),

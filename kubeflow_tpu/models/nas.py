@@ -115,7 +115,7 @@ class DartsTask(TrainTask):
         return jax.device_put(state, NamedSharding(mesh, P()))
 
     def train_step_fn(self, mesh: Mesh):
-        batch_spec = NamedSharding(mesh, P(("data", "fsdp")))
+        batch_spec = NamedSharding(mesh, P(("data", "fsdp", "expert")))
         repl = NamedSharding(mesh, P())
 
         def loss_fn(params, images, labels):
@@ -171,7 +171,7 @@ class DartsTask(TrainTask):
             self.batch_size, num_processes=num_processes,
             process_id=process_id, seed=seed + 10_000,
         )
-        spec = P(("data", "fsdp"))
+        spec = P(("data", "fsdp", "expert"))
         for tb, vb in zip(train_it, val_it):
             yield (
                 host_to_global(mesh, spec, tb.inputs),

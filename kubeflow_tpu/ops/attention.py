@@ -104,7 +104,11 @@ def dot_product_attention(
 
 
 def _ring_shardable(q: jax.Array, k: jax.Array, mesh) -> bool:
-    batch = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+    batch = (
+        mesh.shape.get("data", 1)
+        * mesh.shape.get("fsdp", 1)
+        * mesh.shape.get("expert", 1)
+    )
     seq = mesh.shape["sequence"]
     heads = mesh.shape.get("tensor", 1)
     return (

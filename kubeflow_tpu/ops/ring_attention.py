@@ -121,7 +121,7 @@ def ring_attention_sharded(
     mesh: Mesh,
     causal: bool = True,
     axis_name: str = "sequence",
-    batch_axes=("data", "fsdp"),
+    batch_axes=("data", "fsdp", "expert"),
     head_axis: str = "tensor",
 ) -> jax.Array:
     """shard_map wrapper: global [B, S, H, D] arrays -> ring attention with

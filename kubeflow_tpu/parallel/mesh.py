@@ -1,14 +1,19 @@
 """Device mesh construction.
 
-One mesh, four axes (SURVEY.md 7.1 step 1 + 5.7):
+One mesh, six axes (SURVEY.md 7.1 step 1 + 5.7):
 
 - ``data``     -- pure data parallelism (batch split; gradients psum).
+- ``pipe``     -- pipeline parallelism (layer stack sharded into stages;
+                  activations flow stage-to-stage via ppermute --
+                  kubeflow_tpu.parallel.pipeline).
 - ``fsdp``     -- data parallelism with parameter sharding (ZeRO-3 style:
                   params/optimizer sharded, all-gathered per layer).
+- ``expert``   -- expert parallelism (MoE expert weights sharded; token
+                  dispatch all-to-all rides ICI). Also acts as a batch
+                  axis for non-expert params/activations.
+- ``sequence`` -- context parallelism for ring attention (SURVEY.md 5.7).
 - ``tensor``   -- tensor/model parallelism (megatron-style within attention
                   and MLP blocks; rides ICI's highest bandwidth).
-- ``sequence`` -- context parallelism slot for ring attention; reserved and
-                  defaulting to 1 (SURVEY.md 5.7).
 
 Multi-slice/multi-host DCN parallelism maps onto the ``data`` axis being
 outermost, which is XLA's expectation for the cheap-collective axis.
@@ -26,7 +31,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("data", "fsdp", "sequence", "tensor")
+AXES = ("data", "pipe", "fsdp", "expert", "sequence", "tensor")
 
 # Trace-time mesh handoff: ops that need an explicit mesh (shard_map ring
 # attention) read it here, so flax modules stay mesh-agnostic. Set by the
@@ -54,25 +59,29 @@ class MeshConfig:
     """Mesh axis sizes. -1 for ``data`` means "absorb remaining devices"."""
 
     data: int = -1
+    pipe: int = 1
     fsdp: int = 1
+    expert: int = 1
     sequence: int = 1
     tensor: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
-        fixed = self.fsdp * self.sequence * self.tensor
+    def resolve(self, n_devices: int) -> tuple[int, ...]:
+        fixed = self.pipe * self.fsdp * self.expert * self.sequence * self.tensor
+        rest = (self.pipe, self.fsdp, self.expert, self.sequence, self.tensor)
         if self.data == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by fsdp*sequence*tensor={fixed}"
+                    f"{n_devices} devices not divisible by "
+                    f"pipe*fsdp*expert*sequence*tensor={fixed}"
                 )
-            return (n_devices // fixed, self.fsdp, self.sequence, self.tensor)
+            return (n_devices // fixed, *rest)
         total = self.data * fixed
         if total != n_devices:
             raise ValueError(
-                f"mesh {self.data}x{self.fsdp}x{self.sequence}x{self.tensor} "
-                f"needs {total} devices, have {n_devices}"
+                f"mesh {(self.data, *rest)} needs {total} devices, "
+                f"have {n_devices}"
             )
-        return (self.data, self.fsdp, self.sequence, self.tensor)
+        return (self.data, *rest)
 
 
 def build_mesh(
@@ -81,10 +90,12 @@ def build_mesh(
 ) -> Mesh:
     """Build the global mesh over all (or the given) devices.
 
-    Axis order is (data, fsdp, sequence, tensor) outer-to-inner: ``tensor``
-    varies fastest so it lands on directly-connected neighbor chips (ICI
-    torus locality); ``data`` is outermost so multi-slice DCN traffic is
-    restricted to the gradient all-reduce.
+    Axis order is (data, pipe, fsdp, expert, sequence, tensor)
+    outer-to-inner: ``tensor`` varies fastest so it lands on
+    directly-connected neighbor chips (ICI torus locality); ``pipe`` is
+    next-outermost (stage hops are infrequent and point-to-point, so they
+    tolerate the longest links); ``data`` is outermost so multi-slice DCN
+    traffic is restricted to the gradient all-reduce.
     """
     devs = list(devices) if devices is not None else jax.devices()
     shape = config.resolve(len(devs))
@@ -97,17 +108,26 @@ def single_device_mesh() -> Mesh:
     return build_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
 
 
-def mesh_for(n_devices: int, *, fsdp: int = 1, tensor: int = 1, sequence: int = 1) -> Mesh:
+def mesh_for(
+    n_devices: int, *, fsdp: int = 1, tensor: int = 1, sequence: int = 1,
+    expert: int = 1, pipe: int = 1,
+) -> Mesh:
     return build_mesh(
-        MeshConfig(data=-1, fsdp=fsdp, sequence=sequence, tensor=tensor),
+        MeshConfig(data=-1, pipe=pipe, fsdp=fsdp, expert=expert,
+                   sequence=sequence, tensor=tensor),
         devices=jax.devices()[:n_devices],
     )
 
 
 def validate_divisibility(global_batch: int, seq_len: int, mesh: Mesh) -> None:
-    data = mesh.shape["data"] * mesh.shape["fsdp"]
+    data = (
+        mesh.shape["data"] * mesh.shape["fsdp"] * mesh.shape.get("expert", 1)
+    )
     if global_batch % data != 0:
-        raise ValueError(f"global batch {global_batch} not divisible by data*fsdp={data}")
+        raise ValueError(
+            f"global batch {global_batch} not divisible by "
+            f"data*fsdp*expert={data}"
+        )
     seq = mesh.shape["sequence"]
     if seq_len % max(seq, 1) != 0:
         raise ValueError(f"seq len {seq_len} not divisible by sequence axis {seq}")

@@ -1,0 +1,134 @@
+"""GPipe-style pipeline parallelism over the ``pipe`` mesh axis.
+
+TPU-first design (SURVEY.md 3.1 note: the reference delegates PP to user
+containers; this runtime owns it):
+
+- The layer stack, already stacked along a leading ``layers`` axis by
+  ``nn.scan``, is sharded over ``pipe`` -- contiguous blocks of layers form
+  stages, with zero re-layout cost.
+- ``shard_map`` in *partial-manual* mode: only ``pipe`` is manual, so the
+  batch/fsdp/expert/sequence/tensor shardings inside each stage remain
+  GSPMD's problem -- pipeline composes with TP/FSDP/SP/EP instead of
+  re-implementing them.
+- Microbatches flow stage-to-stage via ``lax.ppermute`` (neighbor
+  point-to-point on the ICI torus); the tick loop is a ``lax.scan``, so
+  reverse-mode autodiff mechanically yields the reverse pipeline schedule
+  (ppermute transposes to the opposite rotation).
+- The bubble is the standard GPipe (S-1)/(M+S-1) fraction: raise
+  ``n_microbatches`` to amortize.
+
+No data-dependent Python control flow; every tick runs every stage (the
+warmup/drain ticks compute on garbage and mask the result), which is what
+keeps the whole schedule one XLA program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jax.Array], tuple[jax.Array, jax.Array]],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    n_microbatches: int,
+    axis: str = "pipe",
+):
+    """Run ``x`` through an S-stage pipeline.
+
+    Args:
+      stage_fn: ``(local_params, h) -> (h, aux)`` applying one stage's
+        layers to a microbatch. ``aux`` is a scalar (e.g. MoE load-balance
+        loss) summed over valid ticks.
+      stage_params: pytree whose leaves have a leading global axis divisible
+        into S stages (the nn.scan ``layers`` axis, sharded over ``axis``).
+      x: [B, ...] global activations (batch may itself be sharded over
+        data/fsdp/expert -- those axes stay automatic).
+      mesh: the global device mesh.
+      n_microbatches: M; batch must divide by it.
+
+    Returns:
+      (y, aux_mean): y with x's shape/layout; aux averaged over microbatches.
+    """
+    n_stages = mesh.shape[axis]
+    if n_stages == 1:
+        y, aux = stage_fn(stage_params, x)
+        return y, aux
+    batch = x.shape[0]
+    if batch % n_microbatches != 0:
+        raise ValueError(
+            f"batch {batch} not divisible by n_microbatches={n_microbatches}"
+        )
+    mb = batch // n_microbatches
+    n_ticks = n_microbatches + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    dtype = x.dtype
+
+    def pipelined(params, xs):
+        # Manual only over `axis`: params arrive with the leading stage
+        # block local ([L/S, ...]); xs is replicated across pipe ranks.
+        rank = jax.lax.axis_index(axis)
+        xs = xs.astype(dtype)
+        xs = xs.reshape((n_microbatches, mb) + xs.shape[1:])
+
+        def tick(carry, t):
+            recv, outputs, aux_acc = carry
+            mb_idx = jnp.clip(t, 0, n_microbatches - 1)
+            feed = jax.lax.dynamic_index_in_dim(xs, mb_idx, 0, keepdims=False)
+            inp = jnp.where(rank == 0, feed, recv)
+            y, aux = stage_fn(params, inp)
+            # Tick t is a real microbatch for rank r iff r <= t < r + M.
+            valid = (t >= rank) & (t < rank + n_microbatches)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_microbatches - 1)
+            prev = jax.lax.dynamic_index_in_dim(
+                outputs, out_idx, 0, keepdims=False
+            )
+            store = (rank == n_stages - 1) & (t >= n_stages - 1)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(store, y, prev), out_idx, 0
+            )
+            recv = jax.lax.ppermute(y, axis, perm)
+            return (recv, outputs, aux_acc), None
+
+        outputs0 = jnp.zeros_like(xs)
+        recv0 = jnp.zeros_like(xs[0])
+        (_, outputs, aux_acc), _ = jax.lax.scan(
+            tick,
+            (recv0, outputs0, jnp.float32(0.0)),
+            jnp.arange(n_ticks),
+        )
+        # Stack per-rank results on a leading stage dim and let GSPMD move
+        # the last rank's block where it's needed (a psum here would be
+        # simpler, but XLA-CPU's AllReducePromotion pass crashes on bf16
+        # all-reduces -- observed jaxlib 0.9.0 -- and the transpose of a
+        # replicated input is exactly such a psum).
+        return outputs.astype(jnp.float32)[None], aux_acc[None]
+
+    from jax.sharding import PartitionSpec as P
+
+    # f32 across the shard_map boundary: every collective autodiff inserts
+    # for the replicated input / stacked output then rides f32, which
+    # XLA-CPU can promote safely; compute inside stays in x.dtype.
+    outputs, aux = jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=(P(axis), P(axis)),
+        axis_names=frozenset({axis}),
+        check_vma=False,
+    )(stage_params, x.astype(jnp.float32))
+    # outputs: [S, M, mb, ...] -- only the last stage's block is real.
+    y = outputs[n_stages - 1].reshape((batch,) + x.shape[1:]).astype(dtype)
+    # Stages partition the layers, so summing per-rank aux accumulators
+    # counts each layer exactly once; average over the M microbatches.
+    aux_mean = jnp.sum(aux) / n_microbatches
+    return y, aux_mean

@@ -18,19 +18,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 LogicalAxisRules = dict[str, Union[str, tuple[str, ...], None]]
 
 # Default rules for transformer training:
-# - batch over (data, fsdp): every data-parallel rank sees a batch shard.
+# - batch over (data, fsdp, expert): every data-parallel rank sees a batch
+#   shard; the expert axis doubles as a batch axis outside MoE blocks so
+#   no devices idle on dense layers.
 # - embed over fsdp: ZeRO-3-style parameter sharding.
 # - mlp/heads/kv over tensor: megatron partitioning.
 # - length over sequence: ring-attention context parallelism.
+# - expert over expert: MoE expert weights; token dispatch between the
+#   batch layout and the expert layout is XLA's all-to-all.
+# - layers over pipe: the nn.scan-stacked layer axis splits into
+#   contiguous pipeline stages (kubeflow_tpu.parallel.pipeline).
 DEFAULT_RULES: LogicalAxisRules = {
-    "batch": ("data", "fsdp"),
+    "batch": ("data", "fsdp", "expert"),
     "length": "sequence",
     "embed": "fsdp",
     "mlp": "tensor",
     "heads": "tensor",
     "kv": None,
     "vocab": "tensor",
-    "layers": None,
+    "layers": "pipe",
+    "expert": "expert",
 }
 
 
@@ -75,6 +82,12 @@ def with_logical_constraint(
 ) -> jax.Array:
     """Annotate an intermediate with a sharding constraint inside jit."""
     spec = spec_for(logical_axes, rules)
+    if mesh is None:
+        from kubeflow_tpu.parallel.mesh import active_mesh
+
+        mesh = active_mesh()
     if mesh is not None:
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
-    return jax.lax.with_sharding_constraint(x, spec)
+    # No mesh anywhere (single-device model.apply outside the runtime):
+    # constraints are advisory, so skip rather than demand a mesh context.
+    return x

@@ -29,6 +29,8 @@ def parse_args(argv=None):
     p.add_argument("--fsdp", type=int, default=1)
     p.add_argument("--tensor", type=int, default=1)
     p.add_argument("--sequence", type=int, default=1)
+    p.add_argument("--expert", type=int, default=1)
+    p.add_argument("--pipe", type=int, default=1)
     p.add_argument(
         "--arg", action="append", default=[],
         help="task kwargs, key=value (int/float autocast)", metavar="K=V",
@@ -65,7 +67,8 @@ def main(argv=None) -> int:
     task = get_task(args.model, **task_kwargs)
 
     mesh = build_mesh(
-        MeshConfig(data=-1, fsdp=args.fsdp, sequence=args.sequence, tensor=args.tensor)
+        MeshConfig(data=-1, fsdp=args.fsdp, sequence=args.sequence,
+                   tensor=args.tensor, expert=args.expert, pipe=args.pipe)
     )
     n_chips = len(jax.devices())
     logger.info(

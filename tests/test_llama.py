@@ -113,9 +113,9 @@ class TestTraining:
 
         p = state.params["params"]
         qk = unbox(p["layers"]["layer"]["attn"]["q_proj"]["kernel"])
-        # (layers, embed, heads, kv) -> (None, fsdp, tensor, None)
+        # (layers, embed, heads, kv) -> (pipe, fsdp, tensor, None)
         assert qk.sharding.spec == jax.sharding.PartitionSpec(
-            None, "fsdp", "tensor", None
+            "pipe", "fsdp", "tensor", None
         )
         emb = unbox(p["embed"]["embedding"])
         assert "fsdp" in jax.tree.leaves(emb.sharding.spec) or (

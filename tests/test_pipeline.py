@@ -1,0 +1,144 @@
+"""Pipeline parallelism (gpipe over the ``pipe`` mesh axis).
+
+The reference has no in-repo PP (SURVEY.md 3.1: delegated to user
+containers); this runtime owns it. gpipe is shard_map + ppermute in
+partial-manual mode, so it composes with the GSPMD-managed axes
+(data/fsdp/expert/sequence/tensor) instead of re-implementing them.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import get_task
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+from kubeflow_tpu.parallel.pipeline import gpipe
+
+
+def _mlp_stack(L=8, D=16, seed=0):
+    ws = jax.random.normal(jax.random.PRNGKey(seed), (L, D, D)) * 0.3
+
+    def stage_fn(local_ws, h):
+        def body(h, w):
+            return jnp.tanh(h @ w), jnp.sum(h ** 2)
+
+        h, auxs = jax.lax.scan(body, h, local_ws)
+        return h, jnp.sum(auxs)
+
+    return ws, stage_fn
+
+
+class TestGPipe:
+    def test_forward_matches_sequential(self):
+        mesh = build_mesh(MeshConfig(data=-1, pipe=4))
+        ws, stage_fn = _mlp_stack()
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+        y_ref, aux_ref = jax.jit(stage_fn)(ws, x)
+        with mesh:
+            y, aux = jax.jit(
+                lambda w, x: gpipe(stage_fn, w, x, mesh=mesh, n_microbatches=4)
+            )(ws, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+        # Pipelined aux averages per-microbatch sums (M=4 microbatches).
+        assert abs(float(aux) * 4 - float(aux_ref)) < 1e-2
+
+    def test_backward_matches_sequential(self):
+        mesh = build_mesh(MeshConfig(data=-1, pipe=4))
+        ws, stage_fn = _mlp_stack()
+        x = jax.random.normal(jax.random.PRNGKey(2), (8, 16))
+
+        def loss_ref(w):
+            y, _ = stage_fn(w, x)
+            return jnp.sum(y ** 2)
+
+        def loss_pp(w):
+            y, _ = gpipe(stage_fn, w, x, mesh=mesh, n_microbatches=4)
+            return jnp.sum(y ** 2)
+
+        g_ref = jax.jit(jax.grad(loss_ref))(ws)
+        with mesh:
+            g_pp = jax.jit(jax.grad(loss_pp))(ws)
+        np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_ref), atol=1e-4)
+
+    def test_single_stage_passthrough(self):
+        mesh = build_mesh(MeshConfig(data=-1))
+        ws, stage_fn = _mlp_stack()
+        x = jax.random.normal(jax.random.PRNGKey(3), (4, 16))
+        y_ref, _ = stage_fn(ws, x)
+        with mesh:
+            y, _ = gpipe(stage_fn, ws, x, mesh=mesh, n_microbatches=2)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-6)
+
+    def test_rejects_indivisible_microbatch(self):
+        mesh = build_mesh(MeshConfig(data=-1, pipe=4))
+        ws, stage_fn = _mlp_stack()
+        x = jnp.zeros((6, 16))
+        with pytest.raises(ValueError, match="not divisible"):
+            with mesh:
+                gpipe(stage_fn, ws, x, mesh=mesh, n_microbatches=4)
+
+
+class TestPipelinedLlama:
+    def _one_step(self, conf, preset="llama-tiny", **kw):
+        task = get_task(
+            "llama", preset=preset, batch_size=8, seq_len=32, lr=1e-3,
+            n_layers=4, **kw,
+        )
+        mesh = build_mesh(conf)
+        with mesh:
+            state = task.init_state(jax.random.PRNGKey(0), mesh)
+            step = task.train_step_fn(mesh)
+            it = task.data_iter(1, 0, mesh)
+            state, m = step(state, *next(it))
+            state, m2 = step(state, *next(it))
+        return float(m["loss"]), float(m2["loss"])
+
+    def test_pipe_matches_plain(self):
+        ref = self._one_step(MeshConfig(data=-1))
+        pp = self._one_step(MeshConfig(data=-1, pipe=4))
+        assert abs(pp[0] - ref[0]) < 0.02, (pp, ref)
+        assert abs(pp[1] - ref[1]) < 0.05, (pp, ref)
+
+    def test_pipe_composes_with_tensor(self):
+        ref = self._one_step(MeshConfig(data=-1))
+        pp = self._one_step(MeshConfig(data=-1, pipe=2, tensor=2))
+        assert abs(pp[0] - ref[0]) < 0.02, (pp, ref)
+
+    def test_pipe_composes_with_moe(self):
+        ref = self._one_step(MeshConfig(data=-1), preset="llama-tiny-moe")
+        pp = self._one_step(
+            MeshConfig(data=-1, pipe=2, expert=2, tensor=2),
+            preset="llama-tiny-moe",
+        )
+        # MoE aux is averaged per-microbatch under PP; allow slack.
+        assert abs(pp[0] - ref[0]) < 0.05, (pp, ref)
+
+    def test_pipe_training_decreases_loss(self):
+        task = get_task(
+            "llama", preset="llama-tiny", batch_size=8, seq_len=32,
+            lr=3e-3, n_layers=4,
+        )
+        mesh = build_mesh(MeshConfig(data=-1, pipe=2, tensor=2))
+        with mesh:
+            state = task.init_state(jax.random.PRNGKey(0), mesh)
+            step = task.train_step_fn(mesh)
+            it = task.data_iter(1, 0, mesh)
+            losses = []
+            for _ in range(40):
+                state, m = step(state, *next(it))
+                losses.append(float(m["loss"]))
+        assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.3, losses[::8]
+
+    def test_rejects_indivisible_layers(self):
+        task = get_task(
+            "llama", preset="llama-tiny", batch_size=8, seq_len=32,
+            n_layers=2,
+        )
+        mesh = build_mesh(MeshConfig(data=-1, pipe=4))
+        with pytest.raises(ValueError, match="divisible"):
+            with mesh:
+                state = task.init_state(jax.random.PRNGKey(0), mesh)
+                step = task.train_step_fn(mesh)
+                it = task.data_iter(1, 0, mesh)
+                step(state, *next(it))

@@ -16,7 +16,10 @@ from kubeflow_tpu.runtime.metrics import MetricLogger, parse_metric_line
 class TestMesh:
     def test_resolve_absorbs_data(self):
         mesh = build_mesh(MeshConfig(data=-1, fsdp=2, tensor=2))
-        assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "sequence": 1, "tensor": 2}
+        assert dict(mesh.shape) == {
+            "data": 2, "pipe": 1, "fsdp": 2, "expert": 1,
+            "sequence": 1, "tensor": 2,
+        }
 
     def test_bad_divisibility(self):
         with pytest.raises(ValueError, match="not divisible"):
@@ -28,15 +31,21 @@ class TestMesh:
 
     def test_axis_order(self):
         mesh = build_mesh(MeshConfig())
-        assert mesh.axis_names == ("data", "fsdp", "sequence", "tensor")
+        assert mesh.axis_names == (
+            "data", "pipe", "fsdp", "expert", "sequence", "tensor"
+        )
 
 
 class TestShardingRules:
     def test_default_rules(self):
         # batch consumes fsdp, so a later embed (also fsdp) must replicate:
         # a mesh axis may appear at most once per spec.
-        assert spec_for(("batch", "length", "embed")) == P(("data", "fsdp"), "sequence", None)
-        assert spec_for(("batch", None, "heads", "kv")) == P(("data", "fsdp"), None, "tensor", None)
+        assert spec_for(("batch", "length", "embed")) == P(
+            ("data", "fsdp", "expert"), "sequence", None
+        )
+        assert spec_for(("batch", None, "heads", "kv")) == P(
+            ("data", "fsdp", "expert"), None, "tensor", None
+        )
         # Without batch in the spec, embed shards over fsdp (parameters).
         assert spec_for(("embed", "mlp")) == P("fsdp", "tensor")
 
