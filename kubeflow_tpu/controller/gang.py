@@ -160,11 +160,20 @@ class GangScheduler:
         # (priority, then FIFO): without this, small jobs backfill forever
         # and big slices starve.
         mine = self._pending.get(key)
+        # A quota-blocked pending gang from ANOTHER namespace is skipped,
+        # not a barrier (mirror of admissible()): a namespace waiting on
+        # its own quota must not export that limit to other tenants' FIFO
+        # position. Within the same namespace it stays a barrier, or later
+        # small jobs would keep the quota consumed and starve it forever.
         blocked = any(
             (p.sort_key < mine.sort_key if mine is not None
              else p.priority >= sched.priority)
             for p in self._pending.values()
             if p.job_key != key
+            and (
+                p.job_key.split("/", 1)[0] == ns
+                or self._quota_allows(p.job_key.split("/", 1)[0], p.chips)
+            )
         )
         if not blocked and self._fits(chips, processes) \
                 and self._quota_allows(ns, chips):

@@ -521,7 +521,9 @@ class JobController:
     def kick_pending(self, exclude: str = "") -> None:
         """Re-enqueue every gang that might now be admissible (called on
         capacity release and on namespace-quota changes)."""
-        candidates = list(self.gang.admissible()) + list(self.gang.pending())
+        # pending() is a superset of admissible(); reconcile re-runs the
+        # real admission check per candidate, so enqueue the whole queue.
+        candidates = list(self.gang.pending())
         candidates += [
             r.key for r in self._runtimes.values()
             if r.formed_replicas is not None and r.key != exclude

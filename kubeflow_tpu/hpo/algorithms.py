@@ -512,6 +512,12 @@ class PBTSuggester(Suggester):
                 fs = p.feasible_space
                 x = float(parent[p.name]) * factor
                 x = min(max(x, float(fs.min)), float(fs.max))
+                if fs.step:
+                    # Snap to the declared grid like _from_unit does;
+                    # perturbation must not emit off-grid values.
+                    lo = float(fs.min)
+                    x = lo + round((x - lo) / fs.step) * fs.step
+                    x = min(max(x, lo), float(fs.max))
                 asg[p.name] = (
                     int(round(x)) if p.type == ParameterType.int_ else x
                 )

@@ -74,6 +74,19 @@ class HPOController:
 
     async def run(self) -> None:
         watch_q = self.store.watch()
+        # Startup sweep: purge observation rows whose trial no longer
+        # exists. Deletions that happened while the control plane was down
+        # produced no watch event, and trial names are deterministic
+        # ("{exp}-t{index}"), so a later same-named trial would otherwise
+        # inherit a dead trial's metric history.
+        if self.obs_db is not None:
+            live = {
+                f"{t['metadata']['namespace']}/{t['metadata']['name']}"
+                for t in self.store.list("Trial")
+            }
+            for key in self.obs_db.trial_keys():
+                if key not in live:
+                    self.obs_db.delete_observation_log(key)
         for kind in ("Experiment", "Trial"):
             for obj in self.store.list(kind):
                 self._enqueue(kind, obj["metadata"]["namespace"], obj["metadata"]["name"])

@@ -72,6 +72,13 @@ class LlamaConfig:
     capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
 
+    def __post_init__(self):
+        if self.n_experts > 1 and self.experts_per_token > self.n_experts:
+            raise ValueError(
+                f"experts_per_token={self.experts_per_token} exceeds "
+                f"n_experts={self.n_experts}"
+            )
+
     @property
     def head_dim(self) -> int:
         return self.hidden // self.n_heads
@@ -644,11 +651,9 @@ class LlamaTask(TrainTask):
             mesh=mesh, n_microbatches=n_micro,
         )
 
-        x32 = x.astype(jnp.float32)
-        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
-        x = (
-            x32 * jax.lax.rsqrt(var + cfg.norm_eps) * raw["final_norm"]["scale"]
-        ).astype(dtype)
+        x = RMSNorm(cfg.norm_eps, dtype).apply(
+            {"params": raw["final_norm"]}, x
+        )
         logits = x @ raw["lm_head"]["kernel"].astype(dtype)
         return logits, aux
 

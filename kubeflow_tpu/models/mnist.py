@@ -18,6 +18,7 @@ from flax.training import train_state
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.models import register_task
+from kubeflow_tpu.parallel.sharding import spec_for
 from kubeflow_tpu.runtime import data as datalib
 from kubeflow_tpu.runtime.task import TrainTask, host_to_global
 
@@ -58,7 +59,7 @@ class MnistTask(TrainTask):
         return jax.device_put(state, NamedSharding(mesh, P()))
 
     def train_step_fn(self, mesh: Mesh):
-        batch_spec = NamedSharding(mesh, P(("data", "fsdp", "expert")))
+        batch_spec = NamedSharding(mesh, spec_for(("batch",)))
         repl = NamedSharding(mesh, P())
 
         def step(state, images, labels):
@@ -89,7 +90,7 @@ class MnistTask(TrainTask):
             self.batch_size, num_processes=num_processes,
             process_id=process_id, seed=seed,
         )
-        img_spec = P(("data", "fsdp", "expert"))
+        img_spec = spec_for(("batch",))
         for b in it:
             yield (
                 host_to_global(mesh, img_spec, b.inputs),

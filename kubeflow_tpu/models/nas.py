@@ -27,6 +27,7 @@ from flax.training import train_state
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.models import register_task
+from kubeflow_tpu.parallel.sharding import spec_for
 from kubeflow_tpu.runtime import data as datalib
 from kubeflow_tpu.runtime.task import TrainTask, host_to_global
 
@@ -115,7 +116,7 @@ class DartsTask(TrainTask):
         return jax.device_put(state, NamedSharding(mesh, P()))
 
     def train_step_fn(self, mesh: Mesh):
-        batch_spec = NamedSharding(mesh, P(("data", "fsdp", "expert")))
+        batch_spec = NamedSharding(mesh, spec_for(("batch",)))
         repl = NamedSharding(mesh, P())
 
         def loss_fn(params, images, labels):
@@ -171,7 +172,7 @@ class DartsTask(TrainTask):
             self.batch_size, num_processes=num_processes,
             process_id=process_id, seed=seed + 10_000,
         )
-        spec = P(("data", "fsdp", "expert"))
+        spec = spec_for(("batch",))
         for tb, vb in zip(train_it, val_it):
             yield (
                 host_to_global(mesh, spec, tb.inputs),

@@ -84,6 +84,7 @@ def dot_product_attention(
             and mesh.shape["sequence"] > 1
             and segment_ids is None
             and _ring_shardable(q, k, mesh)
+            and not _inside_manual_region()
         )
         if impl == "ring" or seq_parallel:
             if not seq_parallel:
@@ -103,12 +104,24 @@ def dot_product_attention(
     return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
 
 
+def _inside_manual_region() -> bool:
+    """True when tracing inside a shard_map manual region (e.g. the gpipe
+    pipeline body). The ring's own full-mesh shard_map cannot nest there --
+    the context mesh has Manual axis types -- so auto dispatch falls back
+    to GSPMD attention (correct; K/V all-gathered within the stage)."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+    except AttributeError:  # older jax
+        return False
+    return any("Manual" in str(t) for t in getattr(mesh, "axis_types", ()))
+
+
 def _ring_shardable(q: jax.Array, k: jax.Array, mesh) -> bool:
-    batch = (
-        mesh.shape.get("data", 1)
-        * mesh.shape.get("fsdp", 1)
-        * mesh.shape.get("expert", 1)
-    )
+    from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
+
+    batch = 1
+    for ax in DEFAULT_RULES["batch"]:
+        batch *= mesh.shape.get(ax, 1)
     seq = mesh.shape["sequence"]
     heads = mesh.shape.get("tensor", 1)
     return (

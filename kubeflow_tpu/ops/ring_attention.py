@@ -121,14 +121,19 @@ def ring_attention_sharded(
     mesh: Mesh,
     causal: bool = True,
     axis_name: str = "sequence",
-    batch_axes=("data", "fsdp", "expert"),
+    batch_axes=None,
     head_axis: str = "tensor",
 ) -> jax.Array:
     """shard_map wrapper: global [B, S, H, D] arrays -> ring attention with
     S sharded over ``axis_name``, heads over ``head_axis``, batch over
-    ``batch_axes``. Falls through to the per-shard body with n=1 when the
-    sequence axis is trivial."""
+    ``batch_axes`` (default: the rules table's batch axes, so the ring's
+    layout always agrees with DEFAULT_RULES). Falls through to the
+    per-shard body with n=1 when the sequence axis is trivial."""
 
+    if batch_axes is None:
+        from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
+
+        batch_axes = DEFAULT_RULES["batch"]
     qspec = P(batch_axes, axis_name, head_axis, None)
     fn = partial(ring_attention, axis_name=axis_name, causal=causal)
     return jax.shard_map(

@@ -109,6 +109,11 @@ def pack_weights(params: dict, cfg: LlamaConfig) -> dict:
     p = params["params"] if "params" in params else params
     if "layers" not in p:
         raise ValueError("engine requires scan_layers=True checkpoints")
+    if cfg.n_experts > 1 or "moe" in p["layers"]["layer"]:
+        raise ValueError(
+            "engine does not support MoE checkpoints yet (decode path "
+            "assumes a dense per-layer mlp subtree)"
+        )
     dt = jnp.dtype(cfg.dtype)
     return {
         "embed": _cast(p["embed"]["embedding"], dt),           # [V, H]

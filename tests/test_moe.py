@@ -94,6 +94,12 @@ class TestMoELayer:
         # Combine weights renormalize to 1 per surviving token.
         np.testing.assert_allclose(c.sum(axis=(2, 3)), 1.0, atol=1e-5)
 
+    def test_rejects_more_selected_than_experts(self):
+        with pytest.raises(ValueError, match="exceeds"):
+            dataclasses.replace(
+                PRESETS["llama-tiny-moe"], experts_per_token=8, n_experts=2
+            )
+
     def test_param_and_flops_accounting(self):
         moe = PRESETS["llama-tiny-moe"]
         dense = PRESETS["llama-tiny"]

@@ -105,6 +105,14 @@ class TestPipelinedLlama:
         pp = self._one_step(MeshConfig(data=-1, pipe=2, tensor=2))
         assert abs(pp[0] - ref[0]) < 0.02, (pp, ref)
 
+    def test_pipe_composes_with_sequence(self):
+        """Ring attention's own shard_map cannot nest inside the manual
+        pipe region; auto dispatch must fall back to GSPMD attention
+        instead of crashing."""
+        ref = self._one_step(MeshConfig(data=-1))
+        pp = self._one_step(MeshConfig(data=-1, pipe=2, sequence=2))
+        assert abs(pp[0] - ref[0]) < 0.02, (pp, ref)
+
     def test_pipe_composes_with_moe(self):
         ref = self._one_step(MeshConfig(data=-1), preset="llama-tiny-moe")
         pp = self._one_step(

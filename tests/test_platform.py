@@ -129,6 +129,47 @@ class TestQuotaEnforcement:
 
         asyncio.run(run())
 
+    def test_quota_blocked_gang_is_not_fifo_barrier(self):
+        """A gang stuck on its own namespace quota must not block later
+        gangs from other namespaces (it is skipped, like admissible())."""
+        from kubeflow_tpu.controller import GangScheduler
+
+        gang = GangScheduler(total_chips=8)
+        gang.set_namespace_quota("teama", tpu=1)
+        big = make_job("big", replicas=4, tpu=1)
+        big.metadata.namespace = "teama"
+        # Queues: demand 4 > teama quota 1 (but fits the cluster).
+        assert gang.try_admit(big) is None
+        small = make_job("small", replicas=2, tpu=1)
+        small.metadata.namespace = "teamb"
+        res = gang.try_admit(small)
+        assert res is not None, "teamb gang starved behind quota-blocked teama gang"
+        # Raising the quota un-sticks the queued gang.
+        gang.set_namespace_quota("teama", tpu=8)
+        assert gang.try_admit(big) is not None
+
+    def test_quota_blocked_gang_still_bars_own_namespace(self):
+        """Within its own namespace a quota-blocked gang keeps its FIFO
+        position: later small same-ns jobs must not leapfrog it and keep
+        the quota consumed forever."""
+        from kubeflow_tpu.controller import GangScheduler
+
+        gang = GangScheduler(total_chips=8)
+        gang.set_namespace_quota("teama", tpu=4)
+        running = make_job("running", replicas=2, tpu=1)
+        running.metadata.namespace = "teama"
+        assert gang.try_admit(running) is not None  # usage 2/4
+        big = make_job("big", replicas=4, tpu=1)
+        big.metadata.namespace = "teama"
+        assert gang.try_admit(big) is None  # 2+4 > 4: queued
+        late = make_job("late", replicas=2, tpu=1)
+        late.metadata.namespace = "teama"
+        # Would fit quota (2+2 <= 4) but must not jump past big.
+        assert gang.try_admit(late) is None
+        # Once the running job frees quota, FIFO head goes first.
+        gang.release("teama/running")
+        assert gang.try_admit(big) is not None
+
     def test_profile_delete_clears_quota(self):
         store = ObjectStore(":memory:")
         from kubeflow_tpu.controller import GangScheduler
