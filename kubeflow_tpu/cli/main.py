@@ -40,6 +40,7 @@ KIND_ALIASES = {
     "trial": "Trial", "trials": "Trial",
     "inferenceservice": "InferenceService", "inferenceservices": "InferenceService",
     "isvc": "InferenceService",
+    "pipeline": "Pipeline", "pipelines": "Pipeline", "pl": "Pipeline",
     "event": "Event", "events": "Event",
 }
 

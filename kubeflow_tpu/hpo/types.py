@@ -298,24 +298,15 @@ def render_template(template: dict[str, Any], assignments: dict[str, ParamValue]
     All substitution is textual (``str(value)``), exactly the reference's
     template-engine contract: placeholders belong in string-typed fields
     (args, env); the rendered job is then re-validated so a placeholder
-    smuggled into a numeric field fails that trial loudly.
+    smuggled into a numeric field fails that trial loudly. One shared
+    walker (utils.templating) serves this and pipeline-step rendering.
     """
-    def subst(v: Any) -> Any:
-        if isinstance(v, str):
-            for name, val in assignments.items():
-                ph = "${trialParameters." + name + "}"
-                if v == ph:
-                    return str(val)
-                if ph in v:
-                    v = v.replace(ph, str(val))
-            return v
-        if isinstance(v, dict):
-            return {k: subst(x) for k, x in v.items()}
-        if isinstance(v, list):
-            return [subst(x) for x in v]
-        return v
+    from kubeflow_tpu.utils.templating import substitute
 
-    return subst(template)
+    return substitute(
+        template,
+        {"${trialParameters." + n + "}": v for n, v in assignments.items()},
+    )
 
 
 def validate_experiment(exp: Experiment) -> None:

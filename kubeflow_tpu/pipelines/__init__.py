@@ -1,0 +1,25 @@
+"""Pipelines pillar: DAG engine + kfp-style SDK (SURVEY.md 3.4 P9)."""
+
+from kubeflow_tpu.pipelines.controller import PipelineController
+from kubeflow_tpu.pipelines.types import (
+    Pipeline,
+    PipelineSpec,
+    PipelineStatus,
+    PipelineStep,
+    PipelineValidationError,
+    render_step_template,
+    toposort,
+    validate_pipeline,
+)
+
+__all__ = [
+    "Pipeline",
+    "PipelineController",
+    "PipelineSpec",
+    "PipelineStatus",
+    "PipelineStep",
+    "PipelineValidationError",
+    "render_step_template",
+    "toposort",
+    "validate_pipeline",
+]

@@ -1,0 +1,286 @@
+"""Pipeline controller (Kubeflow Pipelines / Argo equivalent, SURVEY.md 3.4
+P9).
+
+Reconciles Pipeline objects into a DAG run: a step whose dependencies have
+all Succeeded gets its job template rendered (pipeline parameters +
+upstream step outputs) and created as a TrainJob of any kind, delegating
+execution to the JobController exactly as HPO trials do (call stack 4.4).
+Step outputs are files: every step job gets ``KFTPU_STEP_OUTPUT`` pointing
+into the pipeline's artifact directory; whatever the step writes there is
+captured into ``status.step_outputs`` and substituted into downstream
+templates via ``${steps.<name>.output}``.
+
+Failure semantics match Argo's DAG mode: a failed step fails the pipeline;
+steps whose dependencies cannot succeed any more are marked Skipped.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Optional
+
+from kubeflow_tpu.api.types import JobKind, phase_of_obj
+from kubeflow_tpu.pipelines.types import (
+    Pipeline,
+    render_step_template,
+    toposort,
+    validate_pipeline,
+)
+
+logger = logging.getLogger(__name__)
+
+JOB_KINDS = {k.value for k in JobKind}
+PIPELINE_LABEL = "pipelines.kftpu/pipeline"
+STEP_LABEL = "pipelines.kftpu/step"
+
+_TERMINAL = ("Succeeded", "Failed")
+
+
+class PipelineController:
+    def __init__(
+        self,
+        store,
+        artifacts_dir: Optional[str] = None,
+        max_output_bytes: int = 64 * 1024,
+    ) -> None:
+        self.store = store
+        self.artifacts_dir = artifacts_dir or os.path.join(
+            os.path.expanduser("~/.kftpu"), "artifacts"
+        )
+        self.max_output_bytes = max_output_bytes
+        self._queue: asyncio.Queue[tuple[str, str]] = asyncio.Queue()
+        self._queued: set[tuple[str, str]] = set()
+        self._stopped = asyncio.Event()
+
+    # -- loop (same shape as the other controllers) ------------------------
+
+    async def run(self) -> None:
+        watch_q = self.store.watch()
+        for obj in self.store.list("Pipeline"):
+            self._enqueue(obj["metadata"]["namespace"], obj["metadata"]["name"])
+        watcher = asyncio.create_task(self._pump_watch(watch_q))
+        try:
+            while not self._stopped.is_set():
+                get = asyncio.create_task(self._queue.get())
+                stop = asyncio.create_task(self._stopped.wait())
+                done, pending = await asyncio.wait(
+                    {get, stop}, return_when=asyncio.FIRST_COMPLETED
+                )
+                for t in pending:
+                    t.cancel()
+                if get in done:
+                    item = get.result()
+                    self._queued.discard(item)
+                    ns, name = item
+                    try:
+                        await self._reconcile(ns, name)
+                    except Exception:
+                        logger.exception(
+                            "pipeline reconcile %s/%s failed", ns, name
+                        )
+                        self._enqueue_later(2.0, ns, name)
+        finally:
+            watcher.cancel()
+            self.store.unwatch(watch_q)
+
+    async def stop(self) -> None:
+        self._stopped.set()
+
+    async def _pump_watch(self, q: asyncio.Queue) -> None:
+        while True:
+            ev = await q.get()
+            if ev.kind == "Pipeline":
+                self._enqueue(ev.namespace, ev.name)
+            elif ev.kind in JOB_KINDS and ev.obj:
+                labels = ev.obj.get("metadata", {}).get("labels", {})
+                pl = labels.get(PIPELINE_LABEL)
+                if pl:
+                    self._enqueue(ev.namespace, pl)
+
+    def _enqueue(self, ns: str, name: str) -> None:
+        item = (ns, name)
+        if item not in self._queued:
+            self._queued.add(item)
+            self._queue.put_nowait(item)
+
+    def _enqueue_later(self, delay: float, ns: str, name: str) -> None:
+        asyncio.get_running_loop().call_later(delay, self._enqueue, ns, name)
+
+    # -- reconcile ---------------------------------------------------------
+
+    def _job_name(self, pipeline: str, step: str) -> str:
+        return f"{pipeline}-{step}"
+
+    def _output_path(self, ns: str, pipeline: str, step: str) -> str:
+        return os.path.join(self.artifacts_dir, ns, pipeline, f"{step}.out")
+
+    async def _reconcile(self, ns: str, name: str) -> None:
+        obj = self.store.get("Pipeline", name, ns)
+        if obj is None:
+            # Pipeline deleted: tear down child jobs.
+            for kind in JOB_KINDS:
+                for j in self.store.list(kind, ns):
+                    labels = j.get("metadata", {}).get("labels", {})
+                    if labels.get(PIPELINE_LABEL) == name:
+                        self.store.delete(kind, j["metadata"]["name"], ns)
+            return
+        pl = Pipeline.from_dict(obj)
+        status_before = pl.status.model_dump(mode="json")
+        if pl.status.finished:
+            return
+        try:
+            validate_pipeline(pl)
+            order = toposort(pl.spec.steps)
+        except ValueError as e:
+            pl.status.set_condition("Failed", "InvalidPipeline", str(e))
+            pl.status.completion_time = time.time()
+            self._persist(pl, status_before)
+            return
+        if pl.status.start_time is None:
+            pl.status.start_time = time.time()
+            pl.status.set_condition("Created", "PipelineCreated")
+
+        by_name = {s.name: s for s in pl.spec.steps}
+        # Drop phases for steps no longer in the spec (re-apply with
+        # renamed/removed steps): stale entries must not gate the verdict.
+        phases = {
+            k: v for k, v in pl.status.step_phases.items() if k in by_name
+        }
+        for step in order:
+            phases.setdefault(step, "Pending")
+
+        running = sum(1 for p in phases.values() if p == "Running")
+        for step in order:
+            phase = phases[step]
+            if phase in ("Succeeded", "Failed", "Skipped"):
+                continue
+            deps = by_name[step].dependencies
+            if any(phases.get(d) in ("Failed", "Skipped") for d in deps):
+                phases[step] = "Skipped"
+                continue
+            job_name = self._job_name(name, step)
+            job = self._get_child_job(ns, job_name)
+            if job is not None and (
+                job.get("metadata", {}).get("labels", {}).get(PIPELINE_LABEL)
+                != name
+                or job["metadata"]["labels"].get(STEP_LABEL) != step
+            ):
+                # A same-named object that this pipeline did not create
+                # (user job, or another pipeline whose name+step composes
+                # to the same string): fail the step rather than adopt --
+                # or worse, overwrite -- someone else's job.
+                phases[step] = "Failed"
+                pl.status.set_condition(
+                    "Running", "JobNameConflict",
+                    f"step {step!r}: {job.get('kind')}/{job_name} already "
+                    "exists and is not owned by this pipeline",
+                )
+                continue
+            if job is None:
+                if any(phases.get(d) != "Succeeded" for d in deps):
+                    continue  # waiting on dependencies
+                limit = pl.spec.max_parallel_steps
+                if limit and running >= limit:
+                    continue
+                created = self._create_step_job(pl, step, job_name)
+                if created:
+                    phases[step] = "Running"
+                    running += 1
+                else:
+                    phases[step] = "Failed"
+                continue
+            jphase = phase_of_obj(job)
+            if jphase == "Succeeded":
+                phases[step] = "Succeeded"
+                self._capture_output(pl, step)
+                running = max(0, running - (1 if phase == "Running" else 0))
+            elif jphase == "Failed":
+                phases[step] = "Failed"
+                running = max(0, running - (1 if phase == "Running" else 0))
+            else:
+                phases[step] = "Running"
+
+        pl.status.step_phases = phases
+        if any(p == "Failed" for p in phases.values()):
+            # Let in-flight steps finish before declaring the verdict.
+            if not any(p in ("Running", "Pending") for p in phases.values()):
+                failed = sorted(k for k, v in phases.items() if v == "Failed")
+                pl.status.set_condition(
+                    "Failed", "StepFailed", f"failed steps: {failed}"
+                )
+                pl.status.completion_time = time.time()
+            else:
+                pl.status.set_condition("Running", "StepsRunning")
+        elif all(p == "Succeeded" for p in phases.values()):
+            pl.status.set_condition("Succeeded", "AllStepsSucceeded")
+            pl.status.completion_time = time.time()
+        elif any(p == "Running" for p in phases.values()):
+            pl.status.set_condition("Running", "StepsRunning")
+        self._persist(pl, status_before)
+
+    def _get_child_job(self, ns: str, job_name: str):
+        for kind in JOB_KINDS:
+            obj = self.store.get(kind, job_name, ns)
+            if obj is not None:
+                return obj
+        return None
+
+    def _create_step_job(self, pl: Pipeline, step: str, job_name: str) -> bool:
+        ns = pl.metadata.namespace
+        tmpl = next(s for s in pl.spec.steps if s.name == step)
+        job = render_step_template(
+            dict(tmpl.job), pl.spec.parameters, pl.status.step_outputs
+        )
+        kind = job.get("kind", "JAXJob")
+        job["kind"] = kind
+        meta = job.setdefault("metadata", {})
+        meta["name"] = job_name
+        meta["namespace"] = ns
+        meta.setdefault("labels", {})[PIPELINE_LABEL] = pl.metadata.name
+        meta["labels"][STEP_LABEL] = step
+        out_path = self._output_path(ns, pl.metadata.name, step)
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        for rs in job.get("spec", {}).get("replica_specs", {}).values():
+            t = rs.get("template")
+            if isinstance(t, dict):
+                t.setdefault("env", {})["KFTPU_STEP_OUTPUT"] = out_path
+        try:
+            from kubeflow_tpu.api import TrainJob, apply_defaults, validate_job
+
+            tj = apply_defaults(TrainJob.from_dict(job))
+            validate_job(tj)
+        except ValueError as e:
+            pl.status.set_condition(
+                "Running", "StepInvalid",
+                f"step {step!r} rendered an invalid job: {e}",
+            )
+            logger.warning("pipeline %s step %s invalid: %s", pl.key, step, e)
+            return False
+        self.store.put(kind, tj.to_dict())
+        return True
+
+    def _capture_output(self, pl: Pipeline, step: str) -> None:
+        if step in pl.status.step_outputs:
+            return
+        path = self._output_path(pl.metadata.namespace, pl.metadata.name, step)
+        try:
+            with open(path, "rb") as f:
+                data = f.read(self.max_output_bytes)
+            pl.status.step_outputs[step] = data.decode("utf-8", "replace").strip()
+        except OSError:
+            # Step wrote no output: record the empty string so downstream
+            # ${steps.<name>.output} placeholders render empty instead of
+            # surviving as literal text.
+            pl.status.step_outputs[step] = ""
+
+    def _persist(self, pl: Pipeline, status_before: dict) -> None:
+        if pl.status.model_dump(mode="json") == status_before:
+            return
+        cur = self.store.get("Pipeline", pl.metadata.name, pl.metadata.namespace)
+        if cur is None:
+            return
+        cur["status"] = pl.status.model_dump(mode="json")
+        self.store.put("Pipeline", cur)

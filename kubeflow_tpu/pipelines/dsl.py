@@ -1,0 +1,213 @@
+"""kfp-style pipeline SDK (SURVEY.md 3.4 P9, the ``kfp`` DSL equivalent).
+
+``@component`` turns a self-contained python function into a pipeline step
+that runs as its own process: the function source is shipped in the step's
+job template, arguments arrive as JSON, and the return value is written to
+the step's output file so downstream steps can consume it via
+``step.output`` (rendered to ``${steps.<name>.output}`` and substituted by
+the controller).
+
+    @component
+    def double(x: float) -> float:
+        return 2 * float(x)
+
+    @pipeline(name="calc", parameters={"x": 3})
+    def calc():
+        a = double(x="${pipelineParameters.x}")
+        double(x=a.output)
+
+    spec = calc()          # Pipeline-shaped dict, ready for apply()
+
+Functions must be self-contained (imports inside the body): they execute
+by source in a fresh interpreter, the same contract as kfp's lightweight
+python components.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import inspect
+import json
+import sys
+import textwrap
+from typing import Any, Callable, Optional
+
+_CTX: contextvars.ContextVar[Optional["_PipelineContext"]] = (
+    contextvars.ContextVar("kftpu_pipeline_ctx", default=None)
+)
+
+
+class _PipelineContext:
+    def __init__(self) -> None:
+        self.steps: list[dict] = []
+        self._names: set[str] = set()
+
+    def unique(self, base: str) -> str:
+        name = base
+        i = 2
+        while name in self._names:
+            name = f"{base}-{i}"
+            i += 1
+        self._names.add(name)
+        return name
+
+
+class Step:
+    """Handle returned by calling a component inside a pipeline function."""
+
+    def __init__(self, name: str, spec: dict) -> None:
+        self.name = name
+        self._spec = spec
+
+    @property
+    def output(self) -> str:
+        return "${steps." + self.name + ".output}"
+
+    def after(self, *steps: "Step") -> "Step":
+        deps = self._spec.setdefault("dependencies", [])
+        for s in steps:
+            if s.name not in deps:
+                deps.append(s.name)
+        return self
+
+
+def _auto_deps(args: dict[str, Any]) -> list[str]:
+    deps = []
+    blob = json.dumps({k: str(v) for k, v in args.items()})
+    start = 0
+    while True:
+        i = blob.find("${steps.", start)
+        if i < 0:
+            break
+        j = blob.find(".output}", i)
+        if j < 0:
+            break
+        deps.append(blob[i + len("${steps."):j])
+        start = j + 1
+    return deps
+
+
+class Component:
+    def __init__(self, fn: Callable, base_image_args: Optional[dict] = None) -> None:
+        self.fn = fn
+        self.name = fn.__name__.replace("_", "-")
+        try:
+            src = textwrap.dedent(inspect.getsource(fn))
+        except OSError as e:
+            raise ValueError(
+                f"@component {fn.__name__!r}: source is not retrievable "
+                "(defined in a REPL/stdin?); components must live in a "
+                "real .py file because they execute by source"
+            ) from e
+        # Strip decorator lines; execution re-defines the bare function.
+        lines = src.splitlines()
+        while lines and lines[0].lstrip().startswith("@"):
+            lines.pop(0)
+        self.source = "\n".join(lines)
+
+    def script(self) -> str:
+        # kwargs ride as alternating name/value argv entries, NOT as one
+        # JSON blob: substituted values (step outputs, parameters) may
+        # contain quotes/backslashes/newlines, which are safe in their own
+        # argv slot but would corrupt an encoded container. Components
+        # therefore receive every argument as str and cast themselves --
+        # the same contract as CLI flags.
+        return (
+            "import os, sys\n"
+            f"{self.source}\n"
+            "_a = sys.argv[1:]\n"
+            "_kwargs = {_a[i]: _a[i + 1] for i in range(0, len(_a), 2)}\n"
+            f"_ret = {self.fn.__name__}(**_kwargs)\n"
+            "_out = os.environ.get('KFTPU_STEP_OUTPUT')\n"
+            "if _out and _ret is not None:\n"
+            "    with open(_out, 'w') as f:\n"
+            "        f.write(str(_ret))\n"
+            "print('step output:', _ret, flush=True)\n"
+        )
+
+    def __call__(self, **kwargs: Any) -> Step:
+        ctx = _CTX.get()
+        if ctx is None:
+            # Outside a pipeline definition behave as the plain function
+            # (unit-testable components, like kfp's .python_func).
+            return self.fn(**kwargs)
+        name = ctx.unique(self.name)
+        step = {
+            "name": name,
+            "dependencies": _auto_deps(kwargs),
+            "job": {
+                "kind": "JAXJob",
+                "spec": {
+                    "replica_specs": {
+                        "Worker": {
+                            "replicas": 1,
+                            "resources": {"tpu": 0},
+                            "template": {
+                                "exec": True,
+                                "entrypoint": sys.executable,
+                                "args": ["-c", self.script()] + [
+                                    s for k, v in kwargs.items()
+                                    for s in (k, str(v))
+                                ],
+                            },
+                        }
+                    }
+                },
+            },
+        }
+        ctx.steps.append(step)
+        return Step(name, step)
+
+
+def component(fn: Callable) -> Component:
+    return Component(fn)
+
+
+def job_step(name: str, job: dict, after: Optional[list[Step]] = None) -> Step:
+    """Add a raw TrainJob-shaped step (full control: any kind, replicas,
+    TPU resources) to the pipeline under construction."""
+    ctx = _CTX.get()
+    if ctx is None:
+        raise RuntimeError("job_step() must be called inside a @pipeline fn")
+    name = ctx.unique(name)
+    spec = {"name": name, "dependencies": [], "job": job}
+    ctx.steps.append(spec)
+    step = Step(name, spec)
+    if after:
+        step.after(*after)
+    return step
+
+
+def pipeline(
+    name: str,
+    namespace: str = "default",
+    parameters: Optional[dict] = None,
+    max_parallel_steps: int = 0,
+) -> Callable:
+    """Decorator: the wrapped function assembles steps by calling
+    components; invoking it returns the Pipeline-shaped dict."""
+
+    def deco(fn: Callable) -> Callable:
+        def build(**param_overrides: Any) -> dict:
+            ctx = _PipelineContext()
+            token = _CTX.set(ctx)
+            try:
+                fn()
+            finally:
+                _CTX.reset(token)
+            params = dict(parameters or {})
+            params.update(param_overrides)
+            return {
+                "kind": "Pipeline",
+                "metadata": {"name": name, "namespace": namespace},
+                "spec": {
+                    "parameters": params,
+                    "steps": ctx.steps,
+                    "max_parallel_steps": max_parallel_steps,
+                },
+            }
+
+        build.__name__ = fn.__name__
+        return build
+
+    return deco

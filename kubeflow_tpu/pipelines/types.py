@@ -1,0 +1,156 @@
+"""Pipeline API types (Kubeflow Pipelines equivalent, SURVEY.md 3.4 P9).
+
+The reference's Pipelines stack is an Argo-workflow DAG engine plus the
+kfp SDK. The TPU-native equivalent keeps the same semantics at control
+-plane scale: a Pipeline is a DAG of steps, each step materializes a
+TrainJob-shaped workload (any job kind -- so a pipeline can chain data
+prep, a JAXJob training run, and an eval job), parameters substitute
+through ``${pipelineParameters.<name>}``, and step outputs flow to
+downstream steps via ``${steps.<name>.output}``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from kubeflow_tpu.api.conditions import set_condition as _set_condition
+from kubeflow_tpu.api.types import JobKind, ObjectMeta
+
+JOB_KINDS = {k.value for k in JobKind}
+
+
+class PipelineValidationError(ValueError):
+    pass
+
+
+class PipelineStep(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    name: str
+    # Step names that must Succeed before this step starts.
+    dependencies: List[str] = Field(default_factory=list)
+    # TrainJob-shaped template (kind defaults to JAXJob); rendered with
+    # pipeline parameters + upstream outputs at creation time.
+    job: Dict[str, Any]
+
+
+class PipelineSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    parameters: Dict[str, Any] = Field(default_factory=dict)
+    steps: List[PipelineStep]
+    # 0 = no limit. Bounds how many step jobs run concurrently.
+    max_parallel_steps: int = Field(default=0, ge=0)
+
+
+class PipelineStatus(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    conditions: List[Dict[str, Any]] = Field(default_factory=list)
+    # step name -> Pending | Running | Succeeded | Failed | Skipped
+    step_phases: Dict[str, str] = Field(default_factory=dict)
+    # step name -> captured output (contents of the step's output file)
+    step_outputs: Dict[str, str] = Field(default_factory=dict)
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+
+    _EXCLUSIVE = ("Running", "Succeeded", "Failed")
+
+    def set_condition(self, ctype: str, reason: str = "", message: str = "") -> None:
+        _set_condition(self.conditions, ctype, self._EXCLUSIVE, reason, message)
+
+    @property
+    def finished(self) -> bool:
+        return any(
+            c["type"] in ("Succeeded", "Failed") and c["status"]
+            for c in self.conditions
+        )
+
+
+class Pipeline(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    kind: str = "Pipeline"
+    metadata: ObjectMeta
+    spec: PipelineSpec
+    status: PipelineStatus = Field(default_factory=PipelineStatus)
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Pipeline":
+        return cls.model_validate(d)
+
+    def to_dict(self) -> dict:
+        return self.model_dump(mode="json")
+
+
+def toposort(steps: List[PipelineStep]) -> List[str]:
+    """Kahn topological order; raises PipelineValidationError on cycles or
+    unknown dependencies."""
+    names = [s.name for s in steps]
+    if len(set(names)) != len(names):
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        raise PipelineValidationError(f"duplicate step names: {dupes}")
+    by_name = {s.name: s for s in steps}
+    for s in steps:
+        for d in s.dependencies:
+            if d not in by_name:
+                raise PipelineValidationError(
+                    f"step {s.name!r} depends on unknown step {d!r}"
+                )
+            if d == s.name:
+                raise PipelineValidationError(
+                    f"step {s.name!r} depends on itself"
+                )
+    indeg = {s.name: len(set(s.dependencies)) for s in steps}
+    ready = [n for n in names if indeg[n] == 0]
+    order: List[str] = []
+    while ready:
+        n = ready.pop(0)
+        order.append(n)
+        for s in steps:
+            if n in s.dependencies:
+                indeg[s.name] -= 1
+                if indeg[s.name] == 0:
+                    ready.append(s.name)
+    if len(order) != len(names):
+        stuck = sorted(set(names) - set(order))
+        raise PipelineValidationError(f"dependency cycle through: {stuck}")
+    return order
+
+
+def validate_pipeline(p: Pipeline) -> None:
+    if not p.spec.steps:
+        raise PipelineValidationError("pipeline has no steps")
+    toposort(p.spec.steps)
+    for s in p.spec.steps:
+        kind = s.job.get("kind", "JAXJob")
+        if kind not in JOB_KINDS:
+            raise PipelineValidationError(
+                f"step {s.name!r}: job kind {kind!r} is not a job kind "
+                f"({sorted(JOB_KINDS)})"
+            )
+
+
+def render_step_template(
+    template: Dict[str, Any],
+    parameters: Dict[str, Any],
+    step_outputs: Dict[str, str],
+) -> Dict[str, Any]:
+    """Textual substitution of ``${pipelineParameters.<name>}`` and
+    ``${steps.<name>.output}`` through every string leaf (the same
+    contract as HPO's trial templates; one shared walker serves both)."""
+    from kubeflow_tpu.utils.templating import substitute
+
+    mapping: Dict[str, Any] = {
+        "${pipelineParameters." + n + "}": v for n, v in parameters.items()
+    }
+    mapping.update(
+        {"${steps." + n + ".output}": v for n, v in step_outputs.items()}
+    )
+    return substitute(template, mapping)

@@ -288,6 +288,36 @@ class TrainingClient:
             f"{kind} {namespace}/{name} did not reach {expected} in {timeout}s"
         )
 
+    # -- pipelines (kfp-client analog, SURVEY.md 3.4 P9) -------------------
+
+    def create_pipeline(self, pipeline: dict) -> dict:
+        """Submit a Pipeline dict (e.g. built with pipelines.dsl)."""
+        return self.apply("Pipeline", pipeline)
+
+    def get_pipeline(self, name: str, namespace: str = "default") -> dict:
+        return self.get("Pipeline", name, namespace)
+
+    def wait_for_pipeline(
+        self, name: str, namespace: str = "default",
+        timeout: float = 600.0, poll: float = 1.0,
+    ) -> dict:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            obj = self.get("Pipeline", name, namespace)
+            conds = obj.get("status", {}).get("conditions", [])
+            active = {c["type"] for c in conds if c.get("status")}
+            if "Succeeded" in active:
+                return obj
+            if "Failed" in active:
+                raise JobFailedError(
+                    f"pipeline {namespace}/{name} failed: "
+                    + json.dumps(obj.get("status", {}))[:500]
+                )
+            time.sleep(poll)
+        raise TimeoutError(
+            f"pipeline {namespace}/{name} did not finish in {timeout}s"
+        )
+
     # -- serving (KServe-client analog, SURVEY.md 3.3) ---------------------
 
     def create_inference_service(self, isvc: dict) -> dict:

@@ -42,6 +42,12 @@ from kubeflow_tpu.platform import (
     validate_pod_default,
     validate_profile,
 )
+from kubeflow_tpu.pipelines import (
+    Pipeline,
+    PipelineController,
+    PipelineValidationError,
+    validate_pipeline,
+)
 from kubeflow_tpu.platform.controller import PlatformController
 from kubeflow_tpu.serving.controller import Activator, ISVCController
 from kubeflow_tpu.serving.types import (
@@ -85,6 +91,10 @@ class ControlPlane:
         self.platform = PlatformController(
             self.store, self.gang, job_controller=self.controller
         )
+        self.pipelines = PipelineController(
+            self.store,
+            artifacts_dir=os.path.join(state_dir, "artifacts"),
+        )
 
         # Worker exits fan out: serving replicas first (on_worker_exit
         # returns False for non-server workers), then training jobs. Bound
@@ -96,7 +106,9 @@ class ControlPlane:
             await self.controller._on_worker_exit(ref, code)
 
         self.launcher.set_exit_callback(dispatch_exit)
-        self.extra_controllers: list = [self.hpo, self.isvc, self.platform]
+        self.extra_controllers: list = [
+            self.hpo, self.isvc, self.platform, self.pipelines
+        ]
         self._tasks: list[asyncio.Task] = []
         self.started_at = time.time()
 
@@ -191,12 +203,18 @@ class ControlPlane:
             validate_pod_default(pd)
             return pd.to_dict()
 
+        def parse_pipeline(o):
+            pl = Pipeline.from_dict(o)
+            validate_pipeline(pl)
+            return pl.to_dict()
+
         parser = (
             parse_job if kind in JOB_KINDS
             else {"Experiment": parse_experiment,
                   "InferenceService": parse_isvc,
                   "Profile": parse_profile,
-                  "PodDefault": parse_pod_default}.get(kind)
+                  "PodDefault": parse_pod_default,
+                  "Pipeline": parse_pipeline}.get(kind)
         )
         if parser is not None:
             # Admission-webhook analog: parse + default + validate, then
@@ -211,7 +229,8 @@ class ControlPlane:
                     )
                 stored = obj_with_preserved_status(self.store, kind, parser(obj))
             except (ValidationError, ServingValidationError,
-                    PlatformValidationError, ValueError) as e:
+                    PlatformValidationError, PipelineValidationError,
+                    ValueError) as e:
                 return web.json_response({"error": str(e)}, status=422)
         else:
             # Unknown kinds are validated by their controllers; only

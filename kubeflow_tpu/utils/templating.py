@@ -1,0 +1,35 @@
+"""Shared template substitution for spec rendering.
+
+One walker serves both template engines -- HPO trial templates
+(``${trialParameters.<name>}``) and pipeline steps
+(``${pipelineParameters.<name>}`` / ``${steps.<name>.output}``). All
+substitution is textual (``str(value)``), the reference's template-engine
+contract: placeholders belong in string-typed fields (args, env); the
+rendered object is re-validated afterwards so a placeholder smuggled into
+a numeric field fails loudly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+
+def substitute(template: Any, mapping: Mapping[str, Any]) -> Any:
+    """Replace every placeholder key of ``mapping`` in every string leaf
+    of ``template`` (dicts/lists walked recursively)."""
+
+    def subst(v: Any) -> Any:
+        if isinstance(v, str):
+            for ph, val in mapping.items():
+                if v == ph:
+                    return str(val)
+                if ph in v:
+                    v = v.replace(ph, str(val))
+            return v
+        if isinstance(v, dict):
+            return {k: subst(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [subst(x) for x in v]
+        return v
+
+    return subst(template)

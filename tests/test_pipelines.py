@@ -1,0 +1,347 @@
+"""Pipelines pillar tests (SURVEY.md 3.4 P9): DAG types, kfp-style DSL,
+and the PipelineController driving real step processes end-to-end."""
+
+import asyncio
+import sys
+
+import pytest
+
+from kubeflow_tpu.controller import (
+    GangScheduler,
+    JobController,
+    ProcessLauncher,
+)
+from kubeflow_tpu.pipelines import (
+    Pipeline,
+    PipelineController,
+    PipelineValidationError,
+    render_step_template,
+    toposort,
+    validate_pipeline,
+)
+from kubeflow_tpu.pipelines import dsl
+from kubeflow_tpu.store import ObjectStore
+
+
+def step(name, deps=(), script="pass", out=None):
+    body = script if out is None else (
+        "import os\n"
+        f"{script}\n"
+        "p = os.environ.get('KFTPU_STEP_OUTPUT')\n"
+        f"open(p, 'w').write(str({out}))\n"
+    )
+    return {
+        "name": name,
+        "dependencies": list(deps),
+        "job": {
+            "kind": "JAXJob",
+            "spec": {
+                "replica_specs": {
+                    "Worker": {
+                        "replicas": 1,
+                        "resources": {"tpu": 0},
+                        "template": {
+                            "exec": True,
+                            "entrypoint": sys.executable,
+                            "args": ["-c", body],
+                        },
+                    }
+                }
+            },
+        },
+    }
+
+
+def pipeline_obj(name="p1", steps=(), parameters=None, **kw):
+    return {
+        "kind": "Pipeline",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "parameters": parameters or {},
+            "steps": list(steps),
+            **kw,
+        },
+    }
+
+
+class TestTypes:
+    def test_toposort_orders_dependencies(self):
+        p = Pipeline.from_dict(pipeline_obj(steps=[
+            step("c", deps=["b"]), step("a"), step("b", deps=["a"]),
+        ]))
+        assert toposort(p.spec.steps) == ["a", "b", "c"]
+
+    def test_cycle_rejected(self):
+        p = Pipeline.from_dict(pipeline_obj(steps=[
+            step("a", deps=["b"]), step("b", deps=["a"]),
+        ]))
+        with pytest.raises(PipelineValidationError, match="cycle"):
+            validate_pipeline(p)
+
+    def test_unknown_dep_and_duplicates_rejected(self):
+        p = Pipeline.from_dict(pipeline_obj(steps=[step("a", deps=["zz"])]))
+        with pytest.raises(PipelineValidationError, match="unknown"):
+            validate_pipeline(p)
+        p2 = Pipeline.from_dict(pipeline_obj(steps=[step("a"), step("a")]))
+        with pytest.raises(PipelineValidationError, match="duplicate"):
+            validate_pipeline(p2)
+
+    def test_empty_and_bad_kind_rejected(self):
+        with pytest.raises(PipelineValidationError, match="no steps"):
+            validate_pipeline(Pipeline.from_dict(pipeline_obj(steps=[])))
+        bad = step("a")
+        bad["job"]["kind"] = "InferenceService"
+        with pytest.raises(PipelineValidationError, match="not a job kind"):
+            validate_pipeline(Pipeline.from_dict(pipeline_obj(steps=[bad])))
+
+    def test_render_substitutes_params_and_outputs(self):
+        t = {"spec": {"args": ["--lr", "${pipelineParameters.lr}",
+                               "--data", "${steps.prep.output}"]}}
+        r = render_step_template(t, {"lr": 0.1}, {"prep": "/tmp/x"})
+        assert r["spec"]["args"] == ["--lr", "0.1", "--data", "/tmp/x"]
+
+
+class TestDSL:
+    def test_component_runs_as_plain_function_outside_pipeline(self):
+        @dsl.component
+        def double(x: float) -> float:
+            return 2 * float(x)
+
+        assert double(x=4) == 8
+
+    def test_pipeline_builds_spec_with_auto_deps(self):
+        @dsl.component
+        def produce() -> int:
+            return 21
+
+        @dsl.component
+        def consume(x: str) -> str:
+            return x
+
+        @dsl.pipeline(name="calc", parameters={"lr": 0.1})
+        def calc():
+            a = produce()
+            consume(x=a.output)
+
+        spec = calc()
+        validate_pipeline(Pipeline.from_dict(spec))
+        assert [s["name"] for s in spec["spec"]["steps"]] == ["produce", "consume"]
+        assert spec["spec"]["steps"][1]["dependencies"] == ["produce"]
+        assert spec["spec"]["parameters"] == {"lr": 0.1}
+
+    def test_duplicate_component_names_deduped(self):
+        @dsl.component
+        def work() -> int:
+            return 1
+
+        @dsl.pipeline(name="p")
+        def p():
+            a = work()
+            work().after(a)
+
+        spec = p()
+        names = [s["name"] for s in spec["spec"]["steps"]]
+        assert names == ["work", "work-2"]
+        assert spec["spec"]["steps"][1]["dependencies"] == ["work"]
+
+    def test_job_step_outside_pipeline_raises(self):
+        with pytest.raises(RuntimeError, match="inside"):
+            dsl.job_step("x", {})
+
+
+class PipelineHarness:
+    """JobController (real processes) + PipelineController on one store."""
+
+    def __init__(self, tmp_path):
+        self.store = ObjectStore(":memory:")
+        self.log_dir = str(tmp_path / "logs")
+        self.launcher = ProcessLauncher(log_dir=self.log_dir)
+        self.ctl = JobController(
+            self.store, self.launcher, GangScheduler(total_chips=8),
+            log_dir=self.log_dir,
+        )
+        self.pipelines = PipelineController(
+            self.store, artifacts_dir=str(tmp_path / "artifacts")
+        )
+        self.tasks = []
+
+    async def __aenter__(self):
+        self.tasks = [
+            asyncio.create_task(self.ctl.run()),
+            asyncio.create_task(self.pipelines.run()),
+        ]
+        await asyncio.sleep(0)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.pipelines.stop()
+        await self.ctl.stop()
+        for t in self.tasks:
+            try:
+                await asyncio.wait_for(t, 2)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                t.cancel()
+        self.store.close()
+
+    async def wait(self, pred, timeout=30.0, msg=""):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if pred():
+                return
+            await asyncio.sleep(0.05)
+        raise AssertionError(msg or "condition not met")
+
+    def pipeline(self, name="p1"):
+        return self.store.get("Pipeline", name, "default")
+
+    def phase(self, name="p1"):
+        obj = self.pipeline(name) or {}
+        conds = obj.get("status", {}).get("conditions", [])
+        active = [c["type"] for c in conds if c.get("status")]
+        for t in ("Failed", "Succeeded", "Running"):
+            if t in active:
+                return t
+        return "Pending"
+
+
+class TestController:
+    def test_dag_runs_in_order_with_output_passing(self, tmp_path):
+        async def run():
+            async with PipelineHarness(tmp_path) as h:
+                h.store.put("Pipeline", pipeline_obj(steps=[
+                    step("produce", script="v = 21", out="v"),
+                    step(
+                        "consume", deps=["produce"],
+                        script="v = 2 * int('${steps.produce.output}')",
+                        out="v",
+                    ),
+                ]))
+                await h.wait(
+                    lambda: h.phase() == "Succeeded", msg=str(h.pipeline())
+                )
+                st = h.pipeline()["status"]
+                assert st["step_phases"] == {
+                    "produce": "Succeeded", "consume": "Succeeded"
+                }
+                assert st["step_outputs"]["produce"] == "21"
+                assert st["step_outputs"]["consume"] == "42"
+
+        asyncio.run(run())
+
+    def test_parameters_substituted(self, tmp_path):
+        async def run():
+            async with PipelineHarness(tmp_path) as h:
+                h.store.put("Pipeline", pipeline_obj(
+                    steps=[step(
+                        "echo",
+                        script="v = int('${pipelineParameters.n}') + 1",
+                        out="v",
+                    )],
+                    parameters={"n": 41},
+                ))
+                await h.wait(
+                    lambda: h.phase() == "Succeeded", msg=str(h.pipeline())
+                )
+                assert h.pipeline()["status"]["step_outputs"]["echo"] == "42"
+
+        asyncio.run(run())
+
+    def test_failed_step_skips_downstream_and_fails_pipeline(self, tmp_path):
+        async def run():
+            async with PipelineHarness(tmp_path) as h:
+                h.store.put("Pipeline", pipeline_obj(steps=[
+                    step("boom", script="raise SystemExit(1)"),
+                    step("after", deps=["boom"]),
+                    step("independent"),
+                ]))
+                await h.wait(
+                    lambda: h.phase() == "Failed", timeout=45,
+                    msg=str(h.pipeline()),
+                )
+                st = h.pipeline()["status"]
+                assert st["step_phases"]["boom"] == "Failed"
+                assert st["step_phases"]["after"] == "Skipped"
+                # Independent branch still ran.
+                assert st["step_phases"]["independent"] == "Succeeded"
+
+        asyncio.run(run())
+
+    def test_quote_bearing_output_passes_through_dsl_steps(self, tmp_path):
+        """Step outputs with quotes/backslashes must survive into the
+        consuming component (argv transport, not an encoded blob)."""
+        async def run():
+            async with PipelineHarness(tmp_path) as h:
+                h.store.put("Pipeline", pipeline_obj(steps=[
+                    step("emit", script='v = \'he said "hi" \\\\ done\'',
+                         out="v"),
+                    step(
+                        "recv", deps=["emit"],
+                        script="v = len('''${steps.emit.output}''')",
+                        out="v",
+                    ),
+                ]))
+                await h.wait(
+                    lambda: h.phase() == "Succeeded", msg=str(h.pipeline())
+                )
+                st = h.pipeline()["status"]
+                assert st["step_outputs"]["emit"] == 'he said "hi" \\ done'
+
+        asyncio.run(run())
+
+    def test_missing_output_renders_empty(self, tmp_path):
+        async def run():
+            async with PipelineHarness(tmp_path) as h:
+                h.store.put("Pipeline", pipeline_obj(steps=[
+                    step("silent"),  # writes no output file
+                    step(
+                        "recv", deps=["silent"],
+                        script="v = repr('${steps.silent.output}')",
+                        out="v",
+                    ),
+                ]))
+                await h.wait(
+                    lambda: h.phase() == "Succeeded", msg=str(h.pipeline())
+                )
+                st = h.pipeline()["status"]
+                assert st["step_outputs"]["silent"] == ""
+                assert st["step_outputs"]["recv"] == "''"
+
+        asyncio.run(run())
+
+    def test_name_conflict_fails_step_not_adopts(self, tmp_path):
+        async def run():
+            async with PipelineHarness(tmp_path) as h:
+                # Pre-existing unrelated job occupying the step job name.
+                from tests.test_controller import make_job
+
+                squatter = make_job("p1-train", replicas=1, tpu=0)
+                h.store.put("JAXJob", squatter.to_dict())
+                h.store.put("Pipeline", pipeline_obj(steps=[step("train")]))
+                await h.wait(
+                    lambda: h.phase() == "Failed", msg=str(h.pipeline())
+                )
+                st = h.pipeline()["status"]
+                assert st["step_phases"]["train"] == "Failed"
+                # The squatter was not overwritten or deleted.
+                assert h.store.get("JAXJob", "p1-train", "default") is not None
+
+        asyncio.run(run())
+
+    def test_delete_pipeline_deletes_child_jobs(self, tmp_path):
+        async def run():
+            async with PipelineHarness(tmp_path) as h:
+                h.store.put("Pipeline", pipeline_obj(steps=[
+                    step("slow", script="import time; time.sleep(30)"),
+                ]))
+                await h.wait(
+                    lambda: h.store.get("JAXJob", "p1-slow", "default")
+                    is not None,
+                    msg="step job never created",
+                )
+                h.store.delete("Pipeline", "p1", "default")
+                await h.wait(
+                    lambda: h.store.get("JAXJob", "p1-slow", "default") is None,
+                    msg="child job not cleaned up",
+                )
+
+        asyncio.run(run())
