@@ -41,6 +41,11 @@ KIND_ALIASES = {
     "inferenceservice": "InferenceService", "inferenceservices": "InferenceService",
     "isvc": "InferenceService",
     "pipeline": "Pipeline", "pipelines": "Pipeline", "pl": "Pipeline",
+    "notebook": "Notebook", "notebooks": "Notebook", "nb": "Notebook",
+    "tensorboard": "Tensorboard", "tensorboards": "Tensorboard",
+    "tb": "Tensorboard",
+    "profile": "Profile", "profiles": "Profile",
+    "poddefault": "PodDefault", "poddefaults": "PodDefault",
     "event": "Event", "events": "Event",
 }
 

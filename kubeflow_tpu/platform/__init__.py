@@ -1,10 +1,13 @@
-"""Platform glue (SURVEY.md 3.4 P1/P4, 7.1 step 8).
+"""Platform glue (SURVEY.md 3.4 P1/P2/P3/P4/P7, 7.1 step 8).
 
-- ``types``      Profile (namespace + chip quota) and PodDefault
-                 (admission-time spec mutation) API types
-- ``controller`` PlatformController syncing Profile quotas into the gang
-                 scheduler; PodDefault application lives in apply-time
-                 admission (server/app.py), like the reference's webhook
+- ``types``          Profile (namespace + quota + access bindings) and
+                     PodDefault (admission-time spec mutation) API types
+- ``controller``     PlatformController syncing Profile quotas into the
+                     gang scheduler; PodDefault application lives in
+                     apply-time admission (server/app.py)
+- ``workbench``      Notebook + Tensorboard controllers (P2/P3)
+- ``metrics_viewer`` the Tensorboard-equivalent runtime
+- ``kfam``           access management (P7)
 """
 
 from kubeflow_tpu.platform.types import (

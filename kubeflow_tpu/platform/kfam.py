@@ -1,0 +1,98 @@
+"""KFAM-equivalent access management (SURVEY.md 3.4 P7).
+
+The reference's Kubeflow Access Management service manages per-namespace
+RoleBindings so profile owners can share their namespace with
+contributors. Here the Profile IS the binding store
+(``spec.owner`` + ``spec.contributors``), and this module provides:
+
+- ``AccessManager``: the authorization rule (owner/contributor/admin, and
+  open access for namespaces with no governing Profile), plus binding
+  CRUD that mutates the Profile.
+- The server mounts it at ``/kfam/v1/bindings`` and, when auth is
+  enabled, enforces it per request from the ``X-Kftpu-User`` header --
+  standing in for the reference's Istio/RBAC layer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kubeflow_tpu.platform.types import Profile
+
+ADMIN_DEFAULT = "admin"
+
+
+class AccessDenied(PermissionError):
+    pass
+
+
+class AccessManager:
+    def __init__(self, store, admin: str = ADMIN_DEFAULT) -> None:
+        self.store = store
+        self.admin = admin
+
+    def _profile(self, namespace: str) -> Optional[Profile]:
+        obj = self.store.get("Profile", namespace)
+        return Profile.from_dict(obj) if obj else None
+
+    def can_access(self, user: Optional[str], namespace: str) -> bool:
+        """Owner, contributor, or admin; namespaces without a governing
+        Profile are open (governance is opt-in, as with the reference's
+        unmanaged namespaces)."""
+        prof = self._profile(namespace)
+        if prof is None:
+            return True
+        if user is None:
+            return False
+        return (
+            user == self.admin
+            or user == prof.spec.owner
+            or user in prof.spec.contributors
+        )
+
+    def can_manage(self, user: Optional[str], namespace: str) -> bool:
+        """Binding management: the profile owner or the admin."""
+        prof = self._profile(namespace)
+        if prof is None:
+            return True
+        return user is not None and (
+            user == self.admin or user == prof.spec.owner
+        )
+
+    # -- bindings CRUD ------------------------------------------------------
+
+    def bindings(self, namespace: Optional[str] = None) -> list[dict]:
+        out = []
+        for obj in self.store.list("Profile"):
+            prof = Profile.from_dict(obj)
+            ns = prof.namespace_governed
+            if namespace and ns != namespace:
+                continue
+            if prof.spec.owner:
+                out.append({"user": prof.spec.owner, "namespace": ns,
+                            "role": "owner"})
+            for c in prof.spec.contributors:
+                out.append({"user": c, "namespace": ns,
+                            "role": "contributor"})
+        return out
+
+    def add_binding(self, user: str, namespace: str) -> dict:
+        obj = self.store.get("Profile", namespace)
+        if obj is None:
+            raise KeyError(f"no Profile governs namespace {namespace!r}")
+        prof = Profile.from_dict(obj)
+        if user != prof.spec.owner and user not in prof.spec.contributors:
+            prof.spec.contributors.append(user)
+            self.store.put("Profile", prof.to_dict())
+        return {"user": user, "namespace": namespace, "role": "contributor"}
+
+    def delete_binding(self, user: str, namespace: str) -> bool:
+        obj = self.store.get("Profile", namespace)
+        if obj is None:
+            return False
+        prof = Profile.from_dict(obj)
+        if user not in prof.spec.contributors:
+            return False
+        prof.spec.contributors.remove(user)
+        self.store.put("Profile", prof.to_dict())
+        return True

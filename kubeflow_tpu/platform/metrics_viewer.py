@@ -1,0 +1,151 @@
+"""Metrics viewer: the Tensorboard-equivalent runtime (SURVEY.md 3.4 P3).
+
+Serves the ``KFTPU-METRIC`` series scraped from worker logs -- the native
+metric stream every training run in this framework emits -- as JSON plus a
+minimal self-contained HTML page with inline SVG charts. Run by the
+WorkbenchController for each Tensorboard object:
+
+    python -m kubeflow_tpu.platform.metrics_viewer --logdir <dir> [--prefix ns_job_]
+
+Endpoints:
+- ``GET /``                      HTML dashboard
+- ``GET /api/runs``              log files (runs) discovered under logdir
+- ``GET /api/scalars?run=<r>``   {metric: [[step, value], ...]} for a run
+- ``GET /healthz``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional
+
+from aiohttp import web
+
+from kubeflow_tpu.runtime.metrics import parse_metric_line
+
+_PAGE = """<!doctype html>
+<html><head><title>kftpu metrics</title><style>
+body{font-family:monospace;margin:2em;background:#fafafa}
+h1{font-size:1.2em} .run{margin-bottom:2em}
+svg{background:#fff;border:1px solid #ccc;margin:4px}
+text{font-size:10px}
+</style></head><body>
+<h1>kftpu metrics viewer</h1><div id="root">loading...</div>
+<script>
+async function main(){
+  const runs = await (await fetch('api/runs')).json();
+  const root = document.getElementById('root');
+  root.innerHTML = '';
+  for (const run of runs){
+    const d = document.createElement('div'); d.className='run';
+    d.innerHTML = '<h2>'+run+'</h2>';
+    const scalars = await (await fetch('api/scalars?run='+encodeURIComponent(run))).json();
+    for (const [metric, pts] of Object.entries(scalars)){
+      if (pts.length < 1) continue;
+      const W=360,H=120,P=28;
+      const xs=pts.map(p=>p[0]), ys=pts.map(p=>p[1]);
+      const x0=Math.min(...xs), x1=Math.max(...xs,x0+1);
+      const y0=Math.min(...ys), y1=Math.max(...ys,y0+1e-9);
+      const X=v=>P+(W-2*P)*(v-x0)/(x1-x0), Y=v=>H-P-(H-2*P)*(v-y0)/(y1-y0);
+      const path=pts.map((p,i)=>(i?'L':'M')+X(p[0]).toFixed(1)+','+Y(p[1]).toFixed(1)).join(' ');
+      d.innerHTML += '<svg width="'+W+'" height="'+H+'">'
+        +'<path d="'+path+'" fill="none" stroke="#36c"/>'
+        +'<text x="'+P+'" y="12">'+metric+'</text>'
+        +'<text x="'+P+'" y="'+(H-6)+'">'+x0+'</text>'
+        +'<text x="'+(W-P)+'" y="'+(H-6)+'" text-anchor="end">'+x1+'</text>'
+        +'<text x="2" y="'+(Y(y1)+4)+'">'+y1.toPrecision(3)+'</text>'
+        +'<text x="2" y="'+(Y(y0)+4)+'">'+y0.toPrecision(3)+'</text></svg>';
+    }
+    root.appendChild(d);
+  }
+}
+main();
+</script></body></html>
+"""
+
+
+class MetricsViewer:
+    def __init__(self, logdir: str, prefix: Optional[str] = None) -> None:
+        self.logdir = logdir
+        self.prefix = prefix or ""
+
+    def runs(self) -> list[str]:
+        try:
+            names = sorted(os.listdir(self.logdir))
+        except OSError:
+            return []
+        return [
+            n for n in names
+            if n.endswith(".log") and n.startswith(self.prefix)
+        ]
+
+    def scalars(self, run: str) -> dict[str, list[list[float]]]:
+        # Path safety: run must be one of the discovered names.
+        if run not in self.runs():
+            return {}
+        series: dict[str, list[list[float]]] = {}
+        auto_step = 0
+        with open(os.path.join(self.logdir, run), errors="replace") as f:
+            for line in f:
+                kv = parse_metric_line(line)
+                if not kv:
+                    continue
+                try:
+                    step = int(kv.get("step", auto_step))
+                except ValueError:
+                    step = auto_step
+                auto_step = step + 1
+                for k, v in kv.items():
+                    if k in ("step", "event"):
+                        continue
+                    try:
+                        series.setdefault(k, []).append([step, float(v)])
+                    except ValueError:
+                        pass  # non-numeric value (names, paths)
+        return series
+
+    # -- http --------------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.add_routes([
+            web.get("/", self.h_index),
+            web.get("/api/runs", self.h_runs),
+            web.get("/api/scalars", self.h_scalars),
+            web.get("/healthz", self.h_health),
+        ])
+        return app
+
+    async def h_index(self, req: web.Request) -> web.Response:
+        return web.Response(text=_PAGE, content_type="text/html")
+
+    async def h_runs(self, req: web.Request) -> web.Response:
+        return web.json_response(self.runs())
+
+    async def h_scalars(self, req: web.Request) -> web.Response:
+        run = req.query.get("run", "")
+        return web.json_response(self.scalars(run))
+
+    async def h_health(self, req: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--logdir", required=True)
+    p.add_argument("--prefix", default="")
+    p.add_argument("--port", type=int,
+                   default=int(os.environ.get("PORT", "7470")))
+    args = p.parse_args(argv)
+    viewer = MetricsViewer(args.logdir, args.prefix)
+    print(json.dumps({"event": "viewer_start", "port": args.port,
+                      "logdir": args.logdir}), flush=True)
+    web.run_app(viewer.build_app(), host="127.0.0.1", port=args.port,
+                print=None)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

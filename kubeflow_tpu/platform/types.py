@@ -40,6 +40,9 @@ class ProfileSpec(BaseModel):
     model_config = ConfigDict(extra="forbid")
 
     owner: Optional[str] = None
+    # KFAM-equivalent access bindings (SURVEY.md 3.4 P7): users granted
+    # access to this profile's namespace alongside the owner.
+    contributors: List[str] = Field(default_factory=list)
     quota: QuotaSpec = Field(default_factory=QuotaSpec)
 
 

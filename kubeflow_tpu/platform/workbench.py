@@ -1,0 +1,362 @@
+"""Workbench controllers: Notebook + Tensorboard (SURVEY.md 3.4 P2/P3).
+
+The reference's notebook-controller turns a Notebook CRD into a
+StatefulSet + Service with idle-culling; its tensorboard-controller turns
+a Tensorboard CRD into a Deployment serving a log directory. The
+TPU-native equivalents keep the semantics at process scale:
+
+- **Notebook**: spec carries a process template (the user's interactive
+  server -- anything that serves on $PORT); the controller keeps it
+  running, injects PORT, exposes ``status.url``, and culls it (stops the
+  process, stamps the ``kftpu.io/stopped`` annotation) when its log has
+  been idle longer than ``culling.idle_seconds`` -- the reference's
+  last-activity culler, with log mtime standing in for Jupyter kernel
+  activity. Deleting the annotation resumes it.
+- **Tensorboard**: reconciled into a metrics-viewer process
+  (platform.metrics_viewer) serving the KFTPU-METRIC series scraped from
+  a job's worker logs -- the role Tensorboard plays for the reference,
+  re-pointed at this control plane's native metric stream.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Any, Dict, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from kubeflow_tpu.api.conditions import set_condition as _set_condition
+from kubeflow_tpu.api.types import ObjectMeta, ProcessTemplate
+from kubeflow_tpu.controller.launcher import BaseLauncher, SpawnRequest, WorkerRef
+from kubeflow_tpu.utils.ports import allocate_port
+
+logger = logging.getLogger(__name__)
+
+STOPPED_ANNOTATION = "kftpu.io/stopped"
+_EXCLUSIVE = ("Ready", "Unready", "Failed")
+
+
+class WorkbenchValidationError(ValueError):
+    pass
+
+
+class CullingPolicy(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    enabled: bool = True
+    idle_seconds: int = Field(default=3600, ge=10)
+
+
+class NotebookSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    template: ProcessTemplate
+    culling: CullingPolicy = Field(default_factory=CullingPolicy)
+
+
+class TensorboardSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    # Either a job name (its worker logs in this control plane) or an
+    # explicit log directory.
+    job: Optional[str] = None
+    log_dir: Optional[str] = None
+
+
+class WorkbenchStatus(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    conditions: list[Dict[str, Any]] = Field(default_factory=list)
+    url: Optional[str] = None
+    restart_count: int = 0
+    last_activity: Optional[float] = None
+
+    def set_condition(self, ctype: str, reason: str = "", message: str = "") -> None:
+        _set_condition(self.conditions, ctype, _EXCLUSIVE, reason, message)
+
+
+class Notebook(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    kind: str = "Notebook"
+    metadata: ObjectMeta
+    spec: NotebookSpec
+    status: WorkbenchStatus = Field(default_factory=WorkbenchStatus)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Notebook":
+        return cls.model_validate(d)
+
+    def to_dict(self) -> dict:
+        return self.model_dump(mode="json", by_alias=True)
+
+
+class Tensorboard(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    kind: str = "Tensorboard"
+    metadata: ObjectMeta
+    spec: TensorboardSpec
+    status: WorkbenchStatus = Field(default_factory=WorkbenchStatus)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Tensorboard":
+        return cls.model_validate(d)
+
+    def to_dict(self) -> dict:
+        return self.model_dump(mode="json", by_alias=True)
+
+
+def validate_notebook(nb: Notebook) -> None:
+    if not nb.spec.template.entrypoint:
+        raise WorkbenchValidationError("notebook template needs an entrypoint")
+
+
+def validate_tensorboard(tb: Tensorboard) -> None:
+    if not tb.spec.job and not tb.spec.log_dir:
+        raise WorkbenchValidationError(
+            "tensorboard needs spec.job or spec.log_dir"
+        )
+
+
+class _Running:
+    def __init__(self, ref: WorkerRef, port: int) -> None:
+        self.ref = ref
+        self.port = port
+        self.started_at = time.time()
+
+
+class WorkbenchController:
+    """One controller reconciles both workbench kinds (same lifecycle)."""
+
+    KINDS = ("Notebook", "Tensorboard")
+
+    def __init__(
+        self,
+        store,
+        launcher: BaseLauncher,
+        log_dir: Optional[str] = None,
+        poll_interval: float = 5.0,
+        restart_backoff: float = 1.0,
+    ) -> None:
+        self.store = store
+        self.launcher = launcher
+        self.log_dir = log_dir
+        self.poll = poll_interval
+        self.restart_backoff = restart_backoff
+        self._running: dict[str, _Running] = {}  # "Kind/ns/name" -> proc
+        self._queue: asyncio.Queue[tuple[str, str, str]] = asyncio.Queue()
+        self._queued: set[tuple[str, str, str]] = set()
+        # Keys with a culling poll timer in flight: one timer per notebook,
+        # not one per reconcile (watch events also trigger reconciles).
+        self._poll_scheduled: set[str] = set()
+        self._stopped = asyncio.Event()
+
+    # -- loop --------------------------------------------------------------
+
+    async def run(self) -> None:
+        watch_q = self.store.watch()
+        for kind in self.KINDS:
+            for obj in self.store.list(kind):
+                self._enqueue(kind, obj["metadata"]["namespace"],
+                              obj["metadata"]["name"])
+        watcher = asyncio.create_task(self._pump_watch(watch_q))
+        try:
+            while not self._stopped.is_set():
+                get = asyncio.create_task(self._queue.get())
+                stop = asyncio.create_task(self._stopped.wait())
+                done, pending = await asyncio.wait(
+                    {get, stop}, return_when=asyncio.FIRST_COMPLETED
+                )
+                for t in pending:
+                    t.cancel()
+                if get in done:
+                    item = get.result()
+                    self._queued.discard(item)
+                    kind, ns, name = item
+                    try:
+                        await self._reconcile(kind, ns, name)
+                    except Exception:
+                        logger.exception(
+                            "workbench reconcile %s %s/%s failed",
+                            kind, ns, name,
+                        )
+                        self._enqueue_later(2.0, kind, ns, name)
+        finally:
+            watcher.cancel()
+            self.store.unwatch(watch_q)
+            for run in list(self._running.values()):
+                await self.launcher.kill(run.ref)
+            self._running.clear()
+
+    async def stop(self) -> None:
+        self._stopped.set()
+
+    async def _pump_watch(self, q: asyncio.Queue) -> None:
+        while True:
+            ev = await q.get()
+            if ev.kind in self.KINDS:
+                self._enqueue(ev.kind, ev.namespace, ev.name)
+
+    def _enqueue(self, kind: str, ns: str, name: str) -> None:
+        item = (kind, ns, name)
+        if item not in self._queued:
+            self._queued.add(item)
+            self._queue.put_nowait(item)
+
+    def _enqueue_later(self, delay: float, kind: str, ns: str, name: str) -> None:
+        asyncio.get_running_loop().call_later(
+            delay, self._enqueue, kind, ns, name
+        )
+
+    # -- exit fan-in (chained from the shared launcher callback) -----------
+
+    async def on_worker_exit(self, ref: WorkerRef, code: int) -> bool:
+        if ref.req.replica_type != "workbench":
+            return False
+        key = ref.req.job_key  # "Kind/ns/name" packed below
+        run = self._running.get(key)
+        if run is None or run.ref.generation != ref.generation:
+            return True
+        self._running.pop(key, None)
+        kind, ns, name = key.split("/", 2)
+        logger.info("workbench %s exited code %s", key, code)
+        # Respawn with a small backoff unless the object is gone/stopped.
+        self._enqueue_later(self.restart_backoff, kind, ns, name)
+        return True
+
+    # -- reconcile ---------------------------------------------------------
+
+    def _key(self, kind: str, ns: str, name: str) -> str:
+        return f"{kind}/{ns}/{name}"
+
+    async def _reconcile(self, kind: str, ns: str, name: str) -> None:
+        key = self._key(kind, ns, name)
+        obj = self.store.get(kind, name, ns)
+        if obj is None:
+            run = self._running.pop(key, None)
+            if run is not None:
+                await self.launcher.kill(run.ref)
+            return
+        model = Notebook if kind == "Notebook" else Tensorboard
+        wb = model.from_dict(obj)
+        status_before = wb.status.model_dump(mode="json")
+        stopped = STOPPED_ANNOTATION in wb.metadata.annotations
+        run = self._running.get(key)
+
+        if stopped:
+            if run is not None:
+                await self.launcher.kill(run.ref)
+                self._running.pop(key, None)
+            wb.status.set_condition("Unready", "Stopped",
+                                    "stopped (culled or by user)")
+            wb.status.url = None
+            self._persist(kind, wb, status_before)
+            return
+
+        if run is None:
+            port = allocate_port()
+            req = self._spawn_request(kind, wb, ns, name, port)
+            try:
+                ref = await self.launcher.spawn(req)
+            except Exception as e:  # noqa: BLE001 -- spawn errors -> status
+                wb.status.set_condition("Failed", "SpawnFailed", str(e))
+                self._persist(kind, wb, status_before)
+                return
+            self._running[key] = _Running(ref, port)
+            wb.status.restart_count = wb.status.restart_count + (
+                1 if wb.status.url is not None else 0
+            )
+            wb.status.url = f"http://127.0.0.1:{port}"
+            wb.status.set_condition("Ready", "Running")
+            self._persist(kind, wb, status_before)
+        else:
+            wb.status.url = f"http://127.0.0.1:{run.port}"
+            wb.status.set_condition("Ready", "Running")
+
+        # Idle culling (notebooks only). last_activity is only persisted
+        # on the cull transition -- writing it every pass would emit a
+        # watch event per reconcile and turn the loop self-sustaining.
+        if kind == "Notebook" and wb.spec.culling.enabled:
+            idle_for = self._idle_seconds(key)
+            if idle_for is not None and idle_for > wb.spec.culling.idle_seconds:
+                wb.status.last_activity = time.time() - idle_for
+                cur = self.store.get(kind, name, ns)
+                if cur is not None:
+                    cur.setdefault("metadata", {}).setdefault(
+                        "annotations", {}
+                    )[STOPPED_ANNOTATION] = str(time.time())
+                    self.store.put(kind, cur)
+                self._persist(kind, wb, status_before)
+                return
+            if key not in self._poll_scheduled:
+                self._poll_scheduled.add(key)
+                asyncio.get_running_loop().call_later(
+                    self.poll, self._poll_fire, key, kind, ns, name
+                )
+        self._persist(kind, wb, status_before)
+
+    def _poll_fire(self, key: str, kind: str, ns: str, name: str) -> None:
+        self._poll_scheduled.discard(key)
+        self._enqueue(kind, ns, name)
+
+    def _idle_seconds(self, key: str) -> Optional[float]:
+        """Seconds since the workbench process last wrote its log."""
+        run = self._running.get(key)
+        if run is None or not run.ref.req.log_path:
+            return None
+        try:
+            mtime = os.stat(run.ref.req.log_path).st_mtime
+        except OSError:
+            return None
+        return max(0.0, time.time() - mtime)
+
+    def _spawn_request(
+        self, kind: str, wb, ns: str, name: str, port: int
+    ) -> SpawnRequest:
+        env = {"PORT": str(port)}
+        if kind == "Notebook":
+            t = wb.spec.template
+            env.update(t.env)
+            entrypoint, args, exec_ = t.entrypoint, tuple(t.args), t.exec_
+            workdir = t.workdir
+        else:
+            log_dir = wb.spec.log_dir
+            if not log_dir:
+                # Job mode: point the viewer at this control plane's log
+                # dir filtered to the job's workers.
+                log_dir = self.log_dir or "."
+            entrypoint = "kubeflow_tpu.platform.metrics_viewer"
+            args = ("--logdir", log_dir, "--port", str(port))
+            if wb.spec.job:
+                args += ("--prefix", f"{ns}_{wb.spec.job}_")
+            exec_, workdir = False, None
+        log_path = None
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            log_path = os.path.join(
+                self.log_dir, f"{kind.lower()}_{ns}_{name}.log"
+            )
+        return SpawnRequest(
+            job_key=self._key(kind, ns, name),
+            replica_type="workbench",
+            index=0,
+            entrypoint=entrypoint,
+            args=args,
+            env=tuple(sorted(env.items())),
+            workdir=workdir,
+            exec_=exec_,
+            log_path=log_path,
+        )
+
+    def _persist(self, kind: str, wb, status_before: dict) -> None:
+        if wb.status.model_dump(mode="json") == status_before:
+            return
+        cur = self.store.get(kind, wb.metadata.name, wb.metadata.namespace)
+        if cur is None:
+            return
+        cur["status"] = wb.status.model_dump(mode="json")
+        self.store.put(kind, cur)

@@ -49,6 +49,14 @@ from kubeflow_tpu.pipelines import (
     validate_pipeline,
 )
 from kubeflow_tpu.platform.controller import PlatformController
+from kubeflow_tpu.platform.kfam import AccessManager
+from kubeflow_tpu.platform.workbench import (
+    Notebook,
+    Tensorboard,
+    WorkbenchController,
+    validate_notebook,
+    validate_tensorboard,
+)
 from kubeflow_tpu.serving.controller import Activator, ISVCController
 from kubeflow_tpu.serving.types import (
     InferenceService,
@@ -95,6 +103,15 @@ class ControlPlane:
             self.store,
             artifacts_dir=os.path.join(state_dir, "artifacts"),
         )
+        self.workbench = WorkbenchController(
+            self.store, self.launcher, log_dir=self.log_dir
+        )
+        # KFAM-equivalent authz (P7): enforced when auth_enabled (or env
+        # KFTPU_AUTH=1); identity comes from the X-Kftpu-User header.
+        self.access = AccessManager(
+            self.store, admin=os.environ.get("KFTPU_ADMIN", "admin")
+        )
+        self.auth_enabled = os.environ.get("KFTPU_AUTH", "") == "1"
 
         # Worker exits fan out: serving replicas first (on_worker_exit
         # returns False for non-server workers), then training jobs. Bound
@@ -103,11 +120,14 @@ class ControlPlane:
         async def dispatch_exit(ref, code):
             if await self.isvc.on_worker_exit(ref, code):
                 return
+            if await self.workbench.on_worker_exit(ref, code):
+                return
             await self.controller._on_worker_exit(ref, code)
 
         self.launcher.set_exit_callback(dispatch_exit)
         self.extra_controllers: list = [
-            self.hpo, self.isvc, self.platform, self.pipelines
+            self.hpo, self.isvc, self.platform, self.pipelines,
+            self.workbench,
         ]
         self._tasks: list[asyncio.Task] = []
         self.started_at = time.time()
@@ -138,7 +158,10 @@ class ControlPlane:
     def build_app(self) -> web.Application:
         # Sized to match ModelServer's limit: the activator proxies predict
         # bodies, so the ingress must accept what the replicas accept.
-        app = web.Application(client_max_size=256 * 1024 * 1024)
+        middlewares = [self._auth_middleware] if self.auth_enabled else []
+        app = web.Application(
+            client_max_size=256 * 1024 * 1024, middlewares=middlewares
+        )
         app.add_routes(
             [
                 web.post("/apis/{kind}", self.h_apply),
@@ -150,6 +173,10 @@ class ControlPlane:
                 web.get("/observations/{ns}/{name}", self.h_observations),
                 web.get("/healthz", self.h_healthz),
                 web.get("/metrics", self.h_metrics),
+                # KFAM-equivalent access management API (P7).
+                web.get("/kfam/v1/bindings", self.h_kfam_list),
+                web.post("/kfam/v1/bindings", self.h_kfam_add),
+                web.delete("/kfam/v1/bindings", self.h_kfam_delete),
                 # Activator: data-plane ingress for InferenceServices.
                 web.route("*", "/serving/{ns}/{name}/{tail:.*}",
                           self.activator.handle),
@@ -170,10 +197,19 @@ class ControlPlane:
 
     async def h_apply(self, req: web.Request) -> web.Response:
         kind = req.match_info["kind"]
-        try:
-            obj = await req.json()
-        except json.JSONDecodeError:
-            return web.json_response({"error": "body is not JSON"}, status=400)
+        if "parsed_json" in req:  # auth middleware already parsed it
+            obj = req["parsed_json"]
+        else:
+            try:
+                obj = await req.json()
+            except json.JSONDecodeError:
+                return web.json_response(
+                    {"error": "body is not JSON"}, status=400
+                )
+        if not isinstance(obj, dict):
+            return web.json_response(
+                {"error": "body must be a JSON object"}, status=400
+            )
 
         def parse_job(o):
             # Mutating-webhook analog: PodDefaults first, then defaulting
@@ -208,13 +244,25 @@ class ControlPlane:
             validate_pipeline(pl)
             return pl.to_dict()
 
+        def parse_notebook(o):
+            nb = Notebook.from_dict(o)
+            validate_notebook(nb)
+            return nb.to_dict()
+
+        def parse_tensorboard(o):
+            tb = Tensorboard.from_dict(o)
+            validate_tensorboard(tb)
+            return tb.to_dict()
+
         parser = (
             parse_job if kind in JOB_KINDS
             else {"Experiment": parse_experiment,
                   "InferenceService": parse_isvc,
                   "Profile": parse_profile,
                   "PodDefault": parse_pod_default,
-                  "Pipeline": parse_pipeline}.get(kind)
+                  "Pipeline": parse_pipeline,
+                  "Notebook": parse_notebook,
+                  "Tensorboard": parse_tensorboard}.get(kind)
         )
         if parser is not None:
             # Admission-webhook analog: parse + default + validate, then
@@ -314,6 +362,123 @@ class ControlPlane:
             end_step=end_step,
         )
         return web.json_response({"trial": key, "observations": rows})
+
+    # -- KFAM (P7): access bindings + authz middleware ---------------------
+
+    @web.middleware
+    async def _auth_middleware(self, req: web.Request, handler):
+        """Namespace authorization from the X-Kftpu-User header (the
+        reference's Istio RBAC layer, reduced to its semantics).
+        Namespaces without a governing Profile are open; Profile objects
+        themselves are cluster-scoped and write-gated to their owner or
+        the admin (or anyone could apply a Profile naming themselves
+        owner and take a namespace over). Object routes deny by default:
+        anything under /apis/ without a resolvable namespace requires the
+        admin."""
+        if not req.path.startswith("/apis/"):
+            return await handler(req)
+        user = req.headers.get("X-Kftpu-User")
+        kind = req.match_info.get("kind")
+        name = req.match_info.get("name")
+        ns = req.match_info.get("ns") or req.query.get("namespace")
+        body = None
+        if req.method == "POST":
+            try:
+                body = await req.json()
+            except Exception:  # noqa: BLE001 -- malformed -> handler 400s
+                body = None
+            else:
+                # Parsed once here; h_apply reuses it (bodies can be MBs).
+                req["parsed_json"] = body
+        if kind == "Profile":
+            # Cluster-scoped: the governed namespace is the object NAME.
+            governed = name or (
+                ((body or {}).get("metadata") or {}).get("name")
+            )
+            if req.method in ("POST", "DELETE"):
+                ok = governed is not None and self.access.can_manage(
+                    user, governed
+                )
+            elif governed is not None:
+                ok = self.access.can_access(user, governed)
+            else:  # list all profiles: admin only
+                ok = user == self.access.admin
+            if not ok:
+                return web.json_response(
+                    {"error": f"user {user!r} may not access Profile "
+                              f"{governed!r}"},
+                    status=403,
+                )
+            return await handler(req)
+        if ns is None and body is not None:
+            ns = ((body.get("metadata") or {}).get("namespace", "default"))
+        if ns is None:
+            # Cross-namespace list (or unparseable body): admin only --
+            # deny by default rather than leak every namespace's objects.
+            if user != self.access.admin:
+                return web.json_response(
+                    {"error": "cross-namespace access requires the admin; "
+                              "pass ?namespace="},
+                    status=403,
+                )
+        elif not self.access.can_access(user, ns):
+            return web.json_response(
+                {"error": f"user {user!r} may not access namespace "
+                          f"{ns!r}"},
+                status=403,
+            )
+        return await handler(req)
+
+    async def h_kfam_list(self, req: web.Request) -> web.Response:
+        ns = req.query.get("namespace")
+        bindings = self.access.bindings(ns)
+        if self.auth_enabled:
+            # Non-admins see only bindings for namespaces they can access
+            # (the full map is a targeting aid for takeover attempts).
+            user = req.headers.get("X-Kftpu-User")
+            if user != self.access.admin:
+                bindings = [
+                    b for b in bindings
+                    if self.access.can_access(user, b["namespace"])
+                ]
+        return web.json_response(bindings)
+
+    async def h_kfam_add(self, req: web.Request) -> web.Response:
+        try:
+            body = await req.json()
+            user, ns = body["user"], body["namespace"]
+        except Exception:  # noqa: BLE001
+            return web.json_response(
+                {"error": "body needs user and namespace"}, status=422
+            )
+        caller = req.headers.get("X-Kftpu-User")
+        if self.auth_enabled and not self.access.can_manage(caller, ns):
+            return web.json_response(
+                {"error": f"user {caller!r} may not manage bindings for "
+                          f"{ns!r}"},
+                status=403,
+            )
+        try:
+            return web.json_response(self.access.add_binding(user, ns))
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+
+    async def h_kfam_delete(self, req: web.Request) -> web.Response:
+        user = req.query.get("user")
+        ns = req.query.get("namespace")
+        if not user or not ns:
+            return web.json_response(
+                {"error": "query needs user and namespace"}, status=422
+            )
+        caller = req.headers.get("X-Kftpu-User")
+        if self.auth_enabled and not self.access.can_manage(caller, ns):
+            return web.json_response(
+                {"error": f"user {caller!r} may not manage bindings for "
+                          f"{ns!r}"},
+                status=403,
+            )
+        deleted = self.access.delete_binding(user, ns)
+        return web.json_response({"deleted": deleted})
 
     async def h_healthz(self, req: web.Request) -> web.Response:
         return web.json_response({"ok": True, "uptime": time.time() - self.started_at})
