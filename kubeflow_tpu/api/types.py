@@ -188,6 +188,20 @@ class CheckpointPolicy(BaseModel):
     resume: bool = True
 
 
+class ProfilingPolicy(BaseModel):
+    """jax.profiler tracing for a window of training steps (SURVEY.md 5.1:
+    the reference delegates profiling to in-container TensorBoard
+    profilers; this runtime owns it via a job-spec flag). The trace is
+    TensorBoard/Perfetto-viewable."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    enabled: bool = False
+    dir: Optional[str] = None  # default: <log_dir>/profile/<job>
+    start_step: int = Field(default=2, ge=0)  # skip compile steps
+    num_steps: int = Field(default=3, ge=1)
+
+
 class RunPolicy(BaseModel):
     """Job-level lifecycle policy; same field semantics as the reference."""
 
@@ -208,6 +222,7 @@ class JobSpec(BaseModel):
     run_policy: RunPolicy = Field(default_factory=RunPolicy)
     elastic: Optional[ElasticPolicy] = None
     checkpoint: CheckpointPolicy = Field(default_factory=CheckpointPolicy)
+    profiling: ProfilingPolicy = Field(default_factory=ProfilingPolicy)
     # Process count per replica when one replica hosts multiple JAX
     # processes (== nproc_per_node in torch terms). Almost always 1 here:
     # one process per host, all local chips visible to it.

@@ -28,6 +28,9 @@ ENV_REPLICA_TYPE = "KFTPU_REPLICA_TYPE"
 ENV_REPLICA_INDEX = "KFTPU_REPLICA_INDEX"
 ENV_CHECKPOINT_DIR = "KFTPU_CHECKPOINT_DIR"
 ENV_RESUME = "KFTPU_RESUME"
+ENV_PROFILE_DIR = "KFTPU_PROFILE_DIR"
+ENV_PROFILE_START = "KFTPU_PROFILE_START"
+ENV_PROFILE_STEPS = "KFTPU_PROFILE_STEPS"
 
 
 def _flat_ranks(job: TrainJob, replicas_override: dict[ReplicaType, int]) -> list[tuple[ReplicaType, int]]:
@@ -69,6 +72,11 @@ def rendezvous_env(
     if job.spec.checkpoint.dir:
         env[ENV_CHECKPOINT_DIR] = job.spec.checkpoint.dir
         env[ENV_RESUME] = "1" if job.spec.checkpoint.resume else "0"
+    prof = job.spec.profiling
+    if prof.enabled:
+        env[ENV_PROFILE_DIR] = prof.dir or ""
+        env[ENV_PROFILE_START] = str(prof.start_step)
+        env[ENV_PROFILE_STEPS] = str(prof.num_steps)
 
     if job.kind == JobKind.JAXJob:
         env.update(

@@ -51,10 +51,13 @@ class AccessManager:
         )
 
     def can_manage(self, user: Optional[str], namespace: str) -> bool:
-        """Binding management: the profile owner or the admin."""
+        """Binding/Profile management: the profile owner or the admin.
+        Creating governance over a so-far-ungoverned namespace is
+        admin-only -- otherwise anyone could claim an in-use open
+        namespace by posting a Profile naming themselves owner."""
         prof = self._profile(namespace)
         if prof is None:
-            return True
+            return user is not None and user == self.admin
         return user is not None and (
             user == self.admin or user == prof.spec.owner
         )

@@ -272,7 +272,10 @@ class WorkbenchController:
             )
             wb.status.url = f"http://127.0.0.1:{port}"
             wb.status.set_condition("Ready", "Running")
+            # Single persist per reconcile: its watch event re-enters
+            # reconcile, which then schedules the culling poll.
             self._persist(kind, wb, status_before)
+            return
         else:
             wb.status.url = f"http://127.0.0.1:{run.port}"
             wb.status.set_condition("Ready", "Running")

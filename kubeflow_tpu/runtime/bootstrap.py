@@ -29,6 +29,10 @@ class WorkerContext:
     coordinator: Optional[str]
     checkpoint_dir: Optional[str]
     resume: bool
+    # jax.profiler window (SURVEY.md 5.1); profile_steps == 0 -> disabled.
+    profile_dir: Optional[str] = None
+    profile_start: int = 0
+    profile_steps: int = 0
 
     @property
     def is_coordinator(self) -> bool:
@@ -47,6 +51,9 @@ def read_context() -> WorkerContext:
         coordinator=env.get("JAX_COORDINATOR_ADDRESS"),
         checkpoint_dir=env.get("KFTPU_CHECKPOINT_DIR") or None,
         resume=env.get("KFTPU_RESUME", "1") == "1",
+        profile_dir=env.get("KFTPU_PROFILE_DIR") or None,
+        profile_start=int(env.get("KFTPU_PROFILE_START", "0")),
+        profile_steps=int(env.get("KFTPU_PROFILE_STEPS", "0")),
     )
 
 

@@ -103,9 +103,28 @@ def main(argv=None) -> int:
         mlog.emit(event="train_start", model=task.name, start_step=start_step,
                   steps=args.steps, world=ctx.num_processes)
 
+        # jax.profiler window (SURVEY.md 5.1): rank 0 traces steps
+        # [profile_start, profile_start + profile_steps); the trace is
+        # TensorBoard/Perfetto-viewable from profile_dir.
+        profiling = ctx.profile_steps > 0 and ctx.process_id == 0
+        profile_dir = ctx.profile_dir or os.path.join(
+            os.environ.get("KFTPU_LOG_DIR", "/tmp/kftpu"),
+            "profile", ctx.job_name,
+        )
+        prof_active = False
+
         data = task.data_iter(ctx.num_processes, ctx.process_id, mesh, args.seed)
         metrics = {}
         for step in range(start_step, args.steps):
+            # >= not ==: a checkpoint resume landing inside (or past the
+            # start of) the window still traces the remaining steps.
+            if (profiling and not prof_active
+                    and step >= ctx.profile_start
+                    and step < ctx.profile_start + ctx.profile_steps):
+                os.makedirs(profile_dir, exist_ok=True)
+                jax.profiler.start_trace(profile_dir)
+                prof_active = True
+                mlog.emit(event="profile_start", step=step, dir=profile_dir)
             batch = next(data)
             # Transient-fault semantics: the injected death fires only in a
             # fresh (non-resumed) incarnation, so restart+resume recovers --
@@ -118,6 +137,13 @@ def main(argv=None) -> int:
                 ckpt.wait()
                 os._exit(137)
             state, metrics = step_fn(state, *batch)
+            if prof_active and step >= ctx.profile_start + ctx.profile_steps - 1:
+                # Sync so the trace includes real device work, not just
+                # dispatch (transfer = sync on this backend, bench.py note).
+                float(metrics["loss"])
+                jax.profiler.stop_trace()
+                prof_active = False
+                mlog.emit(event="profile_end", step=step, dir=profile_dir)
             ckpt.maybe_save(step, state)
             if step % args.log_every == 0 or step == args.steps - 1:
                 mlog.log_step(
@@ -126,6 +152,9 @@ def main(argv=None) -> int:
                     **{k: f"{float(v):.4f}" for k, v in metrics.items()
                        if k != "loss"},
                 )
+        if prof_active:  # window extended past the last step
+            jax.profiler.stop_trace()
+            mlog.emit(event="profile_end", step=args.steps - 1, dir=profile_dir)
         if ckpt.enabled:
             ckpt.maybe_save(args.steps - 1, state, force=True)
             ckpt.close()

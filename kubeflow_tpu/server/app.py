@@ -375,19 +375,25 @@ class ControlPlane:
         owner and take a namespace over). Object routes deny by default:
         anything under /apis/ without a resolvable namespace requires the
         admin."""
-        if not req.path.startswith("/apis/"):
+        gated = ("/apis/", "/logs/", "/events/", "/observations/",
+                 "/serving/")
+        if not req.path.startswith(gated):
             return await handler(req)
         user = req.headers.get("X-Kftpu-User")
         kind = req.match_info.get("kind")
         name = req.match_info.get("name")
         ns = req.match_info.get("ns") or req.query.get("namespace")
         body = None
-        if req.method == "POST":
+        if req.method == "POST" and req.path.startswith("/apis/"):
             try:
                 body = await req.json()
             except Exception:  # noqa: BLE001 -- malformed -> handler 400s
                 body = None
             else:
+                if not isinstance(body, dict):
+                    return web.json_response(
+                        {"error": "body must be a JSON object"}, status=400
+                    )
                 # Parsed once here; h_apply reuses it (bodies can be MBs).
                 req["parsed_json"] = body
         if kind == "Profile":
@@ -450,6 +456,14 @@ class ControlPlane:
         except Exception:  # noqa: BLE001
             return web.json_response(
                 {"error": "body needs user and namespace"}, status=422
+            )
+        if not (isinstance(user, str) and user
+                and isinstance(ns, str) and ns):
+            # A non-string contributor would bypass pydantic (we mutate
+            # the stored dict) and poison every later Profile parse.
+            return web.json_response(
+                {"error": "user and namespace must be non-empty strings"},
+                status=422,
             )
         caller = req.headers.get("X-Kftpu-User")
         if self.auth_enabled and not self.access.can_manage(caller, ns):

@@ -317,6 +317,12 @@ class ISVCController:
             ]
             if m.storage_uri:
                 args += ["--storage-uri", m.storage_uri]
+        if comp.logger is not None:
+            # Part of the runtime flag contract (runtimes/common.py);
+            # custom entrypoints opting into logger: must accept it too.
+            args += ["--logger-json", json.dumps(
+                {"sink": comp.logger.sink, "mode": comp.logger.mode}
+            )]
         return SpawnRequest(
             job_key=f"{ns}/{name}",
             replica_type="server",

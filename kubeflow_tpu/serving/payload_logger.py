@@ -1,0 +1,121 @@
+"""Request/response payload logger (SURVEY.md 3.3 S6, KServe's logger).
+
+The reference's agent sidecar posts CloudEvents-wrapped request/response
+payloads to a sink URL. Here the model server logs them itself: each
+predict produces up to two events (request, response) written as JSONL to
+a file sink or POSTed to an http sink (localhost only -- this environment
+has no egress, and the reference's sink is an in-cluster collector
+anyway). Events follow the CloudEvents-ish shape KServe emits:
+``{id, type, source, time, model, data}``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+MODE_ALL = "all"
+MODE_REQUEST = "request"
+MODE_RESPONSE = "response"
+MODES = (MODE_ALL, MODE_REQUEST, MODE_RESPONSE)
+
+TYPE_REQUEST = "org.kubeflow.serving.inference.request"
+TYPE_RESPONSE = "org.kubeflow.serving.inference.response"
+
+
+class PayloadLogger:
+    def __init__(self, sink: str, mode: str = MODE_ALL,
+                 source: str = "kftpu-modelserver",
+                 max_bytes: int = 1 << 20) -> None:
+        if mode not in MODES:
+            raise ValueError(f"logger mode {mode!r} not in {MODES}")
+        self.sink = sink
+        self.mode = mode
+        self.source = source
+        self.max_bytes = max_bytes
+        self._http = sink.startswith(("http://", "https://"))
+        self._session = None  # lazily-created shared aiohttp session
+        # Fire-and-forget emits: retain tasks so they aren't GC'd mid-run;
+        # close() drains them.
+        self._tasks: set = set()
+
+    def new_id(self) -> str:
+        return str(uuid.uuid4())
+
+    def _event(self, etype: str, model: str, payload: Any,
+               request_id: str) -> dict:
+        data = json.dumps(payload)
+        if len(data) > self.max_bytes:
+            data = data[: self.max_bytes]
+        return {
+            "id": request_id,
+            "type": etype,
+            "source": self.source,
+            "time": time.time(),
+            "model": model,
+            "data": data,
+        }
+
+    async def log_request(self, model: str, payload: Any,
+                          request_id: str) -> None:
+        if self.mode in (MODE_ALL, MODE_REQUEST):
+            self._schedule(self._event(TYPE_REQUEST, model, payload,
+                                       request_id))
+
+    async def log_response(self, model: str, payload: Any,
+                           request_id: str) -> None:
+        if self.mode in (MODE_ALL, MODE_RESPONSE):
+            self._schedule(self._event(TYPE_RESPONSE, model, payload,
+                                       request_id))
+
+    def _schedule(self, event: dict) -> None:
+        """Fire-and-forget: the predict path never waits on the sink."""
+        task = asyncio.get_running_loop().create_task(self._emit(event))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def close(self) -> None:
+        if self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=True)
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def _emit(self, event: dict) -> None:
+        """Best-effort: logging must never fail a prediction."""
+        try:
+            if self._http:
+                import aiohttp
+
+                if self._session is None or self._session.closed:
+                    self._session = aiohttp.ClientSession(
+                        timeout=aiohttp.ClientTimeout(total=2)
+                    )
+                await self._session.post(self.sink, json=event)
+            else:
+                line = json.dumps(event) + "\n"
+                await asyncio.to_thread(self._append, line)
+        except Exception as e:  # noqa: BLE001 -- sink failures are non-fatal
+            logger.warning("payload logger sink %s failed: %s", self.sink, e)
+
+    def _append(self, line: str) -> None:
+        path = self.sink[len("file://"):] if self.sink.startswith("file://") \
+            else self.sink
+        with open(path, "a") as f:
+            f.write(line)
+
+
+def from_json(cfg: Optional[str]) -> Optional[PayloadLogger]:
+    """Build from the --logger-json flag ('{\"sink\":..,\"mode\":..}')."""
+    if not cfg:
+        return None
+    d = json.loads(cfg)
+    if not d.get("sink"):
+        return None
+    return PayloadLogger(d["sink"], d.get("mode", MODE_ALL))

@@ -39,6 +39,8 @@ def serve_main(factory: ModelFactory, argv=None) -> int:
                    help="format-specific options (ModelSpec.options)")
     p.add_argument("--max-batch", type=int, default=32)
     p.add_argument("--max-latency-ms", type=float, default=5.0)
+    p.add_argument("--logger-json", default=None,
+                   help='payload logger config: {"sink": ..., "mode": ...}')
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -54,7 +56,12 @@ def serve_main(factory: ModelFactory, argv=None) -> int:
     repo.register(model, max_batch=args.max_batch, max_latency_ms=args.max_latency_ms)
     model.load()
 
-    server = ModelServer(repository=repo)
+    from kubeflow_tpu.serving import payload_logger
+
+    server = ModelServer(
+        repository=repo,
+        payload_logger=payload_logger.from_json(args.logger_json),
+    )
     logging.getLogger(__name__).info(
         "serving %s on %s:%d (model path %s)",
         args.model_name, args.host, args.port, path,

@@ -38,9 +38,12 @@ logger = logging.getLogger(__name__)
 
 class ModelServer:
     def __init__(self, repository: Optional[ModelRepository] = None,
-                 name: str = "kftpu-modelserver") -> None:
+                 name: str = "kftpu-modelserver",
+                 payload_logger=None) -> None:
         self.name = name
         self.repository = repository or ModelRepository()
+        # S6 request/response logger (serving.payload_logger), optional.
+        self.payload_logger = payload_logger
         self.started_at = time.time()
         self.request_count = 0
         self.error_count = 0
@@ -72,6 +75,8 @@ class ModelServer:
 
         async def on_cleanup(app):
             await self.repository.stop()
+            if self.payload_logger is not None:
+                await self.payload_logger.close()
 
         app.on_startup.append(on_startup)
         app.on_cleanup.append(on_cleanup)
@@ -130,11 +135,14 @@ class ModelServer:
             instances = body.get("instances")
             if not isinstance(instances, list):
                 raise InferenceError('body must have "instances": [...]', status=400)
+            rid = await self._log_request(name, body, req)
             batcher = self.repository.batcher(name)
             pre = [model.preprocess(i) for i in instances]
             outs = await asyncio.gather(*(batcher.predict(i) for i in pre))
             preds = [model.postprocess(o) for o in outs]
-            return web.json_response({"predictions": preds})
+            resp = {"predictions": preds}
+            await self._log_response(name, resp, rid)
+            return web.json_response(resp)
         except json.JSONDecodeError:
             self.error_count += 1
             return web.json_response({"error": "body is not JSON"}, status=400)
@@ -183,6 +191,7 @@ class ModelServer:
             inputs = body.get("inputs")
             if not isinstance(inputs, list) or not inputs:
                 raise InferenceError('body must have "inputs": [...]', status=400)
+            rid = await self._log_request(name, body, req)
             batcher = self.repository.batcher(name)
             # V2 tensors ride through preprocess/predict as dicts; simple
             # models treat input.data as the instance list.
@@ -196,9 +205,11 @@ class ModelServer:
                     "name": "output_0", "datatype": "FP32",
                     "shape": [len(outs)], "data": outputs,
                 }]
-            return web.json_response({
+            resp = {
                 "model_name": name, "id": body.get("id", ""), "outputs": outputs,
-            })
+            }
+            await self._log_response(name, resp, rid)
+            return web.json_response(resp)
         except json.JSONDecodeError:
             self.error_count += 1
             return web.json_response({"error": "body is not JSON"}, status=400)
@@ -207,6 +218,19 @@ class ModelServer:
             return self._err(e)
         finally:
             self.predict_seconds += time.monotonic() - t0
+
+    # -- payload logging (S6) ----------------------------------------------
+
+    async def _log_request(self, model: str, body, req) -> str:
+        if self.payload_logger is None:
+            return ""
+        rid = req.headers.get("X-Request-Id") or self.payload_logger.new_id()
+        await self.payload_logger.log_request(model, body, rid)
+        return rid
+
+    async def _log_response(self, model: str, resp, rid: str) -> None:
+        if self.payload_logger is not None:
+            await self.payload_logger.log_response(model, resp, rid)
 
     async def h_v2_load(self, req: web.Request) -> web.Response:
         try:

@@ -62,6 +62,16 @@ class CustomSpec(BaseModel):
     env: Dict[str, str] = Field(default_factory=dict)
 
 
+class LoggerSpec(BaseModel):
+    """S6 request/response payload logging: JSONL file sink or http sink
+    (KServe's logger.url/logger.mode)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    sink: str  # file path, file://, or http(s):// collector
+    mode: str = "all"  # all | request | response
+
+
 class ComponentSpec(BaseModel):
     """One ISVC component (predictor or transformer)."""
 
@@ -69,6 +79,7 @@ class ComponentSpec(BaseModel):
 
     model: Optional[ModelSpec] = None
     custom: Optional[CustomSpec] = None
+    logger: Optional[LoggerSpec] = None
     resources: Resources = Field(default_factory=Resources)
     min_replicas: int = 1  # 0 = scale-to-zero
     max_replicas: int = 1
@@ -159,6 +170,12 @@ def validate_isvc(isvc: InferenceService) -> None:
         if (comp.model is None) == (comp.custom is None):
             raise ServingValidationError(
                 f"{label}: exactly one of model/custom must be set"
+            )
+        if comp.logger is not None and comp.logger.mode not in (
+            "all", "request", "response"
+        ):
+            raise ServingValidationError(
+                f"{label}: logger.mode must be all|request|response"
             )
         if comp.model is not None:
             if comp.model.format == ModelFormat.custom:

@@ -226,3 +226,108 @@ def test_sklearn_runtime(tmp_path):
     proba.load()
     out = proba.predict([[0.0]])
     assert len(out[0]) == 2 and abs(sum(out[0]) - 1.0) < 1e-6
+
+
+# -- payload logger (S6) ----------------------------------------------------
+
+
+def test_payload_logger_file_sink(tmp_path):
+    from kubeflow_tpu.serving.payload_logger import PayloadLogger
+
+    sink = tmp_path / "payloads.jsonl"
+
+    async def run():
+        repo = ModelRepository()
+        model = EchoModel("demo", "/models/demo", {})
+        repo.register(model)
+        model.load()
+        server = ModelServer(
+            repository=repo,
+            payload_logger=PayloadLogger(str(sink)),
+        )
+        c = TestClient(TestServer(server.build_app()))
+        await c.start_server()
+        try:
+            r = await c.post(
+                "/v1/models/demo:predict",
+                json={"instances": [1]},
+                headers={"X-Request-Id": "rid-1"},
+            )
+            assert r.status == 200
+        finally:
+            await c.close()
+
+    asyncio.run(run())
+    import json
+
+    events = [json.loads(l) for l in sink.read_text().splitlines()]
+    assert [e["type"] for e in events] == [
+        "org.kubeflow.serving.inference.request",
+        "org.kubeflow.serving.inference.response",
+    ]
+    # Request and response correlate by the caller's request id.
+    assert {e["id"] for e in events} == {"rid-1"}
+    assert events[0]["model"] == "demo"
+    assert "instances" in events[0]["data"]
+    assert "predictions" in events[1]["data"]
+
+
+def test_payload_logger_mode_filter(tmp_path):
+    from kubeflow_tpu.serving.payload_logger import PayloadLogger
+
+    sink = tmp_path / "req_only.jsonl"
+
+    async def run():
+        repo = ModelRepository()
+        model = EchoModel("demo", "/models/demo", {})
+        repo.register(model)
+        model.load()
+        server = ModelServer(
+            repository=repo,
+            payload_logger=PayloadLogger(str(sink), mode="request"),
+        )
+        c = TestClient(TestServer(server.build_app()))
+        await c.start_server()
+        try:
+            await c.post("/v2/models/demo/infer",
+                         json={"inputs": [{"name": "x", "data": [1]}]})
+        finally:
+            await c.close()
+
+    asyncio.run(run())
+    import json
+
+    events = [json.loads(l) for l in sink.read_text().splitlines()]
+    assert len(events) == 1
+    assert events[0]["type"] == "org.kubeflow.serving.inference.request"
+
+
+def test_payload_logger_sink_failure_is_nonfatal(tmp_path):
+    from kubeflow_tpu.serving.payload_logger import PayloadLogger
+
+    async def run():
+        repo = ModelRepository()
+        model = EchoModel("demo", "/models/demo", {})
+        repo.register(model)
+        model.load()
+        server = ModelServer(
+            repository=repo,
+            payload_logger=PayloadLogger(str(tmp_path / "no" / "dir" / "x")),
+        )
+        c = TestClient(TestServer(server.build_app()))
+        await c.start_server()
+        try:
+            r = await c.post("/v1/models/demo:predict", json={"instances": [1]})
+            assert r.status == 200  # prediction unaffected by sink failure
+        finally:
+            await c.close()
+
+    asyncio.run(run())
+
+
+def test_logger_spec_validation():
+    spec = isvc_dict(logger={"sink": "/tmp/x", "mode": "nope"})
+    with pytest.raises(ServingValidationError, match="logger.mode"):
+        validate_isvc(InferenceService.from_dict(spec))
+    spec["spec"]["predictor"]["logger"]["mode"] = "response"
+    validate_isvc(InferenceService.from_dict(spec))

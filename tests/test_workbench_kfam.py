@@ -390,6 +390,33 @@ class TestKFAMServer:
                                user="bob")
         assert code == 200
         assert all(b["namespace"] == "teama" for b in body) and body
+        # Logs/events/observations/serving data plane are gated too.
+        code, _ = self._req(server, "GET", "/logs/teama/j1", user="carol")
+        assert code == 403
+        code, _ = self._req(server, "GET", "/events/teama/j1", user="carol")
+        assert code == 403
+        # An ungoverned namespace cannot be claimed by a non-admin (or
+        # anonymous) Profile apply.
+        code, _ = self._req(server, "POST", "/apis/Profile", {
+            "kind": "Profile", "metadata": {"name": "default"},
+            "spec": {"owner": "mallory"},
+        }, user="mallory")
+        assert code == 403
+        code, _ = self._req(server, "POST", "/apis/Profile", {
+            "kind": "Profile", "metadata": {"name": "default"},
+            "spec": {"owner": "mallory"},
+        })
+        assert code == 403
+        # Non-string binding users are rejected before they poison the
+        # stored Profile.
+        code, _ = self._req(server, "POST", "/kfam/v1/bindings",
+                            {"user": {"x": 1}, "namespace": "teama"},
+                            user="alice")
+        assert code == 422
+        # Valid-JSON non-dict bodies get 400, not 500.
+        code, _ = self._req(server, "POST", "/apis/JAXJob", [1, 2],
+                            user="admin")
+        assert code == 400
 
 
 import urllib.error  # noqa: E402
