@@ -1,23 +1,86 @@
-"""Pallas TPU flash attention (placeholder until the kernel milestone).
+"""Pallas TPU flash attention.
 
-Falls back to XLA attention; replaced by the tiled online-softmax Pallas
-kernel in the long-context milestone.
+Tiled online-softmax attention (forward + backward kernels) via
+``jax.experimental.pallas.ops.tpu.flash_attention`` -- O(S) HBM traffic
+instead of materializing the S x S score matrix. GQA is handled by
+broadcasting KV heads to the query head count before the kernel (K/V are
+small relative to scores; the broadcast is fused by XLA).
+
+Layout contract matches kubeflow_tpu.ops.attention: [B, S, H, D] in/out
+(the kernel itself wants [B, H, S, D]). Falls back to XLA attention off
+TPU or for shapes the kernel cannot tile; callers go through
+``dot_product_attention(impl="auto")`` which also gates on seq length.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
 
+# Tiling floor: the kernel wants 128-multiples in seq and head_dim.
+_MIN_BLOCK = 128
+
+
+@functools.cache
+def _kernel():
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+    return fa
+
+
+def _block_sizes(seq_q: int, seq_k: int):
+    fa = _kernel()
+    # Largest 128-multiple <= 512 dividing both seqs (the kernel requires
+    # exact tiling; e.g. seq 640 must use 128, not 512).
+    b = next(c for c in (512, 384, 256, 128)
+             if seq_q % c == 0 and seq_k % c == 0)
+    return fa.BlockSizes(
+        block_q=b, block_k_major=b, block_k=b, block_b=1,
+        block_q_major_dkv=b, block_k_major_dkv=b, block_k_dkv=b,
+        block_q_dkv=b,
+        block_k_major_dq=b, block_k_dq=b, block_q_dq=b,
+    )
+
 
 def flash_attention(
-    q: jax.Array,
-    k: jax.Array,
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, Hkv, D]
     v: jax.Array,
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     from kubeflow_tpu.ops.attention import xla_attention
 
-    return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    n_rep = q.shape[2] // k.shape[2]
+    if (
+        jax.default_backend() != "tpu"
+        # Self-attention only: the kernel's causal mask is zero-aligned,
+        # xla_attention tail-aligns Sq < Sk (decode/chunked prefill) --
+        # different semantics, same guard as the ring path.
+        or q.shape[1] != k.shape[1]
+        or q.shape[1] < _MIN_BLOCK
+        or q.shape[1] % _MIN_BLOCK
+        or q.shape[-1] % _MIN_BLOCK
+    ):
+        return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    fa = _kernel()
+    if n_rep > 1:
+        from kubeflow_tpu.ops.attention import _repeat_kv
+
+        k = _repeat_kv(k, n_rep)
+        v = _repeat_kv(v, n_rep)
+    # [B, S, H, D] -> [B, H, S, D]
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    seg = None
+    if segment_ids is not None:
+        seg = fa.SegmentIds(q=segment_ids, kv=segment_ids)
+    out = fa.flash_attention(
+        qt, kt, vt,
+        causal=causal,
+        segment_ids=seg,
+        sm_scale=1.0 / (q.shape[-1] ** 0.5),
+        block_sizes=_block_sizes(q.shape[1], k.shape[1]),
+    )
+    return out.transpose(0, 2, 1, 3)
