@@ -30,6 +30,7 @@ from kubeflow_tpu.hpo.metrics import (
     median_should_stop,
     observation_of,
     scrape,
+    scrape_prometheus,
     worker_log_path,
 )
 from kubeflow_tpu.hpo.types import (
@@ -452,7 +453,7 @@ class HPOController:
             return
 
         phase = phase_of_obj(job)
-        self._scrape_metrics(trial, ns, name)
+        await self._scrape_metrics(trial, ns, name)
 
         if phase == "Running":
             trial.status.set_condition("Running", "JobRunning")
@@ -473,16 +474,10 @@ class HPOController:
             trial.status.completion_time = time.time()
         self._persist_trial(trial, status_before)
 
-    def _scrape_metrics(self, trial: Trial, ns: str, name: str) -> None:
+    async def _scrape_metrics(self, trial: Trial, ns: str, name: str) -> None:
         if self.log_dir is None:
             return
         mc = trial.spec.metrics_collector
-        if mc.kind == "file" and mc.file_path:
-            path = mc.file_path
-        else:
-            path = worker_log_path(
-                self.log_dir, ns, name, trial.spec.primary_replica, 0
-            )
         names = [trial.spec.objective_metric_name] + list(
             trial.spec.additional_metric_names
         )
@@ -490,9 +485,37 @@ class HPOController:
         offset, series, auto_step = self._scrape_cache.get(
             key, (0, {n: [] for n in names}, 0)
         )
-        _, delta, new_offset, auto_step = scrape(mc, path, names, offset, auto_step)
-        if new_offset == offset:
-            return
+        if mc.kind == "prometheus" and mc.url:
+            # One gauge sample per poll; offset doubles as "polls so far".
+            # Off-thread: the blocking GET (up to 1s timeout) must not
+            # stall the event loop shared with the HTTP API.
+            _, delta, auto_step = await asyncio.to_thread(
+                scrape_prometheus, mc.url, names, auto_step
+            )
+            new_offset = offset + 1
+            # Gauges repeat between polls; record only value movement
+            # (auto-numbered steps would otherwise re-record a flat gauge
+            # every poll and grow status without bound).
+            for n in names:
+                tail = series.get(n, [])[-1:]
+                delta[n] = [
+                    p for p in delta.get(n, [])
+                    if not tail or p[1] != tail[0][1]
+                ]
+            if not any(delta.values()):
+                return
+        else:
+            if mc.kind == "file" and mc.file_path:
+                path = mc.file_path
+            else:
+                path = worker_log_path(
+                    self.log_dir, ns, name, trial.spec.primary_replica, 0
+                )
+            _, delta, new_offset, auto_step = scrape(
+                mc, path, names, offset, auto_step
+            )
+            if new_offset == offset:
+                return
         if self.obs_db is not None:
             self.obs_db.report_observation_log(key, delta)
         for n in names:

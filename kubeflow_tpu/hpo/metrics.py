@@ -74,6 +74,54 @@ def scrape(
     return observation_of(series), series, new_offset, auto_step
 
 
+def parse_prometheus_text(text: str) -> dict[str, float]:
+    """Parse Prometheus exposition format into {metric_name: value}.
+
+    Labels are ignored (the reference's prometheus collector filters by
+    metric name too); last sample of a repeated name wins.
+    """
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) < 2:
+            continue
+        name = parts[0].split("{", 1)[0]
+        try:
+            out[name] = float(parts[1])
+        except ValueError:
+            continue
+    return out
+
+
+def scrape_prometheus(
+    url: str,
+    metric_names: list[str],
+    auto_step: int = 0,
+    timeout: float = 1.0,
+) -> tuple[Observation, dict[str, list[tuple[int, float]]], int]:
+    """One poll of a Prometheus endpoint -> (observation-of-sample,
+    per-metric single-point series, new auto_step). Unreachable endpoints
+    yield an empty sample (the workload may still be booting)."""
+    import urllib.request
+
+    series: dict[str, list[tuple[int, float]]] = {n: [] for n in metric_names}
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            values = parse_prometheus_text(r.read().decode(errors="replace"))
+    except Exception:  # noqa: BLE001 -- bad url/HTTP garbage/timeouts all
+        # mean "no sample this poll", never a reconcile crash-loop.
+        return Observation(), series, auto_step
+    auto_step += 1
+    step = int(values.get("step", auto_step))
+    for n in metric_names:
+        if n in values:
+            series[n].append((step, values[n]))
+    return observation_of(series), series, auto_step
+
+
 def observation_of(series: dict[str, list[tuple[int, float]]]) -> Observation:
     metrics = []
     for name, hist in series.items():

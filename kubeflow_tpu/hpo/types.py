@@ -95,14 +95,18 @@ class EarlyStoppingSpec(BaseModel):
 
 
 class MetricsCollectorSpec(BaseModel):
-    """Stdout scraping config (K5). ``kind=stdout`` parses KFTPU-METRIC
+    """Metrics collection config (K5). ``kind=stdout`` parses KFTPU-METRIC
     key=value lines from the primary replica's log; ``kind=file`` tails a
-    JSON-lines file of {"name":..., "value":..., "step":...} records."""
+    JSON-lines file of {"name":..., "value":..., "step":...} records;
+    ``kind=prometheus`` polls a Prometheus exposition endpoint (``url``)
+    for gauge values -- a ``step`` gauge provides the x-axis, else polls
+    are numbered sequentially."""
 
     model_config = ConfigDict(extra="forbid")
 
     kind: str = "stdout"
     file_path: Optional[str] = None
+    url: Optional[str] = None
 
 
 class TrialTemplate(BaseModel):
@@ -347,3 +351,16 @@ def validate_experiment(exp: Experiment) -> None:
     if exp.spec.algorithm.name == "hyperband":
         # Surface bad resource/eta settings at admission, not mid-experiment.
         HyperbandSuggester(exp.spec)._cfg()
+    mc = exp.spec.metrics_collector
+    if mc.kind not in ("stdout", "file", "prometheus"):
+        raise ValueError(
+            f"metrics_collector.kind {mc.kind!r} not in "
+            "stdout|file|prometheus"
+        )
+    if mc.kind == "prometheus":
+        if not mc.url or not mc.url.startswith(("http://", "https://")):
+            raise ValueError(
+                "metrics_collector kind=prometheus needs an http(s) url"
+            )
+    if mc.kind == "file" and not mc.file_path:
+        raise ValueError("metrics_collector kind=file needs file_path")

@@ -57,6 +57,16 @@ def main(argv=None) -> int:
 
     import jax
 
+    # Numerics debugging (SURVEY.md 5.2: the TPU analog of the reference's
+    # `go test -race` CI switch): KFTPU_DEBUG_NANS=1 makes every jitted
+    # computation re-run un-jitted on NaN and raise with the culprit op;
+    # KFTPU_CHECK_LEAKS=1 errors on tracer leaks. Both are debug-only --
+    # they disable async dispatch and must stay off in production runs.
+    if os.environ.get("KFTPU_DEBUG_NANS", "") == "1":
+        jax.config.update("jax_debug_nans", True)
+    if os.environ.get("KFTPU_CHECK_LEAKS", "") == "1":
+        jax.config.update("jax_check_tracer_leaks", True)
+
     from kubeflow_tpu.models import get_task
     from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
     from kubeflow_tpu.runtime.checkpoint import Checkpointer

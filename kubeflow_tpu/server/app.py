@@ -173,6 +173,9 @@ class ControlPlane:
                 web.get("/observations/{ns}/{name}", self.h_observations),
                 web.get("/healthz", self.h_healthz),
                 web.get("/metrics", self.h_metrics),
+                # Central-dashboard equivalent (P5): one page over /apis/.
+                web.get("/dashboard", self.h_dashboard),
+                web.get("/", self.h_dashboard),
                 # KFAM-equivalent access management API (P7).
                 web.get("/kfam/v1/bindings", self.h_kfam_list),
                 web.post("/kfam/v1/bindings", self.h_kfam_add),
@@ -494,6 +497,13 @@ class ControlPlane:
         deleted = self.access.delete_binding(user, ns)
         return web.json_response({"deleted": deleted})
 
+    async def h_dashboard(self, req: web.Request) -> web.Response:
+        """Central-dashboard equivalent (SURVEY.md 3.4 P5): a single
+        self-contained page aggregating every kind's objects and phases
+        over the /apis/ routes (so it sees exactly what the CLI sees,
+        authorization included)."""
+        return web.Response(text=_DASHBOARD_PAGE, content_type="text/html")
+
     async def h_healthz(self, req: web.Request) -> web.Response:
         return web.json_response({"ok": True, "uptime": time.time() - self.started_at})
 
@@ -520,6 +530,67 @@ def obj_with_preserved_status(store: ObjectStore, kind: str, obj: dict) -> dict:
         obj = dict(obj)
         obj["status"] = existing["status"]
     return obj
+
+
+_DASHBOARD_PAGE = """<!doctype html>
+<html><head><title>kftpu dashboard</title><style>
+body{font-family:monospace;margin:2em;background:#fafafa}
+h1{font-size:1.3em} h2{font-size:1.05em;margin:1.2em 0 .3em}
+table{border-collapse:collapse;min-width:40em}
+td,th{border:1px solid #ccc;padding:3px 8px;text-align:left;font-size:13px}
+th{background:#eee}
+.Succeeded,.Ready{color:#0a0} .Failed{color:#c00}
+.Running{color:#06c} .Pending,.Unready{color:#b60}
+#err{color:#c00}
+</style></head><body>
+<h1>kftpu control plane</h1>
+<div id="err"></div><div id="root">loading...</div>
+<script>
+const KINDS = ["JAXJob","TFJob","PyTorchJob","MPIJob","XGBoostJob",
+  "PaddleJob","Experiment","Trial","InferenceService","Pipeline",
+  "Notebook","Tensorboard","Profile","PodDefault"];
+const PHASE_ORDER = ["Failed","Succeeded","Suspended","Restarting",
+  "Running","Ready","Unready","Created"];
+function phaseOf(o){
+  const active = (o.status && o.status.conditions || [])
+    .filter(c=>c.status).map(c=>c.type);
+  for (const t of PHASE_ORDER) if (active.includes(t))
+    return t === "Created" ? "Pending" : t;
+  return "Pending";
+}
+function esc(s){
+  return String(s).replace(/[&<>"']/g, c => ({"&":"&amp;","<":"&lt;",
+    ">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+}
+async function main(){
+  const root = document.getElementById("root"); root.innerHTML = "";
+  for (const kind of KINDS){
+    let items;
+    try {
+      const r = await fetch("apis/" + kind);
+      if (!r.ok) continue;
+      items = (await r.json()).items || [];
+    } catch (e) { continue; }
+    if (!items.length) continue;
+    const rows = items.map(o=>{
+      const ph = phaseOf(o);
+      // Escape everything object-controlled; links only for http(s).
+      const raw = o.status && o.status.url;
+      const url = raw && /^https?:\\/\\//.test(raw)
+        ? ' <a href="'+esc(raw)+'">open</a>' : "";
+      return "<tr><td>"+esc(o.metadata.namespace||"default")+"</td><td>"
+        +esc(o.metadata.name)+'</td><td class="'+esc(ph)+'">'
+        +esc(ph)+url+"</td></tr>";
+    }).join("");
+    root.innerHTML += "<h2>"+kind+" ("+items.length+")</h2>"
+      +"<table><tr><th>namespace</th><th>name</th><th>phase</th></tr>"
+      +rows+"</table>";
+  }
+  if (!root.innerHTML) root.innerHTML = "no objects yet";
+}
+main().catch(e=>{document.getElementById("err").textContent = e});
+</script></body></html>
+"""
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,131 @@
+"""HuggingFace transformers runtime (KServe huggingfaceserver equivalent,
+SURVEY.md 3.3 S5).
+
+Serves a local ``save_pretrained`` directory (storage_uri -> file path; no
+network -- this environment is egress-gated and the reference's server
+also prefers pre-staged models) behind the V1/V2 protocols:
+
+- task=text-generation (default): AutoModelForCausalLM.generate. With a
+  tokenizer in the model dir, instances are prompts (str or
+  {"text", "max_new_tokens"}) and predictions are strings; without one
+  (tokenizer=none), instances are token-id lists and predictions are
+  token-id lists -- the hermetic mode tests use.
+- task=text-classification: AutoModelForSequenceClassification; returns
+  {label, score}.
+
+Torch runs CPU-side here; the TPU-native LLM path is the ``jax`` format
+(serving.engine) -- this runtime exists for HF-ecosystem parity, e.g.
+serving a model family the JAX engine does not implement yet.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from kubeflow_tpu.serving.model import InferenceError, Model
+from kubeflow_tpu.serving.runtimes.common import serve_main
+
+
+class HuggingFaceModel(Model):
+    def __init__(self, name: str, path: Optional[str],
+                 options: Dict[str, Any]) -> None:
+        super().__init__(name)
+        self.path = path
+        self.options = options
+        self.task = options.get("task", "text-generation")
+        self.max_new_tokens = int(options.get("max_new_tokens", 32))
+        self._model = None
+        self._tokenizer = None
+
+    def load(self) -> None:
+        if self.path is None:
+            raise InferenceError(
+                "huggingface runtime requires storage_uri pointing at a "
+                "save_pretrained directory", 500,
+            )
+        import torch  # noqa: F401  -- fail early if torch is unavailable
+        from transformers import (
+            AutoModelForCausalLM,
+            AutoModelForSequenceClassification,
+            AutoTokenizer,
+        )
+
+        if self.task == "text-generation":
+            cls = AutoModelForCausalLM
+        elif self.task == "text-classification":
+            cls = AutoModelForSequenceClassification
+        else:
+            raise InferenceError(f"unsupported task {self.task!r}", 500)
+        self._model = cls.from_pretrained(self.path, local_files_only=True)
+        self._model.eval()
+        if str(self.options.get("tokenizer", "")) != "none":
+            try:
+                self._tokenizer = AutoTokenizer.from_pretrained(
+                    self.path, local_files_only=True
+                )
+            except Exception as e:  # noqa: BLE001
+                raise InferenceError(
+                    f"no tokenizer in {self.path}; pass options.tokenizer="
+                    f"'none' for token-id mode ({e})", 500,
+                )
+        self.ready = True
+
+    def unload(self) -> None:
+        self._model = None
+        self._tokenizer = None
+        self.ready = False
+
+    def predict(self, instances: Sequence[Any]) -> List[Any]:
+        import torch
+
+        if self.task == "text-classification":
+            return [self._classify(i) for i in instances]
+        out = []
+        for inst in instances:
+            max_new = self.max_new_tokens
+            if isinstance(inst, dict):
+                max_new = int(inst.get("max_new_tokens", max_new))
+                inst = inst.get("text", inst.get("ids"))
+            if self._tokenizer is not None:
+                ids = self._tokenizer(inst, return_tensors="pt").input_ids
+            else:
+                if not isinstance(inst, (list, tuple)):
+                    raise InferenceError(
+                        "tokenizer-less mode takes token-id lists", 400
+                    )
+                ids = torch.tensor([list(inst)], dtype=torch.long)
+            with torch.no_grad():
+                gen = self._model.generate(
+                    ids, max_new_tokens=max_new, do_sample=False,
+                    pad_token_id=int(self.options.get("pad_token_id", 0)),
+                )
+            new = gen[0][ids.shape[1]:]
+            if self._tokenizer is not None:
+                out.append(self._tokenizer.decode(
+                    new, skip_special_tokens=True
+                ))
+            else:
+                out.append([int(t) for t in new])
+        return out
+
+    def _classify(self, inst: Any) -> dict:
+        import torch
+
+        if self._tokenizer is None:
+            ids = torch.tensor([list(inst)], dtype=torch.long)
+        else:
+            ids = self._tokenizer(inst, return_tensors="pt").input_ids
+        with torch.no_grad():
+            logits = self._model(ids).logits[0]
+        probs = torch.softmax(logits, dim=-1)
+        idx = int(torch.argmax(probs))
+        labels = getattr(self._model.config, "id2label", {}) or {}
+        return {"label": labels.get(idx, str(idx)), "score": float(probs[idx])}
+
+
+def main(argv=None) -> int:
+    return serve_main(HuggingFaceModel, argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -35,6 +35,7 @@ class ModelFormat(str, enum.Enum):
 
     sklearn = "sklearn"
     jax = "jax"  # JAX/StableHLO LLM predictor on PJRT (north-star config #5)
+    huggingface = "huggingface"  # transformers on host CPU (S5 parity)
     custom = "custom"
 
 
@@ -214,6 +215,8 @@ def validate_isvc(isvc: InferenceService) -> None:
 RUNTIMES: Dict[ModelFormat, str] = {
     ModelFormat.sklearn: "kubeflow_tpu.serving.runtimes.sklearn_server",
     ModelFormat.jax: "kubeflow_tpu.serving.runtimes.jax_llm_server",
+    ModelFormat.huggingface:
+        "kubeflow_tpu.serving.runtimes.huggingface_server",
 }
 
 

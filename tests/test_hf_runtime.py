@@ -1,0 +1,82 @@
+"""HuggingFace transformers runtime (S5 parity). Hermetic: tiny random
+models written with save_pretrained; token-id mode (no tokenizer files)."""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeflow_tpu.serving.model import InferenceError, ModelRepository
+from kubeflow_tpu.serving.runtimes.huggingface_server import HuggingFaceModel
+from kubeflow_tpu.serving.server import ModelServer
+
+
+@pytest.fixture(scope="module")
+def tiny_lm_dir(tmp_path_factory):
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    d = tmp_path_factory.mktemp("tiny_lm")
+    cfg = GPT2Config(vocab_size=64, n_positions=64, n_embd=32,
+                     n_layer=2, n_head=2)
+    GPT2LMHeadModel(cfg).save_pretrained(d)
+    return str(d)
+
+
+class TestHuggingFaceModel:
+    def test_generation_token_id_mode(self, tiny_lm_dir):
+        m = HuggingFaceModel(
+            "tiny", tiny_lm_dir, {"tokenizer": "none", "max_new_tokens": 4}
+        )
+        m.load()
+        out = m.predict([[1, 2, 3], {"ids": [5, 6], "max_new_tokens": 2}])
+        assert len(out[0]) == 4 and len(out[1]) == 2
+        assert all(isinstance(t, int) for t in out[0])
+        m.unload()
+        assert not m.ready
+
+    def test_classification(self, tmp_path):
+        from transformers import GPT2Config, GPT2ForSequenceClassification
+
+        cfg = GPT2Config(vocab_size=64, n_positions=64, n_embd=32,
+                         n_layer=2, n_head=2, num_labels=3, pad_token_id=0)
+        GPT2ForSequenceClassification(cfg).save_pretrained(tmp_path)
+        m = HuggingFaceModel(
+            "cls", str(tmp_path),
+            {"tokenizer": "none", "task": "text-classification"},
+        )
+        m.load()
+        r = m.predict([[1, 2, 3]])
+        assert "label" in r[0] and 0 <= r[0]["score"] <= 1
+
+    def test_missing_storage_and_bad_task(self, tiny_lm_dir):
+        with pytest.raises(InferenceError, match="storage_uri"):
+            HuggingFaceModel("x", None, {}).load()
+        with pytest.raises(InferenceError, match="unsupported task"):
+            HuggingFaceModel("x", tiny_lm_dir, {"task": "nope"}).load()
+
+    def test_missing_tokenizer_is_explicit(self, tiny_lm_dir):
+        with pytest.raises(InferenceError, match="tokenizer"):
+            HuggingFaceModel("x", tiny_lm_dir, {}).load()
+
+    def test_served_behind_v1_protocol(self, tiny_lm_dir):
+        async def run():
+            repo = ModelRepository()
+            m = HuggingFaceModel(
+                "tiny", tiny_lm_dir,
+                {"tokenizer": "none", "max_new_tokens": 3},
+            )
+            repo.register(m)
+            m.load()
+            server = ModelServer(repository=repo)
+            c = TestClient(TestServer(server.build_app()))
+            await c.start_server()
+            try:
+                r = await c.post("/v1/models/tiny:predict",
+                                 json={"instances": [[1, 2, 3]]})
+                assert r.status == 200
+                body = await r.json()
+                assert len(body["predictions"][0]) == 3
+            finally:
+                await c.close()
+
+        asyncio.run(run())

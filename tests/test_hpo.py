@@ -659,3 +659,144 @@ def test_e2e_experiment_real_processes(tmp_path):
 
     asyncio.run(run())
 
+
+
+class TestPrometheusCollector:
+    def test_parse_exposition_text(self):
+        from kubeflow_tpu.hpo.metrics import parse_prometheus_text
+
+        text = (
+            "# HELP loss training loss\n"
+            "# TYPE loss gauge\n"
+            'loss{replica="0"} 0.75\n'
+            "step 12\n"
+            "acc 0.9\n"
+            "malformed_line\n"
+        )
+        v = parse_prometheus_text(text)
+        assert v == {"loss": 0.75, "step": 12.0, "acc": 0.9}
+
+    def test_scrape_prometheus_endpoint(self):
+        import http.server
+        import threading
+
+        from kubeflow_tpu.hpo.metrics import scrape_prometheus
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"loss 0.5\nstep 3\n")
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_port}/metrics"
+            obs, series, auto = scrape_prometheus(url, ["loss"], 0)
+            assert series["loss"] == [(3, 0.5)]
+            assert obs.value_of("loss") == 0.5
+        finally:
+            srv.shutdown()
+
+    def test_unreachable_endpoint_is_empty_not_fatal(self):
+        from kubeflow_tpu.hpo.metrics import scrape_prometheus
+
+        obs, series, auto = scrape_prometheus(
+            "http://127.0.0.1:1/metrics", ["loss"], 5, timeout=0.2
+        )
+        assert series == {"loss": []} and auto == 5
+
+    def test_e2e_trial_with_prometheus_collector(self, tmp_path):
+        """A trial whose workload serves /metrics; the collector polls it
+        and the experiment completes on the scraped objective."""
+        async def run():
+            from kubeflow_tpu.controller import ProcessLauncher
+
+            store = ObjectStore(":memory:")
+            log_dir = tmp_path / "logs"
+            launcher = ProcessLauncher(log_dir=str(log_dir))
+            ctl = JobController(store, launcher, GangScheduler(total_chips=8))
+            hpo = HPOController(store, log_dir=str(log_dir), poll_interval=0.2)
+            tasks = [asyncio.create_task(ctl.run()),
+                     asyncio.create_task(hpo.run())]
+            port = _free_port()
+            script = (
+                "import http.server, sys, threading, time\n"
+                "lr = float(sys.argv[sys.argv.index('--lr') + 1])\n"
+                "v = (lr - 0.01) ** 2\n"
+                "class H(http.server.BaseHTTPRequestHandler):\n"
+                "    def do_GET(self):\n"
+                "        self.send_response(200); self.end_headers()\n"
+                "        self.wfile.write(f'loss {v}\\nstep 1\\n'.encode())\n"
+                "    def log_message(self, *a): pass\n"
+                f"srv = http.server.HTTPServer(('127.0.0.1', {port}), H)\n"
+                "threading.Thread(target=srv.serve_forever, daemon=True).start()\n"
+                "time.sleep(2.5)\n"
+            )
+            exp = mk_experiment_obj(max_trials=1, parallel=1,
+                                    algorithm="random")
+            exp["spec"]["trial_template"]["job"]["spec"]["replica_specs"][
+                "Worker"]["template"] = {
+                "exec": True,
+                "entrypoint": sys.executable,
+                "args": ["-c", script, "--lr", "${trialParameters.lr}"],
+            }
+            exp["spec"]["metrics_collector"] = {
+                "kind": "prometheus",
+                "url": f"http://127.0.0.1:{port}/metrics",
+            }
+            store.put("Experiment", exp)
+            try:
+                deadline = asyncio.get_event_loop().time() + 45
+                while asyncio.get_event_loop().time() < deadline:
+                    obj = store.get("Experiment", "exp1")
+                    conds = obj.get("status", {}).get("conditions", [])
+                    if any(c["type"] == "Succeeded" and c["status"]
+                           for c in conds):
+                        break
+                    await asyncio.sleep(0.2)
+                else:
+                    raise AssertionError(f"experiment never finished: {obj}")
+                best = obj["status"]["current_optimal_trial"]
+                assert best["observation"]["metrics"], best
+            finally:
+                await hpo.stop()
+                await ctl.stop()
+                for t in tasks:
+                    try:
+                        await asyncio.wait_for(t, 2)
+                    except (asyncio.TimeoutError, asyncio.CancelledError):
+                        t.cancel()
+                await launcher.shutdown()
+                store.close()
+
+        asyncio.run(run())
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_validate_metrics_collector():
+    exp = mk_experiment_obj()
+    exp["spec"]["metrics_collector"] = {"kind": "nope"}
+    with pytest.raises(ValueError, match="stdout|file|prometheus"):
+        validate_experiment(Experiment.from_dict(exp))
+    exp["spec"]["metrics_collector"] = {"kind": "prometheus"}
+    with pytest.raises(ValueError, match="http"):
+        validate_experiment(Experiment.from_dict(exp))
+    exp["spec"]["metrics_collector"] = {"kind": "file"}
+    with pytest.raises(ValueError, match="file_path"):
+        validate_experiment(Experiment.from_dict(exp))
+    exp["spec"]["metrics_collector"] = {
+        "kind": "prometheus", "url": "http://127.0.0.1:9/m"
+    }
+    validate_experiment(Experiment.from_dict(exp))
