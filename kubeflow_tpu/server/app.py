@@ -613,6 +613,10 @@ def main(argv=None) -> int:
             chips = 1
 
     cp = ControlPlane(args.state_dir, total_chips=chips)
+    # Transformer replicas call predictors back through this ingress;
+    # wildcard binds are not dialable, so point callbacks at loopback.
+    cb_host = "127.0.0.1" if args.host in ("0.0.0.0", "::") else args.host
+    cp.isvc.base_url = f"http://{cb_host}:{args.port}"
     app = cp.build_app()
     logger.info(
         "control plane on http://%s:%d (state %s, %d chips)",

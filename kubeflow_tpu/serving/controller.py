@@ -36,6 +36,7 @@ from kubeflow_tpu.controller.launcher import BaseLauncher, SpawnRequest, WorkerR
 from kubeflow_tpu.serving.types import (
     KIND,
     ComponentSpec,
+    ComponentStatus,
     InferenceService,
     ModelFormat,
     ReplicaInfo,
@@ -49,7 +50,18 @@ from kubeflow_tpu.utils.ports import allocate_port
 
 logger = logging.getLogger(__name__)
 
-PRIMARY = "predictor"  # component the activator routes to
+PRIMARY = "predictor"  # component the activator routes to by default
+# Transformer replica services are tracked under "{ns}/{name}#transformer";
+# the suffix never appears in object names ('#' is not name-legal).
+TRANSFORMER_SUFFIX = "#transformer"
+
+
+def _key_parts(key: str) -> tuple[str, str]:
+    """(ns, name) of a service key, component suffix stripped."""
+    ns, name = key.split("/", 1)
+    if name.endswith(TRANSFORMER_SUFFIX):
+        name = name[: -len(TRANSFORMER_SUFFIX)]
+    return ns, name
 
 
 class _Replica:
@@ -109,6 +121,10 @@ class ISVCController:
         self.state_dir = state_dir or "."
         self.probe_interval = probe_interval
         self.autoscale_interval = autoscale_interval
+        # Control-plane ingress URL, injected into transformer replicas so
+        # they call the predictor through the activator (the server sets
+        # the real host:port at startup).
+        self.base_url = "http://127.0.0.1:7450"
         self.services: Dict[str, _Service] = {}
         self._queue: asyncio.Queue = asyncio.Queue()
         self._queued: set = set()
@@ -171,12 +187,14 @@ class ISVCController:
 
     async def _reconcile(self, ns: str, name: str) -> None:
         key = f"{ns}/{name}"
+        tkey = key + TRANSFORMER_SUFFIX
         raw = self.store.get(KIND, name, ns)
         if raw is None:
-            # Deleted: tear down replicas.
-            if key in self.services:
-                await self._scale_to(key, 0)
-                self.services.pop(key, None)
+            # Deleted: tear down replicas (both components).
+            for k in (key, tkey):
+                if k in self.services:
+                    await self._scale_to(k, 0)
+                    self.services.pop(k, None)
             return
         try:
             isvc = InferenceService.from_dict(raw)
@@ -185,34 +203,48 @@ class ISVCController:
             self._write_failed(ns, name, "InvalidSpec", str(e))
             return
 
-        svc = self.services.setdefault(key, _Service())
-        comp = isvc.spec.predictor
-        # A changed spec resets the crash-loop counter so a corrected
-        # re-apply recovers without delete+recreate (generation can't be
-        # the key: status writes bump it too).
         fingerprint = json.dumps(
             isvc.spec.model_dump(mode="json"), sort_keys=True
         )
-        if svc.spec_fingerprint != fingerprint:
-            svc.spec_fingerprint = fingerprint
-            svc.failure_count = 0
-        if svc.failure_count >= self.CRASH_LOOP_LIMIT:
-            # Crash-looping: stay down until the spec changes.
-            await self._scale_to(key, 0)
-            return
-        if svc.desired == 0 and not svc.replicas:
-            # First reconcile (or post scale-to-zero restart): start at
-            # min_replicas; the activator bumps desired on traffic.
-            svc.desired = max(svc.desired, comp.min_replicas)
-        svc.desired = max(min(svc.desired, comp.max_replicas),
-                         comp.min_replicas)
-        try:
-            await self._converge(key, isvc, comp, svc)
-        except Exception as e:  # noqa: BLE001 - spec/spawn errors -> Failed
-            logger.exception("isvc %s: converge failed", key)
-            self._write_failed(ns, name, "SpawnError", str(e))
-            return
-        self._write_status(isvc, svc)
+        if isvc.spec.transformer is None and tkey in self.services:
+            # Transformer removed from the spec: tear its replicas down.
+            await self._scale_to(tkey, 0)
+            self.services.pop(tkey, None)
+        components = [(key, isvc.spec.predictor, "predictor")]
+        if isvc.spec.transformer is not None:
+            components.append((tkey, isvc.spec.transformer, "transformer"))
+        crash_looped = False
+        for skey, comp, _label in components:
+            svc = self.services.setdefault(skey, _Service())
+            # A changed spec resets the crash-loop counter so a corrected
+            # re-apply recovers without delete+recreate (generation can't
+            # be the key: status writes bump it too).
+            if svc.spec_fingerprint != fingerprint:
+                svc.spec_fingerprint = fingerprint
+                svc.failure_count = 0
+            if svc.failure_count >= self.CRASH_LOOP_LIMIT:
+                # Crash-looping: stay down until the spec changes. Skip
+                # the status write below -- it must not clobber the
+                # Failed condition on_worker_exit recorded.
+                await self._scale_to(skey, 0)
+                crash_looped = True
+                continue
+            if svc.desired == 0 and not svc.replicas:
+                # First reconcile (or post scale-to-zero restart): start
+                # at min_replicas; the activator bumps desired on traffic.
+                svc.desired = max(svc.desired, comp.min_replicas)
+            svc.desired = max(min(svc.desired, comp.max_replicas),
+                             comp.min_replicas)
+            try:
+                await self._converge(skey, isvc, comp, svc)
+            except Exception as e:  # noqa: BLE001 - spawn errors -> Failed
+                logger.exception("isvc %s: converge failed", skey)
+                self._write_failed(ns, name, "SpawnError", str(e))
+                return
+        if not crash_looped:
+            self._write_status(
+                isvc, self.services[key], self.services.get(tkey)
+            )
 
     def _write_failed(self, ns: str, name: str, reason: str,
                       message: str) -> None:
@@ -242,7 +274,7 @@ class ISVCController:
             index = svc.next_index
             svc.next_index += 1
             port = allocate_port()
-            req = self._spawn_request(isvc, comp, index, port)
+            req = self._spawn_request(isvc, comp, index, port, key)
             ref = await self.launcher.spawn(req)
             svc.replicas[index] = _Replica(index, port, ref)
             probe_key = f"{key}#{index}"
@@ -294,9 +326,22 @@ class ISVCController:
             svc.ready_event.clear()
 
     def _spawn_request(self, isvc: InferenceService, comp: ComponentSpec,
-                       index: int, port: int) -> SpawnRequest:
+                       index: int, port: int,
+                       service_key: Optional[str] = None) -> SpawnRequest:
         ns, name = isvc.metadata.namespace, isvc.metadata.name
+        service_key = service_key or f"{ns}/{name}"
         env = {"PORT": str(port)}
+        if service_key.endswith(TRANSFORMER_SUFFIX):
+            # Transformer processes call the predictor back through the
+            # activator (scale-from-zero applies), pinned to the predictor
+            # component via header by TransformerModel.
+            env["KFTPU_PREDICTOR_URL"] = (
+                f"{self.base_url}/serving/{ns}/{name}"
+            )
+            env["KFTPU_PREDICTOR_MODEL"] = (
+                (isvc.spec.predictor.model.name
+                 if isvc.spec.predictor.model else None) or name
+            )
         if comp.custom is not None:
             entrypoint = comp.custom.entrypoint
             args = list(comp.custom.args)
@@ -324,7 +369,7 @@ class ISVCController:
                 {"sink": comp.logger.sink, "mode": comp.logger.mode}
             )]
         return SpawnRequest(
-            job_key=f"{ns}/{name}",
+            job_key=service_key,
             replica_type="server",
             index=index,
             entrypoint=entrypoint,
@@ -350,7 +395,7 @@ class ISVCController:
                         rep.ready = True
                         svc.failure_count = 0
                         svc.ready_event.set()
-                        self._enqueue(*key.split("/", 1))
+                        self._enqueue(*_key_parts(key))
                         return
             except Exception:
                 pass
@@ -381,9 +426,9 @@ class ISVCController:
         # Crash-looping guard: stop respawning after repeated failures;
         # the status shows Failed with the failure count.
         if svc.failure_count < self.CRASH_LOOP_LIMIT:
-            self._enqueue(*key.split("/", 1))
+            self._enqueue(*_key_parts(key))
         elif svc.failure_count == self.CRASH_LOOP_LIMIT:
-            ns, name = key.split("/", 1)
+            ns, name = _key_parts(key)
             self._write_failed(
                 ns, name, "CrashLoop",
                 f"replica exited {svc.failure_count} times (last code {code})",
@@ -396,13 +441,19 @@ class ISVCController:
         while not self._stopped.is_set():
             await asyncio.sleep(self.autoscale_interval)
             for key, svc in list(self.services.items()):
-                ns, name = key.split("/", 1)
+                ns, name = _key_parts(key)
                 raw = self.store.get(KIND, name, ns)
                 if raw is None:
                     continue
                 try:
-                    comp = InferenceService.from_dict(raw).spec.predictor
+                    spec = InferenceService.from_dict(raw).spec
                 except ValueError:
+                    continue
+                comp = (
+                    spec.transformer
+                    if key.endswith(TRANSFORMER_SUFFIX) else spec.predictor
+                )
+                if comp is None:
                     continue
                 import math
 
@@ -424,7 +475,8 @@ class ISVCController:
 
     # -- status -----------------------------------------------------------
 
-    def _write_status(self, isvc: InferenceService, svc: _Service) -> None:
+    def _write_status(self, isvc: InferenceService, svc: _Service,
+                      tsvc: Optional[_Service] = None) -> None:
         raw = self.store.get(KIND, isvc.metadata.name, isvc.metadata.namespace)
         if raw is None:
             return
@@ -433,21 +485,39 @@ class ISVCController:
         status.predictor.desired_replicas = svc.desired
         status.predictor.ready_replicas = len(ready)
         status.predictor.replicas = [r.info() for r in svc.replicas.values()]
+        if tsvc is not None:
+            if status.transformer is None:
+                status.transformer = ComponentStatus()
+            status.transformer.desired_replicas = tsvc.desired
+            status.transformer.ready_replicas = len(tsvc.ready_replicas())
+            status.transformer.replicas = [
+                r.info() for r in tsvc.replicas.values()
+            ]
         status.in_flight = svc.in_flight
         status.last_request_time = svc.last_request
         status.url = (
             f"/serving/{isvc.metadata.namespace}/{isvc.metadata.name}"
         )
         set_condition(status, "Created", "Reconciled")
-        if ready:
+        # Ready = every present component has a ready replica or is
+        # legitimately scaled to zero (the activator wakes it).
+        t_ready = (
+            tsvc is None or tsvc.ready_replicas() or tsvc.desired == 0
+        )
+        if ready and t_ready:
             set_condition(status, "Ready", "MinimumReplicasAvailable",
                           f"{len(ready)}/{svc.desired} replicas ready")
         elif svc.desired == 0:
             set_condition(status, "Unready", "ScaledToZero",
                           "scaled to zero; activator buffers requests")
         else:
+            stuck = []
+            if not ready:
+                stuck.append(f"predictor 0/{svc.desired}")
+            if tsvc is not None and not t_ready:
+                stuck.append(f"transformer 0/{tsvc.desired}")
             set_condition(status, "Unready", "WaitingForReplicas",
-                          f"0/{svc.desired} replicas ready")
+                          f"waiting for replicas: {', '.join(stuck)}")
         new = dict(raw)
         new["status"] = status.model_dump(mode="json", exclude_none=True)
         if new["status"] != raw.get("status"):
@@ -490,6 +560,13 @@ class Activator:
                           f"{failed[0].get('message')}"},
                 status=503,
             )
+        # With a transformer present, it is the ingress component; its
+        # replicas call back here with X-Kftpu-Component: predictor
+        # (KServe: transformer fronts the predictor service).
+        has_transformer = bool((raw.get("spec") or {}).get("transformer"))
+        component = req.headers.get("X-Kftpu-Component", "").lower()
+        if has_transformer and component != PRIMARY:
+            key = key + TRANSFORMER_SUFFIX
         svc = ctrl.services.setdefault(key, _Service())
         svc.last_request = time.time()
         svc.in_flight += 1
@@ -528,7 +605,7 @@ class Activator:
             # Cold start: ask for at least one replica and hold the request.
             if svc.desired < 1:
                 svc.desired = 1
-            self.controller._enqueue(*key.split("/", 1))
+            self.controller._enqueue(*_key_parts(key))
             try:
                 await asyncio.wait_for(
                     svc.ready_event.wait(), self.cold_start_timeout

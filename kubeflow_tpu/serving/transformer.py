@@ -1,0 +1,99 @@
+"""Transformer component base (KServe transformer equivalent, S2/S4).
+
+A transformer is its own server process fronting the predictor: it
+receives the inference request, applies ``preprocess`` per instance,
+forwards the batch to the predictor THROUGH the activator (so predictor
+scale-from-zero still works), and applies ``postprocess`` per output.
+
+Write one by subclassing and serving it as the ISVC's transformer
+``custom`` process:
+
+    from kubeflow_tpu.serving.transformer import TransformerModel
+    from kubeflow_tpu.serving.runtimes.common import serve_main
+
+    class MyTransformer(TransformerModel):
+        def preprocess(self, instance):
+            return instance["text"].lower()
+        def postprocess(self, output):
+            return {"clean": output}
+
+    if __name__ == "__main__":
+        raise SystemExit(serve_main(
+            lambda name, path, opts: MyTransformer(name, options=opts)))
+
+The controller injects ``KFTPU_PREDICTOR_URL`` (activator ingress) and
+``KFTPU_PREDICTOR_MODEL`` into transformer replicas.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence
+
+from kubeflow_tpu.serving.model import InferenceError, Model
+
+
+class TransformerModel(Model):
+    def __init__(self, name: str, path: Optional[str] = None,
+                 options: Optional[Dict[str, Any]] = None) -> None:
+        super().__init__(name)
+        self.options = options or {}
+        self.predictor_url = (
+            self.options.get("predictor_url")
+            or os.environ.get("KFTPU_PREDICTOR_URL")
+        )
+        self.predictor_model = (
+            self.options.get("predictor_model")
+            or os.environ.get("KFTPU_PREDICTOR_MODEL")
+            or name
+        )
+        self.timeout = float(self.options.get("predictor_timeout", 300.0))
+
+    def load(self) -> None:
+        if not self.predictor_url:
+            raise InferenceError(
+                "transformer needs KFTPU_PREDICTOR_URL (set by the ISVC "
+                "controller) or options.predictor_url", 500,
+            )
+        self.ready = True
+
+    def unload(self) -> None:
+        self.ready = False
+
+    # predict == proxy the (already preprocessed) batch to the predictor.
+    # Runs in the batcher's executor thread, so sync urllib is fine.
+    def predict(self, instances: Sequence[Any]) -> List[Any]:
+        url = (
+            f"{self.predictor_url}/v1/models/"
+            f"{self.predictor_model}:predict"
+        )
+        req = urllib.request.Request(
+            url,
+            data=json.dumps({"instances": list(instances)}).encode(),
+            headers={
+                "Content-Type": "application/json",
+                # Pin to the predictor component or the activator would
+                # route us back to the transformer (a loop).
+                "X-Kftpu-Component": "predictor",
+            },
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                body = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            raise InferenceError(
+                f"predictor returned {e.code}: {e.read()[:200]!r}", 502
+            )
+        except OSError as e:
+            raise InferenceError(f"predictor unreachable: {e}", 502)
+        preds = body.get("predictions")
+        if not isinstance(preds, list) or len(preds) != len(instances):
+            raise InferenceError(
+                f"predictor returned {type(preds).__name__} of wrong "
+                "arity", 502,
+            )
+        return preds

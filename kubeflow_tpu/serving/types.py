@@ -202,12 +202,15 @@ def validate_isvc(isvc: InferenceService) -> None:
     if not 0 <= isvc.spec.canary_traffic_percent <= 100:
         raise ServingValidationError("canary_traffic_percent must be in [0, 100]")
     if isvc.spec.transformer is not None:
-        # Rejected loudly rather than silently dropped: the controller does
-        # not yet spawn transformer replicas or chain traffic through them.
-        raise ServingValidationError(
-            "transformer components are not supported yet; put pre/post "
-            "processing in the predictor's Model.preprocess/postprocess"
-        )
+        # Transformers are custom processes (the reference's transformers
+        # are custom containers too); serving.transformer.TransformerModel
+        # is the 10-line base class for writing one.
+        if isvc.spec.transformer.custom is None:
+            raise ServingValidationError(
+                "transformer components must use custom: (a process "
+                "subclassing serving.transformer.TransformerModel); "
+                "model: formats apply to predictors only"
+            )
 
 
 # Runtime registry: model format -> server entry module (ServingRuntime CR

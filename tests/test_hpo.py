@@ -595,8 +595,12 @@ def test_medianstop_prunes_bad_trial(tmp_path):
                     ["status"]["conditions"]
                 ), timeout=15,
             ), h.store.get("Trial", name)["status"]
-            st = h.exp()["status"]
-            assert st["trials_early_stopped"] >= 1
+            # The experiment counter updates on ITS next reconcile, which
+            # trails the trial's EarlyStopped write -- wait, don't peek.
+            assert await h.wait(
+                lambda: h.exp()["status"].get("trials_early_stopped", 0) >= 1,
+                timeout=10,
+            ), h.exp()["status"]
 
 
 

@@ -55,12 +55,16 @@ def test_isvc_rejects_custom_format_via_model():
         validate_isvc(InferenceService.from_dict(d))
 
 
-def test_isvc_rejects_transformer_component():
+def test_isvc_transformer_must_be_custom():
+    # Custom transformers are supported (chained in front of the
+    # predictor); model-format transformers are not a thing.
     d = isvc_dict()
+    d["spec"]["transformer"] = {"custom": {"entrypoint": "x"}}
+    validate_isvc(InferenceService.from_dict(d))
     d["spec"]["transformer"] = {
-        "custom": {"entrypoint": "x"},
+        "model": {"format": "sklearn", "storage_uri": "/tmp/m"},
     }
-    with pytest.raises(ServingValidationError, match="transformer"):
+    with pytest.raises(ServingValidationError, match="custom"):
         validate_isvc(InferenceService.from_dict(d))
 
 
