@@ -1,1 +1,331 @@
-"""BERT-large -- BASELINE config #3. Implemented in the bert milestone."""
+"""BERT family -- BASELINE config #3 ("BERT-large PyTorchJob").
+
+TPU-first encoder: flax.linen with logical-axis annotations on every
+parameter (same rules table as Llama: DP/FSDP/TP are mesh axes),
+``nn.scan`` over encoder blocks, ``nn.remat``, bf16 activations, and the
+shared attention entry point (Pallas flash / ring / XLA) with
+``causal=False`` -- bidirectional attention is just the causal mask
+dropped.
+
+The reference runs BERT inside a PyTorchJob container via torch_xla; here
+the same job kind (PyTorchJob-shaped spec, MASTER_ADDR-style env
+contract) supervises this JAX runtime task -- the control plane keeps the
+reference's job semantics while the in-container framework is native
+(SURVEY.md 3.1 T4, 7.1 step 4).
+
+Training objective: masked-LM (BERT's pretraining task). 15% of tokens
+are masked host-side; loss is CE over masked positions only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding
+
+from kubeflow_tpu.models import register_task
+from kubeflow_tpu.ops.attention import dot_product_attention
+from kubeflow_tpu.runtime import data as datalib
+from kubeflow_tpu.runtime.metrics import transformer_flops_per_token
+from kubeflow_tpu.runtime.task import TrainTask, host_to_global
+from kubeflow_tpu.models.common import cached_shardings, with_mesh_context
+from kubeflow_tpu.parallel.sharding import spec_for
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden: int = 1024
+    n_layers: int = 24
+    n_heads: int = 16
+    intermediate: int = 4096
+    max_seq: int = 512
+    type_vocab: int = 2
+    norm_eps: float = 1e-12
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    remat: bool = True
+    scan_layers: bool = True
+    attention_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.n_heads
+
+    def n_params(self) -> int:
+        emb = (self.vocab_size + self.max_seq + self.type_vocab) * self.hidden
+        attn = 4 * self.hidden * self.hidden
+        mlp = 2 * self.hidden * self.intermediate
+        per_layer = attn + mlp + 4 * self.hidden  # 2 LN scale+bias pairs
+        head = self.hidden * self.vocab_size
+        return emb + self.n_layers * per_layer + head
+
+    def flops_per_token(self, seq_len: int) -> float:
+        matmul = self.n_params() - (
+            self.vocab_size + self.max_seq + self.type_vocab
+        ) * self.hidden
+        return transformer_flops_per_token(
+            matmul, seq_len, self.n_layers, self.hidden
+        )
+
+
+PRESETS: dict[str, BertConfig] = {
+    # Public BERT-large geometry (config #3).
+    "bert-large": BertConfig(),
+    "bert-base": BertConfig(hidden=768, n_layers=12, n_heads=12,
+                            intermediate=3072),
+    # Tiny for CPU tests.
+    "bert-tiny": BertConfig(
+        vocab_size=256, hidden=64, n_layers=2, n_heads=4, intermediate=128,
+        max_seq=64, remat=False,
+    ),
+}
+
+
+def _dt(name: str):
+    return jnp.dtype(name)
+
+
+class EncoderBlock(nn.Module):
+    """Post-LN transformer encoder block (original BERT layout)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dtype = _dt(cfg.dtype)
+        dense = partial(
+            nn.DenseGeneral, use_bias=True, dtype=dtype,
+            param_dtype=_dt(cfg.param_dtype),
+        )
+        qkv = partial(
+            dense,
+            features=(cfg.n_heads, cfg.head_dim),
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "heads", "kv")
+            ),
+        )
+        q = qkv(name="q_proj")(x)
+        k = qkv(name="k_proj")(x)
+        v = qkv(name="v_proj")(x)
+        attn = dot_product_attention(
+            q, k, v, causal=False, impl=cfg.attention_impl
+        )
+        attn = nn.DenseGeneral(
+            features=cfg.hidden, axis=(-2, -1), use_bias=True, dtype=dtype,
+            param_dtype=_dt(cfg.param_dtype),
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("heads", "kv", "embed")
+            ),
+            name="o_proj",
+        )(attn)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=dtype,
+                         name="attn_norm")(x + attn)
+        h = dense(
+            features=cfg.intermediate,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "mlp")
+            ),
+            name="up_proj",
+        )(x)
+        h = dense(
+            features=cfg.hidden,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("mlp", "embed")
+            ),
+            name="down_proj",
+        )(nn.gelu(h))
+        return nn.LayerNorm(epsilon=cfg.norm_eps, dtype=dtype,
+                            name="mlp_norm")(x + h)
+
+
+class _ScanBlock(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        return EncoderBlock(self.cfg, name="layer")(x), None
+
+
+class Bert(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array,
+                 segments: Optional[jax.Array] = None):
+        cfg = self.cfg
+        dtype = _dt(cfg.dtype)
+        embed = partial(
+            nn.Embed, features=cfg.hidden, dtype=dtype,
+            param_dtype=_dt(cfg.param_dtype),
+        )
+        x = embed(
+            num_embeddings=cfg.vocab_size,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="tok_embed",
+        )(tokens)
+        positions = jnp.arange(tokens.shape[1])[None, :]
+        x = x + embed(
+            num_embeddings=cfg.max_seq,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, "embed")
+            ),
+            name="pos_embed",
+        )(positions)
+        if segments is None:
+            segments = jnp.zeros_like(tokens)
+        x = x + embed(
+            num_embeddings=cfg.type_vocab,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, "embed")
+            ),
+            name="seg_embed",
+        )(segments)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=dtype,
+                         name="embed_norm")(x)
+
+        policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        if cfg.scan_layers:
+            block = _ScanBlock
+            if cfg.remat:
+                block = nn.remat(_ScanBlock, policy=policy,
+                                 prevent_cse=False)
+            x, _ = nn.scan(
+                block,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")(x)
+        else:
+            block = EncoderBlock
+            if cfg.remat:
+                block = nn.remat(EncoderBlock, policy=policy,
+                                 prevent_cse=False)
+            for i in range(cfg.n_layers):
+                x = block(cfg, name=f"layer_{i}")(x)
+
+        logits = nn.DenseGeneral(
+            features=cfg.vocab_size, use_bias=True, dtype=dtype,
+            param_dtype=_dt(cfg.param_dtype),
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")
+            ),
+            name="mlm_head",
+        )(x)
+        return logits
+
+
+class BertTask(TrainTask):
+    name = "bert"
+
+    MASK_PROB = 0.15
+
+    def __init__(
+        self,
+        preset: str = "bert-large",
+        batch_size: int = 8,
+        seq_len: int = 128,
+        lr: float = 1e-4,
+        weight_decay: float = 0.01,
+        **overrides,
+    ) -> None:
+        cfg = PRESETS[preset]
+        if overrides:
+            cfg = dataclasses.replace(cfg, **overrides)
+        if seq_len > cfg.max_seq:
+            raise ValueError(f"seq_len {seq_len} > max_seq {cfg.max_seq}")
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.model = Bert(cfg)
+        self.tokens_per_step = batch_size * seq_len
+        self.flops_per_token = cfg.flops_per_token(seq_len)
+        self.tx = optax.adamw(lr, b1=0.9, b2=0.999,
+                              weight_decay=weight_decay)
+        # [MASK] takes the last vocab id (synthetic data never emits it).
+        self.mask_id = cfg.vocab_size - 1
+
+    def _init_fn(self, rng):
+        tokens = jnp.zeros((1, self.seq_len), jnp.int32)
+        variables = self.model.init(rng, tokens)
+        return train_state.TrainState.create(
+            apply_fn=self.model.apply,
+            params={"params": variables["params"]},
+            tx=self.tx,
+        )
+
+    def _shardings(self, mesh: Mesh):
+        return cached_shardings(self, mesh, self._init_fn)
+
+    def init_state(self, rng: jax.Array, mesh: Mesh):
+        from kubeflow_tpu.parallel.mesh import validate_divisibility
+
+        validate_divisibility(self.batch_size, self.seq_len, mesh)
+        with mesh:
+            return jax.jit(
+                self._init_fn, out_shardings=self._shardings(mesh)
+            )(rng)
+
+    def train_step_fn(self, mesh: Mesh):
+        shardings = self._shardings(mesh)
+        batch_sharding = NamedSharding(mesh, spec_for(("batch", "length")))
+
+        def step(state, tokens, targets, mask):
+            def loss_fn(params):
+                logits = state.apply_fn(params, tokens).astype(jnp.float32)
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, targets
+                )
+                m = mask.astype(jnp.float32)
+                return jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            return state.apply_gradients(grads=grads), {"loss": loss}
+
+        jitted = jax.jit(
+            step,
+            in_shardings=(shardings, batch_sharding, batch_sharding,
+                          batch_sharding),
+            out_shardings=(shardings, NamedSharding(mesh, spec_for(()))),
+            donate_argnums=(0,),
+        )
+        # Trace-time mesh handoff so ring attention can engage (llama
+        # does the same; the jit cache makes later calls free).
+        return with_mesh_context(mesh, jitted)
+
+    def data_iter(
+        self, num_processes: int, process_id: int, mesh: Mesh, seed: int = 0
+    ) -> Iterator[tuple[jax.Array, ...]]:
+        # Leave headroom for the [MASK] id at vocab_size - 1.
+        it = datalib.synthetic_tokens(
+            self.batch_size, self.seq_len + 1, self.cfg.vocab_size - 1,
+            num_processes=num_processes, process_id=process_id, seed=seed,
+        )
+        rng = np.random.default_rng(seed * 31337 + process_id)
+        spec = spec_for(("batch", "length"))
+        for b in it:
+            clean = b.inputs[:, : self.seq_len]
+            mask = rng.random(clean.shape) < self.MASK_PROB
+            masked = np.where(mask, self.mask_id, clean).astype(np.int32)
+            yield (
+                host_to_global(mesh, spec, masked),
+                host_to_global(mesh, spec, clean.astype(np.int32)),
+                host_to_global(mesh, spec, mask.astype(np.int32)),
+            )
+
+
+@register_task("bert")
+def make_bert(**kw) -> BertTask:
+    return BertTask(**kw)

@@ -1,0 +1,42 @@
+"""Shared model-task helpers (one home for what llama/bert/vit all need)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+
+def dt(name: str):
+    return jnp.dtype(name)
+
+
+def cached_shardings(task, mesh: Mesh, init_fn):
+    """Per-(task, mesh) cache of the state sharding pytree.
+
+    The abstract init trace is expensive at 8B scale; every task caches it
+    the same way, so the invalidation rule (same mesh object -> reuse)
+    lives here once.
+    """
+    from kubeflow_tpu.models.llama import state_shardings
+    from kubeflow_tpu.parallel.mesh import mesh_context
+
+    cache = getattr(task, "_sharding_cache", None)
+    if cache is None or cache[0] is not mesh:
+        with mesh_context(mesh):
+            abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        task._sharding_cache = (mesh, state_shardings(mesh, abstract))
+    return task._sharding_cache[1]
+
+
+def with_mesh_context(mesh: Mesh, jitted):
+    """Wrap a jitted step so the active-mesh contextvar is set at trace
+    time -- ring attention (and any shard_map op) reads it then; later
+    calls hit the jit cache and the context is a no-op."""
+    from kubeflow_tpu.parallel.mesh import mesh_context
+
+    def wrapped(*args, **kw):
+        with mesh_context(mesh):
+            return jitted(*args, **kw)
+
+    return wrapped

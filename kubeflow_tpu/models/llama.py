@@ -589,15 +589,9 @@ class LlamaTask(TrainTask):
     def _shardings(self, mesh: Mesh):
         # The abstract init trace is expensive at 8B scale; compute once
         # per (task, mesh) and reuse for init_state + train_step_fn.
-        if getattr(self, "_sharding_cache", None) is None or (
-            self._sharding_cache[0] is not mesh
-        ):
-            from kubeflow_tpu.parallel.mesh import mesh_context
+        from kubeflow_tpu.models.common import cached_shardings
 
-            with mesh_context(mesh):
-                abstract = jax.eval_shape(self._init_fn, jax.random.PRNGKey(0))
-            self._sharding_cache = (mesh, state_shardings(mesh, abstract))
-        return self._sharding_cache[1]
+        return cached_shardings(self, mesh, self._init_fn)
 
     def init_state(self, rng: jax.Array, mesh: Mesh):
         from kubeflow_tpu.parallel.mesh import mesh_context, validate_divisibility

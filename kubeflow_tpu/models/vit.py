@@ -1,1 +1,334 @@
-"""ViT-B -- BASELINE config #4 (Katib trials). Implemented in the hpo milestone."""
+"""ViT family -- BASELINE config #4 (Katib HPO trials on TPU workers).
+
+TPU-first Vision Transformer: patchify via a single strided conv (one
+MXU-friendly matmul per image), pre-LN encoder blocks through the shared
+attention entry point (``causal=False``), ``nn.scan`` + ``nn.remat``,
+logical-axis annotations on every parameter (same rules table as
+Llama/BERT so DP/FSDP/TP compose). Classification from the [CLS] token.
+
+As a Katib trial workload, lr / batch / depth arrive as
+``${trialParameters.*}``-substituted task args; accuracy and loss go out
+on the KFTPU-METRIC stdout stream the collector scrapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding
+
+from kubeflow_tpu.models import register_task
+from kubeflow_tpu.ops.attention import dot_product_attention
+from kubeflow_tpu.runtime import data as datalib
+from kubeflow_tpu.runtime.metrics import transformer_flops_per_token
+from kubeflow_tpu.runtime.task import TrainTask, host_to_global
+from kubeflow_tpu.models.common import cached_shardings, with_mesh_context
+from kubeflow_tpu.parallel.sharding import spec_for
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    n_classes: int = 1000
+    hidden: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    intermediate: int = 3072
+    norm_eps: float = 1e-6
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    remat: bool = True
+    scan_layers: bool = True
+    attention_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.n_heads
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    def n_params(self) -> int:
+        patch = (self.patch_size ** 2 * self.channels + 1) * self.hidden
+        pos = (self.n_patches + 1) * self.hidden
+        attn = 4 * self.hidden * self.hidden
+        mlp = 2 * self.hidden * self.intermediate
+        per_layer = attn + mlp + 4 * self.hidden
+        head = self.hidden * self.n_classes
+        return patch + pos + self.n_layers * per_layer + head
+
+    def flops_per_example(self) -> float:
+        seq = self.n_patches + 1
+        per_token = transformer_flops_per_token(
+            self.n_params() - (self.n_patches + 1) * self.hidden,
+            seq, self.n_layers, self.hidden,
+        )
+        return per_token * seq
+
+
+PRESETS: dict[str, ViTConfig] = {
+    # Public ViT-B/16 geometry (config #4).
+    "vit-b16": ViTConfig(),
+    "vit-s16": ViTConfig(hidden=384, n_layers=12, n_heads=6,
+                         intermediate=1536),
+    # Tiny for CPU tests / fast HPO trials.
+    "vit-tiny": ViTConfig(
+        image_size=32, patch_size=8, n_classes=10, hidden=64, n_layers=2,
+        n_heads=4, intermediate=128, remat=False,
+    ),
+}
+
+
+def _dt(name: str):
+    return jnp.dtype(name)
+
+
+class ViTBlock(nn.Module):
+    """Pre-LN transformer encoder block (ViT layout)."""
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dtype = _dt(cfg.dtype)
+        dense = partial(
+            nn.DenseGeneral, use_bias=True, dtype=dtype,
+            param_dtype=_dt(cfg.param_dtype),
+        )
+        h = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=dtype,
+                         name="attn_norm")(x)
+        qkv = partial(
+            dense,
+            features=(cfg.n_heads, cfg.head_dim),
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "heads", "kv")
+            ),
+        )
+        attn = dot_product_attention(
+            qkv(name="q_proj")(h), qkv(name="k_proj")(h),
+            qkv(name="v_proj")(h), causal=False, impl=cfg.attention_impl,
+        )
+        x = x + nn.DenseGeneral(
+            features=cfg.hidden, axis=(-2, -1), use_bias=True, dtype=dtype,
+            param_dtype=_dt(cfg.param_dtype),
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("heads", "kv", "embed")
+            ),
+            name="o_proj",
+        )(attn)
+        h = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=dtype,
+                         name="mlp_norm")(x)
+        h = dense(
+            features=cfg.intermediate,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "mlp")
+            ),
+            name="up_proj",
+        )(h)
+        h = dense(
+            features=cfg.hidden,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("mlp", "embed")
+            ),
+            name="down_proj",
+        )(nn.gelu(h))
+        return x + h
+
+
+class _ScanBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        return ViTBlock(self.cfg, name="layer")(x), None
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images: jax.Array):
+        cfg = self.cfg
+        dtype = _dt(cfg.dtype)
+        x = nn.Conv(
+            features=cfg.hidden,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            padding="VALID",
+            dtype=dtype,
+            param_dtype=_dt(cfg.param_dtype),
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), (None, None, None, "embed")
+            ),
+            name="patchify",
+        )(images.astype(dtype))
+        b = x.shape[0]
+        x = x.reshape(b, -1, cfg.hidden)  # [B, P, H]
+        cls = self.param(
+            "cls",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), (None, None, "embed")
+            ),
+            (1, 1, cfg.hidden),
+            _dt(cfg.param_dtype),
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, cfg.hidden)).astype(dtype), x],
+            axis=1,
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, None, "embed")
+            ),
+            (1, cfg.n_patches + 1, cfg.hidden),
+            _dt(cfg.param_dtype),
+        )
+        x = x + pos.astype(dtype)
+
+        policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        if cfg.scan_layers:
+            block = _ScanBlock
+            if cfg.remat:
+                block = nn.remat(_ScanBlock, policy=policy,
+                                 prevent_cse=False)
+            x, _ = nn.scan(
+                block,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")(x)
+        else:
+            block = ViTBlock
+            if cfg.remat:
+                block = nn.remat(ViTBlock, policy=policy, prevent_cse=False)
+            for i in range(cfg.n_layers):
+                x = block(cfg, name=f"layer_{i}")(x)
+
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=dtype,
+                         name="final_norm")(x[:, 0])
+        return nn.DenseGeneral(
+            features=cfg.n_classes, use_bias=True, dtype=dtype,
+            param_dtype=_dt(cfg.param_dtype),
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")
+            ),
+            name="head",
+        )(x)
+
+
+class ViTTask(TrainTask):
+    name = "vit"
+
+    def __init__(
+        self,
+        preset: str = "vit-b16",
+        batch_size: int = 64,
+        lr: float = 3e-4,
+        weight_decay: float = 0.05,
+        **overrides,
+    ) -> None:
+        cfg = PRESETS[preset]
+        if overrides:
+            cfg = dataclasses.replace(cfg, **overrides)
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.model = ViT(cfg)
+        # "tokens" == examples for classification MFU accounting.
+        self.tokens_per_step = batch_size
+        self.flops_per_token = cfg.flops_per_example()
+        self.tx = optax.adamw(lr, weight_decay=weight_decay)
+
+    def _init_fn(self, rng):
+        imgs = jnp.zeros(
+            (1, self.cfg.image_size, self.cfg.image_size,
+             self.cfg.channels),
+            jnp.float32,
+        )
+        variables = self.model.init(rng, imgs)
+        return train_state.TrainState.create(
+            apply_fn=self.model.apply,
+            params={"params": variables["params"]},
+            tx=self.tx,
+        )
+
+    def _shardings(self, mesh: Mesh):
+        return cached_shardings(self, mesh, self._init_fn)
+
+    def init_state(self, rng: jax.Array, mesh: Mesh):
+        from kubeflow_tpu.parallel.mesh import validate_divisibility
+
+        # seq_len=1: images have no sequence axis to divide.
+        validate_divisibility(self.batch_size, 1, mesh)
+        with mesh:
+            return jax.jit(
+                self._init_fn, out_shardings=self._shardings(mesh)
+            )(rng)
+
+    def train_step_fn(self, mesh: Mesh):
+        shardings = self._shardings(mesh)
+        batch_sharding = NamedSharding(mesh, spec_for(("batch",)))
+
+        def step(state, images, labels):
+            def loss_fn(params):
+                logits = state.apply_fn(params, images).astype(jnp.float32)
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels
+                ).mean()
+                acc = jnp.mean(
+                    (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+                )
+                return loss, acc
+
+            (loss, acc), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
+            new_state = state.apply_gradients(grads=grads)
+            return new_state, {"loss": loss, "accuracy": acc}
+
+        jitted = jax.jit(
+            step,
+            in_shardings=(shardings, batch_sharding, batch_sharding),
+            out_shardings=(
+                shardings,
+                {"loss": NamedSharding(mesh, spec_for(())),
+                 "accuracy": NamedSharding(mesh, spec_for(()))},
+            ),
+            donate_argnums=(0,),
+        )
+        # Trace-time mesh handoff so ring attention can engage.
+        return with_mesh_context(mesh, jitted)
+
+    def data_iter(
+        self, num_processes: int, process_id: int, mesh: Mesh, seed: int = 0
+    ) -> Iterator[tuple[jax.Array, ...]]:
+        it = datalib.synthetic_images(
+            self.batch_size,
+            shape=(self.cfg.image_size, self.cfg.image_size,
+                   self.cfg.channels),
+            n_classes=self.cfg.n_classes,
+            num_processes=num_processes, process_id=process_id, seed=seed,
+        )
+        img_spec = spec_for(("batch",))
+        for b in it:
+            yield (
+                host_to_global(mesh, img_spec, b.inputs),
+                host_to_global(mesh, img_spec, b.targets),
+            )
+
+
+@register_task("vit")
+def make_vit(**kw) -> ViTTask:
+    return ViTTask(**kw)

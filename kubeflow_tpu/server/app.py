@@ -616,6 +616,8 @@ def main(argv=None) -> int:
     # Transformer replicas call predictors back through this ingress;
     # wildcard binds are not dialable, so point callbacks at loopback.
     cb_host = "127.0.0.1" if args.host in ("0.0.0.0", "::") else args.host
+    if ":" in cb_host:  # IPv6 literals need brackets in a URL authority
+        cb_host = f"[{cb_host}]"
     cp.isvc.base_url = f"http://{cb_host}:{args.port}"
     app = cp.build_app()
     logger.info(

@@ -493,6 +493,10 @@ class ISVCController:
             status.transformer.replicas = [
                 r.info() for r in tsvc.replicas.values()
             ]
+        else:
+            # Transformer removed from the spec: clear its stale status
+            # (replicas/PIDs that no longer exist) rather than carry it.
+            status.transformer = None
         status.in_flight = svc.in_flight
         status.last_request_time = svc.last_request
         status.url = (

@@ -92,8 +92,10 @@ class TransformerModel(Model):
             raise InferenceError(f"predictor unreachable: {e}", 502)
         preds = body.get("predictions")
         if not isinstance(preds, list) or len(preds) != len(instances):
+            got = (len(preds) if isinstance(preds, list)
+                   else type(preds).__name__)
             raise InferenceError(
-                f"predictor returned {type(preds).__name__} of wrong "
-                "arity", 502,
+                f"predictor returned {got} predictions for "
+                f"{len(instances)} instances", 502,
             )
         return preds
