@@ -41,6 +41,8 @@ KIND_ALIASES = {
     "inferenceservice": "InferenceService", "inferenceservices": "InferenceService",
     "isvc": "InferenceService",
     "pipeline": "Pipeline", "pipelines": "Pipeline", "pl": "Pipeline",
+    "inferencegraph": "InferenceGraph", "inferencegraphs": "InferenceGraph",
+    "ig": "InferenceGraph",
     "notebook": "Notebook", "notebooks": "Notebook", "nb": "Notebook",
     "tensorboard": "Tensorboard", "tensorboards": "Tensorboard",
     "tb": "Tensorboard",

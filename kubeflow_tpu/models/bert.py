@@ -90,8 +90,7 @@ PRESETS: dict[str, BertConfig] = {
 }
 
 
-def _dt(name: str):
-    return jnp.dtype(name)
+from kubeflow_tpu.models.common import dt as _dt  # noqa: E402
 
 
 class EncoderBlock(nn.Module):
@@ -316,7 +315,9 @@ class BertTask(TrainTask):
         rng = np.random.default_rng(seed * 31337 + process_id)
         spec = spec_for(("batch", "length"))
         for b in it:
-            clean = b.inputs[:, : self.seq_len]
+            # synthetic_tokens(seq_len + 1) yields inputs already exactly
+            # seq_len wide (it drops the LM-shifted last column).
+            clean = b.inputs
             mask = rng.random(clean.shape) < self.MASK_PROB
             masked = np.where(mask, self.mask_id, clean).astype(np.int32)
             yield (

@@ -4,11 +4,42 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
+
+LOGICAL_RULES = tuple(DEFAULT_RULES.items())
 
 
 def dt(name: str):
     return jnp.dtype(name)
+
+
+def state_shardings(mesh: Mesh, abstract_state):
+    """Map flax logical annotations to a pytree of NamedShardings (same
+    structure as ``abstract_state``) over the mesh.
+
+    Reduced-rank optimizer leaves (adafactor's factored v_row/v_col drop an
+    axis of their param) inherit the param's full-rank logical spec from
+    flax metadata; those leaves are replicated instead -- they are O(dim),
+    not O(dim^2), so replication costs nothing.
+    """
+    logical = nn.get_partition_spec(abstract_state)
+    shardings = nn.logical_to_mesh_sharding(logical, mesh, LOGICAL_RULES)
+
+    def fix(sh, leaf):
+        ndim = getattr(leaf, "ndim", None)
+        if (
+            isinstance(sh, NamedSharding)
+            and ndim is not None
+            and len(sh.spec) > ndim
+        ):
+            return NamedSharding(mesh, P())
+        return sh
+
+    # Unbox flax Partitioned wrappers so both trees have plain leaves.
+    return jax.tree.map(fix, shardings, nn.meta.unbox(abstract_state))
 
 
 def cached_shardings(task, mesh: Mesh, init_fn):
@@ -18,7 +49,6 @@ def cached_shardings(task, mesh: Mesh, init_fn):
     the same way, so the invalidation rule (same mesh object -> reuse)
     lives here once.
     """
-    from kubeflow_tpu.models.llama import state_shardings
     from kubeflow_tpu.parallel.mesh import mesh_context
 
     cache = getattr(task, "_sharding_cache", None)

@@ -149,8 +149,7 @@ PRESETS: dict[str, LlamaConfig] = {
 }
 
 
-def _dt(name: str):
-    return jnp.dtype(name)
+from kubeflow_tpu.models.common import dt as _dt  # noqa: E402
 
 
 class RMSNorm(nn.Module):
@@ -498,30 +497,9 @@ class Llama(nn.Module):
 # ---------------------------------------------------------------------------
 
 
-def state_shardings(mesh: Mesh, abstract_state):
-    """Map flax logical annotations to a pytree of NamedShardings (same
-    structure as ``abstract_state``) over the mesh.
-
-    Reduced-rank optimizer leaves (adafactor's factored v_row/v_col drop an
-    axis of their param) inherit the param's full-rank logical spec from
-    flax metadata; those leaves are replicated instead -- they are O(dim),
-    not O(dim^2), so replication costs nothing.
-    """
-    logical = nn.get_partition_spec(abstract_state)
-    shardings = nn.logical_to_mesh_sharding(logical, mesh, LOGICAL_RULES)
-
-    def fix(sh, leaf):
-        ndim = getattr(leaf, "ndim", None)
-        if (
-            isinstance(sh, NamedSharding)
-            and ndim is not None
-            and len(sh.spec) > ndim
-        ):
-            return NamedSharding(mesh, P())
-        return sh
-
-    # Unbox flax Partitioned wrappers so both trees have plain leaves.
-    return jax.tree.map(fix, shardings, nn.meta.unbox(abstract_state))
+# state_shardings moved to models.common (shared by bert/vit too);
+# re-exported here for backward compatibility.
+from kubeflow_tpu.models.common import state_shardings  # noqa: E402,F401
 
 
 def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:

@@ -89,8 +89,7 @@ PRESETS: dict[str, ViTConfig] = {
 }
 
 
-def _dt(name: str):
-    return jnp.dtype(name)
+from kubeflow_tpu.models.common import dt as _dt  # noqa: E402
 
 
 class ViTBlock(nn.Module):

@@ -58,6 +58,13 @@ from kubeflow_tpu.platform.workbench import (
     validate_tensorboard,
 )
 from kubeflow_tpu.serving.controller import Activator, ISVCController
+from kubeflow_tpu.serving.graph import (
+    GRAPH_KIND,
+    GraphRouter,
+    GraphValidationError,
+    InferenceGraph,
+    validate_graph,
+)
 from kubeflow_tpu.serving.types import (
     InferenceService,
     ServingValidationError,
@@ -183,6 +190,8 @@ class ControlPlane:
                 # Activator: data-plane ingress for InferenceServices.
                 web.route("*", "/serving/{ns}/{name}/{tail:.*}",
                           self.activator.handle),
+                # InferenceGraph ingress: composes ISVCs per request.
+                web.post("/graphs/{ns}/{name}", self.h_graph_infer),
             ]
         )
 
@@ -257,6 +266,11 @@ class ControlPlane:
             validate_tensorboard(tb)
             return tb.to_dict()
 
+        def parse_graph(o):
+            g = InferenceGraph.from_dict(o)
+            validate_graph(g)
+            return g.to_dict()
+
         parser = (
             parse_job if kind in JOB_KINDS
             else {"Experiment": parse_experiment,
@@ -265,7 +279,8 @@ class ControlPlane:
                   "PodDefault": parse_pod_default,
                   "Pipeline": parse_pipeline,
                   "Notebook": parse_notebook,
-                  "Tensorboard": parse_tensorboard}.get(kind)
+                  "Tensorboard": parse_tensorboard,
+                  GRAPH_KIND: parse_graph}.get(kind)
         )
         if parser is not None:
             # Admission-webhook analog: parse + default + validate, then
@@ -365,6 +380,55 @@ class ControlPlane:
             end_step=end_step,
         )
         return web.json_response({"trial": key, "observations": rows})
+
+    async def h_graph_infer(self, req: web.Request) -> web.Response:
+        """Run one request through an InferenceGraph: V1-shaped body in
+        ({"instances": [...]}), composed result out. Each service hop goes
+        through the activator (scale-to-zero per service applies)."""
+        ns, name = req.match_info["ns"], req.match_info["name"]
+        raw = self.store.get(GRAPH_KIND, name, ns)
+        if raw is None:
+            return web.json_response(
+                {"error": f"inference graph {ns}/{name} not found"},
+                status=404,
+            )
+        try:
+            graph = InferenceGraph.from_dict(raw)
+            body = await req.json()
+            instances = body.get("instances")
+            if not isinstance(instances, list):
+                raise ValueError('body must have "instances": [...]')
+        except (ValueError, json.JSONDecodeError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+
+        async def call_service(svc_name: str, insts):
+            # In-process hop through the activator core (same path as
+            # /serving/, without re-entering the HTTP stack).
+            status, payload, _ = await self.activator.proxy(
+                ns, svc_name, f"v1/models/{svc_name}:predict",
+                body=json.dumps({"instances": insts}).encode(),
+            )
+            try:
+                data = json.loads(payload or b"{}")
+            except json.JSONDecodeError:
+                # Non-JSON upstream bodies (plain-text error pages) must
+                # surface as 502, not crash the graph handler.
+                raise GraphValidationError(
+                    f"service {svc_name} returned {status} with non-JSON "
+                    f"body: {payload[:120]!r}"
+                )
+            if status != 200:
+                raise GraphValidationError(
+                    f"service {svc_name} returned {status}: "
+                    f"{str(data.get('error', ''))[:200]}"
+                )
+            return data.get("predictions")
+
+        try:
+            result = await GraphRouter(graph, call_service).execute(instances)
+        except GraphValidationError as e:
+            return web.json_response({"error": str(e)}, status=502)
+        return web.json_response({"predictions": result})
 
     # -- KFAM (P7): access bindings + authz middleware ---------------------
 

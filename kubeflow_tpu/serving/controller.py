@@ -543,32 +543,58 @@ class Activator:
         self.cold_start_timeout = cold_start_timeout
 
     async def handle(self, req: web.Request) -> web.StreamResponse:
-        ns, name = req.match_info["ns"], req.match_info["name"]
-        tail = req.match_info.get("tail", "")
+        status, payload, ctype = await self.proxy(
+            req.match_info["ns"], req.match_info["name"],
+            req.match_info.get("tail", ""),
+            method=req.method,
+            body=await req.read(),
+            content_type=req.content_type or "application/json",
+            component=req.headers.get("X-Kftpu-Component", "").lower(),
+            query_string=req.query_string,
+        )
+        return web.Response(body=payload, status=status, content_type=ctype)
+
+    async def proxy(
+        self,
+        ns: str,
+        name: str,
+        tail: str,
+        method: str = "POST",
+        body: Optional[bytes] = None,
+        content_type: str = "application/json",
+        component: str = "",
+        query_string: str = "",
+    ) -> tuple[int, bytes, str]:
+        """The activator core, callable in-process (HTTP handler and the
+        InferenceGraph router both use it): route to a ready replica of
+        the ingress component, cold-starting if needed. Returns
+        (status, payload bytes, content type)."""
+
+        def err(status: int, message: str) -> tuple[int, bytes, str]:
+            return (status, json.dumps({"error": message}).encode(),
+                    "application/json")
+
         key = f"{ns}/{name}"
         ctrl = self.controller
         raw = ctrl.store.get(KIND, name, ns)
         if raw is None:
-            return web.json_response(
-                {"error": f"inference service {key} not found"}, status=404
-            )
-        # Fail fast on a Failed (crash-looping / invalid) service instead of
-        # holding the request for the whole cold-start timeout.
+            return err(404, f"inference service {key} not found")
+        # Fail fast on a Failed (crash-looping / invalid) service instead
+        # of holding the request for the whole cold-start timeout.
         failed = [
             c for c in raw.get("status", {}).get("conditions", [])
             if c.get("type") == "Failed" and c.get("status")
         ]
         if failed:
-            return web.json_response(
-                {"error": f"service failed ({failed[0].get('reason')}): "
-                          f"{failed[0].get('message')}"},
-                status=503,
+            return err(
+                503,
+                f"service failed ({failed[0].get('reason')}): "
+                f"{failed[0].get('message')}",
             )
         # With a transformer present, it is the ingress component; its
         # replicas call back here with X-Kftpu-Component: predictor
         # (KServe: transformer fronts the predictor service).
         has_transformer = bool((raw.get("spec") or {}).get("transformer"))
-        component = req.headers.get("X-Kftpu-Component", "").lower()
         if has_transformer and component != PRIMARY:
             key = key + TRANSFORMER_SUFFIX
         svc = ctrl.services.setdefault(key, _Service())
@@ -578,25 +604,18 @@ class Activator:
         try:
             replica = await self._get_replica(key, svc)
             if replica is None:
-                return web.json_response(
-                    {"error": "no replica became ready in time"}, status=503
-                )
+                return err(503, "no replica became ready in time")
             replica.in_flight += 1
             url = f"http://127.0.0.1:{replica.port}/{tail}"
-            if req.query_string:
-                url += f"?{req.query_string}"
-            body = await req.read()
+            if query_string:
+                url += f"?{query_string}"
             async with ctrl._http.request(
-                req.method, url, data=body if body else None,
-                headers={"Content-Type": req.content_type or "application/json"},
+                method, url, data=body if body else None,
+                headers={"Content-Type": content_type},
             ) as resp:
-                payload = await resp.read()
-                return web.Response(
-                    body=payload, status=resp.status,
-                    content_type=resp.content_type,
-                )
+                return (resp.status, await resp.read(), resp.content_type)
         except aiohttp.ClientError as e:
-            return web.json_response({"error": f"upstream: {e}"}, status=502)
+            return err(502, f"upstream: {e}")
         finally:
             if replica is not None:
                 replica.in_flight -= 1

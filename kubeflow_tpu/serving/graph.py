@@ -1,0 +1,210 @@
+"""InferenceGraph (KServe v1alpha1 InferenceGraph equivalent, SURVEY.md
+3.3 S1).
+
+A graph composes InferenceServices into one inference endpoint. Node
+router types match the reference:
+
+- ``Sequence``: steps run in order; each step's output ("predictions"
+  payload) becomes the next step's instances (or ``data: $request``
+  re-sends the original request).
+- ``Switch``: the first step whose ``condition`` matches the request
+  instance routes it (conditions are ``field=value`` checks on dict
+  instances); a step with no condition is the default arm.
+- ``Ensemble``: all steps run concurrently; the response maps step name
+  -> predictions.
+- ``Splitter``: one step is picked by ``weight`` (deterministic hash of
+  the request, so identical requests route identically -- canary-style
+  traffic splitting).
+
+Steps reference InferenceServices by name (``service``) or other nodes
+(``node``). Requests enter at the ``root`` node via
+``POST /graphs/{ns}/{name}`` on the control plane; each service hop goes
+through the activator, so scale-to-zero applies per service.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, List, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from kubeflow_tpu.api.types import ObjectMeta
+
+GRAPH_KIND = "InferenceGraph"
+ROUTER_TYPES = ("Sequence", "Switch", "Ensemble", "Splitter")
+
+
+class GraphValidationError(ValueError):
+    pass
+
+
+class GraphStep(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    name: Optional[str] = None
+    # Exactly one of: an InferenceService name or another node's name.
+    service: Optional[str] = None
+    node: Optional[str] = None
+    # Switch arm: "field=value" matched against dict instances; absent =
+    # default arm. Splitter: relative integer weight.
+    condition: Optional[str] = None
+    weight: Optional[int] = Field(default=None, ge=1)
+    # "$request" re-sends the original request instead of the previous
+    # step's output (Sequence only; KServe's data field).
+    data: Optional[str] = None
+
+    @property
+    def label(self) -> str:
+        return self.name or self.service or self.node or "step"
+
+
+class GraphNode(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    router_type: str = "Sequence"
+    steps: List[GraphStep] = Field(default_factory=list)
+
+
+class InferenceGraphSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    nodes: Dict[str, GraphNode]
+
+
+class InferenceGraph(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    kind: str = GRAPH_KIND
+    metadata: ObjectMeta
+    spec: InferenceGraphSpec
+    status: Dict[str, Any] = Field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "InferenceGraph":
+        return cls.model_validate(d)
+
+    def to_dict(self) -> dict:
+        return self.model_dump(mode="json")
+
+
+def validate_graph(g: InferenceGraph) -> None:
+    nodes = g.spec.nodes
+    if "root" not in nodes:
+        raise GraphValidationError("graph needs a 'root' node")
+    for name, node in nodes.items():
+        if node.router_type not in ROUTER_TYPES:
+            raise GraphValidationError(
+                f"node {name!r}: router_type {node.router_type!r} not in "
+                f"{ROUTER_TYPES}"
+            )
+        if not node.steps:
+            raise GraphValidationError(f"node {name!r} has no steps")
+        for s in node.steps:
+            if (s.service is None) == (s.node is None):
+                raise GraphValidationError(
+                    f"node {name!r} step {s.label!r}: exactly one of "
+                    "service/node required"
+                )
+            if s.node is not None and s.node not in nodes:
+                raise GraphValidationError(
+                    f"node {name!r} references unknown node {s.node!r}"
+                )
+        if node.router_type == "Ensemble":
+            labels = [s_.label for s_ in node.steps]
+            if len(set(labels)) != len(labels):
+                raise GraphValidationError(
+                    f"Ensemble node {name!r}: step labels must be unique "
+                    f"(give colliding steps a name:), got {labels}"
+                )
+        if node.router_type == "Splitter":
+            if any(s.weight is None for s in node.steps):
+                raise GraphValidationError(
+                    f"Splitter node {name!r}: every step needs a weight"
+                )
+    # Cycle check: DFS from root over node->node edges.
+    state: Dict[str, int] = {}
+
+    def visit(n: str, path: tuple) -> None:
+        if state.get(n) == 2:
+            return
+        if state.get(n) == 1:
+            raise GraphValidationError(
+                f"node cycle: {' -> '.join(path + (n,))}"
+            )
+        state[n] = 1
+        for s in nodes[n].steps:
+            if s.node is not None:
+                visit(s.node, path + (n,))
+        state[n] = 2
+
+    visit("root", ())
+
+
+def _matches(condition: str, instance: Any) -> bool:
+    if "=" not in condition:
+        return False
+    field, want = condition.split("=", 1)
+    if isinstance(instance, dict):
+        return str(instance.get(field)) == want
+    return False
+
+
+class GraphRouter:
+    """Executes a graph for one request. ``call_service(name, instances)``
+    is injected by the server (it proxies through the activator)."""
+
+    def __init__(self, graph: InferenceGraph, call_service) -> None:
+        self.graph = graph
+        self.call = call_service
+
+    async def execute(self, instances: List[Any]) -> Any:
+        return await self._run_node("root", instances, instances)
+
+    async def _run_step(self, step: GraphStep, instances, original):
+        feed = original if step.data == "$request" else instances
+        if step.service is not None:
+            return await self.call(step.service, feed)
+        return await self._run_node(step.node, feed, original)
+
+    async def _run_node(self, name: str, instances, original):
+        node = self.graph.spec.nodes[name]
+        if node.router_type == "Sequence":
+            out = instances
+            for step in node.steps:
+                out = await self._run_step(step, out, original)
+            return out
+        if node.router_type == "Switch":
+            probe = instances[0] if instances else None
+            default = None
+            for step in node.steps:
+                if step.condition is None:
+                    default = step
+                elif _matches(step.condition, probe):
+                    return await self._run_step(step, instances, original)
+            if default is not None:
+                return await self._run_step(default, instances, original)
+            raise GraphValidationError(
+                f"switch node {name!r}: no arm matched and no default"
+            )
+        if node.router_type == "Ensemble":
+            import asyncio
+
+            outs = await asyncio.gather(*(
+                self._run_step(s, instances, original) for s in node.steps
+            ))
+            return {s.label: o for s, o in zip(node.steps, outs)}
+        # Splitter: deterministic hash of the payload picks the arm, so
+        # identical requests are routed identically (stable canarying).
+        total = sum(s.weight for s in node.steps)
+        digest = hashlib.sha256(
+            json.dumps(instances, sort_keys=True, default=str).encode()
+        ).digest()
+        point = int.from_bytes(digest[:8], "big") % total
+        acc = 0
+        for step in node.steps:
+            acc += step.weight
+            if point < acc:
+                return await self._run_step(step, instances, original)
+        return await self._run_step(node.steps[-1], instances, original)

@@ -1,0 +1,176 @@
+"""InferenceGraph (KServe S1): validation, router semantics, and e2e
+composition over real ISVC replica processes."""
+
+import asyncio
+import json
+
+import pytest
+
+from kubeflow_tpu.serving.graph import (
+    GraphRouter,
+    GraphValidationError,
+    InferenceGraph,
+    validate_graph,
+)
+from tests.test_serving_controller import cp_client, isvc, wait_for  # noqa: F401
+
+
+def graph_obj(nodes, name="g1"):
+    return {
+        "kind": "InferenceGraph",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"nodes": nodes},
+    }
+
+
+class TestValidation:
+    def test_needs_root_and_steps(self):
+        with pytest.raises(GraphValidationError, match="root"):
+            validate_graph(InferenceGraph.from_dict(graph_obj({})))
+        with pytest.raises(GraphValidationError, match="no steps"):
+            validate_graph(InferenceGraph.from_dict(graph_obj(
+                {"root": {"router_type": "Sequence", "steps": []}}
+            )))
+
+    def test_step_needs_exactly_one_target(self):
+        with pytest.raises(GraphValidationError, match="exactly one"):
+            validate_graph(InferenceGraph.from_dict(graph_obj({
+                "root": {"steps": [{"service": "a", "node": "root"}]},
+            })))
+
+    def test_unknown_node_and_cycles_rejected(self):
+        with pytest.raises(GraphValidationError, match="unknown node"):
+            validate_graph(InferenceGraph.from_dict(graph_obj({
+                "root": {"steps": [{"node": "nope"}]},
+            })))
+        with pytest.raises(GraphValidationError, match="cycle"):
+            validate_graph(InferenceGraph.from_dict(graph_obj({
+                "root": {"steps": [{"node": "a"}]},
+                "a": {"steps": [{"node": "root"}]},
+            })))
+
+    def test_splitter_needs_weights(self):
+        with pytest.raises(GraphValidationError, match="weight"):
+            validate_graph(InferenceGraph.from_dict(graph_obj({
+                "root": {"router_type": "Splitter",
+                         "steps": [{"service": "a"}]},
+            })))
+
+
+class TestRouter:
+    def _router(self, nodes, calls):
+        async def call(svc, insts):
+            calls.append((svc, insts))
+            return [f"{svc}:{i}" for i in insts]
+
+        g = InferenceGraph.from_dict(graph_obj(nodes))
+        validate_graph(g)
+        return GraphRouter(g, call)
+
+    def test_sequence_chains_outputs(self):
+        calls = []
+        r = self._router({
+            "root": {"router_type": "Sequence",
+                     "steps": [{"service": "a"}, {"service": "b"}]},
+        }, calls)
+        out = asyncio.run(r.execute([1, 2]))
+        assert out == ["b:a:1", "b:a:2"]
+        assert calls[0] == ("a", [1, 2])
+        assert calls[1] == ("b", ["a:1", "a:2"])
+
+    def test_sequence_data_request_resends_original(self):
+        calls = []
+        r = self._router({
+            "root": {"router_type": "Sequence",
+                     "steps": [{"service": "a"},
+                               {"service": "b", "data": "$request"}]},
+        }, calls)
+        out = asyncio.run(r.execute([1]))
+        assert out == ["b:1"]
+        assert calls[1] == ("b", [1])
+
+    def test_switch_routes_by_condition(self):
+        calls = []
+        r = self._router({
+            "root": {"router_type": "Switch", "steps": [
+                {"service": "big", "condition": "size=large"},
+                {"service": "small"},
+            ]},
+        }, calls)
+        out = asyncio.run(r.execute([{"size": "large", "x": 1}]))
+        assert out[0].startswith("big:")
+        out = asyncio.run(r.execute([{"size": "tiny"}]))
+        assert out[0].startswith("small:")
+
+    def test_ensemble_runs_all(self):
+        calls = []
+        r = self._router({
+            "root": {"router_type": "Ensemble",
+                     "steps": [{"service": "a"}, {"service": "b"}]},
+        }, calls)
+        out = asyncio.run(r.execute([5]))
+        assert out == {"a": ["a:5"], "b": ["b:5"]}
+
+    def test_splitter_is_deterministic_and_weighted(self):
+        calls = []
+        r = self._router({
+            "root": {"router_type": "Splitter", "steps": [
+                {"service": "a", "weight": 1},
+                {"service": "b", "weight": 1},
+            ]},
+        }, calls)
+        first = asyncio.run(r.execute([123]))
+        again = asyncio.run(r.execute([123]))
+        assert first == again  # same payload -> same arm
+        arms = {asyncio.run(r.execute([i]))[0].split(":")[0]
+                for i in range(24)}
+        assert arms == {"a", "b"}  # both arms take traffic
+
+    def test_nested_nodes(self):
+        calls = []
+        r = self._router({
+            "root": {"router_type": "Sequence",
+                     "steps": [{"node": "inner"}]},
+            "inner": {"router_type": "Sequence",
+                      "steps": [{"service": "a"}]},
+        }, calls)
+        assert asyncio.run(r.execute([9])) == ["a:9"]
+
+
+@pytest.mark.e2e
+def test_graph_end_to_end_over_real_services(cp_client):  # noqa: F811
+    """Sequence graph of two echo ISVCs through the live control plane."""
+    cp, client, loop = cp_client
+
+    async def run():
+        for name in ("stage1", "stage2"):
+            r = await client.post("/apis/InferenceService", json=isvc(name))
+            assert r.status == 200, await r.text()
+        r = await client.post("/apis/InferenceGraph", json=graph_obj({
+            "root": {"router_type": "Sequence",
+                     "steps": [{"service": "stage1"},
+                               {"service": "stage2"}]},
+        }))
+        assert r.status == 200, await r.text()
+        for name in ("stage1", "stage2"):
+            await wait_for(
+                lambda n=name: (cp.store.get("InferenceService", n, "default")
+                                or {}).get("status", {}).get(
+                                    "predictor", {}).get("ready_replicas"),
+                msg=f"{name} ready",
+            )
+        r = await client.post("/graphs/default/g1",
+                              json={"instances": [11]})
+        assert r.status == 200, await r.text()
+        body = await r.json()
+        p = body["predictions"][0]
+        # stage2 echoed stage1's echo.
+        assert p["echo"]["echo"] == 11, body
+
+        # Bad graph spec rejected at apply.
+        r = await client.post("/apis/InferenceGraph", json=graph_obj({
+            "root": {"steps": [{"node": "missing"}]},
+        }, name="bad"))
+        assert r.status == 422
+
+    loop.run_until_complete(run())
