@@ -29,6 +29,7 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
+from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
 AXES = ("data", "pipe", "fsdp", "expert", "sequence", "tensor")
@@ -100,6 +101,48 @@ def build_mesh(
     devs = list(devices) if devices is not None else jax.devices()
     shape = config.resolve(len(devs))
     arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def build_multislice_mesh(
+    config: MeshConfig = MeshConfig(),
+    num_slices: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Multislice mesh: the ``data`` axis spans slices (DCN), every other
+    axis stays within a slice (ICI) -- gradient all-reduce is the only
+    traffic that crosses the slow links, the standard multislice recipe.
+
+    On real multislice hardware (devices expose ``slice_index``) the
+    layout comes from ``mesh_utils.create_hybrid_device_mesh`` so the
+    intra-slice axes respect the physical torus. Elsewhere (CPU
+    emulation, single slice) the device list is partitioned in order,
+    slice-major -- same logical shape, testable on a virtual mesh.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if num_slices <= 1:
+        return build_mesh(config, devs)
+    if len(devs) % num_slices:
+        raise ValueError(
+            f"{len(devs)} devices not divisible into {num_slices} slices"
+        )
+    shape = config.resolve(len(devs))
+    data = shape[0]
+    if data % num_slices:
+        raise ValueError(
+            f"data axis {data} must be a multiple of num_slices "
+            f"{num_slices}: DCN traffic is confined to the data axis"
+        )
+    ici_shape = (data // num_slices, *shape[1:])
+    if any(getattr(d, "slice_index", None) is not None for d in devs):
+        arr = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, (num_slices,) + (1,) * (len(AXES) - 1), devs
+        ).reshape(shape)
+    else:
+        # Emulation: jax.devices() is already slice-major, so the plain
+        # C-order reshape puts each slice's block on consecutive data
+        # rows -- same layout build_mesh produces.
+        arr = np.asarray(devs).reshape(shape)
     return Mesh(arr, AXES)
 
 

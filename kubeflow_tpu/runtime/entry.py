@@ -31,6 +31,9 @@ def parse_args(argv=None):
     p.add_argument("--sequence", type=int, default=1)
     p.add_argument("--expert", type=int, default=1)
     p.add_argument("--pipe", type=int, default=1)
+    p.add_argument("--num-slices", type=int,
+                   default=int(os.environ.get("KFTPU_NUM_SLICES", "1")),
+                   help="multislice: data axis spans slices over DCN")
     p.add_argument(
         "--arg", action="append", default=[],
         help="task kwargs, key=value (int/float autocast)", metavar="K=V",
@@ -76,10 +79,14 @@ def main(argv=None) -> int:
     task_kwargs = {k: _cast(v) for k, v in task_kwargs.items()}
     task = get_task(args.model, **task_kwargs)
 
-    mesh = build_mesh(
-        MeshConfig(data=-1, fsdp=args.fsdp, sequence=args.sequence,
-                   tensor=args.tensor, expert=args.expert, pipe=args.pipe)
-    )
+    cfg = MeshConfig(data=-1, fsdp=args.fsdp, sequence=args.sequence,
+                     tensor=args.tensor, expert=args.expert, pipe=args.pipe)
+    if args.num_slices > 1:
+        from kubeflow_tpu.parallel.mesh import build_multislice_mesh
+
+        mesh = build_multislice_mesh(cfg, num_slices=args.num_slices)
+    else:
+        mesh = build_mesh(cfg)
     n_chips = len(jax.devices())
     logger.info(
         "worker %s/%s rank %d/%d mesh %s devices %d",
