@@ -72,6 +72,8 @@ def rendezvous_env(
     if job.spec.checkpoint.dir:
         env[ENV_CHECKPOINT_DIR] = job.spec.checkpoint.dir
         env[ENV_RESUME] = "1" if job.spec.checkpoint.resume else "0"
+        env["KFTPU_CKPT_INTERVAL"] = str(job.spec.checkpoint.interval_steps)
+        env["KFTPU_CKPT_KEEP"] = str(job.spec.checkpoint.keep)
     prof = job.spec.profiling
     if prof.enabled:
         env[ENV_PROFILE_DIR] = prof.dir or ""

@@ -137,7 +137,7 @@ def build_multislice_mesh(
     if any(getattr(d, "slice_index", None) is not None for d in devs):
         arr = mesh_utils.create_hybrid_device_mesh(
             ici_shape, (num_slices,) + (1,) * (len(AXES) - 1), devs
-        ).reshape(shape)
+        )
     else:
         # Emulation: jax.devices() is already slice-major, so the plain
         # C-order reshape puts each slice's block on consecutive data

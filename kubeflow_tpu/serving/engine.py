@@ -109,18 +109,60 @@ def pack_weights(params: dict, cfg: LlamaConfig) -> dict:
     p = params["params"] if "params" in params else params
     if "layers" not in p:
         raise ValueError("engine requires scan_layers=True checkpoints")
-    if cfg.n_experts > 1 or "moe" in p["layers"]["layer"]:
-        raise ValueError(
-            "engine does not support MoE checkpoints yet (decode path "
-            "assumes a dense per-layer mlp subtree)"
-        )
     dt = jnp.dtype(cfg.dtype)
+    layers = _cast(p["layers"]["layer"], dt)
+    if "moe" in layers:
+        # Router weights route DISCRETELY (top-k): a bf16 rounding can
+        # flip a near-tie to a different expert than training chose, an
+        # O(1) output change. The [L, H, E] router is tiny; keep it f32.
+        layers = dict(layers)
+        layers["moe"] = dict(layers["moe"])
+        layers["moe"]["router"] = (
+            p["layers"]["layer"]["moe"]["router"].astype(jnp.float32)
+        )
     return {
         "embed": _cast(p["embed"]["embedding"], dt),           # [V, H]
         "final_scale": p["final_norm"]["scale"].astype(jnp.float32),
         "lm_head": _cast(p["lm_head"]["kernel"], dt),          # [H, V]
-        "layers": _cast(p["layers"]["layer"], dt),             # leaves [L, ...]
+        "layers": layers,                                      # leaves [L, ...]
     }
+
+
+def _moe_ffn(cfg: LlamaConfig, m: dict, h):
+    """MoE FFN for inference: compute every expert densely, weight by the
+    renormalized top-k router probabilities.
+
+    No capacity, no drops -- capacity is a training-throughput artifact;
+    at serving batch sizes the E/k extra FFN FLOPs are cheaper than
+    gather/scatter of per-token expert weights, and the result is exact
+    (matches the training layer whenever training dropped nothing).
+    """
+    e, k = cfg.n_experts, cfg.experts_per_token
+    logits = jnp.einsum(
+        "bsh,he->bse", h.astype(jnp.float32),
+        m["router"].astype(jnp.float32),
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)                    # [B,S,k]
+    topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+    w_e = jnp.zeros_like(probs)                             # [B,S,E]
+    for j in range(k):
+        w_e = w_e + jax.nn.one_hot(topi[..., j], e) * topv[..., j:j + 1]
+    gate = jnp.einsum("bsh,ehi->bsei", h, m["gate_proj"])
+    up = jnp.einsum("bsh,ehi->bsei", h, m["up_proj"])
+    act = jax.nn.silu(gate) * up
+    out = jnp.einsum("bsei,eih->bseh", act, m["down_proj"])
+    return jnp.einsum("bse,bseh->bsh", w_e.astype(h.dtype), out)
+
+
+def _ffn(cfg: LlamaConfig, lp: dict, h):
+    if "moe" in lp:
+        return _moe_ffn(cfg, lp["moe"], h)
+    mlp = lp["mlp"]
+    gate = jnp.einsum("bsh,hi->bsi", h, mlp["gate_proj"]["kernel"])
+    up = jnp.einsum("bsh,hi->bsi", h, mlp["up_proj"]["kernel"])
+    return jnp.einsum("bsi,ih->bsh", jax.nn.silu(gate) * up,
+                      mlp["down_proj"]["kernel"])
 
 
 def _layer_forward(cfg: LlamaConfig, lp: dict, x, freqs, positions, mask):
@@ -128,7 +170,7 @@ def _layer_forward(cfg: LlamaConfig, lp: dict, x, freqs, positions, mask):
     prefill path; decode attends over the cache, see _decode). Returns
     (x, k, v) with k/v the current tokens' cache rows."""
 
-    attn, mlp = lp["attn"], lp["mlp"]
+    attn = lp["attn"]
     h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps)
     q = jnp.einsum("bsh,hnd->bsnd", h, attn["q_proj"]["kernel"])
     k = jnp.einsum("bsh,hnd->bsnd", h, attn["k_proj"]["kernel"])
@@ -139,11 +181,7 @@ def _layer_forward(cfg: LlamaConfig, lp: dict, x, freqs, positions, mask):
     out = jnp.einsum("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
     x = x + out
     h = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
-    gate = jnp.einsum("bsh,hi->bsi", h, mlp["gate_proj"]["kernel"])
-    up = jnp.einsum("bsh,hi->bsi", h, mlp["up_proj"]["kernel"])
-    down = jnp.einsum("bsi,ih->bsh", jax.nn.silu(gate) * up,
-                      mlp["down_proj"]["kernel"])
-    return x + down, k, v
+    return x + _ffn(cfg, lp, h), k, v
 
 
 def _prefill(cfg: LlamaConfig, w: dict, tokens, length):
@@ -215,11 +253,7 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths):
         out = jnp.einsum("bsnd,ndh->bsh", out, lp["attn"]["o_proj"]["kernel"])
         x = x + out
         h = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
-        gate = jnp.einsum("bsh,hi->bsi", h, lp["mlp"]["gate_proj"]["kernel"])
-        up = jnp.einsum("bsh,hi->bsi", h, lp["mlp"]["up_proj"]["kernel"])
-        x = x + jnp.einsum(
-            "bsi,ih->bsh", jax.nn.silu(gate) * up, lp["mlp"]["down_proj"]["kernel"]
-        )
+        x = x + _ffn(cfg, lp, h)
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (w["layers"], cache_k, cache_v))
