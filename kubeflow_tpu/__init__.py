@@ -8,12 +8,18 @@ stack (training-operator, Katib, KServe; see SURVEY.md) designed TPU-first:
   injects ``jax.distributed`` coordinator environment (the ICI/DCN-world
   equivalent of Kubeflow's NCCL MASTER_ADDR/RANK wiring).
 - An in-runtime training stack (flax/pjit models over a
-  ``jax.sharding.Mesh`` with data/fsdp/tensor/sequence axes) that the
-  reference delegates to user containers.
+  ``jax.sharding.Mesh`` with data/pipe/fsdp/expert/sequence/tensor axes:
+  DP, GPipe pipelining, ZeRO-3, MoE expert parallel, ring-attention
+  context parallel, tensor parallel) that the reference delegates to
+  user containers, plus multislice DCN meshes.
 - An HPO loop (experiments -> suggestions -> trials -> scraped metrics ->
-  early stopping) equivalent to Katib.
+  early stopping) equivalent to Katib, and a Pipelines DAG engine with a
+  kfp-style DSL.
 - A serving path (InferenceService -> PJRT-driven JAX model server,
-  V1/V2 inference protocols, scale-to-zero) equivalent to KServe.
+  V1/V2 inference protocols, scale-to-zero, transformers,
+  InferenceGraphs) equivalent to KServe.
+- Platform glue: profiles/quotas, pod defaults, notebooks, tensorboards,
+  KFAM access management, and a central dashboard.
 
 Reference parity map lives in SURVEY.md section 3; note /root/reference was
 empty at survey time (SURVEY.md section 0), so parity citations are to the
