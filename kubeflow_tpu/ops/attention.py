@@ -63,13 +63,35 @@ def dot_product_attention(
     segment_ids: Optional[jax.Array] = None,
     impl: str = "auto",
 ) -> jax.Array:
-    """Attention entry point. impl: auto | xla | flash | ring.
+    """Attention entry point. impl: auto | xla | flash | ring | ulysses.
 
     ``ring`` shards the sequence dim over the mesh's ``sequence`` axis via
-    shard_map + ppermute (context parallelism); ``auto`` picks it whenever
-    the active mesh has a non-trivial sequence axis, because otherwise
-    GSPMD would all-gather K/V for the S x S einsum.
+    shard_map + ppermute (context parallelism); ``ulysses`` uses one
+    all-to-all per direction to re-shard heads instead (needs the
+    per-tensor-shard head count divisible by the sequence axis). ``auto``
+    picks the ring whenever the
+    active mesh has a non-trivial sequence axis, because otherwise GSPMD
+    would all-gather K/V for the S x S einsum.
     """
+    if impl == "ulysses":
+        from kubeflow_tpu.parallel.mesh import active_mesh
+        from kubeflow_tpu.ops.ulysses import (
+            ulysses_attention_sharded,
+            ulysses_shardable,
+        )
+
+        mesh = active_mesh()
+        if (
+            mesh is not None
+            and mesh.shape.get("sequence", 1) > 1
+            and segment_ids is None
+            and not _inside_manual_region()
+            and ulysses_shardable(q, k, mesh)
+        ):
+            return ulysses_attention_sharded(q, k, v, mesh, causal=causal)
+        # Untileable for Ulysses: fall through to auto, which may still
+        # pick the ring (no head constraint) before plain attention.
+        impl = "auto"
     if impl in ("auto", "ring"):
         from kubeflow_tpu.parallel.mesh import active_mesh
 
@@ -116,21 +138,28 @@ def _inside_manual_region() -> bool:
     return any("Manual" in str(t) for t in getattr(mesh, "axis_types", ()))
 
 
-def _ring_shardable(q: jax.Array, k: jax.Array, mesh) -> bool:
+def _cp_shardable_base(q: jax.Array, k: jax.Array, mesh) -> bool:
+    """Tiling preconditions shared by every context-parallel scheme
+    (ring, Ulysses): self-attention shapes only (zero-aligned causal
+    masks; xla_attention tail-aligns decode masks -- different
+    semantics), batch divisible by the batch axes, sequence divisible by
+    the sequence axis."""
     from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
 
     batch = 1
     for ax in DEFAULT_RULES["batch"]:
         batch *= mesh.shape.get(ax, 1)
-    seq = mesh.shape["sequence"]
-    heads = mesh.shape.get("tensor", 1)
     return (
-        # Self-attention only: the ring's causal mask is zero-aligned,
-        # while xla_attention tail-aligns cross-length (decode) masks --
-        # different semantics, so Sq != Sk must not ride the ring.
         q.shape[1] == k.shape[1]
         and q.shape[0] % batch == 0
-        and q.shape[1] % seq == 0
+        and q.shape[1] % mesh.shape["sequence"] == 0
+    )
+
+
+def _ring_shardable(q: jax.Array, k: jax.Array, mesh) -> bool:
+    heads = mesh.shape.get("tensor", 1)
+    return (
+        _cp_shardable_base(q, k, mesh)
         and q.shape[2] % heads == 0
         and k.shape[2] % heads == 0
     )

@@ -1,0 +1,117 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second of the two standard context-parallel schemes (the other is
+ring attention, ops/ring_attention.py):
+
+- Activations arrive sequence-sharded ([B, S/n, H, D] per device).
+- One ``all_to_all`` re-shards heads instead of sequence
+  ([B, S, H/n, D]): every device then holds the FULL sequence for a
+  subset of heads, so plain (flash) attention runs locally with exact
+  causal semantics and no per-step communication.
+- A second ``all_to_all`` restores the sequence layout.
+
+Trade-off vs the ring: Ulysses moves Q/K/V/O once per layer over
+all-to-all (great on ICI's bisection bandwidth) but needs
+``n_heads % n == 0`` (and ``n_kv_heads % n == 0`` after GQA broadcast,
+which this wrapper guarantees by broadcasting KV heads first); the ring
+has no head constraint but overlaps compute with P2P transfers. Pick per
+model geometry: ``attention_impl="ulysses"`` opts in.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _local_attention(q, k, v, causal):
+    from kubeflow_tpu.ops.flash_attention import flash_attention
+
+    return flash_attention(q, k, v, causal=causal)
+
+
+def ulysses_attention(
+    q: jax.Array,  # [B, S/n, H, D] per device (sequence-sharded)
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    axis_name: str = "sequence",
+) -> jax.Array:
+    """Per-shard body (already inside shard_map over ``axis_name``)."""
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return _local_attention(q, k, v, causal)
+    # seq-sharded -> head-sharded: split heads, gather sequence.
+    a2a = partial(
+        jax.lax.all_to_all, axis_name=axis_name,
+        split_axis=2, concat_axis=1, tiled=True,
+    )
+    qh, kh, vh = a2a(q), a2a(k), a2a(v)  # [B, S, H/n, D]
+    out = _local_attention(qh, kh, vh, causal)
+    # head-sharded -> seq-sharded: split sequence, gather heads.
+    return jax.lax.all_to_all(
+        out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_shardable(q: jax.Array, k: jax.Array, mesh: Mesh) -> bool:
+    """Exact-tiling gate for the global [B, S, H, D] arrays.
+
+    Only the query head count matters: K/V are broadcast to it whenever
+    their own heads would not tile (ulysses_attention_sharded), so if q
+    tiles, the wrapper can always make K/V tile.
+    """
+    from kubeflow_tpu.ops.attention import _cp_shardable_base
+
+    n = mesh.shape.get("sequence", 1)
+    heads_ax = mesh.shape.get("tensor", 1)
+    return (
+        _cp_shardable_base(q, k, mesh)
+        and q.shape[2] % heads_ax == 0
+        and (q.shape[2] // heads_ax) % n == 0
+    )
+
+
+def ulysses_attention_sharded(
+    q: jax.Array,  # [B, S, H, D] global
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = True,
+    axis_name: str = "sequence",
+    batch_axes=None,
+    head_axis: str = "tensor",
+) -> jax.Array:
+    """shard_map wrapper: S sharded over ``axis_name``, heads over
+    ``head_axis``, batch over the rules table's batch axes.
+
+    GQA: narrow K/V ride the all_to_all at their native width whenever
+    they tile (the per-layer all-to-all is Ulysses' whole cost; the
+    local flash kernel broadcasts KV heads itself). Only untileable KV
+    head counts are broadcast to the query width first.
+    """
+    if batch_axes is None:
+        from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
+
+        batch_axes = DEFAULT_RULES["batch"]
+    n = mesh.shape[axis_name]
+    heads_ax = mesh.shape.get(head_axis, 1)
+    kv = k.shape[2]
+    kv_tiles = kv % heads_ax == 0 and (kv // heads_ax) % n == 0
+    if not kv_tiles and q.shape[2] != kv:
+        from kubeflow_tpu.ops.attention import _repeat_kv
+
+        n_rep = q.shape[2] // kv
+        k = _repeat_kv(k, n_rep)
+        v = _repeat_kv(v, n_rep)
+    spec = P(batch_axes, axis_name, head_axis, None)
+    fn = partial(ulysses_attention, causal=causal, axis_name=axis_name)
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)
