@@ -12,8 +12,8 @@ ring attention, ops/ring_attention.py):
 
 Trade-off vs the ring: Ulysses moves Q/K/V/O once per layer over
 all-to-all (great on ICI's bisection bandwidth) but needs
-``n_heads % n == 0`` (and ``n_kv_heads % n == 0`` after GQA broadcast,
-which this wrapper guarantees by broadcasting KV heads first); the ring
+``n_heads % n == 0`` (untileable KV head counts are broadcast to the
+query width first; tileable ones ride at native width); the ring
 has no head constraint but overlaps compute with P2P transfers. Pick per
 model geometry: ``attention_impl="ulysses"`` opts in.
 """
@@ -21,10 +21,8 @@ model geometry: ``attention_impl="ulysses"`` opts in.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 

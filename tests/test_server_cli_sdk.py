@@ -169,3 +169,14 @@ class TestSdk:
         with pytest.raises(JobFailedError):
             tc.wait_for_job_conditions("sdk-bad", timeout=60)
         tc.delete_job("sdk-bad")
+
+
+@pytest.mark.e2e
+def test_dashboard_serves(server):
+    import urllib.request
+
+    page = urllib.request.urlopen(server + "/dashboard", timeout=5).read()
+    text = page.decode()
+    assert "kftpu control plane" in text
+    # Escaping helper present (stored-XSS guard) and kinds enumerated.
+    assert "function esc(" in text and "InferenceService" in text

@@ -46,11 +46,14 @@ class TestUlysses:
             np.asarray(out), np.asarray(ref), atol=2e-5
         )
 
-    def test_gqa_broadcast(self):
+    @pytest.mark.parametrize("hkv", [2, 4])
+    def test_gqa_both_transport_branches(self, hkv):
+        """hkv=2 forces the KV broadcast branch (2 % 4 != 0); hkv=4 rides
+        the all_to_all at native width."""
         mesh = build_mesh(
             MeshConfig(data=1, sequence=4), devices=jax.devices()[:4]
         )
-        q, k, v = _qkv(h=8, hkv=2)
+        q, k, v = _qkv(h=8, hkv=hkv)
         ref = xla_attention(q, k, v, causal=True)
         with mesh:
             out = ulysses_attention_sharded(q, k, v, mesh, causal=True)
