@@ -238,8 +238,11 @@ class BertTask(TrainTask):
         seq_len: int = 128,
         lr: float = 1e-4,
         weight_decay: float = 0.01,
+        data: str = "synthetic",
         **overrides,
     ) -> None:
+        # "synthetic" or a path to a pre-tokenized corpus.
+        self.data = data
         cfg = PRESETS[preset]
         if overrides:
             cfg = dataclasses.replace(cfg, **overrides)
@@ -307,11 +310,21 @@ class BertTask(TrainTask):
     def data_iter(
         self, num_processes: int, process_id: int, mesh: Mesh, seed: int = 0
     ) -> Iterator[tuple[jax.Array, ...]]:
-        # Leave headroom for the [MASK] id at vocab_size - 1.
-        it = datalib.synthetic_tokens(
-            self.batch_size, self.seq_len + 1, self.cfg.vocab_size - 1,
-            num_processes=num_processes, process_id=process_id, seed=seed,
-        )
+        if self.data == "synthetic":
+            # Leave headroom for the [MASK] id at vocab_size - 1.
+            it = datalib.synthetic_tokens(
+                self.batch_size, self.seq_len + 1, self.cfg.vocab_size - 1,
+                num_processes=num_processes, process_id=process_id,
+                seed=seed,
+            )
+        else:
+            it = datalib.file_tokens(
+                self.data, self.batch_size, self.seq_len,
+                num_processes=num_processes, process_id=process_id,
+                # vocab_size - 1: the top id is reserved for [MASK]; a
+                # corpus emitting it would alias real tokens with masks.
+                seed=seed, vocab_size=self.cfg.vocab_size - 1,
+            )
         rng = np.random.default_rng(seed * 31337 + process_id)
         spec = spec_for(("batch", "length"))
         for b in it:

@@ -525,9 +525,12 @@ class LlamaTask(TrainTask):
         optimizer: str = "adamw",
         grad_clip: float = 1.0,
         n_microbatches: Optional[int] = None,
+        data: str = "synthetic",
         **overrides,
     ) -> None:
         self.n_microbatches = n_microbatches
+        # "synthetic" or a path to a pre-tokenized corpus (data.file_tokens).
+        self.data = data
         cfg = PRESETS[preset]
         if overrides:
             cfg = dataclasses.replace(cfg, **overrides)
@@ -679,10 +682,18 @@ class LlamaTask(TrainTask):
     def data_iter(
         self, num_processes: int, process_id: int, mesh: Mesh, seed: int = 0
     ) -> Iterator[tuple[jax.Array, ...]]:
-        it = datalib.synthetic_tokens(
-            self.batch_size, self.seq_len + 1, self.cfg.vocab_size,
-            num_processes=num_processes, process_id=process_id, seed=seed,
-        )
+        if self.data == "synthetic":
+            it = datalib.synthetic_tokens(
+                self.batch_size, self.seq_len + 1, self.cfg.vocab_size,
+                num_processes=num_processes, process_id=process_id,
+                seed=seed,
+            )
+        else:
+            it = datalib.file_tokens(
+                self.data, self.batch_size, self.seq_len,
+                num_processes=num_processes, process_id=process_id,
+                seed=seed, vocab_size=self.cfg.vocab_size,
+            )
         spec = spec_for(("batch", "length"))
         for b in it:
             yield (

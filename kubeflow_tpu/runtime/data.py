@@ -1,16 +1,26 @@
 """Data pipelines.
 
-Synthetic, deterministic, infinite iterators -- the target environment has
-zero egress (SURVEY.md 7.0), so benchmark/training data is generated on
-host and staged to device. Each pipeline yields process-local shards: with
-N data-parallel processes, process i gets the i-th slice of the global
-batch, matching how jax.make_array_from_process_local_data assembles the
-global array.
+Deterministic, infinite iterators. Two source families:
+
+- **Synthetic** (default): generated on host -- the target environment
+  has zero egress (SURVEY.md 7.0), so benches and e2e tests need no
+  staged data.
+- **File-backed** (``file_tokens``): pre-tokenized corpora from disk --
+  a ``.npy``/``.npz`` of token ids, a ``.bin`` (uint16/uint32 memmap,
+  the nanoGPT/Megatron convention), or a ``datasets.save_to_disk``
+  directory with an ``input_ids``/``tokens`` column. This is the
+  replacement for the reference SDK's dataset-download init containers:
+  stage once, point ``--arg data=<path>`` at it.
+
+Each pipeline yields process-local shards: with N data-parallel
+processes, process i gets the i-th slice of the global batch, matching
+how jax.make_array_from_process_local_data assembles the global array.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Iterator
 
 import numpy as np
@@ -63,5 +73,92 @@ def synthetic_tokens(
         base = rng.integers(0, vocab_size, size=(local, 1))
         steps = rng.integers(0, 17, size=(local, seq_len))
         toks = (base + np.cumsum(steps, axis=1)) % vocab_size
+        toks = toks.astype(np.int32)
+        yield Batch(inputs=toks[:, :-1], targets=toks[:, 1:])
+
+
+def _load_token_stream(path: str) -> np.ndarray:
+    """Load a 1-D token-id array from any supported on-disk format.
+
+    .bin stays a memmap (a 10 GB corpus must not be materialized in RAM;
+    slicing a memmap yields plain ndarray windows, and batches are cast
+    to int32 per window anyway)."""
+    if os.path.isdir(path):
+        # datasets.save_to_disk directory.
+        import datasets  # local import: torch-adjacent, slow
+
+        ds = datasets.load_from_disk(path)
+        if isinstance(ds, datasets.DatasetDict):
+            if len(ds) != 1:
+                raise ValueError(
+                    f"dataset at {path} has splits {sorted(ds)}; point at "
+                    "one split's subdirectory"
+                )
+            ds = next(iter(ds.values()))
+        for col in ("input_ids", "tokens"):
+            if col in ds.column_names:
+                return np.concatenate(
+                    [np.asarray(row).ravel() for row in ds[col]]
+                )
+        raise ValueError(
+            f"dataset at {path} has no input_ids/tokens column "
+            f"(columns: {ds.column_names})"
+        )
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            return np.asarray(z[z.files[0]]).ravel()
+    if path.endswith(".npy"):
+        return np.load(path, mmap_mode="r").ravel()
+    if path.endswith(".bin"):
+        # nanoGPT/Megatron-style raw memmap; uint16 is the common case.
+        return np.memmap(path, dtype=np.uint16, mode="r")
+    raise ValueError(
+        f"unsupported token file {path!r} (want .npy/.npz/.bin or a "
+        "datasets.save_to_disk directory)"
+    )
+
+
+def file_tokens(
+    path: str,
+    global_batch: int,
+    seq_len: int,
+    num_processes: int = 1,
+    process_id: int = 0,
+    seed: int = 0,
+    vocab_size: int | None = None,
+) -> Iterator[Batch]:
+    """LM batches from a pre-tokenized corpus on disk.
+
+    Infinite: each epoch draws random windows of ``seq_len`` (the
+    standard packed-LM recipe -- no document boundaries, matching how
+    the .bin convention is consumed). Deterministic per (seed, process);
+    different processes draw disjoint random streams.
+    """
+    if global_batch % num_processes:
+        raise ValueError(
+            f"batch {global_batch} % processes {num_processes} != 0"
+        )
+    stream = _load_token_stream(path)
+    if stream.size < seq_len + 1:
+        raise ValueError(
+            f"corpus {path} has {stream.size} tokens < seq_len+1="
+            f"{seq_len + 1}"
+        )
+    if vocab_size is not None:
+        # Fail fast on a vocab mismatch: out-of-range ids would silently
+        # clamp in the embedding lookup and train on garbage. One O(N)
+        # scan at iterator construction (memmap-friendly).
+        top = int(np.max(stream))
+        if top >= vocab_size:
+            raise ValueError(
+                f"corpus {path} contains token id {top} >= model vocab "
+                f"{vocab_size} (retokenize or pick a bigger-vocab preset)"
+            )
+    local = global_batch // num_processes
+    rng = np.random.default_rng(seed * 9176213 + process_id)
+    hi = stream.size - seq_len - 1
+    while True:
+        starts = rng.integers(0, hi + 1, size=(local,))
+        toks = np.stack([stream[s: s + seq_len + 1] for s in starts])
         toks = toks.astype(np.int32)
         yield Batch(inputs=toks[:, :-1], targets=toks[:, 1:])

@@ -243,3 +243,74 @@ class TestMultislice:
             build_multislice_mesh(
                 MeshConfig(data=1, fsdp=8), num_slices=2
             )
+
+
+class TestFileTokens:
+    def _train_one(self, data_path):
+        from kubeflow_tpu.models import get_task
+
+        task = get_task("llama", preset="llama-tiny", batch_size=8,
+                        seq_len=16, lr=1e-3, data=data_path)
+        mesh = build_mesh(MeshConfig(data=-1))
+        with mesh:
+            state = task.init_state(jax.random.PRNGKey(0), mesh)
+            step = task.train_step_fn(mesh)
+            it = task.data_iter(1, 0, mesh)
+            state, m = step(state, *next(it))
+        return float(m["loss"])
+
+    def test_npy_corpus(self, tmp_path):
+        corpus = np.random.default_rng(0).integers(0, 256, 4096)
+        p = tmp_path / "corpus.npy"
+        np.save(p, corpus)
+        assert np.isfinite(self._train_one(str(p)))
+
+    def test_bin_corpus(self, tmp_path):
+        corpus = np.random.default_rng(0).integers(
+            0, 256, 4096
+        ).astype(np.uint16)
+        p = tmp_path / "corpus.bin"
+        corpus.tofile(p)
+        assert np.isfinite(self._train_one(str(p)))
+
+    def test_datasets_dir_corpus(self, tmp_path):
+        datasets = pytest.importorskip("datasets")
+
+        ds = datasets.Dataset.from_dict({
+            "input_ids": [list(range(100)), list(range(100, 240))],
+        })
+        d = tmp_path / "ds"
+        ds.save_to_disk(str(d))
+        assert np.isfinite(self._train_one(str(d)))
+
+    def test_windows_deterministic_and_from_corpus(self, tmp_path):
+        from kubeflow_tpu.runtime.data import file_tokens
+
+        corpus = np.arange(1000, dtype=np.int64) % 256
+        p = tmp_path / "c.npy"
+        np.save(p, corpus)
+        a = next(file_tokens(str(p), 4, 16, seed=7))
+        b = next(file_tokens(str(p), 4, 16, seed=7))
+        np.testing.assert_array_equal(a.inputs, b.inputs)
+        # Windows are contiguous slices of the corpus.
+        row = a.inputs[0]
+        assert all(
+            (row[i + 1] - row[i]) % 256 == 1 for i in range(len(row) - 1)
+        )
+        # Targets are next-token shifted.
+        np.testing.assert_array_equal(a.targets[:, :-1], a.inputs[:, 1:])
+
+    def test_errors(self, tmp_path):
+        from kubeflow_tpu.runtime.data import file_tokens
+
+        p = tmp_path / "tiny.npy"
+        np.save(p, np.arange(4))
+        with pytest.raises(ValueError, match="tokens <"):
+            next(file_tokens(str(p), 2, 16))
+        with pytest.raises(ValueError, match="unsupported"):
+            next(file_tokens(str(tmp_path / "x.txt"), 2, 16))
+        # Vocab mismatch fails fast instead of clamping silently.
+        big = tmp_path / "big.npy"
+        np.save(big, np.array([1, 2, 50000] * 20))
+        with pytest.raises(ValueError, match="vocab"):
+            next(file_tokens(str(big), 2, 16, vocab_size=256))
