@@ -6,15 +6,18 @@ Deterministic, infinite iterators. Two source families:
   has zero egress (SURVEY.md 7.0), so benches and e2e tests need no
   staged data.
 - **File-backed** (``file_tokens``): pre-tokenized corpora from disk --
-  a ``.npy``/``.npz`` of token ids, a ``.bin`` (uint16/uint32 memmap,
-  the nanoGPT/Megatron convention), or a ``datasets.save_to_disk``
-  directory with an ``input_ids``/``tokens`` column. This is the
-  replacement for the reference SDK's dataset-download init containers:
-  stage once, point ``--arg data=<path>`` at it.
+  a ``.npy``/``.npz`` of token ids, a raw memmap (``.bin`` = uint16, the
+  nanoGPT convention; ``.bin32`` = uint32 for >64k vocabs), or a
+  ``datasets.save_to_disk`` directory with an ``input_ids``/``tokens``
+  column. This is the replacement for the reference SDK's
+  dataset-download init containers: stage once, point
+  ``--arg data=<path>`` at it.
 
-Each pipeline yields process-local shards: with N data-parallel
-processes, process i gets the i-th slice of the global batch, matching
-how jax.make_array_from_process_local_data assembles the global array.
+Each pipeline yields process-local shards sized global_batch/N. The
+synthetic pipelines slice one deterministic global batch (process i gets
+the i-th slice); file_tokens instead gives each process an independent
+random-window stream -- shards are i.i.d. draws from the corpus, not
+slices of a single enumerated batch.
 """
 
 from __future__ import annotations
@@ -110,10 +113,13 @@ def _load_token_stream(path: str) -> np.ndarray:
     if path.endswith(".npy"):
         return np.load(path, mmap_mode="r").ravel()
     if path.endswith(".bin"):
-        # nanoGPT/Megatron-style raw memmap; uint16 is the common case.
+        # nanoGPT-style raw memmap: uint16 by convention.
         return np.memmap(path, dtype=np.uint16, mode="r")
+    if path.endswith(".bin32"):
+        # uint32 variant for vocabs past 65535 (e.g. Llama-3's 128k).
+        return np.memmap(path, dtype=np.uint32, mode="r")
     raise ValueError(
-        f"unsupported token file {path!r} (want .npy/.npz/.bin or a "
+        f"unsupported token file {path!r} (want .npy/.npz/.bin/.bin32 or a "
         "datasets.save_to_disk directory)"
     )
 

