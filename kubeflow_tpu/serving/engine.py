@@ -262,6 +262,31 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths):
     return logits, new_k, new_v
 
 
+def _decode_block(cfg: LlamaConfig, n_steps: int, w: dict, cache_k,
+                  cache_v, tokens, lengths, rng, temps):
+    """n_steps decode+sample iterations in ONE device program.
+
+    Amortizes the host<->device dispatch roundtrip (dominant on remote
+    tunnels, still material on direct-attached chips) over n_steps
+    tokens. Slots that hit EOS mid-block keep decoding; the host
+    discards their overshoot -- rows past a slot's accepted length are
+    never attended (the decode mask is position-bounded) and prefill
+    overwrites them on slot reuse.
+    """
+
+    def body(carry, step_rng):
+        ck, cv, toks, lens = carry
+        logits, ck, cv = _decode(cfg, w, ck, cv, toks, lens)
+        nxt = _sample(logits, step_rng, temps)
+        return (ck, cv, nxt, lens + 1), nxt
+
+    rngs = jax.random.split(rng, n_steps)
+    (ck, cv, _, _), outs = jax.lax.scan(
+        body, (cache_k, cache_v, tokens, lengths), rngs
+    )
+    return outs, ck, cv  # outs [n_steps, B]
+
+
 def _sample(logits, rng, temps):
     """Per-slot sampling: temp<=0 means greedy. logits [B,V], temps [B]."""
 
@@ -306,7 +331,12 @@ class GenerationEngine:
         max_seq: Optional[int] = None,
         seed: int = 0,
         config: Optional[LlamaConfig] = None,
+        decode_block: int = 8,
     ) -> None:
+        # Max decode steps fused into one device program (power-of-2
+        # sub-blocks keep the compile count bounded); 1 = per-token
+        # dispatch.
+        self.decode_block = max(1, decode_block)
         cfg = config or PRESETS[preset]
         if max_seq is not None:
             cfg = dataclasses.replace(cfg, max_seq=max_seq)
@@ -338,11 +368,20 @@ class GenerationEngine:
         # cfg is a static closure (hashable primitives); weights are
         # ARGUMENTS so multi-GB params are buffers, not jaxpr constants.
         prefill_jit = jax.jit(partial(_prefill, cfg))
-        decode_jit = jax.jit(partial(_decode, cfg), donate_argnums=(1, 2))
+        block_jits = {}
+
+        def decode_block_call(n, ck, cv, toks, lens, rng, temps):
+            if n not in block_jits:
+                block_jits[n] = jax.jit(
+                    partial(_decode_block, cfg, n), donate_argnums=(1, 2)
+                )
+            return block_jits[n](self.weights, ck, cv, toks, lens, rng,
+                                 temps)
+
+        self._decode_block_call = decode_block_call
         insert_jit = jax.jit(_insert, donate_argnums=(0, 1))
         sample_jit = jax.jit(_sample)
         self._prefill = lambda tokens, n: prefill_jit(self.weights, tokens, n)
-        self._decode = lambda ck, cv, t, l: decode_jit(self.weights, ck, cv, t, l)
         self._insert = insert_jit
         self._sample = sample_jit
         self._thread: Optional[threading.Thread] = None
@@ -424,28 +463,44 @@ class GenerationEngine:
             req.future.set_result(req.generated)
 
     def step(self) -> bool:
-        """Admit pending + run one decode round. Returns True if work ran."""
+        """Admit pending + run one decode block. Returns True if work ran."""
 
         self._admit()
         if not self.active:
             return False
+        # Block size: largest power-of-2 <= decode_block within every
+        # slot's CACHE headroom (an out-of-range write must not happen).
+        # Budget is deliberately NOT a bound: a single nearly-done slot
+        # would otherwise convoy the whole batch down to per-token
+        # dispatch; its overshoot is discarded host-side like EOS.
+        remaining = min(
+            self.cfg.max_seq - int(self.lengths[slot])
+            for slot in self.active
+        )
+        n = 1
+        while n * 2 <= min(self.decode_block, max(remaining, 1)):
+            n *= 2
         tokens = np.zeros(self.max_slots, np.int32)
+        temps = np.zeros(self.max_slots, np.float32)
         for slot, req in self.active.items():
             tokens[slot] = req.generated[-1]
+            temps[slot] = req.temperature
         # lengths[slot] already counts the last generated token, whose K/V
         # is not in the cache yet: its position is lengths-1.
         positions = jnp.asarray(
             np.maximum(self.lengths - 1, 0), jnp.int32
         )
-        logits, self.cache_k, self.cache_v = self._decode(
-            self.cache_k, self.cache_v, jnp.asarray(tokens), positions
+        outs, self.cache_k, self.cache_v = self._decode_block_call(
+            n, self.cache_k, self.cache_v, jnp.asarray(tokens), positions,
+            self._next_rng(), jnp.asarray(temps),
         )
-        temps = np.zeros(self.max_slots, np.float32)
-        for slot, req in self.active.items():
-            temps[slot] = req.temperature
-        nxt = np.asarray(self._sample(logits, self._next_rng(), jnp.asarray(temps)))
+        outs = np.asarray(outs)  # [n, B]
         for slot in list(self.active):
-            self._emit(self.active[slot], int(nxt[slot]))
+            req = self.active[slot]
+            for j in range(n):
+                self._emit(req, int(outs[j, slot]))
+                if slot not in self.active:  # finished: drop overshoot
+                    break
         return True
 
     # -- convenience / threaded driver ------------------------------------

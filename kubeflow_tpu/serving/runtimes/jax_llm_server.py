@@ -14,6 +14,8 @@ Options (ModelSpec.options):
   the geometry from the checkpoint's kftpu_config.json (written by
   kubeflow_tpu.runtime.convert_hf)
 - ``max_slots``: concurrent sequences in the KV cache (default 8)
+- ``decode_block``: decode steps fused per device dispatch (default 8;
+  1 = per-token dispatch for lowest streaming latency)
 - ``max_seq``: override cache length
 - ``tokenizer``: "byte" (default; ids = utf-8 bytes, self-contained) or a
   HF tokenizer name resolved from the local cache only (zero egress)
@@ -155,14 +157,20 @@ class JaxLLMModel(Model):
             params=params,
             max_slots=int(opts.get("max_slots", 8)),
             max_seq=opts.get("max_seq"),
+            decode_block=int(opts.get("decode_block", 8)),
         )
         if config is not None:
             self.engine = GenerationEngine(config=config, **engine_kw)
         else:
             self.engine = GenerationEngine(preset=preset, **engine_kw)
-        # Warm both programs so first request latency is serving-time, not
+        # Warm prefill + the full-size decode block (the only block the
+        # steady state uses; smaller ones appear only near cache
+        # exhaustion) so first request latency is serving-time, not
         # compile-time (SURVEY.md 7.4 #5).
-        self.engine.generate([1, 2, 3], max_new_tokens=2)
+        self.engine.generate(
+            [1, 2, 3],
+            max_new_tokens=max(2, self.engine.decode_block + 1),
+        )
         self.engine.start()
         self.ready = True
 
