@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import enum
 import time
-from typing import Any, Optional
+from typing import Any, Literal, Optional
 
 from pydantic import BaseModel, ConfigDict, Field
 
@@ -161,6 +161,12 @@ class SchedulingPolicy(BaseModel):
     min_available: Optional[int] = None
     queue: str = "default"
     priority: int = 0
+    # "Never" (default): the gang waits in the queue for free capacity.
+    # "PreemptLowerPriority": a gang that cannot be admitted may evict
+    # strictly-lower-priority running gangs (Volcano preempt action /
+    # k8s PriorityClass preemptionPolicy semantics). On TPU the victim is
+    # quiesced whole-slice and resumes from its latest checkpoint.
+    preemption: Literal["Never", "PreemptLowerPriority"] = "Never"
 
 
 class ElasticPolicy(BaseModel):

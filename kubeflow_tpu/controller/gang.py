@@ -11,8 +11,12 @@ solve (two jobs each holding half their pods' resources).
 The capacity model is deliberately simple: one pool of ``total_chips``
 TPU chips plus a host-process budget, with priority + FIFO ordering and
 per-queue accounting. This matches what the reference actually guarantees
-(minMember admission), without reimplementing Volcano's full queue/
-preemption machinery.
+(minMember admission). Priority preemption (Volcano's ``preempt`` action /
+k8s PriorityClass ``preemptionPolicy``) is supported for gangs that opt in
+via ``scheduling.preemption=PreemptLowerPriority``: victim selection is
+all-or-nothing (``preemption_victims``), and eviction itself is the
+controller's job -- on TPU a victim is quiesced whole-slice and later
+resumes from its latest checkpoint (SURVEY.md 5.3/5.4).
 """
 
 from __future__ import annotations
@@ -79,12 +83,18 @@ class GangScheduler:
         res = [r for k, r in self._reserved.items() if k.startswith(ns + "/")]
         return sum(r.chips for r in res), len(res)
 
-    def _quota_allows(self, ns: str, chips: int) -> bool:
+    def _quota_allows(
+        self, ns: str, chips: int, released: tuple[int, int] = (0, 0)
+    ) -> bool:
+        """``released`` = (chips, jobs) this namespace is about to give back
+        (e.g. same-namespace preemption victims) before admitting."""
         quota = self._ns_quotas.get(ns)
         if quota is None:
             return True
         max_chips, max_jobs = quota
         used_chips, used_jobs = self.namespace_usage(ns)
+        used_chips -= released[0]
+        used_jobs -= released[1]
         if max_chips is not None and used_chips + chips > max_chips:
             return False
         if max_jobs is not None and used_jobs + 1 > max_jobs:
@@ -156,25 +166,7 @@ class GangScheduler:
         # an admin raising the quota must un-stick the queue.
         ns = key.split("/", 1)[0]
         sched = job.spec.run_policy.scheduling
-        # A gang may not jump past pending gangs that sort before it
-        # (priority, then FIFO): without this, small jobs backfill forever
-        # and big slices starve.
-        mine = self._pending.get(key)
-        # A quota-blocked pending gang from ANOTHER namespace is skipped,
-        # not a barrier (mirror of admissible()): a namespace waiting on
-        # its own quota must not export that limit to other tenants' FIFO
-        # position. Within the same namespace it stays a barrier, or later
-        # small jobs would keep the quota consumed and starve it forever.
-        blocked = any(
-            (p.sort_key < mine.sort_key if mine is not None
-             else p.priority >= sched.priority)
-            for p in self._pending.values()
-            if p.job_key != key
-            and (
-                p.job_key.split("/", 1)[0] == ns
-                or self._quota_allows(p.job_key.split("/", 1)[0], p.chips)
-            )
-        )
+        blocked = self._pending_barrier(key, ns, sched, self._pending.get(key))
         if not blocked and self._fits(chips, processes) \
                 and self._quota_allows(ns, chips):
             res = Reservation(
@@ -197,6 +189,104 @@ class GangScheduler:
                 seq=next(self._seq),
             )
         return None
+
+    def _pending_barrier(
+        self,
+        key: str,
+        ns: str,
+        sched,
+        mine: Optional[_Pending],
+        released: Optional[dict[str, tuple[int, int]]] = None,
+    ) -> bool:
+        """True when a pending gang that sorts before ``key`` owns the next
+        admission slot.
+
+        A gang may not jump past pending gangs that sort before it
+        (priority, then FIFO): without this, small jobs backfill forever
+        and big slices starve. A quota-blocked pending gang from ANOTHER
+        namespace is skipped, not a barrier (mirror of ``admissible()``): a
+        namespace waiting on its own quota must not export that limit to
+        other tenants' FIFO position. Within the same namespace it stays a
+        barrier, or later small jobs would keep the quota consumed and
+        starve it forever. ``released`` maps namespace -> (chips, jobs)
+        about to be given back (preemption victims), so the quota skip is
+        judged against POST-eviction usage -- a foreign gang that eviction
+        itself would un-block IS a barrier.
+        """
+        for p in self._pending.values():
+            if p.job_key == key:
+                continue
+            p_ns = p.job_key.split("/", 1)[0]
+            if p_ns != ns and not self._quota_allows(
+                p_ns, p.chips, released=(released or {}).get(p_ns, (0, 0))
+            ):
+                continue
+            if (p.sort_key < mine.sort_key if mine is not None
+                    else p.priority >= sched.priority):
+                return True
+        return False
+
+    def preemption_victims(
+        self, job: TrainJob, replicas_override: Optional[int] = None
+    ) -> Optional[list[str]]:
+        """Job keys whose eviction would let ``job``'s gang fit; None if
+        preemption cannot help.
+
+        All-or-nothing: returns a victim set only when releasing ALL of it
+        (plus current free capacity) fits the gang -- never a partial kill
+        that frees chips without admitting anyone. Victims are running
+        gangs with STRICTLY lower priority, taken lowest-priority-first and
+        youngest-first within a priority (minimizing lost work), matching
+        Volcano's preemptee ordering. Returns None when another pending
+        gang sorts ahead of ``job``: that gang owns the next admission slot,
+        so preempting on this job's behalf would leak the freed capacity
+        past the queue order.
+        """
+        key = job.key
+        sched = job.spec.run_policy.scheduling
+        ns = key.split("/", 1)[0]
+        chips, processes = self.demand(job, replicas_override)
+        candidates = sorted(
+            (r for r in self._reserved.values() if r.priority < sched.priority),
+            key=lambda r: (r.priority, -r.admitted_at),
+        )
+        victims: list[Reservation] = []
+        free_c, free_p = self.free_chips, self.max_processes - self.used_processes
+        for r in candidates:
+            if chips <= free_c and processes <= free_p:
+                break
+            victims.append(r)
+            free_c += r.chips
+            free_p += r.processes
+        if chips > free_c or processes > free_p:
+            return None
+        # Minimality pass: a small early victim can become unnecessary once
+        # a later, larger one joins the set -- drop any whose survival still
+        # fits the gang, so no running slice is quiesced for nothing.
+        for r in list(victims):
+            if chips <= free_c - r.chips and processes <= free_p - r.processes:
+                victims.remove(r)
+                free_c -= r.chips
+                free_p -= r.processes
+        # All remaining checks run against POST-eviction usage: eviction
+        # returns victims' chips/jobs to their namespaces, which can
+        # un-block a foreign pending gang that then owns the admission
+        # slot -- in that case preempting for THIS job would kill victims
+        # without admitting it (the try_admit after eviction would refuse).
+        released_by_ns: dict[str, tuple[int, int]] = {}
+        for r in victims:
+            r_ns = r.job_key.split("/", 1)[0]
+            c, j = released_by_ns.get(r_ns, (0, 0))
+            released_by_ns[r_ns] = (c + r.chips, j + 1)
+        if self._pending_barrier(
+            key, ns, sched, self._pending.get(key), released=released_by_ns
+        ):
+            return None
+        if not self._quota_allows(
+            ns, chips, released=released_by_ns.get(ns, (0, 0))
+        ):
+            return None
+        return [r.job_key for r in victims] or None
 
     def best_fit_workers(self, job: TrainJob) -> Optional[int]:
         """Largest Worker count in [elastic.min, spec replicas) whose gang

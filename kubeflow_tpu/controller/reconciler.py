@@ -153,6 +153,24 @@ class JobController:
 
     # -- exit callback (from launcher) ------------------------------------
 
+    def _find_job(self, ns: str, name: str) -> tuple[Optional[str], Optional[dict]]:
+        """(kind, object) for a stored job of any kind, or (None, None)."""
+        for kind in JOB_KINDS:
+            obj = self.store.get(kind, name, ns)
+            if obj is not None:
+                return kind, obj
+        return None, None
+
+    @staticmethod
+    def _lead_worker_id(job: TrainJob) -> Optional[str]:
+        """Worker id whose exit-0 decides job success (rank 0 of the first
+        success-deciding replica type)."""
+        lead = next(
+            (t for t in SUCCESS_POLICY_REPLICA[job.kind]
+             if t in job.spec.replica_specs), None,
+        )
+        return f"{job.key}/{lead.value.lower()}-0" if lead else None
+
     async def _on_worker_exit(self, ref: WorkerRef, code: int) -> None:
         rt = self._runtimes.get(ref.req.job_key)
         if rt is None or rt.workers.get(ref.worker_id) is not ref:
@@ -165,10 +183,9 @@ class JobController:
         ns, name = ref.req.job_key.split("/", 1)
         # Kind is recoverable from the stored object; enqueue all kinds is
         # wasteful, so look it up directly.
-        for kind in JOB_KINDS:
-            if self.store.get(kind, name, ns) is not None:
-                self._enqueue(kind, ns, name)
-                return
+        kind, _ = self._find_job(ns, name)
+        if kind is not None:
+            self._enqueue(kind, ns, name)
 
     # -- reconcile --------------------------------------------------------
 
@@ -286,6 +303,29 @@ class JobController:
             if n is not None:
                 res = self.gang.try_admit(job, replicas_override=n)
                 workers_override = n if res is not None else None
+        if res is None and \
+                job.spec.run_policy.scheduling.preemption == "PreemptLowerPriority":
+            # Victim selection is all-or-nothing for the FULL gang size
+            # (reduced-size elastic admission was already tried above, so a
+            # preempting gang claims its spec-size slice).
+            victims = self.gang.preemption_victims(job)
+            if victims:
+                # Re-check each victim immediately before its eviction: a
+                # worker exit that arrived (even during an earlier victim's
+                # kill awaits) but hasn't been reconciled yet could carry a
+                # Succeeded outcome that eviction would discard and re-run.
+                # Defer to let exits settle, then re-evaluate from scratch.
+                deferred = False
+                for vkey in victims:
+                    if self._has_unprocessed_exits(vkey):
+                        deferred = True
+                        break
+                    await self._evict(vkey, by=job.key)
+                if deferred:
+                    self._enqueue_later(0.05, kind, job.namespace, job.name)
+                else:
+                    res = self.gang.try_admit(job)
+                    workers_override = None
         if res is None:
             self._record_event(
                 job, "GangPending",
@@ -332,6 +372,59 @@ class JobController:
         )
         return True
 
+    def _has_unprocessed_exits(self, victim_key: str) -> bool:
+        """A worker of this job exited but the exit hasn't been reconciled
+        into persisted status yet (failures are consumed by reconcile, so a
+        lingering entry is always unprocessed; a lead-worker success means
+        the job is about to be marked Succeeded). A job whose persisted
+        phase is already terminal has nothing left to process -- its
+        lead-success entry lives on in the runtime (clean_pod_policy=None
+        keeps residual workers), and must not defer eviction forever."""
+        rt = self._runtimes.get(victim_key)
+        if rt is None:
+            return False
+        ns, name = victim_key.split("/", 1)
+        kind, obj = self._find_job(ns, name)
+        if obj is None:
+            return False
+        vjob = TrainJob.from_dict(obj)
+        if vjob.status.phase.value in ("Succeeded", "Failed"):
+            return False  # already reconciled to a terminal state
+        if rt.failed:
+            return True
+        lead_id = self._lead_worker_id(vjob)
+        return lead_id is not None and lead_id in rt.succeeded
+
+    async def _evict(self, victim_key: str, by: str) -> None:
+        """Preempt a running gang: quiesce whole-slice, release its
+        reservation, and send it back through admission (where it queues at
+        its own priority and later resumes from its latest checkpoint, the
+        same path as a gang restart -- SURVEY.md 5.3/5.4)."""
+        ns, name = victim_key.split("/", 1)
+        await self._teardown(victim_key, release=True)
+        kind, obj = self._find_job(ns, name)
+        if obj is None:
+            return
+        vjob = TrainJob.from_dict(obj)
+        if vjob.status.phase.value in ("Succeeded", "Failed"):
+            # Terminal job holding capacity only through residual workers
+            # (clean_pod_policy=None): the teardown reclaimed the slice;
+            # the job keeps its terminal status and must NOT restart.
+            self._record_event(
+                vjob, "ResidualPreempted",
+                f"residual workers of finished job evicted by {by}",
+            )
+            return
+        before = vjob.status.model_dump(mode="json")
+        vjob.status.formed_replicas = None
+        vjob.status.set_condition(
+            ConditionType.Restarting, "Preempted",
+            f"gang evicted by higher-priority {by}",
+        )
+        self._record_event(vjob, "Preempted", f"evicted by {by}")
+        self._persist(kind, vjob, before)
+        self._enqueue(kind, ns, name)
+
     async def _spawn_worker(
         self,
         job: TrainJob,
@@ -372,11 +465,7 @@ class JobController:
             job.status.replica_statuses[rtype] = st
 
         # Success policy: rank 0 of the first success-deciding replica type.
-        success_types = SUCCESS_POLICY_REPLICA[job.kind]
-        lead = next(
-            (t for t in success_types if t in job.spec.replica_specs), None
-        )
-        lead_id = f"{job.key}/{lead.value.lower()}-0" if lead else None
+        lead_id = self._lead_worker_id(job)
 
         if lead_id and lead_id in rt.succeeded:
             job.status.set_condition(ConditionType.Succeeded, "JobSucceeded")
@@ -534,10 +623,9 @@ class JobController:
                 continue
             seen.add(cand)
             ns, name = cand.split("/", 1)
-            for kind in JOB_KINDS:
-                if self.store.get(kind, name, ns) is not None:
-                    self._enqueue(kind, ns, name)
-                    break
+            kind, _ = self._find_job(ns, name)
+            if kind is not None:
+                self._enqueue(kind, ns, name)
 
     # -- persistence helpers ----------------------------------------------
 

@@ -470,15 +470,21 @@ class GenerationEngine:
             return False
         # Block size: largest power-of-2 <= decode_block within every
         # slot's CACHE headroom (an out-of-range write must not happen).
-        # Budget is deliberately NOT a bound: a single nearly-done slot
-        # would otherwise convoy the whole batch down to per-token
-        # dispatch; its overshoot is discarded host-side like EOS.
+        # The MIN token budget is deliberately NOT a bound: a single
+        # nearly-done slot would otherwise convoy the whole batch down to
+        # per-token dispatch; its overshoot is discarded host-side like
+        # EOS. The MAX budget IS a bound: when every active slot is nearly
+        # done, fused steps past the longest budget are pure waste.
         remaining = min(
             self.cfg.max_seq - int(self.lengths[slot])
             for slot in self.active
         )
+        budget = max(
+            req.max_new_tokens - len(req.generated)
+            for req in self.active.values()
+        )
         n = 1
-        while n * 2 <= min(self.decode_block, max(remaining, 1)):
+        while n * 2 <= min(self.decode_block, max(remaining, 1), max(budget, 1)):
             n *= 2
         tokens = np.zeros(self.max_slots, np.int32)
         temps = np.zeros(self.max_slots, np.float32)

@@ -20,7 +20,13 @@ from kubeflow_tpu.api import (
     apply_defaults,
     validate_job,
 )
-from kubeflow_tpu.api.types import ConditionType, ObjectMeta, RestartPolicy
+from kubeflow_tpu.api.types import (
+    CleanPodPolicy,
+    ConditionType,
+    ObjectMeta,
+    RestartPolicy,
+    RunPolicy,
+)
 from kubeflow_tpu.controller import FakeLauncher, GangScheduler, JobController
 from kubeflow_tpu.store import ObjectStore
 
@@ -356,6 +362,233 @@ class TestGangScheduler:
         assert g.try_admit(make_job("small", replicas=1, tpu=1)) is None
         # 'small' would fit, but the gang at the head must not be starved.
         assert g.admissible() == []
+
+
+class TestPreemption:
+    def test_preempts_lower_priority_and_victim_resumes(self):
+        async def run():
+            async with Harness(total_chips=4) as h:
+                h.submit(make_job("low", replicas=4, tpu=1))
+                await h.wait_phase("low", "Running")
+                hi = make_job("hi", replicas=4, tpu=1)
+                hi.spec.run_policy.scheduling.priority = 10
+                hi.spec.run_policy.scheduling.preemption = "PreemptLowerPriority"
+                h.submit(hi)
+                await h.wait_phase("hi", "Running")
+                # Whole victim gang quiesced, not a partial kill.
+                assert sorted(h.launcher.killed) == [
+                    f"default/low/worker-{i}" for i in range(4)
+                ]
+                low = h.job("low")
+                assert any(c.reason == "Preempted" for c in low.status.conditions)
+                await h.wait(
+                    lambda: "default/low" in h.gang.pending(), msg="low requeued"
+                )
+                # Preemption is not a failure: no backoff budget consumed.
+                assert low.status.restart_count == 0
+                # Preemptor finishes -> victim re-admitted (resume path).
+                await h.launcher.exit("default/hi/worker-0", 0)
+                await h.wait_phase("hi", "Succeeded")
+                await h.wait_phase("low", "Running")
+
+        asyncio.run(run())
+
+    def test_high_priority_without_optin_queues(self):
+        async def run():
+            async with Harness(total_chips=4) as h:
+                h.submit(make_job("low", replicas=4, tpu=1))
+                await h.wait_phase("low", "Running")
+                hi = make_job("hi", replicas=4, tpu=1)
+                hi.spec.run_policy.scheduling.priority = 10  # preemption=Never
+                h.submit(hi)
+                await h.wait(
+                    lambda: "default/hi" in h.gang.pending(), msg="hi queued"
+                )
+                assert h.launcher.killed == []
+                assert h.job("low").status.phase.value == "Running"
+
+        asyncio.run(run())
+
+    def test_equal_priority_never_preempts(self):
+        async def run():
+            async with Harness(total_chips=4) as h:
+                h.submit(make_job("first", replicas=4, tpu=1))
+                await h.wait_phase("first", "Running")
+                peer = make_job("peer", replicas=4, tpu=1)
+                peer.spec.run_policy.scheduling.preemption = "PreemptLowerPriority"
+                h.submit(peer)
+                await h.wait(
+                    lambda: "default/peer" in h.gang.pending(), msg="peer queued"
+                )
+                assert h.launcher.killed == []
+
+        asyncio.run(run())
+
+    def test_no_partial_preemption_when_insufficient(self):
+        async def run():
+            async with Harness(total_chips=8) as h:
+                h.submit(make_job("small", replicas=2, tpu=1))  # priority 0
+                peer = make_job("peer", replicas=6, tpu=1)
+                peer.spec.run_policy.scheduling.priority = 20
+                h.submit(peer)
+                await h.wait_phase("small", "Running")
+                await h.wait_phase("peer", "Running")
+                # Evicting 'small' alone can never fit 8 chips ('peer' out-
+                # ranks hi): no victim may be killed without admitting hi.
+                hi = make_job("hi", replicas=8, tpu=1)
+                hi.spec.run_policy.scheduling.priority = 10
+                hi.spec.run_policy.scheduling.preemption = "PreemptLowerPriority"
+                h.submit(hi)
+                await h.wait(
+                    lambda: "default/hi" in h.gang.pending(), msg="hi queued"
+                )
+                assert h.launcher.killed == []
+                assert h.job("small").status.phase.value == "Running"
+
+        asyncio.run(run())
+
+    def test_victim_selection_order(self):
+        g = GangScheduler(total_chips=8)
+        old = make_job("old-low", replicas=2, tpu=1)
+        g.try_admit(old)
+        g.reservation("default/old-low").admitted_at = 100.0
+        young = make_job("young-low", replicas=2, tpu=1)
+        g.try_admit(young)
+        g.reservation("default/young-low").admitted_at = 200.0
+        mid = make_job("mid", replicas=4, tpu=1)
+        mid.spec.run_policy.scheduling.priority = 5
+        g.try_admit(mid)
+        hi = make_job("hi", replicas=2, tpu=1)
+        hi.spec.run_policy.scheduling.priority = 10
+        # Needs 2 chips: youngest lowest-priority victim first, and only
+        # as many victims as needed.
+        assert g.preemption_victims(hi) == ["default/young-low"]
+        big = make_job("big", replicas=8, tpu=1)
+        big.spec.run_policy.scheduling.priority = 6
+        # 8 chips needs all three running gangs (all priority < 6) evicted,
+        # lowest priority first, youngest first within a priority.
+        assert g.preemption_victims(big) == [
+            "default/young-low", "default/old-low", "default/mid"
+        ]
+
+    def test_minimal_victim_set(self):
+        g = GangScheduler(total_chips=8)
+        small = make_job("small", replicas=1, tpu=1)  # priority 0
+        g.try_admit(small)
+        big = make_job("big", replicas=6, tpu=1)
+        big.spec.run_policy.scheduling.priority = 5
+        g.try_admit(big)
+        hi = make_job("hi", replicas=6, tpu=1)
+        hi.spec.run_policy.scheduling.priority = 10
+        # Greedy order collects 'small' first, but once 'big' joins the set
+        # 'small' is unnecessary (free 1 + 6 >= 6): it must be spared.
+        assert g.preemption_victims(hi) == ["default/big"]
+
+    def test_quota_blocked_foreign_pending_is_not_barrier(self):
+        g = GangScheduler(total_chips=4)
+        g.set_namespace_quota("nsa", tpu=0)
+        low = make_job("low", replicas=4, tpu=1)
+        g.try_admit(low)
+        blocked = make_job("blocked", replicas=1, tpu=1)
+        blocked.metadata.namespace = "nsa"
+        blocked.spec.run_policy.scheduling.priority = 50
+        assert g.try_admit(blocked) is None  # pending, quota-blocked forever
+        hi = make_job("hi", replicas=4, tpu=1)
+        hi.spec.run_policy.scheduling.priority = 10
+        hi.spec.run_policy.scheduling.preemption = "PreemptLowerPriority"
+        # 'blocked' can never take the freed capacity (nsa quota is 0), so
+        # it must not veto hi's preemption -- same rule as try_admit.
+        assert g.preemption_victims(hi) == ["default/low"]
+
+    def test_eviction_unblocked_foreign_pending_is_barrier(self):
+        g = GangScheduler(total_chips=4)
+        g.set_namespace_quota("nsa", tpu=4)
+        vict = make_job("vict", replicas=4, tpu=1)
+        vict.metadata.namespace = "nsa"
+        g.try_admit(vict)
+        p = make_job("p", replicas=4, tpu=1)
+        p.metadata.namespace = "nsa"
+        p.spec.run_policy.scheduling.priority = 50
+        assert g.try_admit(p) is None  # pending, quota-blocked by vict
+        hi = make_job("hi", replicas=4, tpu=1)
+        hi.spec.run_policy.scheduling.priority = 10
+        hi.spec.run_policy.scheduling.preemption = "PreemptLowerPriority"
+        # Evicting vict would un-block 'p' (same-namespace quota returns),
+        # and 'p' outranks hi -- so eviction would kill vict without
+        # admitting hi. Must refuse.
+        assert g.preemption_victims(hi) is None
+
+    def test_preemption_defers_to_unprocessed_success(self):
+        async def run():
+            async with Harness(total_chips=4) as h:
+                h.submit(make_job("low", replicas=4, tpu=1))
+                await h.wait_phase("low", "Running")
+                # Stage the race: low's lead worker exit is recorded in the
+                # controller's in-memory runtime but its reconcile has not
+                # run when the preemptor reconciles first.
+                rt = h.ctl._runtimes["default/low"]
+                rt.workers.pop("default/low/worker-0")
+                rt.succeeded.add("default/low/worker-0")
+                hi = make_job("hi", replicas=4, tpu=1)
+                hi.spec.run_policy.scheduling.priority = 10
+                hi.spec.run_policy.scheduling.preemption = "PreemptLowerPriority"
+                h.submit(hi)
+                await asyncio.sleep(0.1)
+                # Preemption must defer -- nothing evicted yet.
+                assert h.launcher.killed == []
+                assert h.job("hi").status.phase.value != "Running"
+                # Now let low's success reconcile: it completes normally
+                # (never re-run) and hi admits via the freed capacity.
+                h.ctl._enqueue("JAXJob", "default", "low")
+                low = await h.wait_phase("low", "Succeeded")
+                assert not any(
+                    c.reason == "Preempted" for c in low.status.conditions
+                )
+                await h.wait_phase("hi", "Running")
+
+        asyncio.run(run())
+
+    def test_preempt_residual_workers_keeps_terminal_status(self):
+        async def run():
+            async with Harness(total_chips=4) as h:
+                h.submit(make_job(
+                    "low", replicas=2, tpu=2,
+                    run_policy=RunPolicy(clean_pod_policy=CleanPodPolicy.NoneP),
+                ))
+                await h.wait_phase("low", "Running")
+                await h.launcher.exit("default/low/worker-0", 0)
+                await h.wait_phase("low", "Succeeded")
+                # clean_pod_policy=None: worker-1 lives on, reservation held.
+                assert h.gang.reservation("default/low") is not None
+                hi = make_job("hi", replicas=2, tpu=2)
+                hi.spec.run_policy.scheduling.priority = 10
+                hi.spec.run_policy.scheduling.preemption = "PreemptLowerPriority"
+                h.submit(hi)
+                await h.wait_phase("hi", "Running")
+                assert "default/low/worker-1" in h.launcher.killed
+                low = h.job("low")
+                # The finished job must stay Succeeded -- never restarted.
+                assert low.status.phase.value == "Succeeded"
+                assert not any(
+                    c.reason == "Preempted" for c in low.status.conditions
+                )
+                assert "default/low" not in h.gang.pending()
+
+        asyncio.run(run())
+
+    def test_pending_precedence_blocks_preemption(self):
+        g = GangScheduler(total_chips=4)
+        low = make_job("low", replicas=4, tpu=1)
+        g.try_admit(low)
+        top = make_job("top", replicas=4, tpu=1)
+        top.spec.run_policy.scheduling.priority = 50
+        assert g.try_admit(top) is None  # pending, outranks 'hi'
+        hi = make_job("hi", replicas=4, tpu=1)
+        hi.spec.run_policy.scheduling.priority = 10
+        hi.spec.run_policy.scheduling.preemption = "PreemptLowerPriority"
+        # 'top' owns the next admission slot: preempting for 'hi' would
+        # hand the freed chips past the queue order.
+        assert g.preemption_victims(hi) is None
 
 
 class TestFailureSemantics:

@@ -112,6 +112,30 @@ def test_eos_and_budget_stop(tiny):
     assert out2 == [out[0]]
 
 
+def test_block_capped_by_longest_budget(tiny):
+    cfg, _, _, params = tiny
+    eng = GenerationEngine(config=cfg, params=params, max_slots=2, decode_block=8)
+    ns = []
+    orig = eng._decode_block_call
+    eng._decode_block_call = lambda n, *a: ns.append(n) or orig(n, *a)
+    # All-short batch: every slot has budget 2, so fusing 8 steps would be
+    # 4x wasted device compute -- block must cap at 2.
+    futs = [eng.submit(Request([1, 2], max_new_tokens=2)),
+            eng.submit(Request([3, 4], max_new_tokens=2))]
+    while any(not f.done() for f in futs):
+        eng.step()
+    assert ns and max(ns) <= 2
+    # Mixed batch: one nearly-done slot must NOT convoy the long one down
+    # to per-token dispatch -- block sizes to the LONGEST budget (9 asked,
+    # 1 already emitted by prefill, so 8 remain).
+    ns.clear()
+    futs = [eng.submit(Request([1, 2], max_new_tokens=1)),
+            eng.submit(Request([3, 4], max_new_tokens=9))]
+    while any(not f.done() for f in futs):
+        eng.step()
+    assert ns[0] == 8
+
+
 def test_temperature_sampling_runs(tiny):
     cfg, _, _, params = tiny
     eng = GenerationEngine(config=cfg, params=params, max_slots=2)
