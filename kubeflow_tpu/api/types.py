@@ -219,6 +219,12 @@ class RunPolicy(BaseModel):
     backoff_limit: int = Field(default=3, ge=0)
     scheduling: SchedulingPolicy = Field(default_factory=SchedulingPolicy)
     suspend: bool = False
+    # Hang detection (SURVEY.md 5.3 heartbeats): a worker that wedges
+    # without exiting (e.g. a stuck collective) stalls the whole gang's
+    # output. If no worker writes anything for this long, the gang is
+    # restarted through the normal crash-loop path. Must exceed the
+    # longest legitimate quiet period (first-step compile!). None = off.
+    hang_timeout_seconds: Optional[float] = Field(default=None, gt=0)
 
 
 class JobSpec(BaseModel):

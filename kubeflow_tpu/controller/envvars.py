@@ -47,6 +47,19 @@ def _flat_ranks(job: TrainJob, replicas_override: dict[ReplicaType, int]) -> lis
     return out
 
 
+def mpi_hostfile_content(
+    job: TrainJob,
+    replicas_override: dict[ReplicaType, int] | None = None,
+) -> str:
+    """Single source of truth for the MPI hostfile: the reconciler writes
+    this to disk and ``rendezvous_env`` ships it in KFTPU_HOSTFILE — both
+    derive from the same worker enumeration so they cannot drift."""
+    ranks = _flat_ranks(job, replicas_override or {})
+    return "".join(
+        "127.0.0.1 slots=1\n" for r, _ in ranks if r == ReplicaType.Worker
+    )
+
+
 def rendezvous_env(
     job: TrainJob,
     rtype: ReplicaType,
@@ -100,7 +113,7 @@ def rendezvous_env(
                 "task": {"type": rtype.value.lower(), "index": index},
             }
         )
-    elif job.kind in (JobKind.PyTorchJob, JobKind.XGBoostJob, JobKind.PaddleJob):
+    elif job.kind == JobKind.PyTorchJob:
         env.update(
             {
                 "MASTER_ADDR": "127.0.0.1",
@@ -113,12 +126,46 @@ def rendezvous_env(
                 "PJRT_DEVICE": "TPU",
             }
         )
-    elif job.kind == JobKind.MPIJob:
-        workers = [f"127.0.0.1 slots=1" for r, _ in ranks if r == ReplicaType.Worker]
+    elif job.kind == JobKind.XGBoostJob:
+        # Rabit tracker contract (reference T6: the tracker runs on the
+        # master; DMLC_* is what xgboost's rabit client reads). MASTER_*
+        # mirrors the reference's xgboost controller env for script compat.
+        n_workers = sum(1 for r, _ in ranks if r == ReplicaType.Worker)
         env.update(
             {
-                "KFTPU_HOSTFILE": "\n".join(workers),
-                "OMPI_MCA_orte_default_hostfile": "",  # hostfile passed via env
+                "MASTER_ADDR": "127.0.0.1",
+                "MASTER_PORT": str(coordinator_port),
+                "WORLD_SIZE": str(world),
+                "RANK": str(rank),
+                "DMLC_TRACKER_URI": "127.0.0.1",
+                "DMLC_TRACKER_PORT": str(coordinator_port),
+                "DMLC_NUM_WORKER": str(n_workers),
+                "DMLC_ROLE": (
+                    "master" if rtype == ReplicaType.Master else "worker"
+                ),
+                "DMLC_TASK_ID": str(index),
+            }
+        )
+    elif job.kind == JobKind.PaddleJob:
+        # Paddle collective contract (reference T6): every trainer knows the
+        # full endpoint list plus its own endpoint/id. Endpoint ports follow
+        # the same rank-offset scheme as the TF_CONFIG cluster spec.
+        endpoints = [
+            f"127.0.0.1:{coordinator_port + 1 + r}" for r in range(world)
+        ]
+        env.update(
+            {
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+                "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+                "PADDLE_MASTER": endpoints[0],
+            }
+        )
+    elif job.kind == JobKind.MPIJob:
+        env.update(
+            {
+                "KFTPU_HOSTFILE": mpi_hostfile_content(job, override),
                 "KFTPU_WORLD_SIZE": str(world - 1),  # exclude launcher
                 "KFTPU_RANK": str(max(rank - 1, 0)),
                 ENV_COORDINATOR: coord,

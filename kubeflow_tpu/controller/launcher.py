@@ -59,6 +59,9 @@ class WorkerRef:
     generation: int = 0
     alive: bool = True
     exit_code: Optional[int] = None
+    # Resolved stdout/stderr capture path (None for fake/no-log workers).
+    # Its mtime doubles as the liveness signal for hang detection.
+    log_path: Optional[str] = None
 
     @property
     def worker_id(self) -> str:
@@ -135,7 +138,10 @@ class ProcessLauncher(BaseLauncher):
                 out.close()  # subprocess holds its own fd now
 
         self._generation += 1
-        ref = WorkerRef(req=req, pid=proc.pid, generation=self._generation)
+        ref = WorkerRef(
+            req=req, pid=proc.pid, generation=self._generation,
+            log_path=log_path,
+        )
         self._procs[ref.worker_id] = (ref, proc)
         logger.info("spawned %s pid=%d cmd=%s", ref.worker_id, proc.pid, cmd[:4])
 

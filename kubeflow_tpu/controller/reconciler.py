@@ -23,6 +23,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import shutil
+import tempfile
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -36,7 +39,10 @@ from kubeflow_tpu.api.types import (
     TrainJob,
 )
 from kubeflow_tpu.api.validation import SUCCESS_POLICY_REPLICA
-from kubeflow_tpu.controller.envvars import rendezvous_env
+from kubeflow_tpu.controller.envvars import (
+    mpi_hostfile_content,
+    rendezvous_env,
+)
 from kubeflow_tpu.controller.gang import GangScheduler
 from kubeflow_tpu.controller.launcher import BaseLauncher, SpawnRequest, WorkerRef
 from kubeflow_tpu.controller.restarts import should_restart
@@ -72,6 +78,11 @@ class _JobRuntime:
     formed_world: tuple = ()
     # Worker-count override the gang was formed at; None = full spec size.
     formed_replicas: Optional[int] = None
+    # Set by the hang-detection timer when no worker has produced output
+    # within run_policy.hang_timeout_seconds; consumed by reconcile.
+    hung: bool = False
+    # On-disk MPI hostfile for this gang generation; removed at teardown.
+    hostfile_path: Optional[str] = None
 
 
 class JobController:
@@ -97,6 +108,10 @@ class JobController:
         self._event_seq = 0
         # Gang-restart crash-loop protection: no respawn before this time.
         self._backoff_until: dict[str, float] = {}
+        # Private dir for MPI hostfiles when no log_dir is configured
+        # (mkdtemp => mode 0700, unpredictable path: no symlink/tamper
+        # surface in the shared temp dir). Created lazily.
+        self._hostfile_dir: Optional[str] = None
         launcher.set_exit_callback(self._on_worker_exit)
 
     # -- public lifecycle -------------------------------------------------
@@ -133,6 +148,9 @@ class JobController:
     async def stop(self) -> None:
         self._stopped.set()
         await self.launcher.shutdown()
+        if self._hostfile_dir is not None:
+            shutil.rmtree(self._hostfile_dir, ignore_errors=True)
+            self._hostfile_dir = None
 
     async def _pump_watch(self, q: asyncio.Queue) -> None:
         while True:
@@ -260,6 +278,22 @@ class JobController:
             if rt is None:  # spawn failed and job was failed
                 return
 
+        if rt.hung:
+            # Consume the latch: if real exits or a pending lead-worker
+            # success win this race, the flag must not fire a spurious
+            # restart on a later reconcile. Re-check the timeout is still
+            # configured (the flag may race a spec update disabling it).
+            rt.hung = False
+            lead_id = self._lead_worker_id(job)
+            if (job.spec.run_policy.hang_timeout_seconds
+                    and not rt.failed
+                    and not (lead_id and lead_id in rt.succeeded)):
+                await self._handle_hang(kind, job, rt, status_before)
+                return
+            # The hang timer exits once it sets the flag; this runtime
+            # survives (exit paths keep it), so re-arm monitoring.
+            self._schedule_hang_check(kind, job, rt)
+
         await self._sync_status(kind, job, rt, status_before)
 
     def _desired_world(
@@ -310,17 +344,21 @@ class JobController:
             # preempting gang claims its spec-size slice).
             victims = self.gang.preemption_victims(job)
             if victims:
-                # Re-check each victim immediately before its eviction: a
-                # worker exit that arrived (even during an earlier victim's
-                # kill awaits) but hasn't been reconciled yet could carry a
-                # Succeeded outcome that eviction would discard and re-run.
-                # Defer to let exits settle, then re-evaluate from scratch.
-                deferred = False
-                for vkey in victims:
-                    if self._has_unprocessed_exits(vkey):
-                        deferred = True
-                        break
-                    await self._evict(vkey, by=job.key)
+                # Unprocessed worker exits could carry a Succeeded outcome
+                # that eviction would discard and re-run. Pre-check ALL
+                # victims before killing any, so the common race defers
+                # with zero victims evicted (all-or-nothing preserved);
+                # the per-victim re-check below still catches exits that
+                # arrive during an earlier victim's kill awaits.
+                deferred = any(
+                    self._has_unprocessed_exits(v) for v in victims
+                )
+                if not deferred:
+                    for vkey in victims:
+                        if self._has_unprocessed_exits(vkey):
+                            deferred = True
+                            break
+                        await self._evict(vkey, by=job.key)
                 if deferred:
                     self._enqueue_later(0.05, kind, job.namespace, job.name)
                 else:
@@ -348,10 +386,63 @@ class JobController:
             {ReplicaType.Worker: workers_override}
             if workers_override is not None else None
         )
+        launcher_deferred = False
         try:
-            for rtype_s, i in world:
+            spawn_order = list(world)
+            extra_env: dict[str, str] = {}
+            if job.kind == JobKind.MPIJob:
+                # Asymmetric MPI flow (SURVEY.md 4.3): hostfile on disk
+                # (the reference's ConfigMap mount), workers first, and
+                # the launcher only once every worker is up — mpirun's
+                # ssh/exec into a worker must find it listening.
+                spawn_order.sort(
+                    key=lambda wi: wi[0] == ReplicaType.Launcher.value
+                )
+                extra_env = self._materialize_hostfile(job, override_map)
+                rt.hostfile_path = extra_env["KFTPU_HOSTFILE_PATH"]
+            for rtype_s, i in spawn_order:
                 rtype = ReplicaType(rtype_s)
-                ref = await self._spawn_worker(job, rtype, i, port, override_map)
+                if (job.kind == JobKind.MPIJob
+                        and rtype == ReplicaType.Launcher):
+                    # A worker that died during the spawn awaits is gone
+                    # from rt.workers already (exit callback), so count
+                    # the live set against what was spawned rather than
+                    # scanning for dead refs.
+                    n_workers = sum(
+                        1 for t, _ in world
+                        if t == ReplicaType.Worker.value
+                    )
+                    if rt.failed:
+                        # Don't start mpirun against a dead worker — and
+                        # don't fail the job here either: the recorded
+                        # exits flow through _handle_failures right after
+                        # this spawn returns, taking the normal gang
+                        # restart/backoff path the user configured.
+                        self._record_event(
+                            job, "LauncherDeferred",
+                            f"only {len(rt.workers)}/{n_workers} workers "
+                            f"up; letting failure handling run",
+                        )
+                        launcher_deferred = True
+                        break
+                    if len(rt.workers) < n_workers:
+                        # Workers exited CLEANLY before the launcher ran:
+                        # nothing lands in rt.failed, so deferring would
+                        # wedge the job in Running forever. An MPI worker
+                        # that completes instantly is misconfigured (it
+                        # must outlive mpirun); retrying would loop.
+                        raise RuntimeError(
+                            f"{n_workers - len(rt.workers)} workers "
+                            "exited cleanly before launcher start "
+                            "(MPI workers must stay up for mpirun)"
+                        )
+                    self._record_event(
+                        job, "LauncherSpawning",
+                        f"all {len(rt.workers)} workers up; starting launcher",
+                    )
+                ref = await self._spawn_worker(
+                    job, rtype, i, port, override_map, extra_env
+                )
                 rt.workers[ref.worker_id] = ref
         except Exception as e:
             logger.exception("spawn failed for %s", job.key)
@@ -364,13 +455,119 @@ class JobController:
 
         if job.status.start_time is None:
             job.status.start_time = time.time()
+        if launcher_deferred:
+            # Don't claim a formed gang that never existed: report the
+            # partial spawn honestly; _sync_status takes the failure/
+            # restart path immediately after this returns.
+            job.status.formed_replicas = len(rt.workers)
+            self._record_event(
+                job, "GangPartiallySpawned",
+                f"spawned {len(rt.workers)}/{len(world)} replicas; "
+                "launcher deferred",
+            )
+            return True
         job.status.formed_replicas = len(world)
         reason = "GangAdmitted" if workers_override is None else "GangAdmittedReduced"
         job.status.set_condition(ConditionType.Running, reason)
         self._record_event(
             job, reason, f"spawned {len(world)} workers, coordinator :{port}"
         )
+        self._schedule_hang_check(kind, job, rt)
         return True
+
+    def _materialize_hostfile(
+        self, job: TrainJob,
+        replicas_override: Optional[dict[ReplicaType, int]] = None,
+    ) -> dict[str, str]:
+        """Write the MPI hostfile to disk (reference: hostfile ConfigMap
+        mounted into the launcher, SURVEY.md 4.3). Returns the env exposing
+        its path to all replicas — both the framework-neutral name and
+        OpenMPI's default-hostfile MCA variable. Content comes from the
+        same helper that fills KFTPU_HOSTFILE, so file and env agree."""
+        content = mpi_hostfile_content(job, replicas_override)
+        if self.log_dir:
+            base = self.log_dir
+            os.makedirs(base, exist_ok=True)
+        else:
+            if self._hostfile_dir is None:
+                self._hostfile_dir = tempfile.mkdtemp(
+                    prefix="kftpu-hostfiles-"
+                )
+            base = self._hostfile_dir
+        path = os.path.join(
+            base, f"{job.namespace}_{job.name}.hostfile"
+        )
+        with open(path, "w") as f:
+            f.write(content)
+        return {
+            "KFTPU_HOSTFILE_PATH": path,
+            "OMPI_MCA_orte_default_hostfile": path,
+        }
+
+    def _schedule_hang_check(
+        self, kind: str, job: TrainJob, rt: _JobRuntime
+    ) -> None:
+        """Arm liveness monitoring for a freshly formed gang (SURVEY.md 5.3
+        heartbeats). Signal: freshest mtime across worker log files — one
+        wedged member stalls the collective, so every member's output goes
+        quiet together. The timer dies with its runtime generation (a
+        restart re-arms a new one)."""
+        timeout = job.spec.run_policy.hang_timeout_seconds
+        if not timeout:
+            return
+        if not any(
+            getattr(r, "log_path", None) for r in rt.workers.values()
+        ):
+            # No liveness signal exists (launcher without log capture):
+            # better a loud event than a policy that silently never fires.
+            self._record_event(
+                job, "HangDetectionUnavailable",
+                "hang_timeout_seconds set but workers have no log "
+                "capture (launcher log_dir unset)",
+            )
+            return
+        loop = asyncio.get_running_loop()
+
+        def check() -> None:
+            if self._runtimes.get(job.key) is not rt:
+                return  # torn down or gang-restarted; stale timer
+            # Re-read the CURRENT spec each fire: the operator may have
+            # raised or disabled the timeout on the running job (e.g. a
+            # recompile running longer than expected).
+            _, obj = self._find_job(job.namespace, job.name)
+            if obj is None:
+                return
+            cur = TrainJob.from_dict(obj)
+            t = cur.spec.run_policy.hang_timeout_seconds
+            if not t or cur.status.phase.value in ("Succeeded", "Failed"):
+                return
+            if not rt.workers:
+                # Mid-restart lull (per-replica respawn in flight): the
+                # runtime survives those, so keep monitoring.
+                loop.call_later(t, check)
+                return
+            age = self._freshest_output_age(rt)
+            if age is not None and age > t:
+                rt.hung = True
+                self._enqueue(kind, job.namespace, job.name)
+                return
+            delay = t if age is None else max(t - age, 1.0)
+            loop.call_later(delay, check)
+
+        loop.call_later(timeout, check)
+
+    @staticmethod
+    def _freshest_output_age(rt: _JobRuntime) -> Optional[float]:
+        ages = []
+        now = time.time()
+        for ref in rt.workers.values():
+            lp = getattr(ref, "log_path", None)
+            if lp:
+                try:
+                    ages.append(now - os.path.getmtime(lp))
+                except OSError:
+                    pass
+        return min(ages) if ages else None
 
     def _has_unprocessed_exits(self, victim_key: str) -> bool:
         """A worker of this job exited but the exit hasn't been reconciled
@@ -401,7 +598,15 @@ class JobController:
         its own priority and later resumes from its latest checkpoint, the
         same path as a gang restart -- SURVEY.md 5.3/5.4)."""
         ns, name = victim_key.split("/", 1)
+        # Preemption must not reset crash-loop protection: teardown pops
+        # _backoff_until, but a victim evicted mid-backoff would then
+        # respawn the moment capacity frees. Restore any live window (the
+        # gang-restart _enqueue_later timer survives eviction and will
+        # still re-enqueue after expiry).
+        backoff = self._backoff_until.get(victim_key)
         await self._teardown(victim_key, release=True)
+        if backoff is not None and backoff > time.time():
+            self._backoff_until[victim_key] = backoff
         kind, obj = self._find_job(ns, name)
         if obj is None:
             return
@@ -432,10 +637,13 @@ class JobController:
         index: int,
         port: int,
         replicas_override: Optional[dict[ReplicaType, int]] = None,
+        extra_env: Optional[dict[str, str]] = None,
     ) -> WorkerRef:
         rs = job.spec.replica_specs[rtype]
         env = dict(rs.template.env)
         env.update(rendezvous_env(job, rtype, index, port, replicas_override))
+        if extra_env:
+            env.update(extra_env)
         req = SpawnRequest(
             job_key=job.key,
             replica_type=rtype.value,
@@ -498,9 +706,7 @@ class JobController:
                 return
 
         wid, code = failures[0]
-        max_restarts = job.spec.run_policy.backoff_limit
-        if job.spec.elastic is not None:
-            max_restarts = max(max_restarts, job.spec.elastic.max_restarts)
+        max_restarts = self._max_restarts(job)
         if job.status.restart_count >= max_restarts:
             await self._fail_job(
                 kind, job, status_before, "BackoffLimitExceeded",
@@ -509,46 +715,85 @@ class JobController:
             )
             return
 
+        if job.kind in GANG_RESTART_KINDS:
+            await self._gang_restart(
+                kind, job, status_before, "GangRestart",
+                f"{wid} exited {code}; restarting whole gang",
+            )
+            return
+        # Per-replica restart (TFJob-style): respawn only the failed
+        # ones, immediately (kubelet-style container restart).
+        job.status.restart_count += 1
+        job.status.set_condition(
+            ConditionType.Restarting, "ReplicaRestart", f"{wid} exited {code}",
+        )
+        override_map = (
+            {ReplicaType.Worker: rt.formed_replicas}
+            if rt.formed_replicas is not None else None
+        )
+        for fwid, _ in failures:
+            frtype = self._rtype_of(fwid)
+            index = int(fwid.rsplit("-", 1)[1])
+            # Spawn BEFORE dropping the failure record: if spawn raises,
+            # the record survives and the retry reconcile reprocesses it
+            # (deleting first would strand the replica forever).
+            ref = await self._spawn_worker(
+                job, frtype, index, rt.coordinator_port, override_map
+            )
+            del rt.failed[fwid]
+            rt.workers[ref.worker_id] = ref
+        job.status.set_condition(ConditionType.Running, "ReplicaRestarted")
+        self._persist(kind, job, status_before)
+
+    async def _gang_restart(
+        self, kind: str, job: TrainJob, status_before: dict,
+        reason: str, detail: str,
+    ) -> None:
+        """Atomic gang restart: kill survivors, keep the reservation (the
+        slice is ours), respawn after the backoff window — enforced via
+        _backoff_until because persisting Restarting status immediately
+        re-triggers reconcile via our own watch. Shared by worker-exit
+        failures and hang detection."""
         job.status.restart_count += 1
         delay = min(
             self.backoff_max,
             self.backoff_base * (2 ** (job.status.restart_count - 1)),
         )
-        if job.kind in GANG_RESTART_KINDS:
-            # Atomic gang restart: kill survivors, keep the reservation
-            # (the slice is ours), respawn after the backoff window --
-            # enforced via _backoff_until because persisting Restarting
-            # status immediately re-triggers reconcile via our own watch.
-            await self._teardown(job.key, release=False)
-            self._backoff_until[job.key] = time.time() + delay
-            job.status.set_condition(
-                ConditionType.Restarting, "GangRestart",
-                f"{wid} exited {code}; restart {job.status.restart_count}",
-            )
-            self._record_event(
-                job, "GangRestart", f"{wid} exited {code}; restarting whole gang"
-            )
-            self._enqueue_later(delay + 0.01, kind, job.namespace, job.name)
-        else:
-            # Per-replica restart (TFJob-style): respawn only the failed
-            # ones, immediately (kubelet-style container restart).
-            job.status.set_condition(
-                ConditionType.Restarting, "ReplicaRestart", f"{wid} exited {code}",
-            )
-            override_map = (
-                {ReplicaType.Worker: rt.formed_replicas}
-                if rt.formed_replicas is not None else None
-            )
-            for fwid, _ in failures:
-                frtype = self._rtype_of(fwid)
-                index = int(fwid.rsplit("-", 1)[1])
-                del rt.failed[fwid]
-                ref = await self._spawn_worker(
-                    job, frtype, index, rt.coordinator_port, override_map
-                )
-                rt.workers[ref.worker_id] = ref
-            job.status.set_condition(ConditionType.Running, "ReplicaRestarted")
+        await self._teardown(job.key, release=False)
+        self._backoff_until[job.key] = time.time() + delay
+        job.status.set_condition(ConditionType.Restarting, reason, detail)
+        self._record_event(job, reason, detail)
+        self._enqueue_later(delay + 0.01, kind, job.namespace, job.name)
         self._persist(kind, job, status_before)
+
+    async def _handle_hang(
+        self, kind: str, job: TrainJob, rt: _JobRuntime, status_before: dict
+    ) -> None:
+        """A live-but-wedged gang (no worker output past the configured
+        timeout): same verdict path as a crash — backoff limit, then
+        atomic gang restart resuming from the latest checkpoint."""
+        timeout = job.spec.run_policy.hang_timeout_seconds
+        max_restarts = self._max_restarts(job)
+        if job.status.restart_count >= max_restarts:
+            await self._fail_job(
+                kind, job, status_before, "BackoffLimitExceeded",
+                f"hang detected (quiet > {timeout}s); restart "
+                f"{job.status.restart_count} >= limit {max_restarts}",
+            )
+            return
+        await self._gang_restart(
+            kind, job, status_before, "HangDetected",
+            f"no worker output for > {timeout}s; restarting gang",
+        )
+
+    @staticmethod
+    def _max_restarts(job: TrainJob) -> int:
+        """Effective restart budget: elastic jobs may extend the run
+        policy's backoff limit (shared by crash and hang paths)."""
+        limit = job.spec.run_policy.backoff_limit
+        if job.spec.elastic is not None:
+            limit = max(limit, job.spec.elastic.max_restarts)
+        return limit
 
     @staticmethod
     def _rtype_of(worker_id: str) -> ReplicaType:
@@ -600,6 +845,11 @@ class JobController:
             rt.workers.clear()  # mark refs stale before killing
             for ref in refs:
                 await self.launcher.kill(ref)
+            if rt.hostfile_path:
+                try:
+                    os.unlink(rt.hostfile_path)
+                except OSError:
+                    pass
         if release:
             self.gang.release(key)
             self._backoff_until.pop(key, None)

@@ -297,6 +297,81 @@ def _sample(logits, rng, temps):
 
 
 # ---------------------------------------------------------------------------
+# Tensor-parallel serving (SURVEY.md 3.3 S5 delta: config #5 is v5e-4).
+# ---------------------------------------------------------------------------
+
+
+def make_tp_mesh(tensor_parallel: int, devices=None):
+    """One-axis ``tensor`` mesh over the first N local devices. Serving TP
+    is pure Megatron-style within-layer parallelism riding ICI; the slot
+    scheduler stays host-side and mesh-unaware."""
+    devices = list(devices if devices is not None else jax.devices())
+    if tensor_parallel > len(devices):
+        raise ValueError(
+            f"tensor_parallel={tensor_parallel} > {len(devices)} devices"
+        )
+    return jax.sharding.Mesh(
+        np.array(devices[:tensor_parallel]), ("tensor",)
+    )
+
+
+def _validate_tp(cfg: LlamaConfig, tp: int) -> None:
+    for name, dim in (
+        ("n_heads", cfg.n_heads),
+        ("n_kv_heads", cfg.n_kv_heads),
+        ("intermediate", cfg.intermediate),
+        ("vocab_size", cfg.vocab_size),
+    ):
+        if dim % tp != 0:
+            raise ValueError(
+                f"tensor_parallel={tp} must divide {name}={dim}"
+            )
+
+
+def tp_weight_shardings(mesh, weights: dict):
+    """NamedSharding pytree for the packed-weight tree: attention heads,
+    MLP intermediate, and the lm_head vocab dim shard over ``tensor``;
+    embeddings/norms/router replicate. XLA's SPMD partitioner inserts the
+    (two per layer) all-reduces from these placements alone -- no manual
+    collectives in the forward math."""
+    P = jax.sharding.PartitionSpec
+
+    def spec_for(path, leaf) -> "jax.sharding.NamedSharding":
+        ks = "/".join(str(getattr(k, "key", k)) for k in path)
+        if "lm_head" in ks:
+            spec = P(None, "tensor")                  # [H, V]
+        elif any(p in ks for p in ("q_proj", "k_proj", "v_proj")):
+            spec = P(None, None, "tensor", None)      # [L, H, N, D]
+        elif "o_proj" in ks:
+            spec = P(None, "tensor", None, None)      # [L, N, D, H]
+        elif "moe" in ks:
+            if "router" in ks:
+                spec = P()                            # [L, H, E] tiny, f32
+            elif "down_proj" in ks:
+                spec = P(None, None, "tensor", None)  # [L, E, I, H]
+            else:
+                spec = P(None, None, None, "tensor")  # [L, E, H, I]
+        elif "down_proj" in ks:
+            spec = P(None, "tensor", None)            # [L, I, H]
+        elif any(p in ks for p in ("gate_proj", "up_proj")):
+            spec = P(None, None, "tensor")            # [L, H, I]
+        else:
+            spec = P()  # embed, norm scales
+        return jax.sharding.NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(spec_for, weights)
+
+
+def tp_cache_sharding(mesh):
+    """KV cache [L, B, Smax, KV, D]: KV heads over ``tensor`` -- each
+    device holds its heads' cache for every slot, so decode is fully
+    local until the output projection's all-reduce."""
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(None, None, None, "tensor", None)
+    )
+
+
+# ---------------------------------------------------------------------------
 # Engine
 # ---------------------------------------------------------------------------
 
@@ -332,6 +407,8 @@ class GenerationEngine:
         seed: int = 0,
         config: Optional[LlamaConfig] = None,
         decode_block: int = 8,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        tensor_parallel: int = 1,
     ) -> None:
         # Max decode steps fused into one device program (power-of-2
         # sub-blocks keep the compile count bounded); 1 = per-token
@@ -343,6 +420,18 @@ class GenerationEngine:
         self.cfg = cfg
         self.max_slots = max_slots
         self.buckets = default_buckets(cfg.max_seq)
+        # Tensor-parallel serving: a ``tensor``-axis mesh shards weights
+        # and KV cache; the host-side scheduler below is unchanged.
+        if mesh is None and tensor_parallel > 1:
+            mesh = make_tp_mesh(tensor_parallel)
+        self.mesh = mesh
+        if mesh is not None:
+            if "tensor" not in mesh.shape:
+                raise ValueError(
+                    "serving mesh needs a 'tensor' axis, got "
+                    f"{tuple(mesh.axis_names)}"
+                )
+            _validate_tp(cfg, mesh.shape["tensor"])
         if params is None:
             # Demo mode: random init (serving tests; real use loads orbax).
             import flax.linen as nn
@@ -353,33 +442,70 @@ class GenerationEngine:
             )
             params = nn.meta.unbox(raw)
         self.weights = pack_weights(params, cfg)
+        if mesh is not None:
+            self.weights = jax.device_put(
+                self.weights, tp_weight_shardings(mesh, self.weights)
+            )
 
         kvshape = (cfg.n_layers, max_slots, cfg.max_seq, cfg.n_kv_heads,
                    cfg.head_dim)
         dt = jnp.dtype(cfg.dtype)
-        self.cache_k = jnp.zeros(kvshape, dt)
-        self.cache_v = jnp.zeros(kvshape, dt)
+        if mesh is not None:
+            self.cache_k = jnp.zeros(
+                kvshape, dt, device=tp_cache_sharding(mesh)
+            )
+            self.cache_v = jnp.zeros(
+                kvshape, dt, device=tp_cache_sharding(mesh)
+            )
+        else:
+            self.cache_k = jnp.zeros(kvshape, dt)
+            self.cache_v = jnp.zeros(kvshape, dt)
         self.lengths = np.zeros(max_slots, np.int64)  # host-side bookkeeping
         self.free_slots = list(range(max_slots))
         self.active: Dict[int, Request] = {}
         self.pending: "queue.Queue[Request]" = queue.Queue()
         self._rng = jax.random.PRNGKey(seed + 1)
 
+        # Pin cache outputs to the KV-head sharding under TP: without the
+        # constraint GSPMD may pick a different (e.g. head-dim) layout for
+        # the donated outputs, leaving the cache off its intended layout.
+        if mesh is not None:
+            csh = tp_cache_sharding(mesh)
+
+            def _pin(t):
+                return jax.lax.with_sharding_constraint(t, csh)
+        else:
+            def _pin(t):
+                return t
+
         # cfg is a static closure (hashable primitives); weights are
         # ARGUMENTS so multi-GB params are buffers, not jaxpr constants.
         prefill_jit = jax.jit(partial(_prefill, cfg))
         block_jits = {}
 
+        def _block_fn(n):
+            def fn(w, ck, cv, toks, lens, rng, temps):
+                outs, ck, cv = _decode_block(
+                    cfg, n, w, ck, cv, toks, lens, rng, temps
+                )
+                return outs, _pin(ck), _pin(cv)
+            return fn
+
         def decode_block_call(n, ck, cv, toks, lens, rng, temps):
             if n not in block_jits:
                 block_jits[n] = jax.jit(
-                    partial(_decode_block, cfg, n), donate_argnums=(1, 2)
+                    _block_fn(n), donate_argnums=(1, 2)
                 )
             return block_jits[n](self.weights, ck, cv, toks, lens, rng,
                                  temps)
 
         self._decode_block_call = decode_block_call
-        insert_jit = jax.jit(_insert, donate_argnums=(0, 1))
+
+        def _insert_pinned(cache_k, cache_v, k_seq, v_seq, slot):
+            ck, cv = _insert(cache_k, cache_v, k_seq, v_seq, slot)
+            return _pin(ck), _pin(cv)
+
+        insert_jit = jax.jit(_insert_pinned, donate_argnums=(0, 1))
         sample_jit = jax.jit(_sample)
         self._prefill = lambda tokens, n: prefill_jit(self.weights, tokens, n)
         self._insert = insert_jit

@@ -21,6 +21,9 @@ Options (ModelSpec.options):
   HF tokenizer name resolved from the local cache only (zero egress)
 - ``checkpoint``: "orbax" (TrainState dir from the training runtime) or
   "none" (random init -- demo/e2e mode)
+- ``tensor_parallel``: shard weights + KV cache over an N-device
+  ``tensor`` mesh (config #5 targets v5e-4: tensor_parallel=4). N must
+  divide n_heads/n_kv_heads/intermediate/vocab. Default 1.
 """
 
 from __future__ import annotations
@@ -158,6 +161,7 @@ class JaxLLMModel(Model):
             max_slots=int(opts.get("max_slots", 8)),
             max_seq=opts.get("max_seq"),
             decode_block=int(opts.get("decode_block", 8)),
+            tensor_parallel=int(opts.get("tensor_parallel", 1)),
         )
         if config is not None:
             self.engine = GenerationEngine(config=config, **engine_kw)

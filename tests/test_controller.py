@@ -702,3 +702,197 @@ class TestElasticAdmission:
                 assert j.status.has_condition(ConditionType.Running)
 
         asyncio.run(run())
+
+
+def make_mpi_job(name="m1", workers=2):
+    job = TrainJob(
+        kind=JobKind.MPIJob,
+        metadata=ObjectMeta(name=name),
+        spec=JobSpec(
+            replica_specs={
+                ReplicaType.Launcher: ReplicaSpec(
+                    replicas=1,
+                    template=ProcessTemplate(entrypoint="fake.launcher"),
+                    resources=Resources(tpu=0),
+                ),
+                ReplicaType.Worker: ReplicaSpec(
+                    replicas=workers,
+                    template=ProcessTemplate(entrypoint="fake.worker"),
+                    resources=Resources(tpu=1),
+                ),
+            },
+        ),
+    )
+    job = apply_defaults(job)
+    validate_job(job)
+    return job
+
+
+class TestMPIJobFlow:
+    """Reference MPIJob semantics (SURVEY.md 4.3): hostfile materialized
+    to disk, launcher spawned only after all workers are up, launcher exit
+    code is the job verdict, workers torn down after."""
+
+    def test_hostfile_on_disk_and_launcher_last(self):
+        async def run():
+            async with Harness() as h:
+                h.submit(make_mpi_job(workers=2))
+                await h.wait(
+                    lambda: len(h.launcher.spawned) == 3, msg="3 spawns"
+                )
+                order = [r.replica_type for r in h.launcher.spawned]
+                assert order == ["Worker", "Worker", "Launcher"], order
+                lenv = dict(h.launcher.spawned[-1].env)
+                path = lenv["KFTPU_HOSTFILE_PATH"]
+                with open(path) as f:
+                    assert f.read() == "127.0.0.1 slots=1\n" * 2
+                assert lenv["OMPI_MCA_orte_default_hostfile"] == path
+                # Workers carry the same hostfile path.
+                wenv = dict(h.launcher.spawned[0].env)
+                assert wenv["KFTPU_HOSTFILE_PATH"] == path
+
+        asyncio.run(run())
+
+    def test_launcher_exit_is_verdict_and_workers_torn_down(self):
+        async def run():
+            async with Harness() as h:
+                h.submit(make_mpi_job(workers=2))
+                await h.wait(
+                    lambda: len(h.launcher.spawned) == 3, msg="3 spawns"
+                )
+                # Workers keep running; launcher succeeds -> job Succeeded,
+                # workers torn down (clean_pod_policy=Running default).
+                await h.launcher.exit("default/m1/launcher-0", 0)
+                await h.wait_phase("m1", "Succeeded", kind="MPIJob")
+                assert set(h.launcher.killed) == {
+                    "default/m1/worker-0", "default/m1/worker-1"
+                }
+
+        asyncio.run(run())
+
+    def test_launcher_failure_fails_job(self):
+        async def run():
+            async with Harness() as h:
+                job = make_mpi_job("m2", workers=1)
+                job.spec.replica_specs[ReplicaType.Launcher].restart_policy = (
+                    RestartPolicy.Never
+                )
+                h.submit(job)
+                await h.wait(
+                    lambda: len(h.launcher.spawned) == 2, msg="2 spawns"
+                )
+                await h.launcher.exit("default/m2/launcher-0", 1)
+                j = await h.wait_phase("m2", "Failed", kind="MPIJob")
+                assert j.status.restart_count == 0
+
+        asyncio.run(run())
+
+
+class TestEnvContracts:
+    """Per-kind rendezvous env (reference T3-T6): the distributed-init
+    contract each framework's in-container runtime reads."""
+
+    @staticmethod
+    def _env(job, rtype, index, port=9000):
+        from kubeflow_tpu.controller.envvars import rendezvous_env
+
+        return rendezvous_env(job, rtype, index, port)
+
+    def _two_tier_job(self, kind, name, workers=2):
+        job = TrainJob(
+            kind=kind,
+            metadata=ObjectMeta(name=name),
+            spec=JobSpec(
+                replica_specs={
+                    ReplicaType.Master: ReplicaSpec(
+                        replicas=1,
+                        template=ProcessTemplate(entrypoint="fake.master"),
+                        resources=Resources(tpu=1),
+                    ),
+                    ReplicaType.Worker: ReplicaSpec(
+                        replicas=workers,
+                        template=ProcessTemplate(entrypoint="fake.worker"),
+                        resources=Resources(tpu=1),
+                    ),
+                },
+            ),
+        )
+        job = apply_defaults(job)
+        validate_job(job)
+        return job
+
+    def test_xgboost_rabit_tracker_env(self):
+        job = self._two_tier_job(JobKind.XGBoostJob, "xgb")
+        master = self._env(job, ReplicaType.Master, 0)
+        assert master["DMLC_TRACKER_URI"] == "127.0.0.1"
+        assert master["DMLC_TRACKER_PORT"] == "9000"
+        assert master["DMLC_NUM_WORKER"] == "2"
+        assert master["DMLC_ROLE"] == "master"
+        worker1 = self._env(job, ReplicaType.Worker, 1)
+        assert worker1["DMLC_ROLE"] == "worker"
+        assert worker1["DMLC_TASK_ID"] == "1"
+        assert worker1["DMLC_TRACKER_PORT"] == "9000"
+        # Torch-specific device selection must not leak into xgboost.
+        assert "PJRT_DEVICE" not in worker1
+        # Reference-compatible MASTER_*/RANK kept for script portability.
+        assert worker1["MASTER_ADDR"] == "127.0.0.1"
+        assert worker1["WORLD_SIZE"] == "3"
+
+    def test_paddle_trainer_endpoints_env(self):
+        job = self._two_tier_job(JobKind.PaddleJob, "pd")
+        w0 = self._env(job, ReplicaType.Worker, 0)
+        assert w0["PADDLE_TRAINERS_NUM"] == "3"
+        endpoints = w0["PADDLE_TRAINER_ENDPOINTS"].split(",")
+        assert len(endpoints) == 3 and len(set(endpoints)) == 3
+        # Master is rank 0; this worker is rank 1.
+        assert w0["PADDLE_TRAINER_ID"] == "1"
+        assert w0["PADDLE_CURRENT_ENDPOINT"] == endpoints[1]
+        assert w0["PADDLE_MASTER"] == endpoints[0]
+        m = self._env(job, ReplicaType.Master, 0)
+        assert m["PADDLE_TRAINER_ID"] == "0"
+        assert m["PADDLE_CURRENT_ENDPOINT"] == endpoints[0]
+        assert "PJRT_DEVICE" not in m
+
+
+class TestMPIJobSpawnRace:
+    def test_worker_death_during_spawn_defers_launcher(self):
+        """A worker dying while the gang is still spawning must not start
+        mpirun against the hole NOR terminally fail the job: the exit flows
+        through the normal gang-restart path and the retry succeeds."""
+
+        class DyingLauncher(FakeLauncher):
+            def __init__(self):
+                super().__init__()
+                self.tripped = False
+
+            async def spawn(self, req):
+                ref = await super().spawn(req)
+                if (not self.tripped
+                        and req.worker_id.endswith("worker-1")):
+                    self.tripped = True
+                    await self.exit("default/m3/worker-0", 137)
+                return ref
+
+        async def run():
+            h = Harness()
+            h.launcher = DyingLauncher()
+            h.ctl = JobController(
+                h.store, h.launcher, h.gang,
+                backoff_base_seconds=0.01, backoff_max_seconds=0.05,
+            )
+            async with h:
+                h.submit(make_mpi_job("m3", workers=2))
+                # First generation: 2 workers spawned, worker-0 died mid-
+                # spawn, launcher deferred; gang restart; second
+                # generation spawns all 3 (launcher last).
+                await h.wait(
+                    lambda: [r.replica_type for r in h.launcher.spawned]
+                    == ["Worker", "Worker", "Worker", "Worker", "Launcher"],
+                    msg="retry spawns full gang, launcher deferred first try",
+                )
+                j = h.job("m3", kind="MPIJob")
+                assert j.status.restart_count == 1
+                await h.launcher.exit("default/m3/launcher-0", 0)
+                await h.wait_phase("m3", "Succeeded", kind="MPIJob")
+
+        asyncio.run(run())

@@ -185,3 +185,71 @@ def test_worker_death_restart_policy_never_fails_gang(tmp_path):
         store.close()
 
     asyncio.run(run())
+
+
+@pytest.mark.e2e
+def test_hang_detection_restarts_wedged_worker(tmp_path):
+    """SURVEY.md 5.3 heartbeats: a worker that SIGSTOPs itself (wedged,
+    not exited) goes quiet; hang detection notices the stale output and
+    drives the normal gang-restart path; the respawned incarnation
+    completes. Process-exit-driven failure detection alone would wait on
+    active_deadline_seconds forever."""
+    worker_src = '''\
+import os, signal, sys, time
+
+marker = os.environ["HANG_MARKER"]
+for i in range(3):
+    print(f"beat {i}", flush=True)
+    time.sleep(0.05)
+if not os.path.exists(marker):
+    open(marker, "w").close()
+    print("wedging", flush=True)
+    os.kill(os.getpid(), signal.SIGSTOP)  # wedge without exiting
+print("done", flush=True)
+'''
+    (tmp_path / "hangworker.py").write_text(worker_src)
+    marker = tmp_path / "first_incarnation"
+
+    async def run():
+        from kubeflow_tpu.api.types import ObjectMeta
+
+        store = ObjectStore(":memory:")
+        job = apply_defaults(TrainJob(
+            kind=JobKind.JAXJob,
+            metadata=ObjectMeta(name="hang"),
+            spec=JobSpec(
+                replica_specs={
+                    ReplicaType.Worker: ReplicaSpec(
+                        replicas=1,
+                        restart_policy=RestartPolicy.OnFailure,
+                        template=ProcessTemplate(
+                            entrypoint="hangworker",
+                            env={
+                                "PYTHONPATH": str(tmp_path),
+                                "HANG_MARKER": str(marker),
+                            },
+                        ),
+                        resources=Resources(tpu=1),
+                    )
+                },
+                run_policy=RunPolicy(
+                    backoff_limit=2, hang_timeout_seconds=1.0
+                ),
+            ),
+        ))
+        phase, logs = await run_job_to_completion(
+            store, job, tmp_path / "logs", timeout=60
+        )
+        assert phase == "Succeeded", f"phase={phase} logs={logs}"
+        obj = store.get("JAXJob", "hang", "default")
+        assert obj["status"]["restart_count"] == 1
+        reasons = [
+            e["reason"] for e in store.list("Event")
+            if e.get("involved") == "default/hang"
+        ]
+        assert "HangDetected" in reasons, reasons
+        log = next(iter(logs.values()))
+        assert "wedging" in log and "done" in log
+        store.close()
+
+    asyncio.run(run())

@@ -234,3 +234,74 @@ def test_moe_decode_matches_full_forward(tiny_moe):
         )
         assert int(jnp.argmax(logits[0])) == tok, (seq, out)
         seq.append(tok)
+
+
+class TestTensorParallelServing:
+    """Sharded serving (SURVEY.md 3.3 S5 delta: config #5 is a v5e-4
+    predictor): weights + KV cache shard over a ``tensor`` mesh, the
+    host-side slot scheduler is mesh-unaware, and greedy output matches
+    the single-device engine token-for-token (f32 activations make the
+    argmax robust to TP's reduction reorder)."""
+
+    @staticmethod
+    def _f32(preset):
+        return dataclasses.replace(PRESETS[preset], dtype="float32")
+
+    def test_tp_identical_to_single_device(self):
+        cfg = self._f32("llama-tiny")
+        base = GenerationEngine(config=cfg, max_slots=4, decode_block=4)
+        tp = GenerationEngine(
+            config=cfg, max_slots=4, decode_block=4, tensor_parallel=2
+        )
+        assert tp.mesh is not None and tp.mesh.shape["tensor"] == 2
+        for prompt in ([5, 9, 17, 250, 3], [1, 2, 3], list(range(40))):
+            a = base.generate(prompt, max_new_tokens=16)
+            b = tp.generate(prompt, max_new_tokens=16)
+            assert a == b, (prompt, a, b)
+        # Weights and cache actually live sharded: KV-head axis split
+        # (trailing-None spec normalization makes == too strict).
+        from kubeflow_tpu.serving.engine import tp_cache_sharding
+
+        assert tp.cache_k.sharding.is_equivalent_to(
+            tp_cache_sharding(tp.mesh), tp.cache_k.ndim
+        )
+        q = tp.weights["layers"]["attn"]["q_proj"]["kernel"]
+        assert "tensor" in str(q.sharding.spec)
+
+    def test_tp_moe_identical(self):
+        cfg = self._f32("llama-tiny-moe")
+        base = GenerationEngine(config=cfg, max_slots=2, decode_block=4)
+        tp = GenerationEngine(
+            config=cfg, max_slots=2, decode_block=4, tensor_parallel=2
+        )
+        p = [3, 1, 4, 1, 5]
+        assert base.generate(p, max_new_tokens=12) == tp.generate(
+            p, max_new_tokens=12
+        )
+
+    def test_tp_continuous_batching_mixed_slots(self):
+        """Concurrent requests through the sharded engine: slot admission,
+        decode blocks, and finish/reuse all work over the mesh."""
+        cfg = self._f32("llama-tiny")
+        tp = GenerationEngine(
+            config=cfg, max_slots=2, decode_block=4, tensor_parallel=2
+        )
+        reqs = [
+            Request(prompt=[i + 1, i + 2, i + 3], max_new_tokens=6)
+            for i in range(5)  # 5 requests > 2 slots: forces reuse
+        ]
+        futs = [tp.submit(r) for r in reqs]
+        while any(not f.done() for f in futs):
+            if not tp.step():
+                break
+        outs = [f.result() for f in futs]
+        assert all(len(o) == 6 for o in outs)
+        # Same prompts through a fresh single-device engine agree.
+        base = GenerationEngine(config=cfg, max_slots=2, decode_block=4)
+        for r, o in zip(reqs, outs):
+            assert base.generate(r.prompt, max_new_tokens=6) == o
+
+    def test_tp_divisibility_validated(self):
+        cfg = self._f32("llama-tiny")  # n_kv_heads=2
+        with pytest.raises(ValueError, match="divide"):
+            GenerationEngine(config=cfg, tensor_parallel=4)
