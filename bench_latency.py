@@ -1,0 +1,146 @@
+"""Time-to-first-step harness: the north-star latency metric.
+
+Measures `apply -> first training step` (BASELINE north star: < 90 s for
+a Llama-3-8B JAXJob) the way an operator experiences it: a live control
+plane (HTTP server subprocess), a real `apply` of the flagship example
+spec, and the stopwatch stops when the first `KFTPU-METRIC ... step=`
+line lands in the worker-0 log — i.e. after gang admission, process
+spawn, runtime bootstrap, data setup, and the first jit-compiled step.
+
+Two variants, because XLA compile time dominates and the persistent
+compilation cache is the designed mitigation (SURVEY.md 7.4 #1):
+- cold: a FRESH compile-cache dir (worst case, first ever run)
+- warm: the same dir again (steady state: any later job of this shape)
+
+Emits one JSON line and writes LATENCY.json next to this file.
+Run: python bench_latency.py  (on the TPU dev box; no args needed)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TARGET_S = 90.0
+STEP_RE = re.compile(r"KFTPU-METRIC .*step=")
+
+
+def _wait_http(url: str, timeout: float) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(url, timeout=2)
+            return
+        except Exception:
+            time.sleep(0.2)
+    raise RuntimeError(f"server at {url} never came up")
+
+
+def _job_yaml(name: str, steps: int = 12) -> str:
+    return f"""\
+kind: JAXJob
+metadata:
+  name: {name}
+spec:
+  replica_specs:
+    Worker:
+      replicas: 1
+      resources: {{tpu: 1}}
+      template:
+        entrypoint: kubeflow_tpu.runtime.entry
+        args: ["--model", "llama", "--steps", "{steps}",
+               "--log-every", "1",
+               "--arg", "preset=llama3-8b-proxy",
+               "--arg", "batch_size=4", "--arg", "seq_len=1024",
+               "--arg", "optimizer=adafactor"]
+"""
+
+
+def measure_once(state_dir: str, cache_dir: str, name: str,
+                 port: int, timeout: float = 1200.0) -> float:
+    """One apply->first-step measurement against a fresh control plane."""
+    env = dict(os.environ)
+    env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+    env.setdefault("PYTHONPATH", HERE)
+    server = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu.cli", "serve",
+         "--state-dir", state_dir, "--port", str(port), "--chips", "8"],
+        env=env, cwd=HERE,
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+    try:
+        _wait_http(f"http://127.0.0.1:{port}/healthz", 30)
+        spec = os.path.join(state_dir, "job.yaml")
+        with open(spec, "w") as f:
+            f.write(_job_yaml(name))
+        log_path = os.path.join(
+            state_dir, "logs", f"default_{name}_worker-0.log"
+        )
+
+        t0 = time.time()
+        subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.cli",
+             "--server", f"http://127.0.0.1:{port}", "apply", "-f", spec],
+            check=True, env=env, cwd=HERE, stdout=subprocess.DEVNULL,
+        )
+        deadline = t0 + timeout
+        while time.time() < deadline:
+            if os.path.exists(log_path):
+                with open(log_path, "r", errors="replace") as f:
+                    if STEP_RE.search(f.read()):
+                        return time.time() - t0
+            time.sleep(0.25)
+        raise RuntimeError(
+            f"no step metric within {timeout}s; log tail: "
+            + (open(log_path, errors="replace").read()[-2000:]
+               if os.path.exists(log_path) else "<no log>")
+        )
+    finally:
+        server.terminate()
+        try:
+            server.wait(10)
+        except subprocess.TimeoutExpired:
+            server.kill()
+
+
+def main() -> int:
+    base = tempfile.mkdtemp(prefix="kftpu-latency-")
+    cache = os.path.join(base, "xla-cache")
+    os.makedirs(cache, exist_ok=True)
+    cold = measure_once(
+        os.path.join(base, "cold"), cache, "lat-cold", 7471
+    )
+    warm = measure_once(
+        os.path.join(base, "warm"), cache, "lat-warm", 7472
+    )
+    result = {
+        "metric": "apply_to_first_step_seconds",
+        "value": round(warm, 1),
+        "unit": "s",
+        "vs_baseline": round(TARGET_S / warm, 3),
+        "extra": {
+            "cold_s": round(cold, 1),
+            "warm_s": round(warm, 1),
+            "target_s": TARGET_S,
+            "preset": "llama3-8b-proxy",
+            "batch": 4, "seq_len": 1024,
+            "note": "cold = fresh XLA compile cache; warm = persistent "
+                    "cache hit (steady state). vs_baseline = target/warm "
+                    "(>1 beats the <90s north star).",
+        },
+    }
+    print(json.dumps(result), flush=True)
+    with open(os.path.join(HERE, "LATENCY.json"), "w") as f:
+        json.dump(result, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -22,6 +22,7 @@ import logging
 import os
 import signal
 import sys
+import time
 from typing import Awaitable, Callable, Optional
 
 logger = logging.getLogger(__name__)
@@ -62,6 +63,10 @@ class WorkerRef:
     # Resolved stdout/stderr capture path (None for fake/no-log workers).
     # Its mtime doubles as the liveness signal for hang detection.
     log_path: Optional[str] = None
+    # Spawn wall-clock time: hang detection clamps log mtime to this,
+    # since log files are append-reused across gang generations and a
+    # fresh worker must not inherit its wedged predecessor's staleness.
+    spawned_at: float = 0.0
 
     @property
     def worker_id(self) -> str:
@@ -140,7 +145,7 @@ class ProcessLauncher(BaseLauncher):
         self._generation += 1
         ref = WorkerRef(
             req=req, pid=proc.pid, generation=self._generation,
-            log_path=log_path,
+            log_path=log_path, spawned_at=time.time(),
         )
         self._procs[ref.worker_id] = (ref, proc)
         logger.info("spawned %s pid=%d cmd=%s", ref.worker_id, proc.pid, cmd[:4])

@@ -81,6 +81,10 @@ class _JobRuntime:
     # Set by the hang-detection timer when no worker has produced output
     # within run_policy.hang_timeout_seconds; consumed by reconcile.
     hung: bool = False
+    # True while a hang-detection timer is live for this runtime (also
+    # set when monitoring is impossible — no log capture — so the
+    # unavailable event fires once, not every reconcile).
+    hang_armed: bool = False
     # On-disk MPI hostfile for this gang generation; removed at teardown.
     hostfile_path: Optional[str] = None
 
@@ -290,9 +294,12 @@ class JobController:
                     and not (lead_id and lead_id in rt.succeeded)):
                 await self._handle_hang(kind, job, rt, status_before)
                 return
-            # The hang timer exits once it sets the flag; this runtime
-            # survives (exit paths keep it), so re-arm monitoring.
-            self._schedule_hang_check(kind, job, rt)
+
+        # Arm (or re-arm) hang monitoring for a live runtime: covers a
+        # timeout enabled on an already-running job, and re-arms after
+        # the timer fired but real exits won the race (guarded by
+        # hang_armed, so a live timer is never duplicated).
+        self._schedule_hang_check(kind, job, rt)
 
         await self._sync_status(kind, job, rt, status_before)
 
@@ -513,13 +520,16 @@ class JobController:
         quiet together. The timer dies with its runtime generation (a
         restart re-arms a new one)."""
         timeout = job.spec.run_policy.hang_timeout_seconds
-        if not timeout:
+        if not timeout or rt.hang_armed:
             return
+        rt.hang_armed = True
         if not any(
             getattr(r, "log_path", None) for r in rt.workers.values()
         ):
             # No liveness signal exists (launcher without log capture):
-            # better a loud event than a policy that silently never fires.
+            # better a loud event than a policy that silently never
+            # fires. hang_armed stays set — log capture cannot appear
+            # within one runtime generation, so don't re-announce.
             self._record_event(
                 job, "HangDetectionUnavailable",
                 "hang_timeout_seconds set but workers have no log "
@@ -536,10 +546,14 @@ class JobController:
             # recompile running longer than expected).
             _, obj = self._find_job(job.namespace, job.name)
             if obj is None:
+                rt.hang_armed = False
                 return
             cur = TrainJob.from_dict(obj)
             t = cur.spec.run_policy.hang_timeout_seconds
             if not t or cur.status.phase.value in ("Succeeded", "Failed"):
+                # Disabled or finished: disarm; a later spec update
+                # re-arms through reconcile.
+                rt.hang_armed = False
                 return
             if not rt.workers:
                 # Mid-restart lull (per-replica respawn in flight): the
@@ -549,6 +563,7 @@ class JobController:
             age = self._freshest_output_age(rt)
             if age is not None and age > t:
                 rt.hung = True
+                rt.hang_armed = False  # reconcile re-arms if it defers
                 self._enqueue(kind, job.namespace, job.name)
                 return
             delay = t if age is None else max(t - age, 1.0)
@@ -564,9 +579,15 @@ class JobController:
             lp = getattr(ref, "log_path", None)
             if lp:
                 try:
-                    ages.append(now - os.path.getmtime(lp))
+                    mtime = os.path.getmtime(lp)
                 except OSError:
-                    pass
+                    continue
+                # Logs are append-reused across gang generations: a fresh
+                # worker must get a full quiet-period budget from ITS
+                # spawn, not inherit the previous incarnation's mtime.
+                ages.append(
+                    now - max(mtime, getattr(ref, "spawned_at", 0.0))
+                )
         return min(ages) if ages else None
 
     def _has_unprocessed_exits(self, victim_key: str) -> bool:

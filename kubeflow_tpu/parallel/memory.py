@@ -1,0 +1,100 @@
+"""Abstract memory planning: will this model/mesh/batch fit the chips?
+
+The reference ecosystem discovers OOMs by running the job; on TPU slices
+that burns real slice-hours. Everything needed to answer "does config #2
+fit a v5e-8?" is known abstractly: ``jax.eval_shape`` gives every state
+array's shape/dtype, the logical-axis rules give its sharding, and the
+mesh gives the divisor. No device memory is touched.
+
+Used by tests/test_8b_geometry.py to validate the flagship llama3-8b
+preset on an 8-device mesh before any hardware sees it, and usable by
+operators the same way.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+import jax
+from jax.sharding import NamedSharding
+
+HBM_BYTES = {
+    "v5e": 16 * 1024**3,
+    "v5p": 95 * 1024**3,
+    "v4": 32 * 1024**3,
+}
+
+
+def _axes_size(mesh, entry) -> int:
+    axes = (entry,) if isinstance(entry, str) else tuple(entry)
+    return math.prod(mesh.shape[a] for a in axes)
+
+
+def shard_divisibility_errors(abstract, shardings) -> List[str]:
+    """Every sharded dim must divide evenly by its mesh-axis product —
+    an indivisible axis is a trace-time error on the real slice, so catch
+    it here first. Returns human-readable violations (empty = clean)."""
+    errors: List[str] = []
+
+    def check(path, leaf, sh):
+        if not isinstance(sh, NamedSharding):
+            return
+        name = jax.tree_util.keystr(path)
+        for d, entry in enumerate(sh.spec):
+            if entry is None:
+                continue
+            n = _axes_size(sh.mesh, entry)
+            if leaf.shape[d] % n != 0:
+                errors.append(
+                    f"{name}: dim {d} of shape {tuple(leaf.shape)} not "
+                    f"divisible by {entry}={n}"
+                )
+
+    jax.tree_util.tree_map_with_path(check, abstract, shardings)
+    return errors
+
+
+def per_device_state_bytes(abstract, shardings) -> int:
+    """Bytes of train state (params + optimizer moments + step counters)
+    resident per device under the given shardings."""
+    total = 0
+
+    def add(leaf, sh):
+        nonlocal total
+        size = math.prod(leaf.shape) * leaf.dtype.itemsize if leaf.shape \
+            else leaf.dtype.itemsize
+        div = 1
+        if isinstance(sh, NamedSharding):
+            for entry in sh.spec:
+                if entry is not None:
+                    div *= _axes_size(sh.mesh, entry)
+        total += size // div
+
+    jax.tree_util.tree_map(add, abstract, shardings)
+    return total
+
+
+def activation_bytes_estimate(
+    cfg,
+    batch_local: int,
+    seq_local: int,
+    *,
+    vocab_shards: int = 1,
+    act_bytes: int = 2,
+) -> int:
+    """Upper-bound estimate of live activation memory for one remat'd
+    training step on one device.
+
+    Components (full per-layer remat, the runtime's policy):
+    - residual stream saved at every layer boundary: L * B * S * H
+    - one layer's recompute workspace: a few B * S * max(I, N*D) buffers
+    - the loss logits: B * S * V in f32 (by far the largest single
+      buffer at Llama vocab sizes; sharded over ``tensor`` when the mesh
+      has one, per the ``vocab`` logical rule)
+    """
+    resid = cfg.n_layers * batch_local * seq_local * cfg.hidden * act_bytes
+    width = max(cfg.intermediate, cfg.n_heads * cfg.head_dim)
+    workspace = 4 * batch_local * seq_local * width * act_bytes
+    logits = batch_local * seq_local * cfg.vocab_size * 4 // vocab_shards
+    return resid + workspace + logits

@@ -25,6 +25,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import math
 import os
 import time
 from typing import Dict, List, Optional
@@ -51,29 +52,60 @@ from kubeflow_tpu.utils.ports import allocate_port
 logger = logging.getLogger(__name__)
 
 PRIMARY = "predictor"  # component the activator routes to by default
-# Transformer replica services are tracked under "{ns}/{name}#transformer";
-# the suffix never appears in object names ('#' is not name-legal).
+# Transformer replica services are tracked under "{ns}/{name}#transformer",
+# canary predictor sets under "{ns}/{name}#canary"; the suffixes never
+# appear in object names ('#' is not name-legal).
 TRANSFORMER_SUFFIX = "#transformer"
+CANARY_SUFFIX = "#canary"
 
 
 def _key_parts(key: str) -> tuple[str, str]:
     """(ns, name) of a service key, component suffix stripped."""
     ns, name = key.split("/", 1)
-    if name.endswith(TRANSFORMER_SUFFIX):
-        name = name[: -len(TRANSFORMER_SUFFIX)]
+    for suffix in (TRANSFORMER_SUFFIX, CANARY_SUFFIX):
+        if name.endswith(suffix):
+            name = name[: -len(suffix)]
     return ns, name
+
+
+def _rollout_state(isvc: InferenceService) -> tuple[dict, Optional[dict], int, bool]:
+    """(applied predictor dump, stable revision, pct, canarying?) — the
+    ONE definition of "a canary rollout is in flight" shared by reconcile
+    and the autoscaler, so they can never disagree on which spec governs
+    the stable set."""
+    pdump = isvc.spec.predictor.model_dump(mode="json", exclude_none=True)
+    stable = isvc.status.stable_predictor
+    pct = isvc.spec.canary_traffic_percent
+    canarying = stable is not None and stable != pdump and pct < 100
+    return pdump, stable, pct, canarying
+
+
+def _governing_predictor(isvc: InferenceService) -> Optional[ComponentSpec]:
+    """The component spec the PRIMARY predictor set is running right now:
+    the stable revision mid-rollout, else the applied spec."""
+    _, stable, _, canarying = _rollout_state(isvc)
+    if canarying:
+        try:
+            return ComponentSpec.model_validate(stable)
+        except ValueError:
+            return None
+    return isvc.spec.predictor
 
 
 class _Replica:
     """Controller-side record of one running server process."""
 
-    def __init__(self, index: int, port: int, ref: WorkerRef) -> None:
+    def __init__(self, index: int, port: int, ref: WorkerRef,
+                 comp_fp: Optional[str] = None) -> None:
         self.index = index
         self.port = port
         self.ref = ref
         self.ready = False
         self.in_flight = 0  # proxied requests on this replica (drain gate)
         self.started_at = time.time()
+        # Component-spec fingerprint this replica was spawned from;
+        # rollouts retire replicas whose fingerprint no longer matches.
+        self.comp_fp = comp_fp
 
     def info(self) -> ReplicaInfo:
         return ReplicaInfo(
@@ -98,6 +130,14 @@ class _Service:
         self.ready_event = asyncio.Event()
         self.failure_count = 0
         self.spec_fingerprint: Optional[str] = None
+        # Fingerprint of the COMPONENT spec the current replicas were
+        # spawned from; a change means a new revision -> replace replicas.
+        self.comp_fingerprint: Optional[str] = None
+        # Deterministic canary split cursor (activator: seq%100 < pct).
+        self.canary_seq: int = 0
+        # Promoted canary replicas keep their original spawn job_key;
+        # exit lookups resolve through these aliases.
+        self.adopted_keys: set = set()
 
     def ready_replicas(self) -> List[_Replica]:
         return [r for r in self.replicas.values() if r.ready]
@@ -188,10 +228,11 @@ class ISVCController:
     async def _reconcile(self, ns: str, name: str) -> None:
         key = f"{ns}/{name}"
         tkey = key + TRANSFORMER_SUFFIX
+        ckey = key + CANARY_SUFFIX
         raw = self.store.get(KIND, name, ns)
         if raw is None:
-            # Deleted: tear down replicas (both components).
-            for k in (key, tkey):
+            # Deleted: tear down replicas (all component sets).
+            for k in (key, tkey, ckey):
                 if k in self.services:
                     await self._scale_to(k, 0)
                     self.services.pop(k, None)
@@ -203,6 +244,24 @@ class ISVCController:
             self._write_failed(ns, name, "InvalidSpec", str(e))
             return
 
+        # Revision/canary resolution (reference canaryTrafficPercent):
+        # the promoted predictor spec lives in status.stable_predictor.
+        # An applied spec that differs from it with pct<100 runs as a
+        # separate canary set; pct>=100 promotes it; re-applying the
+        # stable spec rolls the canary back.
+        pdump, stable, pct, canarying = _rollout_state(isvc)
+        if not canarying:
+            if ckey in self.services:
+                if stable is not None and stable != pdump:
+                    await self._promote_canary(key)  # pct>=100: promote
+                else:
+                    # Rolled back to the stable spec: discard the canary,
+                    # draining its in-flight requests (it was carrying
+                    # pct% of traffic a moment ago).
+                    await self._drain_set(ckey)
+            if stable != pdump:
+                isvc.status.stable_predictor = pdump  # persist promotion
+
         fingerprint = json.dumps(
             isvc.spec.model_dump(mode="json"), sort_keys=True
         )
@@ -210,11 +269,16 @@ class ISVCController:
             # Transformer removed from the spec: tear its replicas down.
             await self._scale_to(tkey, 0)
             self.services.pop(tkey, None)
-        components = [(key, isvc.spec.predictor, "predictor")]
+        if canarying:
+            stable_comp = ComponentSpec.model_validate(stable)
+            components = [(key, stable_comp, "predictor"),
+                          (ckey, isvc.spec.predictor, "canary")]
+        else:
+            components = [(key, isvc.spec.predictor, "predictor")]
         if isvc.spec.transformer is not None:
             components.append((tkey, isvc.spec.transformer, "transformer"))
         crash_looped = False
-        for skey, comp, _label in components:
+        for skey, comp, label in components:
             svc = self.services.setdefault(skey, _Service())
             # A changed spec resets the crash-loop counter so a corrected
             # re-apply recovers without delete+recreate (generation can't
@@ -223,18 +287,42 @@ class ISVCController:
                 svc.spec_fingerprint = fingerprint
                 svc.failure_count = 0
             if svc.failure_count >= self.CRASH_LOOP_LIMIT:
-                # Crash-looping: stay down until the spec changes. Skip
-                # the status write below -- it must not clobber the
-                # Failed condition on_worker_exit recorded.
-                await self._scale_to(skey, 0)
-                crash_looped = True
+                # Crash-looping: stay down until the spec changes. A
+                # crash-looping CANARY only pauses itself (stable set
+                # keeps serving), and a crash-looping NEW REVISION
+                # mid-rollout only retires its own cohort — the retiring
+                # old-revision replicas keep serving (that is the whole
+                # point of create-before-destroy). Only a plain crash
+                # loop with no healthy cohort takes the service down and
+                # suppresses the status write (it must not clobber the
+                # Failed condition on_worker_exit recorded).
+                has_old = any(
+                    r.comp_fp != svc.comp_fingerprint
+                    for r in svc.replicas.values()
+                )
+                if has_old:
+                    for i, r in list(svc.replicas.items()):
+                        if r.comp_fp == svc.comp_fingerprint:
+                            await self._retire_replica(
+                                skey, svc, i, drain=False
+                            )
+                else:
+                    await self._scale_to(skey, 0)
+                    if label != "canary":
+                        crash_looped = True
                 continue
             if svc.desired == 0 and not svc.replicas:
                 # First reconcile (or post scale-to-zero restart): start
                 # at min_replicas; the activator bumps desired on traffic.
                 svc.desired = max(svc.desired, comp.min_replicas)
+            if label == "canary":
+                # A canary set always runs at least one replica so the
+                # split has something to route to (its size ramps with
+                # the percent against the stable set's desired count).
+                stable_n = self.services[key].desired
+                svc.desired = max(1, math.ceil(stable_n * pct / 100))
             svc.desired = max(min(svc.desired, comp.max_replicas),
-                             comp.min_replicas)
+                             comp.min_replicas if label != "canary" else 1)
             try:
                 await self._converge(skey, isvc, comp, svc)
             except Exception as e:  # noqa: BLE001 - spawn errors -> Failed
@@ -243,7 +331,9 @@ class ISVCController:
                 return
         if not crash_looped:
             self._write_status(
-                isvc, self.services[key], self.services.get(tkey)
+                isvc, self.services[key], self.services.get(tkey),
+                csvc=self.services.get(ckey) if canarying else None,
+                canary_pct=pct if canarying else None,
             )
 
     def _write_failed(self, ns: str, name: str, reason: str,
@@ -267,29 +357,128 @@ class ISVCController:
         }]
         self.store.put(KIND, raw)
 
+    async def _retire_replica(self, key: str, svc: _Service, index: int,
+                              drain: bool = True) -> None:
+        """THE one way a replica leaves a set: popped from the service,
+        probe task cancelled, then drained (graceful) or killed (hard)."""
+        rep = svc.replicas.pop(index, None)
+        t = self._probe_tasks.pop(f"{key}#{index}", None)
+        if t:
+            t.cancel()
+        if rep is None:
+            return
+        if drain:
+            await self._drain_and_kill(key, rep)
+        else:
+            rep.ready = False
+            await self.launcher.kill(rep.ref)
+
+    async def _drain_replicas(self, key: str, svc: _Service) -> None:
+        """Drain every replica of a set: out of rotation immediately,
+        killed once in-flight requests finish. Shared by rollback
+        discard and full-set teardown."""
+        for i in list(svc.replicas):
+            await self._retire_replica(key, svc, i)
+        svc.ready_event.clear()
+
+    async def _drain_set(self, key: str) -> None:
+        """Remove a whole replica set gracefully: out of rotation now,
+        killed only after in-flight requests finish."""
+        svc = self.services.pop(key, None)
+        if svc is not None:
+            await self._drain_replicas(key, svc)
+
+    async def _promote_canary(self, key: str) -> None:
+        """Canary promoted to 100%: its replicas (already running the new
+        revision, already warm) BECOME the primary set. The old stable
+        replicas join it as a RETIRING cohort (their comp_fp differs) so
+        _converge drains them one-for-one as new-revision replicas come
+        up — promotion at a small canary percent must not collapse
+        capacity onto the few canary replicas."""
+        ckey = key + CANARY_SUFFIX
+        csvc = self.services.pop(ckey, None)
+        if csvc is None:
+            return
+        old = self.services.get(key)
+        csvc.adopted_keys.add(ckey)
+        if old is not None:
+            csvc.desired = max(csvc.desired, old.desired)
+            csvc.adopted_keys |= old.adopted_keys
+            for i, rep in list(old.replicas.items()):
+                t = self._probe_tasks.pop(f"{key}#{i}", None)
+                if t:
+                    t.cancel()
+                new_i = csvc.next_index
+                csvc.next_index += 1
+                rep.index = new_i
+                csvc.replicas[new_i] = rep
+            old.replicas.clear()
+        self.services[key] = csvc
+        # Re-home probe tasks: pending canary replicas must keep probing
+        # under the primary key (their old-key probes would give up).
+        for i, rep in list(csvc.replicas.items()):
+            t = self._probe_tasks.pop(f"{ckey}#{i}", None)
+            if t:
+                t.cancel()
+            if not rep.ready:
+                self._probe_tasks[f"{key}#{i}"] = asyncio.create_task(
+                    self._probe_ready(key, i)
+                )
+        logger.info("isvc %s: canary promoted (%d replicas adopted)",
+                    key, len(csvc.replicas))
+
     async def _converge(self, key: str, isvc: InferenceService,
                         comp: ComponentSpec, svc: _Service) -> None:
-        # Scale up.
-        while len(svc.replicas) < svc.desired:
+        # Revision change: the running replicas were spawned from a
+        # different component spec. Create-before-destroy: old replicas
+        # KEEP SERVING while new-revision ones spawn; they drain only
+        # once a new replica is ready — an ordinary spec update must not
+        # open a cold-start window (the 8B jax runtime takes minutes to
+        # load; 0 ready replicas would 503 the service meanwhile).
+        comp_fp = json.dumps(comp.model_dump(mode="json"), sort_keys=True)
+        if (svc.comp_fingerprint is not None
+                and svc.comp_fingerprint != comp_fp and svc.replicas):
+            logger.info(
+                "isvc %s: revision change, rolling %d replicas "
+                "(create-before-destroy)", key, len(svc.replicas),
+            )
+        svc.comp_fingerprint = comp_fp
+        current = {
+            i: r for i, r in svc.replicas.items() if r.comp_fp == comp_fp
+        }
+        retiring = {
+            i: r for i, r in svc.replicas.items() if r.comp_fp != comp_fp
+        }
+        # Scale up the current revision.
+        while len(current) < svc.desired:
             index = svc.next_index
             svc.next_index += 1
             port = allocate_port()
             req = self._spawn_request(isvc, comp, index, port, key)
             ref = await self.launcher.spawn(req)
-            svc.replicas[index] = _Replica(index, port, ref)
+            rep = _Replica(index, port, ref, comp_fp=comp_fp)
+            svc.replicas[index] = rep
+            current[index] = rep
             probe_key = f"{key}#{index}"
             self._probe_tasks[probe_key] = asyncio.create_task(
                 self._probe_ready(key, index)
             )
             logger.info("isvc %s: spawned replica %d on port %d", key, index, port)
-        # Scale down (highest index first; KServe reaps newest too).
-        while len(svc.replicas) > svc.desired:
-            index = max(svc.replicas)
-            rep = svc.replicas.pop(index)
-            t = self._probe_tasks.pop(f"{key}#{index}", None)
-            if t:
-                t.cancel()
-            await self._drain_and_kill(key, rep)
+        # Old revision drains ONE-FOR-ONE with ready new replicas, so
+        # in-rotation capacity never dips below the old level while the
+        # new revision is still loading (each readiness probe enqueues a
+        # reconcile, which drains the next batch).
+        ready_new = sum(1 for r in current.values() if r.ready)
+        if retiring and ready_new:
+            for index in sorted(retiring)[:ready_new]:
+                retiring.pop(index)
+                await self._retire_replica(key, svc, index)
+        # Scale down within the current revision (highest index first;
+        # KServe reaps newest too).
+        while len(current) > svc.desired:
+            index = max(current)
+            current.pop(index)
+            await self._retire_replica(key, svc, index)
         if not svc.ready_replicas():
             svc.ready_event.clear()
 
@@ -316,12 +505,9 @@ class ISVCController:
             return
         svc.desired = n
         while len(svc.replicas) > n:
-            index = max(svc.replicas)
-            rep = svc.replicas.pop(index)
-            t = self._probe_tasks.pop(f"{key}#{index}", None)
-            if t:
-                t.cancel()
-            await self.launcher.kill(rep.ref)
+            await self._retire_replica(
+                key, svc, max(svc.replicas), drain=False
+            )
         if not svc.ready_replicas():
             svc.ready_event.clear()
 
@@ -406,14 +592,28 @@ class ISVCController:
 
         Returns True if the exit belonged to a serving replica."""
 
-        key = ref.req.job_key
-        svc = self.services.get(key)
-        if svc is None or ref.req.replica_type != "server":
+        if ref.req.replica_type != "server":
             return False
-        index = ref.req.index
-        rep = svc.replicas.get(index)
-        if rep is None or rep.ref.generation != ref.generation:
-            return True  # stale exit for an already-replaced replica
+        # Resolve by launcher generation (globally unique), not by spawn
+        # job_key/index: promotion re-keys adopted replicas, and a spawn
+        # key like "ns/name#canary" may since have been re-occupied by a
+        # NEWER canary set — a key-based lookup would misattribute the
+        # exit (or swallow it, leaving a dead replica in rotation).
+        svc = key = index = rep = None
+        for skey, s in self.services.items():
+            for i, r in list(s.replicas.items()):
+                if r.ref.generation == ref.generation:
+                    svc, key, index, rep = s, skey, i, r
+                    break
+            if svc is not None:
+                break
+        if svc is None:
+            spawn_key = ref.req.job_key
+            known = spawn_key in self.services or any(
+                spawn_key in s.adopted_keys for s in self.services.values()
+            )
+            # Ours-but-already-replaced (stale) vs not a serving exit.
+            return known
         svc.replicas.pop(index, None)
         self._probe_tasks.pop(f"{key}#{index}", None)
         if not svc.ready_replicas():
@@ -429,11 +629,62 @@ class ISVCController:
             self._enqueue(*_key_parts(key))
         elif svc.failure_count == self.CRASH_LOOP_LIMIT:
             ns, name = _key_parts(key)
-            self._write_failed(
-                ns, name, "CrashLoop",
-                f"replica exited {svc.failure_count} times (last code {code})",
+            # Canary-ness is decided by the service's CURRENT role, not
+            # the spawn key: promoted replicas keep their #canary
+            # job_key but ARE the primary set — their crash loop must
+            # mark the whole service Failed.
+            is_canary = svc is self.services.get(
+                f"{ns}/{name}" + CANARY_SUFFIX
             )
+            if is_canary:
+                # A bad canary must not blackhole the service: the stable
+                # set keeps serving (the activator skips a canary with no
+                # ready replicas). Record a non-exclusive condition so the
+                # operator sees the rollout is stuck.
+                self._write_condition(
+                    ns, name, "CanaryCrashLoop",
+                    f"canary replica exited {svc.failure_count} times "
+                    f"(last code {code}); traffic stays on stable",
+                )
+            elif any(
+                r.comp_fp != svc.comp_fingerprint
+                for r in svc.replicas.values()
+            ):
+                # New revision crash-looping mid-rollout while the old
+                # revision's retiring replicas still serve: pause the
+                # rollout, don't fail (and so don't 503) the service.
+                self._write_condition(
+                    ns, name, "RolloutCrashLoop",
+                    f"new-revision replica exited {svc.failure_count} "
+                    f"times (last code {code}); previous revision keeps "
+                    "serving",
+                )
+            else:
+                self._write_failed(
+                    ns, name, "CrashLoop",
+                    f"replica exited {svc.failure_count} times "
+                    f"(last code {code})",
+                )
         return True
+
+    def _write_condition(self, ns: str, name: str, ctype: str,
+                         message: str) -> None:
+        """Set a non-exclusive informational condition (does not touch
+        Ready/Unready/Failed) via the shared condition machinery. No-op
+        when identical (a status write re-triggers reconcile via our own
+        watch)."""
+        from kubeflow_tpu.api import conditions as cond
+
+        raw = self.store.get(KIND, name, ns)
+        if raw is None:
+            return
+        conds = raw.setdefault("status", {}).setdefault("conditions", [])
+        for c in conds:
+            if (c.get("type") == ctype and c.get("status")
+                    and c.get("message") == message):
+                return
+        cond.set_condition(conds, ctype, (), reason=ctype, message=message)
+        self.store.put(KIND, raw)
 
     # -- autoscaler -------------------------------------------------------
 
@@ -441,22 +692,27 @@ class ISVCController:
         while not self._stopped.is_set():
             await asyncio.sleep(self.autoscale_interval)
             for key, svc in list(self.services.items()):
+                if key.endswith(CANARY_SUFFIX):
+                    # Canary sets are sized by the rollout percent in
+                    # reconcile, not by traffic.
+                    continue
                 ns, name = _key_parts(key)
                 raw = self.store.get(KIND, name, ns)
                 if raw is None:
                     continue
                 try:
-                    spec = InferenceService.from_dict(raw).spec
+                    parsed = InferenceService.from_dict(raw)
                 except ValueError:
                     continue
-                comp = (
-                    spec.transformer
-                    if key.endswith(TRANSFORMER_SUFFIX) else spec.predictor
-                )
+                if key.endswith(TRANSFORMER_SUFFIX):
+                    comp = parsed.spec.transformer
+                else:
+                    # Mid-rollout the stable set RUNS the stable
+                    # revision; scale it by that spec's bounds, not the
+                    # unpromoted canary spec's.
+                    comp = _governing_predictor(parsed)
                 if comp is None:
                     continue
-                import math
-
                 want = math.ceil(svc.in_flight / comp.target_concurrency)
                 want = min(max(want, comp.min_replicas), comp.max_replicas)
                 idle = time.time() - svc.last_request
@@ -476,11 +732,38 @@ class ISVCController:
     # -- status -----------------------------------------------------------
 
     def _write_status(self, isvc: InferenceService, svc: _Service,
-                      tsvc: Optional[_Service] = None) -> None:
+                      tsvc: Optional[_Service] = None,
+                      csvc: Optional[_Service] = None,
+                      canary_pct: Optional[int] = None) -> None:
         raw = self.store.get(KIND, isvc.metadata.name, isvc.metadata.namespace)
         if raw is None:
             return
         status = isvc.status
+        if csvc is not None:
+            status.canary = ComponentStatus(
+                desired_replicas=csvc.desired,
+                ready_replicas=len(csvc.ready_replicas()),
+                replicas=[r.info() for r in csvc.replicas.values()],
+            )
+            status.canary_percent = canary_pct
+        else:
+            status.canary = None
+            status.canary_percent = None
+        if csvc is None or csvc.ready_replicas():
+            # Rollout resolved (promoted/rolled back) or the canary is
+            # healthy again: the stuck-rollout marker must not outlive
+            # the condition it reports.
+            status.conditions = [
+                c for c in status.conditions
+                if c.get("type") != "CanaryCrashLoop"
+            ]
+        if svc.failure_count < self.CRASH_LOOP_LIMIT:
+            # Spec change reset the counter (or the new revision came
+            # good): the paused-rollout marker is stale.
+            status.conditions = [
+                c for c in status.conditions
+                if c.get("type") != "RolloutCrashLoop"
+            ]
         ready = svc.ready_replicas()
         status.predictor.desired_replicas = svc.desired
         status.predictor.ready_replicas = len(ready)
@@ -597,6 +880,17 @@ class Activator:
         has_transformer = bool((raw.get("spec") or {}).get("transformer"))
         if has_transformer and component != PRIMARY:
             key = key + TRANSFORMER_SUFFIX
+        elif not key.endswith(TRANSFORMER_SUFFIX):
+            # Canary split on the predictor path: a deterministic cursor
+            # sends pct of 100 consecutive requests to the canary set
+            # (exact split, testable; random() only approximates).
+            pct = (raw.get("spec") or {}).get("canary_traffic_percent", 100)
+            csvc = ctrl.services.get(key + CANARY_SUFFIX)
+            if 0 < pct < 100 and csvc is not None and csvc.ready_replicas():
+                primary = ctrl.services.setdefault(key, _Service())
+                primary.canary_seq = (primary.canary_seq + 1) % 100
+                if primary.canary_seq < pct:
+                    key = key + CANARY_SUFFIX
         svc = ctrl.services.setdefault(key, _Service())
         svc.last_request = time.time()
         svc.in_flight += 1

@@ -98,33 +98,50 @@ def _cast(tree, dtype):
     )
 
 
-def pack_weights(params: dict, cfg: LlamaConfig) -> dict:
+def pack_weights(params: dict, cfg: LlamaConfig, cast: bool = True) -> dict:
     """params: the ``{"params": ...}`` pytree from Llama.init / orbax
     restore (scan layout required), flax metadata already unboxed.
 
     Returns a plain-dict pytree so it can be a jit *argument* -- closing
     over multi-GB weights would bake them into the jaxpr as constants.
+
+    ``cast=False`` returns the reorganized tree with leaves UNTOUCHED (no
+    device ops): the tensor-parallel path places each leaf sharded first
+    and casts on-mesh, so the full tree is never materialized on one
+    device (config #5's 8B on a 16 GiB v5e-4 would OOM otherwise).
     """
 
     p = params["params"] if "params" in params else params
     if "layers" not in p:
         raise ValueError("engine requires scan_layers=True checkpoints")
-    dt = jnp.dtype(cfg.dtype)
-    layers = _cast(p["layers"]["layer"], dt)
+    out = {
+        "embed": p["embed"]["embedding"],                      # [V, H]
+        "final_scale": p["final_norm"]["scale"],
+        "lm_head": p["lm_head"]["kernel"],                     # [H, V]
+        "layers": p["layers"]["layer"],                        # leaves [L, ...]
+    }
+    return _cast_packed(out, cfg) if cast else out
+
+
+def _cast_packed(w: dict, cfg: LlamaConfig) -> dict:
+    """Serving dtypes for a packed tree: activations-dtype everywhere,
+    except norm scales and the MoE router in f32. Router weights route
+    DISCRETELY (top-k): a bf16 rounding can flip a near-tie to a
+    different expert than training chose, an O(1) output change; the
+    [L, H, E] router is tiny, so f32 costs nothing."""
+    dtype = jnp.dtype(cfg.dtype)
+    layers = _cast(w["layers"], dtype)
     if "moe" in layers:
-        # Router weights route DISCRETELY (top-k): a bf16 rounding can
-        # flip a near-tie to a different expert than training chose, an
-        # O(1) output change. The [L, H, E] router is tiny; keep it f32.
         layers = dict(layers)
         layers["moe"] = dict(layers["moe"])
-        layers["moe"]["router"] = (
-            p["layers"]["layer"]["moe"]["router"].astype(jnp.float32)
+        layers["moe"]["router"] = w["layers"]["moe"]["router"].astype(
+            jnp.float32
         )
     return {
-        "embed": _cast(p["embed"]["embedding"], dt),           # [V, H]
-        "final_scale": p["final_norm"]["scale"].astype(jnp.float32),
-        "lm_head": _cast(p["lm_head"]["kernel"], dt),          # [H, V]
-        "layers": layers,                                      # leaves [L, ...]
+        "embed": _cast(w["embed"], dtype),
+        "final_scale": w["final_scale"].astype(jnp.float32),
+        "lm_head": _cast(w["lm_head"], dtype),
+        "layers": layers,
     }
 
 
@@ -357,9 +374,34 @@ def tp_weight_shardings(mesh, weights: dict):
             spec = P(None, None, "tensor")            # [L, H, I]
         else:
             spec = P()  # embed, norm scales
+        if len(spec) > getattr(leaf, "ndim", 0):
+            # Name matched but rank didn't (e.g. a scalar in an aux
+            # collection whose path contains "moe"): replicate.
+            spec = P()
         return jax.sharding.NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(spec_for, weights)
+
+
+def abstract_param_targets(cfg: LlamaConfig, mesh):
+    """(abstract_tree, shardings) for the MODEL param tree ``{"params":
+    ...}`` under tensor parallelism — the shape/dtype/placement targets
+    for sharded checkpoint restore and sharded random init. One home so
+    the restore path and the engine can never disagree on placements."""
+    import dataclasses
+
+    from flax import linen as nn
+
+    model = Llama(dataclasses.replace(cfg, remat=False))
+
+    def init_fn(key):
+        variables = model.init(key, jnp.zeros((1, 8), jnp.int32))
+        # Params only: init also sows aux collections (MoE losses)
+        # that serving never touches.
+        return {"params": nn.meta.unbox(variables)["params"]}
+
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    return abstract, tp_weight_shardings(mesh, abstract), init_fn
 
 
 def tp_cache_sharding(mesh):
@@ -433,19 +475,36 @@ class GenerationEngine:
                 )
             _validate_tp(cfg, mesh.shape["tensor"])
         if params is None:
-            # Demo mode: random init (serving tests; real use loads orbax).
-            import flax.linen as nn
+            # Demo mode: random init (serving tests; real use loads
+            # orbax). With a mesh, init sharded from birth — the full
+            # tree never exists on one device.
+            if mesh is not None:
+                _, msh, init_fn = abstract_param_targets(cfg, mesh)
+                params = jax.jit(init_fn, out_shardings=msh)(
+                    jax.random.PRNGKey(seed)
+                )
+            else:
+                import flax.linen as nn
 
-            model = Llama(dataclasses.replace(cfg, remat=False))
-            raw = jax.jit(model.init)(
-                jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32)
-            )
-            params = nn.meta.unbox(raw)
-        self.weights = pack_weights(params, cfg)
-        if mesh is not None:
-            self.weights = jax.device_put(
-                self.weights, tp_weight_shardings(mesh, self.weights)
-            )
+                model = Llama(dataclasses.replace(cfg, remat=False))
+                raw = jax.jit(model.init)(
+                    jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32)
+                )
+                params = nn.meta.unbox(raw)
+        if mesh is None:
+            self.weights = pack_weights(params, cfg)
+        else:
+            # Shard-first, cast-on-mesh: each leaf goes to its devices in
+            # checkpoint dtype (a no-op for leaves orbax already restored
+            # sharded), then one donated jit casts shard-locally. The
+            # full serving-dtype tree never exists on a single device.
+            raw = pack_weights(params, cfg, cast=False)
+            wsh = tp_weight_shardings(mesh, raw)
+            placed = jax.tree.map(jax.device_put, raw, wsh)
+            self.weights = jax.jit(
+                partial(_cast_packed, cfg=cfg),
+                donate_argnums=0, out_shardings=wsh,
+            )(placed)
 
         kvshape = (cfg.n_layers, max_slots, cfg.max_seq, cfg.n_kv_heads,
                    cfg.head_dim)

@@ -31,7 +31,13 @@ class EchoModel(Model):
         if delay:
             time.sleep(delay)
         self.batch_sizes.append(len(instances))
-        return [{"echo": i, "model_path": self.path} for i in instances]
+        out = [{"echo": i, "model_path": self.path} for i in instances]
+        if "tag" in self.options:
+            # Revision marker for canary-rollout tests: identifies which
+            # spec generation served the request.
+            for o in out:
+                o["tag"] = self.options["tag"]
+        return out
 
 
 def main(argv=None) -> int:

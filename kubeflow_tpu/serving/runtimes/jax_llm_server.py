@@ -68,12 +68,15 @@ class HFTokenizer:
         return self._tok.decode(list(ids))
 
 
-def load_params_from_checkpoint(path: str, cfg) -> dict:
+def load_params_from_checkpoint(path: str, cfg, mesh=None) -> dict:
     """Restore model params from a training checkpoint directory.
 
     Accepts either a raw orbax step dir or a job checkpoint dir (picks the
-    latest step). Restores on the serving host's devices with the engine's
-    single-process sharding.
+    latest step). With a mesh, first tries an abstract-target restore so
+    every leaf lands SHARDED across the mesh directly from disk — at 8B
+    on 16 GiB chips a single-device restore would OOM before the engine
+    could reshard. Falls back to the generic restore for checkpoint
+    layouts that don't match the model tree (e.g. full TrainState dirs).
     """
 
     import orbax.checkpoint as ocp
@@ -83,7 +86,17 @@ def load_params_from_checkpoint(path: str, cfg) -> dict:
     step = mgr.latest_step()
     if step is None:
         raise InferenceError(f"no checkpoint steps under {path}", 500)
-    restored = mgr.restore(step)
+    restored = None
+    if mesh is not None:
+        try:
+            restored = _restore_sharded(mgr, step, cfg, mesh)
+        except Exception as e:  # noqa: BLE001 - layout mismatch: fall back
+            logger.info(
+                "sharded restore unavailable (%s: %s); generic restore",
+                type(e).__name__, e,
+            )
+    if restored is None:
+        restored = mgr.restore(step)
     mgr.close()
     # Unwrap to the MODEL param tree: a TrainState checkpoint nests it as
     # state["params"]["params"] (TrainState.params holds the variables
@@ -100,6 +113,27 @@ def load_params_from_checkpoint(path: str, cfg) -> dict:
     if not (isinstance(tree, dict) and "layers" in tree):
         raise InferenceError(f"checkpoint at {path} has no params", 500)
     return {"params": tree}
+
+
+def _restore_sharded(mgr, step: int, cfg, mesh) -> dict:
+    """Abstract-target restore: shape/dtype/sharding targets from the
+    engine's shared abstract-param helper, so restore placements can
+    never diverge from what the engine expects. Works for the
+    ``{"params": ...}`` layout our converter and raw-variables
+    checkpoints use; raises on structure mismatch (caller falls back)."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    from kubeflow_tpu.serving.engine import abstract_param_targets
+
+    abstract, shardings, _ = abstract_param_targets(cfg, mesh)
+    target = jax.tree.map(
+        lambda leaf, sh: jax.ShapeDtypeStruct(
+            leaf.shape, leaf.dtype, sharding=sh
+        ),
+        abstract, shardings,
+    )
+    return mgr.restore(step, args=ocp.args.StandardRestore(target))
 
 
 class JaxLLMModel(Model):
@@ -133,6 +167,12 @@ class JaxLLMModel(Model):
                 "checkpoint; it requires checkpoint=orbax and a "
                 "storage_uri", 500,
             )
+        tp = int(opts.get("tensor_parallel", 1))
+        mesh = None
+        if tp > 1:
+            from kubeflow_tpu.serving.engine import make_tp_mesh
+
+            mesh = make_tp_mesh(tp)
         if ckpt_mode == "orbax":
             if not self.path:
                 raise InferenceError("checkpoint=orbax requires storage_uri", 500)
@@ -155,13 +195,13 @@ class JaxLLMModel(Model):
                 from kubeflow_tpu.models.llama import PRESETS
 
                 config = PRESETS[preset]
-            params = load_params_from_checkpoint(self.path, config)
+            params = load_params_from_checkpoint(self.path, config, mesh)
         engine_kw = dict(
             params=params,
             max_slots=int(opts.get("max_slots", 8)),
             max_seq=opts.get("max_seq"),
             decode_block=int(opts.get("decode_block", 8)),
-            tensor_parallel=int(opts.get("tensor_parallel", 1)),
+            mesh=mesh,
         )
         if config is not None:
             self.engine = GenerationEngine(config=config, **engine_kw)

@@ -132,6 +132,13 @@ class InferenceServiceStatus(BaseModel):
     url: Optional[str] = None
     predictor: ComponentStatus = Field(default_factory=ComponentStatus)
     transformer: Optional[ComponentStatus] = None
+    # Revision/canary rollout (reference: canaryTrafficPercent + Knative
+    # revisions). stable_predictor is the last PROMOTED predictor spec;
+    # while a canary rollout is in flight the stable set keeps serving it
+    # and the canary set runs the applied spec at canary_percent traffic.
+    stable_predictor: Optional[dict] = None
+    canary: Optional[ComponentStatus] = None
+    canary_percent: Optional[int] = None
     # Activator-observed load, persisted for visibility (kftpu get isvc).
     in_flight: int = 0
     last_request_time: float = 0.0

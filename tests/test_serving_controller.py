@@ -358,3 +358,115 @@ def test_transformer_requires_custom():
     }
     with pytest.raises(ServingValidationError, match="custom"):
         validate_isvc(InferenceService.from_dict(spec))
+
+
+def test_canary_rollout_split_promote(cp_client):
+    """Reference canaryTrafficPercent semantics (SURVEY.md 3.3 S1/S2):
+    apply a new revision at canary=20 -> exactly 20/100 requests hit the
+    canary set (deterministic cursor); promote to 100 -> canary replicas
+    are adopted as the primary set and the old revision drains."""
+    cp, client, loop = cp_client
+
+    def spec(tag, pct=100):
+        d = isvc("roll", options={"tag": tag})
+        d["spec"]["canary_traffic_percent"] = pct
+        return d
+
+    async def predict_tags(n):
+        tags = []
+        for _ in range(n):
+            r = await client.post(
+                "/serving/default/roll/v1/models/roll:predict",
+                json={"instances": [1]},
+            )
+            assert r.status == 200, await r.text()
+            tags.append((await r.json())["predictions"][0]["tag"])
+        return tags
+
+    async def run():
+        r = await client.post("/apis/InferenceService", json=spec("v1"))
+        assert r.status == 200, await r.text()
+        await wait_for(
+            lambda: _status(cp, "roll").get("predictor", {}).get("ready_replicas"),
+            msg="v1 ready",
+        )
+        # First apply promotes itself: stable revision recorded.
+        assert _status(cp, "roll")["stable_predictor"]["custom"]["args"][-1] \
+            == json.dumps({"tag": "v1"})
+        assert (await predict_tags(3)) == ["v1"] * 3
+
+        # New revision at 20% canary.
+        r = await client.post("/apis/InferenceService", json=spec("v2", 20))
+        assert r.status == 200, await r.text()
+        await wait_for(
+            lambda: (_status(cp, "roll").get("canary") or {}).get("ready_replicas"),
+            msg="canary ready",
+        )
+        st = _status(cp, "roll")
+        assert st["canary_percent"] == 20
+        # Stable set still runs v1 (not respawned by the canary apply).
+        assert st["stable_predictor"]["custom"]["args"][-1] \
+            == json.dumps({"tag": "v1"})
+        tags = await predict_tags(100)
+        assert tags.count("v2") == 20, tags.count("v2")
+        assert tags.count("v1") == 80
+
+        # Promote: same revision, full traffic.
+        r = await client.post("/apis/InferenceService", json=spec("v2", 100))
+        assert r.status == 200, await r.text()
+        await wait_for(
+            lambda: _status(cp, "roll").get("canary") is None
+            and _status(cp, "roll")["stable_predictor"]["custom"]["args"][-1]
+            == json.dumps({"tag": "v2"}),
+            msg="promoted",
+        )
+        assert (await predict_tags(10)) == ["v2"] * 10
+        # Old-revision replicas drained away; one set remains.
+        await wait_for(
+            lambda: "default/roll#canary" not in cp.isvc.services,
+            msg="canary set gone",
+        )
+
+    loop.run_until_complete(run())
+
+
+def test_canary_rollback(cp_client):
+    """Re-applying the stable spec mid-canary discards the canary set and
+    all traffic returns to the stable revision."""
+    cp, client, loop = cp_client
+
+    def spec(tag, pct=100):
+        d = isvc("rb", options={"tag": tag})
+        d["spec"]["canary_traffic_percent"] = pct
+        return d
+
+    async def run():
+        await client.post("/apis/InferenceService", json=spec("v1"))
+        await wait_for(
+            lambda: _status(cp, "rb").get("predictor", {}).get("ready_replicas"),
+            msg="v1 ready",
+        )
+        await client.post("/apis/InferenceService", json=spec("v2", 50))
+        await wait_for(
+            lambda: (_status(cp, "rb").get("canary") or {}).get("ready_replicas"),
+            msg="canary ready",
+        )
+        # Rollback: re-apply v1 (the stable revision).
+        await client.post("/apis/InferenceService", json=spec("v1"))
+        await wait_for(
+            lambda: _status(cp, "rb").get("canary") is None,
+            msg="canary discarded",
+        )
+        await wait_for(
+            lambda: "default/rb#canary" not in cp.isvc.services,
+            msg="canary set torn down",
+        )
+        r = await client.post(
+            "/serving/default/rb/v1/models/rb:predict",
+            json={"instances": [1]},
+        )
+        assert (await r.json())["predictions"][0]["tag"] == "v1"
+        assert _status(cp, "rb")["stable_predictor"]["custom"]["args"][-1] \
+            == json.dumps({"tag": "v1"})
+
+    loop.run_until_complete(run())
