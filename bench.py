@@ -34,6 +34,32 @@ STEPS = int(os.environ.get("BENCH_STEPS", "10"))
 PRESET = os.environ.get("BENCH_PRESET", "llama3-8b-proxy")
 
 
+def check_flash_kernel() -> None:
+    """Pallas-kernel-vs-XLA equivalence on the REAL chip. The CI suite
+    runs on the CPU backend where flash_attention falls back to
+    xla_attention, so this bench run is the only place the actual kernel
+    executes — make it the correctness signal too (a mismatch aborts the
+    bench rather than publishing numbers from a wrong kernel)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.ops.attention import xla_attention
+    from kubeflow_tpu.ops.flash_attention import flash_attention
+
+    if jax.default_backend() != "tpu":
+        return
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, s, h, hkv, d = 2, 512, 8, 4, 128
+    q = jax.random.normal(kq, (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, s, hkv, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, s, hkv, d), jnp.bfloat16)
+    flash = np.asarray(jax.jit(flash_attention)(q, k, v), np.float32)
+    ref = np.asarray(jax.jit(xla_attention)(q, k, v), np.float32)
+    np.testing.assert_allclose(flash, ref, atol=2e-2, rtol=2e-2)
+
+
 def main() -> int:
     import jax
 
@@ -41,6 +67,8 @@ def main() -> int:
     from kubeflow_tpu.models import get_task
     from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
     from kubeflow_tpu.runtime.metrics import peak_flops_per_chip
+
+    check_flash_kernel()
 
     task = get_task(
         "llama", preset=PRESET, batch_size=BATCH, seq_len=SEQ,

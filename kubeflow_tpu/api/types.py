@@ -183,6 +183,16 @@ class ElasticPolicy(BaseModel):
     min_replicas: int = Field(default=1, ge=1)
     max_replicas: int = Field(default=1, ge=1)
     max_restarts: int = Field(default=3, ge=0)
+    # Metric-driven resize (reference: ElasticPolicy metrics -> HPA).
+    # ``metric`` names a key from the worker's KFTPU-METRIC lines (e.g.
+    # "tokens_per_sec", "queue_depth"); the controller polls the lead
+    # worker's output and applies the HPA formula
+    # desired = ceil(current * value / target_value), clamped to
+    # [min_replicas, max_replicas]. Resize = quiesce -> re-admit at the
+    # new size -> resume from checkpoint (slice-granularity elasticity).
+    metric: Optional[str] = None
+    target_value: Optional[float] = Field(default=None, gt=0)
+    metric_poll_seconds: float = Field(default=10.0, gt=0)
 
 
 class CheckpointPolicy(BaseModel):

@@ -105,6 +105,11 @@ def validate_job(job: TrainJob) -> None:
                 f"elastic policy requires 1 <= min ({el.min_replicas}) <= max "
                 f"({el.max_replicas})"
             )
+        if (el.metric is None) != (el.target_value is None):
+            raise ValidationError(
+                "elastic metric-driven resize requires both metric and "
+                "target_value (or neither)"
+            )
 
     sched = job.spec.run_policy.scheduling
     if sched.min_available is not None and sched.min_available < 1:

@@ -79,9 +79,12 @@ class GangScheduler:
         self._ns_quotas.pop(ns, None)
 
     def namespace_usage(self, ns: str) -> tuple[int, int]:
-        """(chips held, admitted jobs) for a namespace."""
+        """(chips held, admitted jobs) for a namespace. Serving replica
+        reservations count their CHIPS but are not jobs — a per-replica
+        object must not consume a per-job quota slot."""
         res = [r for k, r in self._reserved.items() if k.startswith(ns + "/")]
-        return sum(r.chips for r in res), len(res)
+        jobs = sum(1 for r in res if r.queue != "serving")
+        return sum(r.chips for r in res), jobs
 
     def _quota_allows(
         self, ns: str, chips: int, released: tuple[int, int] = (0, 0)
@@ -166,7 +169,9 @@ class GangScheduler:
         # an admin raising the quota must un-stick the queue.
         ns = key.split("/", 1)[0]
         sched = job.spec.run_policy.scheduling
-        blocked = self._pending_barrier(key, ns, sched, self._pending.get(key))
+        blocked = self._pending_barrier(
+            key, ns, sched.priority, self._pending.get(key)
+        )
         if not blocked and self._fits(chips, processes) \
                 and self._quota_allows(ns, chips):
             res = Reservation(
@@ -190,11 +195,43 @@ class GangScheduler:
             )
         return None
 
+    def try_reserve(
+        self,
+        key: str,
+        chips: int,
+        processes: int = 1,
+        priority: int = 0,
+        queue: str = "serving",
+    ) -> bool:
+        """Non-gang reservation for an independent replica (serving): fit
+        now or refuse (no pending entry — the caller retries on its own
+        cadence). Serving and training contend for the same chip pool,
+        and a reservation may not backfill past pending gangs of equal
+        or higher priority (their admission slot comes first)."""
+        if key in self._reserved:
+            return True
+        if chips > self.total_chips or processes > self.max_processes:
+            raise ValueError(
+                f"replica {key} needs {chips} chips; cluster has "
+                f"{self.total_chips}"
+            )
+        ns = key.split("/", 1)[0]
+        if self._pending_barrier(key, ns, priority, None):
+            return False
+        if not (self._fits(chips, processes)
+                and self._quota_allows(ns, chips)):
+            return False
+        self._reserved[key] = Reservation(
+            job_key=key, chips=chips, processes=processes,
+            queue=queue, priority=priority,
+        )
+        return True
+
     def _pending_barrier(
         self,
         key: str,
         ns: str,
-        sched,
+        priority: int,
         mine: Optional[_Pending],
         released: Optional[dict[str, tuple[int, int]]] = None,
     ) -> bool:
@@ -222,7 +259,7 @@ class GangScheduler:
             ):
                 continue
             if (p.sort_key < mine.sort_key if mine is not None
-                    else p.priority >= sched.priority):
+                    else p.priority >= priority):
                 return True
         return False
 
@@ -279,7 +316,8 @@ class GangScheduler:
             c, j = released_by_ns.get(r_ns, (0, 0))
             released_by_ns[r_ns] = (c + r.chips, j + 1)
         if self._pending_barrier(
-            key, ns, sched, self._pending.get(key), released=released_by_ns
+            key, ns, sched.priority, self._pending.get(key),
+            released=released_by_ns,
         ):
             return None
         if not self._quota_allows(
@@ -308,6 +346,12 @@ class GangScheduler:
 
     def release(self, job_key: str) -> None:
         self._reserved.pop(job_key, None)
+        self._pending.pop(job_key, None)
+
+    def drop_pending(self, job_key: str) -> None:
+        """Remove a queued (not admitted) entry — used when a caller
+        re-queues the same job at a different demand, so stale sizes
+        never pollute barrier/quota decisions."""
         self._pending.pop(job_key, None)
 
     def admissible(self) -> list[str]:

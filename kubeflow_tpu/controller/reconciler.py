@@ -85,6 +85,10 @@ class _JobRuntime:
     # set when monitoring is impossible — no log capture — so the
     # unavailable event fires once, not every reconcile).
     hang_armed: bool = False
+    # Metric-driven elastic resize target (worker count), set by the
+    # metric-scaler timer and consumed by reconcile.
+    resize_to: Optional[int] = None
+    metrics_armed: bool = False
     # On-disk MPI hostfile for this gang generation; removed at teardown.
     hostfile_path: Optional[str] = None
 
@@ -112,6 +116,9 @@ class JobController:
         self._event_seq = 0
         # Gang-restart crash-loop protection: no respawn before this time.
         self._backoff_until: dict[str, float] = {}
+        # Worker-count targets for metric-driven elastic re-formation,
+        # consumed by the next admission of that job.
+        self._resize_hints: dict[str, int] = {}
         # Private dir for MPI hostfiles when no log_dir is configured
         # (mkdtemp => mode 0700, unpredictable path: no symlink/tamper
         # surface in the shared temp dir). Created lazily.
@@ -263,7 +270,39 @@ class JobController:
             rt = None
             job.status.set_condition(ConditionType.Restarting, "Resizing")
             job.status.formed_replicas = None
-        elif rt is not None and rt.formed_replicas is not None and self._can_grow(job, rt):
+        elif rt is not None and rt.resize_to is not None:
+            # Metric-driven elastic resize (HPA analog): quiesce and
+            # re-form at the computed worker count; resume from the
+            # latest checkpoint like any gang re-formation. The flag may
+            # race a spec update removing the policy — re-check.
+            n = rt.resize_to
+            rt.resize_to = None
+            current = rt.formed_replicas or sum(
+                1 for t, _ in rt.formed_world if t == ReplicaType.Worker.value
+            )
+            el = job.spec.elastic
+            if el is not None and el.metric is not None and n != current:
+                self._record_event(
+                    job, "ElasticMetricResize",
+                    f"metric {el.metric} drives "
+                    f"{current} -> {n} workers",
+                )
+                self._resize_hints[key] = n
+                await self._teardown(key, release=True)
+                rt = None
+                job.status.set_condition(
+                    ConditionType.Restarting, "ElasticMetricResize"
+                )
+                job.status.formed_replicas = None
+            else:
+                # Resize skipped (policy raced away / target already
+                # current): the scaler timer died delivering the flag;
+                # disarm so the arming below can restart it.
+                rt.metrics_armed = False
+        elif (rt is not None and rt.formed_replicas is not None
+                and (job.spec.elastic is None
+                     or job.spec.elastic.metric is None)
+                and self._can_grow(job, rt)):
             # Formed at reduced size (elastic); full size now fits: grow.
             self._record_event(
                 job, "ScalingUp",
@@ -295,11 +334,12 @@ class JobController:
                 await self._handle_hang(kind, job, rt, status_before)
                 return
 
-        # Arm (or re-arm) hang monitoring for a live runtime: covers a
-        # timeout enabled on an already-running job, and re-arms after
-        # the timer fired but real exits won the race (guarded by
-        # hang_armed, so a live timer is never duplicated).
+        # Arm (or re-arm) monitoring for a live runtime: covers policies
+        # enabled on an already-running job, and re-arms after a timer
+        # fired but lost its race (guarded by the armed flags, so live
+        # timers are never duplicated).
         self._schedule_hang_check(kind, job, rt)
+        self._schedule_metric_scaler(kind, job, rt)
 
         await self._sync_status(kind, job, rt, status_before)
 
@@ -329,14 +369,34 @@ class JobController:
             return False  # zero-replica job: nothing to run (suspended shape)
         if time.time() < self._backoff_until.get(job.key, 0.0):
             return False  # crash-loop backoff window; a timer re-enqueues us
-        try:
-            res = self.gang.try_admit(job)
-        except ValueError as e:
-            await self._fail_job(
-                kind, job, job.status.model_dump(mode="json"), "Unschedulable", str(e)
-            )
-            return False
         workers_override: Optional[int] = None
+        hint = self._resize_hints.pop(job.key, None)
+        res = None
+        if hint is not None:
+            # Metric-driven target size: admit there directly. An
+            # infeasible target (scaler clamped to a max beyond cluster
+            # capacity) or a capacity miss falls through to the normal
+            # paths — the autoscaler must never Fail a healthy job.
+            try:
+                res = self.gang.try_admit(job, replicas_override=hint)
+            except ValueError:
+                res = None
+            if res is not None:
+                workers_override = hint
+            else:
+                # A failed hint attempt queued a hint-SIZED pending
+                # entry; drop it so the spec-size re-queue below records
+                # the real demand (barrier/quota decisions read it).
+                self.gang.drop_pending(job.key)
+        if res is None:
+            try:
+                res = self.gang.try_admit(job)
+            except ValueError as e:
+                await self._fail_job(
+                    kind, job, job.status.model_dump(mode="json"),
+                    "Unschedulable", str(e),
+                )
+                return False
         if res is None and job.spec.elastic is not None:
             # Elastic reduced-size admission: form at the largest worker
             # count in [min_replicas, spec) that fits right now.
@@ -480,7 +540,91 @@ class JobController:
             job, reason, f"spawned {len(world)} workers, coordinator :{port}"
         )
         self._schedule_hang_check(kind, job, rt)
+        self._schedule_metric_scaler(kind, job, rt)
         return True
+
+    def _schedule_metric_scaler(
+        self, kind: str, job: TrainJob, rt: _JobRuntime
+    ) -> None:
+        """HPA-analog metric-driven elastic resize (reference: PyTorch
+        ElasticPolicy metrics drive an HPA on replica count). Polls the
+        lead worker's KFTPU-METRIC lines and applies
+        desired = ceil(current * value / target), clamped to the elastic
+        bounds; a changed target quiesces and re-forms the gang. The
+        CURRENT spec is re-read each fire so the policy can be retuned
+        or removed on a running job."""
+        el = job.spec.elastic
+        if el is None or el.metric is None or rt.metrics_armed:
+            return
+        rt.metrics_armed = True
+        loop = asyncio.get_running_loop()
+
+        def check() -> None:
+            import math
+
+            if self._runtimes.get(job.key) is not rt:
+                return  # re-formed runtime re-arms its own scaler
+            _, obj = self._find_job(job.namespace, job.name)
+            if obj is None:
+                rt.metrics_armed = False
+                return
+            cur = TrainJob.from_dict(obj)
+            el_now = cur.spec.elastic
+            if (el_now is None or el_now.metric is None
+                    or cur.status.phase.value in ("Succeeded", "Failed")):
+                rt.metrics_armed = False  # disabled live; reconcile re-arms
+                return
+            if not rt.workers:
+                # Per-replica-restart lull: the runtime survives; keep
+                # polling rather than silently stopping forever.
+                loop.call_later(el_now.metric_poll_seconds, check)
+                return
+            value = self._read_worker_metric(rt, el_now.metric)
+            if value is not None:
+                current = rt.formed_replicas or sum(
+                    1 for t, _ in rt.formed_world
+                    if t == ReplicaType.Worker.value
+                )
+                desired = math.ceil(current * value / el_now.target_value)
+                desired = max(el_now.min_replicas,
+                              min(desired, el_now.max_replicas))
+                if desired != current:
+                    rt.resize_to = desired
+                    self._enqueue(kind, job.namespace, job.name)
+                    return
+            loop.call_later(el_now.metric_poll_seconds, check)
+
+        loop.call_later(el.metric_poll_seconds, check)
+
+    def _read_worker_metric(
+        self, rt: _JobRuntime, metric: str
+    ) -> Optional[float]:
+        """Latest value of ``metric`` from any worker's KFTPU-METRIC
+        output (newest line wins; lead worker emits the throughput
+        metrics, so in practice this reads rank 0). Parsing is the shared
+        wire-format helper, the same one the HPO collector uses."""
+        from kubeflow_tpu.runtime.metrics import parse_metric_line
+
+        for ref in rt.workers.values():
+            lp = getattr(ref, "log_path", None)
+            if not lp:
+                continue
+            try:
+                with open(lp, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    f.seek(max(0, size - 16384))
+                    tail = f.read().decode("utf-8", errors="replace")
+            except OSError:
+                continue
+            for line in reversed(tail.splitlines()):
+                kv = parse_metric_line(line)
+                if kv and metric in kv:
+                    try:
+                        return float(kv[metric])
+                    except ValueError:
+                        break
+        return None
 
     def _materialize_hostfile(
         self, job: TrainJob,

@@ -100,7 +100,9 @@ class ControlPlane:
             self.store, log_dir=self.log_dir, obs_db=self.obs_db
         )
         self.isvc = ISVCController(
-            self.store, self.launcher, log_dir=self.log_dir, state_dir=state_dir
+            self.store, self.launcher, log_dir=self.log_dir,
+            state_dir=state_dir, gang=self.gang,
+            on_capacity_released=self.controller.kick_pending,
         )
         self.activator = Activator(self.isvc)
         self.platform = PlatformController(
@@ -183,6 +185,10 @@ class ControlPlane:
                 # Central-dashboard equivalent (P5): one page over /apis/.
                 web.get("/dashboard", self.h_dashboard),
                 web.get("/", self.h_dashboard),
+                # Katib-UI-equivalent experiment drill-down (K8): trial
+                # table + objective plot for one experiment.
+                web.get("/dashboard/experiment/{ns}/{name}",
+                        self.h_experiment_detail),
                 # KFAM-equivalent access management API (P7).
                 web.get("/kfam/v1/bindings", self.h_kfam_list),
                 web.post("/kfam/v1/bindings", self.h_kfam_add),
@@ -568,6 +574,122 @@ class ControlPlane:
         authorization included)."""
         return web.Response(text=_DASHBOARD_PAGE, content_type="text/html")
 
+    async def h_experiment_detail(self, req: web.Request) -> web.Response:
+        """Experiment drill-down (Katib UI analog, SURVEY.md 3.2 K8):
+        parameters, budget, per-trial assignments + objective values, the
+        optimal trial, and an inline SVG of objective vs. trial index."""
+        import html as _html
+
+        ns, name = req.match_info["ns"], req.match_info["name"]
+        raw = self.store.get("Experiment", name, ns)
+        if raw is None:
+            return web.Response(status=404, text="experiment not found")
+        spec = raw.get("spec", {})
+        status = raw.get("status", {})
+        objective = spec.get("objective", {})
+        metric = objective.get("objective_metric_name",
+                               objective.get("metric", "loss"))
+        goal_type = objective.get("type", "minimize")
+
+        from kubeflow_tpu.hpo.controller import EXPERIMENT_LABEL
+
+        trials = [
+            t for t in self.store.list("Trial")
+            if t["metadata"].get("namespace", "default") == ns
+            and t["metadata"].get("labels", {}).get(EXPERIMENT_LABEL) == name
+        ]
+        trials.sort(key=lambda t: t["metadata"]["name"])
+
+        from kubeflow_tpu.hpo.types import Trial as TrialModel
+
+        def trial_value(t):
+            # Canonical extraction (Observation.value_of / status.phase)
+            # so the page can never disagree with the API's view.
+            try:
+                return TrialModel.model_validate(t).status.observation \
+                    .value_of(metric)
+            except ValueError:
+                return None
+
+        def trial_phase(t):
+            try:
+                return TrialModel.model_validate(t).status.phase
+            except ValueError:
+                return "Pending"
+
+        rows = []
+        values = []
+        for i, t in enumerate(trials):
+            v = trial_value(t)
+            if v is not None:
+                values.append((i, float(v)))
+            assigns = ", ".join(
+                f"{k}={v}" for k, v in
+                t.get("spec", {}).get("assignments", {}).items()
+            )
+            rows.append(
+                f"<tr><td>{_html.escape(t['metadata']['name'])}</td>"
+                f"<td>{_html.escape(assigns)}</td>"
+                f"<td>{trial_phase(t)}</td>"
+                f"<td>{'' if v is None else f'{float(v):.6g}'}</td></tr>"
+            )
+
+        # Inline SVG scatter: objective vs trial index.
+        svg = ""
+        if values:
+            w, h, pad = 520, 160, 28
+            vs = [v for _, v in values]
+            vmin, vmax = min(vs), max(vs)
+            span = (vmax - vmin) or 1.0
+            n = max(len(trials) - 1, 1)
+            pts = []
+            for i, v in values:
+                x = pad + (w - 2 * pad) * i / n
+                y = h - pad - (h - 2 * pad) * (v - vmin) / span
+                pts.append(f'<circle cx="{x:.1f}" cy="{y:.1f}" r="3" '
+                           'fill="#36c"/>')
+            svg = (
+                f'<svg width="{w}" height="{h}" '
+                'style="background:#fff;border:1px solid #ccc">'
+                f'<text x="{pad}" y="14" font-size="11">{_html.escape(metric)}'
+                f' ({goal_type}); min={vmin:.6g} max={vmax:.6g}</text>'
+                + "".join(pts) + "</svg>"
+            )
+
+        optimal = status.get("current_optimal_trial", {})
+        opt_txt = ""
+        if optimal.get("name"):
+            opt_assigns = ", ".join(
+                f"{k}={v}" for k, v in optimal.get("assignments", {}).items()
+            )
+            opt_txt = (
+                f"<p><b>optimal:</b> {_html.escape(optimal['name'])} "
+                f"({_html.escape(opt_assigns)})</p>"
+            )
+        counts = " ".join(
+            f"{k.split('_', 1)[1]}={status.get(k, 0)}"
+            for k in ("trials_created", "trials_running",
+                      "trials_succeeded", "trials_failed",
+                      "trials_early_stopped")
+        )
+        page = (
+            "<!doctype html><html><head><title>experiment "
+            f"{_html.escape(name)}</title><style>"
+            "body{font-family:monospace;margin:2em;background:#fafafa}"
+            "table{border-collapse:collapse}"
+            "td,th{border:1px solid #ccc;padding:3px 8px;font-size:13px}"
+            "</style></head><body>"
+            f"<h1>experiment {_html.escape(ns)}/{_html.escape(name)}</h1>"
+            f"<p>algorithm: {_html.escape(str(spec.get('algorithm', {}).get('name', '?')))}"
+            f" · objective: {_html.escape(metric)} ({goal_type}) · {counts}</p>"
+            + opt_txt + svg +
+            "<h2>trials</h2><table><tr><th>trial</th><th>assignments</th>"
+            "<th>phase</th><th>" + _html.escape(metric) + "</th></tr>"
+            + "".join(rows) + "</table>"
+            '<p><a href="/dashboard">back</a></p></body></html>'
+        )
+        return web.Response(text=page, content_type="text/html")
+
     async def h_healthz(self, req: web.Request) -> web.Response:
         return web.json_response({"ok": True, "uptime": time.time() - self.started_at})
 
@@ -642,8 +764,12 @@ async function main(){
       const raw = o.status && o.status.url;
       const url = raw && /^https?:\\/\\//.test(raw)
         ? ' <a href="'+esc(raw)+'">open</a>' : "";
-      return "<tr><td>"+esc(o.metadata.namespace||"default")+"</td><td>"
-        +esc(o.metadata.name)+'</td><td class="'+esc(ph)+'">'
+      const ns = esc(o.metadata.namespace||"default");
+      let name = esc(o.metadata.name);
+      if (kind === "Experiment")  // drill-down: trials + objective plot
+        name = '<a href="dashboard/experiment/'+ns+'/'+name+'">'+name+'</a>';
+      return "<tr><td>"+ns+"</td><td>"
+        +name+'</td><td class="'+esc(ph)+'">'
         +esc(ph)+url+"</td></tr>";
     }).join("");
     root.innerHTML += "<h2>"+kind+" ("+items.length+")</h2>"

@@ -16,13 +16,17 @@ a scale-up, and replays once a replica reports ready -- the reference's
 activator->KPA cold-start path (SURVEY.md 7.4 #5).
 
 TPU note: replica processes on this host share the one visible chip; the
-jit compile cache makes the cold-start path survivable. Chip-capacity
-accounting for serving (contending with training gangs) is a later round.
+jit compile cache makes the cold-start path survivable. Replicas with
+``resources.tpu > 0`` reserve chips through the shared GangScheduler, so
+serving and training contend for the same pool: a serving scale-up
+queues behind pending training gangs (no backfill past their admission
+slot) and proceeds when capacity frees.
 """
 
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json
 import logging
 import math
@@ -106,6 +110,9 @@ class _Replica:
         # Component-spec fingerprint this replica was spawned from;
         # rollouts retire replicas whose fingerprint no longer matches.
         self.comp_fp = comp_fp
+        # Chip reservation key held in the shared GangScheduler (None
+        # when the component requests no TPU chips).
+        self.res_key: Optional[str] = None
 
     def info(self) -> ReplicaInfo:
         return ReplicaInfo(
@@ -154,10 +161,20 @@ class ISVCController:
         state_dir: Optional[str] = None,
         probe_interval: float = 0.25,
         autoscale_interval: float = 2.0,
+        gang=None,
+        on_capacity_released=None,
     ) -> None:
         self.store = store
         self.launcher = launcher
         self.log_dir = log_dir
+        # Shared chip-capacity model (controller/gang.py): serving
+        # replicas with resources.tpu > 0 reserve chips through it, so
+        # serving and training contend honestly for the same pool. None
+        # = unlimited (unit tests without a control plane).
+        self.gang = gang
+        # Called after a chip-holding replica is released, so the
+        # training reconciler can re-try its pending gangs.
+        self.on_capacity_released = on_capacity_released
         self.state_dir = state_dir or "."
         self.probe_interval = probe_interval
         self.autoscale_interval = autoscale_interval
@@ -166,6 +183,11 @@ class ISVCController:
         # the real host:port at startup).
         self.base_url = "http://127.0.0.1:7450"
         self.services: Dict[str, _Service] = {}
+        # Monotonic suffix for chip-reservation keys: replica indices
+        # restart per service generation (canary sets, promotions), so a
+        # bare key would collide with a still-held reservation of an
+        # adopted replica and corrupt chip accounting.
+        self._res_seq = itertools.count()
         self._queue: asyncio.Queue = asyncio.Queue()
         self._queued: set = set()
         self._stopped = asyncio.Event()
@@ -357,10 +379,19 @@ class ISVCController:
         }]
         self.store.put(KIND, raw)
 
+    def _release_chips(self, rep: Optional[_Replica]) -> None:
+        if rep is None or rep.res_key is None or self.gang is None:
+            return
+        self.gang.release(rep.res_key)
+        rep.res_key = None
+        if self.on_capacity_released is not None:
+            self.on_capacity_released()
+
     async def _retire_replica(self, key: str, svc: _Service, index: int,
                               drain: bool = True) -> None:
         """THE one way a replica leaves a set: popped from the service,
-        probe task cancelled, then drained (graceful) or killed (hard)."""
+        probe task cancelled, then drained (graceful) or killed (hard);
+        its chip reservation returns to the shared pool once dead."""
         rep = svc.replicas.pop(index, None)
         t = self._probe_tasks.pop(f"{key}#{index}", None)
         if t:
@@ -372,6 +403,7 @@ class ISVCController:
         else:
             rep.ready = False
             await self.launcher.kill(rep.ref)
+            self._release_chips(rep)
 
     async def _drain_replicas(self, key: str, svc: _Service) -> None:
         """Drain every replica of a set: out of rotation immediately,
@@ -449,14 +481,53 @@ class ISVCController:
         retiring = {
             i: r for i, r in svc.replicas.items() if r.comp_fp != comp_fp
         }
-        # Scale up the current revision.
+        # Scale up the current revision. Chip-requesting replicas go
+        # through the shared capacity model first: a refused reservation
+        # stops the scale-up (the autoscale tick retries as capacity
+        # frees), so serving queues behind training gangs honestly.
+        chips = comp.resources.tpu
         while len(current) < svc.desired:
             index = svc.next_index
+            res_key = None
+            if self.gang is not None and chips > 0:
+                res_key = f"{key}#r{index}.{next(self._res_seq)}"
+                if not self.gang.try_reserve(res_key, chips):
+                    # Retire an old replica ONLY when the refusal is a
+                    # genuine capacity shortage with nobody queued ahead:
+                    # on a pending-gang barrier the freed chips would go
+                    # to the gang, not the rollout — draining the healthy
+                    # old revision would be a self-inflicted outage.
+                    starved = self.gang.free_chips < chips
+                    if retiring and starved and not self.gang.pending():
+                        # Our own old revision holds the chips the new
+                        # one needs: fall back to destroy-before-create
+                        # for one replica (a capacity-constrained
+                        # rollout cannot be gapless); its drained chips
+                        # admit the next attempt.
+                        idx = sorted(retiring)[0]
+                        retiring.pop(idx)
+                        await self._retire_replica(key, svc, idx)
+                        logger.info(
+                            "isvc %s: retiring old-revision replica %d "
+                            "to free chips for the rollout", key, idx,
+                        )
+                    else:
+                        logger.info(
+                            "isvc %s: waiting for %d chips (free: %d)",
+                            key, chips, self.gang.free_chips,
+                        )
+                    break
             svc.next_index += 1
             port = allocate_port()
             req = self._spawn_request(isvc, comp, index, port, key)
-            ref = await self.launcher.spawn(req)
+            try:
+                ref = await self.launcher.spawn(req)
+            except Exception:
+                if res_key is not None:
+                    self.gang.release(res_key)
+                raise
             rep = _Replica(index, port, ref, comp_fp=comp_fp)
+            rep.res_key = res_key
             svc.replicas[index] = rep
             current[index] = rep
             probe_key = f"{key}#{index}"
@@ -495,6 +566,7 @@ class ISVCController:
             while rep.in_flight > 0 and time.monotonic() < deadline:
                 await asyncio.sleep(0.1)
             await self.launcher.kill(rep.ref)
+            self._release_chips(rep)
             logger.info("isvc %s: reaped replica %d (drained)", key, rep.index)
 
         asyncio.create_task(drain())
@@ -616,6 +688,7 @@ class ISVCController:
             return known
         svc.replicas.pop(index, None)
         self._probe_tasks.pop(f"{key}#{index}", None)
+        self._release_chips(rep)
         if not svc.ready_replicas():
             svc.ready_event.clear()
         svc.failure_count += 1
@@ -713,6 +786,15 @@ class ISVCController:
                     comp = _governing_predictor(parsed)
                 if comp is None:
                     continue
+                if svc.desired > len(svc.replicas) and not any(
+                    c.get("type") == "Failed" and c.get("status")
+                    for c in raw.get("status", {}).get("conditions", [])
+                ):
+                    # Chip-starved (scale-up stopped at a refused
+                    # reservation): retry — training may have released.
+                    # A Failed service (e.g. can-never-fit chip request)
+                    # stays down until its spec changes.
+                    self._enqueue(ns, name)
                 want = math.ceil(svc.in_flight / comp.target_concurrency)
                 want = min(max(want, comp.min_replicas), comp.max_replicas)
                 idle = time.time() - svc.last_request

@@ -201,13 +201,16 @@ def _layer_forward(cfg: LlamaConfig, lp: dict, x, freqs, positions, mask):
     return x + _ffn(cfg, lp, h), k, v
 
 
-def _prefill(cfg: LlamaConfig, w: dict, tokens, length):
-    """Causal self-attention over one padded prompt [1, S].
+def _prefill(cfg: LlamaConfig, w: dict, tokens, lengths):
+    """Causal self-attention over a BATCH of padded prompts [K, S].
 
-    Returns (next_token_logits [1, V], k_seq, v_seq [L, 1, S, KV, D]).
+    Prefilling K admitted requests in one program amortizes both the
+    per-dispatch host->device roundtrip and the MXU's preference for
+    bigger batches over the serial [1, S] case. Returns
+    (next_token_logits [K, V], k_seq, v_seq [L, K, S, KV, D]).
     """
 
-    s = tokens.shape[1]
+    k_rows, s = tokens.shape
     positions = jnp.arange(s)[None, :]
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     x = w["embed"][tokens]
@@ -219,21 +222,26 @@ def _prefill(cfg: LlamaConfig, w: dict, tokens, length):
 
     x, (ks, vs) = jax.lax.scan(body, x, w["layers"])
     x = _rms(x, w["final_scale"], cfg.norm_eps)
-    # Logits only for the last real token (length-1): one row of lm_head.
-    last = jax.lax.dynamic_index_in_dim(x, length - 1, axis=1, keepdims=False)
+    # Logits only for each row's last real token (lengths[k]-1).
+    last = x[jnp.arange(k_rows), lengths - 1]  # [K, H]
     logits = (last.astype(jnp.float32) @ w["lm_head"].astype(jnp.float32))
     return logits, ks, vs
 
 
-def _insert(cache_k, cache_v, k_seq, v_seq, slot):
-    """Write a prefilled sequence into cache slot ``slot``.
+def _insert(cache_k, cache_v, k_seq, v_seq, slots):
+    """Write K prefilled sequences into cache slots ``slots`` [K].
 
-    cache [L,B,Smax,KV,D]; k_seq [L,1,S,KV,D]. Donated buffers."""
+    cache [L,B,Smax,KV,D]; k_seq [L,K,S,KV,D] with S <= Smax (the
+    prefill bucket). Donated buffers; one scatter per cache instead of
+    K dynamic-update dispatches. Dummy rows (K padded up to its bucket)
+    carry an out-of-range slot index and are DROPPED by the scatter, so
+    every input keeps its bucketed shape — compile count stays
+    O(K-buckets x len-buckets), not O(max_slots x len-buckets)."""
 
-    start = (0, slot, 0, 0, 0)
+    s = k_seq.shape[2]
     return (
-        jax.lax.dynamic_update_slice(cache_k, k_seq, start),
-        jax.lax.dynamic_update_slice(cache_v, v_seq, start),
+        cache_k.at[:, slots, :s].set(k_seq, mode="drop"),
+        cache_v.at[:, slots, :s].set(v_seq, mode="drop"),
     )
 
 
@@ -560,13 +568,19 @@ class GenerationEngine:
 
         self._decode_block_call = decode_block_call
 
-        def _insert_pinned(cache_k, cache_v, k_seq, v_seq, slot):
-            ck, cv = _insert(cache_k, cache_v, k_seq, v_seq, slot)
+        def _insert_pinned(cache_k, cache_v, k_seq, v_seq, slots):
+            ck, cv = _insert(cache_k, cache_v, k_seq, v_seq, slots)
             return _pin(ck), _pin(cv)
 
         insert_jit = jax.jit(_insert_pinned, donate_argnums=(0, 1))
         sample_jit = jax.jit(_sample)
-        self._prefill = lambda tokens, n: prefill_jit(self.weights, tokens, n)
+
+        def _prefill_call(tokens, lengths):
+            # Accept a scalar for the single-prompt case (tests/oracles).
+            lengths = jnp.atleast_1d(jnp.asarray(lengths, jnp.int32))
+            return prefill_jit(self.weights, tokens, lengths)
+
+        self._prefill = _prefill_call
         self._insert = insert_jit
         self._sample = sample_jit
         self._thread: Optional[threading.Thread] = None
@@ -603,29 +617,56 @@ class GenerationEngine:
         return sub
 
     def _admit(self) -> None:
+        """Admit pending requests into free slots, prefilling them in
+        BATCHES: all admissible prompts pad to one (K-bucket x len-bucket)
+        shape and run as a single device program, then one scatter writes
+        every sequence's KV into its slot. Serial per-prompt prefill was
+        the throughput bottleneck at high request rates (one dispatch +
+        an underfilled MXU per prompt)."""
         while self.free_slots and not self.pending.empty():
-            try:
-                req = self.pending.get_nowait()
-            except queue.Empty:
+            reqs: List[Request] = []
+            while len(reqs) < len(self.free_slots):
+                try:
+                    req = self.pending.get_nowait()
+                except queue.Empty:
+                    break
+                if req.future.cancelled():
+                    continue
+                reqs.append(req)
+            if not reqs:
                 return
-            if req.future.cancelled():
-                continue
-            slot = self.free_slots.pop()
-            n = len(req.prompt)
-            bucket = self._bucket(n)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :n] = req.prompt
-            logits, ks, vs = self._prefill(jnp.asarray(padded), n)
+            k_real = len(reqs)
+            kbucket = 1
+            while kbucket < k_real:
+                kbucket *= 2
+            bucket = max(self._bucket(len(r.prompt)) for r in reqs)
+            padded = np.zeros((kbucket, bucket), np.int32)
+            lengths = np.ones(kbucket, np.int32)  # dummy rows: 1 token
+            for j, r in enumerate(reqs):
+                padded[j, : len(r.prompt)] = r.prompt
+                lengths[j] = len(r.prompt)
+            logits, ks, vs = self._prefill(jnp.asarray(padded), lengths)
+            slots = [self.free_slots.pop() for _ in reqs]
+            # Keep kbucket shapes end-to-end (bounded compile count):
+            # dummy rows scatter to an out-of-range slot (dropped) and
+            # sample greedily into a discarded lane.
+            padded_slots = np.full(kbucket, self.max_slots, np.int32)
+            padded_slots[:k_real] = slots
             self.cache_k, self.cache_v = self._insert(
-                self.cache_k, self.cache_v, ks, vs, slot
+                self.cache_k, self.cache_v, ks, vs,
+                jnp.asarray(padded_slots),
             )
-            first = self._sample(
-                logits, self._next_rng(), jnp.array([req.temperature], jnp.float32)
-            )
-            req.slot = slot
-            self.lengths[slot] = n
-            self.active[slot] = req
-            self._emit(req, int(first[0]))
+            temps = np.zeros(kbucket, np.float32)
+            for j, r in enumerate(reqs):
+                temps[j] = r.temperature
+            first = np.asarray(self._sample(
+                logits, self._next_rng(), jnp.asarray(temps)
+            ))
+            for j, (req, slot) in enumerate(zip(reqs, slots)):
+                req.slot = slot
+                self.lengths[slot] = len(req.prompt)
+                self.active[slot] = req
+                self._emit(req, int(first[j]))
 
     def _emit(self, req: Request, token: int) -> None:
         req.generated.append(token)

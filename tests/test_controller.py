@@ -896,3 +896,53 @@ class TestMPIJobSpawnRace:
                 await h.wait_phase("m3", "Succeeded", kind="MPIJob")
 
         asyncio.run(run())
+
+
+class TestMetricDrivenElastic:
+    def test_hpa_formula_resizes_gang(self):
+        """Reference parity: ElasticPolicy metrics drive replica count
+        (HPA analog). desired = ceil(current * value / target) clamped to
+        [min, max]; a change quiesces and re-forms the gang."""
+
+        async def run():
+            from kubeflow_tpu.api import ElasticPolicy
+
+            async with Harness(total_chips=8) as h:
+                vals = {"v": 300.0}
+                h.ctl._read_worker_metric = lambda rt, m: vals["v"]
+                job = make_job(
+                    "hpa", replicas=2, tpu=1,
+                    elastic=ElasticPolicy(
+                        min_replicas=1, max_replicas=4, max_restarts=5,
+                        metric="queue_depth", target_value=100.0,
+                        metric_poll_seconds=0.05,
+                    ),
+                )
+                h.submit(job)
+                await h.wait_phase("hpa", "Running")
+                # ceil(2 * 300/100) = 6 -> clamped to max 4.
+                await h.wait(
+                    lambda: (lambda j: j is not None
+                             and j.status.formed_replicas == 4)(h.job("hpa")),
+                    msg="metric scale-up to 4",
+                )
+                # Steady at 4 (ceil(4*3)=12 -> clamp 4 == current).
+                vals["v"] = 25.0  # ceil(4 * 25/100) = 1
+                await h.wait(
+                    lambda: (lambda j: j is not None
+                             and j.status.formed_replicas == 1)(h.job("hpa")),
+                    msg="metric scale-down to 1",
+                )
+                reasons = [
+                    e["reason"] for e in h.store.list("Event")
+                    if e.get("involved") == "default/hpa"
+                ]
+                assert "ElasticMetricResize" in reasons, reasons
+                envs = [
+                    dict(r.env) for r in h.launcher.spawned
+                    if r.job_key == "default/hpa"
+                ]
+                # Last formed world has 1 process.
+                assert envs[-1]["JAX_NUM_PROCESSES"] == "1"
+
+        asyncio.run(run())

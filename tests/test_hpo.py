@@ -804,3 +804,89 @@ def test_validate_metrics_collector():
         "kind": "prometheus", "url": "http://127.0.0.1:9/m"
     }
     validate_experiment(Experiment.from_dict(exp))
+
+
+def test_experiment_dashboard_drilldown(tmp_path):
+    """Katib-UI analog (K8): the per-experiment dashboard page renders
+    trial assignments, phases, objective values, the optimal trial, and
+    an objective plot — straight from stored objects."""
+
+    async def run():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from kubeflow_tpu.hpo.controller import EXPERIMENT_LABEL
+        from kubeflow_tpu.server.app import ControlPlane
+
+        cp = ControlPlane(str(tmp_path / "state"), total_chips=8)
+        client = TestClient(TestServer(cp.build_app()))
+        await client.start_server()
+        try:
+            cp.store.put("Experiment", {
+                "kind": "Experiment",
+                "metadata": {"name": "sweep"},
+                "spec": {
+                    "objective": {"type": "minimize",
+                                  "objective_metric_name": "loss"},
+                    "algorithm": {"name": "tpe"},
+                    "parameters": [
+                        {"name": "lr", "type": "double",
+                         "min": 0.001, "max": 0.1}
+                    ],
+                    "trial_template": {"job": {
+                        "kind": "JAXJob",
+                        "metadata": {"name": "t"},
+                        "spec": {"replica_specs": {"Worker": {
+                            "replicas": 1,
+                            "template": {"entrypoint": "x"},
+                        }}},
+                    }},
+                },
+                "status": {
+                    "trials_succeeded": 2,
+                    "current_optimal_trial": {
+                        "name": "sweep-t0001",
+                        "assignments": {"lr": 0.01},
+                        "observation": {"metrics": [
+                            {"name": "loss", "latest": 0.1,
+                             "min": 0.1, "max": 0.2}
+                        ]},
+                    },
+                },
+            })
+            for i, loss in enumerate([0.5, 0.1]):
+                cp.store.put("Trial", {
+                    "kind": "Trial",
+                    "metadata": {
+                        "name": f"sweep-t{i:04d}",
+                        "labels": {EXPERIMENT_LABEL: "sweep"},
+                    },
+                    "spec": {
+                        "experiment": "sweep",
+                        "assignments": {"lr": 0.01 * (i + 1)},
+                        "job": {},
+                    },
+                    "status": {
+                        "conditions": [{"type": "Succeeded", "status": True,
+                                        "reason": "", "message": "",
+                                        "last_transition": 0.0}],
+                        "observation": {"metrics": [
+                            {"name": "loss", "latest": loss,
+                             "min": loss, "max": loss}
+                        ]},
+                    },
+                })
+            r = await client.get("/dashboard/experiment/default/sweep")
+            assert r.status == 200
+            page = await r.text()
+            assert "sweep-t0000" in page and "sweep-t0001" in page
+            assert "lr=0.02" in page      # assignments rendered
+            assert "0.5" in page and "0.1" in page  # objective values
+            assert "optimal:" in page and "sweep-t0001" in page
+            assert "<svg" in page          # objective plot present
+            assert "tpe" in page
+            r = await client.get("/dashboard/experiment/default/nope")
+            assert r.status == 404
+        finally:
+            await client.close()
+
+    asyncio.run(run())

@@ -470,3 +470,64 @@ def test_canary_rollback(cp_client):
             == json.dumps({"tag": "v1"})
 
     loop.run_until_complete(run())
+
+
+def test_serving_queues_behind_training_for_chips(cp_client, tmp_path):
+    """Serving/training chip contention (shared GangScheduler): an ISVC
+    whose replica requests chips cannot scale up while a training gang
+    holds the pool, and proceeds as soon as the gang releases."""
+    cp, client, loop = cp_client
+
+    # A worker that just sleeps: holds its gang's chips until deleted.
+    (tmp_path / "sleeper.py").write_text(
+        "import time\nprint('up', flush=True)\ntime.sleep(120)\n"
+    )
+
+    async def run():
+        job = {
+            "kind": "JAXJob",
+            "metadata": {"name": "hog"},
+            "spec": {"replica_specs": {"Worker": {
+                "replicas": 1,
+                "resources": {"tpu": 8},  # the whole pool
+                "template": {
+                    "entrypoint": "sleeper",
+                    "env": {"PYTHONPATH": str(tmp_path)},
+                },
+            }}},
+        }
+        r = await client.post("/apis/JAXJob", json=job)
+        assert r.status == 200, await r.text()
+        await wait_for(lambda: cp.gang.free_chips == 0, msg="gang admitted")
+
+        d = isvc("chippy")
+        d["spec"]["predictor"]["resources"] = {"tpu": 4}
+        r = await client.post("/apis/InferenceService", json=d)
+        assert r.status == 200, await r.text()
+        # Starved: no replica can spawn while the gang holds the pool.
+        await asyncio.sleep(1.0)
+        svc = cp.isvc.services.get("default/chippy")
+        assert svc is not None and not svc.replicas, (
+            svc.replicas if svc else None
+        )
+        assert cp.gang.free_chips == 0
+
+        # Training job deleted -> chips release -> serving proceeds.
+        r = await client.delete("/apis/JAXJob/default/hog")
+        assert (await r.json())["deleted"]
+        await wait_for(
+            lambda: _status(cp, "chippy").get("predictor", {}).get(
+                "ready_replicas"),
+            timeout=30.0, msg="ISVC ready after release",
+        )
+        assert cp.gang.free_chips == 4  # 8 - serving's 4
+        # Reservation is visible in the shared model under the replica key.
+        assert any(
+            k.startswith("default/chippy#r")
+            for k in cp.gang._reserved
+        )
+        # Deleting the ISVC returns the chips.
+        await client.delete("/apis/InferenceService/default/chippy")
+        await wait_for(lambda: cp.gang.free_chips == 8, msg="chips back")
+
+    loop.run_until_complete(run())
