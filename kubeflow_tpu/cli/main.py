@@ -40,6 +40,8 @@ KIND_ALIASES = {
     "trial": "Trial", "trials": "Trial",
     "inferenceservice": "InferenceService", "inferenceservices": "InferenceService",
     "isvc": "InferenceService",
+    "trainedmodel": "TrainedModel", "trainedmodels": "TrainedModel",
+    "tm": "TrainedModel",
     "pipeline": "Pipeline", "pipelines": "Pipeline", "pl": "Pipeline",
     "inferencegraph": "InferenceGraph", "inferencegraphs": "InferenceGraph",
     "ig": "InferenceGraph",

@@ -247,6 +247,16 @@ class ControlPlane:
             validate_isvc(isvc)
             return isvc.to_dict()
 
+        def parse_trained_model(o):
+            from kubeflow_tpu.serving.types import (
+                TrainedModel,
+                validate_trained_model,
+            )
+
+            tm = TrainedModel.from_dict(o)
+            validate_trained_model(tm)
+            return tm.to_dict()
+
         def parse_profile(o):
             prof = Profile.from_dict(o)
             validate_profile(prof)
@@ -281,6 +291,7 @@ class ControlPlane:
             parse_job if kind in JOB_KINDS
             else {"Experiment": parse_experiment,
                   "InferenceService": parse_isvc,
+                  "TrainedModel": parse_trained_model,
                   "Profile": parse_profile,
                   "PodDefault": parse_pod_default,
                   "Pipeline": parse_pipeline,
@@ -733,7 +744,8 @@ th{background:#eee}
 <div id="err"></div><div id="root">loading...</div>
 <script>
 const KINDS = ["JAXJob","TFJob","PyTorchJob","MPIJob","XGBoostJob",
-  "PaddleJob","Experiment","Trial","InferenceService","Pipeline",
+  "PaddleJob","Experiment","Trial","InferenceService","TrainedModel",
+  "Pipeline",
   "Notebook","Tensorboard","Profile","PodDefault"];
 const PHASE_ORDER = ["Failed","Succeeded","Suspended","Restarting",
   "Running","Ready","Unready","Created"];

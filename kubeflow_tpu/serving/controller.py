@@ -31,6 +31,7 @@ import json
 import logging
 import math
 import os
+import re
 import time
 from typing import Dict, List, Optional
 
@@ -40,6 +41,7 @@ from aiohttp import web
 from kubeflow_tpu.controller.launcher import BaseLauncher, SpawnRequest, WorkerRef
 from kubeflow_tpu.serving.types import (
     KIND,
+    TRAINED_MODEL_KIND,
     ComponentSpec,
     ComponentStatus,
     InferenceService,
@@ -48,8 +50,10 @@ from kubeflow_tpu.serving.types import (
     ReplicaState,
     RUNTIMES,
     ServingValidationError,
+    TrainedModel,
     set_condition,
     validate_isvc,
+    validate_trained_model,
 )
 from kubeflow_tpu.utils.ports import allocate_port
 
@@ -145,6 +149,13 @@ class _Service:
         # Promoted canary replicas keep their original spawn job_key;
         # exit lookups resolve through these aliases.
         self.adopted_keys: set = set()
+        # Multi-model placement (ModelMesh analog): model name -> the
+        # replica index currently holding it, plus the spec fingerprint
+        # each placed model was loaded from (spec changes force reload).
+        self.model_locations: Dict[str, int] = {}
+        self.model_spec_fps: Dict[str, str] = {}
+        # Consecutive failed placement rounds (drives retry backoff).
+        self.placement_failures: int = 0
 
     def ready_replicas(self) -> List[_Replica]:
         return [r for r in self.replicas.values() if r.ready]
@@ -193,6 +204,10 @@ class ISVCController:
         self._stopped = asyncio.Event()
         self._http: Optional[aiohttp.ClientSession] = None
         self._probe_tasks: Dict[str, asyncio.Task] = {}
+        # Multi-model placement tasks (one live per service) + services
+        # that asked for another round while one was running.
+        self._placement_tasks: Dict[str, asyncio.Task] = {}
+        self._placement_pending: set = set()
 
     # -- loop -------------------------------------------------------------
 
@@ -201,9 +216,11 @@ class ISVCController:
             timeout=aiohttp.ClientTimeout(total=600)
         )
         watch_q = self.store.watch(KIND)
+        tm_q = self.store.watch(TRAINED_MODEL_KIND)
         for obj in self.store.list(KIND):
             self._enqueue(obj["metadata"]["namespace"], obj["metadata"]["name"])
         watcher = asyncio.create_task(self._pump_watch(watch_q))
+        tm_watcher = asyncio.create_task(self._pump_tm_watch(tm_q))
         scaler = asyncio.create_task(self._autoscale_loop())
         try:
             while not self._stopped.is_set():
@@ -223,8 +240,12 @@ class ISVCController:
                         logger.exception("reconcile %s failed", key)
         finally:
             watcher.cancel()
+            tm_watcher.cancel()
             scaler.cancel()
+            for t in self._placement_tasks.values():
+                t.cancel()
             self.store.unwatch(watch_q)
+            self.store.unwatch(tm_q)
             for t in self._probe_tasks.values():
                 t.cancel()
             for key in list(self.services):
@@ -238,6 +259,26 @@ class ISVCController:
         while True:
             ev = await q.get()
             self._enqueue(ev.namespace, ev.name)
+
+    async def _pump_tm_watch(self, q: asyncio.Queue) -> None:
+        """A TrainedModel change re-reconciles the InferenceService whose
+        replica pool serves it (DELETED events carry the last object
+        snapshot, so the target is always readable). A RETARGETED model
+        also re-reconciles its previous pool so the stray copy unloads."""
+        last_target: Dict[str, str] = {}
+        while True:
+            ev = await q.get()
+            tm_key = f"{ev.namespace}/{ev.name}"
+            target = (ev.obj or {}).get("spec", {}).get("inference_service")
+            prev = last_target.get(tm_key)
+            if str(getattr(ev, "type", "")).endswith("DELETED"):
+                last_target.pop(tm_key, None)
+            elif target:
+                last_target[tm_key] = target
+            if target:
+                self._enqueue(ev.namespace, target)
+            if prev and prev != target:
+                self._enqueue(ev.namespace, prev)
 
     def _enqueue(self, ns: str, name: str) -> None:
         key = f"{ns}/{name}"
@@ -253,11 +294,26 @@ class ISVCController:
         ckey = key + CANARY_SUFFIX
         raw = self.store.get(KIND, name, ns)
         if raw is None:
-            # Deleted: tear down replicas (all component sets).
+            # Deleted: tear down replicas (all component sets); any
+            # models placed on them are no longer served. An in-flight
+            # placement round must die with the service, or it would
+            # re-mark TrainedModels Loaded after this teardown.
+            t = self._placement_tasks.pop(key, None)
+            if t is not None:
+                t.cancel()
+            self._placement_pending.discard(key)
             for k in (key, tkey, ckey):
-                if k in self.services:
-                    await self._scale_to(k, 0)
-                    self.services.pop(k, None)
+                svc = self.services.get(k)
+                if svc is None:
+                    continue
+                for mname in list(svc.model_locations):
+                    svc.model_locations.pop(mname, None)
+                    self._write_tm_status(
+                        ns, mname, loaded=False, replica_index=None,
+                        url=None,
+                    )
+                await self._scale_to(k, 0)
+                self.services.pop(k, None)
             return
         try:
             isvc = InferenceService.from_dict(raw)
@@ -351,6 +407,11 @@ class ISVCController:
                 logger.exception("isvc %s: converge failed", skey)
                 self._write_failed(ns, name, "SpawnError", str(e))
                 return
+        if isvc.spec.predictor.multi_model is not None:
+            # Placement runs as a background task: a slow model load
+            # (up to 120s per call) must not head-of-line-block the
+            # shared reconcile loop for every other service.
+            self._spawn_placement(ns, name, isvc.spec.predictor)
         if not crash_looped:
             self._write_status(
                 isvc, self.services[key], self.services.get(tkey),
@@ -379,6 +440,280 @@ class ISVCController:
         }]
         self.store.put(KIND, raw)
 
+    async def _reconcile_models(self, ns: str, name: str,
+                                comp: ComponentSpec, svc: _Service) -> None:
+        """ModelMesh-style placement (S7): converge the set of
+        TrainedModels targeting this multi-model ISVC onto its ready
+        replicas. Level-triggered against what each replica ACTUALLY has
+        loaded (its /healthz model list) — controller-side bookkeeping
+        alone would drift the first time a replica's LRU evicts.
+        Placement is budget-aware rendezvous hashing: each model's
+        replica preference order is stable, but a replica at its
+        max_models_per_replica budget is skipped, so placement never
+        oversubscribes a replica into eviction thrash."""
+        import zlib
+
+        budget = (comp.multi_model.max_models_per_replica
+                  if comp.multi_model else 1)
+        tms = []
+        for raw in self.store.list(TRAINED_MODEL_KIND, ns):
+            try:
+                tm = TrainedModel.from_dict(raw)
+                validate_trained_model(tm)
+            except (ValueError, ServingValidationError):
+                continue
+            if tm.spec.inference_service == name:
+                tms.append(tm)
+        tms.sort(key=lambda t: t.metadata.name)
+        # A model of a different format would be constructed by the
+        # POOL's runtime and silently return wrong results — reject.
+        pool_format = comp.model.format if comp.model else None
+        mismatched = [
+            tm for tm in tms if tm.spec.model.format != pool_format
+        ]
+        for tm in mismatched:
+            logger.warning(
+                "TrainedModel %s/%s format %s != pool runtime %s; "
+                "not placing", ns, tm.metadata.name,
+                tm.spec.model.format, pool_format,
+            )
+            self._write_tm_status(
+                ns, tm.metadata.name, loaded=False,
+                replica_index=None, url=None,
+            )
+        tms = [tm for tm in tms if tm.spec.model.format == pool_format]
+        ready = sorted(i for i, r in svc.replicas.items() if r.ready)
+        if not ready:
+            # Nothing serves anymore (e.g. scaled to zero): statuses
+            # must say so — a stale loaded=true with a dead url misleads
+            # anything polling TrainedModels.
+            for mname in list(svc.model_locations):
+                self._write_tm_status(
+                    ns, mname, loaded=False, replica_index=None, url=None
+                )
+            svc.model_locations.clear()
+            return  # probes enqueue us again when a replica readies
+
+        # Ground truth: what each ready replica holds right now
+        # (concurrent probes: one wedged replica must not stall the
+        # whole reconcile loop serially). A replica whose probe failed
+        # is left out of this placement round entirely.
+        probes = await asyncio.gather(
+            *(self._replica_models(svc, i) for i in ready)
+        )
+        actual: Dict[int, set] = {
+            i: models for i, models in zip(ready, probes)
+            if models is not None
+        }
+        # Spec-change unloads may only be trusted as complete when every
+        # replica answered — a stale copy could hide on an unprobed one.
+        full_coverage = len(actual) == len(ready)
+        ready = sorted(actual)
+        if not ready:
+            # Every probe failed this round: retry, or placement stalls
+            # until some unrelated event arrives.
+            asyncio.get_running_loop().call_later(
+                2.0, self._enqueue, ns, name
+            )
+            return
+
+        # A model whose SPEC changed must reload even though its name is
+        # already on the target replica (the copy there was built from
+        # the old spec). The recorded fingerprint only advances once the
+        # stale copies are really gone — otherwise a failed unload would
+        # leave the old revision serving forever while marked current.
+        spec_change_failed = False
+        for tm in tms:
+            mname = tm.metadata.name
+            fp = json.dumps(
+                tm.spec.model.model_dump(mode="json"), sort_keys=True
+            )
+            if svc.model_spec_fps.get(mname) not in (None, fp):
+                cleared = full_coverage
+                for i in ready:
+                    if mname in actual[i]:
+                        if await self._model_call(svc, i, mname, "unload"):
+                            actual[i].discard(mname)
+                        else:
+                            cleared = False
+                if not cleared:
+                    spec_change_failed = True
+                    continue  # keep old fp; retried next round
+            svc.model_spec_fps[mname] = fp
+        for stale in set(svc.model_spec_fps) - {
+            tm.metadata.name for tm in tms
+        }:
+            svc.model_spec_fps.pop(stale, None)
+
+        # Budget-aware rendezvous placement.
+        counts = {i: 0 for i in ready}
+        placements: Dict[str, int] = {}
+        for tm in tms:
+            mname = tm.metadata.name
+            order = sorted(
+                ready,
+                key=lambda i: zlib.crc32(f"{mname}@{i}".encode()),
+            )
+            target = next(
+                (i for i in order if counts[i] < budget), None
+            )
+            if target is None:
+                self._write_tm_status(
+                    ns, mname, loaded=False, replica_index=None,
+                    url=None,
+                )
+                continue
+            counts[target] += 1
+            placements[mname] = target
+
+        # Unload strays (deleted models, or copies on the wrong replica)
+        # BEFORE loading, so LRU budgets free up first.
+        stray_calls = [
+            self._model_call(svc, i, mname, "unload")
+            for i in ready
+            for mname in sorted(actual[i])
+            if placements.get(mname) != i
+        ]
+        stray_failed = False
+        if stray_calls:
+            stray_results = await asyncio.gather(*stray_calls)
+            # A failed stray unload keeps holding an LRU slot (and its
+            # model memory) — it must be retried like a failed load.
+            stray_failed = not all(stray_results)
+
+        # Load what's missing (concurrently — loads mostly land on
+        # different replicas); record truth-backed locations.
+        async def place(tm) -> tuple[str, Optional[int], bool]:
+            mname = tm.metadata.name
+            target = placements.get(mname)
+            if target is None:
+                return mname, None, False
+            ok = True
+            if mname not in actual[target]:
+                ok = await self._model_call(
+                    svc, target, mname, "load",
+                    body={
+                        "storage_uri": tm.spec.model.storage_uri,
+                        "options": tm.spec.model.options,
+                    },
+                )
+            return mname, target, bool(ok)
+
+        results = await asyncio.gather(
+            *(place(tm) for tm in tms if tm.metadata.name in placements)
+        )
+        locations: Dict[str, int] = {}
+        any_failed = False
+        for mname, target, ok in results:
+            if ok and target is not None:
+                locations[mname] = target
+            else:
+                any_failed = True
+            self._write_tm_status(
+                ns, mname, loaded=ok,
+                replica_index=target if ok else None,
+                url=(f"/serving/{ns}/{name}/v2/models/{mname}/infer"
+                     if ok else None),
+            )
+        svc.model_locations = locations
+        if spec_change_failed or stray_failed:
+            any_failed = True
+        if any_failed:
+            # A transiently failed load writes an identical LoadFailed
+            # status next round (no-op, no watch event) — without an
+            # explicit requeue nothing would ever retry it. Exponential
+            # backoff (2s..60s) so a permanently bad model does not
+            # hammer the replicas' serialized load lock forever.
+            svc.placement_failures += 1
+            delay = min(2.0 * (2 ** min(svc.placement_failures - 1, 5)),
+                        60.0)
+            asyncio.get_running_loop().call_later(
+                delay, self._enqueue, ns, name
+            )
+        else:
+            svc.placement_failures = 0
+
+    async def _replica_models(self, svc: _Service,
+                              index: int) -> Optional[set]:
+        """Model names loaded on a replica, or None when the probe fails
+        — a failed probe must NOT read as 'holds nothing', or the
+        controller would evict-and-rebuild healthy models on a replica
+        that was merely slow for one probe."""
+        rep = svc.replicas.get(index)
+        if rep is None:
+            return None
+        try:
+            async with self._http.get(
+                f"http://127.0.0.1:{rep.port}/healthz",
+                timeout=aiohttp.ClientTimeout(total=5),
+            ) as resp:
+                body = await resp.json()
+                return set(body.get("models", []))
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            return None
+
+    async def _model_call(self, svc: _Service, index: int, model: str,
+                          verb: str, body: Optional[dict] = None) -> bool:
+        rep = svc.replicas.get(index)
+        if rep is None:
+            return False
+        try:
+            async with self._http.post(
+                f"http://127.0.0.1:{rep.port}/v2/repository/models/"
+                f"{model}/{verb}",
+                json=body,
+                timeout=aiohttp.ClientTimeout(total=120),
+            ) as resp:
+                if resp.status != 200:
+                    logger.warning(
+                        "model %s %s on replica %d: HTTP %d %s",
+                        model, verb, index, resp.status,
+                        (await resp.text())[:200],
+                    )
+                    return False
+                return True
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            logger.warning("model %s %s on replica %d: %s",
+                           model, verb, index, e)
+            return False
+
+    def _write_tm_status(self, ns: str, name: str, *, loaded: bool,
+                         replica_index: Optional[int],
+                         url: Optional[str]) -> None:
+        raw = self.store.get(TRAINED_MODEL_KIND, name, ns)
+        if raw is None:
+            return
+        new_status = {
+            "loaded": loaded,
+            "conditions": [{
+                "type": "Ready" if loaded else "Unready",
+                "status": True,
+                "reason": "Loaded" if loaded else "LoadFailed",
+                "message": "",
+                "last_transition": time.time(),
+            }],
+        }
+        if replica_index is not None:
+            new_status["replica_index"] = replica_index
+        if url is not None:
+            new_status["url"] = url
+        old = dict(raw.get("status", {}))
+        cmp_old = {k: v for k, v in old.items() if k != "conditions"}
+        cmp_new = {k: v for k, v in new_status.items() if k != "conditions"}
+        old_ready = any(
+            c.get("type") == "Ready" and c.get("status")
+            for c in old.get("conditions", [])
+        )
+        if (cmp_old == cmp_new and old_ready == loaded
+                and old.get("conditions")):
+            # No-op guard (a status write re-triggers our own watch) —
+            # but a condition-less fresh object must get its FIRST
+            # condition even when the comparable fields match.
+            return
+        raw = dict(raw)
+        raw["status"] = new_status
+        self.store.put(TRAINED_MODEL_KIND, raw)
+
     def _release_chips(self, rep: Optional[_Replica]) -> None:
         if rep is None or rep.res_key is None or self.gang is None:
             return
@@ -386,6 +721,32 @@ class ISVCController:
         rep.res_key = None
         if self.on_capacity_released is not None:
             self.on_capacity_released()
+
+    def _spawn_placement(self, ns: str, name: str,
+                         comp: ComponentSpec) -> None:
+        """One placement task per service at a time; a reconcile that
+        arrives mid-placement marks it pending and the task re-enqueues
+        the service when done (so no placement round is lost)."""
+        key = f"{ns}/{name}"
+        running = self._placement_tasks.get(key)
+        if running is not None and not running.done():
+            self._placement_pending.add(key)
+            return
+        svc = self.services.get(key)
+        if svc is None:
+            return
+
+        async def run() -> None:
+            try:
+                await self._reconcile_models(ns, name, comp, svc)
+            except Exception:  # noqa: BLE001
+                logger.exception("model placement for %s failed", key)
+            finally:
+                if key in self._placement_pending:
+                    self._placement_pending.discard(key)
+                    self._enqueue(ns, name)
+
+        self._placement_tasks[key] = asyncio.create_task(run())
 
     async def _retire_replica(self, key: str, svc: _Service, index: int,
                               drain: bool = True) -> None:
@@ -612,14 +973,26 @@ class ISVCController:
             model_dir = os.path.join(
                 self.state_dir, "models", ns, name
             )
-            args = [
-                "--model-name", m.name or name,
-                "--port", str(port),
-                "--model-dir", model_dir,
-                "--options-json", json.dumps(m.options),
-            ]
-            if m.storage_uri:
-                args += ["--storage-uri", m.storage_uri]
+            if comp.multi_model is not None:
+                # ModelMesh replica: boots empty; the placement loop
+                # admits TrainedModels via the V2 repository API.
+                args = [
+                    "--multi-model",
+                    "--max-loaded",
+                    str(comp.multi_model.max_models_per_replica),
+                    "--port", str(port),
+                    "--model-dir", model_dir,
+                    "--options-json", json.dumps(m.options),
+                ]
+            else:
+                args = [
+                    "--model-name", m.name or name,
+                    "--port", str(port),
+                    "--model-dir", model_dir,
+                    "--options-json", json.dumps(m.options),
+                ]
+                if m.storage_uri:
+                    args += ["--storage-uri", m.storage_uri]
         if comp.logger is not None:
             # Part of the runtime flag contract (runtimes/common.py);
             # custom entrypoints opting into logger: must accept it too.
@@ -977,8 +1350,47 @@ class Activator:
         svc.last_request = time.time()
         svc.in_flight += 1
         replica = None
+        prefer = None
+        is_multi_model = bool(
+            ((raw.get("spec") or {}).get("predictor") or {}).get(
+                "multi_model")
+        )
+        if is_multi_model and not key.endswith(TRANSFORMER_SUFFIX):
+            # (Model routing applies to the PREDICTOR hop only: a
+            # transformer ingress forwards to the predictor itself.)
+            # Multi-model routing: send the request to the replica that
+            # holds the named model (ModelMesh's model-aware router).
+            m = re.match(r"v[12]/models/([^/:]+)", tail)
+            if m is not None:
+                mname = m.group(1)
+                prefer = svc.model_locations.get(mname)
+                targets_pool = False
+                if prefer is None:
+                    # Store lookup only on the miss path — the placed
+                    # hot path must not pay a per-request SELECT.
+                    tm_raw = ctrl.store.get(TRAINED_MODEL_KIND, mname, ns)
+                    targets_pool = (
+                        tm_raw is not None
+                        and (tm_raw.get("spec") or {}).get(
+                            "inference_service") == name
+                    )
+                if prefer is None and targets_pool:
+                    # The model EXISTS but isn't placed yet (cold pool /
+                    # placement in flight): 503 is honest and retryable;
+                    # an empty replica's 404 would read as "no such
+                    # model". Kick the pool awake so the retry lands.
+                    if not svc.ready_replicas() and svc.desired < 1:
+                        svc.desired = 1
+                    ctrl._enqueue(*_key_parts(key))
+                    svc.in_flight -= 1
+                    svc.last_request = time.time()
+                    return err(
+                        503,
+                        f"model {mname} is not placed yet "
+                        "(placement in progress)",
+                    )
         try:
-            replica = await self._get_replica(key, svc)
+            replica = await self._get_replica(key, svc, prefer)
             if replica is None:
                 return err(503, "no replica became ready in time")
             replica.in_flight += 1
@@ -998,7 +1410,17 @@ class Activator:
             svc.in_flight -= 1
             svc.last_request = time.time()
 
-    async def _get_replica(self, key: str, svc: _Service) -> Optional[_Replica]:
+    async def _get_replica(self, key: str, svc: _Service,
+                           prefer: Optional[int] = None) -> Optional[_Replica]:
+        if prefer is not None:
+            # Model-aware routing: only the preferred replica holds the
+            # model. Falling back to an arbitrary replica would turn a
+            # transient relocation into a misleading 404 — return "no
+            # replica" (503, retryable) and let placement converge.
+            rep = svc.replicas.get(prefer)
+            if rep is not None and rep.ready:
+                return rep
+            return None
         ready = svc.ready_replicas()
         if not ready:
             # Cold start: ask for at least one replica and hold the request.

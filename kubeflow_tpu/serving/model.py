@@ -15,8 +15,28 @@ trade HBM-resident batch growth against tail latency.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
+import logging
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+# KFTPU_SERVING_TRACE=1: log batcher/repository lifecycle + per-request
+# stages (diagnosing wedged requests in multi-model replicas).
+TRACE = os.environ.get("KFTPU_SERVING_TRACE") == "1"
+
+# Predict batches run here rather than the loop's default executor so the
+# CONCURRENT future is visible: eviction needs "has the worker thread
+# really finished model.predict?" — the asyncio wrapper future gets
+# cancelled with its task and can't answer that.
+_PREDICT_POOL = concurrent.futures.ThreadPoolExecutor(
+    # Same sizing as asyncio's default executor: a dense multi-model
+    # replica must not serialize unrelated models' batches behind a
+    # tiny thread cap.
+    max_workers=min(32, (os.cpu_count() or 1) + 4),
+    thread_name_prefix="kftpu-predict",
+)
 
 
 class InferenceError(RuntimeError):
@@ -76,6 +96,13 @@ class Batcher:
         self.max_latency = max_latency_ms / 1000.0
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
+        # Set by cancel(): the batcher is dead; predicts must fail fast
+        # instead of enqueueing onto a queue nobody will ever drain.
+        self._closed: Optional[Exception] = None
+        # The CONCURRENT future of the batch currently computing, if
+        # any: eviction must not unload the model under a running
+        # predict, and only this future reports true thread completion.
+        self.inflight: Optional[concurrent.futures.Future] = None
 
     def start(self) -> None:
         if self._task is None:
@@ -91,54 +118,141 @@ class Batcher:
             self._task = None
 
     async def predict(self, instance: Any) -> Any:
+        if self._closed is not None:
+            raise self._closed
+        if self._task is None:
+            # Not started: queueing would hang forever (nobody drains).
+            raise InferenceError("batcher is not running", 503)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         await self._queue.put((instance, fut))
+        if TRACE:
+            logger.info("TRACE batcher %x enqueue model=%s task=%s "
+                        "closed=%s", id(self), self.model.name,
+                        self._task, self._closed)
+        if self._closed is not None and not fut.done():
+            # Evicted between the closed-check and the put: the drain in
+            # cancel() ran before our entry landed — fail it ourselves.
+            fut.set_exception(self._closed)
         return await fut
 
-    async def _run(self) -> None:
+    def cancel(self, exc: Exception) -> None:
+        """Tear down synchronously (eviction): stop the worker and fail
+        queued requests instead of hanging their futures forever."""
+        if TRACE:
+            logger.info("TRACE batcher %x cancel model=%s qsize=%d",
+                        id(self), self.model.name, self._queue.qsize())
+        self._closed = exc
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
         while True:
-            batch = [await self._queue.get()]
-            deadline = time.monotonic() + self.max_latency
-            while len(batch) < self.max_batch:
-                timeout = deadline - time.monotonic()
-                if timeout <= 0:
-                    break
-                try:
-                    batch.append(await asyncio.wait_for(self._queue.get(), timeout))
-                except asyncio.TimeoutError:
-                    break
-            instances = [b[0] for b in batch]
             try:
-                # predict is sync (jit dispatch); run in default executor so
-                # the event loop keeps accepting requests during compute.
-                outputs = await asyncio.get_running_loop().run_in_executor(
-                    None, self.model.predict, instances
-                )
-                if len(outputs) != len(instances):
-                    raise InferenceError(
-                        f"model returned {len(outputs)} outputs for "
-                        f"{len(instances)} instances"
+                _, fut = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if not fut.done():
+                fut.set_exception(exc)
+
+    async def _run(self) -> None:
+        # ``batch`` lives OUTSIDE the loop and the cancellation handler
+        # wraps the WHOLE loop: eviction can cancel this task at ANY
+        # await — including the batching-window wait_for below, which is
+        # where a cancel racing a just-popped request usually lands — and
+        # every popped-but-unresolved future must be failed, never
+        # abandoned (an abandoned future hangs its HTTP request forever).
+        batch: List[Any] = []
+        try:
+            while True:
+                batch = [await self._queue.get()]
+                if TRACE:
+                    logger.info("TRACE batcher %x popped model=%s",
+                                id(self), self.model.name)
+                deadline = time.monotonic() + self.max_latency
+                while len(batch) < self.max_batch:
+                    timeout = deadline - time.monotonic()
+                    if timeout <= 0:
+                        break
+                    try:
+                        batch.append(
+                            await asyncio.wait_for(self._queue.get(), timeout)
+                        )
+                    except asyncio.TimeoutError:
+                        break
+                instances = [b[0] for b in batch]
+                try:
+                    # predict is sync (jit dispatch); run in a thread so
+                    # the event loop keeps accepting requests during
+                    # compute.
+                    self.inflight = _PREDICT_POOL.submit(
+                        self.model.predict, instances
                     )
-                for (_, fut), out in zip(batch, outputs):
-                    if not fut.done():
-                        fut.set_result(out)
-            except Exception as e:  # noqa: BLE001 - failures propagate per-request
-                for _, fut in batch:
-                    if not fut.done():
-                        fut.set_exception(e)
+                    outputs = await asyncio.wrap_future(self.inflight)
+                    if TRACE:
+                        logger.info("TRACE batcher %x executor done n=%d",
+                                    id(self), len(outputs))
+                    if len(outputs) != len(instances):
+                        raise InferenceError(
+                            f"model returned {len(outputs)} outputs for "
+                            f"{len(instances)} instances"
+                        )
+                    for (_, fut), out in zip(batch, outputs):
+                        if not fut.done():
+                            fut.set_result(out)
+                except Exception as e:  # noqa: BLE001 - per-request failures
+                    for _, fut in batch:
+                        if not fut.done():
+                            fut.set_exception(e)
+                finally:
+                    self.inflight = None
+                batch = []
+        except asyncio.CancelledError:
+            if TRACE:
+                logger.info("TRACE batcher %x cancelled (%d in-flight)",
+                            id(self), len(batch))
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(
+                        InferenceError("model was unloaded", 503)
+                    )
+            raise
 
 
 class ModelRepository:
-    """Name -> Model registry with dynamic load/unload (V2 repository API)."""
+    """Name -> Model registry with dynamic load/unload (V2 repository API).
 
-    def __init__(self) -> None:
+    Multi-model mode (ModelMesh analog, S7): constructed with a
+    ``factory(name, storage_uri, options) -> Model`` and a ``max_loaded``
+    budget, the repository can ADMIT models it has never seen (the V2
+    load route passes the model spec) and evicts the least-recently-used
+    ready model when the budget is exceeded — high-density serving where
+    many models share one replica process."""
+
+    def __init__(self, factory=None, max_loaded: Optional[int] = None,
+                 max_batch: int = 32, max_latency_ms: float = 5.0) -> None:
         self._models: Dict[str, Model] = {}
         self._batchers: Dict[str, Batcher] = {}
+        self._factory = factory
+        self._max_loaded = max_loaded
+        # Batching defaults applied to dynamically admitted models.
+        self._max_batch = max_batch
+        self._max_latency_ms = max_latency_ms
+        self._last_used: Dict[str, float] = {}
+        self._started = False
+        # Created lazily (needs a running loop): serializes dynamic
+        # admissions.
+        self._load_lock: Optional[asyncio.Lock] = None
+
+    @property
+    def multi_model(self) -> bool:
+        return self._factory is not None
 
     def register(self, model: Model, max_batch: int = 32,
                  max_latency_ms: float = 5.0) -> None:
         self._models[model.name] = model
-        self._batchers[model.name] = Batcher(model, max_batch, max_latency_ms)
+        b = Batcher(model, max_batch, max_latency_ms)
+        self._batchers[model.name] = b
+        if self._started:
+            b.start()
 
     def get(self, name: str) -> Model:
         if name not in self._models:
@@ -152,14 +266,85 @@ class ModelRepository:
     def names(self) -> List[str]:
         return sorted(self._models)
 
+    def touch(self, name: str) -> None:
+        self._last_used[name] = time.monotonic()
+
     def load(self, name: str) -> None:
         self.get(name).load()
+        self.touch(name)
+
+    async def load_dynamic_async(self, name: str,
+                                 storage_uri: Optional[str],
+                                 options: Dict[str, Any]) -> None:
+        """Admit-and-load a model by spec (multi-model replicas only).
+
+        The HEAVY part (weight read + jit warmup) runs off the event
+        loop: a multi-second model load must not freeze every other
+        model's predicts and the replica's health probes. BUILD comes
+        BEFORE any eviction: a failing load must cost nothing — not the
+        old same-name instance (it keeps serving), and never an
+        unrelated LRU victim. Admissions are serialized so concurrent
+        loads can neither overshoot max_loaded nor double-register."""
+        if self._factory is None:
+            raise InferenceError(
+                "this replica is not multi-model; models are fixed at "
+                "spawn", status=409,
+            )
+        if self._load_lock is None:
+            self._load_lock = asyncio.Lock()
+        async with self._load_lock:
+            loop = asyncio.get_running_loop()
+
+            def build() -> Model:
+                m = self._factory(name, storage_uri, options)
+                m.load()
+                return m
+
+            model = await loop.run_in_executor(None, build)
+            if name in self._models:
+                # Re-admission: the old instance was built from an older
+                # spec — replace it only now that the new one is ready.
+                self.evict(name)
+            if self._max_loaded is not None:
+                loaded = [n for n, m in self._models.items() if m.ready]
+                while len(loaded) >= self._max_loaded:
+                    victim = min(
+                        loaded, key=lambda n: self._last_used.get(n, 0.0)
+                    )
+                    self.evict(victim)
+                    loaded.remove(victim)
+            self.register(model, max_batch=self._max_batch,
+                          max_latency_ms=self._max_latency_ms)
+            self.touch(name)
 
     def unload(self, name: str) -> None:
         m = self.get(name)
         m.unload()
 
+    def evict(self, name: str) -> None:
+        """Unload AND deregister (multi-model LRU / model removal). The
+        model's unload() is deferred past any predict batch currently
+        computing in the executor — tearing an engine down under a
+        running jit dispatch is unsafe."""
+        m = self._models.pop(name, None)
+        b = self._batchers.pop(name, None)
+        self._last_used.pop(name, None)
+        if b is not None:
+            inflight = b.inflight
+            b.cancel(InferenceError(f"model {name} was unloaded", 503))
+            # inflight is the CONCURRENT future: it completes only when
+            # the worker thread actually leaves model.predict (task
+            # cancellation cannot cancel a running thread), so the
+            # done-callback is a safe post-compute unload point.
+            if (m is not None and inflight is not None
+                    and not inflight.done()):
+                inflight.add_done_callback(lambda _f: m.unload())
+                return
+        if m is not None:
+            m.unload()
+
     def start(self) -> None:
+        self._started = True
         for b in self._batchers.values():
             b.start()
 

@@ -29,8 +29,13 @@ def serve_main(factory: ModelFactory, argv=None) -> int:
     """
 
     p = argparse.ArgumentParser("kftpu model runtime")
-    p.add_argument("--model-name", required=True)
+    p.add_argument("--model-name", default=None)
     p.add_argument("--storage-uri", default=None)
+    p.add_argument("--multi-model", action="store_true",
+                   help="ModelMesh mode: boot empty; models are admitted "
+                        "via the V2 repository API with per-model specs")
+    p.add_argument("--max-loaded", type=int, default=4,
+                   help="multi-model LRU budget per replica")
     p.add_argument("--model-dir", default=None,
                    help="where storage is materialized (default: ./models)")
     p.add_argument("--host", default="127.0.0.1")
@@ -46,15 +51,39 @@ def serve_main(factory: ModelFactory, argv=None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    # Debugging aid: `kill -USR1 <replica pid>` dumps every thread's
+    # stack to stderr (the replica's log file) — invaluable for a
+    # wedged-handler diagnosis without py-spy in the image.
+    import faulthandler
+    import signal as _signal
+
+    faulthandler.register(_signal.SIGUSR1)
 
     options = json.loads(args.options_json)
     model_dir = args.model_dir or os.path.abspath("./models")
-    path = model_path(args.storage_uri, model_dir)
 
-    model = factory(args.model_name, path, options)
-    repo = ModelRepository()
-    repo.register(model, max_batch=args.max_batch, max_latency_ms=args.max_latency_ms)
-    model.load()
+    if args.multi_model:
+        # ModelMesh mode (S7): no fixed model; the repository constructs
+        # models on demand from per-load specs, resolving each model's
+        # storage under its own subdirectory.
+        def dyn_factory(name: str, storage_uri, opts) -> Model:
+            local = model_path(storage_uri, os.path.join(model_dir, name))
+            return factory(name, local, opts)
+
+        repo = ModelRepository(
+            factory=dyn_factory, max_loaded=args.max_loaded,
+            max_batch=args.max_batch, max_latency_ms=args.max_latency_ms,
+        )
+        path = None
+    else:
+        if not args.model_name:
+            p.error("--model-name is required (or pass --multi-model)")
+        path = model_path(args.storage_uri, model_dir)
+        model = factory(args.model_name, path, options)
+        repo = ModelRepository()
+        repo.register(model, max_batch=args.max_batch,
+                      max_latency_ms=args.max_latency_ms)
+        model.load()
 
     from kubeflow_tpu.serving import payload_logger
 

@@ -88,8 +88,16 @@ class ModelServer:
     # -- helpers ----------------------------------------------------------
 
     def _ready(self) -> bool:
+        if self.repository.multi_model:
+            # Multi-model replicas are ready when the PROCESS is up:
+            # they boot empty, and one slow/unloaded model must not take
+            # every other model on the replica out of rotation (per-model
+            # readiness is enforced per-request).
+            return True
         names = self.repository.names()
-        return bool(names) and all(self.repository.get(n).ready for n in names)
+        return bool(names) and all(
+            self.repository.get(n).ready for n in names
+        )
 
     @staticmethod
     def _err(e: Exception) -> web.Response:
@@ -127,10 +135,15 @@ class ModelServer:
         name = req.match_info["m"]
         self.request_count += 1
         t0 = time.monotonic()
+        from kubeflow_tpu.serving.model import TRACE
+
+        if TRACE:
+            logger.info("TRACE v1_predict start %s", name)
         try:
             model = self.repository.get(name)
             if not model.ready:
                 raise InferenceError(f"model {name} is not ready", status=503)
+            self.repository.touch(name)  # LRU recency for multi-model
             body = await req.json()
             instances = body.get("instances")
             if not isinstance(instances, list):
@@ -187,6 +200,7 @@ class ModelServer:
             model = self.repository.get(name)
             if not model.ready:
                 raise InferenceError(f"model {name} is not ready", status=503)
+            self.repository.touch(name)  # LRU recency for multi-model
             body = await req.json()
             inputs = body.get("inputs")
             if not isinstance(inputs, list) or not inputs:
@@ -233,15 +247,38 @@ class ModelServer:
             await self.payload_logger.log_response(model, resp, rid)
 
     async def h_v2_load(self, req: web.Request) -> web.Response:
+        name = req.match_info["m"]
         try:
-            self.repository.load(req.match_info["m"])
-            return web.json_response({"name": req.match_info["m"], "ready": True})
+            spec = None
+            if req.can_read_body:
+                try:
+                    spec = await req.json()
+                except json.JSONDecodeError:
+                    spec = None
+            if isinstance(spec, dict) and (
+                "storage_uri" in spec or "options" in spec
+            ):
+                # Multi-model admission: the controller ships the model
+                # spec; the repository constructs + loads it (LRU-
+                # evicting at the replica's budget; heavy load off-loop).
+                await self.repository.load_dynamic_async(
+                    name, spec.get("storage_uri"),
+                    spec.get("options") or {},
+                )
+            else:
+                self.repository.load(name)
+            return web.json_response({"name": name, "ready": True})
         except Exception as e:  # noqa: BLE001
             return self._err(e)
 
     async def h_v2_unload(self, req: web.Request) -> web.Response:
+        name = req.match_info["m"]
         try:
-            self.repository.unload(req.match_info["m"])
-            return web.json_response({"name": req.match_info["m"], "ready": False})
+            if self.repository.multi_model:
+                # Deregister entirely: frees the replica's model budget.
+                self.repository.evict(name)
+            else:
+                self.repository.unload(name)
+            return web.json_response({"name": name, "ready": False})
         except Exception as e:  # noqa: BLE001
             return self._err(e)

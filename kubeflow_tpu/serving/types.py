@@ -36,6 +36,7 @@ class ModelFormat(str, enum.Enum):
     sklearn = "sklearn"
     jax = "jax"  # JAX/StableHLO LLM predictor on PJRT (north-star config #5)
     huggingface = "huggingface"  # transformers on host CPU (S5 parity)
+    echo = "echo"  # conformance/test runtime (reference: custom example images)
     custom = "custom"
 
 
@@ -73,6 +74,18 @@ class LoggerSpec(BaseModel):
     mode: str = "all"  # all | request | response
 
 
+class MultiModelSpec(BaseModel):
+    """ModelMesh-style high-density multi-model serving (S7): many
+    models share this component's replica pool; each model is placed on
+    one replica, loaded on demand, and evicted LRU when a replica
+    exceeds ``max_models_per_replica``. Models are declared as separate
+    ``TrainedModel`` objects referencing the InferenceService."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    max_models_per_replica: int = Field(default=4, ge=1)
+
+
 class ComponentSpec(BaseModel):
     """One ISVC component (predictor or transformer)."""
 
@@ -80,6 +93,7 @@ class ComponentSpec(BaseModel):
 
     model: Optional[ModelSpec] = None
     custom: Optional[CustomSpec] = None
+    multi_model: Optional[MultiModelSpec] = None
     logger: Optional[LoggerSpec] = None
     resources: Resources = Field(default_factory=Resources)
     min_replicas: int = 1  # 0 = scale-to-zero
@@ -164,8 +178,58 @@ class InferenceService(BaseModel):
         return f"{self.metadata.namespace}/{self.metadata.name}"
 
 
+TRAINED_MODEL_KIND = "TrainedModel"
+
+
+class TrainedModelSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    # The multi-model InferenceService whose replica pool serves this
+    # model (KServe's TrainedModel.spec.inferenceService).
+    inference_service: str
+    model: ModelSpec
+
+
+class TrainedModelStatus(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    conditions: List[dict] = Field(default_factory=list)
+    url: Optional[str] = None
+    # Which replica of the target service currently holds the model.
+    replica_index: Optional[int] = None
+    loaded: bool = False
+
+
+class TrainedModel(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    kind: str = TRAINED_MODEL_KIND
+    metadata: ObjectMeta
+    spec: TrainedModelSpec
+    status: TrainedModelStatus = Field(default_factory=TrainedModelStatus)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TrainedModel":
+        return cls.model_validate(d)
+
+    def to_dict(self) -> dict:
+        return self.model_dump(mode="json", exclude_none=True)
+
+
 class ServingValidationError(ValueError):
     pass
+
+
+def validate_trained_model(tm: TrainedModel) -> None:
+    if tm.spec.model.format == ModelFormat.custom:
+        raise ServingValidationError(
+            "TrainedModel needs a bundled format (multi-model replicas "
+            "construct models from the runtime registry)"
+        )
+    if tm.spec.model.format not in RUNTIMES:
+        raise ServingValidationError(
+            f"no runtime for format {tm.spec.model.format}"
+        )
 
 
 def validate_isvc(isvc: InferenceService) -> None:
@@ -206,6 +270,31 @@ def validate_isvc(isvc: InferenceService) -> None:
             )
         if comp.target_concurrency <= 0:
             raise ServingValidationError(f"{label}: target_concurrency must be > 0")
+        if comp.multi_model is not None:
+            if label != "predictor":
+                raise ServingValidationError(
+                    "multi_model applies to predictors only"
+                )
+            if comp.model is None:
+                raise ServingValidationError(
+                    "multi_model needs model.format to select the "
+                    "replica runtime (models themselves come from "
+                    "TrainedModel objects)"
+                )
+            if isvc.spec.canary_traffic_percent < 100:
+                raise ServingValidationError(
+                    "multi_model pools do not support canary rollouts "
+                    "(canary replicas would receive no model "
+                    "placements); roll models via TrainedModel updates "
+                    "instead"
+                )
+            if comp.model.storage_uri or comp.model.name:
+                raise ServingValidationError(
+                    "multi_model pools ignore model.storage_uri/name — "
+                    "the pool's model spec only selects the runtime "
+                    "(format/options); the served models come from "
+                    "TrainedModel objects"
+                )
     if not 0 <= isvc.spec.canary_traffic_percent <= 100:
         raise ServingValidationError("canary_traffic_percent must be in [0, 100]")
     if isvc.spec.transformer is not None:
@@ -227,6 +316,7 @@ RUNTIMES: Dict[ModelFormat, str] = {
     ModelFormat.jax: "kubeflow_tpu.serving.runtimes.jax_llm_server",
     ModelFormat.huggingface:
         "kubeflow_tpu.serving.runtimes.huggingface_server",
+    ModelFormat.echo: "kubeflow_tpu.serving.runtimes.echo_server",
 }
 
 

@@ -531,3 +531,165 @@ def test_serving_queues_behind_training_for_chips(cp_client, tmp_path):
         await wait_for(lambda: cp.gang.free_chips == 8, msg="chips back")
 
     loop.run_until_complete(run())
+
+
+def test_multimodel_modelmesh_serving(cp_client):
+    """ModelMesh analog (S7): one multi-model ISVC replica pool serves
+    many TrainedModels — placement over ready replicas, model-aware
+    activator routing, LRU density bound, and unload on delete."""
+    cp, client, loop = cp_client
+
+    def tm(name):
+        return {
+            "kind": "TrainedModel",
+            "metadata": {"name": name},
+            "spec": {
+                "inference_service": "mesh",
+                "model": {"format": "echo", "options": {"tag": name}},
+            },
+        }
+
+    async def run():
+        pool = {
+            "metadata": {"name": "mesh"},
+            "spec": {"predictor": {
+                "model": {"format": "echo"},
+                "multi_model": {"max_models_per_replica": 2},
+                "min_replicas": 2, "max_replicas": 2,
+            }},
+        }
+        r = await client.post("/apis/InferenceService", json=pool)
+        assert r.status == 200, await r.text()
+        await wait_for(
+            lambda: _status(cp, "mesh").get("predictor", {}).get(
+                "ready_replicas") == 2,
+            msg="pool ready",
+        )
+        for name in ("m-a", "m-b", "m-c"):
+            r = await client.post("/apis/TrainedModel", json=tm(name))
+            assert r.status == 200, await r.text()
+
+        def tm_status(name):
+            obj = cp.store.get("TrainedModel", name, "default")
+            return (obj or {}).get("status", {})
+
+        await wait_for(
+            lambda: all(tm_status(n).get("loaded") for n in
+                        ("m-a", "m-b", "m-c")),
+            msg="all models placed",
+        )
+        svc = cp.isvc.services["default/mesh"]
+        assert len(svc.model_locations) == 3
+        # Requests route to the replica holding each model and the echo
+        # tag proves which model served them.
+        for name in ("m-a", "m-b", "m-c"):
+            r = await client.post(
+                f"/serving/default/mesh/v1/models/{name}:predict",
+                json={"instances": [1]},
+            )
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["predictions"][0]["tag"] == name
+            assert tm_status(name)["url"].endswith(
+                f"/v2/models/{name}/infer"
+            )
+        # Density: 3 models over 2 replicas x budget 2 fits; the pool's
+        # per-replica load never exceeds the budget.
+        from collections import Counter
+
+        per_replica = Counter(svc.model_locations.values())
+        assert max(per_replica.values()) <= 2
+
+        # Unknown model 404s (routed replica doesn't have it).
+        r = await client.post(
+            "/serving/default/mesh/v1/models/nope:predict",
+            json={"instances": [1]},
+        )
+        assert r.status == 404, await r.text()
+
+        # Delete a model: unloaded from its replica and de-routed.
+        r = await client.delete("/apis/TrainedModel/default/m-b")
+        assert (await r.json())["deleted"]
+        await wait_for(
+            lambda: "m-b" not in cp.isvc.services[
+                "default/mesh"].model_locations,
+            msg="m-b unplaced",
+        )
+        r = await client.post(
+            "/serving/default/mesh/v1/models/m-b:predict",
+            json={"instances": [1]},
+        )
+        assert r.status == 404, await r.text()
+        # Survivors still serve.
+        r = await client.post(
+            "/serving/default/mesh/v1/models/m-a:predict",
+            json={"instances": [1]},
+        )
+        assert (await r.json())["predictions"][0]["tag"] == "m-a"
+
+        # Updating a model's SPEC reloads it (new revision served).
+        updated = tm("m-a")
+        updated["spec"]["model"]["options"]["tag"] = "m-a-v2"
+        r = await client.post("/apis/TrainedModel", json=updated)
+        assert r.status == 200, await r.text()
+
+        async def served_tag():
+            resp = await client.post(
+                "/serving/default/mesh/v1/models/m-a:predict",
+                json={"instances": [1]},
+            )
+            if resp.status != 200:
+                return None
+            return (await resp.json())["predictions"][0]["tag"]
+
+        deadline = asyncio.get_running_loop().time() + 15
+        tag = None
+        while asyncio.get_running_loop().time() < deadline:
+            tag = await served_tag()
+            if tag == "m-a-v2":
+                break
+            await asyncio.sleep(0.2)
+        assert tag == "m-a-v2", tag
+
+    loop.run_until_complete(run())
+
+
+def test_multimodel_lru_eviction_in_replica(cp_client):
+    """A replica at its model budget evicts the least-recently-used
+    model when a new one is admitted (repository-level density bound)."""
+    cp, client, loop = cp_client
+
+    async def run():
+        pool = {
+            "metadata": {"name": "dense"},
+            "spec": {"predictor": {
+                "model": {"format": "echo"},
+                "multi_model": {"max_models_per_replica": 1},
+                "min_replicas": 1, "max_replicas": 1,
+            }},
+        }
+        r = await client.post("/apis/InferenceService", json=pool)
+        assert r.status == 200, await r.text()
+        await wait_for(
+            lambda: _status(cp, "dense").get("predictor", {}).get(
+                "ready_replicas"),
+            msg="pool ready",
+        )
+        svc = cp.isvc.services["default/dense"]
+        port = svc.replicas[0].port
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            for name in ("lru-a", "lru-b"):
+                async with s.post(
+                    f"http://127.0.0.1:{port}/v2/repository/models/"
+                    f"{name}/load",
+                    json={"options": {"tag": name}},
+                ) as resp:
+                    assert resp.status == 200, await resp.text()
+            async with s.get(f"http://127.0.0.1:{port}/healthz") as resp:
+                body = await resp.json()
+        # Budget 1: loading lru-b evicted lru-a.
+        assert body["models"] == ["lru-b"], body
+
+    loop.run_until_complete(run())
