@@ -48,6 +48,8 @@ KIND_ALIASES = {
     "notebook": "Notebook", "notebooks": "Notebook", "nb": "Notebook",
     "tensorboard": "Tensorboard", "tensorboards": "Tensorboard",
     "tb": "Tensorboard",
+    "volumeviewer": "VolumeViewer", "volumeviewers": "VolumeViewer",
+    "vv": "VolumeViewer", "pvcviewer": "VolumeViewer",
     "profile": "Profile", "profiles": "Profile",
     "poddefault": "PodDefault", "poddefaults": "PodDefault",
     "event": "Event", "events": "Event",

@@ -1,0 +1,116 @@
+"""Volume viewer: browse/download files under a directory over HTTP.
+
+PVCViewer-controller analog (SURVEY.md 3.4 P3): the reference spawns a
+filebrowser pod per PVCViewer object; here a ``VolumeViewer`` object
+spawns this process pointed at a local directory (the "volume" — job
+checkpoint dirs, dataset roots, log trees).
+
+Routes:
+- ``GET /healthz``          liveness
+- ``GET /``, ``GET /{path}``  directory listing (HTML) or file download
+
+Traversal-safe: every request path is resolved and must stay under the
+root.
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import os
+import urllib.parse
+from pathlib import Path
+
+from aiohttp import web
+
+
+def build_app(root: str) -> web.Application:
+    rootp = Path(root).resolve()
+
+    def resolve(tail: str) -> Path:
+        p = (rootp / tail.lstrip("/")).resolve()
+        if p != rootp and rootp not in p.parents:
+            raise web.HTTPForbidden(text="path escapes the volume root")
+        return p
+
+    async def healthz(req: web.Request) -> web.Response:
+        return web.json_response({"ok": True, "root": str(rootp)})
+
+    async def browse(req: web.Request) -> web.StreamResponse:
+        tail = req.match_info.get("tail", "")
+        p = resolve(tail)
+        if not p.exists():
+            raise web.HTTPNotFound(text=f"{tail or '/'} not found")
+        if p.is_file():
+            return web.FileResponse(
+                p, headers={
+                    "Content-Disposition":
+                        f'attachment; filename="{p.name}"'
+                }
+            )
+        rows = []
+        entries = sorted(
+            p.iterdir(), key=lambda e: (e.is_file(), e.name.lower())
+        )
+        if p != rootp:
+            parent = os.path.relpath(p.parent, rootp)
+            parent = "" if parent == "." else parent
+            rows.append(
+                f'<tr><td><a href="/{urllib.parse.quote(parent)}">..</a>'
+                "</td><td></td><td></td></tr>"
+            )
+        for e in entries:
+            rel = os.path.relpath(e, rootp)
+            st = e.stat()
+            # href percent-encoded (%, #, ? in filenames), display text
+            # HTML-escaped — two different escaping domains.
+            name = html.escape(e.name) + ("/" if e.is_dir() else "")
+            size = "" if e.is_dir() else f"{st.st_size:,}"
+            import time as _time
+
+            mtime = _time.strftime(
+                "%Y-%m-%d %H:%M", _time.localtime(st.st_mtime)
+            )
+            rows.append(
+                f'<tr><td><a href="/{urllib.parse.quote(rel)}">{name}'
+                f'</a></td><td align="right">{size}</td>'
+                f"<td>{mtime}</td></tr>"
+            )
+        rel = os.path.relpath(p, rootp)
+        title = "/" if rel == "." else f"/{rel}"
+        page = (
+            "<!doctype html><html><head><title>volume "
+            f"{html.escape(title)}</title><style>"
+            "body{font-family:monospace;margin:2em}"
+            "td{padding:2px 12px}</style></head><body>"
+            f"<h2>volume {html.escape(title)}</h2>"
+            "<table><tr><th align=left>name</th><th>size</th>"
+            "<th>modified</th></tr>"
+            + "".join(rows) + "</table></body></html>"
+        )
+        return web.Response(text=page, content_type="text/html")
+
+    app = web.Application()
+    app.add_routes([
+        web.get("/healthz", healthz),
+        web.get("/", browse),
+        web.get("/{tail:.*}", browse),
+    ])
+    return app
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("kftpu volume viewer")
+    p.add_argument("--root", required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int,
+                   default=int(os.environ.get("PORT", "8080")))
+    args = p.parse_args(argv)
+    web.run_app(
+        build_app(args.root), host=args.host, port=args.port, print=None
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -110,9 +110,41 @@ class Tensorboard(BaseModel):
         return self.model_dump(mode="json", by_alias=True)
 
 
+class VolumeViewerSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    # Directory to browse (the "volume": checkpoint dirs, datasets,
+    # log trees).
+    path: str
+
+
+class VolumeViewer(BaseModel):
+    """PVCViewer analog (SURVEY.md 3.4 P3): browse/download files under
+    a directory through a spawned viewer process."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    kind: str = "VolumeViewer"
+    metadata: ObjectMeta
+    spec: VolumeViewerSpec
+    status: WorkbenchStatus = Field(default_factory=WorkbenchStatus)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "VolumeViewer":
+        return cls.model_validate(d)
+
+    def to_dict(self) -> dict:
+        return self.model_dump(mode="json", by_alias=True)
+
+
 def validate_notebook(nb: Notebook) -> None:
     if not nb.spec.template.entrypoint:
         raise WorkbenchValidationError("notebook template needs an entrypoint")
+
+
+def validate_volume_viewer(vv: VolumeViewer) -> None:
+    if not vv.spec.path:
+        raise WorkbenchValidationError("volume viewer needs spec.path")
 
 
 def validate_tensorboard(tb: Tensorboard) -> None:
@@ -132,7 +164,7 @@ class _Running:
 class WorkbenchController:
     """One controller reconciles both workbench kinds (same lifecycle)."""
 
-    KINDS = ("Notebook", "Tensorboard")
+    KINDS = ("Notebook", "Tensorboard", "VolumeViewer")
 
     def __init__(
         self,
@@ -241,7 +273,11 @@ class WorkbenchController:
             if run is not None:
                 await self.launcher.kill(run.ref)
             return
-        model = Notebook if kind == "Notebook" else Tensorboard
+        model = {
+            "Notebook": Notebook,
+            "Tensorboard": Tensorboard,
+            "VolumeViewer": VolumeViewer,
+        }[kind]
         wb = model.from_dict(obj)
         status_before = wb.status.model_dump(mode="json")
         stopped = STOPPED_ANNOTATION in wb.metadata.annotations
@@ -326,6 +362,10 @@ class WorkbenchController:
             env.update(t.env)
             entrypoint, args, exec_ = t.entrypoint, tuple(t.args), t.exec_
             workdir = t.workdir
+        elif kind == "VolumeViewer":
+            entrypoint = "kubeflow_tpu.platform.volume_viewer"
+            args = ("--root", wb.spec.path, "--port", str(port))
+            exec_, workdir = False, None
         else:
             log_dir = wb.spec.log_dir
             if not log_dir:

@@ -282,6 +282,16 @@ class ControlPlane:
             validate_tensorboard(tb)
             return tb.to_dict()
 
+        def parse_volume_viewer(o):
+            from kubeflow_tpu.platform.workbench import (
+                VolumeViewer,
+                validate_volume_viewer,
+            )
+
+            vv = VolumeViewer.from_dict(o)
+            validate_volume_viewer(vv)
+            return vv.to_dict()
+
         def parse_graph(o):
             g = InferenceGraph.from_dict(o)
             validate_graph(g)
@@ -297,6 +307,7 @@ class ControlPlane:
                   "Pipeline": parse_pipeline,
                   "Notebook": parse_notebook,
                   "Tensorboard": parse_tensorboard,
+                  "VolumeViewer": parse_volume_viewer,
                   GRAPH_KIND: parse_graph}.get(kind)
         )
         if parser is not None:
@@ -746,7 +757,7 @@ th{background:#eee}
 const KINDS = ["JAXJob","TFJob","PyTorchJob","MPIJob","XGBoostJob",
   "PaddleJob","Experiment","Trial","InferenceService","TrainedModel",
   "Pipeline",
-  "Notebook","Tensorboard","Profile","PodDefault"];
+  "Notebook","Tensorboard","VolumeViewer","Profile","PodDefault"];
 const PHASE_ORDER = ["Failed","Succeeded","Suspended","Restarting",
   "Running","Ready","Unready","Created"];
 function phaseOf(o){

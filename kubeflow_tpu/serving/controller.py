@@ -1378,10 +1378,14 @@ class Activator:
                     # The model EXISTS but isn't placed yet (cold pool /
                     # placement in flight): 503 is honest and retryable;
                     # an empty replica's 404 would read as "no such
-                    # model". Kick the pool awake so the retry lands.
+                    # model". Kick the pool awake so the retry lands —
+                    # unless placement is already in failure backoff
+                    # (client polling must not defeat the backoff and
+                    # hammer the replicas' serialized load lock).
                     if not svc.ready_replicas() and svc.desired < 1:
                         svc.desired = 1
-                    ctrl._enqueue(*_key_parts(key))
+                    if svc.placement_failures == 0:
+                        ctrl._enqueue(*_key_parts(key))
                     svc.in_flight -= 1
                     svc.last_request = time.time()
                     return err(

@@ -167,17 +167,25 @@ class Batcher:
                 if TRACE:
                     logger.info("TRACE batcher %x popped model=%s",
                                 id(self), self.model.name)
+                # Batching window via non-blocking drain + micro-sleeps:
+                # wait_for(queue.get(), t) can DISCARD a popped item when
+                # cancellation races the inner get's completion (the
+                # documented wait_for caveat) — that lost item's future
+                # would hang its HTTP request forever. get_nowait never
+                # holds an item across an await, so eviction-cancel at
+                # any point leaves undrained items IN the queue for
+                # cancel()'s drain to fail.
                 deadline = time.monotonic() + self.max_latency
                 while len(batch) < self.max_batch:
-                    timeout = deadline - time.monotonic()
-                    if timeout <= 0:
-                        break
                     try:
-                        batch.append(
-                            await asyncio.wait_for(self._queue.get(), timeout)
-                        )
-                    except asyncio.TimeoutError:
+                        batch.append(self._queue.get_nowait())
+                        continue
+                    except asyncio.QueueEmpty:
+                        pass
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
                         break
+                    await asyncio.sleep(min(remaining, 0.001))
                 instances = [b[0] for b in batch]
                 try:
                     # predict is sync (jit dispatch); run in a thread so

@@ -1,0 +1,66 @@
+"""lightgbm runtime (KServe lgbserver equivalent, SURVEY.md 3.3 S5).
+
+Loads a LightGBM Booster from a ``.txt``/``.model`` file and serves
+predictions. Like the xgboost runtime, the library is an OPTIONAL
+dependency here: an absent library fails at LOAD time with an
+actionable message rather than crashing the process at import.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from kubeflow_tpu.serving.model import InferenceError, Model
+from kubeflow_tpu.serving.runtimes.common import serve_main
+
+_SUFFIXES = (".txt", ".model", ".lgb")
+
+
+class LightGBMModel(Model):
+    def __init__(self, name: str, path: Optional[str],
+                 options: Dict[str, Any]) -> None:
+        super().__init__(name)
+        self.path = path
+        self.options = options
+        self._booster = None
+
+    def load(self) -> None:
+        try:
+            import lightgbm  # noqa: PLC0415 - optional dependency
+        except ImportError:
+            raise InferenceError(
+                "the lightgbm library is not installed in this image; "
+                "install it or serve the model via format=sklearn "
+                "(joblib-wrapped LGBM estimators work there)", 500,
+            )
+        path = self.path
+        if path is None:
+            raise InferenceError("lightgbm runtime requires storage_uri", 500)
+        if os.path.isdir(path):
+            cands = [f for f in sorted(os.listdir(path))
+                     if f.endswith(_SUFFIXES)]
+            if not cands:
+                raise InferenceError(f"no {_SUFFIXES} file in {path}", 500)
+            path = os.path.join(path, cands[0])
+        self._booster = lightgbm.Booster(model_file=path)
+        self.ready = True
+
+    def unload(self) -> None:
+        self._booster = None
+        self.ready = False
+
+    def predict(self, instances: Sequence[Any]) -> List[Any]:
+        return np.asarray(
+            self._booster.predict(np.asarray(instances))
+        ).tolist()
+
+
+def main(argv=None) -> int:
+    return serve_main(LightGBMModel, argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

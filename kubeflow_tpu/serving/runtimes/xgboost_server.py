@@ -1,0 +1,71 @@
+"""xgboost runtime (KServe xgbserver equivalent, SURVEY.md 3.3 S5).
+
+Loads an xgboost Booster from a ``.json``/``.ubj``/``.bst`` model file
+and serves predictions. The library is an OPTIONAL dependency in this
+image (the runtime registry must cover the reference's format catalog;
+an absent library fails at LOAD time with an actionable message, not an
+import crash at process start — the same gating the HF runtime uses for
+missing model assets).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from kubeflow_tpu.serving.model import InferenceError, Model
+from kubeflow_tpu.serving.runtimes.common import serve_main
+
+_SUFFIXES = (".json", ".ubj", ".bst", ".model")
+
+
+class XGBoostModel(Model):
+    def __init__(self, name: str, path: Optional[str],
+                 options: Dict[str, Any]) -> None:
+        super().__init__(name)
+        self.path = path
+        self.options = options
+        self._booster = None
+        self._xgb = None
+
+    def load(self) -> None:
+        try:
+            import xgboost  # noqa: PLC0415 - optional dependency
+        except ImportError:
+            raise InferenceError(
+                "the xgboost library is not installed in this image; "
+                "install it or serve the model via format=sklearn "
+                "(joblib-wrapped XGB estimators work there)", 500,
+            )
+        path = self.path
+        if path is None:
+            raise InferenceError("xgboost runtime requires storage_uri", 500)
+        if os.path.isdir(path):
+            cands = [f for f in sorted(os.listdir(path))
+                     if f.endswith(_SUFFIXES)]
+            if not cands:
+                raise InferenceError(f"no {_SUFFIXES} file in {path}", 500)
+            path = os.path.join(path, cands[0])
+        booster = xgboost.Booster()
+        booster.load_model(path)
+        self._booster = booster
+        self._xgb = xgboost
+        self.ready = True
+
+    def unload(self) -> None:
+        self._booster = None
+        self.ready = False
+
+    def predict(self, instances: Sequence[Any]) -> List[Any]:
+        dmat = self._xgb.DMatrix(np.asarray(instances))
+        return np.asarray(self._booster.predict(dmat)).tolist()
+
+
+def main(argv=None) -> int:
+    return serve_main(XGBoostModel, argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -31,7 +31,7 @@ from typing import Optional
 
 from aiohttp import web
 
-from kubeflow_tpu.serving.model import InferenceError, ModelRepository
+from kubeflow_tpu.serving.model import TRACE, InferenceError, ModelRepository
 
 logger = logging.getLogger(__name__)
 
@@ -135,8 +135,6 @@ class ModelServer:
         name = req.match_info["m"]
         self.request_count += 1
         t0 = time.monotonic()
-        from kubeflow_tpu.serving.model import TRACE
-
         if TRACE:
             logger.info("TRACE v1_predict start %s", name)
         try:

@@ -34,6 +34,8 @@ class ModelFormat(str, enum.Enum):
     """Built-in model formats with bundled server runtimes (S5)."""
 
     sklearn = "sklearn"
+    xgboost = "xgboost"  # Booster files; library optional (gated at load)
+    lightgbm = "lightgbm"  # Booster files; library optional (gated at load)
     jax = "jax"  # JAX/StableHLO LLM predictor on PJRT (north-star config #5)
     huggingface = "huggingface"  # transformers on host CPU (S5 parity)
     echo = "echo"  # conformance/test runtime (reference: custom example images)
@@ -313,6 +315,8 @@ def validate_isvc(isvc: InferenceService) -> None:
 # analog; see serving/runtimes/). Custom formats bypass the registry.
 RUNTIMES: Dict[ModelFormat, str] = {
     ModelFormat.sklearn: "kubeflow_tpu.serving.runtimes.sklearn_server",
+    ModelFormat.xgboost: "kubeflow_tpu.serving.runtimes.xgboost_server",
+    ModelFormat.lightgbm: "kubeflow_tpu.serving.runtimes.lightgbm_server",
     ModelFormat.jax: "kubeflow_tpu.serving.runtimes.jax_llm_server",
     ModelFormat.huggingface:
         "kubeflow_tpu.serving.runtimes.huggingface_server",
