@@ -56,6 +56,14 @@ def default_buckets(max_seq: int) -> tuple[int, ...]:
     return tuple(out)
 
 
+def _pow2_bucket(n: int) -> int:
+    """Smallest power of 2 >= n (jit-compile key bucketing for row counts)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
 # ---------------------------------------------------------------------------
 # Pure forward math over the training param pytree (scan layout).
 # ---------------------------------------------------------------------------
@@ -321,6 +329,71 @@ def _sample(logits, rng, temps):
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
+def _prefill_chunk(cfg: LlamaConfig, klen: int, w: dict, cache_k, cache_v,
+                   tokens, offsets, chunk_lens, slots):
+    """One CHUNK of prefill for K mid-prefill rows, written straight into
+    the cache (chunked prefill: admission must never stall decoding slots
+    for a whole long-prompt prefill).
+
+    tokens [K, C]: the next C prompt tokens per row, zero-padded past
+    chunk_lens. offsets [K]: tokens already in the cache per row.
+    chunk_lens [K]: real tokens this chunk. slots [K]: cache slot per row
+    (out-of-range = dummy row; its scatter drops). klen: STATIC key bound
+    covering max(offsets)+C, bucketed by the caller so the compile count
+    stays O(K-buckets x klen-buckets).
+
+    Unlike _prefill (fresh [K,S] self-attention), each chunk attends over
+    the cache prefix it and earlier chunks wrote, so cost is C x klen per
+    chunk -- the price of interleaving. Padding garbage written past a
+    row's real length is safe by the same invariant as _insert padding:
+    a position >= the row's length is masked until the decode step that
+    overwrites it.
+
+    NOTE: the scan body below is the layer forward a third time
+    (_layer_forward is the fresh-sequence case, _decode's body the C=1
+    cached case) -- kept separate because _decode is THE hot loop and
+    must index the cache by batch row, not gather by slot. Any change to
+    the shared math (RoPE, GQA reshape, write-then-attend order, norm
+    placement) must land in all three.
+
+    Returns (logits [K, V] at each row's last real chunk token, caches).
+    """
+
+    k_rows, c = tokens.shape
+    positions = offsets[:, None] + jnp.arange(c)[None, :]          # [K,C]
+    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    x = w["embed"][tokens]
+    mask = jnp.arange(klen)[None, None, :] <= positions[:, :, None]  # [K,C,klen]
+    row = slots[:, None]
+
+    def body(x, layer):
+        lp, ck, cv = layer
+        h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps)
+        q = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["q_proj"]["kernel"])
+        k = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["k_proj"]["kernel"])
+        v = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["v_proj"]["kernel"])
+        q = _rope(q, freqs, positions)
+        k = _rope(k, freqs, positions)
+        # Write the chunk's K/V, then attend over the cache prefix --
+        # within-chunk causality rides the position mask.
+        ck = ck.at[row, positions].set(k, mode="drop")
+        cv = cv.at[row, positions].set(v, mode="drop")
+        keys = ck[slots, :klen]                                    # [K,klen,KV,D]
+        vals = cv[slots, :klen]
+        out = _gqa_attend(q, keys, vals, mask)
+        out = jnp.einsum("bsnd,ndh->bsh", out, lp["attn"]["o_proj"]["kernel"])
+        x = x + out
+        h = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
+        x = x + _ffn(cfg, lp, h)
+        return x, (ck, cv)
+
+    x, (ck, cv) = jax.lax.scan(body, x, (w["layers"], cache_k, cache_v))
+    x = _rms(x, w["final_scale"], cfg.norm_eps)
+    last = x[jnp.arange(k_rows), jnp.maximum(chunk_lens - 1, 0)]
+    logits = last.astype(jnp.float32) @ w["lm_head"].astype(jnp.float32)
+    return logits, ck, cv
+
+
 # ---------------------------------------------------------------------------
 # Tensor-parallel serving (SURVEY.md 3.3 S5 delta: config #5 is v5e-4).
 # ---------------------------------------------------------------------------
@@ -435,8 +508,15 @@ class Request:
     temperature: float = 0.0
     eos_id: Optional[int] = None
     future: Optional[Future] = None
+    # Streaming: called with each generated token id, FROM THE ENGINE
+    # THREAD, in emission order (the final token included -- the future
+    # resolving is the end-of-stream signal). Callbacks must be cheap and
+    # thread-safe; server handlers bridge into asyncio via
+    # loop.call_soon_threadsafe.
+    on_token: Optional[Any] = None
     # Filled by the scheduler:
     slot: int = -1
+    prefilled: int = 0  # prompt tokens already in the cache (chunked path)
     generated: List[int] = dataclasses.field(default_factory=list)
 
 
@@ -459,11 +539,18 @@ class GenerationEngine:
         decode_block: int = 8,
         mesh: Optional[jax.sharding.Mesh] = None,
         tensor_parallel: int = 1,
+        prefill_chunk: int = 0,
     ) -> None:
         # Max decode steps fused into one device program (power-of-2
         # sub-blocks keep the compile count bounded); 1 = per-token
         # dispatch.
         self.decode_block = max(1, decode_block)
+        # Chunked prefill: prompts longer than this are admitted into a
+        # slot immediately and prefilled prefill_chunk tokens per step,
+        # interleaved with decode blocks -- one long admission can then
+        # stall active decoders for at most one chunk's duration instead
+        # of the whole prompt. 0 disables (whole-prompt batched prefill).
+        self.prefill_chunk = max(0, int(prefill_chunk))
         cfg = config or PRESETS[preset]
         if max_seq is not None:
             cfg = dataclasses.replace(cfg, max_seq=max_seq)
@@ -530,6 +617,7 @@ class GenerationEngine:
         self.lengths = np.zeros(max_slots, np.int64)  # host-side bookkeeping
         self.free_slots = list(range(max_slots))
         self.active: Dict[int, Request] = {}
+        self.prefilling: Dict[int, Request] = {}  # slot -> mid-prefill req
         self.pending: "queue.Queue[Request]" = queue.Queue()
         self._rng = jax.random.PRNGKey(seed + 1)
 
@@ -567,6 +655,22 @@ class GenerationEngine:
                                  temps)
 
         self._decode_block_call = decode_block_call
+
+        chunk_jits = {}
+
+        def chunk_call(klen, ck, cv, toks, offs, clens, slots):
+            key = (klen, toks.shape[0])
+            if key not in chunk_jits:
+                def fn(w, ck, cv, toks, offs, clens, slots):
+                    logits, ck, cv = _prefill_chunk(
+                        cfg, klen, w, ck, cv, toks, offs, clens, slots
+                    )
+                    return logits, _pin(ck), _pin(cv)
+                chunk_jits[key] = jax.jit(fn, donate_argnums=(1, 2))
+            return chunk_jits[key](self.weights, ck, cv, toks, offs,
+                                   clens, slots)
+
+        self._chunk_call = chunk_call
 
         def _insert_pinned(cache_k, cache_v, k_seq, v_seq, slots):
             ck, cv = _insert(cache_k, cache_v, k_seq, v_seq, slots)
@@ -625,6 +729,7 @@ class GenerationEngine:
         an underfilled MXU per prompt)."""
         while self.free_slots and not self.pending.empty():
             reqs: List[Request] = []
+            took_chunked = False
             while len(reqs) < len(self.free_slots):
                 try:
                     req = self.pending.get_nowait()
@@ -632,13 +737,23 @@ class GenerationEngine:
                     break
                 if req.future.cancelled():
                     continue
+                if (self.prefill_chunk
+                        and len(req.prompt) > self.prefill_chunk):
+                    # Long prompt: claim a slot now, prefill chunk-by-
+                    # chunk across steps (_prefill_step) so admission
+                    # never stalls decoding slots for the whole prompt.
+                    req.slot = self.free_slots.pop()
+                    req.prefilled = 0
+                    self.prefilling[req.slot] = req
+                    took_chunked = True
+                    continue
                 reqs.append(req)
             if not reqs:
+                if took_chunked:
+                    continue
                 return
             k_real = len(reqs)
-            kbucket = 1
-            while kbucket < k_real:
-                kbucket *= 2
+            kbucket = _pow2_bucket(k_real)
             bucket = max(self._bucket(len(r.prompt)) for r in reqs)
             padded = np.zeros((kbucket, bucket), np.int32)
             lengths = np.ones(kbucket, np.int32)  # dummy rows: 1 token
@@ -668,9 +783,57 @@ class GenerationEngine:
                 self.active[slot] = req
                 self._emit(req, int(first[j]))
 
+    def _prefill_step(self) -> None:
+        """Advance every mid-prefill slot by one chunk, in ONE device
+        program. Rows finishing their prompt this chunk sample their
+        first token and join the decode batch the same step."""
+
+        if not self.prefilling:
+            return
+        items = list(self.prefilling.items())
+        c = self.prefill_chunk
+        kbucket = _pow2_bucket(len(items))
+        toks = np.zeros((kbucket, c), np.int32)
+        offs = np.zeros(kbucket, np.int32)
+        clens = np.ones(kbucket, np.int32)
+        slots = np.full(kbucket, self.max_slots, np.int32)  # dummies drop
+        temps = np.zeros(kbucket, np.float32)
+        max_end = 1
+        for j, (slot, req) in enumerate(items):
+            n = min(c, len(req.prompt) - req.prefilled)
+            toks[j, :n] = req.prompt[req.prefilled:req.prefilled + n]
+            offs[j] = req.prefilled
+            clens[j] = n
+            slots[j] = slot
+            temps[j] = req.temperature
+            max_end = max(max_end, req.prefilled + c)
+        klen = self._bucket(max_end)
+        logits, self.cache_k, self.cache_v = self._chunk_call(
+            klen, self.cache_k, self.cache_v, jnp.asarray(toks),
+            jnp.asarray(offs), jnp.asarray(clens), jnp.asarray(slots),
+        )
+        first = None  # sampled lazily: most chunks finish no row
+        for j, (slot, req) in enumerate(items):
+            req.prefilled += int(clens[j])
+            if req.prefilled < len(req.prompt):
+                continue
+            if first is None:
+                first = np.asarray(self._sample(
+                    logits, self._next_rng(), jnp.asarray(temps)
+                ))
+            del self.prefilling[slot]
+            self.lengths[slot] = len(req.prompt)
+            self.active[slot] = req
+            self._emit(req, int(first[j]))
+
     def _emit(self, req: Request, token: int) -> None:
         req.generated.append(token)
         self.tokens_generated += 1
+        if req.on_token is not None:
+            try:
+                req.on_token(token)
+            except Exception:  # noqa: BLE001 - a bad stream sink must not
+                logger.exception("on_token callback failed")  # kill the slot
         self.lengths[req.slot] += 1
         done = (
             (req.eos_id is not None and token == req.eos_id)
@@ -689,11 +852,15 @@ class GenerationEngine:
             req.future.set_result(req.generated)
 
     def step(self) -> bool:
-        """Admit pending + run one decode block. Returns True if work ran."""
+        """Admit pending, advance prefill chunks, run one decode block.
+        Returns True if work ran. The chunk-then-block interleave is the
+        point: an active decoder waits at most one chunk per step."""
 
         self._admit()
+        ran = bool(self.prefilling)
+        self._prefill_step()
         if not self.active:
-            return False
+            return ran
         # Block size: largest power-of-2 <= decode_block within every
         # slot's CACHE headroom (an out-of-range write must not happen).
         # The MIN token budget is deliberately NOT a bound: a single
@@ -714,14 +881,20 @@ class GenerationEngine:
             n *= 2
         tokens = np.zeros(self.max_slots, np.int32)
         temps = np.zeros(self.max_slots, np.float32)
+        # Non-active slots park at Smax-1: decode writes dummy K/V for
+        # EVERY row, and position 0 of a mid-prefill slot already holds
+        # real chunked-prefill state. Smax-1 garbage is safe for any
+        # future occupant -- a row first becomes visible (mask: key <=
+        # query position) in the very decode step that overwrites it.
+        positions_np = np.full(self.max_slots, self.cfg.max_seq - 1,
+                               np.int32)
         for slot, req in self.active.items():
             tokens[slot] = req.generated[-1]
             temps[slot] = req.temperature
-        # lengths[slot] already counts the last generated token, whose K/V
-        # is not in the cache yet: its position is lengths-1.
-        positions = jnp.asarray(
-            np.maximum(self.lengths - 1, 0), jnp.int32
-        )
+            # lengths[slot] already counts the last generated token, whose
+            # K/V is not in the cache yet: its position is lengths-1.
+            positions_np[slot] = max(int(self.lengths[slot]) - 1, 0)
+        positions = jnp.asarray(positions_np)
         outs, self.cache_k, self.cache_v = self._decode_block_call(
             n, self.cache_k, self.cache_v, jnp.asarray(tokens), positions,
             self._next_rng(), jnp.asarray(temps),

@@ -16,6 +16,9 @@ Options (ModelSpec.options):
 - ``max_slots``: concurrent sequences in the KV cache (default 8)
 - ``decode_block``: decode steps fused per device dispatch (default 8;
   1 = per-token dispatch for lowest streaming latency)
+- ``prefill_chunk``: prompts longer than this prefill in chunks of this
+  many tokens, interleaved with decode blocks, so one long admission
+  never stalls active slots (default 0 = whole-prompt prefill)
 - ``max_seq``: override cache length
 - ``tokenizer``: "byte" (default; ids = utf-8 bytes, self-contained) or a
   HF tokenizer name resolved from the local cache only (zero egress)
@@ -201,6 +204,7 @@ class JaxLLMModel(Model):
             max_slots=int(opts.get("max_slots", 8)),
             max_seq=opts.get("max_seq"),
             decode_block=int(opts.get("decode_block", 8)),
+            prefill_chunk=int(opts.get("prefill_chunk", 0)),
             mesh=mesh,
         )
         if config is not None:
