@@ -1281,9 +1281,14 @@ class Activator:
         self.cold_start_timeout = cold_start_timeout
 
     async def handle(self, req: web.Request) -> web.StreamResponse:
+        tail = req.match_info.get("tail", "")
+        if req.method == "POST" and tail.endswith("generate_stream"):
+            # SSE token streaming: chunks must pass through as they
+            # arrive -- buffering the body would turn TTFT into
+            # time-to-last-token for every streaming client.
+            return await self._handle_stream(req, tail)
         status, payload, ctype = await self.proxy(
-            req.match_info["ns"], req.match_info["name"],
-            req.match_info.get("tail", ""),
+            req.match_info["ns"], req.match_info["name"], tail,
             method=req.method,
             body=await req.read(),
             content_type=req.content_type or "application/json",
@@ -1291,6 +1296,60 @@ class Activator:
             query_string=req.query_string,
         )
         return web.Response(body=payload, status=status, content_type=ctype)
+
+    async def _handle_stream(self, req: web.Request,
+                             tail: str) -> web.StreamResponse:
+        """Streaming variant of handle(): same routing/cold-start core,
+        but the upstream body is forwarded chunk-by-chunk. Always routes
+        to the PREDICTOR (token streams don't compose with the
+        transformer's whole-payload pre/postprocess contract)."""
+        ns, name = req.match_info["ns"], req.match_info["name"]
+        err, svc, replica = await self._route(ns, name, tail,
+                                              component=PRIMARY)
+        if err is not None:
+            status, payload, ctype = err
+            return web.Response(body=payload, status=status,
+                                content_type=ctype)
+        out: Optional[web.StreamResponse] = None
+        try:
+            url = f"http://127.0.0.1:{replica.port}/{tail}"
+            if req.query_string:
+                url += f"?{req.query_string}"
+            body = await req.read()
+            async with self.controller._http.request(
+                "POST", url, data=body if body else None,
+                headers={"Content-Type":
+                         req.content_type or "application/json"},
+            ) as upstream:
+                out = web.StreamResponse(status=upstream.status)
+                out.headers["Content-Type"] = upstream.headers.get(
+                    "Content-Type", "text/event-stream"
+                )
+                out.headers["Cache-Control"] = "no-cache"
+                await out.prepare(req)
+                async for chunk in upstream.content.iter_any():
+                    await out.write(chunk)
+                await out.write_eof()
+                return out
+        except aiohttp.ClientError as e:
+            if out is None:
+                return web.json_response({"error": f"upstream: {e}"},
+                                         status=502)
+            # Headers already sent (replica died mid-stream): the only
+            # honest move is an in-band error event + EOF -- a second
+            # response object can't be prepared on this connection.
+            try:
+                await out.write(
+                    b"data: " + json.dumps(
+                        {"error": f"upstream: {e}"}
+                    ).encode() + b"\n\ndata: [DONE]\n\n"
+                )
+                await out.write_eof()
+            except (ConnectionResetError, aiohttp.ClientError):
+                pass
+            return out
+        finally:
+            self._release(svc, replica)
 
     async def proxy(
         self,
@@ -1308,9 +1367,44 @@ class Activator:
         the ingress component, cold-starting if needed. Returns
         (status, payload bytes, content type)."""
 
-        def err(status: int, message: str) -> tuple[int, bytes, str]:
-            return (status, json.dumps({"error": message}).encode(),
+        err, svc, replica = await self._route(ns, name, tail, component)
+        if err is not None:
+            return err
+        try:
+            url = f"http://127.0.0.1:{replica.port}/{tail}"
+            if query_string:
+                url += f"?{query_string}"
+            async with self.controller._http.request(
+                method, url, data=body if body else None,
+                headers={"Content-Type": content_type},
+            ) as resp:
+                return (resp.status, await resp.read(), resp.content_type)
+        except aiohttp.ClientError as e:
+            return (502, json.dumps({"error": f"upstream: {e}"}).encode(),
                     "application/json")
+        finally:
+            self._release(svc, replica)
+
+    def _release(self, svc: "_Service",
+                 replica: Optional["_Replica"]) -> None:
+        if replica is not None:
+            replica.in_flight -= 1
+        svc.in_flight -= 1
+        svc.last_request = time.time()
+
+    async def _route(
+        self, ns: str, name: str, tail: str, component: str = "",
+    ) -> tuple:
+        """Routing + replica reservation shared by the buffered and
+        streaming paths: canary split, transformer ingress, multi-model
+        placement, cold-start wait. Returns (err, svc, replica); on
+        success err is None and BOTH svc.in_flight and replica.in_flight
+        are already incremented -- the caller MUST _release(svc, replica)
+        when the exchange ends. On error, nothing is left reserved."""
+
+        def err(status: int, message: str) -> tuple:
+            return ((status, json.dumps({"error": message}).encode(),
+                     "application/json"), None, None)
 
         key = f"{ns}/{name}"
         ctrl = self.controller
@@ -1386,8 +1480,7 @@ class Activator:
                         svc.desired = 1
                     if svc.placement_failures == 0:
                         ctrl._enqueue(*_key_parts(key))
-                    svc.in_flight -= 1
-                    svc.last_request = time.time()
+                    self._release(svc, None)
                     return err(
                         503,
                         f"model {mname} is not placed yet "
@@ -1395,24 +1488,17 @@ class Activator:
                     )
         try:
             replica = await self._get_replica(key, svc, prefer)
-            if replica is None:
-                return err(503, "no replica became ready in time")
-            replica.in_flight += 1
-            url = f"http://127.0.0.1:{replica.port}/{tail}"
-            if query_string:
-                url += f"?{query_string}"
-            async with ctrl._http.request(
-                method, url, data=body if body else None,
-                headers={"Content-Type": content_type},
-            ) as resp:
-                return (resp.status, await resp.read(), resp.content_type)
-        except aiohttp.ClientError as e:
-            return err(502, f"upstream: {e}")
-        finally:
-            if replica is not None:
-                replica.in_flight -= 1
-            svc.in_flight -= 1
-            svc.last_request = time.time()
+        except BaseException:
+            # Client disconnect during the cold-start wait cancels us
+            # here; a leaked in_flight would pin the autoscaler's
+            # scale-to-zero condition false forever.
+            self._release(svc, None)
+            raise
+        if replica is None:
+            self._release(svc, None)
+            return err(503, "no replica became ready in time")
+        replica.in_flight += 1
+        return None, svc, replica
 
     async def _get_replica(self, key: str, svc: _Service,
                            prefer: Optional[int] = None) -> Optional[_Replica]:

@@ -806,7 +806,9 @@ class GenerationEngine:
             clens[j] = n
             slots[j] = slot
             temps[j] = req.temperature
-            max_end = max(max_end, req.prefilled + c)
+            # Real tokens bound klen; padding lanes past n attend garbage
+            # that's discarded, so they don't need covering.
+            max_end = max(max_end, req.prefilled + n)
         klen = self._bucket(max_end)
         logits, self.cache_k, self.cache_v = self._chunk_call(
             klen, self.cache_k, self.cache_v, jnp.asarray(toks),

@@ -79,6 +79,16 @@ class Model:
     def metadata(self) -> Dict[str, Any]:
         return {"name": self.name, "platform": "kftpu", "inputs": [], "outputs": []}
 
+    # Streaming generation (V2 generate extension). LLM runtimes override:
+    # submit the request, arrange for ``on_token(token_id)`` to be called
+    # per generated token (any thread), and return (future-of-token-ids,
+    # decode) where ``decode(ids) -> str`` renders a cumulative text. The
+    # server owns the SSE framing; models own only token production.
+    def submit_stream(self, instance: Any, on_token) -> tuple:
+        raise InferenceError(
+            f"model {self.name} does not support streaming generation", 501
+        )
+
 
 class Batcher:
     """Coalesce concurrent single-instance predicts into batched calls.

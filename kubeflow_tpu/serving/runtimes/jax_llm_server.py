@@ -228,6 +228,37 @@ class JaxLLMModel(Model):
             self.engine = None
         self.ready = False
 
+    def _parse_instance(self, inst: Any):
+        """Normalize one request instance -> (token_ids, text_out) or an
+        error dict (shared by predict and the streaming path)."""
+        if not isinstance(inst, dict):
+            inst = {"prompt": str(inst)}
+        if "token_ids" in inst:
+            ids, text_out = list(inst["token_ids"]), False
+        elif "prompt" in inst:
+            ids, text_out = self.tokenizer.encode(inst["prompt"]), True
+        else:
+            return {"error": 'instance needs "prompt" or "token_ids"'}, inst
+        if not ids:
+            return {"error": "empty prompt"}, inst
+        return (ids, text_out), inst
+
+    def submit_stream(self, instance: Any, on_token) -> tuple:
+        from kubeflow_tpu.serving.engine import Request
+
+        parsed, inst = self._parse_instance(instance)
+        if isinstance(parsed, dict):
+            raise InferenceError(parsed["error"], 400)
+        ids, _ = parsed
+        req = Request(
+            prompt=ids,
+            max_new_tokens=int(inst.get("max_new_tokens", 64)),
+            temperature=float(inst.get("temperature", 0.0)),
+            eos_id=inst.get("eos_id", self.tokenizer.eos_id),
+            on_token=on_token,
+        )
+        return self.engine.submit(req), self.tokenizer.decode
+
     def predict(self, instances: Sequence[Any]) -> List[Any]:
         from kubeflow_tpu.serving.engine import Request
 
@@ -236,18 +267,11 @@ class JaxLLMModel(Model):
         # coalesced with it.
         slots: List[Any] = []  # (future, text_out) | {"error": ...}
         for inst in instances:
-            if not isinstance(inst, dict):
-                inst = {"prompt": str(inst)}
-            if "token_ids" in inst:
-                ids, text_out = list(inst["token_ids"]), False
-            elif "prompt" in inst:
-                ids, text_out = self.tokenizer.encode(inst["prompt"]), True
-            else:
-                slots.append({"error": 'instance needs "prompt" or "token_ids"'})
+            parsed, inst = self._parse_instance(inst)
+            if isinstance(parsed, dict):
+                slots.append(parsed)
                 continue
-            if not ids:
-                slots.append({"error": "empty prompt"})
-                continue
+            ids, text_out = parsed
             req = Request(
                 prompt=ids,
                 max_new_tokens=int(inst.get("max_new_tokens", 64)),

@@ -66,6 +66,9 @@ class ModelServer:
             web.get("/v2/models/{m}", self.h_v2_model_meta),
             web.get("/v2/models/{m}/ready", self.h_v2_model_ready),
             web.post("/v2/models/{m}/infer", self.h_v2_infer),
+            web.post("/v2/models/{m}/generate", self.h_v2_generate),
+            web.post("/v2/models/{m}/generate_stream",
+                     self.h_v2_generate_stream),
             web.post("/v2/repository/models/{m}/load", self.h_v2_load),
             web.post("/v2/repository/models/{m}/unload", self.h_v2_unload),
         ])
@@ -230,6 +233,154 @@ class ModelServer:
             return self._err(e)
         finally:
             self.predict_seconds += time.monotonic() - t0
+
+    # -- V2 generate extension (LLM text generation, streaming) ------------
+
+    @staticmethod
+    def _generate_instance(body: dict) -> dict:
+        """Map a V2 generate body to an engine instance. Accepts the OIP
+        generate-extension shape ({"text_input", "parameters": {...}})
+        and the V1-instance shape ({"prompt"|"token_ids", ...}) alike."""
+        inst = dict(body.get("parameters") or {})
+        for k in ("prompt", "token_ids", "max_new_tokens", "temperature",
+                  "eos_id"):
+            if k in body:
+                inst[k] = body[k]
+        if "text_input" in body:
+            inst["prompt"] = body["text_input"]
+        return inst
+
+    async def h_v2_generate(self, req: web.Request) -> web.Response:
+        """Non-streaming generate: same contract as generate_stream with
+        the tokens collected server-side."""
+        name = req.match_info["m"]
+        self.request_count += 1
+        t0 = time.monotonic()
+        try:
+            model = self.repository.get(name)
+            if not model.ready:
+                raise InferenceError(f"model {name} is not ready", status=503)
+            self.repository.touch(name)
+            body = await req.json()
+            fut, decode = model.submit_stream(
+                self._generate_instance(body), None
+            )
+            try:
+                ids = await asyncio.wrap_future(fut)
+            except ValueError as e:
+                raise InferenceError(str(e), 400)
+            return web.json_response({
+                "model_name": name, "id": body.get("id", ""),
+                "text_output": decode(ids), "token_ids": ids,
+            })
+        except json.JSONDecodeError:
+            self.error_count += 1
+            return web.json_response({"error": "body is not JSON"}, status=400)
+        except Exception as e:  # noqa: BLE001
+            self.error_count += 1
+            return self._err(e)
+        finally:
+            self.predict_seconds += time.monotonic() - t0
+
+    async def h_v2_generate_stream(self, req: web.Request) -> web.StreamResponse:
+        """SSE token stream: one ``data: {...}`` event per generated token
+        with the incremental text delta, then ``data: [DONE]``. TTFT is
+        the time to the first event -- the reason this route exists."""
+        name = req.match_info["m"]
+        self.request_count += 1
+        t0 = time.monotonic()
+        try:
+            model = self.repository.get(name)
+            if not model.ready:
+                raise InferenceError(f"model {name} is not ready", status=503)
+            self.repository.touch(name)
+            body = await req.json()
+        except json.JSONDecodeError:
+            self.error_count += 1
+            return web.json_response({"error": "body is not JSON"}, status=400)
+        except Exception as e:  # noqa: BLE001
+            self.error_count += 1
+            return self._err(e)
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        done = object()
+
+        def on_token(tok: int) -> None:  # engine thread
+            loop.call_soon_threadsafe(q.put_nowait, tok)
+
+        try:
+            fut, decode = model.submit_stream(
+                self._generate_instance(body), on_token
+            )
+        except Exception as e:  # noqa: BLE001 - any submit failure is a
+            self.error_count += 1  # clean pre-stream HTTP error
+            return self._err(e)
+        fut.add_done_callback(
+            lambda _f: loop.call_soon_threadsafe(q.put_nowait, done)
+        )
+        resp = web.StreamResponse()
+        resp.headers["Content-Type"] = "text/event-stream"
+        resp.headers["Cache-Control"] = "no-cache"
+        resp.headers["X-Accel-Buffering"] = "no"
+        await resp.prepare(req)
+        ids: list = []
+        text = ""
+        try:
+            while True:
+                tok = await q.get()
+                if tok is done:
+                    break
+                ids.append(tok)
+                # Deltas must concatenate to the final text. A codepoint
+                # split across tokens decodes to a trailing U+FFFD that
+                # the NEXT token replaces (or raises, for a strict
+                # decoder) -- holding the unstable tail back (empty delta
+                # this event) keeps the concatenation exact instead of
+                # leaking replacement chars.
+                try:
+                    full = decode(ids)
+                except (UnicodeDecodeError, ValueError):
+                    full = None
+                if (full is not None and full.startswith(text)
+                        and not full.endswith("�")):
+                    delta, text = full[len(text):], full
+                else:
+                    delta = ""
+                await resp.write(
+                    b"data: " + json.dumps({
+                        "token_id": tok, "text_output": delta,
+                    }).encode() + b"\n\n"
+                )
+            if ids:
+                # Flush any withheld tail (stream ended mid-codepoint).
+                try:
+                    full = decode(ids)
+                except (UnicodeDecodeError, ValueError):
+                    full = text
+                tail = full[len(text):] if full.startswith(text) else full
+                if tail:
+                    await resp.write(
+                        b"data: " + json.dumps(
+                            {"text_output": tail}
+                        ).encode() + b"\n\n"
+                    )
+            exc = fut.exception()
+            if exc is not None:
+                self.error_count += 1
+                await resp.write(
+                    b"data: " + json.dumps({"error": str(exc)}).encode()
+                    + b"\n\n"
+                )
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+        except (ConnectionResetError, asyncio.CancelledError):
+            # Client went away mid-stream: the engine request keeps
+            # running to completion (slot freed by budget/EOS); nothing
+            # to clean up here beyond dropping the queue.
+            pass
+        finally:
+            self.predict_seconds += time.monotonic() - t0
+        return resp
 
     # -- payload logging (S6) ----------------------------------------------
 

@@ -220,6 +220,25 @@ def test_jax_llm_isvc_end_to_end(cp_client):
         assert isinstance(preds[0]["text"], str)
         assert len(preds[1]["token_ids"]) == 3
 
+        # SSE streaming through the activator passthrough: one event per
+        # token, then [DONE]; token ids must match a non-streaming run.
+        r = await client.post(
+            "/serving/default/llm/v2/models/llm/generate_stream",
+            json={"text_input": "hello tpu", "max_new_tokens": 4},
+        )
+        assert r.status == 200, await r.text()
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        events = []
+        async for line in r.content:
+            line = line.decode().strip()
+            if line.startswith("data: "):
+                events.append(line[len("data: "):])
+        assert events[-1] == "[DONE]"
+        toks = [json.loads(e)["token_id"] for e in events[:-1]]
+        assert len(toks) == 4
+        # Greedy: the streamed ids equal the buffered predict's ids.
+        assert toks == preds[0]["token_ids"]
+
     loop.run_until_complete(run())
 
 

@@ -393,3 +393,136 @@ class TestOptionalBoosterRuntimes:
         m.load()
         out = m.predict([[0.0], [3.0]])
         assert len(out) == 2
+
+
+# -- V2 generate extension (streaming) -------------------------------------
+
+
+class FakeStreamModel(Model):
+    """Deterministic streaming model: emits fixed byte tokens."""
+
+    def __init__(self, name="gen", tokens=(104, 105, 33)):  # "hi!"
+        super().__init__(name)
+        self.tokens = list(tokens)
+        self.ready = True
+
+    def submit_stream(self, instance, on_token):
+        import concurrent.futures
+        import threading
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def run():
+            for t in self.tokens:
+                if on_token is not None:
+                    on_token(t)
+            fut.set_result(self.tokens)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut, lambda ids: bytes(ids).decode(errors="replace")
+
+
+@pytest.fixture
+def stream_client():
+    async def make():
+        repo = ModelRepository()
+        repo.register(FakeStreamModel())
+        echo = EchoModel("plain", "/models/plain", {})
+        repo.register(echo)
+        echo.load()
+        server = ModelServer(repository=repo)
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        return client
+
+    loop = asyncio.new_event_loop()
+    c = loop.run_until_complete(make())
+    yield c, loop
+    loop.run_until_complete(c.close())
+    loop.close()
+
+
+def test_v2_generate_stream_sse(stream_client):
+    c, loop = stream_client
+
+    async def run():
+        r = await c.post("/v2/models/gen/generate_stream",
+                         json={"text_input": "x"})
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        events = []
+        async for line in r.content:
+            line = line.decode().strip()
+            if line.startswith("data: "):
+                events.append(line[len("data: "):])
+        assert events[-1] == "[DONE]"
+        import json as _json
+
+        parsed = [_json.loads(e) for e in events[:-1]]
+        assert [p["token_id"] for p in parsed] == [104, 105, 33]
+        assert "".join(p["text_output"] for p in parsed) == "hi!"
+
+    loop.run_until_complete(run())
+
+
+def test_v2_generate_buffered(stream_client):
+    c, loop = stream_client
+
+    async def run():
+        r = await c.post("/v2/models/gen/generate",
+                         json={"text_input": "x"})
+        assert r.status == 200
+        body = await r.json()
+        assert body["text_output"] == "hi!"
+        assert body["token_ids"] == [104, 105, 33]
+
+    loop.run_until_complete(run())
+
+
+def test_generate_stream_unsupported_model_501(stream_client):
+    c, loop = stream_client
+
+    async def run():
+        r = await c.post("/v2/models/plain/generate_stream",
+                         json={"text_input": "x"})
+        assert r.status == 501
+
+    loop.run_until_complete(run())
+
+
+def test_v2_generate_stream_multibyte_codepoint():
+    """A codepoint split across tokens must not leak U+FFFD into the
+    delta concatenation (0xC3,0xA9 = 'é')."""
+
+    async def run():
+        repo = ModelRepository()
+        repo.register(FakeStreamModel("mb", tokens=(195, 169, 33)))  # é!
+        c2 = TestClient(TestServer(ModelServer(repository=repo).build_app()))
+        await c2.start_server()
+        try:
+            r = await c2.post("/v2/models/mb/generate_stream",
+                              json={"text_input": "x"})
+            assert r.status == 200
+            events = []
+            async for line in r.content:
+                line = line.decode().strip()
+                if line.startswith("data: "):
+                    events.append(line[len("data: "):])
+            assert events[-1] == "[DONE]"
+            import json as _json
+
+            parsed = [_json.loads(e) for e in events[:-1]]
+            text = "".join(p["text_output"] for p in parsed)
+            assert text == "é!"
+            assert "�" not in text
+            # Per-token events still carried every token id.
+            assert [p["token_id"] for p in parsed if "token_id" in p] == [
+                195, 169, 33]
+        finally:
+            await c2.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(run())
+    finally:
+        loop.close()
