@@ -104,9 +104,11 @@ class _Replica:
     """Controller-side record of one running server process."""
 
     def __init__(self, index: int, port: int, ref: WorkerRef,
-                 comp_fp: Optional[str] = None) -> None:
+                 comp_fp: Optional[str] = None,
+                 grpc_port: Optional[int] = None) -> None:
         self.index = index
         self.port = port
+        self.grpc_port = grpc_port
         self.ref = ref
         self.ready = False
         self.in_flight = 0  # proxied requests on this replica (drain gate)
@@ -122,6 +124,7 @@ class _Replica:
         return ReplicaInfo(
             index=self.index,
             port=self.port,
+            grpc_port=self.grpc_port,
             pid=self.ref.pid,
             state=ReplicaState.Ready if self.ready else ReplicaState.Pending,
             started_at=self.started_at,
@@ -880,14 +883,19 @@ class ISVCController:
                     break
             svc.next_index += 1
             port = allocate_port()
-            req = self._spawn_request(isvc, comp, index, port, key)
+            # Bundled runtimes serve OIP gRPC alongside HTTP; custom
+            # entrypoints aren't assumed to accept the flag.
+            grpc_port = allocate_port() if comp.custom is None else None
+            req = self._spawn_request(isvc, comp, index, port, key,
+                                      grpc_port=grpc_port)
             try:
                 ref = await self.launcher.spawn(req)
             except Exception:
                 if res_key is not None:
                     self.gang.release(res_key)
                 raise
-            rep = _Replica(index, port, ref, comp_fp=comp_fp)
+            rep = _Replica(index, port, ref, comp_fp=comp_fp,
+                           grpc_port=grpc_port)
             rep.res_key = res_key
             svc.replicas[index] = rep
             current[index] = rep
@@ -946,7 +954,8 @@ class ISVCController:
 
     def _spawn_request(self, isvc: InferenceService, comp: ComponentSpec,
                        index: int, port: int,
-                       service_key: Optional[str] = None) -> SpawnRequest:
+                       service_key: Optional[str] = None,
+                       grpc_port: Optional[int] = None) -> SpawnRequest:
         ns, name = isvc.metadata.namespace, isvc.metadata.name
         service_key = service_key or f"{ns}/{name}"
         env = {"PORT": str(port)}
@@ -993,6 +1002,8 @@ class ISVCController:
                 ]
                 if m.storage_uri:
                     args += ["--storage-uri", m.storage_uri]
+            if grpc_port:
+                args += ["--grpc-port", str(grpc_port)]
         if comp.logger is not None:
             # Part of the runtime flag contract (runtimes/common.py);
             # custom entrypoints opting into logger: must accept it too.

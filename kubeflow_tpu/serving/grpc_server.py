@@ -1,0 +1,369 @@
+"""gRPC transport for the Open Inference Protocol (V2).
+
+The reference's model server speaks the V2 protocol over REST *and* gRPC
+(SURVEY.md 3.3 S4); this is the gRPC side, backed by the SAME
+ModelRepository and ModelServer.v2_infer core as the aiohttp routes --
+the transports are thin codecs over one inference path.
+
+Service wiring uses grpc.method_handlers_generic_handler over the
+protoc-generated messages (kubeflow_tpu/serving/oip.proto ->
+oip_pb2.py), so no grpcio-tools plugin is needed at build time.
+
+Edge note: the activator/ingress is an L7 HTTP proxy; gRPC is served
+per-replica (the controller allocates and reports a grpc_port per
+replica) rather than through the activator. This mirrors the reference's
+split, where gRPC rides the mesh gateway, not the Knative activator's
+HTTP buffer path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from kubeflow_tpu.serving import oip_pb2 as pb
+from kubeflow_tpu.serving.model import InferenceError
+
+logger = logging.getLogger(__name__)
+
+SERVICE = "inference.GRPCInferenceService"
+
+# OIP datatype -> (InferTensorContents field, numpy dtype for flattening)
+_DTYPE_FIELDS = {
+    "BOOL": ("bool_contents", np.bool_),
+    "INT8": ("int_contents", np.int32),
+    "INT16": ("int_contents", np.int32),
+    "INT32": ("int_contents", np.int32),
+    "INT64": ("int64_contents", np.int64),
+    "UINT8": ("uint_contents", np.uint32),
+    "UINT16": ("uint_contents", np.uint32),
+    "UINT32": ("uint_contents", np.uint32),
+    "UINT64": ("uint64_contents", np.uint64),
+    "FP16": ("fp32_contents", np.float32),
+    "FP32": ("fp32_contents", np.float32),
+    "FP64": ("fp64_contents", np.float64),
+    "BYTES": ("bytes_contents", None),
+}
+
+
+_RAW_NP_DTYPES = {
+    "BOOL": np.bool_, "INT8": np.int8, "INT16": np.int16,
+    "INT32": np.int32, "INT64": np.int64, "UINT8": np.uint8,
+    "UINT16": np.uint16, "UINT32": np.uint32, "UINT64": np.uint64,
+    "FP16": np.float16, "FP32": np.float32, "FP64": np.float64,
+}
+
+
+def _zip_raw(inputs, raw_list):
+    """Pair each input tensor with its raw_input_contents entry, if the
+    client used the raw representation (positional, one per tensor)."""
+    raw_list = list(raw_list)
+    for i, t in enumerate(inputs):
+        yield t, (raw_list[i] if i < len(raw_list) else None)
+
+
+def _decode_raw(datatype: str, raw: bytes) -> list:
+    """OIP raw tensor representation -> flat python list. BYTES elements
+    are 4-byte little-endian length-prefixed; numeric types are packed
+    little-endian arrays."""
+    if datatype == "BYTES":
+        out, off = [], 0
+        while off + 4 <= len(raw):
+            n = int.from_bytes(raw[off:off + 4], "little")
+            off += 4
+            out.append(raw[off:off + n].decode("utf-8", errors="replace"))
+            off += n
+        return out
+    dt = _RAW_NP_DTYPES.get(datatype, np.float32)
+    return np.frombuffer(raw, dtype=dt).tolist()
+
+
+def tensor_to_dict(t: "pb.ModelInferRequest.InferInputTensor",
+                   raw: Optional[bytes] = None) -> dict:
+    """Proto input tensor -> the V2 JSON-shaped dict the model sees.
+
+    Standard OIP clients (Triton/KServe defaults) ship tensor data in
+    ModelInferRequest.raw_input_contents rather than the typed contents
+    fields -- both representations are accepted."""
+    if raw:
+        data = _decode_raw(t.datatype, raw)
+    else:
+        field, _ = _DTYPE_FIELDS.get(t.datatype,
+                                     ("fp32_contents", np.float32))
+        data = list(getattr(t.contents, field))
+        if t.datatype == "BYTES":
+            data = [b.decode("utf-8", errors="replace") for b in data]
+    return {
+        "name": t.name, "datatype": t.datatype,
+        "shape": list(t.shape), "data": data,
+    }
+
+
+def dict_to_tensor(d: dict) -> "pb.ModelInferResponse.InferOutputTensor":
+    """V2 JSON-shaped output dict -> proto output tensor."""
+    out = pb.ModelInferResponse.InferOutputTensor(
+        name=str(d.get("name", "output_0")),
+        datatype=str(d.get("datatype", "FP32")),
+    )
+    shape = d.get("shape")
+    data = d.get("data", [])
+    if d.get("datatype") == "BYTES":
+        flat = [
+            x if isinstance(x, bytes) else str(x).encode()
+            for x in np.asarray(data, dtype=object).reshape(-1)
+        ]
+        out.shape.extend(shape if shape is not None else [len(flat)])
+        out.contents.bytes_contents.extend(flat)
+        return out
+    field, np_dtype = _DTYPE_FIELDS.get(
+        out.datatype, ("fp32_contents", np.float32)
+    )
+    try:
+        arr = np.asarray(data, dtype=np_dtype)
+    except (TypeError, ValueError):
+        # Arbitrary JSON outputs (echo/custom models whose postprocess
+        # returns dicts): a typed tensor can't hold them -- ship each
+        # element as JSON in a BYTES tensor, mirroring what the REST
+        # transport serializes.
+        import json
+
+        flat = [
+            json.dumps(x).encode()
+            for x in np.asarray(data, dtype=object).reshape(-1)
+        ]
+        out.datatype = "BYTES"
+        out.shape.extend(shape if shape is not None else [len(flat)])
+        out.contents.bytes_contents.extend(flat)
+        return out
+    out.shape.extend(shape if shape is not None else list(arr.shape))
+    getattr(out.contents, field).extend(arr.reshape(-1).tolist())
+    return out
+
+
+def _grpc_status(e: Exception) -> grpc.StatusCode:
+    status = e.status if isinstance(e, InferenceError) else 500
+    return {
+        400: grpc.StatusCode.INVALID_ARGUMENT,
+        404: grpc.StatusCode.NOT_FOUND,
+        409: grpc.StatusCode.FAILED_PRECONDITION,
+        501: grpc.StatusCode.UNIMPLEMENTED,
+        503: grpc.StatusCode.UNAVAILABLE,
+    }.get(status, grpc.StatusCode.INTERNAL)
+
+
+class OIPServicer:
+    """GRPCInferenceService over a ModelServer (shared repository/core)."""
+
+    def __init__(self, server) -> None:
+        self.server = server  # ModelServer
+        self.repo = server.repository
+
+    async def ServerLive(self, request, context):
+        return pb.ServerLiveResponse(live=True)
+
+    async def ServerReady(self, request, context):
+        return pb.ServerReadyResponse(ready=self.server._ready())
+
+    async def ModelReady(self, request, context):
+        try:
+            model = self.repo.get(request.name)
+        except InferenceError:
+            return pb.ModelReadyResponse(ready=False)
+        return pb.ModelReadyResponse(ready=model.ready)
+
+    async def ServerMetadata(self, request, context):
+        return pb.ServerMetadataResponse(
+            name=self.server.name, version="2",
+            extensions=["model_repository"],
+        )
+
+    async def ModelMetadata(self, request, context):
+        try:
+            meta = self.repo.get(request.name).metadata()
+        except Exception as e:  # noqa: BLE001
+            await context.abort(_grpc_status(e), str(e))
+        resp = pb.ModelMetadataResponse(
+            name=meta.get("name", request.name),
+            platform=meta.get("platform", "kftpu"),
+        )
+        for key, dest in (("inputs", resp.inputs), ("outputs", resp.outputs)):
+            for t in meta.get(key) or []:
+                dest.add(name=t.get("name", ""),
+                         datatype=t.get("datatype", ""),
+                         shape=t.get("shape") or [])
+        return resp
+
+    async def ModelInfer(self, request, context):
+        import time
+
+        self.server.request_count += 1
+        t0 = time.monotonic()
+        try:
+            inputs = [
+                tensor_to_dict(t, raw)
+                for t, raw in _zip_raw(request.inputs,
+                                       request.raw_input_contents)
+            ]
+            # S6 payload logging: same audit trail as the REST route.
+            rid = ""
+            if self.server.payload_logger is not None:
+                rid = request.id or self.server.payload_logger.new_id()
+                await self.server.payload_logger.log_request(
+                    request.model_name, {"inputs": inputs}, rid
+                )
+            outputs = await self.server.v2_infer(request.model_name, inputs)
+        except Exception as e:  # noqa: BLE001
+            self.server.error_count += 1
+            await context.abort(_grpc_status(e), str(e))
+        finally:
+            self.server.predict_seconds += time.monotonic() - t0
+        resp = pb.ModelInferResponse(
+            model_name=request.model_name, id=request.id,
+        )
+        resp.outputs.extend(dict_to_tensor(d) for d in outputs)
+        if self.server.payload_logger is not None:
+            await self.server._log_response(
+                request.model_name,
+                {"model_name": request.model_name, "outputs": outputs},
+                rid,
+            )
+        return resp
+
+    async def RepositoryModelLoad(self, request, context):
+        try:
+            params = request.parameters
+            uri = (params["storage_uri"].string_param
+                   if "storage_uri" in params else None)
+            opts_raw = (params["options"].string_param
+                        if "options" in params else "")
+            if uri is not None or opts_raw:
+                import json
+
+                await self.repo.load_dynamic_async(
+                    request.model_name, uri,
+                    json.loads(opts_raw) if opts_raw else {},
+                )
+            else:
+                self.repo.load(request.model_name)
+        except Exception as e:  # noqa: BLE001
+            await context.abort(_grpc_status(e), str(e))
+        return pb.RepositoryModelLoadResponse()
+
+    async def RepositoryModelUnload(self, request, context):
+        try:
+            if self.repo.multi_model:
+                self.repo.evict(request.model_name)
+            else:
+                self.repo.unload(request.model_name)
+        except Exception as e:  # noqa: BLE001
+            await context.abort(_grpc_status(e), str(e))
+        return pb.RepositoryModelUnloadResponse()
+
+
+def _handlers(servicer: OIPServicer) -> grpc.GenericRpcHandler:
+    def unary(method, req_cls, resp_cls):
+        return grpc.unary_unary_rpc_method_handler(
+            method,
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+
+    return grpc.method_handlers_generic_handler(SERVICE, {
+        "ServerLive": unary(servicer.ServerLive, pb.ServerLiveRequest,
+                            pb.ServerLiveResponse),
+        "ServerReady": unary(servicer.ServerReady, pb.ServerReadyRequest,
+                             pb.ServerReadyResponse),
+        "ModelReady": unary(servicer.ModelReady, pb.ModelReadyRequest,
+                            pb.ModelReadyResponse),
+        "ServerMetadata": unary(servicer.ServerMetadata,
+                                pb.ServerMetadataRequest,
+                                pb.ServerMetadataResponse),
+        "ModelMetadata": unary(servicer.ModelMetadata,
+                               pb.ModelMetadataRequest,
+                               pb.ModelMetadataResponse),
+        "ModelInfer": unary(servicer.ModelInfer, pb.ModelInferRequest,
+                            pb.ModelInferResponse),
+        "RepositoryModelLoad": unary(servicer.RepositoryModelLoad,
+                                     pb.RepositoryModelLoadRequest,
+                                     pb.RepositoryModelLoadResponse),
+        "RepositoryModelUnload": unary(servicer.RepositoryModelUnload,
+                                       pb.RepositoryModelUnloadRequest,
+                                       pb.RepositoryModelUnloadResponse),
+    })
+
+
+async def start_grpc(model_server, host: str, port: int) -> grpc.aio.Server:
+    """Start the asyncio gRPC server on the running event loop (same loop
+    as the aiohttp app: the repository's batchers live there)."""
+    server = grpc.aio.server()
+    server.add_generic_rpc_handlers((_handlers(OIPServicer(model_server)),))
+    server.add_insecure_port(f"{host}:{port}")
+    await server.start()
+    logger.info("OIP gRPC listening on %s:%d", host, port)
+    return server
+
+
+# -- client helpers (tests / SDK) -------------------------------------------
+
+
+def client_stubs(channel: grpc.Channel) -> dict:
+    """Method-name -> callable stubs for a (sync or aio) channel, built
+    without generated *_pb2_grpc code."""
+    def u(name, req_cls, resp_cls):
+        return channel.unary_unary(
+            f"/{SERVICE}/{name}",
+            request_serializer=req_cls.SerializeToString,
+            response_deserializer=resp_cls.FromString,
+        )
+
+    return {
+        "ServerLive": u("ServerLive", pb.ServerLiveRequest,
+                        pb.ServerLiveResponse),
+        "ServerReady": u("ServerReady", pb.ServerReadyRequest,
+                         pb.ServerReadyResponse),
+        "ModelReady": u("ModelReady", pb.ModelReadyRequest,
+                        pb.ModelReadyResponse),
+        "ServerMetadata": u("ServerMetadata", pb.ServerMetadataRequest,
+                            pb.ServerMetadataResponse),
+        "ModelMetadata": u("ModelMetadata", pb.ModelMetadataRequest,
+                           pb.ModelMetadataResponse),
+        "ModelInfer": u("ModelInfer", pb.ModelInferRequest,
+                        pb.ModelInferResponse),
+        "RepositoryModelLoad": u("RepositoryModelLoad",
+                                 pb.RepositoryModelLoadRequest,
+                                 pb.RepositoryModelLoadResponse),
+        "RepositoryModelUnload": u("RepositoryModelUnload",
+                                   pb.RepositoryModelUnloadRequest,
+                                   pb.RepositoryModelUnloadResponse),
+    }
+
+
+def infer_request(model: str, inputs: list,
+                  request_id: str = "") -> "pb.ModelInferRequest":
+    """Build a ModelInferRequest from V2 JSON-shaped input dicts."""
+    req = pb.ModelInferRequest(model_name=model, id=request_id)
+    for d in inputs:
+        t = req.inputs.add(
+            name=str(d.get("name", "input_0")),
+            datatype=str(d.get("datatype", "FP32")),
+        )
+        data = d.get("data", [])
+        arr = np.asarray(data, dtype=object if d.get("datatype") == "BYTES"
+                         else None)
+        t.shape.extend(d.get("shape") or list(np.shape(data)))
+        if d.get("datatype") == "BYTES":
+            t.contents.bytes_contents.extend(
+                x if isinstance(x, bytes) else str(x).encode()
+                for x in arr.reshape(-1)
+            )
+        else:
+            field, np_dtype = _DTYPE_FIELDS.get(
+                t.datatype, ("fp32_contents", np.float32)
+            )
+            flat = np.asarray(data, dtype=np_dtype).reshape(-1)
+            getattr(t.contents, field).extend(flat.tolist())
+    return req

@@ -40,6 +40,10 @@ def serve_main(factory: ModelFactory, argv=None) -> int:
                    help="where storage is materialized (default: ./models)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=int(os.environ.get("PORT", "8080")))
+    p.add_argument("--grpc-port", type=int,
+                   default=int(os.environ.get("GRPC_PORT", "0")),
+                   help="serve the Open Inference Protocol over gRPC on "
+                        "this port too (0 = HTTP only)")
     p.add_argument("--options-json", default="{}",
                    help="format-specific options (ModelSpec.options)")
     p.add_argument("--max-batch", type=int, default=32)
@@ -90,6 +94,8 @@ def serve_main(factory: ModelFactory, argv=None) -> int:
     server = ModelServer(
         repository=repo,
         payload_logger=payload_logger.from_json(args.logger_json),
+        grpc_port=args.grpc_port,
+        grpc_host=args.host,
     )
     logging.getLogger(__name__).info(
         "serving %s on %s:%d (model path %s)",

@@ -39,11 +39,16 @@ logger = logging.getLogger(__name__)
 class ModelServer:
     def __init__(self, repository: Optional[ModelRepository] = None,
                  name: str = "kftpu-modelserver",
-                 payload_logger=None) -> None:
+                 payload_logger=None, grpc_port: int = 0,
+                 grpc_host: str = "127.0.0.1") -> None:
         self.name = name
         self.repository = repository or ModelRepository()
         # S6 request/response logger (serving.payload_logger), optional.
         self.payload_logger = payload_logger
+        # OIP gRPC transport (serving/grpc_server.py); 0 = HTTP only.
+        self.grpc_port = grpc_port
+        self.grpc_host = grpc_host
+        self._grpc_server = None
         self.started_at = time.time()
         self.request_count = 0
         self.error_count = 0
@@ -75,8 +80,17 @@ class ModelServer:
 
         async def on_startup(app):
             self.repository.start()
+            if self.grpc_port:
+                from kubeflow_tpu.serving.grpc_server import start_grpc
+
+                self._grpc_server = await start_grpc(
+                    self, self.grpc_host, self.grpc_port
+                )
 
         async def on_cleanup(app):
+            if self._grpc_server is not None:
+                await self._grpc_server.stop(grace=2.0)
+                self._grpc_server = None
             await self.repository.stop()
             if self.payload_logger is not None:
                 await self.payload_logger.close()
@@ -193,33 +207,39 @@ class ModelServer:
             return self._err(e)
         return web.json_response({"name": model.name, "ready": model.ready})
 
+    async def v2_infer(self, name: str, inputs: list) -> list:
+        """The V2 infer core, shared by the REST route and the gRPC
+        ModelInfer servicer: readiness, batcher fan-out, output
+        normalization. Returns the V2 output-tensor dicts."""
+        model = self.repository.get(name)
+        if not model.ready:
+            raise InferenceError(f"model {name} is not ready", status=503)
+        self.repository.touch(name)  # LRU recency for multi-model
+        if not isinstance(inputs, list) or not inputs:
+            raise InferenceError('body must have "inputs": [...]', status=400)
+        batcher = self.repository.batcher(name)
+        # V2 tensors ride through preprocess/predict as dicts; simple
+        # models treat input.data as the instance list.
+        pre = model.preprocess({"inputs": inputs})
+        instances = pre["inputs"] if isinstance(pre, dict) and "inputs" in pre else pre
+        outs = await asyncio.gather(*(batcher.predict(i) for i in instances))
+        outputs = model.postprocess(outs)
+        if not (isinstance(outputs, list) and outputs
+                and isinstance(outputs[0], dict) and "data" in outputs[0]):
+            outputs = [{
+                "name": "output_0", "datatype": "FP32",
+                "shape": [len(outs)], "data": outputs,
+            }]
+        return outputs
+
     async def h_v2_infer(self, req: web.Request) -> web.Response:
         name = req.match_info["m"]
         self.request_count += 1
         t0 = time.monotonic()
         try:
-            model = self.repository.get(name)
-            if not model.ready:
-                raise InferenceError(f"model {name} is not ready", status=503)
-            self.repository.touch(name)  # LRU recency for multi-model
             body = await req.json()
-            inputs = body.get("inputs")
-            if not isinstance(inputs, list) or not inputs:
-                raise InferenceError('body must have "inputs": [...]', status=400)
             rid = await self._log_request(name, body, req)
-            batcher = self.repository.batcher(name)
-            # V2 tensors ride through preprocess/predict as dicts; simple
-            # models treat input.data as the instance list.
-            pre = model.preprocess({"inputs": inputs})
-            instances = pre["inputs"] if isinstance(pre, dict) and "inputs" in pre else pre
-            outs = await asyncio.gather(*(batcher.predict(i) for i in instances))
-            outputs = model.postprocess(outs)
-            if not (isinstance(outputs, list) and outputs
-                    and isinstance(outputs[0], dict) and "data" in outputs[0]):
-                outputs = [{
-                    "name": "output_0", "datatype": "FP32",
-                    "shape": [len(outs)], "data": outputs,
-                }]
+            outputs = await self.v2_infer(name, body.get("inputs"))
             resp = {
                 "model_name": name, "id": body.get("id", ""), "outputs": outputs,
             }

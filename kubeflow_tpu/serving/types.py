@@ -128,6 +128,11 @@ class ReplicaInfo(BaseModel):
 
     index: int
     port: int
+    # OIP gRPC port (serving/grpc_server.py). gRPC is served per-replica
+    # and advertised here; the activator edge stays HTTP (its cold-start
+    # buffer is an L7 HTTP mechanism, as in the reference where gRPC
+    # rides the mesh gateway rather than the Knative activator).
+    grpc_port: Optional[int] = None
     pid: Optional[int] = None
     state: ReplicaState = ReplicaState.Pending
     started_at: float = 0.0

@@ -1,0 +1,170 @@
+"""gRPC Open Inference Protocol transport tests (SURVEY.md 3.3 S4: the
+reference serves V2 over REST and gRPC; this drives the gRPC side against
+the same repository as the REST tests)."""
+
+import asyncio
+
+import grpc
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeflow_tpu.serving.grpc_server import client_stubs, infer_request
+from kubeflow_tpu.serving import oip_pb2 as pb
+from kubeflow_tpu.serving.model import ModelRepository
+from kubeflow_tpu.serving.runtimes.echo_server import EchoModel
+from kubeflow_tpu.serving.server import ModelServer
+from kubeflow_tpu.utils.ports import allocate_port
+
+
+@pytest.fixture
+def grpc_server():
+    """ModelServer with HTTP + gRPC transports over one repository."""
+    port = allocate_port()
+    loop = asyncio.new_event_loop()
+
+    async def make():
+        repo = ModelRepository()
+        model = EchoModel("demo", "/models/demo", {})
+        repo.register(model)
+        model.load()
+        server = ModelServer(repository=repo, grpc_port=port)
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()  # startup hook boots the gRPC server
+        return client
+
+    c = loop.run_until_complete(make())
+    yield c, loop, port
+    loop.run_until_complete(c.close())
+    loop.close()
+
+
+def test_grpc_health_and_metadata(grpc_server):
+    _c, loop, port = grpc_server
+
+    async def run():
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+            stubs = client_stubs(ch)
+            assert (await stubs["ServerLive"](pb.ServerLiveRequest())).live
+            assert (await stubs["ServerReady"](pb.ServerReadyRequest())).ready
+            r = await stubs["ModelReady"](pb.ModelReadyRequest(name="demo"))
+            assert r.ready
+            r = await stubs["ModelReady"](pb.ModelReadyRequest(name="nope"))
+            assert not r.ready
+            meta = await stubs["ServerMetadata"](pb.ServerMetadataRequest())
+            assert meta.version == "2"
+            assert "model_repository" in meta.extensions
+            mm = await stubs["ModelMetadata"](
+                pb.ModelMetadataRequest(name="demo")
+            )
+            assert mm.name == "demo"
+
+    loop.run_until_complete(run())
+
+
+def test_grpc_model_infer_matches_rest(grpc_server):
+    """The same infer through gRPC and REST must produce the same
+    outputs -- both transports sit on ModelServer.v2_infer."""
+    c, loop, port = grpc_server
+    inputs = [{"name": "x", "datatype": "FP32", "shape": [3],
+               "data": [1.0, 2.0, 3.0]}]
+
+    async def run():
+        r = await c.post("/v2/models/demo/infer", json={"inputs": inputs})
+        assert r.status == 200
+        rest = await r.json()
+
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+            stubs = client_stubs(ch)
+            resp = await stubs["ModelInfer"](infer_request("demo", inputs))
+        assert resp.model_name == "demo"
+        assert len(resp.outputs) == len(rest["outputs"])
+        got = list(resp.outputs[0].contents.fp32_contents) or list(
+            resp.outputs[0].contents.bytes_contents
+        )
+        assert got or resp.outputs[0].shape == rest["outputs"][0]["shape"]
+
+    loop.run_until_complete(run())
+
+
+def test_grpc_infer_unknown_model_not_found(grpc_server):
+    _c, loop, port = grpc_server
+
+    async def run():
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+            stubs = client_stubs(ch)
+            with pytest.raises(grpc.aio.AioRpcError) as ei:
+                await stubs["ModelInfer"](infer_request("nope", [
+                    {"name": "x", "datatype": "FP32", "shape": [1],
+                     "data": [1.0]},
+                ]))
+            assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+    loop.run_until_complete(run())
+
+
+def test_grpc_repository_load_unload(grpc_server):
+    _c, loop, port = grpc_server
+
+    async def run():
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+            stubs = client_stubs(ch)
+            await stubs["RepositoryModelUnload"](
+                pb.RepositoryModelUnloadRequest(model_name="demo")
+            )
+            r = await stubs["ModelReady"](pb.ModelReadyRequest(name="demo"))
+            assert not r.ready
+            await stubs["RepositoryModelLoad"](
+                pb.RepositoryModelLoadRequest(model_name="demo")
+            )
+            r = await stubs["ModelReady"](pb.ModelReadyRequest(name="demo"))
+            assert r.ready
+
+    loop.run_until_complete(run())
+
+
+def test_bytes_tensor_roundtrip():
+    from kubeflow_tpu.serving.grpc_server import dict_to_tensor, tensor_to_dict
+
+    req = infer_request("m", [{"name": "s", "datatype": "BYTES",
+                               "shape": [2], "data": ["ab", "cd"]}])
+    d = tensor_to_dict(req.inputs[0])
+    assert d["data"] == ["ab", "cd"]
+    t = dict_to_tensor({"name": "s", "datatype": "BYTES", "shape": [2],
+                        "data": ["xy", "zw"]})
+    assert list(t.contents.bytes_contents) == [b"xy", b"zw"]
+
+
+def test_raw_input_contents_accepted(grpc_server):
+    """Standard OIP clients ship tensors via raw_input_contents; both
+    representations must infer identically."""
+    import numpy as np
+
+    _c, loop, port = grpc_server
+
+    async def run():
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+            stubs = client_stubs(ch)
+            req = pb.ModelInferRequest(model_name="demo")
+            t = req.inputs.add(name="x", datatype="FP32")
+            t.shape.extend([3])
+            req.raw_input_contents.append(
+                np.asarray([1.0, 2.0, 3.0], np.float32).tobytes()
+            )
+            resp = await stubs["ModelInfer"](req)
+            assert resp.outputs
+            echoed = resp.outputs[0].contents.bytes_contents[0]
+            assert b"[1.0, 2.0, 3.0]" in echoed, echoed
+
+    loop.run_until_complete(run())
+
+
+def test_raw_bytes_decoding():
+    from kubeflow_tpu.serving.grpc_server import _decode_raw
+
+    raw = b"".join(
+        len(s).to_bytes(4, "little") + s for s in (b"ab", b"xyz")
+    )
+    assert _decode_raw("BYTES", raw) == ["ab", "xyz"]
+    import numpy as np
+
+    assert _decode_raw("INT64", np.asarray([5, 6], np.int64).tobytes()) == [5, 6]

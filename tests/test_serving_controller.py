@@ -712,3 +712,46 @@ def test_multimodel_lru_eviction_in_replica(cp_client):
         assert body["models"] == ["lru-b"], body
 
     loop.run_until_complete(run())
+
+
+def test_replica_serves_grpc_oip(cp_client):
+    """Bundled-runtime replicas serve OIP gRPC alongside HTTP; the
+    controller allocates/advertises the port in status (SURVEY 3.3 S4)."""
+    import grpc as _grpc
+
+    from kubeflow_tpu.serving import oip_pb2 as pb
+    from kubeflow_tpu.serving.grpc_server import client_stubs, infer_request
+
+    cp, client, loop = cp_client
+
+    async def run():
+        spec = {
+            "metadata": {"name": "grpcecho"},
+            "spec": {"predictor": {
+                "model": {"format": "echo", "storage_uri": None},
+                "min_replicas": 1, "max_replicas": 1,
+            }},
+        }
+        r = await client.post("/apis/InferenceService", json=spec)
+        assert r.status == 200, await r.text()
+        await wait_for(
+            lambda: _status(cp, "grpcecho").get("predictor", {}).get(
+                "ready_replicas"),
+            msg="echo replica ready",
+        )
+        reps = _status(cp, "grpcecho")["predictor"]["replicas"]
+        gport = reps[0]["grpc_port"]
+        assert gport, reps
+
+        async with _grpc.aio.insecure_channel(f"127.0.0.1:{gport}") as ch:
+            stubs = client_stubs(ch)
+            assert (await stubs["ServerReady"](
+                pb.ServerReadyRequest())).ready
+            resp = await stubs["ModelInfer"](infer_request("grpcecho", [
+                {"name": "x", "datatype": "FP32", "shape": [2],
+                 "data": [1.0, 2.0]},
+            ]))
+            assert resp.model_name == "grpcecho"
+            assert resp.outputs
+
+    loop.run_until_complete(run())
