@@ -540,6 +540,7 @@ class GenerationEngine:
         mesh: Optional[jax.sharding.Mesh] = None,
         tensor_parallel: int = 1,
         prefill_chunk: int = 0,
+        max_prefill_tokens: int = 8192,
     ) -> None:
         # Max decode steps fused into one device program (power-of-2
         # sub-blocks keep the compile count bounded); 1 = per-token
@@ -551,6 +552,15 @@ class GenerationEngine:
         # stall active decoders for at most one chunk's duration instead
         # of the whole prompt. 0 disables (whole-prompt batched prefill).
         self.prefill_chunk = max(0, int(prefill_chunk))
+        # Admission budget for one batched prefill program, in PADDED
+        # tokens (K-bucket x len-bucket). The prefill's fp32 attention
+        # scores are K*heads*S^2 -- a 16-request burst of 2048-token
+        # prompts would materialize ~8 GB of scores and OOM the chip.
+        # Overflow waits in a backlog and prefills next step (vLLM's
+        # max_num_batched_tokens). A single over-budget prompt still
+        # admits alone.
+        self.max_prefill_tokens = max(0, int(max_prefill_tokens))
+        self._backlog: List[Request] = []  # engine-thread only
         cfg = config or PRESETS[preset]
         if max_seq is not None:
             cfg = dataclasses.replace(cfg, max_seq=max_seq)
@@ -727,14 +737,20 @@ class GenerationEngine:
         every sequence's KV into its slot. Serial per-prompt prefill was
         the throughput bottleneck at high request rates (one dispatch +
         an underfilled MXU per prompt)."""
-        while self.free_slots and not self.pending.empty():
+        while self.free_slots and (
+            self._backlog or not self.pending.empty()
+        ):
             reqs: List[Request] = []
             took_chunked = False
+            deferred = False
             while len(reqs) < len(self.free_slots):
-                try:
-                    req = self.pending.get_nowait()
-                except queue.Empty:
-                    break
+                if self._backlog:
+                    req = self._backlog.pop(0)
+                else:
+                    try:
+                        req = self.pending.get_nowait()
+                    except queue.Empty:
+                        break
                 if req.future.cancelled():
                     continue
                 if (self.prefill_chunk
@@ -747,9 +763,21 @@ class GenerationEngine:
                     self.prefilling[req.slot] = req
                     took_chunked = True
                     continue
+                if reqs and self.max_prefill_tokens:
+                    # Padded-token budget for ONE prefill program (the
+                    # fp32 scores scale with K x S^2). Over-budget: run
+                    # what we have; the deferred request leads the next
+                    # batch.
+                    k = _pow2_bucket(len(reqs) + 1)
+                    s = max(self._bucket(len(r.prompt))
+                            for r in reqs + [req])
+                    if k * s > self.max_prefill_tokens:
+                        self._backlog.insert(0, req)
+                        deferred = True
+                        break
                 reqs.append(req)
             if not reqs:
-                if took_chunked:
+                if took_chunked or deferred:
                     continue
                 return
             k_real = len(reqs)
@@ -947,3 +975,19 @@ class GenerationEngine:
             self._wake.set()
             self._thread.join(timeout=5)
             self._thread = None
+
+    def close(self) -> None:
+        """Release device memory (weights + KV cache) and the compiled
+        calls that close over them. The jit closures reference the engine
+        through ``self``, a reference CYCLE -- without an explicit break,
+        a dropped engine waits for the cyclic GC while its multi-GB HBM
+        buffers stay live, and the next engine OOMs. Unusable after."""
+        self.stop()
+        self.weights = None
+        self.cache_k = None
+        self.cache_v = None
+        self._decode_block_call = None
+        self._chunk_call = None
+        self._prefill = None
+        self._insert = None
+        self._sample = None

@@ -19,6 +19,9 @@ Options (ModelSpec.options):
 - ``prefill_chunk``: prompts longer than this prefill in chunks of this
   many tokens, interleaved with decode blocks, so one long admission
   never stalls active slots (default 0 = whole-prompt prefill)
+- ``max_prefill_tokens``: padded-token budget for one batched prefill
+  program (bounds the K x S^2 fp32 attention-score memory; overflow
+  prefills next step). Default 8192.
 - ``max_seq``: override cache length
 - ``tokenizer``: "byte" (default; ids = utf-8 bytes, self-contained) or a
   HF tokenizer name resolved from the local cache only (zero egress)
@@ -152,9 +155,9 @@ class JaxLLMModel(Model):
         from kubeflow_tpu.serving.engine import GenerationEngine
 
         if self.engine is not None:
-            # Repository re-load: stop the old scheduler thread and drop its
-            # KV cache before building a new engine (else both stay live).
-            self.engine.stop()
+            # Repository re-load: release the old engine's HBM (weights +
+            # KV cache) before building a new one (else both stay live).
+            self.engine.close()
             self.engine = None
         opts = self.options
         tok = opts.get("tokenizer", "byte")
@@ -205,6 +208,7 @@ class JaxLLMModel(Model):
             max_seq=opts.get("max_seq"),
             decode_block=int(opts.get("decode_block", 8)),
             prefill_chunk=int(opts.get("prefill_chunk", 0)),
+            max_prefill_tokens=int(opts.get("max_prefill_tokens", 8192)),
             mesh=mesh,
         )
         if config is not None:
@@ -224,7 +228,7 @@ class JaxLLMModel(Model):
 
     def unload(self) -> None:
         if self.engine is not None:
-            self.engine.stop()
+            self.engine.close()  # eviction must free HBM, not just the thread
             self.engine = None
         self.ready = False
 
