@@ -178,8 +178,25 @@ class HPOController:
                                f"algorithm={exp.spec.algorithm.name}")
 
         if exp.status.phase in ("Succeeded", "Failed"):
-            self._persist_experiment(exp, status_before)
-            return
+            if self._should_resume(exp):
+                # resume_policy=LongRunning (reference: Katib resumePolicy,
+                # SURVEY.md 5.4): the budget was RAISED after budget
+                # exhaustion -- clear the terminal state and fall through
+                # to normal reconcile, which spawns the next trials. The
+                # seeded suggesters are deterministic over trial history,
+                # so resuming continues the same search.
+                exp.status.set_condition(
+                    "Running", "Resumed",
+                    f"max_trial_count raised to {exp.spec.max_trial_count}",
+                )
+                exp.status.completion_time = None
+                self._record_event(
+                    ns, name, "ExperimentResumed",
+                    f"budget raised to {exp.spec.max_trial_count}",
+                )
+            else:
+                self._persist_experiment(exp, status_before)
+                return
 
         trials = self._child_trials(ns, name)
         running = [t for t in trials if not t.status.finished]
@@ -299,6 +316,24 @@ class HPOController:
         if trials or exp.status.trials_created:
             exp.status.set_condition("Running", "TrialsRunning")
         self._persist_experiment(exp, status_before)
+
+    @staticmethod
+    def _should_resume(exp: Experiment) -> bool:
+        """LongRunning experiments resume when the budget is raised past
+        the trial count that completed them. Only budget completions
+        resume: a reached GOAL is final (more trials can't improve on
+        "done"), and a Failed experiment stays failed."""
+        if exp.spec.resume_policy != "LongRunning":
+            return False
+        if exp.status.phase != "Succeeded":
+            return False
+        latest = next(
+            (c for c in reversed(exp.status.conditions)
+             if c.get("type") == "Succeeded" and c.get("status")), {},
+        )
+        if latest.get("reason") != "MaxTrialsReached":
+            return False
+        return exp.status.trials_created < exp.spec.max_trial_count
 
     def _create_trial(self, exp: Experiment, index: int, assignments) -> None:
         tname = f"{exp.metadata.name}-t{index:04d}"

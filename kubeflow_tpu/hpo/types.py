@@ -339,6 +339,11 @@ def validate_experiment(exp: Experiment) -> None:
                 raise ValueError(f"parameter {p.name}: {p.type.value} needs a list")
     if exp.spec.parallel_trial_count > exp.spec.max_trial_count:
         raise ValueError("parallel_trial_count must be <= max_trial_count")
+    if exp.spec.resume_policy not in ("Never", "LongRunning"):
+        raise ValueError(
+            f"resume_policy must be Never or LongRunning, "
+            f"got {exp.spec.resume_policy!r}"
+        )
     if not exp.spec.trial_template.job.get("spec"):
         raise ValueError("trial_template.job must have a spec")
     from kubeflow_tpu.hpo.algorithms import ALGORITHMS, HyperbandSuggester

@@ -890,3 +890,87 @@ def test_experiment_dashboard_drilldown(tmp_path):
             await client.close()
 
     asyncio.run(run())
+
+
+def test_resume_policy_long_running_resumes_on_budget_raise(tmp_path):
+    """resume_policy=LongRunning (SURVEY.md 5.4 / Katib resumePolicy):
+    after MaxTrialsReached, raising max_trial_count resumes the search;
+    the seeded suggester continues deterministically."""
+
+    async def run():
+        async with HPOHarness(tmp_path) as h:
+            obj = mk_experiment_obj(max_trials=2, parallel=2)
+            obj["spec"]["resume_policy"] = "LongRunning"
+            h.store.put("Experiment", obj)
+            for i in range(2):
+                name = f"exp1-t{i:04d}"
+                assert await h.wait(
+                    lambda n=name: any(
+                        r.worker_id == f"default/{n}/worker-0"
+                        for r in h.launcher.running()
+                    )
+                )
+                await h.finish_trial(name, 0.5 - 0.1 * i)
+            assert await h.wait(
+                lambda: any(c["type"] == "Succeeded" and c["status"]
+                            for c in h.exp()["status"]["conditions"])
+            )
+
+            # Raise the budget: the experiment must RESUME.
+            obj = h.exp()
+            obj["spec"]["max_trial_count"] = 4
+            h.store.put("Experiment", obj)
+            for i in range(2, 4):
+                name = f"exp1-t{i:04d}"
+                assert await h.wait(
+                    lambda n=name: any(
+                        r.worker_id == f"default/{n}/worker-0"
+                        for r in h.launcher.running()
+                    )
+                ), f"trial {name} never spawned after resume"
+                await h.finish_trial(name, 0.3 - 0.1 * (i - 2))
+            assert await h.wait(
+                lambda: h.exp()["status"]["trials_succeeded"] == 4
+                and any(c["type"] == "Succeeded" and c["status"]
+                        for c in h.exp()["status"]["conditions"])
+            ), h.exp()["status"]
+
+    asyncio.run(run())
+
+
+def test_resume_policy_never_stays_completed(tmp_path):
+    async def run():
+        async with HPOHarness(tmp_path) as h:
+            h.store.put("Experiment", mk_experiment_obj(max_trials=1, parallel=1))
+            assert await h.wait(
+                lambda: any(
+                    r.worker_id == "default/exp1-t0000/worker-0"
+                    for r in h.launcher.running()
+                )
+            )
+            await h.finish_trial("exp1-t0000", 0.5)
+            assert await h.wait(
+                lambda: any(c["type"] == "Succeeded" and c["status"]
+                            for c in h.exp()["status"]["conditions"])
+            )
+            obj = h.exp()
+            obj["spec"]["max_trial_count"] = 3
+            h.store.put("Experiment", obj)
+            await asyncio.sleep(0.5)
+            # Never: no new trials, still Succeeded.
+            assert len(h.trials()) == 1
+            assert any(c["type"] == "Succeeded" and c["status"]
+                       for c in h.exp()["status"]["conditions"])
+
+    asyncio.run(run())
+
+
+def test_resume_policy_unknown_rejected():
+    spec = make_exp_spec()
+    spec.resume_policy = "Sometimes"
+    exp = Experiment.from_dict({
+        "metadata": {"name": "e1"},
+        "spec": spec.model_dump(mode="json"),
+    })
+    with pytest.raises(ValueError, match="resume_policy"):
+        validate_experiment(exp)
