@@ -91,6 +91,11 @@ class _JobRuntime:
     metrics_armed: bool = False
     # On-disk MPI hostfile for this gang generation; removed at teardown.
     hostfile_path: Optional[str] = None
+    # Hang detection's step-progress memory: worker_id -> (last KFTPU-METRIC
+    # step value seen, when it last ADVANCED). Workers that emit the metric
+    # protocol are judged by step advance, not log mtime (SURVEY.md 5.3:
+    # spam in a warning loop is output, not progress).
+    step_seen: dict = field(default_factory=dict)
 
 
 class JobController:
@@ -715,23 +720,80 @@ class JobController:
 
         loop.call_later(timeout, check)
 
-    @staticmethod
-    def _freshest_output_age(rt: _JobRuntime) -> Optional[float]:
+    # Output-without-step-progress gets this multiple of the hang timeout
+    # before counting as hung: long legitimate non-step phases (final
+    # checkpoint save, eval between epochs) keep logging but emit no step
+    # lines, and must not be killed at 1x. Silence still hangs at 1x;
+    # chatty-but-stuck hangs at STEP_HANG_GRACE x.
+    STEP_HANG_GRACE = 5.0
+
+    def _freshest_output_age(self, rt: _JobRuntime) -> Optional[float]:
+        """EFFECTIVE age of the freshest progress signal across workers,
+        on the hang-timeout scale.
+
+        Workers emitting ``KFTPU-METRIC step=`` lines are judged by step
+        ADVANCE (a worker spinning in a warning loop produces output but
+        no progress) -- but chatty non-advance only counts as hung after
+        STEP_HANG_GRACE timeouts, so a long checkpoint/eval phase that
+        still logs isn't killed at 1x. The step clock is sticky: once a
+        worker has shown metric lines, spam scrolling them out of the
+        tail window doesn't downgrade it back to pure mtime."""
+        from kubeflow_tpu.runtime.metrics import parse_metric_line
+
         ages = []
         now = time.time()
-        for ref in rt.workers.values():
+        for wid, ref in rt.workers.items():
             lp = getattr(ref, "log_path", None)
-            if lp:
-                try:
-                    mtime = os.path.getmtime(lp)
-                except OSError:
-                    continue
-                # Logs are append-reused across gang generations: a fresh
-                # worker must get a full quiet-period budget from ITS
-                # spawn, not inherit the previous incarnation's mtime.
-                ages.append(
-                    now - max(mtime, getattr(ref, "spawned_at", 0.0))
-                )
+            if not lp:
+                continue
+            try:
+                mtime = os.path.getmtime(lp)
+            except OSError:
+                continue
+            # Logs are append-reused across gang generations: a fresh
+            # worker must get a full quiet-period budget from ITS
+            # spawn, not inherit the previous incarnation's mtime.
+            spawned = getattr(ref, "spawned_at", 0.0)
+            step = None
+            try:
+                with open(lp, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    f.seek(max(0, size - 16384))
+                    tail = f.read().decode("utf-8", errors="replace")
+                for line in reversed(tail.splitlines()):
+                    kv = parse_metric_line(line)
+                    if kv and "step" in kv:
+                        step = float(kv["step"])
+                        break
+            except (OSError, ValueError):
+                pass
+            last = rt.step_seen.get(wid)
+            if last is not None and last[1] < spawned:
+                # Per-replica respawn reused the worker id: the step
+                # counter may restart (resume-from-checkpoint); budget
+                # from THIS spawn.
+                last = None
+            silence_age = now - max(mtime, spawned)
+            if step is not None:
+                if last is None or step > last[0]:
+                    rt.step_seen[wid] = (step, now)
+                    last_ts = now
+                else:
+                    last_ts = last[1]
+                step_age = now - max(last_ts, spawned)
+            elif last is not None:
+                step_age = now - max(last[1], spawned)
+            else:
+                # Never emitted the metric protocol: mtime is the only
+                # signal.
+                ages.append(silence_age)
+                continue
+            # Effective age: silence counts at 1x; output without step
+            # advance counts at 1/GRACE (so it trips the SAME threshold
+            # after GRACE timeouts).
+            ages.append(max(silence_age,
+                            step_age / self.STEP_HANG_GRACE))
         return min(ages) if ages else None
 
     def _has_unprocessed_exits(self, victim_key: str) -> bool:

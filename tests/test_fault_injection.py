@@ -253,3 +253,71 @@ print("done", flush=True)
         store.close()
 
     asyncio.run(run())
+
+
+def test_hang_detection_catches_nonprogress_spam(tmp_path):
+    """SURVEY.md 5.3 step heartbeats: a worker spinning in a warning loop
+    keeps its log mtime fresh forever -- mtime-based liveness would never
+    fire. Workers that emit KFTPU-METRIC step= lines are judged by step
+    ADVANCE instead, so the spam incarnation is detected and restarted;
+    the respawned incarnation completes."""
+    worker_src = '''\
+import os, sys, time
+
+marker = os.environ["HANG_MARKER"]
+for i in range(3):
+    print(f"KFTPU-METRIC step={i} loss=1.0", flush=True)
+    time.sleep(0.05)
+if not os.path.exists(marker):
+    open(marker, "w").close()
+    while True:  # wedged-but-chatty: output without progress
+        print("WARNING: retrying flaky collective", flush=True)
+        time.sleep(0.05)
+print("done", flush=True)
+'''
+    (tmp_path / "spamworker.py").write_text(worker_src)
+    marker = tmp_path / "first_incarnation"
+
+    async def run():
+        from kubeflow_tpu.api.types import ObjectMeta
+
+        store = ObjectStore(":memory:")
+        job = apply_defaults(TrainJob(
+            kind=JobKind.JAXJob,
+            metadata=ObjectMeta(name="spam"),
+            spec=JobSpec(
+                replica_specs={
+                    ReplicaType.Worker: ReplicaSpec(
+                        replicas=1,
+                        restart_policy=RestartPolicy.OnFailure,
+                        template=ProcessTemplate(
+                            entrypoint="spamworker",
+                            env={
+                                "PYTHONPATH": str(tmp_path),
+                                "HANG_MARKER": str(marker),
+                            },
+                        ),
+                        resources=Resources(tpu=1),
+                    )
+                },
+                run_policy=RunPolicy(
+                    backoff_limit=2, hang_timeout_seconds=1.0
+                ),
+            ),
+        ))
+        phase, logs = await run_job_to_completion(
+            store, job, tmp_path / "logs", timeout=60
+        )
+        assert phase == "Succeeded", f"phase={phase} logs={logs}"
+        obj = store.get("JAXJob", "spam", "default")
+        assert obj["status"]["restart_count"] == 1
+        reasons = [
+            e["reason"] for e in store.list("Event")
+            if e.get("involved") == "default/spam"
+        ]
+        assert "HangDetected" in reasons, reasons
+        log = next(iter(logs.values()))
+        assert "WARNING: retrying" in log and "done" in log
+        store.close()
+
+    asyncio.run(run())
