@@ -185,6 +185,20 @@ class PipelineController:
                 limit = pl.spec.max_parallel_steps
                 if limit and running >= limit:
                     continue
+                if by_name[step].cache:
+                    hit = self._cache_lookup(pl, step)
+                    if hit is not None:
+                        # KFP execution-cache analog: identical rendered
+                        # template (params + upstream outputs baked in)
+                        # already Succeeded -- reuse its output, run
+                        # nothing.
+                        phases[step] = "Succeeded"
+                        pl.status.step_outputs[step] = hit
+                        pl.status.set_condition(
+                            "Running", "StepCacheHit",
+                            f"step {step!r} reused a cached result",
+                        )
+                        continue
                 created = self._create_step_job(pl, step, job_name)
                 if created:
                     phases[step] = "Running"
@@ -196,9 +210,28 @@ class PipelineController:
             if jphase == "Succeeded":
                 phases[step] = "Succeeded"
                 self._capture_output(pl, step)
+                if by_name[step].cache:
+                    self._cache_store(pl, step)
                 running = max(0, running - (1 if phase == "Running" else 0))
             elif jphase == "Failed":
-                phases[step] = "Failed"
+                used = pl.status.step_retries.get(step, 0)
+                if used < by_name[step].retry:
+                    # Argo retryStrategy analog: delete the failed job and
+                    # fall back to Pending; the deletion's watch event
+                    # re-reconciles and the create path re-renders a fresh
+                    # attempt.
+                    pl.status.step_retries[step] = used + 1
+                    self.store.delete(
+                        job.get("kind", "JAXJob"), job_name, ns
+                    )
+                    phases[step] = "Pending"
+                    pl.status.set_condition(
+                        "Running", "StepRetrying",
+                        f"step {step!r} attempt "
+                        f"{used + 2}/{by_name[step].retry + 1}",
+                    )
+                else:
+                    phases[step] = "Failed"
                 running = max(0, running - (1 if phase == "Running" else 0))
             else:
                 phases[step] = "Running"
@@ -261,6 +294,41 @@ class PipelineController:
             return False
         self.store.put(kind, tj.to_dict())
         return True
+
+    # -- result caching (KFP execution caching analog) ----------------------
+
+    def _step_cache_key(self, pl: Pipeline, step: str) -> str:
+        """Cache key = hash of the RENDERED template: pipeline parameters
+        and upstream step outputs are substituted in before hashing, so
+        any change to either produces a different key."""
+        import hashlib
+        import json as _json
+
+        tmpl = next(s for s in pl.spec.steps if s.name == step)
+        rendered = render_step_template(
+            dict(tmpl.job), pl.spec.parameters, pl.status.step_outputs
+        )
+        blob = _json.dumps(rendered, sort_keys=True, default=str)
+        return hashlib.sha256(blob.encode()).hexdigest()[:24]
+
+    def _cache_lookup(self, pl: Pipeline, step: str) -> Optional[str]:
+        obj = self.store.get(
+            "StepCache", f"sc-{self._step_cache_key(pl, step)}",
+            pl.metadata.namespace,
+        )
+        return None if obj is None else str(obj.get("output", ""))
+
+    def _cache_store(self, pl: Pipeline, step: str) -> None:
+        self.store.put("StepCache", {
+            "metadata": {
+                "name": f"sc-{self._step_cache_key(pl, step)}",
+                "namespace": pl.metadata.namespace,
+            },
+            "output": pl.status.step_outputs.get(step, ""),
+            "pipeline": pl.metadata.name,
+            "step": step,
+            "time": time.time(),
+        })
 
     def _capture_output(self, pl: Pipeline, step: str) -> None:
         if step in pl.status.step_outputs:

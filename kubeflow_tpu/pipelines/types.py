@@ -34,6 +34,14 @@ class PipelineStep(BaseModel):
     # TrainJob-shaped template (kind defaults to JAXJob); rendered with
     # pipeline parameters + upstream outputs at creation time.
     job: Dict[str, Any]
+    # Re-run a Failed step up to this many more times before the failure
+    # counts (Argo retryStrategy.limit analog). 0 = fail immediately.
+    retry: int = Field(default=0, ge=0)
+    # Result caching (KFP execution caching analog): skip the step when a
+    # previous run Succeeded with an identical rendered template (which
+    # embeds the pipeline parameters and upstream outputs), reusing its
+    # captured output.
+    cache: bool = False
 
 
 class PipelineSpec(BaseModel):
@@ -53,6 +61,8 @@ class PipelineStatus(BaseModel):
     step_phases: Dict[str, str] = Field(default_factory=dict)
     # step name -> captured output (contents of the step's output file)
     step_outputs: Dict[str, str] = Field(default_factory=dict)
+    # step name -> retries consumed so far (spec.steps[].retry budget)
+    step_retries: Dict[str, int] = Field(default_factory=dict)
     start_time: Optional[float] = None
     completion_time: Optional[float] = None
 
