@@ -64,13 +64,14 @@ PRIMARY = "predictor"  # component the activator routes to by default
 # canary predictor sets under "{ns}/{name}#canary"; the suffixes never
 # appear in object names ('#' is not name-legal).
 TRANSFORMER_SUFFIX = "#transformer"
+EXPLAINER_SUFFIX = "#explainer"
 CANARY_SUFFIX = "#canary"
 
 
 def _key_parts(key: str) -> tuple[str, str]:
     """(ns, name) of a service key, component suffix stripped."""
     ns, name = key.split("/", 1)
-    for suffix in (TRANSFORMER_SUFFIX, CANARY_SUFFIX):
+    for suffix in (TRANSFORMER_SUFFIX, EXPLAINER_SUFFIX, CANARY_SUFFIX):
         if name.endswith(suffix):
             name = name[: -len(suffix)]
     return ns, name
@@ -294,6 +295,7 @@ class ISVCController:
     async def _reconcile(self, ns: str, name: str) -> None:
         key = f"{ns}/{name}"
         tkey = key + TRANSFORMER_SUFFIX
+        ekey = key + EXPLAINER_SUFFIX
         ckey = key + CANARY_SUFFIX
         raw = self.store.get(KIND, name, ns)
         if raw is None:
@@ -305,7 +307,7 @@ class ISVCController:
             if t is not None:
                 t.cancel()
             self._placement_pending.discard(key)
-            for k in (key, tkey, ckey):
+            for k in (key, tkey, ekey, ckey):
                 svc = self.services.get(k)
                 if svc is None:
                     continue
@@ -350,6 +352,9 @@ class ISVCController:
             # Transformer removed from the spec: tear its replicas down.
             await self._scale_to(tkey, 0)
             self.services.pop(tkey, None)
+        if isvc.spec.explainer is None and ekey in self.services:
+            await self._scale_to(ekey, 0)
+            self.services.pop(ekey, None)
         if canarying:
             stable_comp = ComponentSpec.model_validate(stable)
             components = [(key, stable_comp, "predictor"),
@@ -358,6 +363,8 @@ class ISVCController:
             components = [(key, isvc.spec.predictor, "predictor")]
         if isvc.spec.transformer is not None:
             components.append((tkey, isvc.spec.transformer, "transformer"))
+        if isvc.spec.explainer is not None:
+            components.append((ekey, isvc.spec.explainer, "explainer"))
         crash_looped = False
         for skey, comp, label in components:
             svc = self.services.setdefault(skey, _Service())
@@ -418,6 +425,7 @@ class ISVCController:
         if not crash_looped:
             self._write_status(
                 isvc, self.services[key], self.services.get(tkey),
+                esvc=self.services.get(ekey),
                 csvc=self.services.get(ckey) if canarying else None,
                 canary_pct=pct if canarying else None,
             )
@@ -959,10 +967,10 @@ class ISVCController:
         ns, name = isvc.metadata.namespace, isvc.metadata.name
         service_key = service_key or f"{ns}/{name}"
         env = {"PORT": str(port)}
-        if service_key.endswith(TRANSFORMER_SUFFIX):
-            # Transformer processes call the predictor back through the
-            # activator (scale-from-zero applies), pinned to the predictor
-            # component via header by TransformerModel.
+        if service_key.endswith((TRANSFORMER_SUFFIX, EXPLAINER_SUFFIX)):
+            # Transformer/explainer processes call the predictor back
+            # through the activator (scale-from-zero applies), pinned to
+            # the predictor component via header by TransformerModel.
             env["KFTPU_PREDICTOR_URL"] = (
                 f"{self.base_url}/serving/{ns}/{name}"
             )
@@ -974,6 +982,15 @@ class ISVCController:
             entrypoint = comp.custom.entrypoint
             args = list(comp.custom.args)
             env.update(comp.custom.env)
+        elif (service_key.endswith(EXPLAINER_SUFFIX)
+                and comp.model is None):
+            # Bundled default: the model-agnostic feature-ablation
+            # explainer (validation guarantees explainer model: is unset).
+            entrypoint = "kubeflow_tpu.serving.runtimes.explainer_server"
+            args = ["--model-name", name, "--port", str(port),
+                    "--options-json", "{}"]
+            if grpc_port:
+                args += ["--grpc-port", str(grpc_port)]
         else:
             m = comp.model
             if m.format == ModelFormat.custom:
@@ -1163,6 +1180,8 @@ class ISVCController:
                     continue
                 if key.endswith(TRANSFORMER_SUFFIX):
                     comp = parsed.spec.transformer
+                elif key.endswith(EXPLAINER_SUFFIX):
+                    comp = parsed.spec.explainer
                 else:
                     # Mid-rollout the stable set RUNS the stable
                     # revision; scale it by that spec's bounds, not the
@@ -1199,6 +1218,7 @@ class ISVCController:
 
     def _write_status(self, isvc: InferenceService, svc: _Service,
                       tsvc: Optional[_Service] = None,
+                      esvc: Optional[_Service] = None,
                       csvc: Optional[_Service] = None,
                       canary_pct: Optional[int] = None) -> None:
         raw = self.store.get(KIND, isvc.metadata.name, isvc.metadata.namespace)
@@ -1246,6 +1266,16 @@ class ISVCController:
             # Transformer removed from the spec: clear its stale status
             # (replicas/PIDs that no longer exist) rather than carry it.
             status.transformer = None
+        if esvc is not None:
+            if status.explainer is None:
+                status.explainer = ComponentStatus()
+            status.explainer.desired_replicas = esvc.desired
+            status.explainer.ready_replicas = len(esvc.ready_replicas())
+            status.explainer.replicas = [
+                r.info() for r in esvc.replicas.values()
+            ]
+        else:
+            status.explainer = None
         status.in_flight = svc.in_flight
         status.last_request_time = svc.last_request
         status.url = (
@@ -1257,7 +1287,10 @@ class ISVCController:
         t_ready = (
             tsvc is None or tsvc.ready_replicas() or tsvc.desired == 0
         )
-        if ready and t_ready:
+        e_ready = (
+            esvc is None or esvc.ready_replicas() or esvc.desired == 0
+        )
+        if ready and t_ready and e_ready:
             set_condition(status, "Ready", "MinimumReplicasAvailable",
                           f"{len(ready)}/{svc.desired} replicas ready")
         elif svc.desired == 0:
@@ -1269,6 +1302,8 @@ class ISVCController:
                 stuck.append(f"predictor 0/{svc.desired}")
             if tsvc is not None and not t_ready:
                 stuck.append(f"transformer 0/{tsvc.desired}")
+            if esvc is not None and not e_ready:
+                stuck.append(f"explainer 0/{esvc.desired}")
             set_condition(status, "Unready", "WaitingForReplicas",
                           f"waiting for replicas: {', '.join(stuck)}")
         new = dict(raw)
@@ -1434,11 +1469,21 @@ class Activator:
                 f"service failed ({failed[0].get('reason')}): "
                 f"{failed[0].get('message')}",
             )
+        # :explain routes to the explainer component (the reference's
+        # explain verb); its replicas call the predictor back through
+        # here with X-Kftpu-Component: predictor. Presence check, not
+        # truthiness: "explainer": {} is a VALID spec (bundled ablation
+        # explainer with all defaults) and must still route.
+        has_explainer = (raw.get("spec") or {}).get("explainer") is not None
+        if (has_explainer and component != PRIMARY
+                and tail.endswith(":explain")):
+            key = key + EXPLAINER_SUFFIX
         # With a transformer present, it is the ingress component; its
         # replicas call back here with X-Kftpu-Component: predictor
         # (KServe: transformer fronts the predictor service).
         has_transformer = bool((raw.get("spec") or {}).get("transformer"))
-        if has_transformer and component != PRIMARY:
+        if (has_transformer and component != PRIMARY
+                and not key.endswith(EXPLAINER_SUFFIX)):
             key = key + TRANSFORMER_SUFFIX
         elif not key.endswith(TRANSFORMER_SUFFIX):
             # Canary split on the predictor path: a deterministic cursor
@@ -1460,7 +1505,9 @@ class Activator:
             ((raw.get("spec") or {}).get("predictor") or {}).get(
                 "multi_model")
         )
-        if is_multi_model and not key.endswith(TRANSFORMER_SUFFIX):
+        if is_multi_model and not key.endswith(
+            (TRANSFORMER_SUFFIX, EXPLAINER_SUFFIX)
+        ):
             # (Model routing applies to the PREDICTOR hop only: a
             # transformer ingress forwards to the predictor itself.)
             # Multi-model routing: send the request to the replica that

@@ -1,0 +1,118 @@
+"""Explainer component base (KServe explainer equivalent, S1/S2).
+
+The third ISVC component: its replicas receive ``:explain`` requests,
+call the PREDICTOR through the activator (so predictor scale-from-zero
+still applies), and return attributions. Subclass and override
+``explain_instance`` for custom explainers:
+
+    from kubeflow_tpu.serving.explainer import ExplainerModel
+    from kubeflow_tpu.serving.runtimes.common import serve_main
+
+    class MyExplainer(ExplainerModel):
+        def explain_instance(self, instance):
+            preds = self.predict([instance])       # predictor call
+            return {"attributions": my_method(instance, preds[0])}
+
+    if __name__ == "__main__":
+        raise SystemExit(serve_main(
+            lambda name, path, opts: MyExplainer(name, options=opts)))
+
+The controller injects ``KFTPU_PREDICTOR_URL``/``KFTPU_PREDICTOR_MODEL``
+into explainer replicas, exactly as for transformers
+(serving.transformer.TransformerModel supplies the proxying ``predict``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+from kubeflow_tpu.serving.model import InferenceError
+from kubeflow_tpu.serving.transformer import TransformerModel
+
+
+class ExplainerModel(TransformerModel):
+    """Base explainer: predictor proxying inherited from TransformerModel
+    (its ``predict`` forwards a batch to the predictor component)."""
+
+    def explain(self, instances: Sequence[Any]) -> List[Any]:
+        return [self.explain_instance(i) for i in instances]
+
+    def explain_instance(self, instance: Any) -> Any:
+        raise InferenceError(
+            f"explainer {self.name} does not implement explain_instance",
+            501,
+        )
+
+
+def _scalar(pred: Any) -> float:
+    """Reduce one prediction to a scalar score for attribution math."""
+    if isinstance(pred, bool):
+        return float(pred)
+    if isinstance(pred, (int, float)):
+        return float(pred)
+    if isinstance(pred, list) and pred:
+        # Probability vector / multi-output: score = first component
+        # unless a binary-proba pair, where index 1 (positive class) is
+        # conventional.
+        vals = [v for v in pred if isinstance(v, (int, float))]
+        if len(vals) == 2:
+            return float(vals[1])
+        if vals:
+            return float(vals[0])
+    if isinstance(pred, dict):
+        for k in ("score", "probability", "value", "prediction"):
+            if isinstance(pred.get(k), (int, float)):
+                return float(pred[k])
+    raise InferenceError(
+        "ablation explainer needs scalar-reducible predictions "
+        f"(number, vector, or dict with score/probability), got "
+        f"{type(pred).__name__}", 400,
+    )
+
+
+class AblationExplainer(ExplainerModel):
+    """Bundled feature-ablation explainer (the default when an ISVC's
+    explainer has no custom process).
+
+    For a numeric feature-vector instance, attribution of feature i =
+    score(x) - score(x with feature i set to the baseline value). All
+    ablations go to the predictor in ONE batch per instance. Model
+    agnostic -- works over any predictor whose outputs reduce to a
+    scalar (sklearn/xgboost/lightgbm regressors and classifiers, custom
+    numeric models).
+
+    The bundled spawn (explainer: {} in an ISVC) runs with the default
+    baseline 0.0; to configure options, run this runtime as a custom
+    process instead:
+        explainer:
+          custom:
+            entrypoint: kubeflow_tpu.serving.runtimes.explainer_server
+            args: ["--model-name", "m", "--options-json",
+                   '{"baseline": 1.0}']
+    """
+
+    def __init__(self, name, path=None, options=None) -> None:
+        super().__init__(name, path, options)
+        self.baseline = float(self.options.get("baseline", 0.0))
+
+    def explain_instance(self, instance: Any) -> Any:
+        feats = instance
+        if isinstance(instance, dict) and "features" in instance:
+            feats = instance["features"]
+        if not (isinstance(feats, list) and feats
+                and all(isinstance(v, (int, float)) for v in feats)):
+            raise InferenceError(
+                "ablation explainer expects a numeric feature vector "
+                '(instance = [..] or {"features": [..]})', 400,
+            )
+        batch = [list(feats)]
+        for i in range(len(feats)):
+            ablated = list(feats)
+            ablated[i] = self.baseline
+            batch.append(ablated)
+        scores = [_scalar(p) for p in self.predict(batch)]
+        base = scores[0]
+        return {
+            "base_value": base,
+            "attributions": [base - s for s in scores[1:]],
+        }

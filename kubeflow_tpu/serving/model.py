@@ -79,6 +79,14 @@ class Model:
     def metadata(self) -> Dict[str, Any]:
         return {"name": self.name, "platform": "kftpu", "inputs": [], "outputs": []}
 
+    # Explanation (V1 ``:explain``). Explainer components override
+    # (serving.explainer.ExplainerModel); a model may also implement it
+    # directly, as the reference's kserve.Model.explain hook allows.
+    def explain(self, instances: Sequence[Any]) -> List[Any]:
+        raise InferenceError(
+            f"model {self.name} does not support explanation", 501
+        )
+
     # Streaming generation (V2 generate extension). LLM runtimes override:
     # submit the request, arrange for ``on_token(token_id)`` to be called
     # per generated token (any thread), and return (future-of-token-ids,

@@ -1,0 +1,20 @@
+"""Bundled explainer runtime: serves ``:explain`` with the model-agnostic
+feature-ablation explainer (serving.explainer.AblationExplainer). The ISVC
+controller spawns this for explainer components that declare no custom
+process (SURVEY.md 3.3 S1: the reference ISVC triple is
+predictor/transformer/explainer)."""
+
+from __future__ import annotations
+
+from kubeflow_tpu.serving.explainer import AblationExplainer
+from kubeflow_tpu.serving.runtimes.common import serve_main
+
+
+def main(argv=None) -> int:
+    return serve_main(
+        lambda name, path, opts: AblationExplainer(name, path, opts), argv
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

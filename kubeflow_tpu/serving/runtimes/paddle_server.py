@@ -1,0 +1,84 @@
+"""Paddle inference runtime (KServe paddleserver equivalent, SURVEY.md
+3.3 S5).
+
+Loads a Paddle inference model (``*.pdmodel`` + ``*.pdiparams``) and
+serves predictions on host CPU. paddlepaddle is an OPTIONAL dependency in
+this image; the runtime exists for the reference's format-catalog parity
+and fails at LOAD time with an actionable message when the library is
+absent — the same gating the xgboost/lightgbm/pmml runtimes use.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from kubeflow_tpu.serving.model import InferenceError, Model
+from kubeflow_tpu.serving.runtimes.common import serve_main
+
+
+class PaddleModel(Model):
+    def __init__(self, name: str, path: Optional[str],
+                 options: Dict[str, Any]) -> None:
+        super().__init__(name)
+        self.path = path
+        self.options = options
+        self._predictor = None
+
+    def load(self) -> None:
+        try:
+            from paddle import inference  # noqa: PLC0415 - optional
+        except ImportError:
+            raise InferenceError(
+                "the paddlepaddle library is not installed in this "
+                "image; install paddlepaddle to serve format=paddle, or "
+                "export the model to ONNX/sklearn and use another "
+                "runtime", 500,
+            )
+        path = self.path
+        if path is None:
+            raise InferenceError("paddle runtime requires storage_uri", 500)
+        model_file = params_file = None
+        if os.path.isdir(path):
+            for f in sorted(os.listdir(path)):
+                if f.endswith(".pdmodel"):
+                    model_file = os.path.join(path, f)
+                elif f.endswith(".pdiparams"):
+                    params_file = os.path.join(path, f)
+        elif path.endswith(".pdmodel"):
+            model_file = path
+            params_file = path[: -len(".pdmodel")] + ".pdiparams"
+        if not model_file or not params_file or not os.path.exists(params_file):
+            raise InferenceError(
+                f"paddle runtime needs a .pdmodel + .pdiparams pair "
+                f"under {path}", 500,
+            )
+        config = inference.Config(model_file, params_file)
+        config.disable_gpu()
+        self._predictor = inference.create_predictor(config)
+        self.ready = True
+
+    def unload(self) -> None:
+        self._predictor = None
+        self.ready = False
+
+    def predict(self, instances: Sequence[Any]) -> List[Any]:
+        pred = self._predictor
+        batch = np.asarray(instances, dtype=np.float32)
+        name = pred.get_input_names()[0]
+        handle = pred.get_input_handle(name)
+        handle.reshape(batch.shape)
+        handle.copy_from_cpu(batch)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0])
+        return np.asarray(out.copy_to_cpu()).tolist()
+
+
+def main(argv=None) -> int:
+    return serve_main(PaddleModel, argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,73 @@
+"""PMML runtime (KServe pmmlserver equivalent, SURVEY.md 3.3 S5).
+
+Loads a ``.pmml`` model via pypmml and serves predictions. pypmml is an
+OPTIONAL dependency in this image (it needs a JVM); the runtime exists
+for the reference's format-catalog parity and fails at LOAD time with an
+actionable message when the library is absent — the same gating the
+xgboost/lightgbm runtimes use.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from kubeflow_tpu.serving.model import InferenceError, Model
+from kubeflow_tpu.serving.runtimes.common import serve_main
+
+
+class PMMLModel(Model):
+    def __init__(self, name: str, path: Optional[str],
+                 options: Dict[str, Any]) -> None:
+        super().__init__(name)
+        self.path = path
+        self.options = options
+        self._model = None
+
+    def load(self) -> None:
+        try:
+            from pypmml import Model as PMML  # noqa: PLC0415 - optional
+        except ImportError:
+            raise InferenceError(
+                "the pypmml library (and its JVM dependency) is not "
+                "installed in this image; install pypmml to serve "
+                "format=pmml, or export the model to sklearn/onnx and "
+                "use another runtime", 500,
+            )
+        path = self.path
+        if path is None:
+            raise InferenceError("pmml runtime requires storage_uri", 500)
+        if os.path.isdir(path):
+            cands = [f for f in sorted(os.listdir(path))
+                     if f.endswith((".pmml", ".xml"))]
+            if not cands:
+                raise InferenceError(f"no .pmml file in {path}", 500)
+            path = os.path.join(path, cands[0])
+        self._model = PMML.load(path)
+        self.ready = True
+
+    def unload(self) -> None:
+        if self._model is not None:
+            self._model.close()
+        self._model = None
+        self.ready = False
+
+    def predict(self, instances: Sequence[Any]) -> List[Any]:
+        # pypmml takes records (dict) or positional lists per its input
+        # field order.
+        out = []
+        for inst in instances:
+            if isinstance(inst, dict):
+                out.append(self._model.predict(inst))
+            else:
+                names = [f.name for f in self._model.inputFields]
+                out.append(self._model.predict(dict(zip(names, inst))))
+        return out
+
+
+def main(argv=None) -> int:
+    return serve_main(PMMLModel, argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

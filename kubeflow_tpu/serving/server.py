@@ -64,6 +64,7 @@ class ModelServer:
             # V1
             web.get("/v1/models/{m}", self.h_v1_status),
             web.post("/v1/models/{m:[^:]+}:predict", self.h_v1_predict),
+            web.post("/v1/models/{m:[^:]+}:explain", self.h_v1_explain),
             # V2
             web.get("/v2", self.h_v2_server),
             web.get("/v2/health/live", self.h_v2_live),
@@ -169,6 +170,37 @@ class ModelServer:
             outs = await asyncio.gather(*(batcher.predict(i) for i in pre))
             preds = [model.postprocess(o) for o in outs]
             resp = {"predictions": preds}
+            await self._log_response(name, resp, rid)
+            return web.json_response(resp)
+        except json.JSONDecodeError:
+            self.error_count += 1
+            return web.json_response({"error": "body is not JSON"}, status=400)
+        except Exception as e:  # noqa: BLE001
+            self.error_count += 1
+            return self._err(e)
+        finally:
+            self.predict_seconds += time.monotonic() - t0
+
+    async def h_v1_explain(self, req: web.Request) -> web.Response:
+        """V1 explain (the reference's :explain verb): explainer replicas
+        serve this via Model.explain; attribution calls back into the
+        predictor happen inside the model (off-loop -- explain fans one
+        instance into many predictor calls)."""
+        name = req.match_info["m"]
+        self.request_count += 1
+        t0 = time.monotonic()
+        try:
+            model = self.repository.get(name)
+            if not model.ready:
+                raise InferenceError(f"model {name} is not ready", status=503)
+            self.repository.touch(name)
+            body = await req.json()
+            instances = body.get("instances")
+            if not isinstance(instances, list):
+                raise InferenceError('body must have "instances": [...]', status=400)
+            rid = await self._log_request(name, body, req)
+            outs = await asyncio.to_thread(model.explain, instances)
+            resp = {"explanations": outs}
             await self._log_response(name, resp, rid)
             return web.json_response(resp)
         except json.JSONDecodeError:

@@ -38,6 +38,8 @@ class ModelFormat(str, enum.Enum):
     lightgbm = "lightgbm"  # Booster files; library optional (gated at load)
     jax = "jax"  # JAX/StableHLO LLM predictor on PJRT (north-star config #5)
     huggingface = "huggingface"  # transformers on host CPU (S5 parity)
+    pmml = "pmml"  # pypmml; library optional (gated at load)
+    paddle = "paddle"  # paddle inference; library optional (gated at load)
     echo = "echo"  # conformance/test runtime (reference: custom example images)
     custom = "custom"
 
@@ -112,6 +114,13 @@ class InferenceServiceSpec(BaseModel):
 
     predictor: ComponentSpec
     transformer: Optional[ComponentSpec] = None
+    # Explainer component (reference ISVC triple predictor/transformer/
+    # explainer): serves ``:explain`` by calling the predictor and
+    # returning per-feature attributions. With neither model nor custom
+    # set, the bundled feature-ablation explainer runs
+    # (serving/runtimes/explainer_server.py); custom: runs a process
+    # subclassing serving.explainer.ExplainerModel.
+    explainer: Optional[ComponentSpec] = None
     # Percent of traffic to the newest generation during a rollout
     # (reference: canaryTrafficPercent). 100 = all traffic to latest.
     canary_traffic_percent: int = 100
@@ -153,6 +162,7 @@ class InferenceServiceStatus(BaseModel):
     url: Optional[str] = None
     predictor: ComponentStatus = Field(default_factory=ComponentStatus)
     transformer: Optional[ComponentStatus] = None
+    explainer: Optional[ComponentStatus] = None
     # Revision/canary rollout (reference: canaryTrafficPercent + Knative
     # revisions). stable_predictor is the last PROMOTED predictor spec;
     # while a canary rollout is in flight the stable set keeps serving it
@@ -243,10 +253,20 @@ def validate_isvc(isvc: InferenceService) -> None:
     """Semantic validation beyond pydantic shape checks (webhook analog)."""
 
     for label, comp in (("predictor", isvc.spec.predictor),
-                        ("transformer", isvc.spec.transformer)):
+                        ("transformer", isvc.spec.transformer),
+                        ("explainer", isvc.spec.explainer)):
         if comp is None:
             continue
-        if (comp.model is None) == (comp.custom is None):
+        if label == "explainer":
+            # Explainers default to the bundled ablation runtime when
+            # neither model nor custom is given; model: is not a thing.
+            if comp.model is not None:
+                raise ServingValidationError(
+                    "explainer: use custom: (a process subclassing "
+                    "serving.explainer.ExplainerModel) or leave empty "
+                    "for the bundled feature-ablation explainer"
+                )
+        elif (comp.model is None) == (comp.custom is None):
             raise ServingValidationError(
                 f"{label}: exactly one of model/custom must be set"
             )
@@ -326,6 +346,8 @@ RUNTIMES: Dict[ModelFormat, str] = {
     ModelFormat.huggingface:
         "kubeflow_tpu.serving.runtimes.huggingface_server",
     ModelFormat.echo: "kubeflow_tpu.serving.runtimes.echo_server",
+    ModelFormat.pmml: "kubeflow_tpu.serving.runtimes.pmml_server",
+    ModelFormat.paddle: "kubeflow_tpu.serving.runtimes.paddle_server",
 }
 
 

@@ -755,3 +755,72 @@ def test_replica_serves_grpc_oip(cp_client):
             assert resp.outputs
 
     loop.run_until_complete(run())
+
+
+def test_explainer_component_end_to_end(cp_client, tmp_path):
+    """Reference ISVC triple (SURVEY 3.3 S1): predictor + explainer. The
+    bundled feature-ablation explainer serves :explain by calling the
+    predictor; for a linear model, attribution_i == coef_i * x_i (exact
+    check, since ablating feature i changes a linear score by coef_i*x_i)."""
+    import joblib
+    import numpy as np
+    from sklearn.linear_model import LinearRegression
+
+    X = np.array([[0.0, 0.0], [1.0, 0.0], [0.0, 1.0], [1.0, 1.0]])
+    y = X @ np.array([2.0, -3.0]) + 1.0
+    model_dir = tmp_path / "linmodel"
+    model_dir.mkdir()
+    joblib.dump(LinearRegression().fit(X, y), model_dir / "model.joblib")
+
+    cp, client, loop = cp_client
+
+    async def run():
+        cp.isvc.base_url = f"http://127.0.0.1:{client.server.port}"
+        spec = {
+            "metadata": {"name": "lin"},
+            "spec": {
+                "predictor": {
+                    "model": {"format": "sklearn",
+                              "storage_uri": str(model_dir)},
+                    "min_replicas": 1, "max_replicas": 1,
+                },
+                # Deliberately EMPTY: {} is the bundled-ablation default
+                # and must still route (presence, not truthiness).
+                "explainer": {},
+            },
+        }
+        r = await client.post("/apis/InferenceService", json=spec)
+        assert r.status == 200, await r.text()
+        await wait_for(
+            lambda: _status(cp, "lin").get("explainer", {}).get(
+                "ready_replicas") and _status(cp, "lin").get(
+                "predictor", {}).get("ready_replicas"),
+            timeout=60, msg="predictor+explainer ready",
+        )
+        st = _status(cp, "lin")
+        assert any(c["type"] == "Ready" and c["status"]
+                   for c in st["conditions"]), st["conditions"]
+
+        # Predict still routes to the predictor.
+        r = await client.post(
+            "/serving/default/lin/v1/models/lin:predict",
+            json={"instances": [[2.0, 1.0]]},
+        )
+        assert r.status == 200, await r.text()
+        pred = (await r.json())["predictions"][0]
+        assert abs(pred - (2 * 2.0 - 3 * 1.0 + 1.0)) < 1e-6
+
+        # Explain routes to the explainer, which fans ablations to the
+        # predictor and returns per-feature attributions.
+        r = await client.post(
+            "/serving/default/lin/v1/models/lin:explain",
+            json={"instances": [[2.0, 1.0]]},
+        )
+        assert r.status == 200, await r.text()
+        exp = (await r.json())["explanations"][0]
+        assert abs(exp["base_value"] - 2.0) < 1e-6
+        atts = exp["attributions"]
+        assert abs(atts[0] - 2.0 * 2.0) < 1e-6   # coef0 * x0
+        assert abs(atts[1] - (-3.0) * 1.0) < 1e-6  # coef1 * x1
+
+    loop.run_until_complete(run())

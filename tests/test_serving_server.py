@@ -348,6 +348,8 @@ class TestOptionalBoosterRuntimes:
 
         assert ModelFormat.xgboost in RUNTIMES
         assert ModelFormat.lightgbm in RUNTIMES
+        assert ModelFormat.pmml in RUNTIMES
+        assert ModelFormat.paddle in RUNTIMES
 
     def test_missing_library_is_actionable(self, tmp_path):
         import importlib.util
@@ -360,8 +362,13 @@ class TestOptionalBoosterRuntimes:
             XGBoostModel,
         )
 
+        from kubeflow_tpu.serving.runtimes.paddle_server import PaddleModel
+        from kubeflow_tpu.serving.runtimes.pmml_server import PMMLModel
+
         for cls, lib in ((XGBoostModel, "xgboost"),
-                         (LightGBMModel, "lightgbm")):
+                         (LightGBMModel, "lightgbm"),
+                         (PMMLModel, "pypmml"),
+                         (PaddleModel, "paddle")):
             if importlib.util.find_spec(lib) is not None:
                 continue  # library present: the gating branch is moot
             m = cls("m", str(tmp_path), {})
@@ -526,3 +533,16 @@ def test_v2_generate_stream_multibyte_codepoint():
         loop.run_until_complete(run())
     finally:
         loop.close()
+
+
+def test_isvc_explainer_validation():
+    d = isvc_dict()
+    d["spec"]["explainer"] = {}  # bundled ablation default is valid
+    validate_isvc(InferenceService.from_dict(d))
+    d["spec"]["explainer"] = {"custom": {"entrypoint": "my.explainer"}}
+    validate_isvc(InferenceService.from_dict(d))
+    d["spec"]["explainer"] = {
+        "model": {"format": "sklearn", "storage_uri": "/tmp/m"},
+    }
+    with pytest.raises(ServingValidationError, match="explainer"):
+        validate_isvc(InferenceService.from_dict(d))
