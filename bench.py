@@ -32,6 +32,21 @@ BATCH = int(os.environ.get("BENCH_BATCH", "5"))
 SEQ = int(os.environ.get("BENCH_SEQ", "1024"))
 STEPS = int(os.environ.get("BENCH_STEPS", "10"))
 PRESET = os.environ.get("BENCH_PRESET", "llama3-8b-proxy")
+# Config #2 trains at seq 8192 (models/llama.py max_seq): measure MFU at
+# the REAL sequence lengths too, batch shrunk to fit HBM per seq
+# ("seq:batch" pairs; empty disables the sweep). The headline metric
+# stays the seq-1024 row for round-over-round comparability.
+# "seq:batch[:loss_chunk[:remat_policy]]" -- loss_chunk enables the
+# sequence-chunked cross entropy and remat_policy=minimal the
+# save-nothing layer remat (models/llama.py): at 8192 the fp32 logits +
+# grad and the saved [L,S,intermediate] dots exceed one chip's HBM
+# without them.
+SEQ_SWEEP = [
+    tuple(pair.split(":"))
+    for pair in os.environ.get(
+        "BENCH_SEQ_SWEEP", "2048:2,4096:1,8192:1:1024:minimal"
+    ).split(",") if pair
+]
 
 
 def check_flash_kernel() -> None:
@@ -60,19 +75,23 @@ def check_flash_kernel() -> None:
     np.testing.assert_allclose(flash, ref, atol=2e-2, rtol=2e-2)
 
 
-def main() -> int:
+def run_config(batch: int, seq: int, steps: int, loss_chunk: int = 0,
+               remat_policy: str = "dots") -> dict:
+    """One measured config: steady-state tokens/s + MFU at (batch, seq).
+    State is freed before returning so back-to-back configs never hold
+    two optimizer states in HBM."""
+    import gc
+
     import jax
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from kubeflow_tpu.models import get_task
     from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
     from kubeflow_tpu.runtime.metrics import peak_flops_per_chip
 
-    check_flash_kernel()
-
     task = get_task(
-        "llama", preset=PRESET, batch_size=BATCH, seq_len=SEQ,
-        optimizer="adafactor",
+        "llama", preset=PRESET, batch_size=batch, seq_len=seq,
+        optimizer="adafactor", loss_chunk=loss_chunk,
+        remat_policy=remat_policy,
     )
     mesh = build_mesh(MeshConfig(data=-1))
     n_chips = len(jax.devices())
@@ -80,7 +99,7 @@ def main() -> int:
         state = task.init_state(jax.random.PRNGKey(0), mesh)
         step = task.train_step_fn(mesh)
         it = task.data_iter(1, 0, mesh)
-        batches = [next(it) for _ in range(STEPS + 2)]
+        batches = [next(it) for _ in range(steps + 2)]
         # Warmup: compile + one steady step.
         for b in batches[:2]:
             state, m = step(state, *b)
@@ -89,11 +108,54 @@ def main() -> int:
         for b in batches[2:]:
             state, m = step(state, *b)
         final_loss = float(m["loss"])
-        dt = (time.perf_counter() - t0) / STEPS
+        dt = (time.perf_counter() - t0) / steps
 
     tokens_per_sec = task.tokens_per_step / dt
-    per_chip = tokens_per_sec / n_chips
-    mfu = tokens_per_sec * task.flops_per_token / (peak_flops_per_chip() * n_chips)
+    out = {
+        "batch": batch,
+        "seq_len": seq,
+        "loss_chunk": loss_chunk,
+        "remat_policy": remat_policy,
+        "tokens_per_sec_per_chip": round(tokens_per_sec / n_chips, 1),
+        "mfu": round(
+            tokens_per_sec * task.flops_per_token
+            / (peak_flops_per_chip() * n_chips), 4,
+        ),
+        "step_time_ms": round(dt * 1e3, 1),
+        "final_loss": round(final_loss, 3),
+        "n_chips": n_chips,
+        "params_b": round(task.cfg.n_params() / 1e9, 3),
+    }
+    del state, step, batches, task
+    gc.collect()
+    return out
+
+
+def main() -> int:
+    import jax
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    check_flash_kernel()
+
+    head = run_config(BATCH, SEQ, STEPS)
+    sweep = []
+    for entry in SEQ_SWEEP:
+        seq, batch = int(entry[0]), int(entry[1])
+        chunk = int(entry[2]) if len(entry) > 2 else 0
+        rp = entry[3] if len(entry) > 3 else "dots"
+        try:
+            sweep.append(
+                run_config(batch, seq, max(STEPS // 2, 3), chunk, rp)
+            )
+        except Exception as e:  # noqa: BLE001 - record, don't lose the headline
+            sweep.append({"seq_len": seq, "batch": batch,
+                          "error": f"{type(e).__name__}: {e}"[:200]})
+    per_chip = head["tokens_per_sec_per_chip"]
+    mfu = head["mfu"]
+    final_loss = head["final_loss"]
+    n_chips = head["n_chips"]
+    dt = head["step_time_ms"] / 1e3
     print(
         json.dumps(
             {
@@ -102,13 +164,14 @@ def main() -> int:
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(mfu / 0.50, 3),
                 "extra": {
-                    "mfu": round(mfu, 4),
+                    "mfu": mfu,
                     "step_time_ms": round(dt * 1e3, 1),
                     "batch": BATCH,
                     "seq_len": SEQ,
                     "n_chips": n_chips,
-                    "params_b": round(task.cfg.n_params() / 1e9, 3),
-                    "final_loss": round(final_loss, 3),
+                    "params_b": head["params_b"],
+                    "final_loss": final_loss,
+                    "seq_sweep": sweep,
                     "device": jax.devices()[0].device_kind,
                 },
             }

@@ -63,6 +63,12 @@ class LlamaConfig:
     dtype: str = "bfloat16"          # activation/compute dtype
     param_dtype: str = "float32"     # master weight dtype
     remat: bool = True
+    # "dots": save matmul outputs (fastest backward that still bounds
+    # activations). "minimal": save NOTHING between layers -- the
+    # backward recomputes the whole layer. ~2 GiB/1k-seq cheaper on the
+    # 8B geometry (the [L,S,intermediate] dot saves dominate) at ~10-15%
+    # step-time cost; the long-sequence fit knob (SURVEY.md 7.4 #2).
+    remat_policy: str = "dots"
     scan_layers: bool = True
     attention_impl: str = "auto"
     # MoE (Mixtral-style: every layer's FFN is a router + n_experts SwiGLU
@@ -430,7 +436,9 @@ class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens: jax.Array, positions: Optional[jax.Array] = None):
+    def __call__(self, tokens: jax.Array,
+                 positions: Optional[jax.Array] = None,
+                 return_hidden: bool = False):
         cfg = self.cfg
         if positions is None:
             positions = jnp.arange(tokens.shape[1])[None, :]
@@ -447,7 +455,12 @@ class Llama(nn.Module):
         x = emb(tokens)
         freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
 
-        remat_policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        if cfg.remat_policy == "minimal":
+            remat_policy = jax.checkpoint_policies.nothing_saveable
+        else:
+            remat_policy = (
+                jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+            )
         aux_total = jnp.float32(0.0)
         if cfg.scan_layers:
             layer_cls = _ScanLayer
@@ -479,7 +492,7 @@ class Llama(nn.Module):
         self.sow("losses", "moe_aux", aux_total)
 
         x = RMSNorm(cfg.norm_eps, _dt(cfg.dtype), name="final_norm")(x)
-        logits = nn.DenseGeneral(
+        lm_head = nn.DenseGeneral(
             features=cfg.vocab_size,
             use_bias=False,
             dtype=_dt(cfg.dtype),
@@ -488,8 +501,14 @@ class Llama(nn.Module):
                 nn.initializers.lecun_normal(), ("embed", "vocab")
             ),
             name="lm_head",
-        )(x)
-        return logits
+        )
+        if return_hidden:
+            # Chunked-loss path: the caller applies lm_head per sequence
+            # chunk so the full [B,S,V] logits never materialize. lm_head
+            # params exist because init traces the DEFAULT call, which
+            # runs lm_head(x) below.
+            return x
+        return lm_head(x)
 
 
 # ---------------------------------------------------------------------------
@@ -506,10 +525,48 @@ def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
     # fp32 upcast before the softmax: bf16 logsumexp loses training
     # signal. (A chunked-scan variant that upcasts 1/n of the tokens at a
     # time was tried and REGRESSED on v5e -- the scan's buffers fragment
-    # HBM worse than the straight fp32 copy; measured 2026-07-30.)
+    # HBM worse than the straight fp32 copy; measured 2026-07-30. That
+    # variant still materialized the full bf16 logits; the memory-lean
+    # path is chunked_cross_entropy below, which runs the lm_head inside
+    # the chunk and is for fitting LONG sequences, not for speed.)
     return optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), targets
     ).mean()
+
+
+def chunked_cross_entropy(hidden: jax.Array, w_lm: jax.Array,
+                          targets: jax.Array, chunk: int) -> jax.Array:
+    """CE without ever materializing the [B, S, V] logits: the lm_head
+    matmul + fp32 softmax run per sequence chunk under jax.checkpoint,
+    so live logits are [B, chunk, V] in forward AND backward (the
+    backward recomputes each chunk's logits).
+
+    Why it exists: at config #2's seq 8192 the fp32 logits are 4.2 GB and
+    their gradient another 4.2 GB -- more than half a v5e's HBM for one
+    activation. Chunking trades one extra lm_head matmul per chunk (in
+    the backward) for that memory; use for long sequences that otherwise
+    OOM, not as the default (the straight path is faster when it fits).
+    """
+    b, s, h = hidden.shape
+    if s % chunk:
+        raise ValueError(f"seq {s} not divisible by loss_chunk {chunk}")
+    n = s // chunk
+    hid = hidden.reshape(b, n, chunk, h).transpose(1, 0, 2, 3)
+    tg = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_loss(hc, tc):
+        logits = (hc @ w_lm).astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tc
+        ).sum()
+
+    def body(acc, xs):
+        hc, tc = xs
+        return acc + chunk_loss(hc, tc), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hid, tg))
+    return total / (b * s)
 
 
 class LlamaTask(TrainTask):
@@ -526,8 +583,11 @@ class LlamaTask(TrainTask):
         grad_clip: float = 1.0,
         n_microbatches: Optional[int] = None,
         data: str = "synthetic",
+        loss_chunk: int = 0,
         **overrides,
     ) -> None:
+        # Sequence-chunked loss (chunked_cross_entropy): 0 = straight CE.
+        self.loss_chunk = loss_chunk
         self.n_microbatches = n_microbatches
         # "synthetic" or a path to a pre-tokenized corpus (data.file_tokens).
         self.data = data
@@ -586,11 +646,13 @@ class LlamaTask(TrainTask):
 
     # -- pipelined apply (pipe axis > 1) ----------------------------------
 
-    def _apply_pipelined(self, params, tokens, mesh: Mesh):
+    def _apply_pipelined(self, params, tokens, mesh: Mesh,
+                         return_hidden: bool = False):
         """Forward pass with the layer stack run as a GPipe pipeline over
         the ``pipe`` mesh axis. Embedding / final norm / lm_head are cheap
         and run replicated across pipe ranks; only the decoder stack is
-        staged. Returns (logits, aux)."""
+        staged. Returns (logits, aux), or (hidden, aux) for the
+        chunked-loss path (loss_chunk: lm_head runs inside the loss)."""
         from kubeflow_tpu.parallel.pipeline import gpipe
 
         cfg = self.cfg
@@ -632,6 +694,8 @@ class LlamaTask(TrainTask):
         x = RMSNorm(cfg.norm_eps, dtype).apply(
             {"params": raw["final_norm"]}, x
         )
+        if return_hidden:
+            return x, aux
         logits = x @ raw["lm_head"]["kernel"].astype(dtype)
         return logits, aux
 
@@ -644,11 +708,42 @@ class LlamaTask(TrainTask):
         moe = self.cfg.n_experts > 1
         pipelined = mesh.shape.get("pipe", 1) > 1
 
+        loss_chunk = self.loss_chunk
+
         def step(state, tokens, targets):
             def loss_fn(params):
                 if pipelined:
+                    if loss_chunk:
+                        hidden, aux = self._apply_pipelined(
+                            params, tokens, mesh, return_hidden=True
+                        )
+                        w_lm = nn.meta.unbox(
+                            params["params"]
+                        )["lm_head"]["kernel"].astype(_dt(self.cfg.dtype))
+                        return chunked_cross_entropy(
+                            hidden, w_lm, targets, loss_chunk
+                        ) + aux
                     logits, aux = self._apply_pipelined(params, tokens, mesh)
                     return cross_entropy(logits, targets) + aux
+                if loss_chunk:
+                    # Memory-lean long-sequence path: the model returns
+                    # hidden states; lm_head runs per chunk inside the
+                    # loss so [B,S,V] logits never materialize.
+                    if moe:
+                        hidden, mut = state.apply_fn(
+                            params, tokens, None, True,
+                            mutable=("losses",),
+                        )
+                        aux = sum(mut["losses"]["moe_aux"])
+                    else:
+                        hidden = state.apply_fn(params, tokens, None, True)
+                        aux = 0.0
+                    w_lm = nn.meta.unbox(
+                        params["params"]
+                    )["lm_head"]["kernel"].astype(_dt(self.cfg.dtype))
+                    return chunked_cross_entropy(
+                        hidden, w_lm, targets, loss_chunk
+                    ) + aux
                 if moe:
                     logits, mut = state.apply_fn(
                         params, tokens, mutable=("losses",)
