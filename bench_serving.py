@@ -1,19 +1,26 @@
 #!/usr/bin/env python
-"""Benchmark: LLM serving decode throughput on the local TPU chip.
+"""Benchmark: LLM serving throughput AND latency on the local TPU chip.
 
 Prints ONE JSON line and writes SERVING_BENCH.json.
 
-Methodology (SURVEY.md 3.3 S5: the reference's serving bar is vLLM-style
-continuous batching):
-- Model: llama3-8b-proxy (exact 8B layer geometry, 8/32 layers — same
-  proxy rationale as bench.py). Random weights: decode cost does not
-  depend on weight values.
-- Engine as served: slot-based continuous batching, batched prefill,
-  block decode (8 fused steps/dispatch), bf16 weights + KV cache.
-- Load: enough concurrent requests to keep every slot busy (2x slots),
-  prompt 128 tokens, 64 new tokens each, greedy. Steady-state timing
-  from first completion to last; throughput counts GENERATED tokens.
-- Sweep over max_slots (the serving batch size) to show scaling.
+Two phases (SURVEY.md 3.3 S5: the reference's serving bar is vLLM-style
+continuous batching, which is judged on TTFT/ITL percentiles, not just
+aggregate tokens/sec):
+
+1. **Throughput sweep** (round-1/2 comparable): all slots saturated with
+   uniform requests, steady-state generated-tokens/sec over a max_slots
+   sweep.
+2. **Latency under open-loop load**: Poisson arrivals at BENCH_RATE req/s
+   with MIXED prompt/output lengths, per-request TTFT (submit -> first
+   token callback) and inter-token latency (gaps between token
+   callbacks) percentiles — run twice, prefill_chunk off vs on, to show
+   what chunked prefill buys at the tail (a whole-prompt prefill stalls
+   every decoding slot; a chunk stalls them for one chunk).
+
+Model: llama3-8b-proxy (exact 8B layer geometry, 8/32 layers — same
+proxy rationale as bench.py). Random weights: decode cost does not
+depend on weight values. Engine as served: slot continuous batching,
+batched/chunked prefill, block decode, bf16 weights + KV cache.
 """
 
 import json
@@ -32,6 +39,26 @@ PROMPT_LEN = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
 NEW_TOKENS = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
 PRESET = os.environ.get("BENCH_PRESET", "llama3-8b-proxy")
 MAX_SEQ = int(os.environ.get("BENCH_MAX_SEQ", "512"))
+# Latency phase knobs. The latency workload runs at LONG prompt lengths
+# (its own max_seq): chunked prefill exists for the regime where one
+# admission's prefill rivals several decode blocks -- at short prompts
+# the stall it removes is under one block and the comparison says
+# nothing.
+RATE_RPS = float(os.environ.get("BENCH_RATE", "2.5"))
+LAT_REQUESTS = int(os.environ.get("BENCH_LAT_REQUESTS", "80"))
+LAT_SLOTS = int(os.environ.get("BENCH_LAT_SLOTS", "16"))
+LAT_MAX_SEQ = int(os.environ.get("BENCH_LAT_MAX_SEQ", "2048"))
+PREFILL_CHUNK = int(os.environ.get("BENCH_PREFILL_CHUNK", "256"))
+# Mixed lengths: bucket-aligned prompts (bounded compile count) and a
+# spread of output lengths, so long prefills overlap short decodes.
+LAT_PROMPT_LENS = tuple(
+    int(s) for s in
+    os.environ.get("BENCH_LAT_PROMPT_LENS", "256,512,1024,1536").split(",")
+)
+LAT_NEW_TOKENS = tuple(
+    int(s) for s in
+    os.environ.get("BENCH_LAT_NEW_TOKENS", "16,32,64,128").split(",")
+)
 
 
 def bench_one(max_slots: int) -> dict:
@@ -66,11 +93,112 @@ def bench_one(max_slots: int) -> dict:
         eng.step()
     dt = time.perf_counter() - t0
     generated = sum(len(f.result()) for f in futs)
+    eng.close()  # free HBM before the next engine (16 GiB chip)
+    import gc
+
+    gc.collect()
     return {
         "max_slots": max_slots,
         "tokens_per_sec": round(generated / dt, 1),
         "requests": n_requests,
         "wall_s": round(dt, 2),
+    }
+
+
+def _pct(xs, q):
+    import numpy as np
+
+    return round(float(np.percentile(np.asarray(xs), q)) * 1000.0, 1)
+
+
+def bench_latency(prefill_chunk: int) -> dict:
+    """Open-loop Poisson load with mixed lengths; TTFT/ITL/TPOT stats."""
+    import numpy as np
+
+    from kubeflow_tpu.serving.engine import GenerationEngine, Request
+
+    eng = GenerationEngine(
+        preset=PRESET, max_slots=LAT_SLOTS, max_seq=LAT_MAX_SEQ,
+        decode_block=8, prefill_chunk=prefill_chunk,
+    )
+    rng = np.random.default_rng(1)
+
+    def make(plen, ntok, sink):
+        return Request(
+            prompt=rng.integers(1, 1000, plen).tolist(),
+            max_new_tokens=ntok,
+            on_token=lambda _t: sink.append(time.perf_counter()),
+        )
+
+    # Warmup: every (prompt-len bucket x admission K-bucket) shape the
+    # load can hit, so the measured phase sees no compiles -- a single
+    # mid-run XLA compile (tens of seconds on this chip) would swamp the
+    # percentiles with compile time, not serving time.
+    kbursts, b = [], 1
+    while b <= LAT_SLOTS:
+        kbursts.append(b)
+        b *= 2
+    for kburst in reversed(kbursts):
+        for plen in LAT_PROMPT_LENS:
+            # 10 new tokens: enough budget for the full decode block
+            # (n=8) to compile at this cache shape too.
+            warm = [eng.submit(make(plen, 10, [])) for _ in range(kburst)]
+            while any(not f.done() for f in warm):
+                eng.step()
+    # Decode blocks are budget-capped to powers of 2: end-of-request
+    # tails hit n=1/2/4, which must not compile mid-measurement.
+    for ntok in (2, 3, 5):
+        f = eng.submit(make(LAT_PROMPT_LENS[0], ntok, []))
+        while not f.done():
+            eng.step()
+
+    eng.start()
+    try:
+        arrivals = np.cumsum(
+            rng.exponential(1.0 / RATE_RPS, LAT_REQUESTS)
+        )
+        plens = rng.choice(LAT_PROMPT_LENS, LAT_REQUESTS)
+        ntoks = rng.choice(LAT_NEW_TOKENS, LAT_REQUESTS)
+        recs = []  # (submit_time, [token_times]) per request
+        futs = []
+        t0 = time.perf_counter()
+        for i in range(LAT_REQUESTS):
+            now = time.perf_counter()
+            wait = t0 + arrivals[i] - now
+            if wait > 0:
+                time.sleep(wait)
+            sink: list = []
+            req = make(int(plens[i]), int(ntoks[i]), sink)
+            recs.append((time.perf_counter(), sink))
+            futs.append(eng.submit(req))
+        for f in futs:
+            f.result(timeout=600)
+        t_end = time.perf_counter()
+    finally:
+        eng.stop()
+    eng.close()  # free HBM before the next engine (16 GiB chip)
+    import gc
+
+    gc.collect()
+
+    ttft = [ts[0] - sub for sub, ts in recs if ts]
+    itl = []
+    tpot = []
+    for _sub, ts in recs:
+        if len(ts) > 1:
+            gaps = np.diff(np.asarray(ts))
+            itl.extend(gaps.tolist())
+            tpot.append(float((ts[-1] - ts[0]) / (len(ts) - 1)))
+    generated = sum(len(ts) for _s, ts in recs)
+    return {
+        "prefill_chunk": prefill_chunk,
+        "ttft_ms": {"p50": _pct(ttft, 50), "p99": _pct(ttft, 99)},
+        "itl_ms": {"p50": _pct(itl, 50), "p99": _pct(itl, 99),
+                   "max": round(max(itl) * 1000.0, 1)},
+        "tpot_ms": {"p50": _pct(tpot, 50), "p99": _pct(tpot, 99)},
+        "throughput_tokens_per_sec": round(generated / (t_end - t0), 1),
+        "requests": LAT_REQUESTS,
+        "rate_rps": RATE_RPS,
     }
 
 
@@ -81,6 +209,7 @@ def main() -> int:
 
     runs = [bench_one(s) for s in SLOTS_SWEEP]
     best = max(runs, key=lambda r: r["tokens_per_sec"])
+    latency_runs = [bench_latency(0), bench_latency(PREFILL_CHUNK)]
     result = {
         "metric": f"{PRESET}_serving_decode_tokens_per_sec_per_chip",
         "value": best["tokens_per_sec"],
@@ -94,9 +223,24 @@ def main() -> int:
             "prompt_len": PROMPT_LEN,
             "new_tokens": NEW_TOKENS,
             "decode_block": 8,
+            "latency": {
+                "workload": {
+                    "arrivals": "poisson", "rate_rps": RATE_RPS,
+                    "requests": LAT_REQUESTS, "max_slots": LAT_SLOTS,
+                    "max_seq": LAT_MAX_SEQ,
+                    "prefill_chunk": PREFILL_CHUNK,
+                    "prompt_lens": list(LAT_PROMPT_LENS),
+                    "new_tokens": list(LAT_NEW_TOKENS),
+                },
+                "runs": latency_runs,
+            },
             "device": jax.devices()[0].device_kind,
             "note": "vs_baseline compares round-1's best (224 tok/s/chip "
-                    "at batch 8, serial prefill).",
+                    "at batch 8, serial prefill). latency.runs compares "
+                    "whole-prompt vs chunked prefill under the same "
+                    "Poisson load: TTFT = submit to first token; ITL = "
+                    "gap between token callbacks (block decode emits in "
+                    "bursts of decode_block).",
         },
     }
     print(json.dumps(result), flush=True)
