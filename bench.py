@@ -12,6 +12,12 @@ Methodology (BASELINE.md: north star is tokens/sec/chip at 8B scale):
 - Real train steps (adafactor, bf16 activations, remat, donated state,
   Pallas flash attention), synthetic token batches, steady-state timing
   over N steps. batch=5 is the measured single-chip HBM sweet spot.
+- Roofline at seq 1024 (~67% MFU), measured 2026-07-30: batch 6 fits
+  but REGRESSES to 63.6% (allocator pressure), batch 7 OOMs, and
+  remat=False OOMs even at batch 3 -- so the dots-remat backward
+  recompute is mandatory and its recompute plus the fp32 softmax/CE and
+  adafactor elementwise passes are the non-MXU residual. The remaining
+  gap is not batch-size-addressable on one 16 GiB chip.
 - Sync via host transfer of the loss: on this axon backend,
   block_until_ready does not synchronize (measured), transfers do.
 - vs_baseline: measured MFU / 0.50 -- the reference publishes no numbers
