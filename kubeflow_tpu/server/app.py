@@ -748,8 +748,12 @@ table{border-collapse:collapse;min-width:40em}
 td,th{border:1px solid #ccc;padding:3px 8px;text-align:left;font-size:13px}
 th{background:#eee}
 .Succeeded,.Ready{color:#0a0} .Failed{color:#c00}
-.Running{color:#06c} .Pending,.Unready{color:#b60}
+.Running{color:#06c} .Pending,.Unready,.Stopped{color:#b60}
 #err{color:#c00}
+button{font-family:monospace;font-size:12px;margin-left:4px}
+form.create{margin:.3em 0 .8em}
+form.create input{font-family:monospace;font-size:12px;margin-right:4px}
+details{margin:.2em 0}
 </style></head><body>
 <h1>kftpu control plane</h1>
 <div id="err"></div><div id="root">loading...</div>
@@ -760,6 +764,7 @@ const KINDS = ["JAXJob","TFJob","PyTorchJob","MPIJob","XGBoostJob",
   "Notebook","Tensorboard","VolumeViewer","Profile","PodDefault"];
 const PHASE_ORDER = ["Failed","Succeeded","Suspended","Restarting",
   "Running","Ready","Unready","Created"];
+const STOP_ANN = "kftpu.io/stopped";
 function phaseOf(o){
   const active = (o.status && o.status.conditions || [])
     .filter(c=>c.status).map(c=>c.type);
@@ -771,18 +776,94 @@ function esc(s){
   return String(s).replace(/[&<>"']/g, c => ({"&":"&amp;","<":"&lt;",
     ">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
 }
+function fail(e){ document.getElementById("err").textContent = e; }
+// CRUD actions (reference P6 web apps): everything goes through the
+// same /apis routes the CLI uses, then re-renders. Buttons carry
+// data-* attributes read via dataset (never interpolate object names
+// into inline JS: the HTML parser decodes entities BEFORE the JS
+// engine parses, so entity-escaping cannot protect a string literal).
+async function submitSpec(kind, spec){
+  const r = await fetch("apis/"+kind, {method: "POST",
+    headers: {"Content-Type": "application/json"},
+    body: JSON.stringify(spec)});
+  if (!r.ok) fail(kind+" apply: "+await r.text());
+  await main();
+}
+async function del(kind, ns, name){
+  if (!confirm("delete " + kind + " " + ns + "/" + name + "?")) return;
+  const r = await fetch("apis/"+kind+"/"+encodeURIComponent(ns)+"/"
+    +encodeURIComponent(name), {method: "DELETE"});
+  if (!r.ok) fail(kind+" delete: "+await r.text());
+  await main();
+}
+async function toggleStop(ns, name){
+  const r = await fetch("apis/Notebook/"+encodeURIComponent(ns)+"/"
+    +encodeURIComponent(name));
+  if (!r.ok) { fail("notebook get: "+await r.text()); return; }
+  const o = await r.json();
+  o.metadata.annotations = o.metadata.annotations || {};
+  if (STOP_ANN in o.metadata.annotations)
+    delete o.metadata.annotations[STOP_ANN];
+  else o.metadata.annotations[STOP_ANN] = "dashboard";
+  await submitSpec("Notebook", o);
+}
+document.addEventListener("click", ev => {
+  const b = ev.target.closest("button[data-act]");
+  if (!b) return;
+  const d = b.dataset;
+  if (d.act === "del") del(d.kind, d.ns, d.name).catch(fail);
+  else if (d.act === "stop") toggleStop(d.ns, d.name).catch(fail);
+});
+function createNotebook(ev){
+  ev.preventDefault();
+  const f = ev.target;
+  const args = f.args.value.trim();
+  submitSpec("Notebook", {kind: "Notebook",
+    metadata: {name: f.name_.value, namespace: f.ns.value || "default"},
+    spec: {template: {entrypoint: f.entry.value,
+                      args: args ? args.split(/\\s+/) : []}}}).catch(fail);
+}
+function createTensorboard(ev){
+  ev.preventDefault();
+  const f = ev.target;
+  const spec = {};
+  if (f.job.value) spec.job = f.job.value;
+  if (f.logdir.value) spec.log_dir = f.logdir.value;
+  submitSpec("Tensorboard", {kind: "Tensorboard",
+    metadata: {name: f.name_.value, namespace: f.ns.value || "default"},
+    spec: spec}).catch(fail);
+}
+const CREATE_FORMS = {
+  Notebook: '<details><summary>new notebook</summary>'
+    +'<form class="create" onsubmit="createNotebook(event)">'
+    +'<input name="name_" placeholder="name" required>'
+    +'<input name="ns" placeholder="namespace (default)">'
+    +'<input name="entry" placeholder="entrypoint module" required>'
+    +'<input name="args" placeholder="args" size="24">'
+    +'<button>create</button></form></details>',
+  Tensorboard: '<details><summary>new tensorboard</summary>'
+    +'<form class="create" onsubmit="createTensorboard(event)">'
+    +'<input name="name_" placeholder="name" required>'
+    +'<input name="ns" placeholder="namespace (default)">'
+    +'<input name="job" placeholder="job name">'
+    +'<input name="logdir" placeholder="or log dir" size="24">'
+    +'<button>create</button></form></details>',
+};
 async function main(){
-  const root = document.getElementById("root"); root.innerHTML = "";
+  const root = document.getElementById("root");
+  let html = "";
   for (const kind of KINDS){
-    let items;
+    let items = [], listErr = null;
     try {
       const r = await fetch("apis/" + kind);
-      if (!r.ok) continue;
-      items = (await r.json()).items || [];
-    } catch (e) { continue; }
-    if (!items.length) continue;
+      if (r.ok) items = (await r.json()).items || [];
+      else listErr = kind + " list: HTTP " + r.status;
+    } catch (e) { listErr = kind + " list: " + e; }
+    const form = CREATE_FORMS[kind] || "";
+    if (!items.length && !form && !listErr) continue;
+    if (listErr) fail(listErr);
     const rows = items.map(o=>{
-      const ph = phaseOf(o);
+      let ph = phaseOf(o);
       // Escape everything object-controlled; links only for http(s).
       const raw = o.status && o.status.url;
       const url = raw && /^https?:\\/\\//.test(raw)
@@ -791,17 +872,29 @@ async function main(){
       let name = esc(o.metadata.name);
       if (kind === "Experiment")  // drill-down: trials + objective plot
         name = '<a href="dashboard/experiment/'+ns+'/'+name+'">'+name+'</a>';
+      const attrs = ' data-kind="'+esc(kind)+'" data-ns="'+ns
+        +'" data-name="'+esc(o.metadata.name)+'"';
+      let actions = '<button data-act="del"'+attrs+'>delete</button>';
+      if (kind === "Notebook"){
+        const stopped = (o.metadata.annotations||{})[STOP_ANN] !== undefined;
+        if (stopped) ph = "Stopped";
+        actions += ' <button data-act="stop"'+attrs+'>'
+          +(stopped ? "resume" : "stop")+'</button>';
+      }
       return "<tr><td>"+ns+"</td><td>"
         +name+'</td><td class="'+esc(ph)+'">'
-        +esc(ph)+url+"</td></tr>";
+        +esc(ph)+url+"</td><td>"+actions+"</td></tr>";
     }).join("");
-    root.innerHTML += "<h2>"+kind+" ("+items.length+")</h2>"
-      +"<table><tr><th>namespace</th><th>name</th><th>phase</th></tr>"
-      +rows+"</table>";
+    const table = items.length
+      ? "<table><tr><th>namespace</th><th>name</th><th>phase</th>"
+        +"<th>actions</th></tr>"+rows+"</table>"
+      : "";
+    const count = listErr ? "list failed" : items.length;
+    html += "<h2>"+kind+" ("+count+")</h2>"+form+table;
   }
-  if (!root.innerHTML) root.innerHTML = "no objects yet";
+  root.innerHTML = html || "no objects yet";
 }
-main().catch(e=>{document.getElementById("err").textContent = e});
+main().catch(fail);
 </script></body></html>
 """
 

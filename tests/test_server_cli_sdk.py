@@ -180,3 +180,8 @@ def test_dashboard_serves(server):
     assert "kftpu control plane" in text
     # Escaping helper present (stored-XSS guard) and kinds enumerated.
     assert "function esc(" in text and "InferenceService" in text
+    # CRUD actions (reference P6 web apps): create forms, delete,
+    # notebook stop/resume -- all riding the same /apis routes.
+    for frag in ("createNotebook", "createTensorboard", "toggleStop",
+                 "async function del(", "new notebook", "new tensorboard"):
+        assert frag in text, frag
