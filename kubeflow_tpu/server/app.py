@@ -786,15 +786,17 @@ async function submitSpec(kind, spec){
   const r = await fetch("apis/"+kind, {method: "POST",
     headers: {"Content-Type": "application/json"},
     body: JSON.stringify(spec)});
-  if (!r.ok) fail(kind+" apply: "+await r.text());
-  await main();
+  const err = r.ok ? null : kind+" apply: "+await r.text();
+  await main();  // re-render resets the banner; report AFTER
+  if (err) fail(err);
 }
 async function del(kind, ns, name){
   if (!confirm("delete " + kind + " " + ns + "/" + name + "?")) return;
   const r = await fetch("apis/"+kind+"/"+encodeURIComponent(ns)+"/"
     +encodeURIComponent(name), {method: "DELETE"});
-  if (!r.ok) fail(kind+" delete: "+await r.text());
+  const err = r.ok ? null : kind+" delete: "+await r.text();
   await main();
+  if (err) fail(err);
 }
 async function toggleStop(ns, name){
   const r = await fetch("apis/Notebook/"+encodeURIComponent(ns)+"/"
@@ -852,6 +854,7 @@ const CREATE_FORMS = {
 async function main(){
   const root = document.getElementById("root");
   let html = "";
+  const listErrs = [];
   for (const kind of KINDS){
     let items = [], listErr = null;
     try {
@@ -861,7 +864,7 @@ async function main(){
     } catch (e) { listErr = kind + " list: " + e; }
     const form = CREATE_FORMS[kind] || "";
     if (!items.length && !form && !listErr) continue;
-    if (listErr) fail(listErr);
+    if (listErr) listErrs.push(listErr);
     const rows = items.map(o=>{
       let ph = phaseOf(o);
       // Escape everything object-controlled; links only for http(s).
@@ -893,6 +896,8 @@ async function main(){
     html += "<h2>"+kind+" ("+count+")</h2>"+form+table;
   }
   root.innerHTML = html || "no objects yet";
+  // A successful render clears stale errors; failed lists aggregate.
+  fail(listErrs.join("; "));
 }
 main().catch(fail);
 </script></body></html>

@@ -1326,9 +1326,23 @@ class Activator:
         self.controller = controller
         self.cold_start_timeout = cold_start_timeout
 
+    @staticmethod
+    async def _wants_stream(req: web.Request) -> bool:
+        """OpenAI routes signal streaming in the body ("stream": true).
+        req.json() caches the payload, so the buffered path can still
+        read it."""
+        try:
+            body = await req.json()
+        except Exception:  # noqa: BLE001 - non-JSON: buffered path 400s
+            return False
+        return bool(isinstance(body, dict) and body.get("stream"))
+
     async def handle(self, req: web.Request) -> web.StreamResponse:
         tail = req.match_info.get("tail", "")
-        if req.method == "POST" and tail.endswith("generate_stream"):
+        if req.method == "POST" and (
+            tail.endswith("generate_stream")
+            or (tail.startswith("openai/") and await self._wants_stream(req))
+        ):
             # SSE token streaming: chunks must pass through as they
             # arrive -- buffering the body would turn TTFT into
             # time-to-last-token for every streaming client.

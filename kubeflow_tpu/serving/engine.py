@@ -296,7 +296,7 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths):
 
 
 def _decode_block(cfg: LlamaConfig, n_steps: int, w: dict, cache_k,
-                  cache_v, tokens, lengths, rng, temps):
+                  cache_v, tokens, lengths, rng, temps, top_ks, top_ps):
     """n_steps decode+sample iterations in ONE device program.
 
     Amortizes the host<->device dispatch roundtrip (dominant on remote
@@ -310,7 +310,7 @@ def _decode_block(cfg: LlamaConfig, n_steps: int, w: dict, cache_k,
     def body(carry, step_rng):
         ck, cv, toks, lens = carry
         logits, ck, cv = _decode(cfg, w, ck, cv, toks, lens)
-        nxt = _sample(logits, step_rng, temps)
+        nxt = _sample(logits, step_rng, temps, top_ks, top_ps)
         return (ck, cv, nxt, lens + 1), nxt
 
     rngs = jax.random.split(rng, n_steps)
@@ -320,11 +320,34 @@ def _decode_block(cfg: LlamaConfig, n_steps: int, w: dict, cache_k,
     return outs, ck, cv  # outs [n_steps, B]
 
 
-def _sample(logits, rng, temps):
-    """Per-slot sampling: temp<=0 means greedy. logits [B,V], temps [B]."""
+def _sample(logits, rng, temps, top_ks=None, top_ps=None):
+    """Per-slot sampling: temp<=0 means greedy; optional per-slot top-k
+    (0 = off) and top-p/nucleus (>=1.0 = off) truncation applied before
+    the categorical draw. logits [B,V]; temps/top_ks/top_ps [B].
+
+    Both filters are rank-based masks over the full vocab (sorted once),
+    so the program stays one fixed-shape fusion -- no dynamic gather of
+    a variable candidate set.
+    """
 
     greedy = jnp.argmax(logits, axis=-1)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    if top_ks is not None or top_ps is not None:
+        order = jnp.argsort(-scaled, axis=-1)
+        ranks = jnp.argsort(order, axis=-1)  # rank of each vocab entry
+        neg = jnp.float32(-1e30)
+        if top_ks is not None:
+            k = jnp.where(top_ks > 0, top_ks, scaled.shape[-1])[:, None]
+            scaled = jnp.where(ranks < k, scaled, neg)
+        if top_ps is not None:
+            sorted_logits = jnp.take_along_axis(scaled, order, axis=-1)
+            probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), -1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # Keep tokens whose CUMULATIVE mass before them is < p (the
+            # top token always survives).
+            keep_sorted = (cum - probs) < top_ps[:, None]
+            keep = jnp.take_along_axis(keep_sorted, ranks, axis=-1)
+            scaled = jnp.where(keep, scaled, neg)
     sampled = jax.random.categorical(rng, scaled, axis=-1)
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
@@ -506,6 +529,8 @@ class Request:
     prompt: List[int]
     max_new_tokens: int = 64
     temperature: float = 0.0
+    top_k: int = 0        # 0 = no top-k truncation
+    top_p: float = 1.0    # >= 1.0 = no nucleus truncation
     eos_id: Optional[int] = None
     future: Optional[Future] = None
     # Streaming: called with each generated token id, FROM THE ENGINE
@@ -649,20 +674,22 @@ class GenerationEngine:
         block_jits = {}
 
         def _block_fn(n):
-            def fn(w, ck, cv, toks, lens, rng, temps):
+            def fn(w, ck, cv, toks, lens, rng, temps, top_ks, top_ps):
                 outs, ck, cv = _decode_block(
-                    cfg, n, w, ck, cv, toks, lens, rng, temps
+                    cfg, n, w, ck, cv, toks, lens, rng, temps,
+                    top_ks, top_ps,
                 )
                 return outs, _pin(ck), _pin(cv)
             return fn
 
-        def decode_block_call(n, ck, cv, toks, lens, rng, temps):
+        def decode_block_call(n, ck, cv, toks, lens, rng, temps,
+                              top_ks, top_ps):
             if n not in block_jits:
                 block_jits[n] = jax.jit(
                     _block_fn(n), donate_argnums=(1, 2)
                 )
             return block_jits[n](self.weights, ck, cv, toks, lens, rng,
-                                 temps)
+                                 temps, top_ks, top_ps)
 
         self._decode_block_call = decode_block_call
 
@@ -800,10 +827,15 @@ class GenerationEngine:
                 jnp.asarray(padded_slots),
             )
             temps = np.zeros(kbucket, np.float32)
+            top_ks = np.zeros(kbucket, np.int32)
+            top_ps = np.ones(kbucket, np.float32)
             for j, r in enumerate(reqs):
                 temps[j] = r.temperature
+                top_ks[j] = r.top_k
+                top_ps[j] = r.top_p
             first = np.asarray(self._sample(
-                logits, self._next_rng(), jnp.asarray(temps)
+                logits, self._next_rng(), jnp.asarray(temps),
+                jnp.asarray(top_ks), jnp.asarray(top_ps),
             ))
             for j, (req, slot) in enumerate(zip(reqs, slots)):
                 req.slot = slot
@@ -826,6 +858,8 @@ class GenerationEngine:
         clens = np.ones(kbucket, np.int32)
         slots = np.full(kbucket, self.max_slots, np.int32)  # dummies drop
         temps = np.zeros(kbucket, np.float32)
+        top_ks = np.zeros(kbucket, np.int32)
+        top_ps = np.ones(kbucket, np.float32)
         max_end = 1
         for j, (slot, req) in enumerate(items):
             n = min(c, len(req.prompt) - req.prefilled)
@@ -834,6 +868,8 @@ class GenerationEngine:
             clens[j] = n
             slots[j] = slot
             temps[j] = req.temperature
+            top_ks[j] = req.top_k
+            top_ps[j] = req.top_p
             # Real tokens bound klen; padding lanes past n attend garbage
             # that's discarded, so they don't need covering.
             max_end = max(max_end, req.prefilled + n)
@@ -849,7 +885,8 @@ class GenerationEngine:
                 continue
             if first is None:
                 first = np.asarray(self._sample(
-                    logits, self._next_rng(), jnp.asarray(temps)
+                    logits, self._next_rng(), jnp.asarray(temps),
+                    jnp.asarray(top_ks), jnp.asarray(top_ps),
                 ))
             del self.prefilling[slot]
             self.lengths[slot] = len(req.prompt)
@@ -911,6 +948,8 @@ class GenerationEngine:
             n *= 2
         tokens = np.zeros(self.max_slots, np.int32)
         temps = np.zeros(self.max_slots, np.float32)
+        top_ks = np.zeros(self.max_slots, np.int32)
+        top_ps = np.ones(self.max_slots, np.float32)
         # Non-active slots park at Smax-1: decode writes dummy K/V for
         # EVERY row, and position 0 of a mid-prefill slot already holds
         # real chunked-prefill state. Smax-1 garbage is safe for any
@@ -921,6 +960,8 @@ class GenerationEngine:
         for slot, req in self.active.items():
             tokens[slot] = req.generated[-1]
             temps[slot] = req.temperature
+            top_ks[slot] = req.top_k
+            top_ps[slot] = req.top_p
             # lengths[slot] already counts the last generated token, whose
             # K/V is not in the cache yet: its position is lengths-1.
             positions_np[slot] = max(int(self.lengths[slot]) - 1, 0)
@@ -928,6 +969,7 @@ class GenerationEngine:
         outs, self.cache_k, self.cache_v = self._decode_block_call(
             n, self.cache_k, self.cache_v, jnp.asarray(tokens), positions,
             self._next_rng(), jnp.asarray(temps),
+            jnp.asarray(top_ks), jnp.asarray(top_ps),
         )
         outs = np.asarray(outs)  # [n, B]
         for slot in list(self.active):
@@ -942,10 +984,12 @@ class GenerationEngine:
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 64,
                  temperature: float = 0.0,
-                 eos_id: Optional[int] = None) -> List[int]:
+                 eos_id: Optional[int] = None,
+                 top_k: int = 0, top_p: float = 1.0) -> List[int]:
         """Synchronous single-request generation (drives step() inline)."""
 
-        req = Request(list(prompt), max_new_tokens, temperature, eos_id)
+        req = Request(list(prompt), max_new_tokens, temperature,
+                      top_k, top_p, eos_id)
         fut = self.submit(req)
         if self._thread is not None:
             return fut.result(timeout=600)

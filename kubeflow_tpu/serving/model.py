@@ -79,6 +79,12 @@ class Model:
     def metadata(self) -> Dict[str, Any]:
         return {"name": self.name, "platform": "kftpu", "inputs": [], "outputs": []}
 
+    # Token accounting for the OpenAI usage block. The base is an
+    # honest approximation (characters); tokenizer-bearing models
+    # override with a real count.
+    def count_tokens(self, text: str) -> int:
+        return len(text)
+
     # Explanation (V1 ``:explain``). Explainer components override
     # (serving.explainer.ExplainerModel); a model may also implement it
     # directly, as the reference's kserve.Model.explain hook allows.

@@ -247,6 +247,9 @@ class JaxLLMModel(Model):
             return {"error": "empty prompt"}, inst
         return (ids, text_out), inst
 
+    def count_tokens(self, text: str) -> int:
+        return len(self.tokenizer.encode(text))
+
     def submit_stream(self, instance: Any, on_token) -> tuple:
         from kubeflow_tpu.serving.engine import Request
 
@@ -258,6 +261,8 @@ class JaxLLMModel(Model):
             prompt=ids,
             max_new_tokens=int(inst.get("max_new_tokens", 64)),
             temperature=float(inst.get("temperature", 0.0)),
+            top_k=int(inst.get("top_k", 0)),
+            top_p=float(inst.get("top_p", 1.0)),
             eos_id=inst.get("eos_id", self.tokenizer.eos_id),
             on_token=on_token,
         )
@@ -280,6 +285,8 @@ class JaxLLMModel(Model):
                 prompt=ids,
                 max_new_tokens=int(inst.get("max_new_tokens", 64)),
                 temperature=float(inst.get("temperature", 0.0)),
+                top_k=int(inst.get("top_k", 0)),
+                top_p=float(inst.get("top_p", 1.0)),
                 eos_id=inst.get("eos_id", self.tokenizer.eos_id),
             )
             slots.append((self.engine.submit(req), text_out))

@@ -77,6 +77,10 @@ class ModelServer:
                      self.h_v2_generate_stream),
             web.post("/v2/repository/models/{m}/load", self.h_v2_load),
             web.post("/v2/repository/models/{m}/unload", self.h_v2_unload),
+            # OpenAI-compatible surface (reference: huggingfaceserver).
+            web.get("/openai/v1/models", self.h_openai_models),
+            web.post("/openai/v1/completions", self.h_openai_completions),
+            web.post("/openai/v1/chat/completions", self.h_openai_chat),
         ])
 
         async def on_startup(app):
@@ -295,7 +299,7 @@ class ModelServer:
         and the V1-instance shape ({"prompt"|"token_ids", ...}) alike."""
         inst = dict(body.get("parameters") or {})
         for k in ("prompt", "token_ids", "max_new_tokens", "temperature",
-                  "eos_id"):
+                  "top_k", "top_p", "eos_id"):
             if k in body:
                 inst[k] = body[k]
         if "text_input" in body:
@@ -334,6 +338,64 @@ class ModelServer:
         finally:
             self.predict_seconds += time.monotonic() - t0
 
+    async def _stream_deltas(self, model, inst):
+        """Async generator over one streaming generation: yields
+        (delta_text, token_id_or_None, ids_so_far) per event, handling
+        the engine-thread bridge and split-codepoint withholding (deltas
+        must concatenate EXACTLY to the final text: a codepoint split
+        across tokens decodes to a trailing U+FFFD that the next token
+        replaces -- or raises, for a strict decoder -- so the unstable
+        tail is held back). Raises the engine error, if any, at the end.
+        Shared by the V2 generate_stream and OpenAI SSE framings."""
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        done = object()
+
+        def on_token(tok: int) -> None:  # engine thread
+            loop.call_soon_threadsafe(q.put_nowait, tok)
+
+        fut, decode = model.submit_stream(inst, on_token)
+        fut.add_done_callback(
+            lambda _f: loop.call_soon_threadsafe(q.put_nowait, done)
+        )
+        ids: list = []
+        text = ""
+        while True:
+            tok = await q.get()
+            if tok is done:
+                break
+            ids.append(tok)
+            try:
+                full = decode(ids)
+            except (UnicodeDecodeError, ValueError):
+                full = None
+            if (full is not None and full.startswith(text)
+                    and not full.endswith("\ufffd")):
+                delta, text = full[len(text):], full
+            else:
+                delta = ""
+            yield delta, tok, ids
+        if ids:
+            # Flush any withheld tail (stream ended mid-codepoint).
+            try:
+                full = decode(ids)
+            except (UnicodeDecodeError, ValueError):
+                full = text
+            tail = full[len(text):] if full.startswith(text) else full
+            if tail:
+                yield tail, None, ids
+        exc = fut.exception()
+        if exc is not None:
+            raise exc
+
+    async def _sse_response(self, req: web.Request) -> web.StreamResponse:
+        resp = web.StreamResponse()
+        resp.headers["Content-Type"] = "text/event-stream"
+        resp.headers["Cache-Control"] = "no-cache"
+        resp.headers["X-Accel-Buffering"] = "no"
+        await resp.prepare(req)
+        return resp
+
     async def h_v2_generate_stream(self, req: web.Request) -> web.StreamResponse:
         """SSE token stream: one ``data: {...}`` event per generated token
         with the incremental text delta, then ``data: [DONE]``. TTFT is
@@ -347,80 +409,41 @@ class ModelServer:
                 raise InferenceError(f"model {name} is not ready", status=503)
             self.repository.touch(name)
             body = await req.json()
+            stream = self._stream_deltas(
+                model, self._generate_instance(body)
+            )
+            # Prime before prepare: submit-time errors (bad instance,
+            # dead engine) must be clean HTTP errors, not mid-SSE.
+            first = await anext(stream, None)
         except json.JSONDecodeError:
             self.error_count += 1
             return web.json_response({"error": "body is not JSON"}, status=400)
+        except ValueError as e:
+            # Engine-side request validation (too long, empty): client
+            # error, same status the buffered route returns.
+            self.error_count += 1
+            return self._err(InferenceError(str(e), 400))
         except Exception as e:  # noqa: BLE001
             self.error_count += 1
             return self._err(e)
-        loop = asyncio.get_running_loop()
-        q: asyncio.Queue = asyncio.Queue()
-        done = object()
-
-        def on_token(tok: int) -> None:  # engine thread
-            loop.call_soon_threadsafe(q.put_nowait, tok)
-
+        resp = await self._sse_response(req)
         try:
-            fut, decode = model.submit_stream(
-                self._generate_instance(body), on_token
-            )
-        except Exception as e:  # noqa: BLE001 - any submit failure is a
-            self.error_count += 1  # clean pre-stream HTTP error
-            return self._err(e)
-        fut.add_done_callback(
-            lambda _f: loop.call_soon_threadsafe(q.put_nowait, done)
-        )
-        resp = web.StreamResponse()
-        resp.headers["Content-Type"] = "text/event-stream"
-        resp.headers["Cache-Control"] = "no-cache"
-        resp.headers["X-Accel-Buffering"] = "no"
-        await resp.prepare(req)
-        ids: list = []
-        text = ""
-        try:
-            while True:
-                tok = await q.get()
-                if tok is done:
-                    break
-                ids.append(tok)
-                # Deltas must concatenate to the final text. A codepoint
-                # split across tokens decodes to a trailing U+FFFD that
-                # the NEXT token replaces (or raises, for a strict
-                # decoder) -- holding the unstable tail back (empty delta
-                # this event) keeps the concatenation exact instead of
-                # leaking replacement chars.
-                try:
-                    full = decode(ids)
-                except (UnicodeDecodeError, ValueError):
-                    full = None
-                if (full is not None and full.startswith(text)
-                        and not full.endswith("�")):
-                    delta, text = full[len(text):], full
-                else:
-                    delta = ""
+            async def emit(delta, tok):
+                ev = {"text_output": delta}
+                if tok is not None:
+                    ev["token_id"] = tok
+                await resp.write(b"data: " + json.dumps(ev).encode()
+                                 + b"\n\n")
+
+            try:
+                if first is not None:
+                    await emit(first[0], first[1])
+                    async for delta, tok, _ids in stream:
+                        await emit(delta, tok)
+            except Exception as e:  # noqa: BLE001 - headers already sent:
+                self.error_count += 1  # the error must go in-band
                 await resp.write(
-                    b"data: " + json.dumps({
-                        "token_id": tok, "text_output": delta,
-                    }).encode() + b"\n\n"
-                )
-            if ids:
-                # Flush any withheld tail (stream ended mid-codepoint).
-                try:
-                    full = decode(ids)
-                except (UnicodeDecodeError, ValueError):
-                    full = text
-                tail = full[len(text):] if full.startswith(text) else full
-                if tail:
-                    await resp.write(
-                        b"data: " + json.dumps(
-                            {"text_output": tail}
-                        ).encode() + b"\n\n"
-                    )
-            exc = fut.exception()
-            if exc is not None:
-                self.error_count += 1
-                await resp.write(
-                    b"data: " + json.dumps({"error": str(exc)}).encode()
+                    b"data: " + json.dumps({"error": str(e)}).encode()
                     + b"\n\n"
                 )
             await resp.write(b"data: [DONE]\n\n")
@@ -433,6 +456,172 @@ class ModelServer:
         finally:
             self.predict_seconds += time.monotonic() - t0
         return resp
+
+    # -- OpenAI-compatible API (reference: huggingfaceserver's OpenAI
+    # endpoints in front of the vLLM backend) ------------------------------
+
+    @staticmethod
+    def _openai_instance(body: dict, prompt: str) -> dict:
+        return {
+            "prompt": prompt,
+            "max_new_tokens": int(body.get("max_tokens", 16)),
+            "temperature": float(body.get("temperature", 1.0)),
+            "top_p": float(body.get("top_p", 1.0)),
+        }
+
+    @staticmethod
+    def _chat_prompt(messages) -> str:
+        """Minimal chat rendering: role-prefixed lines + assistant cue.
+        (No model-specific chat template -- the byte/HF tokenizers here
+        carry none; documented, deterministic, good enough for the
+        protocol surface.)"""
+        if not isinstance(messages, list) or not messages:
+            raise InferenceError('"messages" must be a non-empty list', 400)
+        lines = []
+        for m in messages:
+            if not isinstance(m, dict) or "content" not in m:
+                raise InferenceError(
+                    'each message needs "role" and "content"', 400)
+            content = m["content"]
+            if isinstance(content, list):
+                # OpenAI content-parts form: concatenate the text parts.
+                texts = [
+                    part.get("text", "") for part in content
+                    if isinstance(part, dict) and part.get("type") == "text"
+                ]
+                if not texts:
+                    raise InferenceError(
+                        "only text content parts are supported", 400)
+                content = " ".join(texts)
+            elif not isinstance(content, str):
+                raise InferenceError(
+                    'message "content" must be a string or text parts',
+                    400)
+            lines.append(f"{m.get('role', 'user')}: {content}")
+        lines.append("assistant:")
+        return "\n".join(lines)
+
+    async def h_openai_models(self, req: web.Request) -> web.Response:
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": n, "object": "model", "owned_by": "kftpu"}
+                     for n in self.repository.names()],
+        })
+
+    async def _openai_generate(self, req, chat: bool) -> web.StreamResponse:
+        self.request_count += 1
+        t0 = time.monotonic()
+        obj = "chat.completion" if chat else "text_completion"
+        streaming = False  # once True, the SSE tail owns predict_seconds
+        try:
+            body = await req.json()
+            name = body.get("model") or ""
+            model = self.repository.get(name)
+            if not model.ready:
+                raise InferenceError(f"model {name} is not ready", status=503)
+            self.repository.touch(name)
+            if chat:
+                prompt = self._chat_prompt(body.get("messages"))
+            else:
+                p = body.get("prompt")
+                if isinstance(p, list):
+                    if len(p) != 1:
+                        raise InferenceError(
+                            "only a single prompt is supported", 400)
+                    p = p[0]
+                if not isinstance(p, str):
+                    raise InferenceError('"prompt" must be a string', 400)
+                prompt = p
+            inst = self._openai_instance(body, prompt)
+            rid = f"cmpl-{int(t0 * 1000):x}"
+            if not body.get("stream"):
+                fut, decode = model.submit_stream(inst, None)
+                try:
+                    ids = await asyncio.wrap_future(fut)
+                except ValueError as e:
+                    raise InferenceError(str(e), 400)
+                text = decode(ids)
+                finish = ("length" if len(ids) >= inst["max_new_tokens"]
+                          else "stop")
+                choice = (
+                    {"index": 0, "finish_reason": finish,
+                     "message": {"role": "assistant", "content": text}}
+                    if chat else
+                    {"index": 0, "finish_reason": finish, "text": text}
+                )
+                pt = model.count_tokens(prompt)
+                return web.json_response({
+                    "id": rid, "object": obj, "model": name,
+                    "choices": [choice],
+                    "usage": {
+                        "prompt_tokens": pt,
+                        "completion_tokens": len(ids),
+                        "total_tokens": pt + len(ids),
+                    },
+                })
+            stream = self._stream_deltas(model, inst)
+            first = await anext(stream, None)
+            streaming = True
+        except json.JSONDecodeError:
+            self.error_count += 1
+            return web.json_response({"error": "body is not JSON"}, status=400)
+        except ValueError as e:
+            self.error_count += 1
+            return self._err(InferenceError(str(e), 400))
+        except Exception as e:  # noqa: BLE001
+            self.error_count += 1
+            return self._err(e)
+        finally:
+            if not streaming:
+                # Buffered + error paths account here; the SSE tail's own
+                # finally covers the streaming path end-to-end.
+                self.predict_seconds += time.monotonic() - t0
+        resp = await self._sse_response(req)
+        try:
+            n_tokens = 0
+
+            async def emit(delta, finish=None):
+                if chat:
+                    choice = {"index": 0, "finish_reason": finish,
+                              "delta": ({"content": delta} if finish is None
+                                        else {})}
+                else:
+                    choice = {"index": 0, "finish_reason": finish,
+                              "text": delta}
+                await resp.write(b"data: " + json.dumps({
+                    "id": rid, "object": obj + ".chunk", "model": name,
+                    "choices": [choice],
+                }).encode() + b"\n\n")
+
+            try:
+                if first is not None:
+                    n_tokens += first[1] is not None
+                    await emit(first[0])
+                    async for delta, tok, _ids in stream:
+                        n_tokens += tok is not None
+                        await emit(delta)
+                await emit("", finish=(
+                    "length" if n_tokens >= inst["max_new_tokens"]
+                    else "stop"))
+            except Exception as e:  # noqa: BLE001 - headers sent: in-band
+                self.error_count += 1
+                await resp.write(
+                    b"data: " + json.dumps({"error": str(e)}).encode()
+                    + b"\n\n"
+                )
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            self.predict_seconds += time.monotonic() - t0
+        return resp
+
+    async def h_openai_completions(self, req: web.Request):
+        return await self._openai_generate(req, chat=False)
+
+    async def h_openai_chat(self, req: web.Request):
+        return await self._openai_generate(req, chat=True)
 
     # -- payload logging (S6) ----------------------------------------------
 

@@ -239,6 +239,33 @@ def test_jax_llm_isvc_end_to_end(cp_client):
         # Greedy: the streamed ids equal the buffered predict's ids.
         assert toks == preds[0]["token_ids"]
 
+        # OpenAI-compatible surface through the activator: buffered
+        # completions and body-signaled SSE streaming.
+        r = await client.post(
+            "/serving/default/llm/openai/v1/completions",
+            json={"model": "llm", "prompt": "hello tpu",
+                  "max_tokens": 4, "temperature": 0},
+        )
+        assert r.status == 200, await r.text()
+        body = await r.json()
+        assert body["object"] == "text_completion"
+        assert body["usage"]["completion_tokens"] == 4
+        r = await client.post(
+            "/serving/default/llm/openai/v1/completions",
+            json={"model": "llm", "prompt": "hello tpu",
+                  "max_tokens": 4, "temperature": 0, "stream": True},
+        )
+        assert r.status == 200, await r.text()
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        chunks = []
+        async for line in r.content:
+            line = line.decode().strip()
+            if line.startswith("data: "):
+                chunks.append(line[len("data: "):])
+        assert chunks[-1] == "[DONE]"
+        texts = [json.loads(c)["choices"][0]["text"] for c in chunks[:-1]]
+        assert "".join(texts) == body["choices"][0]["text"]
+
     loop.run_until_complete(run())
 
 

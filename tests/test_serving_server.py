@@ -546,3 +546,61 @@ def test_isvc_explainer_validation():
     }
     with pytest.raises(ServingValidationError, match="explainer"):
         validate_isvc(InferenceService.from_dict(d))
+
+
+def test_openai_endpoints(stream_client):
+    c, loop = stream_client
+
+    async def run():
+        r = await c.get("/openai/v1/models")
+        assert r.status == 200
+        ids = [m["id"] for m in (await r.json())["data"]]
+        assert "gen" in ids
+
+        # Buffered completions.
+        r = await c.post("/openai/v1/completions",
+                         json={"model": "gen", "prompt": "x",
+                               "max_tokens": 16})
+        assert r.status == 200
+        body = await r.json()
+        assert body["object"] == "text_completion"
+        assert body["choices"][0]["text"] == "hi!"
+        assert body["choices"][0]["finish_reason"] == "stop"
+        assert body["usage"]["completion_tokens"] == 3
+
+        # Streaming completions: deltas concatenate; final finish_reason.
+        r = await c.post("/openai/v1/completions",
+                         json={"model": "gen", "prompt": "x",
+                               "stream": True})
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        import json as _json
+
+        events = []
+        async for line in r.content:
+            line = line.decode().strip()
+            if line.startswith("data: "):
+                events.append(line[len("data: "):])
+        assert events[-1] == "[DONE]"
+        chunks = [_json.loads(e) for e in events[:-1]]
+        assert "".join(ch["choices"][0]["text"] for ch in chunks) == "hi!"
+        assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+
+        # Chat completions (role-prefixed prompt rendering).
+        r = await c.post("/openai/v1/chat/completions",
+                         json={"model": "gen", "messages": [
+                             {"role": "user", "content": "hello"}]})
+        assert r.status == 200
+        body = await r.json()
+        assert body["object"] == "chat.completion"
+        assert body["choices"][0]["message"]["content"] == "hi!"
+
+        # Unknown model -> 404; bad prompt -> 400.
+        r = await c.post("/openai/v1/completions",
+                         json={"model": "nope", "prompt": "x"})
+        assert r.status == 404
+        r = await c.post("/openai/v1/completions",
+                         json={"model": "gen", "prompt": ["a", "b"]})
+        assert r.status == 400
+
+    loop.run_until_complete(run())
