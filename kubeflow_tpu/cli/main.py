@@ -72,7 +72,22 @@ def age_of(obj: dict) -> str:
 
 
 def cmd_apply(args, client: TrainingClient) -> int:
+    paths = []
     for path in args.filename:
+        if path != "-" and os.path.isdir(path):
+            # Directory apply (the reference's kustomize-install analog):
+            # every .yaml inside, sorted, so manifests/ trees install in
+            # one command.
+            found = sorted(
+                os.path.join(path, n) for n in os.listdir(path)
+                if n.endswith((".yaml", ".yml"))
+            )
+            if not found:
+                raise SystemExit(f"error: no .yaml files in {path}")
+            paths.extend(found)
+        else:
+            paths.append(path)
+    for path in paths:
         try:
             f = sys.stdin if path == "-" else open(path)
         except OSError as e:

@@ -356,3 +356,31 @@ class TrainingClient:
             {"instances": instances},
             timeout=timeout,
         )["predictions"]
+
+    def explain(self, name: str, instances: list,
+                namespace: str = "default", model: Optional[str] = None,
+                timeout: float = 300.0) -> list:
+        """V1 explain through the activator: routes to the ISVC's
+        explainer component (per-feature attributions)."""
+        model = model or name
+        return self._req(
+            "POST",
+            f"/serving/{namespace}/{name}/v1/models/{model}:explain",
+            {"instances": instances},
+            timeout=timeout,
+        )["explanations"]
+
+    def generate(self, name: str, prompt: str, namespace: str = "default",
+                 model: Optional[str] = None, max_new_tokens: int = 64,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, timeout: float = 300.0) -> dict:
+        """Buffered text generation (V2 generate extension) against an
+        LLM ISVC; returns {"text_output", "token_ids", ...}."""
+        model = model or name
+        return self._req(
+            "POST",
+            f"/serving/{namespace}/{name}/v2/models/{model}/generate",
+            {"text_input": prompt, "max_new_tokens": max_new_tokens,
+             "temperature": temperature, "top_k": top_k, "top_p": top_p},
+            timeout=timeout,
+        )

@@ -440,6 +440,8 @@ class ModelServer:
                     await emit(first[0], first[1])
                     async for delta, tok, _ids in stream:
                         await emit(delta, tok)
+            except (ConnectionResetError, asyncio.CancelledError):
+                raise  # client hung up: outer handler, not an error stat
             except Exception as e:  # noqa: BLE001 - headers already sent:
                 self.error_count += 1  # the error must go in-band
                 await resp.write(
@@ -462,11 +464,17 @@ class ModelServer:
 
     @staticmethod
     def _openai_instance(body: dict, prompt: str) -> dict:
+        # Every knob is NULLABLE in the OpenAI API (clients/proxies send
+        # explicit nulls): null means default, not TypeError.
+        def opt(key, default, cast):
+            v = body.get(key)
+            return default if v is None else cast(v)
+
         return {
             "prompt": prompt,
-            "max_new_tokens": int(body.get("max_tokens", 16)),
-            "temperature": float(body.get("temperature", 1.0)),
-            "top_p": float(body.get("top_p", 1.0)),
+            "max_new_tokens": opt("max_tokens", 16, int),
+            "temperature": opt("temperature", 1.0, float),
+            "top_p": opt("top_p", 1.0, float),
         }
 
     @staticmethod
@@ -533,7 +541,7 @@ class ModelServer:
                     raise InferenceError('"prompt" must be a string', 400)
                 prompt = p
             inst = self._openai_instance(body, prompt)
-            rid = f"cmpl-{int(t0 * 1000):x}"
+            rid = f"{'chatcmpl' if chat else 'cmpl'}-{int(t0 * 1000):x}"
             if not body.get("stream"):
                 fut, decode = model.submit_stream(inst, None)
                 try:
@@ -579,15 +587,22 @@ class ModelServer:
         resp = await self._sse_response(req)
         try:
             n_tokens = 0
+            first_chunk = True
 
             async def emit(delta, finish=None):
+                nonlocal first_chunk
                 if chat:
+                    d = {} if finish is not None else {"content": delta}
+                    if first_chunk:
+                        # OpenAI chat-stream contract: the first delta
+                        # carries the assistant role.
+                        d = {"role": "assistant", **d}
                     choice = {"index": 0, "finish_reason": finish,
-                              "delta": ({"content": delta} if finish is None
-                                        else {})}
+                              "delta": d}
                 else:
                     choice = {"index": 0, "finish_reason": finish,
                               "text": delta}
+                first_chunk = False
                 await resp.write(b"data: " + json.dumps({
                     "id": rid, "object": obj + ".chunk", "model": name,
                     "choices": [choice],
@@ -603,6 +618,8 @@ class ModelServer:
                 await emit("", finish=(
                     "length" if n_tokens >= inst["max_new_tokens"]
                     else "stop"))
+            except (ConnectionResetError, asyncio.CancelledError):
+                raise  # client hung up: outer handler, not an error stat
             except Exception as e:  # noqa: BLE001 - headers sent: in-band
                 self.error_count += 1
                 await resp.write(

@@ -185,3 +185,46 @@ def test_dashboard_serves(server):
     for frag in ("createNotebook", "createTensorboard", "toggleStop",
                  "async function del(", "new notebook", "new tensorboard"):
         assert frag in text, frag
+
+
+def test_sdk_serving_helper_routes():
+    """predict/explain/generate are thin wrappers: right route, right
+    payload (the routes themselves are e2e-tested in the serving suites)."""
+    from kubeflow_tpu.sdk import TrainingClient
+
+    tc = TrainingClient(server="http://stub")
+    calls = []
+
+    def fake_req(method, path, body=None, timeout=0.0):
+        calls.append((method, path, body))
+        return {"predictions": ["p"], "explanations": ["e"],
+                "text_output": "t", "token_ids": [1]}
+
+    tc._req = fake_req
+    assert tc.predict("m", [[1.0]]) == ["p"]
+    assert tc.explain("m", [[1.0]]) == ["e"]
+    out = tc.generate("m", "hi", max_new_tokens=3, top_k=2)
+    assert out["text_output"] == "t"
+    paths = [c[1] for c in calls]
+    assert paths[0].endswith("/v1/models/m:predict")
+    assert paths[1].endswith("/v1/models/m:explain")
+    assert paths[2].endswith("/v2/models/m/generate")
+    assert calls[2][2]["top_k"] == 2 and calls[2][2]["max_new_tokens"] == 3
+
+
+@pytest.mark.e2e
+def test_apply_manifests_directory(server):
+    """Directory apply installs the platform tree (reference P8: the
+    kustomize manifests install, collapsed to control-plane objects)."""
+    r = kftpu(server, "apply", "-f", str(REPO / "manifests"))
+    out = r.stdout
+    assert "profile/team-research applied" in out
+    assert "profile/team-serving applied" in out
+    assert "poddefault/compile-cache applied" in out
+    out = kftpu(server, "get", "profile").stdout
+    assert "team-research" in out and "team-serving" in out
+    # Quota is live: the namespace's chip quota comes from the manifest.
+    from kubeflow_tpu.sdk import TrainingClient
+
+    obj = TrainingClient(server).get("Profile", "team-research", "default")
+    assert obj["spec"]["quota"]["tpu"] == 8

@@ -586,14 +586,34 @@ def test_openai_endpoints(stream_client):
         assert "".join(ch["choices"][0]["text"] for ch in chunks) == "hi!"
         assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
 
-        # Chat completions (role-prefixed prompt rendering).
+        # Chat completions (role-prefixed prompt rendering). Nullable
+        # knobs (explicit JSON nulls) must take defaults, not 500.
         r = await c.post("/openai/v1/chat/completions",
                          json={"model": "gen", "messages": [
-                             {"role": "user", "content": "hello"}]})
+                             {"role": "user", "content": "hello"}],
+                             "max_tokens": None, "temperature": None})
         assert r.status == 200
         body = await r.json()
         assert body["object"] == "chat.completion"
+        assert body["id"].startswith("chatcmpl-")
         assert body["choices"][0]["message"]["content"] == "hi!"
+
+        # Chat streaming: first delta carries the assistant role.
+        r = await c.post("/openai/v1/chat/completions",
+                         json={"model": "gen", "messages": [
+                             {"role": "user", "content": "hello"}],
+                             "stream": True})
+        assert r.status == 200
+        ev = []
+        async for line in r.content:
+            line = line.decode().strip()
+            if line.startswith("data: ") and line != "data: [DONE]":
+                ev.append(_json.loads(line[len("data: "):]))
+        assert ev[0]["choices"][0]["delta"].get("role") == "assistant"
+        joined = "".join(
+            ch["choices"][0]["delta"].get("content", "") for ch in ev
+        )
+        assert joined == "hi!"
 
         # Unknown model -> 404; bad prompt -> 400.
         r = await c.post("/openai/v1/completions",
