@@ -18,6 +18,11 @@ Methodology (BASELINE.md: north star is tokens/sec/chip at 8B scale):
   recompute is mandatory and its recompute plus the fp32 softmax/CE and
   adafactor elementwise passes are the non-MXU residual. The remaining
   gap is not batch-size-addressable on one 16 GiB chip.
+- Sweep configs are measured optima too: at 2048, b3+loss_chunk hits
+  62.3% (< b2's 64.4%; the chunked-CE recompute isn't free) and b4
+  OOMs; at 4096, b2 needs chunk+minimal-remat and lands at 54.3%
+  (< b1/dots' 60.6%). The chunk/minimal levers are FIT tools for 8192,
+  not speedups below it.
 - Sync via host transfer of the loss: on this axon backend,
   block_until_ready does not synchronize (measured), transfers do.
 - vs_baseline: measured MFU / 0.50 -- the reference publishes no numbers
