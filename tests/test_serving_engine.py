@@ -301,6 +301,23 @@ class TestTensorParallelServing:
         for r, o in zip(reqs, outs):
             assert base.generate(r.prompt, max_new_tokens=6) == o
 
+    def test_tp_chunked_prefill_identical(self):
+        """Chunked prefill composes with tensor parallelism: the TP
+        engine's chunk scatter/gather over the KV-sharded cache must
+        produce the same tokens as the single-device chunked engine."""
+        from kubeflow_tpu.serving.engine import make_tp_mesh
+
+        cfg = self._f32("llama-tiny")
+        base = GenerationEngine(config=cfg, max_slots=2, decode_block=4,
+                                prefill_chunk=8, seed=3)
+        tp = GenerationEngine(config=cfg, max_slots=2, decode_block=4,
+                              prefill_chunk=8, seed=3,
+                              mesh=make_tp_mesh(2))
+        prompt = list(range(2, 40))  # 38 tokens -> 5 chunks
+        assert base.generate(prompt, max_new_tokens=8) == tp.generate(
+            prompt, max_new_tokens=8
+        )
+
     def test_tp_divisibility_validated(self):
         cfg = self._f32("llama-tiny")  # n_kv_heads=2
         with pytest.raises(ValueError, match="divide"):
