@@ -302,9 +302,25 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths):
     return logits, new_k, new_v
 
 
-def _decode_block(cfg: LlamaConfig, n_steps: int, filtered: bool, w: dict,
-                  cache_k, cache_v, tokens, lengths, rng, temps, top_ks,
-                  top_ps):
+# Fixed top-k width of the device-side logprob outputs (OpenAI caps
+# completions logprobs at 5, chat top_logprobs at 20; 8 covers the
+# common case in one static shape -- per-request N trims host-side).
+LOGPROBS_K = 8
+
+
+def _logprob_outputs(logits, chosen):
+    """(chosen_logprob [B], top_ids [B,K], top_logprobs [B,K]) from raw
+    f32 logits -- log-softmax BEFORE temperature/filtering, the OpenAI
+    logprobs contract."""
+    lps = jax.nn.log_softmax(logits, axis=-1)
+    sel = jnp.take_along_axis(lps, chosen[:, None], axis=-1)[:, 0]
+    top_lps, top_ids = jax.lax.top_k(lps, LOGPROBS_K)
+    return sel, top_ids, top_lps
+
+
+def _decode_block(cfg: LlamaConfig, n_steps: int, filtered: bool,
+                  want_lp: bool, w: dict, cache_k, cache_v, tokens,
+                  lengths, rng, temps, top_ks, top_ps):
     """n_steps decode+sample iterations in ONE device program.
 
     Amortizes the host<->device dispatch roundtrip (dominant on remote
@@ -313,6 +329,10 @@ def _decode_block(cfg: LlamaConfig, n_steps: int, filtered: bool, w: dict,
     discards their overshoot -- rows past a slot's accepted length are
     never attended (the decode mask is position-bounded) and prefill
     overwrites them on slot reuse.
+
+    ``want_lp`` (STATIC) additionally emits per-step logprob outputs --
+    gated because the extra [B, V] log-softmax + top-k passes are pure
+    waste for the no-logprobs common case.
     """
 
     def body(carry, step_rng):
@@ -325,13 +345,30 @@ def _decode_block(cfg: LlamaConfig, n_steps: int, filtered: bool, w: dict,
         nxt = _sample(logits, step_rng, temps,
                       top_ks if filtered else None,
                       top_ps if filtered else None)
-        return (ck, cv, nxt, lens + 1), nxt
+        out = (nxt, *_logprob_outputs(logits, nxt)) if want_lp else nxt
+        return (ck, cv, nxt, lens + 1), out
 
     rngs = jax.random.split(rng, n_steps)
     (ck, cv, _, _), outs = jax.lax.scan(
         body, (cache_k, cache_v, tokens, lengths), rngs
     )
-    return outs, ck, cv  # outs [n_steps, B]
+    return outs, ck, cv  # outs [n_steps, B] (or the logprob tuple)
+
+
+def _host_logprobs(row: np.ndarray, token: int, n: int) -> dict:
+    """Logprob record from one host-side f32 logits row (first tokens,
+    whose prompt-end logits come back from prefill anyway; decode steps
+    get theirs from the device program's gated outputs)."""
+    m = float(row.max())
+    lse = m + float(np.log(np.exp(row - m).sum()))
+    k = min(max(n, 1), LOGPROBS_K)
+    top = np.argpartition(-row, k - 1)[:k]
+    top = top[np.argsort(-row[top])]
+    return {
+        "logprob": float(row[token]) - lse,
+        "top_ids": top.tolist(),
+        "top_logprobs": (row[top] - lse).tolist(),
+    }
 
 
 def _sample(logits, rng, temps, top_ks=None, top_ps=None):
@@ -366,69 +403,174 @@ def _sample(logits, rng, temps, top_ks=None, top_ps=None):
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
-def _prefill_chunk(cfg: LlamaConfig, klen: int, w: dict, cache_k, cache_v,
-                   tokens, offsets, chunk_lens, slots):
-    """One CHUNK of prefill for K mid-prefill rows, written straight into
-    the cache (chunked prefill: admission must never stall decoding slots
-    for a whole long-prompt prefill).
+def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
+                 klen: int, filtered: bool, want_lp: bool, w: dict,
+                 cache_k, cache_v, tokens, lengths, chunk_toks,
+                 chunk_offs, chunk_clens, chunk_slots, rng, temps,
+                 top_ks, top_ps):
+    """Mixed batch in ONE device program (vLLM's chunked prefill, shaped
+    for XLA): n_steps decode steps each fused with one prefill chunk,
+    then m_tail chunk-only steps that finish the prompts without
+    dragging more decode work into the dispatch.
 
-    tokens [K, C]: the next C prompt tokens per row, zero-padded past
-    chunk_lens. offsets [K]: tokens already in the cache per row.
-    chunk_lens [K]: real tokens this chunk. slots [K]: cache slot per row
-    (out-of-range = dummy row; its scatter drops). klen: STATIC key bound
-    covering max(offsets)+C, bucketed by the caller so the compile count
-    stays O(K-buckets x klen-buckets).
+    The round-3 engine alternated a standalone chunk program with a full
+    decode block, so a long prompt's first token waited
+    ceil(prompt/c) x (chunk + decode-block) dispatches -- a measured 4x
+    TTFT regression for the -26% ITL win. The first fused cut (chunks
+    riding a full n=8 block) measured TTFT p50 711ms vs 248ms
+    whole-prompt: the finishing dispatch still carried 8 decode steps,
+    and scaled with prompt length. This shape fixes both ends:
+    - the mixed scan keeps decoders advancing during every prefill
+      dispatch (never a whole-prompt stall), with layer weights
+      streamed from HBM once per layer per step for both lanes;
+    - the tail scan runs the REST of the prompt's chunks chunk-only, so
+      TTFT ~= wait + n_steps decode steps + the prefill itself, with
+      n_steps capped small (engine default 2) instead of growing with
+      the prompt;
+    - the whole prompt still finishes inside ONE dispatch.
 
-    Unlike _prefill (fresh [K,S] self-attention), each chunk attends over
-    the cache prefix it and earlier chunks wrote, so cost is C x klen per
-    chunk -- the price of interleaving. Padding garbage written past a
-    row's real length is safe by the same invariant as _insert padding:
-    a position >= the row's length is masked until the decode step that
-    overwrites it.
+    tokens/lengths/temps/top_ks/top_ps are the [B] decode lanes (same
+    contract as _decode_block). chunk_toks [n_steps + m_tail, K, C]
+    holds the chunk scheduled for each step (zero rows once a prompt is
+    finished); chunk_offs [K] the starting cache offsets; chunk_clens
+    [n_steps + m_tail, K] real tokens per row per step; chunk_slots [K]
+    the cache slot per row (out-of-range = dummy lane; its scatter
+    drops). klen: STATIC key bound covering max(chunk_offs + scheduled
+    tokens), bucketed by the caller.
 
-    NOTE: the scan body below is the layer forward a third time
-    (_layer_forward is the fresh-sequence case, _decode's body the C=1
-    cached case) -- kept separate because _decode is THE hot loop and
-    must index the cache by batch row, not gather by slot. Any change to
-    the shared math (RoPE, GQA reshape, write-then-attend order, norm
-    placement) must land in all three.
+    Chunk lanes attend over the cache prefix they and earlier chunks
+    wrote (cost C x klen per step -- the price of interleaving); decode
+    lanes attend full-span as in _decode. The two write disjoint cache
+    regions: a slot is either prefilling (chunk rows, positions <
+    prompt_len <= Smax-1 real, garbage past its prompt overwritten-
+    before-visible by later decode steps) or decoding (its own positions;
+    parked dummies at Smax-1) -- never both.
 
-    Returns (logits [K, V] at each row's last real chunk token, caches).
+    Per-row first-token logits are latched into a carried [K, V] buffer
+    on the last step where the row has real tokens (clens > 0), so the
+    host samples first tokens once per dispatch and gets prompt-end
+    logits for free (logprobs).
+
+    NOTE: the layer bodies below are the layer forward a third time
+    (_layer_forward is the fresh-sequence case, _decode's body the
+    decode-only case) -- kept separate because each is a differently-
+    shaped hot loop. Any change to the shared math (RoPE, GQA reshape,
+    write-then-attend order, norm placement) must land in all three.
+
+    Returns (dec_outs [n_steps, B] or logprob tuple, chunk_logits
+    [K, V] f32, caches).
     """
 
-    k_rows, c = tokens.shape
-    positions = offsets[:, None] + jnp.arange(c)[None, :]          # [K,C]
+    b = tokens.shape[0]
+    k_rows = chunk_toks.shape[1]
+    smax = cache_k.shape[2]
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
-    x = w["embed"][tokens]
-    mask = jnp.arange(klen)[None, None, :] <= positions[:, :, None]  # [K,C,klen]
-    row = slots[:, None]
+    batch_idx = jnp.arange(b)[:, None]
+    row = chunk_slots[:, None]
+    lm_head = w["lm_head"].astype(jnp.float32)
 
-    def body(x, layer):
-        lp, ck, cv = layer
-        h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps)
-        q = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["q_proj"]["kernel"])
-        k = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["k_proj"]["kernel"])
-        v = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["v_proj"]["kernel"])
-        q = _rope(q, freqs, positions)
-        k = _rope(k, freqs, positions)
-        # Write the chunk's K/V, then attend over the cache prefix --
-        # within-chunk causality rides the position mask.
-        ck = ck.at[row, positions].set(k, mode="drop")
-        cv = cv.at[row, positions].set(v, mode="drop")
-        keys = ck[slots, :klen]                                    # [K,klen,KV,D]
-        vals = cv[slots, :klen]
-        out = _gqa_attend(q, keys, vals, mask)
-        out = jnp.einsum("bsnd,ndh->bsh", out, lp["attn"]["o_proj"]["kernel"])
-        x = x + out
-        h = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
-        x = x + _ffn(cfg, lp, h)
-        return x, (ck, cv)
+    def chunk_layer(x_c, lp, ck, cv, c_pos, c_mask):
+        """Chunk lanes through one layer: write this chunk's K/V into
+        the row's slot, attend over the cache prefix (within-chunk
+        causality rides the position mask)."""
+        attn = lp["attn"]
+        h = _rms(x_c, lp["attn_norm"]["scale"], cfg.norm_eps)
+        q = jnp.einsum("bsh,hnd->bsnd", h, attn["q_proj"]["kernel"])
+        k = jnp.einsum("bsh,hnd->bsnd", h, attn["k_proj"]["kernel"])
+        v = jnp.einsum("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
+        q = _rope(q, freqs, c_pos)
+        k = _rope(k, freqs, c_pos)
+        ck = ck.at[row, c_pos].set(k, mode="drop")
+        cv = cv.at[row, c_pos].set(v, mode="drop")
+        keys = ck[chunk_slots, :klen]                     # [K,klen,KV,D]
+        vals = cv[chunk_slots, :klen]
+        out = _gqa_attend(q, keys, vals, c_mask)
+        out = jnp.einsum("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
+        x_c = x_c + out
+        h = _rms(x_c, lp["mlp_norm"]["scale"], cfg.norm_eps)
+        return x_c + _ffn(cfg, lp, h), ck, cv
 
-    x, (ck, cv) = jax.lax.scan(body, x, (w["layers"], cache_k, cache_v))
-    x = _rms(x, w["final_scale"], cfg.norm_eps)
-    last = x[jnp.arange(k_rows), jnp.maximum(chunk_lens - 1, 0)]
-    logits = last.astype(jnp.float32) @ w["lm_head"].astype(jnp.float32)
-    return logits, ck, cv
+    def chunk_logits_latch(x_c, cclens, fin_logits):
+        x_c = _rms(x_c, w["final_scale"], cfg.norm_eps)
+        last = x_c[jnp.arange(k_rows), jnp.maximum(cclens - 1, 0)]
+        c_logits = last.astype(jnp.float32) @ lm_head
+        return jnp.where((cclens > 0)[:, None], c_logits, fin_logits)
+
+    def mixed_step(carry, xs):
+        ck0, cv0, toks, lens, offs, fin_logits = carry
+        step_rng, ctoks, cclens = xs
+        dec_pos = lens[:, None]                                  # [B,1]
+        dec_mask = jnp.arange(smax)[None, None, :] <= dec_pos[:, :, None]
+        c_pos = offs[:, None] + jnp.arange(c)[None, :]           # [K,C]
+        c_mask = jnp.arange(klen)[None, None, :] <= c_pos[:, :, None]
+        x_d = w["embed"][toks][:, None, :]                       # [B,1,H]
+        x_c = w["embed"][ctoks]                                  # [K,C,H]
+
+        def layer_body(carry2, layer):
+            x_d, x_c = carry2
+            lp, ck, cv = layer
+            x_c, ck, cv = chunk_layer(x_c, lp, ck, cv, c_pos, c_mask)
+            # Decode lanes (same math as _decode's body).
+            attn = lp["attn"]
+            h = _rms(x_d, lp["attn_norm"]["scale"], cfg.norm_eps)
+            q = jnp.einsum("bsh,hnd->bsnd", h, attn["q_proj"]["kernel"])
+            k = jnp.einsum("bsh,hnd->bsnd", h, attn["k_proj"]["kernel"])
+            v = jnp.einsum("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
+            q = _rope(q, freqs, dec_pos)
+            k = _rope(k, freqs, dec_pos)
+            ck = ck.at[batch_idx, dec_pos].set(k)
+            cv = cv.at[batch_idx, dec_pos].set(v)
+            out = _gqa_attend(q, ck, cv, dec_mask)
+            out = jnp.einsum("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
+            x_d = x_d + out
+            h = _rms(x_d, lp["mlp_norm"]["scale"], cfg.norm_eps)
+            x_d = x_d + _ffn(cfg, lp, h)
+            return (x_d, x_c), (ck, cv)
+
+        (x_d, x_c), (ck1, cv1) = jax.lax.scan(
+            layer_body, (x_d, x_c), (w["layers"], ck0, cv0)
+        )
+        x_d = _rms(x_d, w["final_scale"], cfg.norm_eps)
+        d_logits = x_d[:, 0].astype(jnp.float32) @ lm_head
+        nxt = _sample(d_logits, step_rng, temps,
+                      top_ks if filtered else None,
+                      top_ps if filtered else None)
+        fin_logits = chunk_logits_latch(x_c, cclens, fin_logits)
+        out = (nxt, *_logprob_outputs(d_logits, nxt)) if want_lp else nxt
+        return (ck1, cv1, nxt, lens + 1, offs + cclens, fin_logits), out
+
+    def tail_step(carry, xs):
+        ck0, cv0, offs, fin_logits = carry
+        ctoks, cclens = xs
+        c_pos = offs[:, None] + jnp.arange(c)[None, :]
+        c_mask = jnp.arange(klen)[None, None, :] <= c_pos[:, :, None]
+        x_c = w["embed"][ctoks]
+
+        def layer_body(x_c, layer):
+            lp, ck, cv = layer
+            x_c, ck, cv = chunk_layer(x_c, lp, ck, cv, c_pos, c_mask)
+            return x_c, (ck, cv)
+
+        x_c, (ck1, cv1) = jax.lax.scan(
+            layer_body, x_c, (w["layers"], ck0, cv0)
+        )
+        fin_logits = chunk_logits_latch(x_c, cclens, fin_logits)
+        return (ck1, cv1, offs + cclens, fin_logits), None
+
+    rngs = jax.random.split(rng, n_steps)
+    fin0 = jnp.zeros((k_rows, lm_head.shape[-1]), jnp.float32)
+    (ck, cv, _, _, offs, fin_logits), outs = jax.lax.scan(
+        mixed_step,
+        (cache_k, cache_v, tokens, lengths, chunk_offs, fin0),
+        (rngs, chunk_toks[:n_steps], chunk_clens[:n_steps]),
+    )
+    if m_tail:
+        (ck, cv, _, fin_logits), _ = jax.lax.scan(
+            tail_step,
+            (ck, cv, offs, fin_logits),
+            (chunk_toks[n_steps:], chunk_clens[n_steps:]),
+        )
+    return outs, fin_logits, ck, cv
 
 
 # ---------------------------------------------------------------------------
@@ -546,6 +688,20 @@ class Request:
     top_k: int = 0        # 0 = no top-k truncation
     top_p: float = 1.0    # >= 1.0 = no nucleus truncation
     eos_id: Optional[int] = None
+    # Stop-sequence hook: called FROM THE ENGINE THREAD with the
+    # generated ids after every token; returning True finishes the
+    # request immediately (the slot frees mid-block, overshoot
+    # discarded). The engine is tokenizer-blind, so text-level stop
+    # strings live in the serving layer, which scans the decoded tail
+    # here and trims the stop text from its response. The matched tokens
+    # stay in the result (ids and text must agree).
+    stop_fn: Optional[Any] = None
+    # Top-N logprob capture: 0 = off; else each emitted token appends
+    # {"logprob", "top_ids", "top_logprobs"} (f32 log-softmax of the RAW
+    # logits -- pre-temperature, the OpenAI contract) to
+    # ``logprob_data``. N is capped at LOGPROBS_K (the device program
+    # returns a fixed-K top-k; one static shape, one extra compile).
+    logprobs: int = 0
     future: Optional[Future] = None
     # Streaming: called with each generated token id, FROM THE ENGINE
     # THREAD, in emission order (the final token included -- the future
@@ -557,6 +713,9 @@ class Request:
     slot: int = -1
     prefilled: int = 0  # prompt tokens already in the cache (chunked path)
     generated: List[int] = dataclasses.field(default_factory=list)
+    # Per-token logprob records, parallel to ``generated`` (only when
+    # ``logprobs`` > 0).
+    logprob_data: List[dict] = dataclasses.field(default_factory=list)
 
 
 class GenerationEngine:
@@ -580,11 +739,19 @@ class GenerationEngine:
         tensor_parallel: int = 1,
         prefill_chunk: int = 0,
         max_prefill_tokens: int = 8192,
+        prefill_decode_steps: int = 2,
     ) -> None:
         # Max decode steps fused into one device program (power-of-2
         # sub-blocks keep the compile count bounded); 1 = per-token
         # dispatch.
         self.decode_block = max(1, decode_block)
+        # Decode steps riding a PREFILL-carrying dispatch (the mixed scan
+        # of _fused_block). Small on purpose: every decode step in that
+        # dispatch sits on the new prompt's TTFT critical path, while the
+        # decoders only need "not stalled to zero" -- 2 keeps them moving
+        # at a bounded TTFT cost; the rest of the prompt rides the
+        # chunk-only tail scan.
+        self.prefill_decode_steps = max(1, int(prefill_decode_steps))
         # Chunked prefill: prompts longer than this are admitted into a
         # slot immediately and prefilled prefill_chunk tokens per step,
         # interleaved with decode blocks -- one long admission can then
@@ -687,42 +854,48 @@ class GenerationEngine:
         prefill_jit = jax.jit(partial(_prefill, cfg))
         block_jits = {}
 
-        def _block_fn(n, filtered):
+        def _block_fn(n, filtered, want_lp):
             def fn(w, ck, cv, toks, lens, rng, temps, top_ks, top_ps):
                 outs, ck, cv = _decode_block(
-                    cfg, n, filtered, w, ck, cv, toks, lens, rng, temps,
-                    top_ks, top_ps,
+                    cfg, n, filtered, want_lp, w, ck, cv, toks, lens,
+                    rng, temps, top_ks, top_ps,
                 )
                 return outs, _pin(ck), _pin(cv)
             return fn
 
-        def decode_block_call(n, filtered, ck, cv, toks, lens, rng,
-                              temps, top_ks, top_ps):
-            key = (n, filtered)
+        def decode_block_call(n, filtered, want_lp, ck, cv, toks, lens,
+                              rng, temps, top_ks, top_ps):
+            key = (n, filtered, want_lp)
             if key not in block_jits:
                 block_jits[key] = jax.jit(
-                    _block_fn(n, filtered), donate_argnums=(1, 2)
+                    _block_fn(n, filtered, want_lp), donate_argnums=(1, 2)
                 )
             return block_jits[key](self.weights, ck, cv, toks, lens, rng,
                                    temps, top_ks, top_ps)
 
         self._decode_block_call = decode_block_call
 
-        chunk_jits = {}
+        fused_jits = {}
 
-        def chunk_call(klen, ck, cv, toks, offs, clens, slots):
-            key = (klen, toks.shape[0])
-            if key not in chunk_jits:
-                def fn(w, ck, cv, toks, offs, clens, slots):
-                    logits, ck, cv = _prefill_chunk(
-                        cfg, klen, w, ck, cv, toks, offs, clens, slots
+        def fused_call(n, m, klen, filtered, want_lp, ck, cv, toks,
+                       lens, ctoks, coffs, cclens, cslots, rng, temps,
+                       top_ks, top_ps):
+            key = (n, m, klen, ctoks.shape[1], filtered, want_lp)
+            if key not in fused_jits:
+                def fn(w, ck, cv, toks, lens, ctoks, coffs, cclens,
+                       cslots, rng, temps, top_ks, top_ps):
+                    outs, fin, ck, cv = _fused_block(
+                        cfg, n, m, self.prefill_chunk, klen, filtered,
+                        want_lp, w, ck, cv, toks, lens, ctoks, coffs,
+                        cclens, cslots, rng, temps, top_ks, top_ps,
                     )
-                    return logits, _pin(ck), _pin(cv)
-                chunk_jits[key] = jax.jit(fn, donate_argnums=(1, 2))
-            return chunk_jits[key](self.weights, ck, cv, toks, offs,
-                                   clens, slots)
+                    return outs, fin, _pin(ck), _pin(cv)
+                fused_jits[key] = jax.jit(fn, donate_argnums=(1, 2))
+            return fused_jits[key](self.weights, ck, cv, toks, lens,
+                                   ctoks, coffs, cclens, cslots, rng,
+                                   temps, top_ks, top_ps)
 
-        self._chunk_call = chunk_call
+        self._fused_call = fused_call
 
         def _insert_pinned(cache_k, cache_v, k_seq, v_seq, slots):
             ck, cv = _insert(cache_k, cache_v, k_seq, v_seq, slots)
@@ -808,7 +981,7 @@ class GenerationEngine:
                 if (self.prefill_chunk
                         and len(req.prompt) > self.prefill_chunk):
                     # Long prompt: claim a slot now, prefill chunk-by-
-                    # chunk across steps (_prefill_step) so admission
+                    # chunk across steps (_fused_step) so admission
                     # never stalls decoding slots for the whole prompt.
                     req.slot = self.free_slots.pop()
                     req.prefilled = 0
@@ -862,60 +1035,164 @@ class GenerationEngine:
                 logits, self._next_rng(), jnp.asarray(temps),
                 top_ks, top_ps,
             ))
+            logits_np = None
             for j, (req, slot) in enumerate(zip(reqs, slots)):
                 req.slot = slot
                 self.lengths[slot] = len(req.prompt)
                 self.active[slot] = req
+                if req.logprobs:
+                    if logits_np is None:
+                        logits_np = np.asarray(logits, np.float32)
+                    req.logprob_data.append(_host_logprobs(
+                        logits_np[j], int(first[j]), req.logprobs
+                    ))
                 self._emit(req, int(first[j]))
 
-    def _prefill_step(self) -> None:
-        """Advance every mid-prefill slot by one chunk, in ONE device
-        program. Rows finishing their prompt this chunk sample their
-        first token and join the decode batch the same step."""
+    def _pack_decode_lanes(self):
+        """[max_slots] decode-lane arrays for the active slots; parked
+        rows carry safe dummies (Smax-1 invariant documented below)."""
+        tokens = np.zeros(self.max_slots, np.int32)
+        temps = np.zeros(self.max_slots, np.float32)
+        top_ks = np.zeros(self.max_slots, np.int32)
+        top_ps = np.ones(self.max_slots, np.float32)
+        # Non-active slots park at Smax-1: decode writes dummy K/V for
+        # EVERY row, and position 0 of a mid-prefill slot already holds
+        # real chunked-prefill state. Smax-1 garbage is safe for any
+        # future occupant -- a row first becomes visible (mask: key <=
+        # query position) in the very decode step that overwrites it.
+        positions = np.full(self.max_slots, self.cfg.max_seq - 1, np.int32)
+        for slot, req in self.active.items():
+            tokens[slot] = req.generated[-1]
+            temps[slot] = req.temperature
+            top_ks[slot] = req.top_k
+            top_ps[slot] = req.top_p
+            # lengths[slot] already counts the last generated token, whose
+            # K/V is not in the cache yet: its position is lengths-1.
+            positions[slot] = max(int(self.lengths[slot]) - 1, 0)
+        filtered = any(
+            req.top_k > 0 or req.top_p < 1.0
+            for req in self.active.values()
+        )
+        return tokens, temps, top_ks, top_ps, positions, filtered
 
-        if not self.prefilling:
-            return
+    def _emit_decode_outs(self, outs, want_lp: bool) -> None:
+        """Emit a dispatch's [n, B] decode tokens in step order; slots
+        finishing mid-block drop their overshoot. With ``want_lp`` the
+        dispatch also returned per-step logprob arrays, recorded
+        parallel to each request's generated ids."""
+        if want_lp:
+            toks, lps, tids, tlps = (np.asarray(o) for o in outs)
+        else:
+            toks = np.asarray(outs)
+        n = toks.shape[0]
+        for slot in list(self.active):
+            req = self.active[slot]
+            for j in range(n):
+                if want_lp and req.logprobs:
+                    k = min(req.logprobs, LOGPROBS_K)
+                    req.logprob_data.append({
+                        "logprob": float(lps[j, slot]),
+                        "top_ids": tids[j, slot, :k].tolist(),
+                        "top_logprobs": tlps[j, slot, :k].tolist(),
+                    })
+                self._emit(req, int(toks[j, slot]))
+                if slot not in self.active:  # finished: drop overshoot
+                    break
+
+    def _fused_step(self) -> None:
+        """One mixed dispatch: n decode steps fused with prefill chunks,
+        plus a chunk-only tail that finishes every mid-prefill prompt
+        (_fused_block). Rows finishing their prompt sample their first
+        token when the dispatch returns and join the decode lanes next
+        dispatch, so TTFT ~= one mixed dispatch that carries at most
+        prefill_decode_steps of decode work."""
+
         items = list(self.prefilling.items())
         c = self.prefill_chunk
+        need = max(
+            -(-(len(req.prompt) - req.prefilled) // c) for _, req in items
+        )
+        # Mixed-scan step count: a power of 2 bounded by
+        # prefill_decode_steps (every step here is on the new prompt's
+        # TTFT critical path), the active slots' cache headroom (decode
+        # lanes must not write past Smax-1... the scatter would drop,
+        # but the step would be waste), and the chunk work (steps past
+        # the last scheduled chunk run a garbage c-token chunk each).
+        # The decode-budget bound is deliberately absent: chunk rows
+        # need the steps regardless, and decode overshoot is discarded
+        # host-side.
+        cap = min(self.decode_block, self.prefill_decode_steps)
+        if self.active:
+            cap = min(cap, max(1, min(
+                self.cfg.max_seq - int(self.lengths[slot])
+                for slot in self.active
+            )))
+        n = 1
+        while n * 2 <= cap and n < need:
+            n *= 2
+        # Chunks beyond the mixed scan ride the chunk-only tail
+        # (pow2-bucketed step count; trailing steps are garbage lanes).
+        m = _pow2_bucket(need - n) if need > n else 0
+        total = n + m
         kbucket = _pow2_bucket(len(items))
-        toks = np.zeros((kbucket, c), np.int32)
-        offs = np.zeros(kbucket, np.int32)
-        clens = np.ones(kbucket, np.int32)
-        slots = np.full(kbucket, self.max_slots, np.int32)  # dummies drop
-        temps = np.zeros(kbucket, np.float32)
-        top_ks = np.zeros(kbucket, np.int32)
-        top_ps = np.ones(kbucket, np.float32)
+        ctoks = np.zeros((total, kbucket, c), np.int32)
+        cclens = np.zeros((total, kbucket), np.int32)
+        coffs = np.zeros(kbucket, np.int32)
+        cslots = np.full(kbucket, self.max_slots, np.int32)  # dummies drop
+        ctemps = np.zeros(kbucket, np.float32)
+        ctop_ks = np.zeros(kbucket, np.int32)
+        ctop_ps = np.ones(kbucket, np.float32)
         max_end = 1
         for j, (slot, req) in enumerate(items):
-            n = min(c, len(req.prompt) - req.prefilled)
-            toks[j, :n] = req.prompt[req.prefilled:req.prefilled + n]
-            offs[j] = req.prefilled
-            clens[j] = n
-            slots[j] = slot
-            temps[j] = req.temperature
-            top_ks[j] = req.top_k
-            top_ps[j] = req.top_p
-            # Real tokens bound klen; padding lanes past n attend garbage
-            # that's discarded, so they don't need covering.
-            max_end = max(max_end, req.prefilled + n)
+            pos = req.prefilled
+            coffs[j] = pos
+            cslots[j] = slot
+            ctemps[j] = req.temperature
+            ctop_ks[j] = req.top_k
+            ctop_ps[j] = req.top_p
+            for s in range(total):
+                take = min(c, len(req.prompt) - pos)
+                if take <= 0:
+                    break
+                ctoks[s, j, :take] = req.prompt[pos:pos + take]
+                cclens[s, j] = take
+                pos += take
+            # Real tokens bound klen; padding lanes attend garbage that's
+            # discarded, so they don't need covering.
+            max_end = max(max_end, pos)
         klen = self._bucket(max_end)
-        logits, self.cache_k, self.cache_v = self._chunk_call(
-            klen, self.cache_k, self.cache_v, jnp.asarray(toks),
-            jnp.asarray(offs), jnp.asarray(clens), jnp.asarray(slots),
+        tokens, temps, top_ks, top_ps, positions, filtered = (
+            self._pack_decode_lanes()
         )
-        first = None  # sampled lazily: most chunks finish no row
+        want_lp = any(req.logprobs for req in self.active.values())
+        outs, fin_logits, self.cache_k, self.cache_v = self._fused_call(
+            n, m, klen, filtered, want_lp, self.cache_k, self.cache_v,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(ctoks), jnp.asarray(coffs), jnp.asarray(cclens),
+            jnp.asarray(cslots), self._next_rng(), jnp.asarray(temps),
+            jnp.asarray(top_ks), jnp.asarray(top_ps),
+        )
+        self._emit_decode_outs(outs, want_lp)
+        first = None  # sampled lazily: not every dispatch finishes a row
+        fin_np = None
         for j, (slot, req) in enumerate(items):
-            req.prefilled += int(clens[j])
+            req.prefilled += int(cclens[:, j].sum())
             if req.prefilled < len(req.prompt):
                 continue
             if first is None:
                 first = np.asarray(self._sample(
-                    logits, self._next_rng(), jnp.asarray(temps),
-                    top_ks, top_ps,
+                    fin_logits, self._next_rng(), jnp.asarray(ctemps),
+                    ctop_ks, ctop_ps,
                 ))
             del self.prefilling[slot]
             self.lengths[slot] = len(req.prompt)
             self.active[slot] = req
+            if req.logprobs:
+                if fin_np is None:
+                    fin_np = np.asarray(fin_logits, np.float32)
+                req.logprob_data.append(
+                    _host_logprobs(fin_np[j], int(first[j]), req.logprobs)
+                )
             self._emit(req, int(first[j]))
 
     def _emit(self, req: Request, token: int) -> None:
@@ -927,8 +1204,15 @@ class GenerationEngine:
             except Exception:  # noqa: BLE001 - a bad stream sink must not
                 logger.exception("on_token callback failed")  # kill the slot
         self.lengths[req.slot] += 1
+        stopped = False
+        if req.stop_fn is not None:
+            try:
+                stopped = bool(req.stop_fn(req.generated))
+            except Exception:  # noqa: BLE001 - a bad predicate must not
+                logger.exception("stop_fn failed")  # kill the slot
         done = (
-            (req.eos_id is not None and token == req.eos_id)
+            stopped
+            or (req.eos_id is not None and token == req.eos_id)
             or len(req.generated) >= req.max_new_tokens
             or self.lengths[req.slot] >= self.cfg.max_seq
         )
@@ -944,15 +1228,16 @@ class GenerationEngine:
             req.future.set_result(req.generated)
 
     def step(self) -> bool:
-        """Admit pending, advance prefill chunks, run one decode block.
-        Returns True if work ran. The chunk-then-block interleave is the
-        point: an active decoder waits at most one chunk per step."""
+        """Admit pending, then run one mixed dispatch: a fused
+        chunk+decode program when any slot is mid-prefill, else a pure
+        decode block. Returns True if work ran."""
 
         self._admit()
-        ran = bool(self.prefilling)
-        self._prefill_step()
+        if self.prefilling:
+            self._fused_step()
+            return True
         if not self.active:
-            return ran
+            return False
         # Block size: largest power-of-2 <= decode_block within every
         # slot's CACHE headroom (an out-of-range write must not happen).
         # The MIN token budget is deliberately NOT a bound: a single
@@ -971,42 +1256,17 @@ class GenerationEngine:
         n = 1
         while n * 2 <= min(self.decode_block, max(remaining, 1), max(budget, 1)):
             n *= 2
-        tokens = np.zeros(self.max_slots, np.int32)
-        temps = np.zeros(self.max_slots, np.float32)
-        top_ks = np.zeros(self.max_slots, np.int32)
-        top_ps = np.ones(self.max_slots, np.float32)
-        # Non-active slots park at Smax-1: decode writes dummy K/V for
-        # EVERY row, and position 0 of a mid-prefill slot already holds
-        # real chunked-prefill state. Smax-1 garbage is safe for any
-        # future occupant -- a row first becomes visible (mask: key <=
-        # query position) in the very decode step that overwrites it.
-        positions_np = np.full(self.max_slots, self.cfg.max_seq - 1,
-                               np.int32)
-        for slot, req in self.active.items():
-            tokens[slot] = req.generated[-1]
-            temps[slot] = req.temperature
-            top_ks[slot] = req.top_k
-            top_ps[slot] = req.top_p
-            # lengths[slot] already counts the last generated token, whose
-            # K/V is not in the cache yet: its position is lengths-1.
-            positions_np[slot] = max(int(self.lengths[slot]) - 1, 0)
-        positions = jnp.asarray(positions_np)
-        filtered = any(
-            req.top_k > 0 or req.top_p < 1.0
-            for req in self.active.values()
+        tokens, temps, top_ks, top_ps, positions, filtered = (
+            self._pack_decode_lanes()
         )
+        want_lp = any(req.logprobs for req in self.active.values())
         outs, self.cache_k, self.cache_v = self._decode_block_call(
-            n, filtered, self.cache_k, self.cache_v, jnp.asarray(tokens),
-            positions, self._next_rng(), jnp.asarray(temps),
+            n, filtered, want_lp, self.cache_k, self.cache_v,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            self._next_rng(), jnp.asarray(temps),
             jnp.asarray(top_ks), jnp.asarray(top_ps),
         )
-        outs = np.asarray(outs)  # [n, B]
-        for slot in list(self.active):
-            req = self.active[slot]
-            for j in range(n):
-                self._emit(req, int(outs[j, slot]))
-                if slot not in self.active:  # finished: drop overshoot
-                    break
+        self._emit_decode_outs(outs, want_lp)
         return True
 
     # -- convenience / threaded driver ------------------------------------
@@ -1060,7 +1320,7 @@ class GenerationEngine:
         self.cache_k = None
         self.cache_v = None
         self._decode_block_call = None
-        self._chunk_call = None
+        self._fused_call = None
         self._prefill = None
         self._insert = None
         self._sample = None

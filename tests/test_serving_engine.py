@@ -382,16 +382,18 @@ class TestChunkedPrefill:
             config=cfg, params=params, max_slots=2, prefill_chunk=8
         )
         captured = []
-        orig = eng._chunk_call
-        eng._chunk_call = (
+        orig = eng._fused_call
+        eng._fused_call = (
             lambda *a: captured.append(orig(*a)) or captured[-1]
         )
         prompt = [5, 17, 100, 42, 7] * 5  # 25 tokens -> chunks 8,8,8,1
         fut = eng.submit(Request(list(prompt), max_new_tokens=1))
         while not fut.done():
             eng.step()
-        assert len(captured) == 4
-        chunk_logits = np.asarray(captured[-1][0], np.float32)[0]
+        # All 4 chunks ride ONE fused dispatch (n = 4 steps); the
+        # prompt-end logits come back latched in the fused output.
+        assert len(captured) == 1
+        chunk_logits = np.asarray(captured[-1][1], np.float32)[0]
 
         full = GenerationEngine(config=cfg, params=params, max_slots=2)
         padded = prompt + [0] * (32 - len(prompt))
@@ -455,14 +457,35 @@ class TestChunkedPrefill:
         a2 = eng.generate([50, 60, 70], max_new_tokens=5)
         assert a1 == a2
 
+    def test_fused_mixed_batch_token_exact(self, tiny):
+        """The fused chunk+decode program must not perturb either side:
+        a short request decoding WHILE a long prompt prefills (mixed
+        dispatches) yields exactly the tokens each request gets alone on
+        an unchunked engine."""
+        cfg, _, _, params = tiny
+        plain = GenerationEngine(config=cfg, params=params, max_slots=2)
+        ref_short = plain.generate([1, 2, 3], max_new_tokens=12)
+        long_prompt = list(range(1, 50))
+        ref_long = plain.generate(long_prompt, max_new_tokens=6)
+
+        eng = GenerationEngine(config=cfg, params=params, max_slots=2,
+                               prefill_chunk=8, decode_block=4)
+        f_short = eng.submit(Request([1, 2, 3], max_new_tokens=12))
+        eng.step()  # short admitted and decoding
+        f_long = eng.submit(Request(list(long_prompt), max_new_tokens=6))
+        while not (f_short.done() and f_long.done()):
+            eng.step()
+        assert f_short.result() == ref_short
+        assert f_long.result() == ref_long
+
     def test_short_prompts_skip_chunking(self, tiny):
         cfg, _, _, params = tiny
         eng = GenerationEngine(
             config=cfg, params=params, max_slots=2, prefill_chunk=8
         )
         calls = []
-        orig = eng._chunk_call
-        eng._chunk_call = lambda *a: calls.append(1) or orig(*a)
+        orig = eng._fused_call
+        eng._fused_call = lambda *a: calls.append(1) or orig(*a)
         out = eng.generate([1, 2, 3], max_new_tokens=3)
         assert len(out) == 3 and not calls
 
@@ -536,3 +559,85 @@ class TestSampling:
             eng.step()
         assert f1.result() == expected
         assert len(f2.result()) == 6
+
+
+class TestStopAndLogprobs:
+    def test_stop_fn_frees_slot_mid_block(self, tiny):
+        """A stop predicate ends the request inside a fused block: the
+        result truncates at the stop token and the slot frees without
+        running out the token budget."""
+        cfg, _, _, params = tiny
+        eng = GenerationEngine(config=cfg, params=params, max_slots=1,
+                               decode_block=8)
+        req = Request([1, 2, 3], max_new_tokens=32,
+                      stop_fn=lambda gen: len(gen) >= 3)
+        fut = eng.submit(req)
+        while not fut.done():
+            eng.step()
+        assert len(fut.result()) == 3
+        assert eng.free_slots == [0]  # slot freed despite budget left
+        # The freed slot serves the next request normally.
+        assert len(eng.generate([4, 5], max_new_tokens=2)) == 2
+
+    def test_stop_fn_exception_does_not_kill_slot(self, tiny):
+        cfg, _, _, params = tiny
+        eng = GenerationEngine(config=cfg, params=params, max_slots=1)
+
+        def bad(gen):
+            raise RuntimeError("boom")
+
+        out = eng.generate([1, 2, 3], max_new_tokens=4)
+        req = Request([1, 2, 3], max_new_tokens=4, stop_fn=bad)
+        fut = eng.submit(req)
+        while not fut.done():
+            eng.step()
+        assert fut.result() == out  # predicate failure = no stop
+
+    def test_logprobs_records_match_training_forward(self, tiny):
+        """Greedy generation with logprobs: one record per token; the
+        chosen token is the top-1 (greedy); the first-token logprob
+        matches log_softmax of the training forward at the prompt end."""
+        cfg, model, raw, params = tiny
+        eng = GenerationEngine(config=cfg, params=params, max_slots=2)
+        prompt = [5, 17, 100, 42, 7]
+        req = Request(list(prompt), max_new_tokens=5, logprobs=3)
+        fut = eng.submit(req)
+        while not fut.done():
+            eng.step()
+        out = fut.result()
+        assert len(req.logprob_data) == len(out) == 5
+        for tok, rec in zip(out, req.logprob_data):
+            assert len(rec["top_ids"]) == 3
+            assert rec["top_ids"][0] == tok  # greedy = top-1
+            assert rec["logprob"] == pytest.approx(
+                rec["top_logprobs"][0], abs=1e-5
+            )
+            assert rec["logprob"] <= 0.0
+        ref = model.apply(raw, jnp.asarray([prompt], jnp.int32))[0, -1]
+        ref_lp = jax.nn.log_softmax(ref.astype(jnp.float32))
+        assert req.logprob_data[0]["logprob"] == pytest.approx(
+            float(ref_lp[out[0]]), abs=3e-2
+        )
+
+    def test_logprobs_through_chunked_prefill(self, tiny):
+        """The fused chunked path produces the same complete records."""
+        cfg, _, _, params = tiny
+        eng = GenerationEngine(config=cfg, params=params, max_slots=2,
+                               prefill_chunk=8)
+        prompt = list(range(1, 30))  # 29 tokens -> chunked admission
+        req = Request(list(prompt), max_new_tokens=4, logprobs=2)
+        fut = eng.submit(req)
+        while not fut.done():
+            eng.step()
+        out = fut.result()
+        assert len(req.logprob_data) == len(out) == 4
+        assert req.logprob_data[0]["top_ids"][0] == out[0]
+        # Unchunked engine agrees on the first-token logprob.
+        eng2 = GenerationEngine(config=cfg, params=params, max_slots=2)
+        req2 = Request(list(prompt), max_new_tokens=1, logprobs=2)
+        fut2 = eng2.submit(req2)
+        while not fut2.done():
+            eng2.step()
+        assert req.logprob_data[0]["logprob"] == pytest.approx(
+            req2.logprob_data[0]["logprob"], abs=3e-2
+        )
