@@ -25,6 +25,10 @@ from typing import Optional
 from kubeflow_tpu.api.types import JobKind, phase_of_obj
 from kubeflow_tpu.pipelines.types import (
     Pipeline,
+    PipelineValidationError,
+    eval_when,
+    expansion_names,
+    item_mapping,
     render_step_template,
     toposort,
     validate_pipeline,
@@ -144,115 +148,265 @@ class PipelineController:
             pl.status.set_condition("Created", "PipelineCreated")
 
         by_name = {s.name: s for s in pl.spec.steps}
+
+        def owned(k: str) -> bool:
+            if k in by_name:
+                return True
+            base, sep, idx = k.rpartition("-")
+            return bool(
+                sep and idx.isdigit() and base in by_name
+                and by_name[base].with_items is not None
+            )
+
         # Drop phases for steps no longer in the spec (re-apply with
         # renamed/removed steps): stale entries must not gate the verdict.
+        # Fan-out expansions ("<step>-<i>") belong to their logical step.
         phases = {
-            k: v for k, v in pl.status.step_phases.items() if k in by_name
+            k: v for k, v in pl.status.step_phases.items() if owned(k)
         }
         for step in order:
             phases.setdefault(step, "Pending")
 
-        running = sum(1 for p in phases.values() if p == "Running")
+        skip_reasons = pl.status.step_skip_reasons
+
+        def counts_as_job(k: str) -> bool:
+            # A fan-out's LOGICAL phase aggregates its expansions; only
+            # concrete job units count against max_parallel_steps (the
+            # logical entry would double-count every running expansion).
+            return not (
+                k in by_name and by_name[k].with_items is not None
+            )
+
+        running = sum(
+            1 for k, p in phases.items()
+            if p == "Running" and counts_as_job(k)
+        )
+        limit = pl.spec.max_parallel_steps
         for step in order:
-            phase = phases[step]
-            if phase in ("Succeeded", "Failed", "Skipped"):
+            if phases[step] in ("Succeeded", "Failed", "Skipped"):
                 continue
-            deps = by_name[step].dependencies
-            if any(phases.get(d) in ("Failed", "Skipped") for d in deps):
+            cfg = by_name[step]
+            deps = cfg.dependencies
+            # A dependency that failed -- or was skipped BECAUSE something
+            # above it failed -- propagates skip. A when-skipped dependency
+            # counts as satisfied (Argo semantics: children of a skipped
+            # task run as if it succeeded).
+            def dep_failed(d: str) -> bool:
+                return phases.get(d) == "Failed" or (
+                    phases.get(d) == "Skipped"
+                    and skip_reasons.get(d) != "ConditionNotMet"
+                )
+
+            def dep_done(d: str) -> bool:
+                return phases.get(d) == "Succeeded" or (
+                    phases.get(d) == "Skipped"
+                    and skip_reasons.get(d) == "ConditionNotMet"
+                )
+
+            if any(dep_failed(d) for d in deps):
                 phases[step] = "Skipped"
+                skip_reasons[step] = "UpstreamFailed"
                 continue
-            job_name = self._job_name(name, step)
-            job = self._get_child_job(ns, job_name)
-            if job is not None and (
-                job.get("metadata", {}).get("labels", {}).get(PIPELINE_LABEL)
-                != name
-                or job["metadata"]["labels"].get(STEP_LABEL) != step
-            ):
-                # A same-named object that this pipeline did not create
-                # (user job, or another pipeline whose name+step composes
-                # to the same string): fail the step rather than adopt --
-                # or worse, overwrite -- someone else's job.
+            if not all(dep_done(d) for d in deps):
+                continue  # waiting on dependencies
+            if cfg.when is not None:
+                rendered = render_step_template(
+                    cfg.when, pl.spec.parameters, pl.status.step_outputs
+                )
+                try:
+                    met = eval_when(rendered)
+                except PipelineValidationError as e:
+                    phases[step] = "Failed"
+                    pl.status.set_condition(
+                        "Running", "WhenInvalid", f"step {step!r}: {e}"
+                    )
+                    continue
+                if not met:
+                    phases[step] = "Skipped"
+                    skip_reasons[step] = "ConditionNotMet"
+                    # Downstream ${steps.<name>.output} renders empty.
+                    pl.status.step_outputs.setdefault(step, "")
+                    continue
+            if cfg.with_items is None:
+                phases[step], running = self._advance_unit(
+                    pl, cfg, step, None, phases.get(step, "Pending"),
+                    running, limit,
+                )
+                continue
+            try:
+                items = self._resolve_items(pl, cfg)
+            except PipelineValidationError as e:
                 phases[step] = "Failed"
                 pl.status.set_condition(
-                    "Running", "JobNameConflict",
-                    f"step {step!r}: {job.get('kind')}/{job_name} already "
-                    "exists and is not owned by this pipeline",
+                    "Running", "WithItemsInvalid", f"step {step!r}: {e}"
                 )
                 continue
-            if job is None:
-                if any(phases.get(d) != "Succeeded" for d in deps):
-                    continue  # waiting on dependencies
-                limit = pl.spec.max_parallel_steps
-                if limit and running >= limit:
-                    continue
-                if by_name[step].cache:
-                    hit = self._cache_lookup(pl, step)
-                    if hit is not None:
-                        # KFP execution-cache analog: identical rendered
-                        # template (params + upstream outputs baked in)
-                        # already Succeeded -- reuse its output, run
-                        # nothing.
-                        phases[step] = "Succeeded"
-                        pl.status.step_outputs[step] = hit
-                        pl.status.set_condition(
-                            "Running", "StepCacheHit",
-                            f"step {step!r} reused a cached result",
-                        )
-                        continue
-                created = self._create_step_job(pl, step, job_name)
-                if created:
-                    phases[step] = "Running"
-                    running += 1
-                else:
-                    phases[step] = "Failed"
-                continue
-            jphase = phase_of_obj(job)
-            if jphase == "Succeeded":
-                phases[step] = "Succeeded"
-                self._capture_output(pl, step)
-                if by_name[step].cache:
-                    self._cache_store(pl, step)
-                running = max(0, running - (1 if phase == "Running" else 0))
-            elif jphase == "Failed":
-                used = pl.status.step_retries.get(step, 0)
-                if used < by_name[step].retry:
-                    # Argo retryStrategy analog: delete the failed job and
-                    # fall back to Pending; the deletion's watch event
-                    # re-reconciles and the create path re-renders a fresh
-                    # attempt.
-                    pl.status.step_retries[step] = used + 1
-                    self.store.delete(
-                        job.get("kind", "JAXJob"), job_name, ns
-                    )
-                    phases[step] = "Pending"
-                    pl.status.set_condition(
-                        "Running", "StepRetrying",
-                        f"step {step!r} attempt "
-                        f"{used + 2}/{by_name[step].retry + 1}",
-                    )
-                else:
-                    phases[step] = "Failed"
-                running = max(0, running - (1 if phase == "Running" else 0))
-            else:
+            units = expansion_names(step, len(items))
+            for unit, item in zip(units, items):
+                phases[unit], running = self._advance_unit(
+                    pl, cfg, unit, item_mapping(item),
+                    phases.get(unit, "Pending"), running, limit,
+                )
+            unit_phases = [phases[u] for u in units]
+            if any(p in ("Pending", "Running") for p in unit_phases):
                 phases[step] = "Running"
+            elif any(p == "Failed" for p in unit_phases):
+                phases[step] = "Failed"
+            else:
+                # Join: the logical step's output is the JSON list of
+                # per-item outputs, in item order.
+                phases[step] = "Succeeded"
+                import json as _json
+
+                pl.status.step_outputs[step] = _json.dumps(
+                    [pl.status.step_outputs.get(u, "") for u in units]
+                )
 
         pl.status.step_phases = phases
-        if any(p == "Failed" for p in phases.values()):
+        logical = {s: phases.get(s, "Pending") for s in order}
+        in_flight = any(
+            p in ("Running", "Pending") for p in logical.values()
+        )
+        verdict = None
+        if any(p == "Failed" for p in logical.values()):
             # Let in-flight steps finish before declaring the verdict.
-            if not any(p in ("Running", "Pending") for p in phases.values()):
-                failed = sorted(k for k, v in phases.items() if v == "Failed")
-                pl.status.set_condition(
-                    "Failed", "StepFailed", f"failed steps: {failed}"
-                )
-                pl.status.completion_time = time.time()
+            if not in_flight:
+                verdict = "Failed"
             else:
                 pl.status.set_condition("Running", "StepsRunning")
-        elif all(p == "Succeeded" for p in phases.values()):
-            pl.status.set_condition("Succeeded", "AllStepsSucceeded")
-            pl.status.completion_time = time.time()
-        elif any(p == "Running" for p in phases.values()):
+        elif not in_flight and all(
+            p in ("Succeeded", "Skipped") for p in logical.values()
+        ):
+            verdict = "Succeeded"
+        elif any(p == "Running" for p in logical.values()):
             pl.status.set_condition("Running", "StepsRunning")
+        if verdict is not None:
+            self._finish(pl, verdict, logical, running)
         self._persist(pl, status_before)
+
+    def _finish(self, pl: Pipeline, verdict: str, logical: dict,
+                running: int) -> None:
+        """Run the exit handler (if any) once the DAG has its verdict,
+        then publish the verdict. The handler sees ``${pipelineStatus}``;
+        its own result is recorded in status.exit_handler_phase and never
+        changes the DAG's verdict."""
+        eh = pl.spec.exit_handler
+        if eh is not None:
+            ehp = pl.status.exit_handler_phase
+            if ehp not in ("Succeeded", "Failed"):
+                ehp, _ = self._advance_unit(
+                    pl, eh, eh.name, {"${pipelineStatus}": verdict},
+                    ehp or "Pending", running, 0,
+                )
+                pl.status.exit_handler_phase = ehp
+                if ehp not in ("Succeeded", "Failed"):
+                    pl.status.set_condition(
+                        "Running", "ExitHandlerRunning",
+                        f"exit handler {eh.name!r} is {ehp}",
+                    )
+                    return
+        if verdict == "Failed":
+            failed = sorted(k for k, v in logical.items() if v == "Failed")
+            pl.status.set_condition(
+                "Failed", "StepFailed", f"failed steps: {failed}"
+            )
+        else:
+            pl.status.set_condition("Succeeded", "AllStepsSucceeded")
+        pl.status.completion_time = time.time()
+
+    def _advance_unit(self, pl: Pipeline, cfg, unit: str,
+                      extra: Optional[dict], phase: str, running: int,
+                      limit: int) -> tuple:
+        """State machine for ONE concrete job unit -- a plain step, one
+        fan-out expansion, or the exit handler. ``cfg`` is the owning
+        PipelineStep (template/retry/cache); ``unit`` names the job and
+        the output slot; ``extra`` adds context placeholders. Returns
+        (new_phase, running)."""
+        ns = pl.metadata.namespace
+        name = pl.metadata.name
+        job_name = self._job_name(name, unit)
+        job = self._get_child_job(ns, job_name)
+        if job is not None and (
+            job.get("metadata", {}).get("labels", {}).get(PIPELINE_LABEL)
+            != name
+            or job["metadata"]["labels"].get(STEP_LABEL) != unit
+        ):
+            # A same-named object that this pipeline did not create
+            # (user job, or another pipeline whose name+step composes
+            # to the same string): fail the step rather than adopt --
+            # or worse, overwrite -- someone else's job.
+            pl.status.set_condition(
+                "Running", "JobNameConflict",
+                f"step {unit!r}: {job.get('kind')}/{job_name} already "
+                "exists and is not owned by this pipeline",
+            )
+            return "Failed", running
+        if job is None:
+            if limit and running >= limit:
+                return "Pending", running
+            if cfg.cache:
+                hit = self._cache_lookup(pl, cfg, extra)
+                if hit is not None:
+                    # KFP execution-cache analog: identical rendered
+                    # template (params + upstream outputs baked in)
+                    # already Succeeded -- reuse its output, run nothing.
+                    pl.status.step_outputs[unit] = hit
+                    pl.status.set_condition(
+                        "Running", "StepCacheHit",
+                        f"step {unit!r} reused a cached result",
+                    )
+                    return "Succeeded", running
+            if self._create_step_job(pl, cfg, unit, job_name, extra):
+                return "Running", running + 1
+            return "Failed", running
+        jphase = phase_of_obj(job)
+        was_running = 1 if phase == "Running" else 0
+        if jphase == "Succeeded":
+            self._capture_output(pl, unit)
+            if cfg.cache:
+                self._cache_store(pl, cfg, unit, extra)
+            return "Succeeded", max(0, running - was_running)
+        if jphase == "Failed":
+            used = pl.status.step_retries.get(unit, 0)
+            if used < cfg.retry:
+                # Argo retryStrategy analog: delete the failed job and
+                # fall back to Pending; the deletion's watch event
+                # re-reconciles and the create path re-renders a fresh
+                # attempt.
+                pl.status.step_retries[unit] = used + 1
+                self.store.delete(job.get("kind", "JAXJob"), job_name, ns)
+                pl.status.set_condition(
+                    "Running", "StepRetrying",
+                    f"step {unit!r} attempt {used + 2}/{cfg.retry + 1}",
+                )
+                return "Pending", max(0, running - was_running)
+            return "Failed", max(0, running - was_running)
+        return "Running", running + (0 if phase == "Running" else 1)
+
+    def _resolve_items(self, pl: Pipeline, cfg) -> list:
+        """Concrete fan-out items: a static list passes through; a string
+        renders (parameters + upstream outputs -- the with-param dynamic
+        case) and must parse as a JSON list."""
+        import json as _json
+
+        wi = cfg.with_items
+        if not isinstance(wi, str):
+            return list(wi)
+        rendered = render_step_template(
+            wi, pl.spec.parameters, pl.status.step_outputs
+        )
+        try:
+            items = _json.loads(rendered)
+        except ValueError as e:
+            raise PipelineValidationError(
+                f"with_items rendered to {rendered!r}, not a JSON list"
+            ) from e
+        if not isinstance(items, list):
+            raise PipelineValidationError(
+                f"with_items rendered to a {type(items).__name__}, "
+                "expected a list"
+            )
+        return items
 
     def _get_child_job(self, ns: str, job_name: str):
         for kind in JOB_KINDS:
@@ -261,11 +415,12 @@ class PipelineController:
                 return obj
         return None
 
-    def _create_step_job(self, pl: Pipeline, step: str, job_name: str) -> bool:
+    def _create_step_job(self, pl: Pipeline, cfg, step: str,
+                         job_name: str, extra: Optional[dict]) -> bool:
         ns = pl.metadata.namespace
-        tmpl = next(s for s in pl.spec.steps if s.name == step)
         job = render_step_template(
-            dict(tmpl.job), pl.spec.parameters, pl.status.step_outputs
+            dict(cfg.job), pl.spec.parameters, pl.status.step_outputs,
+            extra,
         )
         kind = job.get("kind", "JAXJob")
         job["kind"] = kind
@@ -297,36 +452,40 @@ class PipelineController:
 
     # -- result caching (KFP execution caching analog) ----------------------
 
-    def _step_cache_key(self, pl: Pipeline, step: str) -> str:
-        """Cache key = hash of the RENDERED template: pipeline parameters
-        and upstream step outputs are substituted in before hashing, so
-        any change to either produces a different key."""
+    def _step_cache_key(self, pl: Pipeline, cfg,
+                        extra: Optional[dict]) -> str:
+        """Cache key = hash of the RENDERED template: pipeline parameters,
+        upstream step outputs, and context placeholders (fan-out item,
+        pipeline status) are substituted in before hashing, so any change
+        to either produces a different key."""
         import hashlib
         import json as _json
 
-        tmpl = next(s for s in pl.spec.steps if s.name == step)
         rendered = render_step_template(
-            dict(tmpl.job), pl.spec.parameters, pl.status.step_outputs
+            dict(cfg.job), pl.spec.parameters, pl.status.step_outputs,
+            extra,
         )
         blob = _json.dumps(rendered, sort_keys=True, default=str)
         return hashlib.sha256(blob.encode()).hexdigest()[:24]
 
-    def _cache_lookup(self, pl: Pipeline, step: str) -> Optional[str]:
+    def _cache_lookup(self, pl: Pipeline, cfg,
+                      extra: Optional[dict]) -> Optional[str]:
         obj = self.store.get(
-            "StepCache", f"sc-{self._step_cache_key(pl, step)}",
+            "StepCache", f"sc-{self._step_cache_key(pl, cfg, extra)}",
             pl.metadata.namespace,
         )
         return None if obj is None else str(obj.get("output", ""))
 
-    def _cache_store(self, pl: Pipeline, step: str) -> None:
+    def _cache_store(self, pl: Pipeline, cfg, unit: str,
+                     extra: Optional[dict]) -> None:
         self.store.put("StepCache", {
             "metadata": {
-                "name": f"sc-{self._step_cache_key(pl, step)}",
+                "name": f"sc-{self._step_cache_key(pl, cfg, extra)}",
                 "namespace": pl.metadata.namespace,
             },
-            "output": pl.status.step_outputs.get(step, ""),
+            "output": pl.status.step_outputs.get(unit, ""),
             "pipeline": pl.metadata.name,
-            "step": step,
+            "step": unit,
             "time": time.time(),
         })
 

@@ -25,6 +25,7 @@ python components.
 
 from __future__ import annotations
 
+import contextlib
 import contextvars
 import inspect
 import json
@@ -41,6 +42,9 @@ class _PipelineContext:
     def __init__(self) -> None:
         self.steps: list[dict] = []
         self._names: set[str] = set()
+        self.when_stack: list[str] = []   # active condition() blocks
+        self.items: Any = None            # active for_each() items
+        self.exit_handler: Optional[dict] = None
 
     def unique(self, base: str) -> str:
         name = base
@@ -50,6 +54,15 @@ class _PipelineContext:
             i += 1
         self._names.add(name)
         return name
+
+    def decorate(self, spec: dict) -> None:
+        """Attach the active condition()/for_each() context to a step."""
+        if self.when_stack:
+            spec["when"] = " and ".join(
+                f"({w})" for w in self.when_stack
+            )
+        if self.items is not None:
+            spec["with_items"] = self.items
 
 
 class Step:
@@ -155,12 +168,85 @@ class Component:
                 },
             },
         }
+        ctx.decorate(step)
         ctx.steps.append(step)
         return Step(name, step)
 
 
 def component(fn: Callable) -> Component:
     return Component(fn)
+
+
+@contextlib.contextmanager
+def condition(expr: str):
+    """kfp ``dsl.Condition`` analog: steps created inside the block get
+    ``when=expr`` and are Skipped (not Failed) when it evaluates false
+    at run time -- downstream steps still run (Argo semantics). Nesting
+    AND-combines the expressions. Quote string operands, the controller
+    substitutes textually::
+
+        with dsl.condition("'${steps.check.output}' == 'deploy'"):
+            deploy(target=...)
+    """
+    ctx = _CTX.get()
+    if ctx is None:
+        raise RuntimeError("condition() must be used inside a @pipeline fn")
+    ctx.when_stack.append(expr)
+    try:
+        yield
+    finally:
+        ctx.when_stack.pop()
+
+
+@contextlib.contextmanager
+def for_each(items: Any):
+    """kfp ``dsl.ParallelFor`` analog: each step created inside the block
+    fans out into one job per item; the yielded placeholder (``${item}``,
+    or ``${item.<key>}`` for dict items) substitutes into arguments.
+    ``items`` may be a list, or a string placeholder rendering to a JSON
+    list at run time (fan-out over an upstream step's output). Downstream
+    steps join on ALL expansions; the fan-out step's ``.output`` is the
+    JSON list of per-item outputs. Each step inside the block fans out
+    independently (chain per-item work inside one component). Nesting is
+    not supported. ::
+
+        with dsl.for_each(["a", "b", "c"]) as item:
+            shard = process(name=item)
+        merge(parts=shard.output)
+    """
+    ctx = _CTX.get()
+    if ctx is None:
+        raise RuntimeError("for_each() must be used inside a @pipeline fn")
+    if ctx.items is not None:
+        raise RuntimeError("nested for_each() is not supported")
+    ctx.items = items
+    try:
+        yield "${item}"
+    finally:
+        ctx.items = None
+
+
+def on_exit(step: Step) -> None:
+    """kfp ``dsl.ExitHandler`` analog: mark an already-declared step as
+    the pipeline's exit handler. It leaves the DAG, runs once after the
+    verdict (success OR failure) with ``${pipelineStatus}`` available in
+    its template, and its result never changes the verdict. ::
+
+        dsl.on_exit(notify(status="${pipelineStatus}"))
+    """
+    ctx = _CTX.get()
+    if ctx is None:
+        raise RuntimeError("on_exit() must be called inside a @pipeline fn")
+    if ctx.exit_handler is not None:
+        raise RuntimeError("a pipeline has at most one exit handler")
+    spec = step._spec
+    if spec not in ctx.steps:
+        raise RuntimeError("on_exit() takes a step created in this pipeline")
+    ctx.steps.remove(spec)
+    spec["dependencies"] = []
+    spec.pop("when", None)
+    spec.pop("with_items", None)
+    ctx.exit_handler = spec
 
 
 def job_step(name: str, job: dict, after: Optional[list[Step]] = None) -> Step:
@@ -171,6 +257,7 @@ def job_step(name: str, job: dict, after: Optional[list[Step]] = None) -> Step:
         raise RuntimeError("job_step() must be called inside a @pipeline fn")
     name = ctx.unique(name)
     spec = {"name": name, "dependencies": [], "job": job}
+    ctx.decorate(spec)
     ctx.steps.append(spec)
     step = Step(name, spec)
     if after:
@@ -197,14 +284,17 @@ def pipeline(
                 _CTX.reset(token)
             params = dict(parameters or {})
             params.update(param_overrides)
+            spec: dict = {
+                "parameters": params,
+                "steps": ctx.steps,
+                "max_parallel_steps": max_parallel_steps,
+            }
+            if ctx.exit_handler is not None:
+                spec["exit_handler"] = ctx.exit_handler
             return {
                 "kind": "Pipeline",
                 "metadata": {"name": name, "namespace": namespace},
-                "spec": {
-                    "parameters": params,
-                    "steps": ctx.steps,
-                    "max_parallel_steps": max_parallel_steps,
-                },
+                "spec": spec,
             }
 
         build.__name__ = fn.__name__

@@ -42,6 +42,24 @@ class PipelineStep(BaseModel):
     # embeds the pipeline parameters and upstream outputs), reusing its
     # captured output.
     cache: bool = False
+    # Conditional execution (Argo `when` / kfp dsl.Condition analog): a
+    # boolean expression rendered with parameters + upstream outputs,
+    # then evaluated by eval_when(). False -> the step is Skipped with
+    # reason ConditionNotMet, which downstream dependencies treat as
+    # SATISFIED (Argo semantics: children of a when-skipped task run as
+    # if it succeeded; its ${steps.<name>.output} renders empty).
+    # Placeholders substitute textually, so quote string comparisons:
+    #   when: "'${steps.check.output}' == 'deploy'"
+    when: Optional[str] = None
+    # Fan-out (Argo withItems/withParam, kfp dsl.ParallelFor analog):
+    # the step expands into one job per item, `${item}` (and
+    # `${item.<key>}` for dict items) substituting into the template. A
+    # string value is rendered first (so it can be a pipeline parameter
+    # or an upstream step's output) and must then parse as a JSON list
+    # -- dynamic fan-out over data produced earlier in the run.
+    # Dependents of the step join on ALL expansions; its
+    # ${steps.<name>.output} is the JSON list of per-item outputs.
+    with_items: Optional[Any] = None
 
 
 class PipelineSpec(BaseModel):
@@ -51,6 +69,13 @@ class PipelineSpec(BaseModel):
     steps: List[PipelineStep]
     # 0 = no limit. Bounds how many step jobs run concurrently.
     max_parallel_steps: int = Field(default=0, ge=0)
+    # Exit handler (Argo onExit / kfp dsl.ExitHandler analog): a step run
+    # once after the main DAG reaches its verdict -- on success AND on
+    # failure -- with ``${pipelineStatus}`` ("Succeeded"/"Failed")
+    # available in its template. The pipeline's final condition waits for
+    # it, but its own result never changes the DAG's verdict (recorded
+    # separately in status.exit_handler_phase).
+    exit_handler: Optional[PipelineStep] = None
 
 
 class PipelineStatus(BaseModel):
@@ -63,6 +88,12 @@ class PipelineStatus(BaseModel):
     step_outputs: Dict[str, str] = Field(default_factory=dict)
     # step name -> retries consumed so far (spec.steps[].retry budget)
     step_retries: Dict[str, int] = Field(default_factory=dict)
+    # Skipped step -> why: "ConditionNotMet" (when= false; dependencies
+    # treat it as satisfied) or "UpstreamFailed" (propagating skip).
+    step_skip_reasons: Dict[str, str] = Field(default_factory=dict)
+    # Exit handler lifecycle, outside the DAG verdict:
+    # Pending | Running | Succeeded | Failed.
+    exit_handler_phase: Optional[str] = None
     start_time: Optional[float] = None
     completion_time: Optional[float] = None
 
@@ -138,23 +169,160 @@ def validate_pipeline(p: Pipeline) -> None:
     if not p.spec.steps:
         raise PipelineValidationError("pipeline has no steps")
     toposort(p.spec.steps)
-    for s in p.spec.steps:
+    steps = list(p.spec.steps)
+    if p.spec.exit_handler is not None:
+        eh = p.spec.exit_handler
+        if eh.dependencies or eh.when or eh.with_items is not None:
+            raise PipelineValidationError(
+                "exit_handler runs unconditionally after the verdict; it "
+                "cannot carry dependencies/when/with_items"
+            )
+        if eh.name in {s.name for s in steps}:
+            raise PipelineValidationError(
+                f"exit_handler name {eh.name!r} collides with a step"
+            )
+        steps.append(eh)
+    for s in steps:
         kind = s.job.get("kind", "JAXJob")
         if kind not in JOB_KINDS:
             raise PipelineValidationError(
                 f"step {s.name!r}: job kind {kind!r} is not a job kind "
                 f"({sorted(JOB_KINDS)})"
             )
+        if s.with_items is not None and not isinstance(
+            s.with_items, (list, str)
+        ):
+            raise PipelineValidationError(
+                f"step {s.name!r}: with_items must be a list or a "
+                "placeholder string rendering to a JSON list"
+            )
+    # Fan-out expansions are named "<step>-<i>"; a sibling step with such
+    # a name would collide with them in phases/outputs/job names.
+    fanout = [s.name for s in steps if s.with_items is not None]
+    for s in steps:
+        for w in fanout:
+            if s.name == w:
+                continue
+            tail = s.name[len(w) + 1:]
+            if s.name.startswith(w + "-") and tail.isdigit():
+                raise PipelineValidationError(
+                    f"step name {s.name!r} collides with fan-out "
+                    f"expansions of step {w!r}"
+                )
+
+
+# -- `when` expressions ------------------------------------------------------
+
+_ALLOWED_CMP = {
+    "Eq": lambda a, b: a == b,
+    "NotEq": lambda a, b: a != b,
+    "Lt": lambda a, b: a < b,
+    "LtE": lambda a, b: a <= b,
+    "Gt": lambda a, b: a > b,
+    "GtE": lambda a, b: a >= b,
+    "In": lambda a, b: a in b,
+    "NotIn": lambda a, b: a not in b,
+}
+
+
+def eval_when(expr: str) -> bool:
+    """Evaluate a RENDERED ``when`` expression safely.
+
+    Grammar: literals (strings, numbers, True/False), comparisons
+    (== != < <= > >= in), and/or/not, parentheses, lists. Interpreted by
+    walking the AST -- no eval(), no names, no calls, so a template that
+    substitutes hostile step output into the expression can at worst
+    fail to parse. Numeric-looking strings compare as written (quote
+    operands: "'${steps.x.output}' == 'ok'").
+    """
+    import ast
+
+    try:
+        tree = ast.parse(expr, mode="eval")
+    except SyntaxError as e:
+        raise PipelineValidationError(
+            f"when expression {expr!r} does not parse: {e}"
+        ) from e
+
+    def ev(node):
+        if isinstance(node, ast.Expression):
+            return ev(node.body)
+        if isinstance(node, ast.Constant):
+            return node.value
+        if isinstance(node, (ast.List, ast.Tuple)):
+            return [ev(e) for e in node.elts]
+        if isinstance(node, ast.BoolOp):
+            vals = [ev(v) for v in node.values]
+            return (all(vals) if isinstance(node.op, ast.And)
+                    else any(vals))
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return not ev(node.operand)
+        if isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, ast.USub
+        ):
+            v = ev(node.operand)
+            if isinstance(v, (int, float)):
+                return -v
+        if isinstance(node, ast.Compare):
+            left = ev(node.left)
+            for op, right in zip(node.ops, node.comparators):
+                fn = _ALLOWED_CMP.get(type(op).__name__)
+                if fn is None:
+                    raise PipelineValidationError(
+                        f"when: operator {type(op).__name__} not allowed"
+                    )
+                r = ev(right)
+                try:
+                    ok = fn(left, r)
+                except TypeError as e:
+                    raise PipelineValidationError(
+                        f"when: cannot compare {left!r} and {r!r}"
+                    ) from e
+                if not ok:
+                    return False
+                left = r
+            return True
+        raise PipelineValidationError(
+            f"when: {type(node).__name__} not allowed (literals, "
+            "comparisons, and/or/not only)"
+        )
+
+    return bool(ev(tree))
+
+
+# -- with_items expansion ----------------------------------------------------
+
+
+def item_mapping(item: Any) -> Dict[str, Any]:
+    """Placeholder map for one fan-out item: ``${item}`` always (dicts
+    render as compact JSON), plus ``${item.<key>}`` per dict key."""
+    import json as _json
+
+    if isinstance(item, dict):
+        m: Dict[str, Any] = {
+            "${item}": _json.dumps(item, sort_keys=True)
+        }
+        for k, v in item.items():
+            m["${item." + str(k) + "}"] = v
+        return m
+    return {"${item}": item}
+
+
+def expansion_names(step: str, n: int) -> List[str]:
+    return [f"{step}-{i}" for i in range(n)]
 
 
 def render_step_template(
-    template: Dict[str, Any],
+    template: Any,
     parameters: Dict[str, Any],
     step_outputs: Dict[str, str],
-) -> Dict[str, Any]:
+    extra: Optional[Dict[str, Any]] = None,
+) -> Any:
     """Textual substitution of ``${pipelineParameters.<name>}`` and
     ``${steps.<name>.output}`` through every string leaf (the same
-    contract as HPO's trial templates; one shared walker serves both)."""
+    contract as HPO's trial templates; one shared walker serves both).
+    ``extra`` carries context placeholders (``${item}``/``${item.k}``
+    for fan-out, ``${pipelineStatus}`` for exit handlers)."""
     from kubeflow_tpu.utils.templating import substitute
 
     mapping: Dict[str, Any] = {
@@ -163,4 +331,6 @@ def render_step_template(
     mapping.update(
         {"${steps." + n + ".output}": v for n, v in step_outputs.items()}
     )
+    if extra:
+        mapping.update(extra)
     return substitute(template, mapping)

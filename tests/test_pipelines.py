@@ -478,3 +478,307 @@ class TestRetryAndCache:
                 assert h.pipeline()["status"]["step_outputs"]["work"] == "b"
 
         asyncio.run(run())
+
+
+class TestWhenExpressions:
+    def test_eval_when_basics(self):
+        from kubeflow_tpu.pipelines.types import eval_when
+
+        assert eval_when("'a' == 'a'")
+        assert not eval_when("'a' == 'b'")
+        assert eval_when("3 > 2 and not (1 == 2)")
+        assert eval_when("'x' in ['x', 'y']")
+        assert eval_when("2 <= 2 <= 3")
+        assert eval_when("-1 < 0")
+
+    def test_eval_when_rejects_code(self):
+        from kubeflow_tpu.pipelines.types import eval_when
+
+        for bad in ("__import__('os')", "open('/etc/passwd')", "x == 1",
+                    "(lambda: 1)()", "1 if True else 2"):
+            with pytest.raises(PipelineValidationError):
+                eval_when(bad)
+
+
+class TestControlFlow:
+    def test_condition_skips_branch_but_join_runs(self, tmp_path):
+        """The false branch is Skipped with ConditionNotMet; the join
+        depending on BOTH branches still runs (Argo semantics), with the
+        skipped branch's output rendering empty."""
+
+        async def run():
+            async with PipelineHarness(tmp_path) as h:
+                taken = step("taken", script="v = 'yes'", out="v")
+                taken["when"] = "'${pipelineParameters.mode}' == 'full'"
+                not_taken = step("not-taken", script="v = 'no'", out="v")
+                not_taken["when"] = "'${pipelineParameters.mode}' == 'dry'"
+                join = step(
+                    "join", deps=["taken", "not-taken"],
+                    script="v = '${steps.taken.output}|'"
+                           "'${steps.not-taken.output}'",
+                    out="v",
+                )
+                h.store.put("Pipeline", pipeline_obj(
+                    steps=[taken, not_taken, join],
+                    parameters={"mode": "full"},
+                ))
+                await h.wait(
+                    lambda: h.phase() == "Succeeded", msg=str(h.pipeline())
+                )
+                st = h.pipeline()["status"]
+                assert st["step_phases"]["taken"] == "Succeeded"
+                assert st["step_phases"]["not-taken"] == "Skipped"
+                assert st["step_skip_reasons"]["not-taken"] == (
+                    "ConditionNotMet"
+                )
+                assert st["step_outputs"]["join"] == "yes|"
+
+        asyncio.run(run())
+
+    def test_upstream_failure_still_propagates_skip(self, tmp_path):
+        async def run():
+            async with PipelineHarness(tmp_path) as h:
+                boom = step("boom", script="raise SystemExit(1)")
+                after = step("after", deps=["boom"])
+                h.store.put("Pipeline", pipeline_obj(steps=[boom, after]))
+                await h.wait(
+                    lambda: h.phase() == "Failed", timeout=45,
+                    msg=str(h.pipeline()),
+                )
+                st = h.pipeline()["status"]
+                assert st["step_phases"]["after"] == "Skipped"
+                assert st["step_skip_reasons"]["after"] == "UpstreamFailed"
+
+        asyncio.run(run())
+
+    def test_invalid_when_fails_step(self, tmp_path):
+        async def run():
+            async with PipelineHarness(tmp_path) as h:
+                bad = step("bad", script="v = 1", out="v")
+                bad["when"] = "__import__('os').getcwd()"
+                h.store.put("Pipeline", pipeline_obj(steps=[bad]))
+                await h.wait(
+                    lambda: h.phase() == "Failed", msg=str(h.pipeline())
+                )
+                reasons = [
+                    c.get("reason")
+                    for c in h.pipeline()["status"]["conditions"]
+                ]
+                assert "WhenInvalid" in reasons
+
+        asyncio.run(run())
+
+    def test_three_way_fanout_joins_with_aggregated_output(self, tmp_path):
+        async def run():
+            async with PipelineHarness(tmp_path) as h:
+                fan = step("fan", script="v = 2 * int('${item}')", out="v")
+                fan["with_items"] = [1, 2, 3]
+                # Keep the join trivial: record the rendered list.
+                join = step(
+                    "join", deps=["fan"],
+                    script="v = '${steps.fan.output}'", out="v",
+                )
+                h.store.put("Pipeline", pipeline_obj(steps=[fan, join]))
+                await h.wait(
+                    lambda: h.phase() == "Succeeded", timeout=45,
+                    msg=str(h.pipeline()),
+                )
+                st = h.pipeline()["status"]
+                assert st["step_phases"]["fan"] == "Succeeded"
+                for i in range(3):
+                    assert st["step_phases"][f"fan-{i}"] == "Succeeded"
+                import json as _json
+
+                assert _json.loads(st["step_outputs"]["fan"]) == [
+                    "2", "4", "6"
+                ]
+                assert st["step_phases"]["join"] == "Succeeded"
+
+        asyncio.run(run())
+
+    def test_dynamic_fanout_over_upstream_output(self, tmp_path):
+        """with_items as a placeholder string: the fan-out width comes
+        from data produced earlier in the run (Argo withParam)."""
+
+        async def run():
+            async with PipelineHarness(tmp_path) as h:
+                gen = step(
+                    "gen", script="import json\nv = json.dumps([10, 20])",
+                    out="v",
+                )
+                fan = step("fan", script="v = 1 + int('${item}')", out="v")
+                fan["with_items"] = "${steps.gen.output}"
+                fan["dependencies"] = ["gen"]
+                h.store.put("Pipeline", pipeline_obj(steps=[gen, fan]))
+                await h.wait(
+                    lambda: h.phase() == "Succeeded", timeout=45,
+                    msg=str(h.pipeline()),
+                )
+                st = h.pipeline()["status"]
+                import json as _json
+
+                assert _json.loads(st["step_outputs"]["fan"]) == [
+                    "11", "21"
+                ]
+
+        asyncio.run(run())
+
+    def test_dict_items_expose_keys(self, tmp_path):
+        async def run():
+            async with PipelineHarness(tmp_path) as h:
+                fan = step(
+                    "fan",
+                    script="v = '${item.name}:' + str(2 * ${item.n})",
+                    out="v",
+                )
+                fan["with_items"] = [
+                    {"name": "a", "n": 1}, {"name": "b", "n": 2},
+                ]
+                h.store.put("Pipeline", pipeline_obj(steps=[fan]))
+                await h.wait(
+                    lambda: h.phase() == "Succeeded", timeout=45,
+                    msg=str(h.pipeline()),
+                )
+                st = h.pipeline()["status"]
+                import json as _json
+
+                assert _json.loads(st["step_outputs"]["fan"]) == [
+                    "a:2", "b:4"
+                ]
+
+        asyncio.run(run())
+
+
+class TestExitHandler:
+    def test_exit_handler_runs_on_failure(self, tmp_path):
+        marker = tmp_path / "exit_saw"
+
+        async def run():
+            async with PipelineHarness(tmp_path) as h:
+                handler = step(
+                    "cleanup",
+                    script=f"open({str(marker)!r}, 'w')"
+                           ".write('${pipelineStatus}')",
+                )
+                h.store.put("Pipeline", pipeline_obj(
+                    steps=[step("boom", script="raise SystemExit(1)")],
+                    exit_handler=handler,
+                ))
+                await h.wait(
+                    lambda: h.phase() == "Failed", timeout=45,
+                    msg=str(h.pipeline()),
+                )
+                st = h.pipeline()["status"]
+                assert st["exit_handler_phase"] == "Succeeded"
+                assert marker.read_text() == "Failed"
+                # Verdict is the DAG's, not the handler's.
+                assert st["step_phases"]["boom"] == "Failed"
+
+        asyncio.run(run())
+
+    def test_exit_handler_runs_on_success(self, tmp_path):
+        marker = tmp_path / "exit_ok"
+
+        async def run():
+            async with PipelineHarness(tmp_path) as h:
+                handler = step(
+                    "notify",
+                    script=f"open({str(marker)!r}, 'w')"
+                           ".write('${pipelineStatus}')",
+                )
+                h.store.put("Pipeline", pipeline_obj(
+                    steps=[step("work", script="v = 1", out="v")],
+                    exit_handler=handler,
+                ))
+                await h.wait(
+                    lambda: h.phase() == "Succeeded", timeout=45,
+                    msg=str(h.pipeline()),
+                )
+                assert marker.read_text() == "Succeeded"
+
+        asyncio.run(run())
+
+    def test_exit_handler_with_deps_rejected(self):
+        handler = step("cleanup", deps=["work"])
+        p = Pipeline.from_dict(pipeline_obj(
+            steps=[step("work")], exit_handler=handler,
+        ))
+        with pytest.raises(PipelineValidationError, match="exit_handler"):
+            validate_pipeline(p)
+
+
+class TestControlFlowDSL:
+    def test_condition_and_for_each_and_on_exit_build(self):
+        @dsl.component
+        def work(x: str) -> str:
+            return x
+
+        @dsl.component
+        def notify(status: str) -> None:
+            pass
+
+        @dsl.pipeline(name="cf", parameters={"mode": "full"})
+        def cf():
+            with dsl.condition("'${pipelineParameters.mode}' == 'full'"):
+                with dsl.for_each(["a", "b", "c"]) as item:
+                    work(x=item)
+            dsl.on_exit(notify(status="${pipelineStatus}"))
+
+        spec = cf()
+        validate_pipeline(Pipeline.from_dict(spec))
+        steps = spec["spec"]["steps"]
+        assert [s["name"] for s in steps] == ["work"]
+        assert steps[0]["when"] == (
+            "('${pipelineParameters.mode}' == 'full')"
+        )
+        assert steps[0]["with_items"] == ["a", "b", "c"]
+        eh = spec["spec"]["exit_handler"]
+        assert eh["name"] == "notify"
+        assert eh["dependencies"] == []
+
+    def test_nested_for_each_rejected(self):
+        @dsl.component
+        def w() -> None:
+            pass
+
+        @dsl.pipeline(name="bad")
+        def bad():
+            with dsl.for_each([1]):
+                with dsl.for_each([2]):
+                    w()
+
+        with pytest.raises(RuntimeError, match="nested"):
+            bad()
+
+
+def test_fanout_does_not_double_count_parallel_limit(tmp_path):
+    """The logical fan-out phase must not count against
+    max_parallel_steps on top of its expansion units: with limit=2 and a
+    ONE-item fan-out running (one real job), an independent fast step
+    must still be admitted -- double-counting the aggregate entry would
+    consume the whole budget with a single job."""
+
+    async def run():
+        async with PipelineHarness(tmp_path) as h:
+            fan = step("fan", script="import time\ntime.sleep(6)\nv=1",
+                       out="v")
+            fan["with_items"] = [1]
+            quick = step("quick", script="v = 'fast'", out="v")
+            h.store.put("Pipeline", pipeline_obj(
+                steps=[fan, quick], max_parallel_steps=2,
+            ))
+            # quick must finish while the fan-out is still running: if
+            # the logical phase double-counted, quick would wait for the
+            # whole fan-out and this wait would time out.
+            def quick_done_fan_running():
+                ph = (h.pipeline() or {}).get("status", {}).get(
+                    "step_phases", {})
+                return (ph.get("quick") == "Succeeded"
+                        and ph.get("fan") == "Running")
+
+            await h.wait(quick_done_fan_running, timeout=5.5,
+                         msg="quick starved while fan-out ran")
+            await h.wait(lambda: h.phase() == "Succeeded", timeout=45,
+                         msg=str(h.pipeline()))
+
+    asyncio.run(run())
