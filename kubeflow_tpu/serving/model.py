@@ -98,10 +98,22 @@ class Model:
     # per generated token (any thread), and return (future-of-token-ids,
     # decode) where ``decode(ids) -> str`` renders a cumulative text. The
     # server owns the SSE framing; models own only token production.
+    # Runtimes that track per-request extras (logprobs) additionally set
+    # ``fut.kftpu_request`` to the engine request.
     def submit_stream(self, instance: Any, on_token) -> tuple:
         raise InferenceError(
             f"model {self.name} does not support streaming generation", 501
         )
+
+    # Chat rendering for the OpenAI chat surface: return the prompt text
+    # for normalized [{"role", "content"}] messages, or None when the
+    # model carries no chat template (the server then falls back to its
+    # generic role-prefixed rendering). Tokenizer-bearing runtimes
+    # override with the checkpoint's own template -- an instruction-tuned
+    # model served through /openai/v1/chat/completions must see the
+    # format it was trained on.
+    def render_chat(self, messages) -> Optional[str]:
+        return None
 
 
 class Batcher:

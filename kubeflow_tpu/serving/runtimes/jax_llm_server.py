@@ -59,6 +59,9 @@ class ByteTokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
 
+    def chat_prompt(self, messages) -> Optional[str]:
+        return None  # no template: server falls back to generic rendering
+
 
 class HFTokenizer:
     def __init__(self, name_or_path: str) -> None:
@@ -72,6 +75,44 @@ class HFTokenizer:
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids))
+
+    def chat_prompt(self, messages) -> Optional[str]:
+        """The checkpoint's own chat template, when it has one --
+        instruction-tuned models must see the prompt format they were
+        trained on, not a generic role-prefixed rendering."""
+        if not getattr(self._tok, "chat_template", None):
+            return None
+        return self._tok.apply_chat_template(
+            list(messages), tokenize=False, add_generation_prompt=True
+        )
+
+
+def make_stop_fn(decode, stops: List[str]):
+    """Engine-side stop predicate: scan the DECODED tail of the
+    generation for any stop string, so the slot frees mid-block instead
+    of running out the token budget. Only the tail is decoded -- a full
+    decode per token would be O(n^2) over long generations. The window
+    is 4 tokens per stop char + slack: byte-level tokenizers (and HF
+    byte-fallback BPE) emit up to ~4 tokens per CJK/emoji char, so a
+    1-token-per-char window would miss such stop strings entirely. Text
+    trimming is the transport layer's job; the matched tokens stay in
+    the result so ids and text agree."""
+    tail = 4 * max(len(s) for s in stops) + 16
+
+    def stop_fn(generated: List[int]) -> bool:
+        text = decode(generated[-tail:])
+        return any(s in text for s in stops)
+
+    return stop_fn
+
+
+def _stop_list(inst) -> List[str]:
+    stop = inst.get("stop")
+    if stop is None:
+        return []
+    if isinstance(stop, str):
+        stop = [stop]
+    return [s for s in stop if isinstance(s, str) and s]
 
 
 def load_params_from_checkpoint(path: str, cfg, mesh=None) -> dict:
@@ -250,27 +291,37 @@ class JaxLLMModel(Model):
     def count_tokens(self, text: str) -> int:
         return len(self.tokenizer.encode(text))
 
-    def submit_stream(self, instance: Any, on_token) -> tuple:
+    def render_chat(self, messages) -> Optional[str]:
+        return self.tokenizer.chat_prompt(messages)
+
+    def _build_request(self, inst: dict, ids: List[int], on_token=None):
         from kubeflow_tpu.serving.engine import Request
 
-        parsed, inst = self._parse_instance(instance)
-        if isinstance(parsed, dict):
-            raise InferenceError(parsed["error"], 400)
-        ids, _ = parsed
-        req = Request(
+        stops = _stop_list(inst)
+        return Request(
             prompt=ids,
             max_new_tokens=int(inst.get("max_new_tokens", 64)),
             temperature=float(inst.get("temperature", 0.0)),
             top_k=int(inst.get("top_k", 0)),
             top_p=float(inst.get("top_p", 1.0)),
             eos_id=inst.get("eos_id", self.tokenizer.eos_id),
+            stop_fn=(make_stop_fn(self.tokenizer.decode, stops)
+                     if stops else None),
+            logprobs=int(inst.get("logprobs", 0) or 0),
             on_token=on_token,
         )
-        return self.engine.submit(req), self.tokenizer.decode
+
+    def submit_stream(self, instance: Any, on_token) -> tuple:
+        parsed, inst = self._parse_instance(instance)
+        if isinstance(parsed, dict):
+            raise InferenceError(parsed["error"], 400)
+        ids, _ = parsed
+        req = self._build_request(inst, ids, on_token)
+        fut = self.engine.submit(req)
+        fut.kftpu_request = req  # logprob records ride the future
+        return fut, self.tokenizer.decode
 
     def predict(self, instances: Sequence[Any]) -> List[Any]:
-        from kubeflow_tpu.serving.engine import Request
-
         # Per-instance errors become per-instance results: one malformed
         # instance must not fail (or orphan) the other requests the batcher
         # coalesced with it.
@@ -281,14 +332,7 @@ class JaxLLMModel(Model):
                 slots.append(parsed)
                 continue
             ids, text_out = parsed
-            req = Request(
-                prompt=ids,
-                max_new_tokens=int(inst.get("max_new_tokens", 64)),
-                temperature=float(inst.get("temperature", 0.0)),
-                top_k=int(inst.get("top_k", 0)),
-                top_p=float(inst.get("top_p", 1.0)),
-                eos_id=inst.get("eos_id", self.tokenizer.eos_id),
-            )
+            req = self._build_request(inst, ids)
             slots.append((self.engine.submit(req), text_out))
         out = []
         for slot in slots:

@@ -299,7 +299,7 @@ class ModelServer:
         and the V1-instance shape ({"prompt"|"token_ids", ...}) alike."""
         inst = dict(body.get("parameters") or {})
         for k in ("prompt", "token_ids", "max_new_tokens", "temperature",
-                  "top_k", "top_p", "eos_id"):
+                  "top_k", "top_p", "eos_id", "stop", "logprobs"):
             if k in body:
                 inst[k] = body[k]
         if "text_input" in body:
@@ -338,15 +338,19 @@ class ModelServer:
         finally:
             self.predict_seconds += time.monotonic() - t0
 
-    async def _stream_deltas(self, model, inst):
+    async def _stream_deltas(self, model, inst, stops=()):
         """Async generator over one streaming generation: yields
         (delta_text, token_id_or_None, ids_so_far) per event, handling
         the engine-thread bridge and split-codepoint withholding (deltas
         must concatenate EXACTLY to the final text: a codepoint split
         across tokens decodes to a trailing U+FFFD that the next token
         replaces -- or raises, for a strict decoder -- so the unstable
-        tail is held back). Raises the engine error, if any, at the end.
-        Shared by the V2 generate_stream and OpenAI SSE framings."""
+        tail is held back). With ``stops``, text is additionally
+        withheld while it could be a stop-string prefix, and the stream
+        ends at the match with the stop text excluded (the engine-side
+        stop_fn frees the slot; this trims the transport). Raises the
+        engine error, if any, at the end. Shared by the V2
+        generate_stream and OpenAI SSE framings."""
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         done = object()
@@ -360,6 +364,7 @@ class ModelServer:
         )
         ids: list = []
         text = ""
+        stopped = False
         while True:
             tok = await q.get()
             if tok is done:
@@ -369,24 +374,49 @@ class ModelServer:
                 full = decode(ids)
             except (UnicodeDecodeError, ValueError):
                 full = None
+            delta = ""
             if (full is not None and full.startswith(text)
                     and not full.endswith("\ufffd")):
-                delta, text = full[len(text):], full
-            else:
-                delta = ""
+                if stops:
+                    trimmed, stopped = self._trim_at_stop(full, stops)
+                    if stopped:
+                        # Everything before the stop (never emitted past
+                        # it: partial matches below were withheld).
+                        yield trimmed[len(text):], tok, ids
+                        break
+                    # Withhold a tail that could grow into a stop match.
+                    safe = len(full)
+                    for s in stops:
+                        for L in range(
+                            min(len(s) - 1, len(full)), 0, -1
+                        ):
+                            if full.endswith(s[:L]):
+                                safe = min(safe, len(full) - L)
+                                break
+                    safe = max(safe, len(text))
+                    delta, text = full[len(text):safe], full[:safe]
+                else:
+                    delta, text = full[len(text):], full
             yield delta, tok, ids
-        if ids:
-            # Flush any withheld tail (stream ended mid-codepoint).
+        if ids and not stopped:
+            # Flush any withheld tail (stream ended mid-codepoint or in
+            # a partial stop match that never completed).
             try:
                 full = decode(ids)
             except (UnicodeDecodeError, ValueError):
                 full = text
             tail = full[len(text):] if full.startswith(text) else full
+            if stops:
+                tail, _ = self._trim_at_stop(tail, stops)
             if tail:
                 yield tail, None, ids
-        exc = fut.exception()
-        if exc is not None:
-            raise exc
+        # After a transport-side stop break the engine may still be
+        # finishing the request (its own stop_fn normally ends it): a
+        # bare fut.exception() would BLOCK the event loop until then.
+        if fut.done():
+            exc = fut.exception()
+            if exc is not None:
+                raise exc
 
     async def _sse_response(self, req: web.Request) -> web.StreamResponse:
         resp = web.StreamResponse()
@@ -463,29 +493,54 @@ class ModelServer:
     # endpoints in front of the vLLM backend) ------------------------------
 
     @staticmethod
-    def _openai_instance(body: dict, prompt: str) -> dict:
+    def _openai_instance(body: dict, prompt: str, chat: bool) -> dict:
         # Every knob is NULLABLE in the OpenAI API (clients/proxies send
         # explicit nulls): null means default, not TypeError.
         def opt(key, default, cast):
             v = body.get(key)
             return default if v is None else cast(v)
 
-        return {
+        inst = {
             "prompt": prompt,
             "max_new_tokens": opt("max_tokens", 16, int),
             "temperature": opt("temperature", 1.0, float),
             "top_p": opt("top_p", 1.0, float),
         }
+        stop = body.get("stop")
+        if stop:
+            inst["stop"] = stop
+        # Logprob capture count for the engine. Completions: logprobs is
+        # an int top-N (0 = chosen-token logprob only -- still needs
+        # capture, so floor at 1 and trim in the response). Chat:
+        # logprobs is a bool gating top_logprobs.
+        if chat:
+            if body.get("logprobs"):
+                inst["logprobs"] = max(1, opt("top_logprobs", 0, int))
+        elif body.get("logprobs") is not None:
+            inst["logprobs"] = max(1, int(body["logprobs"]))
+        return inst
 
     @staticmethod
-    def _chat_prompt(messages) -> str:
-        """Minimal chat rendering: role-prefixed lines + assistant cue.
-        (No model-specific chat template -- the byte/HF tokenizers here
-        carry none; documented, deterministic, good enough for the
-        protocol surface.)"""
+    def _stops(body: dict) -> list:
+        stop = body.get("stop")
+        if stop is None:
+            return []
+        if isinstance(stop, str):
+            stop = [stop]
+        if not isinstance(stop, list) or not all(
+            isinstance(s, str) for s in stop
+        ):
+            raise InferenceError(
+                '"stop" must be a string or a list of strings', 400)
+        return [s for s in stop if s]
+
+    @staticmethod
+    def _normalize_messages(messages) -> list:
+        """Validate and flatten OpenAI messages to
+        [{"role", "content":str}] (content-parts concatenated)."""
         if not isinstance(messages, list) or not messages:
             raise InferenceError('"messages" must be a non-empty list', 400)
-        lines = []
+        norm = []
         for m in messages:
             if not isinstance(m, dict) or "content" not in m:
                 raise InferenceError(
@@ -505,9 +560,88 @@ class ModelServer:
                 raise InferenceError(
                     'message "content" must be a string or text parts',
                     400)
-            lines.append(f"{m.get('role', 'user')}: {content}")
+            norm.append({"role": m.get("role", "user"), "content": content})
+        return norm
+
+    @staticmethod
+    def _chat_prompt(messages: list) -> str:
+        """Fallback chat rendering when the model has no chat template:
+        role-prefixed lines + assistant cue (documented, deterministic,
+        good enough for the protocol surface). Models with a real
+        template render through Model.render_chat instead."""
+        lines = [f"{m['role']}: {m['content']}" for m in messages]
         lines.append("assistant:")
         return "\n".join(lines)
+
+    @staticmethod
+    def _trim_at_stop(text: str, stops: list) -> tuple:
+        """(trimmed_text, stopped): cut at the EARLIEST stop match --
+        OpenAI semantics exclude the stop sequence from the response."""
+        hit = -1
+        for s in stops:
+            i = text.find(s)
+            if i >= 0 and (hit < 0 or i < hit):
+                hit = i
+        return (text[:hit], True) if hit >= 0 else (text, False)
+
+    @staticmethod
+    def _logprobs_block(fut, decode, chat: bool, body: dict,
+                        limit_chars=None):
+        """Per-choice logprobs in the OpenAI response shape, from the
+        engine request's captured records (riding fut.kftpu_request).
+        ``limit_chars`` bounds the entries to the (stop-trimmed)
+        response text -- the OpenAI contract excludes the stop sequence
+        from text AND logprobs alike."""
+        req = getattr(fut, "kftpu_request", None)
+        if req is None or not req.logprob_data:
+            return None
+
+        def tok_str(tid):
+            return decode([int(tid)])
+
+        if chat:
+            want_top = int(body.get("top_logprobs") or 0)
+            content = []
+            offset = 0
+            for tid, rec in zip(req.generated, req.logprob_data):
+                if limit_chars is not None and offset >= limit_chars:
+                    break
+                t = tok_str(tid)
+                offset += len(t)
+                content.append({
+                    "token": t,
+                    "logprob": rec["logprob"],
+                    "top_logprobs": [
+                        {"token": tok_str(i), "logprob": lp}
+                        for i, lp in zip(
+                            rec["top_ids"][:want_top],
+                            rec["top_logprobs"][:want_top],
+                        )
+                    ],
+                })
+            return {"content": content}
+        want_top = int(body.get("logprobs") or 0)
+        tokens, token_lps, tops, offsets = [], [], [], []
+        offset = 0
+        for tid, rec in zip(req.generated, req.logprob_data):
+            if limit_chars is not None and offset >= limit_chars:
+                break
+            t = tok_str(tid)
+            tokens.append(t)
+            token_lps.append(rec["logprob"])
+            tops.append({
+                tok_str(i): lp
+                for i, lp in zip(rec["top_ids"][:want_top],
+                                 rec["top_logprobs"][:want_top])
+            } if want_top else None)
+            offsets.append(offset)
+            offset += len(t)
+        return {
+            "tokens": tokens,
+            "token_logprobs": token_lps,
+            "top_logprobs": tops if want_top else None,
+            "text_offset": offsets,
+        }
 
     async def h_openai_models(self, req: web.Request) -> web.Response:
         return web.json_response({
@@ -529,7 +663,15 @@ class ModelServer:
                 raise InferenceError(f"model {name} is not ready", status=503)
             self.repository.touch(name)
             if chat:
-                prompt = self._chat_prompt(body.get("messages"))
+                norm = self._normalize_messages(body.get("messages"))
+                prompt = None
+                try:
+                    prompt = model.render_chat(norm)
+                except Exception as e:  # noqa: BLE001 - template rejects
+                    logger.warning(  # these messages: generic fallback
+                        "chat template failed (%s); generic rendering", e)
+                if prompt is None:
+                    prompt = self._chat_prompt(norm)
             else:
                 p = body.get("prompt")
                 if isinstance(p, list):
@@ -540,34 +682,61 @@ class ModelServer:
                 if not isinstance(p, str):
                     raise InferenceError('"prompt" must be a string', 400)
                 prompt = p
-            inst = self._openai_instance(body, prompt)
+            inst = self._openai_instance(body, prompt, chat)
+            stops = self._stops(body)
+            n_choices = int(body.get("n") or 1)
+            if not 1 <= n_choices <= 16:
+                raise InferenceError('"n" must be between 1 and 16', 400)
             rid = f"{'chatcmpl' if chat else 'cmpl'}-{int(t0 * 1000):x}"
             if not body.get("stream"):
-                fut, decode = model.submit_stream(inst, None)
-                try:
-                    ids = await asyncio.wrap_future(fut)
-                except ValueError as e:
-                    raise InferenceError(str(e), 400)
-                text = decode(ids)
-                finish = ("length" if len(ids) >= inst["max_new_tokens"]
-                          else "stop")
-                choice = (
-                    {"index": 0, "finish_reason": finish,
-                     "message": {"role": "assistant", "content": text}}
-                    if chat else
-                    {"index": 0, "finish_reason": finish, "text": text}
-                )
+                # n > 1: n engine requests (continuous batching runs them
+                # concurrently); sampling lanes draw independent noise,
+                # so choices differ at temperature > 0 and are identical
+                # at 0, the OpenAI behavior.
+                futs = [model.submit_stream(inst, None)
+                        for _ in range(n_choices)]
+                choices = []
+                completion_tokens = 0
+                for i, (fut, decode) in enumerate(futs):
+                    try:
+                        ids = await asyncio.wrap_future(fut)
+                    except ValueError as e:
+                        raise InferenceError(str(e), 400)
+                    completion_tokens += len(ids)
+                    text = decode(ids)
+                    finish = ("length"
+                              if len(ids) >= inst["max_new_tokens"]
+                              else "stop")
+                    text, stopped = self._trim_at_stop(text, stops)
+                    if stopped:
+                        finish = "stop"
+                    lp = self._logprobs_block(
+                        fut, decode, chat, body,
+                        limit_chars=len(text) if stopped else None,
+                    )
+                    choice = (
+                        {"index": i, "finish_reason": finish,
+                         "message": {"role": "assistant", "content": text}}
+                        if chat else
+                        {"index": i, "finish_reason": finish, "text": text}
+                    )
+                    if lp is not None:
+                        choice["logprobs"] = lp
+                    choices.append(choice)
                 pt = model.count_tokens(prompt)
                 return web.json_response({
                     "id": rid, "object": obj, "model": name,
-                    "choices": [choice],
+                    "choices": choices,
                     "usage": {
                         "prompt_tokens": pt,
-                        "completion_tokens": len(ids),
-                        "total_tokens": pt + len(ids),
+                        "completion_tokens": completion_tokens,
+                        "total_tokens": pt + completion_tokens,
                     },
                 })
-            stream = self._stream_deltas(model, inst)
+            if n_choices != 1:
+                raise InferenceError(
+                    '"n" > 1 is not supported with "stream": true', 400)
+            stream = self._stream_deltas(model, inst, stops=stops)
             first = await anext(stream, None)
             streaming = True
         except json.JSONDecodeError:

@@ -624,3 +624,205 @@ def test_openai_endpoints(stream_client):
         assert r.status == 400
 
     loop.run_until_complete(run())
+
+
+class FakeLPModel(FakeStreamModel):
+    """FakeStreamModel that also attaches an engine-request-shaped object
+    carrying logprob records, and records the instances it served."""
+
+    def __init__(self, name="lp", tokens=(104, 105, 33)):
+        super().__init__(name, tokens)
+        self.instances = []
+
+    def submit_stream(self, instance, on_token):
+        self.instances.append(instance)
+        fut, decode = super().submit_stream(instance, on_token)
+
+        class _Req:
+            generated = list(self.tokens)
+            logprob_data = [
+                {"logprob": -0.1 * (i + 1),
+                 "top_ids": [t, 0],
+                 "top_logprobs": [-0.1 * (i + 1), -5.0]}
+                for i, t in enumerate(self.tokens)
+            ] if instance.get("logprobs") else []
+
+        fut.kftpu_request = _Req()
+        return fut, decode
+
+
+class FakeChatModel(FakeStreamModel):
+    """Carries a chat template, like an instruction-tuned checkpoint."""
+
+    def __init__(self):
+        super().__init__("chatty")
+        self.instances = []
+
+    def render_chat(self, messages):
+        return "".join(f"<|{m['role']}|>{m['content']}" for m in messages) + "<|assistant|>"
+
+    def submit_stream(self, instance, on_token):
+        self.instances.append(instance)
+        return super().submit_stream(instance, on_token)
+
+
+@pytest.fixture
+def openai_client():
+    async def make():
+        repo = ModelRepository()
+        lp = FakeLPModel()
+        chatty = FakeChatModel()
+        repo.register(lp)
+        repo.register(chatty)
+        server = ModelServer(repository=repo)
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        return client, lp, chatty
+
+    loop = asyncio.new_event_loop()
+    c, lp, chatty = loop.run_until_complete(make())
+    yield c, loop, lp, chatty
+    loop.run_until_complete(c.close())
+    loop.close()
+
+
+def test_openai_stop_sequences(openai_client):
+    c, loop, lp, _ = openai_client
+
+    async def run():
+        # Buffered: output "hi!" with stop "i" -> "h", finish_reason stop.
+        r = await c.post("/openai/v1/completions",
+                         json={"model": "lp", "prompt": "x",
+                               "max_tokens": 3, "stop": "i"})
+        assert r.status == 200
+        body = await r.json()
+        assert body["choices"][0]["text"] == "h"
+        assert body["choices"][0]["finish_reason"] == "stop"
+        # The engine instance carried the stop through.
+        assert lp.instances[-1]["stop"] == "i"
+
+        # Streaming: deltas never contain the stop text.
+        r = await c.post("/openai/v1/completions",
+                         json={"model": "lp", "prompt": "x",
+                               "stream": True, "stop": ["i!"]})
+        assert r.status == 200
+        import json as _json
+
+        text = ""
+        async for line in r.content:
+            line = line.decode().strip()
+            if line.startswith("data: ") and line != "data: [DONE]":
+                ch = _json.loads(line[len("data: "):])
+                text += ch["choices"][0].get("text") or ""
+        assert text == "h"
+
+        # Bad stop type -> 400.
+        r = await c.post("/openai/v1/completions",
+                         json={"model": "lp", "prompt": "x", "stop": 7})
+        assert r.status == 400
+
+    loop.run_until_complete(run())
+
+
+def test_openai_n_choices(openai_client):
+    c, loop, _, _ = openai_client
+
+    async def run():
+        r = await c.post("/openai/v1/completions",
+                         json={"model": "lp", "prompt": "x",
+                               "max_tokens": 4, "n": 3})
+        assert r.status == 200
+        body = await r.json()
+        assert [ch["index"] for ch in body["choices"]] == [0, 1, 2]
+        assert all(ch["text"] == "hi!" for ch in body["choices"])
+        assert body["usage"]["completion_tokens"] == 9  # 3 tokens x 3
+
+        # n > 1 with stream -> 400, not silent truncation.
+        r = await c.post("/openai/v1/completions",
+                         json={"model": "lp", "prompt": "x",
+                               "n": 2, "stream": True})
+        assert r.status == 400
+
+    loop.run_until_complete(run())
+
+
+def test_openai_completions_logprobs(openai_client):
+    c, loop, lp, _ = openai_client
+
+    async def run():
+        r = await c.post("/openai/v1/completions",
+                         json={"model": "lp", "prompt": "x",
+                               "max_tokens": 3, "logprobs": 2})
+        assert r.status == 200
+        body = await r.json()
+        blk = body["choices"][0]["logprobs"]
+        assert blk["tokens"] == ["h", "i", "!"]
+        assert blk["token_logprobs"] == pytest.approx([-0.1, -0.2, -0.3])
+        assert len(blk["top_logprobs"]) == 3
+        assert blk["top_logprobs"][0]["h"] == pytest.approx(-0.1)
+        assert blk["text_offset"] == [0, 1, 2]
+        # Engine saw the capture request.
+        assert lp.instances[-1]["logprobs"] == 2
+
+    loop.run_until_complete(run())
+
+
+def test_openai_chat_logprobs(openai_client):
+    c, loop, _, _ = openai_client
+
+    async def run():
+        r = await c.post("/openai/v1/chat/completions",
+                         json={"model": "lp",
+                               "messages": [{"role": "user",
+                                             "content": "hey"}],
+                               "max_tokens": 3, "logprobs": True,
+                               "top_logprobs": 2})
+        assert r.status == 200
+        body = await r.json()
+        content = body["choices"][0]["logprobs"]["content"]
+        assert [e["token"] for e in content] == ["h", "i", "!"]
+        assert all(len(e["top_logprobs"]) == 2 for e in content)
+        assert content[0]["top_logprobs"][0]["token"] == "h"
+
+    loop.run_until_complete(run())
+
+
+def test_openai_chat_template_applied(openai_client):
+    c, loop, _, chatty = openai_client
+
+    async def run():
+        r = await c.post("/openai/v1/chat/completions",
+                         json={"model": "chatty",
+                               "messages": [
+                                   {"role": "system", "content": "be kind"},
+                                   {"role": "user", "content": "hello"},
+                               ]})
+        assert r.status == 200
+        # The model's own template rendered the prompt, not the generic
+        # role-prefixed fallback.
+        assert chatty.instances[-1]["prompt"] == (
+            "<|system|>be kind<|user|>hello<|assistant|>"
+        )
+
+    loop.run_until_complete(run())
+
+
+def test_openai_stop_trims_logprobs_too(openai_client):
+    """The OpenAI contract excludes the stop sequence from text AND
+    logprobs: a stop-trimmed choice must not carry logprob entries for
+    tokens past the trimmed text."""
+    c, loop, _, _ = openai_client
+
+    async def run():
+        r = await c.post("/openai/v1/completions",
+                         json={"model": "lp", "prompt": "x",
+                               "max_tokens": 3, "stop": "i",
+                               "logprobs": 1})
+        assert r.status == 200
+        body = await r.json()
+        ch = body["choices"][0]
+        assert ch["text"] == "h"
+        assert ch["logprobs"]["tokens"] == ["h"]
+        assert len(ch["logprobs"]["token_logprobs"]) == 1
+
+    loop.run_until_complete(run())
