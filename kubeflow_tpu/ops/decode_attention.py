@@ -1,0 +1,141 @@
+"""Pallas TPU decode attention: bounded-span KV-cache reads.
+
+The serving engine's decode step attends over the FULL [Smax] cache slab
+every step at every context length -- bounding the span in XLA (attend
+``ck[:, :klen]``) regressed ~5x because slicing the scan-carried cache
+materializes a per-layer copy instead of fusing into the attention reads
+(measured 2026-07-30, note in serving/engine.py:_decode). This kernel is
+the fix that note prescribes: the cache stays IN PLACE in HBM, and the
+kernel manually DMAs only ceil(span/block) key/value blocks per slot into
+VMEM, so HBM traffic scales with the LIVE context, not Smax.
+
+Shapes (one layer's slice of the engine cache, layout unchanged):
+  q         [B, KV, G, D]   query heads grouped under their KV head
+  cache_k/v [B, Smax, KV, D]
+  positions [B]             query position per slot (span = pos + 1)
+  -> out    [B, KV, G, D]
+
+Grid = (B,): per slot, a fori_loop with DATA-DEPENDENT trip count
+cdiv(span, block) runs online-softmax flash attention over contiguous
+[block, KV, D] cache chunks (the Smax dimension is the contiguous one,
+so each DMA is one dense HBM burst). Rows past ``span`` in the final
+block are masked; rows past a slot's span hold garbage by the engine's
+masked-until-overwritten invariant, which this mask re-implements.
+
+Numerics match ops.attention/xla paths: f32 scores and softmax
+accumulation, output cast to the cache dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Cache rows fetched per DMA. 256 rows x KV x D bf16 at KV=8, D=128 is
+# 512 KiB -- large enough to amortize DMA issue cost, small enough that
+# double-buffering two of them fits VMEM comfortably.
+DEFAULT_BLOCK = 256
+
+
+def _kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref,
+            k_vmem, v_vmem, sem_k, sem_v, *, block: int, smax: int):
+    b = pl.program_id(0)
+    span = pos_ref[b] + 1
+    nb = pl.cdiv(span, block)
+    q = q_ref[0].astype(jnp.float32)            # [KV, G, D]
+    kv_heads, g, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+
+    def body(j, carry):
+        m, l, acc = carry
+        ck = pltpu.make_async_copy(
+            k_hbm.at[b, pl.ds(j * block, block)], k_vmem, sem_k
+        )
+        cv = pltpu.make_async_copy(
+            v_hbm.at[b, pl.ds(j * block, block)], v_vmem, sem_v
+        )
+        ck.start()
+        cv.start()
+        ck.wait()
+        cv.wait()
+        kblk = k_vmem[...].astype(jnp.float32)  # [block, KV, D]
+        vblk = v_vmem[...].astype(jnp.float32)
+        # scores [KV, G, block]: contract D per KV head. HIGHEST keeps
+        # f32 operands exact (the default would downcast them to bf16);
+        # production bf16 caches are unaffected.
+        s = jax.lax.dot_general(
+            q, kblk,
+            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * scale
+        idx = j * block + jax.lax.broadcasted_iota(
+            jnp.int32, (kv_heads, g, block), 2
+        )
+        s = jnp.where(idx < span, s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                  # [KV, G, block]
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, vblk,
+            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                       # [KV, G, D]
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((kv_heads, g, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((kv_heads, g, 1), jnp.float32)
+    a0 = jnp.zeros((kv_heads, g, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, a0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "interpret")
+)
+def decode_attention(q, cache_k, cache_v, positions,
+                     block: int = DEFAULT_BLOCK,
+                     interpret: bool = False):
+    """Bounded-span GQA decode attention over the in-place cache.
+
+    q [B, KV, G, D]; cache_k/v [B, Smax, KV, D]; positions [B].
+    Returns [B, KV, G, D] in q's dtype. Smax must be a multiple of
+    ``block`` (engine max_seq is a power of two; pad otherwise).
+    """
+    b, smax, kv_heads, d = cache_k.shape
+    if smax % block:
+        raise ValueError(f"Smax={smax} not a multiple of block={block}")
+    g = q.shape[2]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, kv_heads, g, d), lambda i, pos: (i, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # cache_k stays HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),   # cache_v stays HBM
+        ],
+        out_specs=pl.BlockSpec((1, kv_heads, g, d),
+                               lambda i, pos: (i, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block, kv_heads, d), cache_k.dtype),
+            pltpu.VMEM((block, kv_heads, d), cache_v.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    kernel = functools.partial(_kernel, block=block, smax=smax)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+    )(positions.astype(jnp.int32), q, cache_k, cache_v)

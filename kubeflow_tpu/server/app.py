@@ -187,6 +187,8 @@ class ControlPlane:
                 web.get("/", self.h_dashboard),
                 # Katib-UI-equivalent experiment drill-down (K8): trial
                 # table + objective plot for one experiment.
+                web.get("/dashboard/isvc/{ns}/{name}",
+                        self.h_isvc_detail),
                 web.get("/dashboard/experiment/{ns}/{name}",
                         self.h_experiment_detail),
                 # KFAM-equivalent access management API (P7).
@@ -596,6 +598,78 @@ class ControlPlane:
         authorization included)."""
         return web.Response(text=_DASHBOARD_PAGE, content_type="text/html")
 
+    async def h_isvc_detail(self, req: web.Request) -> web.Response:
+        """InferenceService drill-down (SURVEY.md 5.5): component/replica
+        status plus LIVE engine metrics scraped from each replica's
+        /metrics -- queue depth, slot occupancy, prefill backlog,
+        TTFT/ITL histograms land where an operator looks for them."""
+        import html as _html
+
+        import aiohttp
+
+        ns, name = req.match_info["ns"], req.match_info["name"]
+        raw = self.store.get("InferenceService", name, ns)
+        if raw is None:
+            return web.Response(status=404, text="inferenceservice not found")
+        status = raw.get("status", {})
+
+        async def scrape(session, port):
+            try:
+                async with session.get(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=aiohttp.ClientTimeout(total=2),
+                ) as r:
+                    return await r.text()
+            except Exception as e:  # noqa: BLE001 - dead replica
+                return f"(scrape failed: {e})"
+
+        sections = []
+        # One session, all replicas scraped CONCURRENTLY: hung replicas
+        # bound the page at ~one timeout, not timeouts x replicas.
+        async with aiohttp.ClientSession() as session:
+            for comp in ("predictor", "transformer", "explainer"):
+                cstat = status.get(comp) or {}
+                reps = cstat.get("replicas") or []
+                if not reps and comp != "predictor":
+                    continue
+                head = (
+                    f"<h2>{comp} "
+                    f"({cstat.get('ready_replicas', 0)}/"
+                    f"{cstat.get('desired_replicas', 0)} ready)</h2>"
+                )
+                texts = await asyncio.gather(*[
+                    scrape(session, rep.get("port"))
+                    if rep.get("port") and rep.get("state") == "Ready"
+                    else asyncio.sleep(0, result="")
+                    for rep in reps
+                ])
+                blocks = []
+                for rep, text in zip(reps, texts):
+                    blocks.append(
+                        f"<h3>replica {rep.get('index')} · port "
+                        f"{rep.get('port')} · "
+                        f"{_html.escape(str(rep.get('state', '?')))}</h3>"
+                        f"<pre>{_html.escape(text)}</pre>"
+                    )
+                sections.append(head + "".join(blocks))
+        conds = " · ".join(
+            f"{c.get('type')}={c.get('status')}"
+            for c in status.get("conditions", [])
+        )
+        page = (
+            "<!doctype html><html><head><title>isvc "
+            f"{_html.escape(name)}</title><style>"
+            "body{font-family:monospace;margin:2em;background:#fafafa}"
+            "pre{background:#fff;border:1px solid #ccc;padding:8px;"
+            "font-size:12px;overflow-x:auto}"
+            "</style></head><body>"
+            f"<h1>inferenceservice {_html.escape(ns)}/{_html.escape(name)}"
+            f"</h1><p>{_html.escape(conds)}</p>"
+            + "".join(sections) +
+            '<p><a href="/dashboard">back</a></p></body></html>'
+        )
+        return web.Response(text=page, content_type="text/html")
+
     async def h_experiment_detail(self, req: web.Request) -> web.Response:
         """Experiment drill-down (Katib UI analog, SURVEY.md 3.2 K8):
         parameters, budget, per-trial assignments + objective values, the
@@ -875,6 +949,8 @@ async function main(){
       let name = esc(o.metadata.name);
       if (kind === "Experiment")  // drill-down: trials + objective plot
         name = '<a href="dashboard/experiment/'+ns+'/'+name+'">'+name+'</a>';
+      if (kind === "InferenceService")  // drill-down: replica metrics
+        name = '<a href="dashboard/isvc/'+ns+'/'+name+'">'+name+'</a>';
       const attrs = ' data-kind="'+esc(kind)+'" data-ns="'+ns
         +'" data-name="'+esc(o.metadata.name)+'"';
       let actions = '<button data-act="del"'+attrs+'>delete</button>';

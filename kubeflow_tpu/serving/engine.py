@@ -29,6 +29,7 @@ import dataclasses
 import logging
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence
@@ -253,11 +254,16 @@ def _insert(cache_k, cache_v, k_seq, v_seq, slots):
     )
 
 
-def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths):
+def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths,
+            kernel: bool = False):
     """One decode step for all slots.
 
     tokens [B] (last sampled token per slot), lengths [B] (tokens already
     in cache; the new token's position). Returns (logits [B, V], caches).
+
+    ``kernel`` routes attention through the Pallas bounded-span decode
+    kernel (ops/decode_attention.py): HBM cache reads scale with each
+    slot's live context instead of Smax.
     """
 
     # NOTE (measured 2026-07-30): bounding the attended span to a bucket
@@ -265,10 +271,14 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths):
     # v5e -- the slice of the scan-carried cache materializes as a copy
     # per layer per step instead of fusing into the attention reads,
     # dwarfing the bandwidth it saves. Full-span attention + mask is the
-    # fast path under XLA; don't re-try without a Pallas decode kernel
-    # that indexes the cache directly.
+    # fast path under XLA; the Pallas kernel (``kernel=True``) is the
+    # only correct way to bound the span: it DMAs the live rows straight
+    # out of the in-place HBM cache.
     b = tokens.shape[0]
     smax = cache_k.shape[2]
+    kblock = min(256, smax)
+    if smax % kblock:
+        kernel = False  # non-pow2 max_seq: kernel tiling can't cover it
     positions = lengths[:, None]  # [B,1]
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     x = w["embed"][tokens][:, None, :]  # [B,1,H]
@@ -289,7 +299,18 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths):
         k = _rope(k, freqs, positions)
         ck = ck.at[batch_idx, positions].set(k)
         cv = cv.at[batch_idx, positions].set(v)
-        out = _gqa_attend(q, ck, cv, mask)
+        if kernel:
+            from kubeflow_tpu.ops.decode_attention import decode_attention
+
+            n = q.shape[2]
+            kvh = cfg.n_kv_heads
+            qg = q[:, 0].reshape(b, kvh, n // kvh, cfg.head_dim)
+            out = decode_attention(
+                qg, ck, cv, lengths, block=kblock,
+                interpret=jax.default_backend() != "tpu",
+            ).reshape(b, 1, n, cfg.head_dim)
+        else:
+            out = _gqa_attend(q, ck, cv, mask)
         out = jnp.einsum("bsnd,ndh->bsh", out, lp["attn"]["o_proj"]["kernel"])
         x = x + out
         h = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
@@ -320,7 +341,8 @@ def _logprob_outputs(logits, chosen):
 
 def _decode_block(cfg: LlamaConfig, n_steps: int, filtered: bool,
                   want_lp: bool, w: dict, cache_k, cache_v, tokens,
-                  lengths, rng, temps, top_ks, top_ps):
+                  lengths, rng, temps, top_ks, top_ps,
+                  kernel: bool = False):
     """n_steps decode+sample iterations in ONE device program.
 
     Amortizes the host<->device dispatch roundtrip (dominant on remote
@@ -337,7 +359,7 @@ def _decode_block(cfg: LlamaConfig, n_steps: int, filtered: bool,
 
     def body(carry, step_rng):
         ck, cv, toks, lens = carry
-        logits, ck, cv = _decode(cfg, w, ck, cv, toks, lens)
+        logits, ck, cv = _decode(cfg, w, ck, cv, toks, lens, kernel)
         # ``filtered`` is STATIC: the all-greedy/unfiltered batch (the
         # common case) must not pay the double [B, V] argsort + cumsum
         # of top-k/top-p -- measured 5x decode throughput on the 8B
@@ -673,6 +695,266 @@ def tp_cache_sharding(mesh):
     )
 
 
+def _ngram_draft(hist, lens, k: int):
+    """Prompt-lookup drafting, fully on device: for each row find the
+    LATEST earlier occurrence of the trailing 2-gram in the token
+    history and propose the k tokens that followed it. No draft model,
+    no extra weights -- repetition in the context (code, chat echoes,
+    structured text) is the signal. Rows with no match draft garbage
+    that verification simply rejects (cost: the step degenerates to one
+    decode step, never wrongness).
+
+    hist [B, Smax] (prompt + generated, valid to lens); lens [B] = total
+    tokens incl. the pending last sample. Returns draft [B, k].
+    """
+    b, smax = hist.shape
+    rows = jnp.arange(b)
+    t1 = hist[rows, jnp.maximum(lens - 2, 0)]
+    t2 = hist[rows, jnp.maximum(lens - 1, 0)]
+    # match[i] == True: (hist[i], hist[i+1]) equals the trailing 2-gram,
+    # with i+1 strictly before the trailing occurrence itself.
+    m = (hist[:, :-1] == t1[:, None]) & (hist[:, 1:] == t2[:, None])
+    m &= (jnp.arange(smax - 1)[None, :] + 1) < (lens - 1)[:, None]
+    p = (smax - 2) - jnp.argmax(m[:, ::-1], axis=1)  # latest match
+    found = m.any(axis=1)
+    start = jnp.where(found, p + 2, 0)
+    gpos = start[:, None] + jnp.arange(k)[None, :]
+    return jnp.take_along_axis(hist, jnp.minimum(gpos, smax - 1), axis=1)
+
+
+def _spec_block(cfg: LlamaConfig, m_steps: int, k_draft: int, w: dict,
+                cache_k, cache_v, tokens, lengths, hist):
+    """m_steps SPECULATIVE decode iterations in ONE device program
+    (greedy path only; the scheduler falls back to _decode_block for
+    sampled/filterered/logprob batches).
+
+    Each step: draft k tokens per slot by prompt lookup (_ngram_draft),
+    verify [last, d1..dk] in one (k+1)-wide forward over the cache --
+    decode is HBM-bandwidth bound, so the (k+1)x FLOPs ride the SAME
+    weight stream a 1-token step pays for -- then accept the longest
+    matched prefix plus the model's bonus token. Per step a slot emits
+    1..k+1 tokens for one weight read; on the dispatch-overhead-
+    dominated serving path that compounds with block fusion: tokens per
+    dispatch goes from m to up to m*(k+1).
+
+    Cache invariant: verification writes K/V for all k+1 candidate
+    positions; rows past the accepted count hold garbage that is
+    masked-until-overwritten exactly like block-decode overshoot (the
+    next step's write window starts at the new length). The carried
+    history gets ONLY accepted tokens (mode="drop" scatter) -- garbage
+    there would poison later drafts.
+
+    tokens [B] last sampled; lengths [B] total tokens incl. it (cache
+    holds lengths-1). hist [B, Smax] token history, valid to lengths.
+    Returns (out_tokens [m, B, k+1], counts [m, B], ck, cv); rows of
+    out_tokens past counts are zero-padding the host discards.
+    """
+
+    b = tokens.shape[0]
+    smax = cache_k.shape[2]
+    s = k_draft + 1
+    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    batch_idx = jnp.arange(b)[:, None]
+    lm_head = w["lm_head"].astype(jnp.float32)
+    j = jnp.arange(s)[None, :]
+
+    def step_body(carry, _):
+        ck0, cv0, toks, lens, hist = carry
+        draft = _ngram_draft(hist, lens, k_draft)            # [B,k]
+        tokens_in = jnp.concatenate([toks[:, None], draft], axis=1)
+        positions = (lens - 1)[:, None] + j                  # [B,S]
+        mask = jnp.arange(smax)[None, None, :] <= positions[:, :, None]
+        x = w["embed"][tokens_in]                            # [B,S,H]
+
+        def layer_body(x, layer):
+            lp, ck, cv = layer
+            attn = lp["attn"]
+            h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps)
+            q = jnp.einsum("bsh,hnd->bsnd", h, attn["q_proj"]["kernel"])
+            k = jnp.einsum("bsh,hnd->bsnd", h, attn["k_proj"]["kernel"])
+            v = jnp.einsum("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
+            q = _rope(q, freqs, positions)
+            k = _rope(k, freqs, positions)
+            ck = ck.at[batch_idx, positions].set(k)
+            cv = cv.at[batch_idx, positions].set(v)
+            out = _gqa_attend(q, ck, cv, mask)
+            out = jnp.einsum("bsnd,ndh->bsh", out,
+                             attn["o_proj"]["kernel"])
+            x = x + out
+            h = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
+            return x + _ffn(cfg, lp, h), (ck, cv)
+
+        x, (ck1, cv1) = jax.lax.scan(layer_body, x,
+                                     (w["layers"], ck0, cv0))
+        x = _rms(x, w["final_scale"], cfg.norm_eps)
+        g = jnp.argmax(
+            jnp.einsum("bsh,hv->bsv", x.astype(jnp.float32), lm_head),
+            axis=-1,
+        )                                                    # [B,S]
+        eq = draft == g[:, :-1]
+        a = jnp.cumprod(eq.astype(jnp.int32), axis=1).sum(axis=1)  # [B]
+        bonus = jnp.take_along_axis(g, a[:, None], axis=1)[:, 0]
+        padded_draft = jnp.pad(draft, ((0, 0), (0, 1)))
+        out = jnp.where(j < a[:, None], padded_draft,
+                        jnp.where(j == a[:, None], bonus[:, None], 0))
+        count = a + 1
+        wpos = jnp.where(j <= a[:, None], lens[:, None] + j, smax)
+        hist = hist.at[batch_idx, wpos].set(out, mode="drop")
+        return (ck1, cv1, bonus, lens + count, hist), (out, count)
+
+    (ck, cv, _, _, _), (outs, counts) = jax.lax.scan(
+        step_body, (cache_k, cache_v, tokens, lengths, hist),
+        None, length=m_steps,
+    )
+    return outs, counts, ck, cv
+
+
+# ---------------------------------------------------------------------------
+# Prefix (KV) cache
+# ---------------------------------------------------------------------------
+
+
+class LatencyHistogram:
+    """Prometheus-style cumulative histogram, host-side and allocation
+    free on the hot path (one list walk per observe)."""
+
+    BUCKETS_MS = (5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+                  2500.0, 5000.0)
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(self.BUCKETS_MS) + 1)
+        self.sum = 0.0
+        self.n = 0
+
+    def observe(self, seconds: float) -> None:
+        ms = seconds * 1000.0
+        self.sum += seconds
+        self.n += 1
+        for i, b in enumerate(self.BUCKETS_MS):
+            if ms <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def prom_lines(self, name: str, labels: str) -> List[str]:
+        out = []
+        cum = 0
+        for b, c in zip(self.BUCKETS_MS, self.counts):
+            cum += c
+            out.append(
+                f'{name}_bucket{{{labels},le="{b / 1000.0}"}} {cum}'
+            )
+        out.append(f'{name}_bucket{{{labels},le="+Inf"}} {self.n}')
+        out.append(f"{name}_sum{{{labels}}} {self.sum:.6f}")
+        out.append(f"{name}_count{{{labels}}} {self.n}")
+        return out
+
+
+class PrefixCache:
+    """Exact-match prompt-prefix reuse (vLLM's prefix caching, slab-shaped).
+
+    Prompts hash block-by-block with a rolling chain hash; a finished
+    prefill donates its slot's KV rows [L, plen, KV, D] to the store,
+    registered under EVERY block-prefix hash (one buffer, many keys), so
+    a later prompt sharing any block-aligned prefix restores those rows
+    with one scatter and prefills only the remainder. Shared system
+    prompts -- the dominant cost of multi-turn OpenAI chat, which
+    re-renders the whole history every turn -- then cost one restore
+    instead of a full prefill.
+
+    Device-memory bounded: LRU over whole entries by byte budget. Keys
+    are chain hashes of exact token blocks, so a hit implies token-exact
+    prefix equality (module collisions of blake2b, not a practical
+    concern).
+    """
+
+    def __init__(self, block: int, capacity_bytes: int) -> None:
+        self.block = max(1, int(block))
+        self.capacity = int(capacity_bytes)
+        # chain-hash -> (entry, plen). entry = dict(k, v, plen, keys,
+        # tick); entries own device buffers and all their prefix keys.
+        self.by_prefix: Dict[bytes, tuple] = {}
+        self.entries: Dict[bytes, dict] = {}  # full-capture hash -> entry
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self._tick = 0
+
+    def chain_hashes(self, prompt: Sequence[int], max_len: int):
+        """[(plen, hash)] at each block boundary <= max_len."""
+        import hashlib
+
+        out = []
+        h = b"kftpu-prefix"
+        n = (min(len(prompt), max_len) // self.block) * self.block
+        for end in range(self.block, n + 1, self.block):
+            blk = np.asarray(
+                prompt[end - self.block:end], np.int64
+            ).tobytes()
+            h = hashlib.blake2b(h + blk, digest_size=16).digest()
+            out.append((end, h))
+        return out
+
+    def lookup(self, prompt: Sequence[int], max_len: int):
+        """Longest cached (plen, entry) for a block-aligned prefix of
+        ``prompt`` no longer than max_len, or (0, None)."""
+        best = (0, None)
+        # No early break on a miss: eviction can delete a SHORTER prefix
+        # key (owned by the victim) while a longer live entry still
+        # covers it, so presence is not monotone in prefix length.
+        for plen, h in self.chain_hashes(prompt, max_len):
+            hit = self.by_prefix.get(h)
+            if hit is not None:
+                best = (plen, hit[0])
+        if best[1] is not None:
+            self._tick += 1
+            best[1]["tick"] = self._tick
+            self.hits += 1
+        else:
+            self.misses += 1
+        return best
+
+    def insert(self, prompt: Sequence[int], k_rows, v_rows) -> None:
+        """Donate KV rows covering a block-multiple prefix of prompt.
+        k_rows/v_rows: [L, plen, KV, D] device arrays."""
+        plen = int(k_rows.shape[1])
+        hashes = self.chain_hashes(prompt, plen)
+        if not hashes or hashes[-1][0] != plen:
+            return
+        full = hashes[-1][1]
+        if full in self.entries:
+            return  # already captured (the common repeated-prefix case)
+        size = k_rows.nbytes + v_rows.nbytes
+        if size > self.capacity:
+            return
+        self._tick += 1
+        entry = {"k": k_rows, "v": v_rows, "plen": plen,
+                 "keys": [], "tick": self._tick, "bytes": size}
+        for _plen, h in hashes:
+            # First writer wins for shorter prefixes (it is the LRU-hot
+            # one); the full-length key is ours by the check above.
+            if h not in self.by_prefix or h == full:
+                self.by_prefix[h] = (entry, _plen)
+                entry["keys"].append(h)
+        self.entries[full] = entry
+        self.bytes += size
+        while self.bytes > self.capacity and self.entries:
+            victim_full, victim = min(
+                self.entries.items(), key=lambda kv: kv[1]["tick"]
+            )
+            if victim is entry and len(self.entries) == 1:
+                break
+            for h in victim["keys"]:
+                if self.by_prefix.get(h, (None,))[0] is victim:
+                    del self.by_prefix[h]
+            del self.entries[victim_full]
+            self.bytes -= victim["bytes"]
+
+    def stats(self) -> dict:
+        return {"entries": len(self.entries), "bytes": self.bytes,
+                "hits": self.hits, "misses": self.misses}
+
+
 # ---------------------------------------------------------------------------
 # Engine
 # ---------------------------------------------------------------------------
@@ -716,6 +998,9 @@ class Request:
     # Per-token logprob records, parallel to ``generated`` (only when
     # ``logprobs`` > 0).
     logprob_data: List[dict] = dataclasses.field(default_factory=list)
+    # Observability timestamps (engine-internal).
+    submit_t: float = 0.0
+    last_emit_t: float = 0.0
 
 
 class GenerationEngine:
@@ -739,19 +1024,31 @@ class GenerationEngine:
         tensor_parallel: int = 1,
         prefill_chunk: int = 0,
         max_prefill_tokens: int = 8192,
-        prefill_decode_steps: int = 2,
+        prefill_decode_steps: Optional[int] = None,
+        prefix_cache_mb: int = 0,
+        prefix_block: int = 128,
+        speculative_k: int = 0,
+        decode_attn_kernel: bool = False,
     ) -> None:
         # Max decode steps fused into one device program (power-of-2
         # sub-blocks keep the compile count bounded); 1 = per-token
         # dispatch.
         self.decode_block = max(1, decode_block)
         # Decode steps riding a PREFILL-carrying dispatch (the mixed scan
-        # of _fused_block). Small on purpose: every decode step in that
-        # dispatch sits on the new prompt's TTFT critical path, while the
-        # decoders only need "not stalled to zero" -- 2 keeps them moving
-        # at a bounded TTFT cost; the rest of the prompt rides the
-        # chunk-only tail scan.
-        self.prefill_decode_steps = max(1, int(prefill_decode_steps))
+        # of _fused_block); chunks past this count ride the chunk-only
+        # tail scan. Default: the full decode block. MEASURED (r4, axon
+        # dispatch tunnel, Poisson 2.5rps mixed 256-1536 prompts):
+        # clamping to 2 to shorten the TTFT-critical dispatch backfired
+        # -- with most prompts chunked, decode advanced only 2 steps per
+        # prefill dispatch, tpot rose 53->62ms, slots stayed occupied
+        # longer, and queue wait blew TTFT p50 711->1459ms. On dispatch-
+        # overhead-dominated links the block must keep riding along;
+        # the knob stays for direct-attached chips where dispatch is
+        # cheap and a smaller clamp genuinely trims TTFT.
+        self.prefill_decode_steps = max(1, int(
+            prefill_decode_steps if prefill_decode_steps is not None
+            else self.decode_block
+        ))
         # Chunked prefill: prompts longer than this are admitted into a
         # slot immediately and prefilled prefill_chunk tokens per step,
         # interleaved with decode blocks -- one long admission can then
@@ -766,6 +1063,26 @@ class GenerationEngine:
         # max_num_batched_tokens). A single over-budget prompt still
         # admits alone.
         self.max_prefill_tokens = max(0, int(max_prefill_tokens))
+        # Prefix (KV) cache: 0 disables. Hits restore the shared rows
+        # into the slot and prefill only the remainder through the fused
+        # chunk machinery, so a remainder chunk size exists even in
+        # whole-prompt mode.
+        self.prefix_cache = (
+            PrefixCache(prefix_block, prefix_cache_mb * (1 << 20))
+            if prefix_cache_mb > 0 else None
+        )
+        self._chunk = self.prefill_chunk or 256
+        # Self-speculative decoding (prompt-lookup drafting): k draft
+        # tokens verified per step when every active slot is greedy and
+        # logprob-free; 0 disables. See _spec_block.
+        self.speculative_k = max(0, int(speculative_k))
+        self.spec_steps = 0       # verify steps run
+        self.spec_emitted = 0     # tokens those steps produced
+        # Pallas bounded-span decode attention (ops/decode_attention.py).
+        # Single-device only: under a TP mesh the sharded cache would
+        # need a shard_map wrapper (not wired yet), so the block builder
+        # ignores the flag when a mesh is configured.
+        self.decode_attn_kernel = bool(decode_attn_kernel)
         self._backlog: List[Request] = []  # engine-thread only
         cfg = config or PRESETS[preset]
         if max_seq is not None:
@@ -831,6 +1148,13 @@ class GenerationEngine:
             self.cache_k = jnp.zeros(kvshape, dt)
             self.cache_v = jnp.zeros(kvshape, dt)
         self.lengths = np.zeros(max_slots, np.int64)  # host-side bookkeeping
+        # Token history per slot (prompt + generated), the draft source
+        # for speculative decoding; host is the source of truth and the
+        # device copy is re-uploaded per spec dispatch (128 KB at 16x2k).
+        self.hist = (
+            np.zeros((max_slots, cfg.max_seq), np.int32)
+            if self.speculative_k else None
+        )
         self.free_slots = list(range(max_slots))
         self.active: Dict[int, Request] = {}
         self.prefilling: Dict[int, Request] = {}  # slot -> mid-prefill req
@@ -854,11 +1178,13 @@ class GenerationEngine:
         prefill_jit = jax.jit(partial(_prefill, cfg))
         block_jits = {}
 
+        use_kernel = self.decode_attn_kernel and self.mesh is None
+
         def _block_fn(n, filtered, want_lp):
             def fn(w, ck, cv, toks, lens, rng, temps, top_ks, top_ps):
                 outs, ck, cv = _decode_block(
                     cfg, n, filtered, want_lp, w, ck, cv, toks, lens,
-                    rng, temps, top_ks, top_ps,
+                    rng, temps, top_ks, top_ps, kernel=use_kernel,
                 )
                 return outs, _pin(ck), _pin(cv)
             return fn
@@ -885,7 +1211,7 @@ class GenerationEngine:
                 def fn(w, ck, cv, toks, lens, ctoks, coffs, cclens,
                        cslots, rng, temps, top_ks, top_ps):
                     outs, fin, ck, cv = _fused_block(
-                        cfg, n, m, self.prefill_chunk, klen, filtered,
+                        cfg, n, m, self._chunk, klen, filtered,
                         want_lp, w, ck, cv, toks, lens, ctoks, coffs,
                         cclens, cslots, rng, temps, top_ks, top_ps,
                     )
@@ -897,11 +1223,55 @@ class GenerationEngine:
 
         self._fused_call = fused_call
 
+        spec_jits = {}
+
+        def spec_call(m, ck, cv, toks, lens, hist):
+            if m not in spec_jits:
+                def fn(w, ck, cv, toks, lens, hist):
+                    outs, counts, ck, cv = _spec_block(
+                        cfg, m, self.speculative_k, w, ck, cv, toks,
+                        lens, hist,
+                    )
+                    return outs, counts, _pin(ck), _pin(cv)
+                spec_jits[m] = jax.jit(fn, donate_argnums=(1, 2))
+            return spec_jits[m](self.weights, ck, cv, toks, lens, hist)
+
+        self._spec_call = spec_call
+
         def _insert_pinned(cache_k, cache_v, k_seq, v_seq, slots):
             ck, cv = _insert(cache_k, cache_v, k_seq, v_seq, slots)
             return _pin(ck), _pin(cv)
 
         insert_jit = jax.jit(_insert_pinned, donate_argnums=(0, 1))
+
+        # Prefix-cache device ops: extract copies a slot's leading KV
+        # rows out (NOT donated -- the live cache stays); restore
+        # scatters a stored prefix into a fresh slot. Keyed by static
+        # lengths (block multiples, so the compile count is bounded by
+        # max_seq/prefix_block).
+        extract_jits = {}
+
+        def extract_call(plen, slot):
+            if plen not in extract_jits:
+                def fn(ck, cv, s):
+                    return ck[:, s, :plen], cv[:, s, :plen]
+                extract_jits[plen] = jax.jit(fn)
+            return extract_jits[plen](self.cache_k, self.cache_v, slot)
+
+        self._extract_call = extract_call
+        restore_jits = {}
+
+        def restore_call(ck, cv, pk, pv, slot, plen):
+            key = (plen, pk.shape[1])
+            if key not in restore_jits:
+                def fn(ck, cv, pk, pv, s):
+                    ck = ck.at[:, s, :plen].set(pk[:, :plen])
+                    cv = cv.at[:, s, :plen].set(pv[:, :plen])
+                    return _pin(ck), _pin(cv)
+                restore_jits[key] = jax.jit(fn, donate_argnums=(0, 1))
+            return restore_jits[key](ck, cv, pk, pv, slot)
+
+        self._restore_call = restore_call
         sample_plain = jax.jit(lambda lg, rng, t: _sample(lg, rng, t))
         sample_filtered = jax.jit(_sample)
 
@@ -926,6 +1296,9 @@ class GenerationEngine:
         self._stop = threading.Event()
         self._wake = threading.Event()
         self.tokens_generated = 0
+        self.requests_finished = 0
+        self.ttft_hist = LatencyHistogram()
+        self.itl_hist = LatencyHistogram()
 
     # -- scheduling core ---------------------------------------------------
 
@@ -941,6 +1314,7 @@ class GenerationEngine:
                 )
             )
             return req.future
+        req.submit_t = time.perf_counter()
         self.pending.put(req)
         self._wake.set()
         return req.future
@@ -978,6 +1352,24 @@ class GenerationEngine:
                         break
                 if req.future.cancelled():
                     continue
+                if self.prefix_cache is not None:
+                    # Longest cached block-aligned prefix, capped at
+                    # len-1 so a remainder always exists to produce the
+                    # prompt-end logits (the first token's distribution).
+                    plen, entry = self.prefix_cache.lookup(
+                        req.prompt, len(req.prompt) - 1
+                    )
+                    if plen:
+                        slot = self.free_slots.pop()
+                        self.cache_k, self.cache_v = self._restore_call(
+                            self.cache_k, self.cache_v, entry["k"],
+                            entry["v"], jnp.int32(slot), plen,
+                        )
+                        req.slot = slot
+                        req.prefilled = plen
+                        self.prefilling[slot] = req
+                        took_chunked = True
+                        continue
                 if (self.prefill_chunk
                         and len(req.prompt) > self.prefill_chunk):
                     # Long prompt: claim a slot now, prefill chunk-by-
@@ -1039,7 +1431,10 @@ class GenerationEngine:
             for j, (req, slot) in enumerate(zip(reqs, slots)):
                 req.slot = slot
                 self.lengths[slot] = len(req.prompt)
+                if self.hist is not None:
+                    self.hist[slot, :len(req.prompt)] = req.prompt
                 self.active[slot] = req
+                self._maybe_capture_prefix(req)
                 if req.logprobs:
                     if logits_np is None:
                         logits_np = np.asarray(logits, np.float32)
@@ -1047,6 +1442,24 @@ class GenerationEngine:
                         logits_np[j], int(first[j]), req.logprobs
                     ))
                 self._emit(req, int(first[j]))
+
+    def _maybe_capture_prefix(self, req: Request) -> None:
+        """Donate a freshly prefilled slot's leading KV rows to the
+        prefix cache (block-multiple length). Called at prefill
+        completion, while rows [0, prompt_len) are pristine -- decode
+        for this slot hasn't run yet. The chain-hash dedupe check runs
+        first so the repeated-prefix hot path costs no device gather."""
+        pc = self.prefix_cache
+        if pc is None:
+            return
+        plen = (len(req.prompt) // pc.block) * pc.block
+        if plen < pc.block:
+            return
+        hashes = pc.chain_hashes(req.prompt, plen)
+        if hashes and hashes[-1][1] in pc.entries:
+            return
+        pk, pv = self._extract_call(plen, jnp.int32(req.slot))
+        pc.insert(req.prompt, pk, pv)
 
     def _pack_decode_lanes(self):
         """[max_slots] decode-lane arrays for the active slots; parked
@@ -1108,7 +1521,7 @@ class GenerationEngine:
         prefill_decode_steps of decode work."""
 
         items = list(self.prefilling.items())
-        c = self.prefill_chunk
+        c = self._chunk
         need = max(
             -(-(len(req.prompt) - req.prefilled) // c) for _, req in items
         )
@@ -1186,7 +1599,10 @@ class GenerationEngine:
                 ))
             del self.prefilling[slot]
             self.lengths[slot] = len(req.prompt)
+            if self.hist is not None:
+                self.hist[slot, :len(req.prompt)] = req.prompt
             self.active[slot] = req
+            self._maybe_capture_prefix(req)
             if req.logprobs:
                 if fin_np is None:
                     fin_np = np.asarray(fin_logits, np.float32)
@@ -1198,6 +1614,16 @@ class GenerationEngine:
     def _emit(self, req: Request, token: int) -> None:
         req.generated.append(token)
         self.tokens_generated += 1
+        if self.hist is not None and self.lengths[req.slot] < self.cfg.max_seq:
+            self.hist[req.slot, self.lengths[req.slot]] = token
+        now = time.perf_counter()
+        if len(req.generated) == 1:
+            self.ttft_hist.observe(now - req.submit_t)
+        else:
+            # Engine-side gap; block decode makes these bursty (the
+            # dispatch boundary carries the whole block's latency).
+            self.itl_hist.observe(now - req.last_emit_t)
+        req.last_emit_t = now
         if req.on_token is not None:
             try:
                 req.on_token(token)
@@ -1224,8 +1650,44 @@ class GenerationEngine:
         self.active.pop(slot, None)
         self.lengths[slot] = 0
         self.free_slots.append(slot)
+        self.requests_finished += 1
         if not req.future.done():
             req.future.set_result(req.generated)
+
+    def stats(self) -> dict:
+        """Scheduler-state gauges for /metrics. Called from the scrape
+        thread while the engine thread mutates the containers, so
+        snapshot them first -- iterating live would intermittently raise
+        'changed size during iteration' and blank the scrape."""
+        backlog_tokens = sum(
+            len(r.prompt) for r in list(self._backlog)
+        ) + sum(
+            len(r.prompt) - r.prefilled
+            for r in list(self.prefilling.values())
+        )
+        out = {
+            "queue_depth": self.pending.qsize() + len(self._backlog),
+            "slots_active": len(self.active),
+            "slots_prefilling": len(self.prefilling),
+            "max_slots": self.max_slots,
+            "prefill_backlog_tokens": backlog_tokens,
+            "tokens_generated": self.tokens_generated,
+            "requests_finished": self.requests_finished,
+        }
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
+        if self.speculative_k:
+            out["spec"] = {
+                "k": self.speculative_k,
+                "steps": self.spec_steps,
+                "emitted": self.spec_emitted,
+                # Accepted drafts per step / k (1.0 = every draft lands).
+                "acceptance": round(
+                    (self.spec_emitted - self.spec_steps)
+                    / (self.spec_steps * self.speculative_k), 4,
+                ) if self.spec_steps else 0.0,
+            }
+        return out
 
     def step(self) -> bool:
         """Admit pending, then run one mixed dispatch: a fused
@@ -1238,6 +1700,15 @@ class GenerationEngine:
             return True
         if not self.active:
             return False
+        if self.speculative_k and all(
+            r.temperature <= 0 and r.top_k == 0 and r.top_p >= 1.0
+            and not r.logprobs
+            for r in self.active.values()
+        ):
+            # Speculation preserves greedy outputs exactly; sampled /
+            # filtered / logprob batches take the normal block path.
+            self._spec_step()
+            return True
         # Block size: largest power-of-2 <= decode_block within every
         # slot's CACHE headroom (an out-of-range write must not happen).
         # The MIN token budget is deliberately NOT a bound: a single
@@ -1270,6 +1741,52 @@ class GenerationEngine:
         return True
 
     # -- convenience / threaded driver ------------------------------------
+
+    def _spec_step(self) -> None:
+        """One speculative dispatch: m verify steps of k drafts each
+        (_spec_block). Emission mirrors _emit_decode_outs -- tokens in
+        step order, overshoot discarded when a slot finishes."""
+        k = self.speculative_k
+        remaining = min(
+            self.cfg.max_seq - int(self.lengths[slot])
+            for slot in self.active
+        )
+        budget = max(
+            req.max_new_tokens - len(req.generated)
+            for req in self.active.values()
+        )
+        # Steps are pow2-bounded like decode blocks; each step emits
+        # 1..k+1 tokens, so headroom divides by the worst-case growth
+        # and the budget bound uses the guaranteed-minimum 1/step.
+        m = 1
+        while m * 2 <= min(self.decode_block,
+                           max(remaining // (k + 1), 1),
+                           max(budget, 1)):
+            m *= 2
+        tokens = np.zeros(self.max_slots, np.int32)
+        lens = np.full(self.max_slots, self.cfg.max_seq, np.int32)
+        for slot, req in self.active.items():
+            tokens[slot] = req.generated[-1]
+            lens[slot] = max(int(self.lengths[slot]), 1)
+        outs, counts, self.cache_k, self.cache_v = self._spec_call(
+            m, self.cache_k, self.cache_v, jnp.asarray(tokens),
+            jnp.asarray(lens), jnp.asarray(self.hist),
+        )
+        outs = np.asarray(outs)      # [m, B, k+1]
+        counts = np.asarray(counts)  # [m, B]
+        for slot in list(self.active):
+            req = self.active[slot]
+            self.spec_steps += m
+            self.spec_emitted += int(counts[:, slot].sum())
+            done = False
+            for si in range(m):
+                for t in range(int(counts[si, slot])):
+                    self._emit(req, int(outs[si, slot, t]))
+                    if slot not in self.active:
+                        done = True  # finished: drop overshoot
+                        break
+                if done:
+                    break
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 64,
                  temperature: float = 0.0,
@@ -1319,8 +1836,13 @@ class GenerationEngine:
         self.weights = None
         self.cache_k = None
         self.cache_v = None
+        self.prefix_cache = None  # stored prefix buffers are HBM too
         self._decode_block_call = None
         self._fused_call = None
         self._prefill = None
         self._insert = None
         self._sample = None
+        self._extract_call = None
+        self._restore_call = None
+        self._spec_call = None
+        self.hist = None

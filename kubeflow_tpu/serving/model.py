@@ -115,6 +115,11 @@ class Model:
     def render_chat(self, messages) -> Optional[str]:
         return None
 
+    # Prometheus exposition lines for /metrics (already formatted;
+    # engine-bearing runtimes expose queue/slot/latency internals).
+    def prom_metrics(self) -> List[str]:
+        return []
+
 
 class Batcher:
     """Coalesce concurrent single-instance predicts into batched calls.

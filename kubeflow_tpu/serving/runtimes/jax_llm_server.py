@@ -22,6 +22,11 @@ Options (ModelSpec.options):
 - ``max_prefill_tokens``: padded-token budget for one batched prefill
   program (bounds the K x S^2 fp32 attention-score memory; overflow
   prefills next step). Default 8192.
+- ``prefix_cache_mb``: device-memory budget (MiB) for exact-match
+  prompt-prefix KV reuse (0 = off). Repeated system prompts / chat
+  histories restore their shared prefix instead of re-prefilling.
+- ``prefix_block``: prefix-cache hash-block granularity (default 128
+  tokens; reuse lengths are multiples of this).
 - ``max_seq``: override cache length
 - ``tokenizer``: "byte" (default; ids = utf-8 bytes, self-contained) or a
   HF tokenizer name resolved from the local cache only (zero egress)
@@ -250,6 +255,11 @@ class JaxLLMModel(Model):
             decode_block=int(opts.get("decode_block", 8)),
             prefill_chunk=int(opts.get("prefill_chunk", 0)),
             max_prefill_tokens=int(opts.get("max_prefill_tokens", 8192)),
+            prefix_cache_mb=int(opts.get("prefix_cache_mb", 0)),
+            prefix_block=int(opts.get("prefix_block", 128)),
+            prefill_decode_steps=opts.get("prefill_decode_steps"),
+            speculative_k=int(opts.get("speculative_k", 0)),
+            decode_attn_kernel=bool(opts.get("decode_attn_kernel", False)),
             mesh=mesh,
         )
         if config is not None:
@@ -293,6 +303,59 @@ class JaxLLMModel(Model):
 
     def render_chat(self, messages) -> Optional[str]:
         return self.tokenizer.chat_prompt(messages)
+
+    def prom_metrics(self) -> List[str]:
+        """Engine observability (SURVEY.md 5.5): scheduler gauges +
+        TTFT/ITL histograms, per model."""
+        if self.engine is None:
+            return []
+        # Prometheus exposition label escaping: a dynamically admitted
+        # model name with a quote/backslash/newline must not corrupt the
+        # whole scrape.
+        esc = (str(self.name).replace("\\", "\\\\")
+               .replace('"', '\\"').replace("\n", "\\n"))
+        lab = f'model="{esc}"'
+        s = self.engine.stats()
+        lines = [
+            f"kftpu_engine_queue_depth{{{lab}}} {s['queue_depth']}",
+            f"kftpu_engine_slots_active{{{lab}}} {s['slots_active']}",
+            f"kftpu_engine_slots_prefilling{{{lab}}} "
+            f"{s['slots_prefilling']}",
+            f"kftpu_engine_max_slots{{{lab}}} {s['max_slots']}",
+            f"kftpu_engine_prefill_backlog_tokens{{{lab}}} "
+            f"{s['prefill_backlog_tokens']}",
+            f"kftpu_engine_tokens_generated_total{{{lab}}} "
+            f"{s['tokens_generated']}",
+            f"kftpu_engine_requests_finished_total{{{lab}}} "
+            f"{s['requests_finished']}",
+        ]
+        sp = s.get("spec")
+        if sp is not None:
+            lines += [
+                f"kftpu_engine_spec_steps_total{{{lab}}} {sp['steps']}",
+                f"kftpu_engine_spec_tokens_total{{{lab}}} "
+                f"{sp['emitted']}",
+                f"kftpu_engine_spec_acceptance{{{lab}}} "
+                f"{sp['acceptance']}",
+            ]
+        pc = s.get("prefix_cache")
+        if pc is not None:
+            lines += [
+                f"kftpu_engine_prefix_cache_entries{{{lab}}} "
+                f"{pc['entries']}",
+                f"kftpu_engine_prefix_cache_bytes{{{lab}}} {pc['bytes']}",
+                f"kftpu_engine_prefix_cache_hits_total{{{lab}}} "
+                f"{pc['hits']}",
+                f"kftpu_engine_prefix_cache_misses_total{{{lab}}} "
+                f"{pc['misses']}",
+            ]
+        lines += self.engine.ttft_hist.prom_lines(
+            "kftpu_engine_ttft_seconds", lab
+        )
+        lines += self.engine.itl_hist.prom_lines(
+            "kftpu_engine_itl_seconds", lab
+        )
+        return lines
 
     def _build_request(self, inst: dict, ids: List[int], on_token=None):
         from kubeflow_tpu.serving.engine import Request

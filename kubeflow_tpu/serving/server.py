@@ -141,6 +141,12 @@ class ModelServer:
             f"kftpu_server_errors_total {self.error_count}",
             f"kftpu_server_predict_seconds_total {self.predict_seconds:.6f}",
         ]
+        for name in self.repository.names():
+            try:
+                lines += self.repository.get(name).prom_metrics()
+            except Exception:  # noqa: BLE001 - one model's metrics
+                logger.exception(  # failure must not break the scrape
+                    "prom_metrics failed for %s", name)
         return web.Response(text="\n".join(lines) + "\n")
 
     # -- V1 ----------------------------------------------------------------

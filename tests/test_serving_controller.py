@@ -266,6 +266,32 @@ def test_jax_llm_isvc_end_to_end(cp_client):
         texts = [json.loads(c)["choices"][0]["text"] for c in chunks[:-1]]
         assert "".join(texts) == body["choices"][0]["text"]
 
+        # Serving observability (SURVEY.md 5.5): after the load above,
+        # the ISVC dashboard drill-down scrapes each replica's /metrics
+        # and shows engine gauges + latency histograms with real counts.
+        r = await client.get("/dashboard/isvc/default/llm")
+        assert r.status == 200
+        import html as _html
+
+        page = _html.unescape(await r.text())
+        assert "kftpu_engine_slots_active" in page
+        assert "kftpu_engine_max_slots" in page
+        assert "kftpu_engine_prefill_backlog_tokens" in page
+        assert "kftpu_engine_ttft_seconds_count" in page
+        assert "kftpu_engine_itl_seconds_bucket" in page
+        # 6+ requests ran against the engine; the TTFT histogram saw them.
+        import re as _re
+
+        m = _re.search(
+            r'kftpu_engine_ttft_seconds_count\{model="llm"\} (\d+)', page
+        )
+        assert m is not None and int(m.group(1)) >= 5, page[-2000:]
+        m = _re.search(
+            r'kftpu_engine_tokens_generated_total\{model="llm"\} (\d+)',
+            page,
+        )
+        assert m is not None and int(m.group(1)) >= 19
+
     loop.run_until_complete(run())
 
 

@@ -641,3 +641,165 @@ class TestStopAndLogprobs:
         assert req.logprob_data[0]["logprob"] == pytest.approx(
             req2.logprob_data[0]["logprob"], abs=3e-2
         )
+
+
+class TestPrefixCache:
+    def test_cached_and_cold_paths_token_exact(self, tiny):
+        """Prefix-cache hits must not change a single token: two prompts
+        sharing a long prefix produce identical outputs on a cold engine
+        and on one that restores the shared prefix from cache."""
+        cfg, _, _, params = tiny
+        cold = GenerationEngine(config=cfg, params=params, max_slots=2)
+        shared = list(range(1, 25))  # 24 tokens = 3 blocks of 8
+        p1 = shared + [40, 41, 42]
+        p2 = shared + [50, 51]
+        ref1 = cold.generate(p1, max_new_tokens=6)
+        ref2 = cold.generate(p2, max_new_tokens=6)
+
+        eng = GenerationEngine(config=cfg, params=params, max_slots=2,
+                               prefix_cache_mb=16, prefix_block=8)
+        assert eng.generate(p1, max_new_tokens=6) == ref1  # cold: captures
+        assert eng.prefix_cache.stats()["entries"] == 1
+        assert eng.generate(p2, max_new_tokens=6) == ref2  # prefix hit
+        assert eng.prefix_cache.hits >= 1
+        # The identical prompt again: capped at len-1, still a hit, still
+        # token-exact.
+        hits_before = eng.prefix_cache.hits
+        assert eng.generate(p1, max_new_tokens=6) == ref1
+        assert eng.prefix_cache.hits > hits_before
+
+    def test_capture_deduped_and_growing_prefix_recaptured(self, tiny):
+        cfg, _, _, params = tiny
+        eng = GenerationEngine(config=cfg, params=params, max_slots=2,
+                               prefix_cache_mb=16, prefix_block=8)
+        p = list(range(1, 20))  # 19 tokens -> capture 16
+        eng.generate(p, max_new_tokens=2)
+        eng.generate(p, max_new_tokens=2)  # same capture hash: deduped
+        assert eng.prefix_cache.stats()["entries"] == 1
+        # A longer prompt sharing the prefix captures its own entry.
+        eng.generate(p + list(range(100, 120)), max_new_tokens=2)
+        assert eng.prefix_cache.stats()["entries"] == 2
+
+    def test_short_prompts_bypass_cache(self, tiny):
+        cfg, _, _, params = tiny
+        eng = GenerationEngine(config=cfg, params=params, max_slots=2,
+                               prefix_cache_mb=16, prefix_block=8)
+        out = eng.generate([1, 2, 3], max_new_tokens=3)  # < one block
+        assert len(out) == 3
+        assert eng.prefix_cache.stats()["entries"] == 0
+
+    def test_unit_lru_eviction_by_bytes(self):
+        from kubeflow_tpu.serving.engine import PrefixCache
+
+        # One entry = k + v = 2 x (1*4*1*8 f32) = 256 B; room for two.
+        pc = PrefixCache(block=4, capacity_bytes=512)
+        k = lambda: np.zeros((1, 4, 1, 8), np.float32)
+
+        pc.insert([1, 2, 3, 4], k(), k())
+        pc.insert([5, 6, 7, 8], k(), k())
+        assert pc.stats()["entries"] == 2
+        # Touch the first so the second is LRU, then overflow.
+        assert pc.lookup([1, 2, 3, 4, 9], 4)[0] == 4
+        pc.insert([9, 10, 11, 12], k(), k())
+        assert pc.stats()["entries"] == 2
+        assert pc.lookup([1, 2, 3, 4, 9], 4)[0] == 4      # survivor
+        assert pc.lookup([5, 6, 7, 8, 9], 4)[0] == 0      # evicted
+        assert pc.lookup([9, 10, 11, 12, 13], 4)[0] == 4  # newest
+
+    def test_oversized_entry_rejected(self):
+        from kubeflow_tpu.serving.engine import PrefixCache
+
+        pc = PrefixCache(block=4, capacity_bytes=64)
+        pc.insert([1, 2, 3, 4], np.zeros((1, 4, 1, 8), np.float32),
+                  np.zeros((1, 4, 1, 8), np.float32))  # 256 B > 64
+        assert pc.stats()["entries"] == 0
+
+
+class TestSpeculativeDecoding:
+    def test_greedy_exact_match_repetitive_and_random(self, tiny):
+        """Speculation must preserve greedy outputs token-for-token --
+        acceptance only changes speed. A repetitive prompt exercises the
+        n-gram lookup hit path; a random-ish one the all-rejected path."""
+        cfg, _, _, params = tiny
+        plain = GenerationEngine(config=cfg, params=params, max_slots=2)
+        spec = GenerationEngine(config=cfg, params=params, max_slots=2,
+                                speculative_k=4)
+        for prompt in ([1, 2, 3] * 12, [9, 71, 23, 5, 40, 8, 61]):
+            assert spec.generate(list(prompt), max_new_tokens=12) == \
+                plain.generate(list(prompt), max_new_tokens=12)
+        assert spec.spec_steps > 0
+        # Every step emits at least the bonus token.
+        assert spec.spec_emitted >= spec.spec_steps
+
+    def test_concurrent_slots_match_solo(self, tiny):
+        cfg, _, _, params = tiny
+        plain = GenerationEngine(config=cfg, params=params, max_slots=4)
+        expected = {
+            i: plain.generate([1 + i, 2 + i] * 6, max_new_tokens=6 + i)
+            for i in range(3)
+        }
+        spec = GenerationEngine(config=cfg, params=params, max_slots=4,
+                                speculative_k=3)
+        futs = [
+            spec.submit(Request([1 + i, 2 + i] * 6, max_new_tokens=6 + i))
+            for i in range(3)
+        ]
+        while any(not f.done() for f in futs):
+            spec.step()
+        for i, f in enumerate(futs):
+            assert f.result() == expected[i]
+
+    def test_sampled_requests_fall_back_to_block_path(self, tiny):
+        cfg, _, _, params = tiny
+        spec = GenerationEngine(config=cfg, params=params, max_slots=2,
+                                speculative_k=4)
+        out = spec.generate([1, 2, 3], max_new_tokens=6, temperature=1.0)
+        assert len(out) == 6
+        assert spec.spec_steps == 0  # sampled batch: never speculated
+
+    def test_spec_stats_exposed(self, tiny):
+        cfg, _, _, params = tiny
+        spec = GenerationEngine(config=cfg, params=params, max_slots=2,
+                                speculative_k=4)
+        spec.generate([4, 5] * 8, max_new_tokens=8)
+        s = spec.stats()["spec"]
+        assert s["k"] == 4 and s["steps"] > 0
+        assert 0.0 <= s["acceptance"] <= 1.0
+
+
+class TestDecodeAttentionKernel:
+    def test_kernel_matches_reference(self):
+        """ops.decode_attention (interpret mode on CPU) == full masked
+        softmax over the live span, across blocks/heads/positions."""
+        from kubeflow_tpu.ops.decode_attention import decode_attention
+
+        rng = np.random.default_rng(0)
+        B, SMAX, KV, G, D = 3, 256, 2, 2, 64
+        q = jnp.asarray(rng.standard_normal((B, KV, G, D)), jnp.float32)
+        ck = jnp.asarray(rng.standard_normal((B, SMAX, KV, D)), jnp.float32)
+        cv = jnp.asarray(rng.standard_normal((B, SMAX, KV, D)), jnp.float32)
+        pos = jnp.asarray([5, 100, 255], jnp.int32)
+        out = np.asarray(decode_attention(q, ck, cv, pos, block=128,
+                                          interpret=True))
+        for b in range(B):
+            for kv in range(KV):
+                for g in range(G):
+                    s = (np.asarray(ck[b, :, kv]) @ np.asarray(q[b, kv, g]))
+                    s = s / np.sqrt(D)
+                    s[np.arange(SMAX) > int(pos[b])] = -np.inf
+                    p = np.exp(s - s.max())
+                    p /= p.sum()
+                    ref = p @ np.asarray(cv[b, :, kv])
+                    np.testing.assert_allclose(out[b, kv, g], ref,
+                                               atol=1e-5, rtol=1e-5)
+
+    def test_engine_tokens_identical_with_kernel(self, tiny):
+        """The kernelized decode path must not change a token vs the XLA
+        full-span path (greedy, f32)."""
+        cfg, _, _, params = tiny
+        plain = GenerationEngine(config=cfg, params=params, max_slots=2)
+        kern = GenerationEngine(config=cfg, params=params, max_slots=2,
+                                decode_attn_kernel=True)
+        for prompt in ([1, 2, 3], list(range(1, 40))):
+            assert kern.generate(list(prompt), max_new_tokens=10) == \
+                plain.generate(list(prompt), max_new_tokens=10)
