@@ -15,9 +15,15 @@ Methodology (BASELINE.md: north star is tokens/sec/chip at 8B scale):
 - Roofline at seq 1024 (~67% MFU), measured 2026-07-30: batch 6 fits
   but REGRESSES to 63.6% (allocator pressure), batch 7 OOMs, and
   remat=False OOMs even at batch 3 -- so the dots-remat backward
-  recompute is mandatory and its recompute plus the fp32 softmax/CE and
-  adafactor elementwise passes are the non-MXU residual. The remaining
-  gap is not batch-size-addressable on one 16 GiB chip.
+  recompute is mandatory. PROFILED 2026-07-31 (profile_train.py ->
+  PROFILE.json, jax.profiler trace committed under profiles/): MXU
+  matmul fusions are 77.3% of device-op time (so they run at ~87% of
+  their own roofline incl. remat recompute), elementwise loop fusions
+  10.8%, Pallas flash attention 4.9%, optax adafactor+global-norm-clip
+  passes ~8%. No single residual item exceeds ~8%; the plateau is the
+  sum of small costs, not a missing optimization. (The same profile
+  shows scan_layers is a 47% step-time WIN over unrolled layers, not
+  just a compile-time convenience.)
 - Sweep configs are measured optima too: at 2048, b3+loss_chunk hits
   62.3% (< b2's 64.4%; the chunked-CE recompute isn't free) and b4
   OOMs; at 4096, b2 needs chunk+minimal-remat and lands at 54.3%

@@ -24,6 +24,22 @@ masked-until-overwritten invariant, which this mask re-implements.
 
 Numerics match ops.attention/xla paths: f32 scores and softmax
 accumulation, output cast to the cache dtype.
+
+MEASURED (2026-07-31, v5e, llama3-8b-proxy, 16 slots, decode_block=32,
+Smax=2048, engine A/B via decode_attn_kernel): correctness exact to bf16
+(max diff 1 ulp vs XLA full-span), but throughput is PARITY at short
+contexts (622 vs 616 tok/s at 128-token prompts, where the span bound
+saves ~90% of cache reads) and 9% WORSE at 1024-token prompts (439 vs
+483). Why: on this proxy the full-span cache read is only ~19% of a
+decode step's HBM traffic (weights dominate at ~4.5 GB/step vs ~1.1 GB
+cache), capping the theoretical win at ~17%; the kernel's single-
+buffered DMA (no fetch/compute overlap), per-KV-head narrow [G, D]
+matmuls, and pallas_call overhead inside the layer scan consume that
+margin. The engine therefore keeps full-span XLA as the default
+(decode_attn_kernel=False); the kernel stays as the correct bounded-span
+implementation, and double-buffering + head-batched matmuls are the
+known path if a config with a larger cache:weights ratio (more slots,
+longer Smax, smaller model) makes the span bound matter.
 """
 
 from __future__ import annotations
@@ -64,30 +80,35 @@ def _kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref,
         cv.wait()
         kblk = k_vmem[...].astype(jnp.float32)  # [block, KV, D]
         vblk = v_vmem[...].astype(jnp.float32)
-        # scores [KV, G, block]: contract D per KV head. HIGHEST keeps
-        # f32 operands exact (the default would downcast them to bf16);
-        # production bf16 caches are unaffected.
-        s = jax.lax.dot_general(
-            q, kblk,
-            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        ) * scale
-        idx = j * block + jax.lax.broadcasted_iota(
-            jnp.int32, (kv_heads, g, block), 2
-        )
-        s = jnp.where(idx < span, s, -jnp.inf)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                  # [KV, G, block]
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p, vblk,
-            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )                                       # [KV, G, D]
-        return m_new, l_new, acc * alpha + pv
+        mask = j * block + jax.lax.broadcasted_iota(
+            jnp.int32, (g, block), 1
+        ) < span
+        # Per-KV-head 2D matmuls, python-unrolled: Mosaic rejects the
+        # batched dot_general form ("batch dims must be equal").
+        # HIGHEST keeps f32 operands exact (the default would downcast
+        # them to bf16); production bf16 caches are unaffected.
+        ms, ls, accs = [], [], []
+        for kv in range(kv_heads):
+            s = jax.lax.dot_general(
+                q[kv], kblk[:, kv, :],              # [G,D] x [block,D]
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            ) * scale                               # [G, block]
+            s = jnp.where(mask, s, -jnp.inf)
+            m_new = jnp.maximum(m[kv], s.max(axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m[kv] - m_new)
+            ls.append(l[kv] * alpha + p.sum(axis=-1, keepdims=True))
+            pv = jax.lax.dot_general(
+                p, vblk[:, kv, :],                  # [G,block] x [block,D]
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )                                       # [G, D]
+            ms.append(m_new)
+            accs.append(acc[kv] * alpha + pv)
+        return jnp.stack(ms), jnp.stack(ls), jnp.stack(accs)
 
     m0 = jnp.full((kv_heads, g, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((kv_heads, g, 1), jnp.float32)

@@ -210,9 +210,13 @@ class PipelineController:
             if not all(dep_done(d) for d in deps):
                 continue  # waiting on dependencies
             if cfg.when is not None:
-                rendered = render_step_template(
-                    cfg.when, pl.spec.parameters, pl.status.step_outputs
-                )
+                if self._refs_pending_step(cfg.when, pl, by_name):
+                    # The expression reads a step output that does not
+                    # exist yet (reference without a declared dep):
+                    # evaluating the literal placeholder would silently
+                    # skip -- wait for the referenced step instead.
+                    continue
+                rendered = self._render_when(pl, cfg.when)
                 try:
                     met = eval_when(rendered)
                 except PipelineValidationError as e:
@@ -233,6 +237,10 @@ class PipelineController:
                     running, limit,
                 )
                 continue
+            if isinstance(cfg.with_items, str) and self._refs_pending_step(
+                cfg.with_items, pl, by_name
+            ):
+                continue  # dynamic fan-out source not produced yet
             try:
                 items = self._resolve_items(pl, cfg)
             except PipelineValidationError as e:
@@ -242,6 +250,23 @@ class PipelineController:
                 )
                 continue
             units = expansion_names(step, len(items))
+            # Re-apply with a NARROWER with_items: expansions past the
+            # new width would otherwise sit 'Running' in step_phases
+            # forever, counting against max_parallel_steps. Drop their
+            # phases and their child jobs.
+            for k in list(phases):
+                base, sep, idx = k.rpartition("-")
+                if (sep and base == step and idx.isdigit()
+                        and int(idx) >= len(items)):
+                    del phases[k]
+                    stale = self._get_child_job(ns, self._job_name(name, k))
+                    if stale is not None and stale.get(
+                        "metadata", {}
+                    ).get("labels", {}).get(PIPELINE_LABEL) == name:
+                        self.store.delete(
+                            stale.get("kind", "JAXJob"),
+                            self._job_name(name, k), ns,
+                        )
             for unit, item in zip(units, items):
                 phases[unit], running = self._advance_unit(
                     pl, cfg, unit, item_mapping(item),
@@ -382,6 +407,44 @@ class PipelineController:
                 return "Pending", max(0, running - was_running)
             return "Failed", max(0, running - was_running)
         return "Running", running + (0 if phase == "Running" else 1)
+
+    @staticmethod
+    def _refs_pending_step(expr: str, pl: Pipeline, by_name) -> bool:
+        """True if ``expr`` reads ``${steps.X.output}`` for a DAG step X
+        whose output does not exist yet -- the caller must wait instead
+        of evaluating a literal placeholder (a reference the author
+        forgot to also declare as a dependency)."""
+        import re as _re
+
+        for m in _re.finditer(r"\$\{steps\.([^.}]+)\.output\}", expr):
+            name = m.group(1)
+            if name in by_name and name not in pl.status.step_outputs:
+                return True
+        return False
+
+    @staticmethod
+    def _render_when(pl: Pipeline, expr: str) -> str:
+        """Substitute parameters/outputs into a ``when`` expression with
+        string-literal ESCAPING: an output like ``x' == 'x' or 'y`` must
+        not be able to escape its quotes and rewrite the condition's
+        logic (the AST walker already blocks code execution; this blocks
+        boolean injection through quoted operands). Unquoted numeric
+        usage is unaffected -- digits escape to themselves."""
+        from kubeflow_tpu.utils.templating import substitute
+
+        def esc(v) -> str:
+            return (str(v).replace("\\", "\\\\").replace("'", "\\'")
+                    .replace('"', '\\"').replace("\n", "\\n"))
+
+        mapping = {
+            "${pipelineParameters." + n + "}": esc(v)
+            for n, v in pl.spec.parameters.items()
+        }
+        mapping.update({
+            "${steps." + n + ".output}": esc(v)
+            for n, v in pl.status.step_outputs.items()
+        })
+        return substitute(expr, mapping)
 
     def _resolve_items(self, pl: Pipeline, cfg) -> list:
         """Concrete fan-out items: a static list passes through; a string

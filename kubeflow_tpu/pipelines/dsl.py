@@ -56,13 +56,26 @@ class _PipelineContext:
         return name
 
     def decorate(self, spec: dict) -> None:
-        """Attach the active condition()/for_each() context to a step."""
+        """Attach the active condition()/for_each() context to a step.
+        ``${steps.X.output}`` references inside the condition or the
+        items string become REAL dependencies -- without them the
+        controller would evaluate the expression before X finishes and
+        skip/fail the step on the unresolved literal."""
+        extra: dict = {}
         if self.when_stack:
             spec["when"] = " and ".join(
                 f"({w})" for w in self.when_stack
             )
+            extra["when"] = spec["when"]
         if self.items is not None:
             spec["with_items"] = self.items
+            if isinstance(self.items, str):
+                extra["items"] = self.items
+        if extra:
+            deps = spec.setdefault("dependencies", [])
+            for d in _auto_deps(extra):
+                if d not in deps:
+                    deps.append(d)
 
 
 class Step:

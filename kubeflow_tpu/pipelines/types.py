@@ -230,10 +230,12 @@ def eval_when(expr: str) -> bool:
 
     Grammar: literals (strings, numbers, True/False), comparisons
     (== != < <= > >= in), and/or/not, parentheses, lists. Interpreted by
-    walking the AST -- no eval(), no names, no calls, so a template that
-    substitutes hostile step output into the expression can at worst
-    fail to parse. Numeric-looking strings compare as written (quote
-    operands: "'${steps.x.output}' == 'ok'").
+    walking the AST -- no eval(), no names, no calls, so substituted
+    content can never execute code. The CONTROLLER additionally escapes
+    quotes/backslashes in substituted outputs before this runs, so a
+    hostile output can't break out of a quoted operand and rewrite the
+    boolean logic either. Numeric-looking strings compare as written
+    (quote string operands: "'${steps.x.output}' == 'ok'").
     """
     import ast
 

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Profile the bench training step on the real chip (SURVEY.md 6).
+
+Three rounds of OOM/batch sweeps said the ~67% MFU plateau is "not
+batch-size-addressable"; this is the trace that replaces that inference
+with numbers. Runs the exact bench.py headline config (llama3-8b-proxy,
+batch 5, seq 1024, adafactor, remat, flash attention), captures a
+jax.profiler trace over steady-state steps, and aggregates device-op
+time into a breakdown: MXU matmuls vs everything else (remat recompute
+rides inside the fusions that contain the backward dots; the residual
+buckets below are the addressable part).
+
+Artifacts:
+- PROFILE.json          aggregated breakdown + top ops (committed)
+- profiles/train/...    the raw trace (tensorboard-loadable)
+
+Run: python profile_train.py   (on the TPU dev box)
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.expanduser("~/.cache/kftpu-xla")
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TRACE_DIR = os.path.join(HERE, "profiles", "train")
+BATCH = int(os.environ.get("BENCH_BATCH", "5"))
+SEQ = int(os.environ.get("BENCH_SEQ", "1024"))
+TRACE_STEPS = int(os.environ.get("PROFILE_STEPS", "3"))
+
+
+def capture(trace_dir: str, unroll: bool) -> float:
+    import jax
+
+    from kubeflow_tpu.models import get_task
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    # unroll=True profiles with scan_layers=False: identical math, but
+    # the layer stack's ops stop hiding inside one opaque while.N event,
+    # so the breakdown attributes time per op class. The scan pass keeps
+    # the production program shape for the step-time ground truth.
+    task = get_task(
+        "llama", preset=os.environ.get("BENCH_PRESET", "llama3-8b-proxy"),
+        batch_size=BATCH, seq_len=SEQ, optimizer="adafactor",
+        **({"scan_layers": False} if unroll else {}),
+    )
+    mesh = build_mesh(MeshConfig(data=-1))
+    with mesh:
+        state = task.init_state(jax.random.PRNGKey(0), mesh)
+        step = task.train_step_fn(mesh)
+        it = task.data_iter(1, 0, mesh)
+        batches = [next(it) for _ in range(TRACE_STEPS + 2)]
+        for b in batches[:2]:
+            state, m = step(state, *b)
+        float(m["loss"])  # transfer = real sync on axon
+        import time
+
+        t0 = time.perf_counter()
+        with jax.profiler.trace(trace_dir):
+            for b in batches[2:]:
+                state, m = step(state, *b)
+            float(m["loss"])
+        dt = (time.perf_counter() - t0) / TRACE_STEPS
+    import gc
+
+    del state, step, batches, task
+    gc.collect()
+    return dt
+
+
+def aggregate(trace_dir: str) -> dict:
+    """Device-op time by XLA ``hlo_category`` (authoritative: the trace
+    tags every op -- "convolution fusion" is the MXU matmul bucket) and
+    by PYTHON SOURCE LINE (the trace's op provenance; optax lines are
+    the optimizer passes, llama.py lines the model)."""
+    files = sorted(glob.glob(
+        os.path.join(trace_dir, "plugins", "profile", "*",
+                     "*.trace.json.gz")
+    ))
+    if not files:
+        raise SystemExit(f"no trace under {trace_dir}")
+    with gzip.open(files[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    by_cat = defaultdict(float)
+    by_src = defaultdict(float)
+    by_op = defaultdict(float)
+    for ev in events:
+        args = ev.get("args") or {}
+        if ev.get("ph") != "X" or "hlo_category" not in args:
+            continue
+        # Control-flow containers (the layer scan's while) span their
+        # body ops, which are traced separately -- counting both would
+        # double the scanned portion.
+        if args["hlo_category"] in ("while", "conditional"):
+            continue
+        dur = float(ev.get("dur", 0.0))  # us
+        by_cat[args["hlo_category"]] += dur
+        src = str(args.get("source", "")) or "(no source)"
+        by_src[src] += dur
+        by_op[ev.get("name", "")] += dur
+    total = sum(by_cat.values()) or 1.0
+    top = sorted(by_op.items(), key=lambda kv: -kv[1])[:20]
+    return {
+        "device_total_us": round(total, 1),
+        "by_hlo_category_pct": {
+            k: round(100.0 * v / total, 2)
+            for k, v in sorted(by_cat.items(), key=lambda kv: -kv[1])
+            if v / total >= 0.0005
+        },
+        "by_source_pct": {
+            k: round(100.0 * v / total, 2)
+            for k, v in sorted(by_src.items(), key=lambda kv: -kv[1])[:15]
+        },
+        "top_ops": [
+            {"op": n, "us": round(us, 1),
+             "pct": round(100.0 * us / total, 2)}
+            for n, us in top
+        ],
+        "trace_file": os.path.relpath(files[-1], HERE),
+    }
+
+
+def main() -> int:
+    sys.path.insert(0, HERE)
+    scan_dir = os.path.join(TRACE_DIR, "scan")
+    unroll_dir = os.path.join(TRACE_DIR, "unrolled")
+    step_s = capture(scan_dir, unroll=False)
+    scan = aggregate(scan_dir)
+    unroll_s = capture(unroll_dir, unroll=True)
+    unrolled = aggregate(unroll_dir)
+    out = {
+        "config": {"batch": BATCH, "seq": SEQ, "steps": TRACE_STEPS,
+                   "preset": "llama3-8b-proxy", "optimizer": "adafactor"},
+        "step_time_ms": round(step_s * 1e3, 1),
+        "scan": scan,
+        "unrolled_step_time_ms": round(unroll_s * 1e3, 1),
+        "unrolled": unrolled,
+        "note": "device-op time over traced steady-state steps; buckets "
+                "by XLA op-name heuristics. The production program scans "
+                "layers (opaque while.N in 'scan'); the 'unrolled' pass "
+                "(scan_layers=False, identical math) attributes the "
+                "layer-stack time per op class. 'matmul (MXU)' includes "
+                "the remat-recomputed backward dots.",
+    }
+    print(json.dumps(out, indent=1))
+    with open(os.path.join(HERE, "PROFILE.json"), "w") as f:
+        json.dump(out, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

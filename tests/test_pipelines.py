@@ -782,3 +782,103 @@ def test_fanout_does_not_double_count_parallel_limit(tmp_path):
                          msg=str(h.pipeline()))
 
     asyncio.run(run())
+
+
+def test_when_waits_for_referenced_output_without_declared_dep(tmp_path):
+    """The dsl.condition docstring shape: a when= reading a step output
+    the author did not also declare as a dependency must WAIT for that
+    step, not evaluate the literal placeholder to a permanent skip."""
+
+    async def run():
+        async with PipelineHarness(tmp_path) as h:
+            gate = step("gate",
+                        script="import time\ntime.sleep(1.5)\nv='go'",
+                        out="v")
+            act = step("act", script="v = 'ran'", out="v")
+            act["when"] = "'${steps.gate.output}' == 'go'"
+            # Deliberately NO dependency on gate.
+            h.store.put("Pipeline", pipeline_obj(steps=[gate, act]))
+            await h.wait(lambda: h.phase() == "Succeeded", timeout=45,
+                         msg=str(h.pipeline()))
+            st = h.pipeline()["status"]
+            assert st["step_phases"]["act"] == "Succeeded"
+            assert st["step_outputs"]["act"] == "ran"
+
+    asyncio.run(run())
+
+
+def test_when_injection_via_output_is_inert(tmp_path):
+    """A hostile upstream output must not rewrite the condition's
+    boolean logic by escaping its quoted operand."""
+
+    async def run():
+        async with PipelineHarness(tmp_path) as h:
+            evil = step(
+                "evil",
+                script="v = \"x' == 'x' or 'y\"", out="v",
+            )
+            guarded = step("guarded", deps=["evil"], script="v = 1",
+                           out="v")
+            guarded["when"] = "'${steps.evil.output}' == 'deploy'"
+            h.store.put("Pipeline", pipeline_obj(steps=[evil, guarded]))
+            await h.wait(lambda: h.phase() == "Succeeded", timeout=45,
+                         msg=str(h.pipeline()))
+            st = h.pipeline()["status"]
+            assert st["step_phases"]["guarded"] == "Skipped"
+            assert st["step_skip_reasons"]["guarded"] == "ConditionNotMet"
+
+    asyncio.run(run())
+
+
+def test_dsl_condition_and_dynamic_items_add_deps(tmp_path):
+    @dsl.component
+    def gen() -> str:
+        return "[1]"
+
+    @dsl.component
+    def use(x: str) -> str:
+        return x
+
+    @dsl.pipeline(name="autodep")
+    def p():
+        g = gen()
+        with dsl.condition(f"'{g.output}' != ''"):
+            use(x="fixed")
+        with dsl.for_each(g.output) as item:
+            use(x=item)
+
+    spec = p()
+    steps = {s["name"]: s for s in spec["spec"]["steps"]}
+    assert steps["use"]["dependencies"] == ["gen"]
+    assert steps["use-2"]["dependencies"] == ["gen"]
+
+
+def test_shrinking_with_items_cleans_orphan_expansions(tmp_path):
+    """Re-applying with a narrower with_items must drop the orphaned
+    expansions' phases and jobs instead of counting them against
+    max_parallel_steps forever."""
+
+    async def run():
+        async with PipelineHarness(tmp_path) as h:
+            fan = step("fan", script="import time\ntime.sleep(3)\nv=1",
+                       out="v")
+            fan["with_items"] = [1, 2]
+            h.store.put("Pipeline", pipeline_obj(
+                steps=[fan], max_parallel_steps=2))
+            await h.wait(
+                lambda: (h.pipeline() or {}).get("status", {})
+                .get("step_phases", {}).get("fan-1") == "Running",
+                timeout=20, msg="fan-1 never started")
+            obj = h.pipeline()
+            obj["spec"]["steps"][0]["with_items"] = [1]
+            h.store.put("Pipeline", obj)
+            await h.wait(lambda: h.phase() == "Succeeded", timeout=45,
+                         msg=str(h.pipeline()))
+            st = h.pipeline()["status"]
+            assert "fan-1" not in st["step_phases"]
+            assert h.store.get("JAXJob", "p1-fan-1", "default") is None
+            import json as _json
+
+            assert _json.loads(st["step_outputs"]["fan"]) == ["1"]
+
+    asyncio.run(run())
