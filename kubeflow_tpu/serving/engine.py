@@ -1524,6 +1524,14 @@ class GenerationEngine:
 
         items = list(self.prefilling.items())
         c = self._chunk
+        # Chunk-lane admission budget, same spirit (and knob) as the
+        # batched-prefill token budget: each lane's attention scores are
+        # heads x C x klen fp32, so K unbounded lanes at K=max_slots,
+        # C=512, klen=2048 compile ~4 GB of temps and OOM the chip
+        # (measured r4: the 32-slot mixed-throughput bench). Rows beyond
+        # the budget simply keep their slot and ride the next dispatch.
+        max_rows = max(1, self.max_prefill_tokens // c)
+        items = items[:max_rows]
         need = max(
             -(-(len(req.prompt) - req.prefilled) // c) for _, req in items
         )

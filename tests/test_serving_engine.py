@@ -803,3 +803,28 @@ class TestDecodeAttentionKernel:
         for prompt in ([1, 2, 3], list(range(1, 40))):
             assert kern.generate(list(prompt), max_new_tokens=10) == \
                 plain.generate(list(prompt), max_new_tokens=10)
+
+
+def test_fused_chunk_rows_bounded_by_prefill_budget(tiny):
+    """The fused dispatch must not take more chunk lanes than the
+    prefill token budget allows (the lanes' attention-score memory
+    scales with K x C x klen); over-budget rows ride later dispatches
+    and every request still completes."""
+    cfg, _, _, params = tiny
+    eng = GenerationEngine(config=cfg, params=params, max_slots=4,
+                           prefill_chunk=8, max_prefill_tokens=16)
+    # Budget allows 16 // 8 = 2 chunk rows per dispatch; admit 4.
+    kbuckets = []
+    orig = eng._fused_call
+    eng._fused_call = (
+        lambda n, m, klen, filt, lp, ck, cv, toks, lens, ctoks, *a:
+        kbuckets.append(ctoks.shape[1])
+        or orig(n, m, klen, filt, lp, ck, cv, toks, lens, ctoks, *a)
+    )
+    futs = [eng.submit(Request(list(range(1, 30)), max_new_tokens=3))
+            for _ in range(4)]
+    while any(not f.done() for f in futs):
+        eng.step()
+    assert max(kbuckets) <= 2
+    for f in futs:
+        assert len(f.result()) == 3
