@@ -187,9 +187,10 @@ def bench_prefix_cache() -> dict:
         )
         ttfts = []
         # Sequential requests: each TTFT isolates (restore + remainder)
-        # vs full prefill, not queueing. First request is the cold
-        # capture either way -- excluded from the cached stats.
-        for i in range(n_requests):
+        # vs full prefill, not queueing. The first TWO requests warm the
+        # path (cold capture, then the restore/remainder programs' first
+        # compile) and stay out of the percentiles.
+        for i in range(n_requests + 2):
             tail = rng.integers(1, 1000, tail_len).tolist()
             t: list = []
             req = Request(prompt=shared + tail, max_new_tokens=4,
@@ -206,11 +207,11 @@ def bench_prefix_cache() -> dict:
         import gc
 
         gc.collect()
-        steady = ttfts[1:]
+        steady = ttfts[2:]
         return {
             "prefix_cache_mb": cache_mb,
             "ttft_ms": {"p50": _pct(steady, 50), "p99": _pct(steady, 99)},
-            "first_request_ttft_ms": round(ttfts[0] * 1000.0, 1),
+            "warmup_ttft_ms": [round(x * 1000.0, 1) for x in ttfts[:2]],
             "cache": stats,
         }
 
@@ -443,7 +444,18 @@ def main() -> int:
                     "block gap); stall = per-request worst pause; tpot = "
                     "steady per-token rate. decode_block_frontier sweeps "
                     "the block size on the chunked config; prefix_cache "
-                    "A/Bs a repeated-1024-token-system-prompt workload.",
+                    "A/Bs a repeated-1024-token-system-prompt workload "
+                    "(on this dispatch tunnel the ~100-300ms dispatch "
+                    "floor caps the win; the compute saving shows fully "
+                    "on direct-attached chips). speculative acceptance "
+                    "is identical across workloads because RANDOM-weight "
+                    "greedy decode collapses into a prompt-independent "
+                    "cycle that prompt-lookup drafts perfectly -- "
+                    "mechanism proof, not a real-checkpoint acceptance "
+                    "estimate. Identical-code tunnel runs spread roughly "
+                    "+/-10-20% day to day (r3's engine re-measured 686 "
+                    "tok/s at 16 slots on this round's run day vs its "
+                    "recorded 897).",
         },
     }
     print(json.dumps(result), flush=True)
