@@ -323,6 +323,36 @@ class TestTensorParallelServing:
         with pytest.raises(ValueError, match="divide"):
             GenerationEngine(config=cfg, tensor_parallel=4)
 
+    def test_tp_prefix_cache_token_exact(self):
+        """Prefix restore/extract over the KV-sharded cache: GSPMD must
+        carry the stored prefix's sharding through scatter/gather with
+        no token drift vs the single-device cached engine."""
+        from kubeflow_tpu.serving.engine import make_tp_mesh
+
+        cfg = self._f32("llama-tiny")
+        base = GenerationEngine(config=cfg, max_slots=2, seed=3,
+                                prefix_cache_mb=16, prefix_block=8)
+        tp = GenerationEngine(config=cfg, max_slots=2, seed=3,
+                              prefix_cache_mb=16, prefix_block=8,
+                              mesh=make_tp_mesh(2))
+        shared = list(range(1, 25))
+        for p in (shared + [40, 41], shared + [50]):
+            assert base.generate(list(p), max_new_tokens=6) == \
+                tp.generate(list(p), max_new_tokens=6)
+        assert tp.prefix_cache.hits >= 1  # second prompt restored
+
+    def test_tp_speculative_token_exact(self):
+        from kubeflow_tpu.serving.engine import make_tp_mesh
+
+        cfg = self._f32("llama-tiny")
+        plain = GenerationEngine(config=cfg, max_slots=2, seed=3)
+        spec = GenerationEngine(config=cfg, max_slots=2, seed=3,
+                                speculative_k=4, mesh=make_tp_mesh(2))
+        for p in ([1, 2, 3] * 8, [9, 4, 7, 1]):
+            assert spec.generate(list(p), max_new_tokens=8) == \
+                plain.generate(list(p), max_new_tokens=8)
+        assert spec.spec_steps > 0
+
 
 class TestShardedCheckpointRestore:
     def test_orbax_restore_lands_sharded_and_serves(self, tmp_path):
