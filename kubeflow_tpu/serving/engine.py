@@ -154,6 +154,103 @@ def _cast_packed(w: dict, cfg: LlamaConfig) -> dict:
     }
 
 
+def quantize_packed(w: dict) -> dict:
+    """Weight-only symmetric int8 over a packed (serving-dtype) tree.
+
+    Decode is HBM-bandwidth bound: every step streams the full weight
+    set per token batch, so halving weight bytes is a direct throughput
+    lever on v5e (and halves the HBM footprint, the binding constraint
+    for 8B on a 16 GiB chip). Scheme chosen for XLA, not for the MXU:
+
+    - **Per-output-channel symmetric scales** (`s = max|w|/127` over the
+      contraction axes). Finer than per-tensor -- the error is ~0.4% per
+      matmul -- while keeping the scale a rank-(out) vector applied to
+      the matmul OUTPUT: ``y = einsum(x, q.astype(bf16)) * s``. The int8
+      ->bf16 convert fuses into the dot's operand read (weights cross
+      HBM as int8); the scale touches only the small activation output.
+    - **Activations stay bf16** (no dynamic activation quant): the MXU
+      runs the dot in bf16 either way, and serving's win is bandwidth,
+      not FLOPs.
+    - Norm scales and the MoE router stay f32 (routing is discrete; see
+      _cast_packed); the embedding quantizes per-ROW (gathers read
+      int8 rows, dequant after the gather costs B*H).
+
+    Parity note: the reference's GPU serving path ships int8/quantized
+    variants via vLLM/huggingfaceserver (SURVEY.md 3.3 S5 delta); this
+    is the TPU-native equivalent.
+    """
+
+    def q8(arr, axes):
+        a = arr.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(a), axis=axes)
+        s = jnp.maximum(amax, 1e-8) / 127.0
+        qq = jnp.clip(
+            jnp.round(a / jnp.expand_dims(s, axes)), -127, 127
+        ).astype(jnp.int8)
+        return {"q": qq, "s": s}
+
+    layers = w["layers"]
+    attn = layers["attn"]
+    qlayers = dict(layers)
+    qlayers["attn"] = {
+        "q_proj": {"kernel": q8(attn["q_proj"]["kernel"], (1,))},
+        "k_proj": {"kernel": q8(attn["k_proj"]["kernel"], (1,))},
+        "v_proj": {"kernel": q8(attn["v_proj"]["kernel"], (1,))},
+        "o_proj": {"kernel": q8(attn["o_proj"]["kernel"], (1, 2))},
+    }
+    if "mlp" in layers:
+        mlp = layers["mlp"]
+        qlayers["mlp"] = {
+            "gate_proj": {"kernel": q8(mlp["gate_proj"]["kernel"], (1,))},
+            "up_proj": {"kernel": q8(mlp["up_proj"]["kernel"], (1,))},
+            "down_proj": {"kernel": q8(mlp["down_proj"]["kernel"], (1,))},
+        }
+    if "moe" in layers:
+        moe = layers["moe"]
+        qlayers["moe"] = {
+            "router": moe["router"],  # f32, discrete routing
+            "gate_proj": q8(moe["gate_proj"], (2,)),
+            "up_proj": q8(moe["up_proj"], (2,)),
+            "down_proj": q8(moe["down_proj"], (2,)),
+        }
+    return {
+        "embed": q8(w["embed"], (1,)),
+        "final_scale": w["final_scale"],
+        "lm_head": q8(w["lm_head"], (0,)),
+        "layers": qlayers,
+    }
+
+
+def _pj(eqn, x, kern):
+    """einsum against a possibly int8-quantized kernel leaf. Quantized
+    leaves are ``{"q": int8, "s": f32 per-output-channel}``; the scale's
+    shape is exactly the weight's output axes, so it broadcasts against
+    the einsum output's trailing dims for every projection in this
+    file."""
+    if isinstance(kern, dict):
+        y = jnp.einsum(eqn, x, kern["q"].astype(x.dtype))
+        return y * kern["s"].astype(x.dtype)
+    return jnp.einsum(eqn, x, kern)
+
+
+def _embed_rows(w: dict, tokens, dtype):
+    """Embedding gather with optional per-row int8 dequant (in f32 --
+    the gathered rows are tiny next to the table read)."""
+    e = w["embed"]
+    if isinstance(e, dict):
+        rows = e["q"][tokens].astype(jnp.float32)
+        return (rows * e["s"][tokens][..., None]).astype(dtype)
+    return e[tokens]
+
+
+def _lm_logits(x32, lm):
+    """f32 logits: x32 [..., H] @ lm_head [H, V] (possibly int8; the
+    convert fuses into the dot read either way)."""
+    if isinstance(lm, dict):
+        return (x32 @ lm["q"].astype(jnp.float32)) * lm["s"]
+    return x32 @ lm.astype(jnp.float32)
+
+
 def _moe_ffn(cfg: LlamaConfig, m: dict, h):
     """MoE FFN for inference: compute every expert densely, weight by the
     renormalized top-k router probabilities.
@@ -174,10 +271,10 @@ def _moe_ffn(cfg: LlamaConfig, m: dict, h):
     w_e = jnp.zeros_like(probs)                             # [B,S,E]
     for j in range(k):
         w_e = w_e + jax.nn.one_hot(topi[..., j], e) * topv[..., j:j + 1]
-    gate = jnp.einsum("bsh,ehi->bsei", h, m["gate_proj"])
-    up = jnp.einsum("bsh,ehi->bsei", h, m["up_proj"])
+    gate = _pj("bsh,ehi->bsei", h, m["gate_proj"])
+    up = _pj("bsh,ehi->bsei", h, m["up_proj"])
     act = jax.nn.silu(gate) * up
-    out = jnp.einsum("bsei,eih->bseh", act, m["down_proj"])
+    out = _pj("bsei,eih->bseh", act, m["down_proj"])
     return jnp.einsum("bse,bseh->bsh", w_e.astype(h.dtype), out)
 
 
@@ -185,10 +282,10 @@ def _ffn(cfg: LlamaConfig, lp: dict, h):
     if "moe" in lp:
         return _moe_ffn(cfg, lp["moe"], h)
     mlp = lp["mlp"]
-    gate = jnp.einsum("bsh,hi->bsi", h, mlp["gate_proj"]["kernel"])
-    up = jnp.einsum("bsh,hi->bsi", h, mlp["up_proj"]["kernel"])
-    return jnp.einsum("bsi,ih->bsh", jax.nn.silu(gate) * up,
-                      mlp["down_proj"]["kernel"])
+    gate = _pj("bsh,hi->bsi", h, mlp["gate_proj"]["kernel"])
+    up = _pj("bsh,hi->bsi", h, mlp["up_proj"]["kernel"])
+    return _pj("bsi,ih->bsh", jax.nn.silu(gate) * up,
+               mlp["down_proj"]["kernel"])
 
 
 def _layer_forward(cfg: LlamaConfig, lp: dict, x, freqs, positions, mask):
@@ -198,13 +295,13 @@ def _layer_forward(cfg: LlamaConfig, lp: dict, x, freqs, positions, mask):
 
     attn = lp["attn"]
     h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps)
-    q = jnp.einsum("bsh,hnd->bsnd", h, attn["q_proj"]["kernel"])
-    k = jnp.einsum("bsh,hnd->bsnd", h, attn["k_proj"]["kernel"])
-    v = jnp.einsum("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
+    q = _pj("bsh,hnd->bsnd", h, attn["q_proj"]["kernel"])
+    k = _pj("bsh,hnd->bsnd", h, attn["k_proj"]["kernel"])
+    v = _pj("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
     q = _rope(q, freqs, positions)
     k = _rope(k, freqs, positions)
     out = _gqa_attend(q, k, v, mask)
-    out = jnp.einsum("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
+    out = _pj("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
     x = x + out
     h = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
     return x + _ffn(cfg, lp, h), k, v
@@ -222,7 +319,7 @@ def _prefill(cfg: LlamaConfig, w: dict, tokens, lengths):
     k_rows, s = tokens.shape
     positions = jnp.arange(s)[None, :]
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
-    x = w["embed"][tokens]
+    x = _embed_rows(w, tokens, jnp.dtype(cfg.dtype))
     causal = jnp.tril(jnp.ones((s, s), bool))[None]
 
     def body(x, lp):
@@ -233,7 +330,7 @@ def _prefill(cfg: LlamaConfig, w: dict, tokens, lengths):
     x = _rms(x, w["final_scale"], cfg.norm_eps)
     # Logits only for each row's last real token (lengths[k]-1).
     last = x[jnp.arange(k_rows), lengths - 1]  # [K, H]
-    logits = (last.astype(jnp.float32) @ w["lm_head"].astype(jnp.float32))
+    logits = _lm_logits(last.astype(jnp.float32), w["lm_head"])
     return logits, ks, vs
 
 
@@ -283,7 +380,7 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths,
         kernel = False  # non-pow2 max_seq: kernel tiling can't cover it
     positions = lengths[:, None]  # [B,1]
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
-    x = w["embed"][tokens][:, None, :]  # [B,1,H]
+    x = _embed_rows(w, tokens, jnp.dtype(cfg.dtype))[:, None, :]  # [B,1,H]
     # Visible: key position <= query position. Everything earlier in the
     # slot was written by the current occupant, so this is exact.
     mask = jnp.arange(smax)[None, None, :] <= positions[:, :, None]  # [B,1,Smax]
@@ -294,9 +391,9 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths,
         lp, ck, cv = layer
         # Write current k/v into the cache *then* attend over it.
         h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps)
-        q = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["q_proj"]["kernel"])
-        k = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["k_proj"]["kernel"])
-        v = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["v_proj"]["kernel"])
+        q = _pj("bsh,hnd->bsnd", h, lp["attn"]["q_proj"]["kernel"])
+        k = _pj("bsh,hnd->bsnd", h, lp["attn"]["k_proj"]["kernel"])
+        v = _pj("bsh,hnd->bsnd", h, lp["attn"]["v_proj"]["kernel"])
         q = _rope(q, freqs, positions)
         k = _rope(k, freqs, positions)
         ck = ck.at[batch_idx, positions].set(k)
@@ -313,7 +410,7 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths,
             ).reshape(b, 1, n, cfg.head_dim)
         else:
             out = _gqa_attend(q, ck, cv, mask)
-        out = jnp.einsum("bsnd,ndh->bsh", out, lp["attn"]["o_proj"]["kernel"])
+        out = _pj("bsnd,ndh->bsh", out, lp["attn"]["o_proj"]["kernel"])
         x = x + out
         h = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
         x = x + _ffn(cfg, lp, h)
@@ -321,7 +418,7 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths,
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (w["layers"], cache_k, cache_v))
     x = _rms(x, w["final_scale"], cfg.norm_eps)
-    logits = (x[:, 0].astype(jnp.float32) @ w["lm_head"].astype(jnp.float32))
+    logits = _lm_logits(x[:, 0].astype(jnp.float32), w["lm_head"])
     return logits, new_k, new_v
 
 
@@ -491,7 +588,6 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     batch_idx = jnp.arange(b)[:, None]
     row = chunk_slots[:, None]
-    lm_head = w["lm_head"].astype(jnp.float32)
 
     def chunk_layer(x_c, lp, ck, cv, c_pos, c_mask):
         """Chunk lanes through one layer: write this chunk's K/V into
@@ -499,9 +595,9 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
         causality rides the position mask)."""
         attn = lp["attn"]
         h = _rms(x_c, lp["attn_norm"]["scale"], cfg.norm_eps)
-        q = jnp.einsum("bsh,hnd->bsnd", h, attn["q_proj"]["kernel"])
-        k = jnp.einsum("bsh,hnd->bsnd", h, attn["k_proj"]["kernel"])
-        v = jnp.einsum("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
+        q = _pj("bsh,hnd->bsnd", h, attn["q_proj"]["kernel"])
+        k = _pj("bsh,hnd->bsnd", h, attn["k_proj"]["kernel"])
+        v = _pj("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
         q = _rope(q, freqs, c_pos)
         k = _rope(k, freqs, c_pos)
         ck = ck.at[row, c_pos].set(k, mode="drop")
@@ -509,7 +605,7 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
         keys = ck[chunk_slots, :klen]                     # [K,klen,KV,D]
         vals = cv[chunk_slots, :klen]
         out = _gqa_attend(q, keys, vals, c_mask)
-        out = jnp.einsum("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
+        out = _pj("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
         x_c = x_c + out
         h = _rms(x_c, lp["mlp_norm"]["scale"], cfg.norm_eps)
         return x_c + _ffn(cfg, lp, h), ck, cv
@@ -517,7 +613,7 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
     def chunk_logits_latch(x_c, cclens, fin_logits):
         x_c = _rms(x_c, w["final_scale"], cfg.norm_eps)
         last = x_c[jnp.arange(k_rows), jnp.maximum(cclens - 1, 0)]
-        c_logits = last.astype(jnp.float32) @ lm_head
+        c_logits = _lm_logits(last.astype(jnp.float32), w["lm_head"])
         return jnp.where((cclens > 0)[:, None], c_logits, fin_logits)
 
     def mixed_step(carry, xs):
@@ -527,8 +623,8 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
         dec_mask = jnp.arange(smax)[None, None, :] <= dec_pos[:, :, None]
         c_pos = offs[:, None] + jnp.arange(c)[None, :]           # [K,C]
         c_mask = jnp.arange(klen)[None, None, :] <= c_pos[:, :, None]
-        x_d = w["embed"][toks][:, None, :]                       # [B,1,H]
-        x_c = w["embed"][ctoks]                                  # [K,C,H]
+        x_d = _embed_rows(w, toks, jnp.dtype(cfg.dtype))[:, None, :]  # [B,1,H]
+        x_c = _embed_rows(w, ctoks, jnp.dtype(cfg.dtype))             # [K,C,H]
 
         def layer_body(carry2, layer):
             x_d, x_c = carry2
@@ -537,15 +633,15 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
             # Decode lanes (same math as _decode's body).
             attn = lp["attn"]
             h = _rms(x_d, lp["attn_norm"]["scale"], cfg.norm_eps)
-            q = jnp.einsum("bsh,hnd->bsnd", h, attn["q_proj"]["kernel"])
-            k = jnp.einsum("bsh,hnd->bsnd", h, attn["k_proj"]["kernel"])
-            v = jnp.einsum("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
+            q = _pj("bsh,hnd->bsnd", h, attn["q_proj"]["kernel"])
+            k = _pj("bsh,hnd->bsnd", h, attn["k_proj"]["kernel"])
+            v = _pj("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
             q = _rope(q, freqs, dec_pos)
             k = _rope(k, freqs, dec_pos)
             ck = ck.at[batch_idx, dec_pos].set(k)
             cv = cv.at[batch_idx, dec_pos].set(v)
             out = _gqa_attend(q, ck, cv, dec_mask)
-            out = jnp.einsum("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
+            out = _pj("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
             x_d = x_d + out
             h = _rms(x_d, lp["mlp_norm"]["scale"], cfg.norm_eps)
             x_d = x_d + _ffn(cfg, lp, h)
@@ -555,7 +651,7 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
             layer_body, (x_d, x_c), (w["layers"], ck0, cv0)
         )
         x_d = _rms(x_d, w["final_scale"], cfg.norm_eps)
-        d_logits = x_d[:, 0].astype(jnp.float32) @ lm_head
+        d_logits = _lm_logits(x_d[:, 0].astype(jnp.float32), w["lm_head"])
         nxt = _sample(d_logits, step_rng, temps,
                       top_ks if filtered else None,
                       top_ps if filtered else None)
@@ -568,7 +664,7 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
         ctoks, cclens = xs
         c_pos = offs[:, None] + jnp.arange(c)[None, :]
         c_mask = jnp.arange(klen)[None, None, :] <= c_pos[:, :, None]
-        x_c = w["embed"][ctoks]
+        x_c = _embed_rows(w, ctoks, jnp.dtype(cfg.dtype))
 
         def layer_body(x_c, layer):
             lp, ck, cv = layer
@@ -582,7 +678,7 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
         return (ck1, cv1, offs + cclens, fin_logits), None
 
     rngs = jax.random.split(rng, n_steps)
-    fin0 = jnp.zeros((k_rows, lm_head.shape[-1]), jnp.float32)
+    fin0 = jnp.zeros((k_rows, cfg.vocab_size), jnp.float32)
     (ck, cv, _, _, offs, fin_logits), outs = jax.lax.scan(
         mixed_step,
         (cache_k, cache_v, tokens, lengths, chunk_offs, fin0),
@@ -757,7 +853,6 @@ def _spec_block(cfg: LlamaConfig, m_steps: int, k_draft: int, w: dict,
     s = k_draft + 1
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     batch_idx = jnp.arange(b)[:, None]
-    lm_head = w["lm_head"].astype(jnp.float32)
     j = jnp.arange(s)[None, :]
 
     def step_body(carry, _):
@@ -766,22 +861,21 @@ def _spec_block(cfg: LlamaConfig, m_steps: int, k_draft: int, w: dict,
         tokens_in = jnp.concatenate([toks[:, None], draft], axis=1)
         positions = (lens - 1)[:, None] + j                  # [B,S]
         mask = jnp.arange(smax)[None, None, :] <= positions[:, :, None]
-        x = w["embed"][tokens_in]                            # [B,S,H]
+        x = _embed_rows(w, tokens_in, jnp.dtype(cfg.dtype))  # [B,S,H]
 
         def layer_body(x, layer):
             lp, ck, cv = layer
             attn = lp["attn"]
             h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps)
-            q = jnp.einsum("bsh,hnd->bsnd", h, attn["q_proj"]["kernel"])
-            k = jnp.einsum("bsh,hnd->bsnd", h, attn["k_proj"]["kernel"])
-            v = jnp.einsum("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
+            q = _pj("bsh,hnd->bsnd", h, attn["q_proj"]["kernel"])
+            k = _pj("bsh,hnd->bsnd", h, attn["k_proj"]["kernel"])
+            v = _pj("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
             q = _rope(q, freqs, positions)
             k = _rope(k, freqs, positions)
             ck = ck.at[batch_idx, positions].set(k)
             cv = cv.at[batch_idx, positions].set(v)
             out = _gqa_attend(q, ck, cv, mask)
-            out = jnp.einsum("bsnd,ndh->bsh", out,
-                             attn["o_proj"]["kernel"])
+            out = _pj("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
             x = x + out
             h = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
             return x + _ffn(cfg, lp, h), (ck, cv)
@@ -790,8 +884,7 @@ def _spec_block(cfg: LlamaConfig, m_steps: int, k_draft: int, w: dict,
                                      (w["layers"], ck0, cv0))
         x = _rms(x, w["final_scale"], cfg.norm_eps)
         g = jnp.argmax(
-            jnp.einsum("bsh,hv->bsv", x.astype(jnp.float32), lm_head),
-            axis=-1,
+            _lm_logits(x.astype(jnp.float32), w["lm_head"]), axis=-1
         )                                                    # [B,S]
         eq = draft == g[:, :-1]
         a = jnp.cumprod(eq.astype(jnp.int32), axis=1).sum(axis=1)  # [B]
@@ -1031,6 +1124,7 @@ class GenerationEngine:
         prefix_block: int = 128,
         speculative_k: int = 0,
         decode_attn_kernel: bool = False,
+        quantize: Optional[str] = None,
     ) -> None:
         # Max decode steps fused into one device program (power-of-2
         # sub-blocks keep the compile count bounded); 1 = per-token
@@ -1085,6 +1179,14 @@ class GenerationEngine:
         # need a shard_map wrapper (not wired yet), so the block builder
         # ignores the flag when a mesh is configured.
         self.decode_attn_kernel = bool(decode_attn_kernel)
+        # Weight-only int8 (see quantize_packed): halves weight HBM
+        # bytes -- the decode bottleneck -- and the 8B resident
+        # footprint. KV cache stays bf16 (attends exactly).
+        if quantize not in (None, "", "int8"):
+            raise ValueError(
+                f"quantize={quantize!r}: supported values are 'int8'"
+            )
+        self.quantize = quantize or None
         self._backlog: List[Request] = []  # engine-thread only
         cfg = config or PRESETS[preset]
         if max_seq is not None:
@@ -1122,7 +1224,19 @@ class GenerationEngine:
                 )
                 params = nn.meta.unbox(raw)
         if mesh is None:
-            self.weights = pack_weights(params, cfg)
+            if self.quantize == "int8":
+                # Cast+quantize in ONE jit over the checkpoint-dtype
+                # tree: the bf16 intermediates are program-internal, so
+                # peak load HBM is ~checkpoint + int8 -- never the full
+                # bf16 tree (which alone wouldn't fit 8B on one 16 GiB
+                # chip). NOT donated: pack_weights(cast=False) aliases
+                # caller params, and donating aliased buffers deletes
+                # them under the caller.
+                self.weights = jax.jit(
+                    lambda raw: quantize_packed(_cast_packed(raw, cfg))
+                )(pack_weights(params, cfg, cast=False))
+            else:
+                self.weights = pack_weights(params, cfg)
         else:
             # Shard-first, cast-on-mesh: each leaf goes to its devices in
             # checkpoint dtype (a no-op for leaves orbax already restored
@@ -1131,10 +1245,32 @@ class GenerationEngine:
             raw = pack_weights(params, cfg, cast=False)
             wsh = tp_weight_shardings(mesh, raw)
             placed = jax.tree.map(jax.device_put, raw, wsh)
+            # NOT donated: device_put aliases caller buffers whenever a
+            # leaf is already on its target devices (e.g. the replicated
+            # norm scales), and donating aliased buffers deletes them
+            # under the caller -- same hazard as the non-mesh quantize
+            # path below. The transient is one extra SHARDED copy during
+            # the cast (per-chip: ~2x the shard, not 2x the model),
+            # which the 8B-on-v5e-4 budget absorbs.
             self.weights = jax.jit(
-                partial(_cast_packed, cfg=cfg),
-                donate_argnums=0, out_shardings=wsh,
+                partial(_cast_packed, cfg=cfg), out_shardings=wsh,
             )(placed)
+            if self.quantize == "int8":
+                # Quantize on-mesh: "q" leaves keep the kernel's spec
+                # (rank-preserving), per-output-channel "s" vectors fall
+                # back to replicated via spec_for's rank check -- tiny,
+                # and the scaled multiply stays shard-local under GSPMD.
+                # Donation is safe HERE: the cast jit's outputs are
+                # exclusively ours.
+                qfn = jax.jit(
+                    quantize_packed,
+                    donate_argnums=0,
+                    out_shardings=tp_weight_shardings(
+                        mesh,
+                        jax.eval_shape(quantize_packed, self.weights),
+                    ),
+                )
+                self.weights = qfn(self.weights)
 
         kvshape = (cfg.n_layers, max_slots, cfg.max_seq, cfg.n_kv_heads,
                    cfg.head_dim)
@@ -1686,6 +1822,13 @@ class GenerationEngine:
         }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
+        if self.quantize:
+            out["quantize"] = self.quantize
+            if self.weights is not None:
+                out["weight_bytes"] = int(sum(
+                    x.size * x.dtype.itemsize
+                    for x in jax.tree.leaves(self.weights)
+                ))
         if self.speculative_k:
             out["spec"] = {
                 "k": self.speculative_k,

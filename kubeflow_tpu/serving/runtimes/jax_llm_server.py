@@ -35,6 +35,9 @@ Options (ModelSpec.options):
 - ``tensor_parallel``: shard weights + KV cache over an N-device
   ``tensor`` mesh (config #5 targets v5e-4: tensor_parallel=4). N must
   divide n_heads/n_kv_heads/intermediate/vocab. Default 1.
+- ``quantize``: "int8" for weight-only int8 serving (per-output-channel
+  scales; halves weight HBM bytes and footprint, KV cache stays bf16).
+  Default off. The reference's quantized-variant analog (vLLM int8).
 """
 
 from __future__ import annotations
@@ -260,6 +263,7 @@ class JaxLLMModel(Model):
             prefill_decode_steps=opts.get("prefill_decode_steps"),
             speculative_k=int(opts.get("speculative_k", 0)),
             decode_attn_kernel=bool(opts.get("decode_attn_kernel", False)),
+            quantize=opts.get("quantize") or None,
             mesh=mesh,
         )
         if config is not None:
@@ -312,9 +316,11 @@ class JaxLLMModel(Model):
         # Prometheus exposition label escaping: a dynamically admitted
         # model name with a quote/backslash/newline must not corrupt the
         # whole scrape.
-        esc = (str(self.name).replace("\\", "\\\\")
-               .replace('"', '\\"').replace("\n", "\\n"))
-        lab = f'model="{esc}"'
+        def _esc(v) -> str:
+            return (str(v).replace("\\", "\\\\")
+                    .replace('"', '\\"').replace("\n", "\\n"))
+
+        lab = f'model="{_esc(self.name)}"'
         s = self.engine.stats()
         lines = [
             f"kftpu_engine_queue_depth{{{lab}}} {s['queue_depth']}",
@@ -329,6 +335,14 @@ class JaxLLMModel(Model):
             f"kftpu_engine_requests_finished_total{{{lab}}} "
             f"{s['requests_finished']}",
         ]
+        if "weight_bytes" in s:
+            # Present only when quantized (the int8-footprint gauge; the
+            # quantize mode itself rides the label).
+            lines.append(
+                f"kftpu_engine_weight_bytes"
+                f'{{{lab},quantize="{_esc(s["quantize"])}"}} '
+                f"{s['weight_bytes']}"
+            )
         sp = s.get("spec")
         if sp is not None:
             lines += [
