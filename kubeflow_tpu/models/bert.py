@@ -99,7 +99,7 @@ class EncoderBlock(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, attn_segments=None):
         cfg = self.cfg
         dtype = _dt(cfg.dtype)
         dense = partial(
@@ -116,8 +116,13 @@ class EncoderBlock(nn.Module):
         q = qkv(name="q_proj")(x)
         k = qkv(name="k_proj")(x)
         v = qkv(name="v_proj")(x)
+        # attn_segments [B, S] (padding mask as segment ids: real=1,
+        # pad=0) keeps batch padding out of real tokens' attention --
+        # embedding serving must be padding-invariant. None (training:
+        # full sequences, no pads) keeps the ring/Ulysses fast paths.
         attn = dot_product_attention(
-            q, k, v, causal=False, impl=cfg.attention_impl
+            q, k, v, causal=False, segment_ids=attn_segments,
+            impl=cfg.attention_impl,
         )
         attn = nn.DenseGeneral(
             features=cfg.hidden, axis=(-2, -1), use_bias=True, dtype=dtype,
@@ -151,8 +156,8 @@ class _ScanBlock(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, x):
-        return EncoderBlock(self.cfg, name="layer")(x), None
+    def __call__(self, x, attn_segments=None):
+        return EncoderBlock(self.cfg, name="layer")(x, attn_segments), None
 
 
 class Bert(nn.Module):
@@ -160,7 +165,9 @@ class Bert(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
-                 segments: Optional[jax.Array] = None):
+                 segments: Optional[jax.Array] = None,
+                 return_hidden: bool = False,
+                 pad_mask: Optional[jax.Array] = None):
         cfg = self.cfg
         dtype = _dt(cfg.dtype)
         embed = partial(
@@ -194,6 +201,9 @@ class Bert(nn.Module):
         x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=dtype,
                          name="embed_norm")(x)
 
+        attn_segments = (
+            pad_mask.astype(jnp.int32) if pad_mask is not None else None
+        )
         policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
         if cfg.scan_layers:
             block = _ScanBlock
@@ -204,17 +214,23 @@ class Bert(nn.Module):
                 block,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
+                in_axes=nn.broadcast,  # same mask every layer
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, name="layers")(x)
+            )(cfg, name="layers")(x, attn_segments)
         else:
             block = EncoderBlock
             if cfg.remat:
                 block = nn.remat(EncoderBlock, policy=policy,
                                  prevent_cse=False)
             for i in range(cfg.n_layers):
-                x = block(cfg, name=f"layer_{i}")(x)
+                x = block(cfg, name=f"layer_{i}")(x, attn_segments)
 
+        if return_hidden:
+            # Encoder output [B, S, H] for embedding serving (pooled by
+            # the jax-embed runtime); skipping the mlm_head at apply
+            # time is fine under flax (params exist, just unused).
+            return x
         logits = nn.DenseGeneral(
             features=cfg.vocab_size, use_bias=True, dtype=dtype,
             param_dtype=_dt(cfg.param_dtype),

@@ -12,6 +12,12 @@ also prefers pre-staged models) behind the V1/V2 protocols:
   token-id lists -- the hermetic mode tests use.
 - task=text-classification: AutoModelForSequenceClassification; returns
   {label, score}.
+- task=embedding: AutoModel; mean-pool of last_hidden_state (sequences
+  run one at a time, unpadded, truncated to the model's position
+  table), L2-normalized (options.normalize=false disables); returns one
+  vector per instance -- wire it behind /openai/v1/embeddings or V1
+  predict. The TPU-native counterpart is format=jax-embed
+  (jax_embed_server), which batches with a padding mask.
 
 Torch runs CPU-side here; the TPU-native LLM path is the ``jax`` format
 (serving.engine) -- this runtime exists for HF-ecosystem parity, e.g.
@@ -45,6 +51,7 @@ class HuggingFaceModel(Model):
             )
         import torch  # noqa: F401  -- fail early if torch is unavailable
         from transformers import (
+            AutoModel,
             AutoModelForCausalLM,
             AutoModelForSequenceClassification,
             AutoTokenizer,
@@ -54,6 +61,8 @@ class HuggingFaceModel(Model):
             cls = AutoModelForCausalLM
         elif self.task == "text-classification":
             cls = AutoModelForSequenceClassification
+        elif self.task in ("embedding", "text_embedding"):
+            cls = AutoModel
         else:
             raise InferenceError(f"unsupported task {self.task!r}", 500)
         self._model = cls.from_pretrained(self.path, local_files_only=True)
@@ -80,6 +89,8 @@ class HuggingFaceModel(Model):
 
         if self.task == "text-classification":
             return [self._classify(i) for i in instances]
+        if self.task in ("embedding", "text_embedding"):
+            return [self._embed(i) for i in instances]
         out = []
         for inst in instances:
             max_new = self.max_new_tokens
@@ -107,6 +118,38 @@ class HuggingFaceModel(Model):
             else:
                 out.append([int(t) for t in new])
         return out
+
+    def _embed(self, inst: Any) -> list:
+        import torch
+
+        # Long documents are the canonical embeddings payload: truncate
+        # to the checkpoint's position table instead of crashing on the
+        # position-embedding lookup.
+        max_len = int(self.options.get(
+            "max_seq",
+            getattr(self._model.config, "max_position_embeddings", 0)
+            or 512,
+        ))
+        if isinstance(inst, dict):
+            inst = inst.get("text", inst.get("token_ids"))
+        if self._tokenizer is not None and isinstance(inst, str):
+            ids = self._tokenizer(
+                inst, return_tensors="pt", truncation=True,
+                max_length=max_len,
+            ).input_ids
+        elif isinstance(inst, (list, tuple)):
+            ids = torch.tensor([list(inst)[:max_len]], dtype=torch.long)
+        else:
+            raise InferenceError(
+                "embedding instances are strings (with a tokenizer) or "
+                "token-id lists", 400,
+            )
+        with torch.no_grad():
+            h = self._model(ids).last_hidden_state[0]  # [S, H]
+        v = h.mean(dim=0)
+        if bool(self.options.get("normalize", True)):
+            v = v / v.norm().clamp_min(1e-9)
+        return [float(x) for x in v]
 
     def _classify(self, inst: Any) -> dict:
         import torch

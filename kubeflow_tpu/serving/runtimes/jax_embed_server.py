@@ -1,0 +1,208 @@
+"""JAX/TPU text-embedding runtime (KServe huggingfaceserver's embedding
+task, SURVEY.md 3.3 S5 delta).
+
+The reference's serving stack exposes embedding models next to
+generation (huggingfaceserver task=text_embedding; OpenAI-compatible
+``/v1/embeddings``). The TPU-native equivalent runs the flax BERT
+encoder (models/bert.py) under jit with bucketed static shapes:
+
+- prompts tokenize, pad to a power-of-2 length bucket, and run as ONE
+  batched forward per bucket (compile count O(#buckets), MXU-friendly
+  batches);
+- padding rides the encoder's ``pad_mask`` (attention segment ids), so
+  an embedding is invariant to how much batch padding it shipped with;
+- pooling: masked mean over real tokens (default) or the [CLS]/first
+  token; L2-normalized by default (cosine-ready, the OpenAI contract).
+
+Options (ModelSpec.options):
+- ``preset``: bert config name (default bert-base; bert-tiny for tests)
+- ``pooling``: "mean" (default) | "cls"
+- ``normalize``: L2-normalize outputs (default True)
+- ``tokenizer``: "byte" (default) or a local-cache HF tokenizer name
+- ``checkpoint``: "none" (random init demo) or "orbax" (a BertTask
+  training checkpoint directory via storage_uri)
+- ``max_seq``: truncation length (default: the preset's max_seq)
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence
+
+from kubeflow_tpu.serving.model import InferenceError, Model
+from kubeflow_tpu.serving.runtimes.common import serve_main
+
+logger = logging.getLogger(__name__)
+
+
+def _bucket(n: int, max_seq: int) -> int:
+    b = 8
+    while b < n and b < max_seq:
+        b *= 2
+    return min(b, max_seq)
+
+
+class JaxEmbedModel(Model):
+    def __init__(self, name: str, path: Optional[str],
+                 options: Dict[str, Any]) -> None:
+        super().__init__(name)
+        self.path = path
+        self.options = options
+        self.tokenizer = None
+        self._embed = None      # jitted (params, tokens, mask) -> [B, D]
+        self._params = None
+        self.dim = 0
+        self.max_seq = 0
+
+    def load(self) -> None:
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.models.bert import PRESETS, Bert
+        from kubeflow_tpu.serving.runtimes.jax_llm_server import (
+            ByteTokenizer,
+            HFTokenizer,
+        )
+
+        opts = self.options
+        tok = opts.get("tokenizer", "byte")
+        self.tokenizer = ByteTokenizer() if tok == "byte" else HFTokenizer(tok)
+        preset = opts.get("preset", "bert-base")
+        if preset not in PRESETS:
+            raise InferenceError(
+                f"unknown bert preset {preset!r}; have {sorted(PRESETS)}",
+                500,
+            )
+        cfg = dataclasses.replace(PRESETS[preset], remat=False)
+        if opts.get("max_seq"):
+            cfg = dataclasses.replace(cfg, max_seq=int(opts["max_seq"]))
+        self.max_seq = cfg.max_seq
+        self.dim = cfg.hidden
+        pooling = opts.get("pooling", "mean")
+        if pooling not in ("mean", "cls"):
+            raise InferenceError(
+                f"pooling={pooling!r}: supported values are mean/cls", 500,
+            )
+        normalize = bool(opts.get("normalize", True))
+        model = Bert(cfg)
+        ckpt = opts.get("checkpoint", "none" if not self.path else "orbax")
+        if ckpt not in ("none", "orbax"):
+            # A typo must not silently serve random-init vectors.
+            raise InferenceError(
+                f"checkpoint={ckpt!r}: supported values are none/orbax",
+                500,
+            )
+        if ckpt == "orbax":
+            if not self.path:
+                raise InferenceError(
+                    "checkpoint=orbax requires storage_uri", 500
+                )
+            self._params = _restore_bert_params(self.path, model)
+        else:
+            import flax.linen as nn
+
+            raw = jax.jit(model.init)(
+                jax.random.PRNGKey(int(opts.get("seed", 0))),
+                jnp.zeros((1, 8), jnp.int32),
+            )
+            self._params = nn.meta.unbox(raw)
+
+        def embed_fn(params, tokens, mask):
+            h = model.apply(params, tokens, None, True, mask)  # [B,S,H]
+            h = h.astype(jnp.float32)
+            if pooling == "cls":
+                v = h[:, 0]
+            else:
+                m = mask[..., None].astype(jnp.float32)
+                v = (h * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+            if normalize:
+                v = v / jnp.maximum(
+                    jnp.linalg.norm(v, axis=-1, keepdims=True), 1e-9
+                )
+            return v
+
+        self._embed = jax.jit(embed_fn)
+        # Warm the smallest bucket so first-request latency is serving
+        # time, not compile time.
+        import numpy as np
+
+        self._embed(
+            self._params, np.zeros((1, 8), np.int32),
+            np.ones((1, 8), bool),
+        )
+        self.ready = True
+
+    def unload(self) -> None:
+        self._embed = None
+        self._params = None
+        self.ready = False
+
+    def _ids(self, inst: Any) -> List[int]:
+        if isinstance(inst, dict):
+            inst = inst.get("text", inst.get("token_ids"))
+        if isinstance(inst, str):
+            ids = self.tokenizer.encode(inst)
+        elif isinstance(inst, (list, tuple)):
+            ids = [int(t) for t in inst]
+        else:
+            raise InferenceError(
+                "embedding instances are strings, token-id lists, or "
+                '{"text"| "token_ids"} dicts', 400,
+            )
+        if not ids:
+            raise InferenceError("empty embedding input", 400)
+        return ids[: self.max_seq]
+
+    def predict(self, instances: Sequence[Any]) -> List[Any]:
+        import numpy as np
+
+        seqs = [self._ids(i) for i in instances]
+        # One padded batch per call, bucketed: compile count stays
+        # O(#len-buckets x #batch-buckets).
+        s = _bucket(max(len(x) for x in seqs), self.max_seq)
+        b = 1
+        while b < len(seqs):
+            b *= 2
+        tokens = np.zeros((b, s), np.int32)
+        mask = np.zeros((b, s), bool)
+        for i, ids in enumerate(seqs):
+            tokens[i, : len(ids)] = ids
+            mask[i, : len(ids)] = True
+        out = np.asarray(self._embed(self._params, tokens, mask))
+        return [out[i].tolist() for i in range(len(seqs))]
+
+
+def _restore_bert_params(path: str, model) -> dict:
+    """Latest-step params from a BertTask training checkpoint directory
+    (runtime/checkpoint.py layout: orbax CheckpointManager over a state
+    dict carrying "params")."""
+    import jax
+    import jax.numpy as jnp
+    import orbax.checkpoint as ocp
+
+    mgr = ocp.CheckpointManager(path)
+    step = mgr.latest_step()
+    if step is None:
+        raise InferenceError(f"no checkpoint steps under {path}", 500)
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    )
+    import flax.linen as nn
+
+    abstract = nn.meta.unbox(abstract)
+    restored = mgr.restore(
+        step,
+        args=ocp.args.StandardRestore({"params": abstract["params"]}),
+    )
+    return {"params": restored["params"]}
+
+
+def main(argv=None) -> int:
+    return serve_main(JaxEmbedModel, argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -81,6 +81,7 @@ class ModelServer:
             web.get("/openai/v1/models", self.h_openai_models),
             web.post("/openai/v1/completions", self.h_openai_completions),
             web.post("/openai/v1/chat/completions", self.h_openai_chat),
+            web.post("/openai/v1/embeddings", self.h_openai_embeddings),
         ])
 
         async def on_startup(app):
@@ -655,6 +656,69 @@ class ModelServer:
             "data": [{"id": n, "object": "model", "owned_by": "kftpu"}
                      for n in self.repository.names()],
         })
+
+    async def h_openai_embeddings(self, req: web.Request) -> web.Response:
+        """OpenAI-compatible embeddings over any runtime whose predict
+        returns one vector per instance (the jax-embed runtime; an HF
+        embedding-task model works too). input: str | [str] | [ids] |
+        [[ids]], the OpenAI contract."""
+        self.request_count += 1
+        t0 = time.monotonic()
+        try:
+            body = await req.json()
+            name = body.get("model") or ""
+            model = self.repository.get(name)
+            if not model.ready:
+                raise InferenceError(f"model {name} is not ready",
+                                     status=503)
+            self.repository.touch(name)
+            raw = body.get("input")
+            if isinstance(raw, str):
+                items: list = [raw]
+            elif isinstance(raw, list) and raw and all(
+                isinstance(t, int) for t in raw
+            ):
+                items = [raw]  # one token-id array
+            elif isinstance(raw, list) and raw:
+                items = raw
+            else:
+                raise InferenceError(
+                    '"input" must be a string, a list of strings, or '
+                    "token-id array(s)", 400,
+                )
+            vecs = await asyncio.get_running_loop().run_in_executor(
+                None, model.predict, items
+            )
+            for v in vecs:
+                if not isinstance(v, list) or (
+                    v and not isinstance(v[0], (int, float))
+                ):
+                    raise InferenceError(
+                        f"model {name} is not an embedding model "
+                        "(predict must return one vector per input)", 400,
+                    )
+            n_tok = sum(
+                len(i) if isinstance(i, list) else max(1, len(i) // 4)
+                for i in items
+            )
+            return web.json_response({
+                "object": "list",
+                "model": name,
+                "data": [
+                    {"object": "embedding", "index": i, "embedding": v}
+                    for i, v in enumerate(vecs)
+                ],
+                "usage": {"prompt_tokens": n_tok, "total_tokens": n_tok},
+            })
+        except json.JSONDecodeError:
+            self.error_count += 1
+            return web.json_response({"error": "body is not JSON"},
+                                     status=400)
+        except Exception as e:  # noqa: BLE001 - route must answer
+            self.error_count += 1
+            return self._err(e)
+        finally:
+            self.predict_seconds += time.monotonic() - t0
 
     async def _openai_generate(self, req, chat: bool) -> web.StreamResponse:
         self.request_count += 1

@@ -37,6 +37,7 @@ class ModelFormat(str, enum.Enum):
     xgboost = "xgboost"  # Booster files; library optional (gated at load)
     lightgbm = "lightgbm"  # Booster files; library optional (gated at load)
     jax = "jax"  # JAX/StableHLO LLM predictor on PJRT (north-star config #5)
+    jax_embed = "jax-embed"  # flax BERT text embeddings on TPU (S5 delta)
     huggingface = "huggingface"  # transformers on host CPU (S5 parity)
     pmml = "pmml"  # pypmml; library optional (gated at load)
     paddle = "paddle"  # paddle inference; library optional (gated at load)
@@ -343,6 +344,7 @@ RUNTIMES: Dict[ModelFormat, str] = {
     ModelFormat.xgboost: "kubeflow_tpu.serving.runtimes.xgboost_server",
     ModelFormat.lightgbm: "kubeflow_tpu.serving.runtimes.lightgbm_server",
     ModelFormat.jax: "kubeflow_tpu.serving.runtimes.jax_llm_server",
+    ModelFormat.jax_embed: "kubeflow_tpu.serving.runtimes.jax_embed_server",
     ModelFormat.huggingface:
         "kubeflow_tpu.serving.runtimes.huggingface_server",
     ModelFormat.echo: "kubeflow_tpu.serving.runtimes.echo_server",

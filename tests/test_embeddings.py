@@ -1,0 +1,240 @@
+"""Embedding serving: the jax-embed runtime (flax BERT encoder, masked
+mean pooling) and the OpenAI-compatible /openai/v1/embeddings surface.
+
+Reference analog (SURVEY.md 3.3 S5 delta): KServe's huggingfaceserver
+serves embedding-task models next to generation; OpenAI clients hit
+/v1/embeddings. The TPU-native runtime is jax_embed_server; the HF
+runtime's task=embedding covers torch-side parity.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeflow_tpu.serving.model import ModelRepository
+from kubeflow_tpu.serving.runtimes.jax_embed_server import JaxEmbedModel
+from kubeflow_tpu.serving.server import ModelServer
+
+TINY = {"preset": "bert-tiny", "checkpoint": "none"}
+
+
+@pytest.fixture(scope="module")
+def embed_model():
+    m = JaxEmbedModel("emb", None, dict(TINY))
+    m.load()
+    yield m
+    m.unload()
+
+
+class TestJaxEmbedRuntime:
+    def test_vectors_unit_norm_and_deterministic(self, embed_model):
+        out = embed_model.predict(["hello world", "hello world", "bye"])
+        assert len(out) == 3 and len(out[0]) == embed_model.dim
+        assert out[0] == out[1]
+        assert out[0] != out[2]
+        for v in out:
+            assert abs(float(np.linalg.norm(v)) - 1.0) < 1e-5
+
+    def test_token_id_and_dict_forms(self, embed_model):
+        a, b, c = embed_model.predict([
+            "hi", {"text": "hi"}, {"token_ids": [104, 105]},
+        ])
+        assert a == b  # same text, either form
+        assert a == c  # byte tokenizer: "hi" == [104, 105]
+
+    def test_padding_invariant(self, embed_model):
+        """An instance's embedding must not depend on what it was
+        batched with (batch padding rides the encoder pad_mask)."""
+        alone = embed_model.predict(["short"])[0]
+        batched = embed_model.predict(
+            ["short", "a much longer sentence that forces a bigger "
+             "padding bucket for the whole batch"]
+        )[0]
+        np.testing.assert_allclose(alone, batched, atol=1e-5)
+
+    def test_cls_pooling_differs(self):
+        m = JaxEmbedModel("emb-cls", None, dict(TINY, pooling="cls"))
+        m.load()
+        try:
+            cls_v = m.predict(["hello world"])[0]
+        finally:
+            m.unload()
+        m2 = JaxEmbedModel("emb-mean", None, dict(TINY))
+        m2.load()
+        try:
+            mean_v = m2.predict(["hello world"])[0]
+        finally:
+            m2.unload()
+        assert cls_v != mean_v
+
+    def test_unnormalized_option(self):
+        m = JaxEmbedModel("emb-raw", None, dict(TINY, normalize=False))
+        m.load()
+        try:
+            v = m.predict(["hello world hello world"])[0]
+        finally:
+            m.unload()
+        assert abs(float(np.linalg.norm(v)) - 1.0) > 1e-3
+
+    def test_bad_options_rejected(self):
+        from kubeflow_tpu.serving.model import InferenceError
+
+        with pytest.raises(InferenceError, match="pooling"):
+            m = JaxEmbedModel("e", None, dict(TINY, pooling="max"))
+            m.load()
+        with pytest.raises(InferenceError, match="preset"):
+            m = JaxEmbedModel("e", None, {"preset": "nope"})
+            m.load()
+
+    def test_format_registered(self):
+        from kubeflow_tpu.serving.types import RUNTIMES, ModelFormat
+
+        assert ModelFormat.jax_embed in RUNTIMES
+
+
+@pytest.fixture()
+def embed_client(embed_model):
+    async def make():
+        repo = ModelRepository()
+        repo.register(embed_model)
+        server = ModelServer(repository=repo)
+        c = TestClient(TestServer(server.build_app()))
+        await c.start_server()
+        return c
+
+    loop = asyncio.new_event_loop()
+    c = loop.run_until_complete(make())
+    yield c, loop
+    loop.run_until_complete(c.close())
+    loop.close()
+
+
+class TestOpenAIEmbeddings:
+    def test_single_and_batch_input(self, embed_client):
+        c, loop = embed_client
+
+        async def go():
+            r = await c.post("/openai/v1/embeddings",
+                             json={"model": "emb", "input": "hello"})
+            assert r.status == 200
+            one = await r.json()
+            r = await c.post(
+                "/openai/v1/embeddings",
+                json={"model": "emb", "input": ["hello", "world"]},
+            )
+            assert r.status == 200
+            two = await r.json()
+            return one, two
+
+        one, two = loop.run_until_complete(go())
+        assert one["object"] == "list" and len(one["data"]) == 1
+        assert one["data"][0]["object"] == "embedding"
+        assert [d["index"] for d in two["data"]] == [0, 1]
+        # Same text -> same vector through the HTTP surface.
+        assert one["data"][0]["embedding"] == two["data"][0]["embedding"]
+        assert one["usage"]["prompt_tokens"] > 0
+
+    def test_token_id_input(self, embed_client):
+        c, loop = embed_client
+
+        async def go():
+            r = await c.post("/openai/v1/embeddings",
+                             json={"model": "emb", "input": [104, 105]})
+            return r.status, await r.json()
+
+        status, body = loop.run_until_complete(go())
+        assert status == 200 and len(body["data"]) == 1
+
+    def test_errors(self, embed_client):
+        c, loop = embed_client
+
+        async def go():
+            r1 = await c.post("/openai/v1/embeddings",
+                              json={"model": "emb", "input": []})
+            r2 = await c.post("/openai/v1/embeddings",
+                              json={"model": "nope", "input": "x"})
+            return r1.status, r2.status
+
+        s1, s2 = loop.run_until_complete(go())
+        assert s1 == 400 and s2 == 404
+
+    def test_non_embedding_model_rejected(self, embed_model):
+        from kubeflow_tpu.serving.runtimes.echo_server import EchoModel
+
+        async def make():
+            repo = ModelRepository()
+            echo = EchoModel("echo", "/m", {})
+            echo.load()
+            repo.register(echo)
+            server = ModelServer(repository=repo)
+            c = TestClient(TestServer(server.build_app()))
+            await c.start_server()
+            r = await c.post("/openai/v1/embeddings",
+                             json={"model": "echo", "input": "hi"})
+            status = r.status
+            await c.close()
+            return status
+
+        loop = asyncio.new_event_loop()
+        try:
+            assert loop.run_until_complete(make()) == 400
+        finally:
+            loop.close()
+
+
+def test_hf_embedding_task(tmp_path):
+    """HF runtime task=embedding: masked mean-pool vector per instance
+    (torch-side parity with the reference's huggingfaceserver)."""
+    from transformers import GPT2Config, GPT2Model
+
+    from kubeflow_tpu.serving.runtimes.huggingface_server import (
+        HuggingFaceModel,
+    )
+
+    cfg = GPT2Config(vocab_size=64, n_positions=64, n_embd=32,
+                     n_layer=2, n_head=2)
+    GPT2Model(cfg).save_pretrained(tmp_path)
+    m = HuggingFaceModel(
+        "emb", str(tmp_path), {"tokenizer": "none", "task": "embedding"}
+    )
+    m.load()
+    try:
+        out = m.predict([[1, 2, 3], [4, 5]])
+        assert len(out) == 2 and len(out[0]) == 32
+        assert abs(float(np.linalg.norm(out[0])) - 1.0) < 1e-5
+        assert out[0] != out[1]
+    finally:
+        m.unload()
+
+
+def test_hf_embedding_truncates_long_input(tmp_path):
+    """Inputs past the checkpoint's position table truncate instead of
+    crashing (long documents are the canonical embeddings payload)."""
+    from transformers import GPT2Config, GPT2Model
+
+    from kubeflow_tpu.serving.runtimes.huggingface_server import (
+        HuggingFaceModel,
+    )
+
+    cfg = GPT2Config(vocab_size=64, n_positions=16, n_embd=32,
+                     n_layer=1, n_head=2)
+    GPT2Model(cfg).save_pretrained(tmp_path)
+    m = HuggingFaceModel(
+        "emb", str(tmp_path), {"tokenizer": "none", "task": "embedding"}
+    )
+    m.load()
+    try:
+        out = m.predict([[1, 2, 3] * 20])  # 60 ids > 16 positions
+        assert len(out[0]) == 32
+    finally:
+        m.unload()
+
+
+def test_jax_embed_unknown_checkpoint_rejected():
+    from kubeflow_tpu.serving.model import InferenceError
+
+    m = JaxEmbedModel("e", None, dict(TINY, checkpoint="latest"))
+    with pytest.raises(InferenceError, match="checkpoint"):
+        m.load()

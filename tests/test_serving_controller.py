@@ -877,3 +877,48 @@ def test_explainer_component_end_to_end(cp_client, tmp_path):
         assert abs(atts[1] - (-3.0) * 1.0) < 1e-6  # coef1 * x1
 
     loop.run_until_complete(run())
+
+
+def test_jax_embed_isvc_end_to_end(cp_client):
+    """jax-embed ISVC -> BERT-encoder replica -> OpenAI /v1/embeddings
+    through the activator (S5 delta: the embeddings serving tier)."""
+    cp, client, loop = cp_client
+
+    async def run():
+        spec = {
+            "metadata": {"name": "emb"},
+            "spec": {"predictor": {
+                "model": {
+                    "format": "jax-embed",
+                    "options": {"preset": "bert-tiny",
+                                "checkpoint": "none"},
+                },
+                "min_replicas": 1, "max_replicas": 1,
+            }},
+        }
+        r = await client.post("/apis/InferenceService", json=spec)
+        assert r.status == 200, await r.text()
+        await wait_for(
+            lambda: _status(cp, "emb").get("predictor", {}).get(
+                "ready_replicas"),
+            timeout=240, msg="embed replica ready (compiles encoder)",
+        )
+        r = await client.post(
+            "/serving/default/emb/openai/v1/embeddings",
+            json={"model": "emb", "input": ["hello tpu", "hello tpu",
+                                            "other"]},
+        )
+        assert r.status == 200, await r.text()
+        body = await r.json()
+        vecs = [d["embedding"] for d in body["data"]]
+        assert len(vecs) == 3 and len(vecs[0]) == 64  # bert-tiny hidden
+        assert vecs[0] == vecs[1] != vecs[2]
+        # V1 predict serves the same vectors (protocol parity).
+        r = await client.post(
+            "/serving/default/emb/v1/models/emb:predict",
+            json={"instances": ["hello tpu"]},
+        )
+        assert r.status == 200, await r.text()
+        assert (await r.json())["predictions"][0] == vecs[0]
+
+    loop.run_until_complete(run())
