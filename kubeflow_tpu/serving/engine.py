@@ -87,16 +87,73 @@ def _rope(x, freqs, positions):
     return out.reshape(x.shape).astype(x.dtype)
 
 
+def _kv_quantize(x):
+    """Per-(position, head) symmetric int8 over the last (D) axis:
+    x [..., KV, D] -> {"q": int8 same shape, "s": f32 [..., KV]}.
+
+    The KV-cache analog of quantize_packed: decode re-reads the whole
+    live cache every step, so int8 rows halve the second-largest HBM
+    stream after the weights (dominant at long contexts). Scales fold
+    into the attention SCORES (k) and PROBS (v) -- the cache-side
+    matmul operands stay int8 all the way to the MXU read."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x32 / s[..., None]), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def _kv_set(cache, idx, val, mode=None):
+    """cache.at[idx].set(val) for a plain bf16 cache or an int8-quantized
+    {"q","s"} cache (same leading index works for both leaves: "s" just
+    lacks the trailing D axis)."""
+    kw = {"mode": mode} if mode else {}
+    if isinstance(cache, dict):
+        qs = _kv_quantize(val)
+        return {"q": cache["q"].at[idx].set(qs["q"], **kw),
+                "s": cache["s"].at[idx].set(qs["s"], **kw)}
+    return cache.at[idx].set(val, **kw)
+
+
+def _kv_index(cache, idx):
+    """cache[idx] on both representations (leading-axis indexing only)."""
+    if isinstance(cache, dict):
+        return {"q": cache["q"][idx], "s": cache["s"][idx]}
+    return cache[idx]
+
+
+def _kv_nbytes(cache) -> int:
+    return int(sum(x.size * x.dtype.itemsize
+                   for x in jax.tree.leaves(cache)))
+
+
+def _kv_rows_len(rows) -> int:
+    return int((rows["q"] if isinstance(rows, dict) else rows).shape[1])
+
+
 def _gqa_attend(q, k, v, mask):
-    """q [B,S,N,D] over k/v [B,T,KV,D]; mask [B,S,T] True=visible."""
+    """q [B,S,N,D] over k/v [B,T,KV,D] -- or int8-quantized {"q","s"}
+    caches, whose scales are folded OUT of the big matmuls: k's scale
+    multiplies the scores, v's scale pre-multiplies the probs, so both
+    cache operands cross HBM as int8. mask [B,S,T] True=visible."""
     b, s, n, d = q.shape
-    kv = k.shape[2]
+    kq, ks = (k["q"], k["s"]) if isinstance(k, dict) else (k, None)
+    vq, vs = (v["q"], v["s"]) if isinstance(v, dict) else (v, None)
+    kv = kq.shape[2]
     q = q.reshape(b, s, kv, n // kv, d)
-    scores = jnp.einsum("bskgd,btkd->bkgst", q, k).astype(jnp.float32)
+    scores = jnp.einsum(
+        "bskgd,btkd->bkgst", q, kq.astype(q.dtype)
+    ).astype(jnp.float32)
+    if ks is not None:
+        scores = scores * ks.transpose(0, 2, 1)[:, :, None, None, :]
     scores = scores / np.sqrt(d)
     scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if vs is not None:
+        probs = probs * vs.transpose(0, 2, 1)[:, :, None, None, :]
+    out = jnp.einsum(
+        "bkgst,btkd->bskgd", probs.astype(q.dtype), vq.astype(q.dtype)
+    )
     return out.reshape(b, s, n, d)
 
 
@@ -345,9 +402,10 @@ def _insert(cache_k, cache_v, k_seq, v_seq, slots):
     O(K-buckets x len-buckets), not O(max_slots x len-buckets)."""
 
     s = k_seq.shape[2]
+    idx = (slice(None), slots, slice(None, s))
     return (
-        cache_k.at[:, slots, :s].set(k_seq, mode="drop"),
-        cache_v.at[:, slots, :s].set(v_seq, mode="drop"),
+        _kv_set(cache_k, idx, k_seq, mode="drop"),
+        _kv_set(cache_v, idx, v_seq, mode="drop"),
     )
 
 
@@ -374,7 +432,7 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths,
     # proxy, where cache reads are only ~19% of step bandwidth; see
     # ops/decode_attention.py for the full A/B. Default stays XLA.
     b = tokens.shape[0]
-    smax = cache_k.shape[2]
+    smax = (cache_k["q"] if isinstance(cache_k, dict) else cache_k).shape[2]
     kblock = min(256, smax)
     if smax % kblock:
         kernel = False  # non-pow2 max_seq: kernel tiling can't cover it
@@ -396,8 +454,8 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths,
         v = _pj("bsh,hnd->bsnd", h, lp["attn"]["v_proj"]["kernel"])
         q = _rope(q, freqs, positions)
         k = _rope(k, freqs, positions)
-        ck = ck.at[batch_idx, positions].set(k)
-        cv = cv.at[batch_idx, positions].set(v)
+        ck = _kv_set(ck, (batch_idx, positions), k)
+        cv = _kv_set(cv, (batch_idx, positions), v)
         if kernel:
             from kubeflow_tpu.ops.decode_attention import decode_attention
 
@@ -584,7 +642,7 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
 
     b = tokens.shape[0]
     k_rows = chunk_toks.shape[1]
-    smax = cache_k.shape[2]
+    smax = (cache_k["q"] if isinstance(cache_k, dict) else cache_k).shape[2]
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     batch_idx = jnp.arange(b)[:, None]
     row = chunk_slots[:, None]
@@ -600,10 +658,11 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
         v = _pj("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
         q = _rope(q, freqs, c_pos)
         k = _rope(k, freqs, c_pos)
-        ck = ck.at[row, c_pos].set(k, mode="drop")
-        cv = cv.at[row, c_pos].set(v, mode="drop")
-        keys = ck[chunk_slots, :klen]                     # [K,klen,KV,D]
-        vals = cv[chunk_slots, :klen]
+        ck = _kv_set(ck, (row, c_pos), k, mode="drop")
+        cv = _kv_set(cv, (row, c_pos), v, mode="drop")
+        sl = (chunk_slots, slice(None, klen))
+        keys = _kv_index(ck, sl)                          # [K,klen,KV,D]
+        vals = _kv_index(cv, sl)
         out = _gqa_attend(q, keys, vals, c_mask)
         out = _pj("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
         x_c = x_c + out
@@ -638,8 +697,8 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
             v = _pj("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
             q = _rope(q, freqs, dec_pos)
             k = _rope(k, freqs, dec_pos)
-            ck = ck.at[batch_idx, dec_pos].set(k)
-            cv = cv.at[batch_idx, dec_pos].set(v)
+            ck = _kv_set(ck, (batch_idx, dec_pos), k)
+            cv = _kv_set(cv, (batch_idx, dec_pos), v)
             out = _gqa_attend(q, ck, cv, dec_mask)
             out = _pj("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
             x_d = x_d + out
@@ -793,6 +852,14 @@ def tp_cache_sharding(mesh):
     )
 
 
+def tp_kv_scale_sharding(mesh):
+    """int8 KV-cache scale [L, B, Smax, KV]: same head split as the
+    cache it scales, so the scores/probs multiplies stay shard-local."""
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(None, None, None, "tensor")
+    )
+
+
 def _ngram_draft(hist, lens, k: int):
     """Prompt-lookup drafting, fully on device: for each row find the
     LATEST earlier occurrence of the trailing 2-gram in the token
@@ -849,7 +916,7 @@ def _spec_block(cfg: LlamaConfig, m_steps: int, k_draft: int, w: dict,
     """
 
     b = tokens.shape[0]
-    smax = cache_k.shape[2]
+    smax = (cache_k["q"] if isinstance(cache_k, dict) else cache_k).shape[2]
     s = k_draft + 1
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     batch_idx = jnp.arange(b)[:, None]
@@ -872,8 +939,8 @@ def _spec_block(cfg: LlamaConfig, m_steps: int, k_draft: int, w: dict,
             v = _pj("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
             q = _rope(q, freqs, positions)
             k = _rope(k, freqs, positions)
-            ck = ck.at[batch_idx, positions].set(k)
-            cv = cv.at[batch_idx, positions].set(v)
+            ck = _kv_set(ck, (batch_idx, positions), k)
+            cv = _kv_set(cv, (batch_idx, positions), v)
             out = _gqa_attend(q, ck, cv, mask)
             out = _pj("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
             x = x + out
@@ -1012,14 +1079,14 @@ class PrefixCache:
     def insert(self, prompt: Sequence[int], k_rows, v_rows) -> None:
         """Donate KV rows covering a block-multiple prefix of prompt.
         k_rows/v_rows: [L, plen, KV, D] device arrays."""
-        plen = int(k_rows.shape[1])
+        plen = _kv_rows_len(k_rows)
         hashes = self.chain_hashes(prompt, plen)
         if not hashes or hashes[-1][0] != plen:
             return
         full = hashes[-1][1]
         if full in self.entries:
             return  # already captured (the common repeated-prefix case)
-        size = k_rows.nbytes + v_rows.nbytes
+        size = _kv_nbytes(k_rows) + _kv_nbytes(v_rows)
         if size > self.capacity:
             return
         self._tick += 1
@@ -1125,6 +1192,7 @@ class GenerationEngine:
         speculative_k: int = 0,
         decode_attn_kernel: bool = False,
         quantize: Optional[str] = None,
+        kv_quant: Optional[str] = None,
     ) -> None:
         # Max decode steps fused into one device program (power-of-2
         # sub-blocks keep the compile count bounded); 1 = per-token
@@ -1187,6 +1255,14 @@ class GenerationEngine:
                 f"quantize={quantize!r}: supported values are 'int8'"
             )
         self.quantize = quantize or None
+        # int8 KV cache (see _kv_quantize): rows quantize on write,
+        # scales fold out of the attention matmuls on read. Independent
+        # of weight quantization; composes with it.
+        if kv_quant not in (None, "", "int8"):
+            raise ValueError(
+                f"kv_quant={kv_quant!r}: supported values are 'int8'"
+            )
+        self.kv_quant = kv_quant or None
         self._backlog: List[Request] = []  # engine-thread only
         cfg = config or PRESETS[preset]
         if max_seq is not None:
@@ -1275,16 +1351,22 @@ class GenerationEngine:
         kvshape = (cfg.n_layers, max_slots, cfg.max_seq, cfg.n_kv_heads,
                    cfg.head_dim)
         dt = jnp.dtype(cfg.dtype)
-        if mesh is not None:
-            self.cache_k = jnp.zeros(
-                kvshape, dt, device=tp_cache_sharding(mesh)
-            )
-            self.cache_v = jnp.zeros(
-                kvshape, dt, device=tp_cache_sharding(mesh)
-            )
+
+        def _zeros(shape, dtype, sharding):
+            if sharding is not None:
+                return jnp.zeros(shape, dtype, device=sharding)
+            return jnp.zeros(shape, dtype)
+
+        qsh = tp_cache_sharding(mesh) if mesh is not None else None
+        if self.kv_quant == "int8":
+            ssh = tp_kv_scale_sharding(mesh) if mesh is not None else None
+            self.cache_k = {"q": _zeros(kvshape, jnp.int8, qsh),
+                            "s": _zeros(kvshape[:-1], jnp.float32, ssh)}
+            self.cache_v = {"q": _zeros(kvshape, jnp.int8, qsh),
+                            "s": _zeros(kvshape[:-1], jnp.float32, ssh)}
         else:
-            self.cache_k = jnp.zeros(kvshape, dt)
-            self.cache_v = jnp.zeros(kvshape, dt)
+            self.cache_k = _zeros(kvshape, dt, qsh)
+            self.cache_v = _zeros(kvshape, dt, qsh)
         self.lengths = np.zeros(max_slots, np.int64)  # host-side bookkeeping
         # Token history per slot (prompt + generated), the draft source
         # for speculative decoding; host is the source of truth and the
@@ -1304,8 +1386,15 @@ class GenerationEngine:
         # the donated outputs, leaving the cache off its intended layout.
         if mesh is not None:
             csh = tp_cache_sharding(mesh)
+            scale_sh = tp_kv_scale_sharding(mesh)
 
             def _pin(t):
+                if isinstance(t, dict):  # int8 cache: pin each leaf
+                    return {
+                        "q": jax.lax.with_sharding_constraint(t["q"], csh),
+                        "s": jax.lax.with_sharding_constraint(
+                            t["s"], scale_sh),
+                    }
                 return jax.lax.with_sharding_constraint(t, csh)
         else:
             def _pin(t):
@@ -1316,7 +1405,11 @@ class GenerationEngine:
         prefill_jit = jax.jit(partial(_prefill, cfg))
         block_jits = {}
 
-        use_kernel = self.decode_attn_kernel and self.mesh is None
+        # The Pallas decode kernel reads bf16 cache rows; under int8 KV
+        # it would need its own dequant DMA path (not wired) -- ignore
+        # the flag, same as under a mesh.
+        use_kernel = (self.decode_attn_kernel and self.mesh is None
+                      and self.kv_quant is None)
 
         def _block_fn(n, filtered, want_lp):
             def fn(w, ck, cv, toks, lens, rng, temps, top_ks, top_ps):
@@ -1392,7 +1485,8 @@ class GenerationEngine:
         def extract_call(plen, slot):
             if plen not in extract_jits:
                 def fn(ck, cv, s):
-                    return ck[:, s, :plen], cv[:, s, :plen]
+                    idx = (slice(None), s, slice(None, plen))
+                    return _kv_index(ck, idx), _kv_index(cv, idx)
                 extract_jits[plen] = jax.jit(fn)
             return extract_jits[plen](self.cache_k, self.cache_v, slot)
 
@@ -1400,11 +1494,20 @@ class GenerationEngine:
         restore_jits = {}
 
         def restore_call(ck, cv, pk, pv, slot, plen):
-            key = (plen, pk.shape[1])
+            key = (plen, _kv_rows_len(pk))
             if key not in restore_jits:
                 def fn(ck, cv, pk, pv, s):
-                    ck = ck.at[:, s, :plen].set(pk[:, :plen])
-                    cv = cv.at[:, s, :plen].set(pv[:, :plen])
+                    idx = (slice(None), s, slice(None, plen))
+                    if isinstance(ck, dict):
+                        # Stored rows are already quantized (extracted
+                        # from a quantized cache): raw copy, no requant.
+                        ck = {"q": ck["q"].at[idx].set(pk["q"][:, :plen]),
+                              "s": ck["s"].at[idx].set(pk["s"][:, :plen])}
+                        cv = {"q": cv["q"].at[idx].set(pv["q"][:, :plen]),
+                              "s": cv["s"].at[idx].set(pv["s"][:, :plen])}
+                    else:
+                        ck = ck.at[idx].set(pk[:, :plen])
+                        cv = cv.at[idx].set(pv[:, :plen])
                     return _pin(ck), _pin(cv)
                 restore_jits[key] = jax.jit(fn, donate_argnums=(0, 1))
             return restore_jits[key](ck, cv, pk, pv, slot)
@@ -1829,6 +1932,12 @@ class GenerationEngine:
                     x.size * x.dtype.itemsize
                     for x in jax.tree.leaves(self.weights)
                 ))
+        if self.kv_quant:
+            out["kv_quant"] = self.kv_quant
+            if self.cache_k is not None:
+                out["kv_cache_bytes"] = (
+                    _kv_nbytes(self.cache_k) + _kv_nbytes(self.cache_v)
+                )
         if self.speculative_k:
             out["spec"] = {
                 "k": self.speculative_k,

@@ -155,23 +155,33 @@ class JaxEmbedModel(Model):
             raise InferenceError("empty embedding input", 400)
         return ids[: self.max_seq]
 
+    # Device-batch row cap: OpenAI clients legitimately send thousands
+    # of inputs in one request; an unchunked [next_pow2(N), S] batch
+    # would OOM or trigger a fresh compile per batch bucket. 64 rows of
+    # max_seq tokens is well inside one chip's activation budget.
+    MAX_ROWS = 64
+
     def predict(self, instances: Sequence[Any]) -> List[Any]:
         import numpy as np
 
         seqs = [self._ids(i) for i in instances]
-        # One padded batch per call, bucketed: compile count stays
-        # O(#len-buckets x #batch-buckets).
-        s = _bucket(max(len(x) for x in seqs), self.max_seq)
-        b = 1
-        while b < len(seqs):
-            b *= 2
-        tokens = np.zeros((b, s), np.int32)
-        mask = np.zeros((b, s), bool)
-        for i, ids in enumerate(seqs):
-            tokens[i, : len(ids)] = ids
-            mask[i, : len(ids)] = True
-        out = np.asarray(self._embed(self._params, tokens, mask))
-        return [out[i].tolist() for i in range(len(seqs))]
+        out: List[Any] = []
+        for lo in range(0, len(seqs), self.MAX_ROWS):
+            chunk = seqs[lo:lo + self.MAX_ROWS]
+            # One padded batch per chunk, bucketed both ways: compile
+            # count stays O(#len-buckets x #batch-buckets <= 7x7).
+            s = _bucket(max(len(x) for x in chunk), self.max_seq)
+            b = 1
+            while b < len(chunk):
+                b *= 2
+            tokens = np.zeros((b, s), np.int32)
+            mask = np.zeros((b, s), bool)
+            for i, ids in enumerate(chunk):
+                tokens[i, : len(ids)] = ids
+                mask[i, : len(ids)] = True
+            vecs = np.asarray(self._embed(self._params, tokens, mask))
+            out.extend(vecs[i].tolist() for i in range(len(chunk)))
+        return out
 
 
 def _restore_bert_params(path: str, model) -> dict:

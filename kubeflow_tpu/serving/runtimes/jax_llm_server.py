@@ -38,6 +38,10 @@ Options (ModelSpec.options):
 - ``quantize``: "int8" for weight-only int8 serving (per-output-channel
   scales; halves weight HBM bytes and footprint, KV cache stays bf16).
   Default off. The reference's quantized-variant analog (vLLM int8).
+- ``kv_quant``: "int8" for an int8 KV cache (per-position-per-head
+  scales folded out of the attention matmuls; halves cache HBM reads
+  and footprint -- the long-context lever). Composes with ``quantize``;
+  the vLLM kv-cache-dtype analog. Default off.
 """
 
 from __future__ import annotations
@@ -264,6 +268,7 @@ class JaxLLMModel(Model):
             speculative_k=int(opts.get("speculative_k", 0)),
             decode_attn_kernel=bool(opts.get("decode_attn_kernel", False)),
             quantize=opts.get("quantize") or None,
+            kv_quant=opts.get("kv_quant") or None,
             mesh=mesh,
         )
         if config is not None:
@@ -342,6 +347,12 @@ class JaxLLMModel(Model):
                 f"kftpu_engine_weight_bytes"
                 f'{{{lab},quantize="{_esc(s["quantize"])}"}} '
                 f"{s['weight_bytes']}"
+            )
+        if "kv_cache_bytes" in s:
+            lines.append(
+                f"kftpu_engine_kv_cache_bytes"
+                f'{{{lab},kv_quant="{_esc(s["kv_quant"])}"}} '
+                f"{s['kv_cache_bytes']}"
             )
         sp = s.get("spec")
         if sp is not None:

@@ -686,8 +686,13 @@ class ModelServer:
                     '"input" must be a string, a list of strings, or '
                     "token-id array(s)", 400,
                 )
-            vecs = await asyncio.get_running_loop().run_in_executor(
-                None, model.predict, items
+            # Through the model's Batcher, like the V1 route: the
+            # repository's eviction guard watches batcher.inflight, so
+            # an LRU unload cannot null the model mid-request; same-model
+            # requests also coalesce into one device batch.
+            batcher = self.repository.batcher(name)
+            vecs = await asyncio.gather(
+                *(batcher.predict(i) for i in items)
             )
             for v in vecs:
                 if not isinstance(v, list) or (
