@@ -40,6 +40,17 @@ margin. The engine therefore keeps full-span XLA as the default
 implementation, and double-buffering + head-batched matmuls are the
 known path if a config with a larger cache:weights ratio (more slots,
 longer Smax, smaller model) makes the span bound matter.
+
+int8-cache variant, MEASURED (r4, same chip, 64 slots, 1024-token
+prompts, 256 new): throughput 760 tok/s vs 966 for the XLA int8 path
+and 921 bf16 XLA -- the kernel's fixed deficit above dominates (the
+bf16 kernel measures 761 on the same workload: format-independent).
+Where it WINS is capacity: the XLA int8-KV read materializes a bf16
+copy of the cache as a temp (12.3 GB for a 128-slot Smax=2048 decode
+block -- memory_analysis r4), so 128 slots @ 2048 OOMs in every XLA
+config; this kernel's VMEM dequant runs it at 1,087 tok/s. The engine
+rule of thumb: kv_quant + decode_attn_kernel when the bf16 cache
+wouldn't fit; plain XLA otherwise.
 """
 
 from __future__ import annotations
@@ -58,7 +69,7 @@ DEFAULT_BLOCK = 256
 
 
 def _kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref,
-            k_vmem, v_vmem, sem_k, sem_v, *, block: int, smax: int):
+            k_vmem, v_vmem, sem_k, sem_v, *, block: int):
     b = pl.program_id(0)
     span = pos_ref[b] + 1
     nb = pl.cdiv(span, block)
@@ -80,41 +91,106 @@ def _kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref,
         cv.wait()
         kblk = k_vmem[...].astype(jnp.float32)  # [block, KV, D]
         vblk = v_vmem[...].astype(jnp.float32)
-        mask = j * block + jax.lax.broadcasted_iota(
+        return _flash_update(q, kblk, vblk, mask_base(j), m, l, acc,
+                             kv_heads, scale)
+
+    def mask_base(j):
+        return j * block + jax.lax.broadcasted_iota(
             jnp.int32, (g, block), 1
         ) < span
-        # Per-KV-head 2D matmuls, python-unrolled: Mosaic rejects the
-        # batched dot_general form ("batch dims must be equal").
-        # HIGHEST keeps f32 operands exact (the default would downcast
-        # them to bf16); production bf16 caches are unaffected.
-        ms, ls, accs = [], [], []
-        for kv in range(kv_heads):
-            s = jax.lax.dot_general(
-                q[kv], kblk[:, kv, :],              # [G,D] x [block,D]
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            ) * scale                               # [G, block]
-            s = jnp.where(mask, s, -jnp.inf)
-            m_new = jnp.maximum(m[kv], s.max(axis=-1, keepdims=True))
-            p = jnp.exp(s - m_new)
-            alpha = jnp.exp(m[kv] - m_new)
-            ls.append(l[kv] * alpha + p.sum(axis=-1, keepdims=True))
-            pv = jax.lax.dot_general(
-                p, vblk[:, kv, :],                  # [G,block] x [block,D]
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            )                                       # [G, D]
-            ms.append(m_new)
-            accs.append(acc[kv] * alpha + pv)
-        return jnp.stack(ms), jnp.stack(ls), jnp.stack(accs)
 
     m0 = jnp.full((kv_heads, g, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((kv_heads, g, 1), jnp.float32)
     a0 = jnp.zeros((kv_heads, g, d), jnp.float32)
     _, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, a0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _int8_kernel(pos_ref, q_ref, k_hbm, ks_hbm, v_hbm, vs_hbm, o_ref,
+                 k_vmem, ks_vmem, v_vmem, vs_vmem,
+                 sem_k, sem_ks, sem_v, sem_vs, *, block: int):
+    """int8-cache variant: DMAs int8 rows (HALF the bf16 kernel's HBM
+    traffic) plus their [block, KV] f32 scales, dequantizes in VMEM.
+    This is the fix for the XLA int8-KV path's materialization: under
+    jit the astype+scale of a scan-carried cache materializes a full
+    bf16 copy as a temp (measured: 12.3 GB temp for a 128-slot
+    Smax=2048 8B-proxy decode block -- worse than the bf16 cache it
+    replaced); here the dequant never leaves VMEM."""
+    b = pl.program_id(0)
+    span = pos_ref[b] + 1
+    nb = pl.cdiv(span, block)
+    q = q_ref[0].astype(jnp.float32)            # [KV, G, D]
+    kv_heads, g, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+
+    def body(j, carry):
+        m, l, acc = carry
+        # Scales arrive [B, KV, Smax] (engine transposes the [B,Smax,KV]
+        # cache layout per layer -- 4 MB, free): Smax as the minor dim
+        # makes the [KV, block] slice lane-aligned; a [block, KV] slice
+        # of the storage layout is not DMA-able (KV=8 < the 128-lane
+        # tile).
+        copies = [
+            pltpu.make_async_copy(
+                k_hbm.at[b, pl.ds(j * block, block)], k_vmem, sem_k),
+            pltpu.make_async_copy(
+                ks_hbm.at[b, :, pl.ds(j * block, block)], ks_vmem,
+                sem_ks),
+            pltpu.make_async_copy(
+                v_hbm.at[b, pl.ds(j * block, block)], v_vmem, sem_v),
+            pltpu.make_async_copy(
+                vs_hbm.at[b, :, pl.ds(j * block, block)], vs_vmem,
+                sem_vs),
+        ]
+        for c in copies:
+            c.start()
+        for c in copies:
+            c.wait()
+        kblk = (k_vmem[...].astype(jnp.float32)
+                * ks_vmem[...].T[..., None])    # [block, KV, D]
+        vblk = (v_vmem[...].astype(jnp.float32)
+                * vs_vmem[...].T[..., None])
+        mask = j * block + jax.lax.broadcasted_iota(
+            jnp.int32, (g, block), 1
+        ) < span
+        return _flash_update(q, kblk, vblk, mask, m, l, acc,
+                             kv_heads, scale)
+
+    m0 = jnp.full((kv_heads, g, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((kv_heads, g, 1), jnp.float32)
+    a0 = jnp.zeros((kv_heads, g, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, a0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_update(q, kblk, vblk, mask, m, l, acc, kv_heads, scale):
+    """One online-softmax flash-attention update over a dequantized
+    [block, KV, D] f32 chunk (shared by the bf16 and int8 kernels).
+    Per-KV-head 2D matmuls, python-unrolled: Mosaic rejects the batched
+    dot_general form ("batch dims must be equal"). HIGHEST keeps f32
+    operands exact (the default would downcast them to bf16)."""
+    ms, ls, accs = [], [], []
+    for kv in range(kv_heads):
+        s = jax.lax.dot_general(
+            q[kv], kblk[:, kv, :],              # [G,D] x [block,D]
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * scale                               # [G, block]
+        s = jnp.where(mask, s, -jnp.inf)
+        m_new = jnp.maximum(m[kv], s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m[kv] - m_new)
+        ls.append(l[kv] * alpha + p.sum(axis=-1, keepdims=True))
+        pv = jax.lax.dot_general(
+            p, vblk[:, kv, :],                  # [G,block] x [block,D]
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                       # [G, D]
+        ms.append(m_new)
+        accs.append(acc[kv] * alpha + pv)
+    return jnp.stack(ms), jnp.stack(ls), jnp.stack(accs)
 
 
 @functools.partial(
@@ -150,7 +226,7 @@ def decode_attention(q, cache_k, cache_v, positions,
             pltpu.SemaphoreType.DMA,
         ],
     )
-    kernel = functools.partial(_kernel, block=block, smax=smax)
+    kernel = functools.partial(_kernel, block=block)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -160,3 +236,55 @@ def decode_attention(q, cache_k, cache_v, positions,
             dimension_semantics=("arbitrary",),
         ),
     )(positions.astype(jnp.int32), q, cache_k, cache_v)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "interpret")
+)
+def decode_attention_int8(q, ck_q, ck_s, cv_q, cv_s, positions,
+                          block: int = DEFAULT_BLOCK,
+                          interpret: bool = False):
+    """Bounded-span GQA decode attention over an int8-quantized cache
+    (engine kv_quant="int8": rows int8 [B, Smax, KV, D], scales handed
+    in TRANSPOSED as [B, KV, Smax] for lane-aligned DMA). DMAs int8
+    rows -- half the bf16 kernel's cache traffic -- and dequantizes in
+    VMEM, which is the only way to read a quantized cache without XLA
+    materializing the bf16 copy (see _int8_kernel's docstring for the
+    measured temp blowup)."""
+    b, smax, kv_heads, d = ck_q.shape
+    if smax % block:
+        raise ValueError(f"Smax={smax} not a multiple of block={block}")
+    g = q.shape[2]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, kv_heads, g, d), lambda i, pos: (i, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # ck_q stays HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),   # ck_s [B, KV, Smax]
+            pl.BlockSpec(memory_space=pltpu.ANY),   # cv_q
+            pl.BlockSpec(memory_space=pltpu.ANY),   # cv_s [B, KV, Smax]
+        ],
+        out_specs=pl.BlockSpec((1, kv_heads, g, d),
+                               lambda i, pos: (i, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block, kv_heads, d), jnp.int8),
+            pltpu.VMEM((kv_heads, block), jnp.float32),
+            pltpu.VMEM((block, kv_heads, d), jnp.int8),
+            pltpu.VMEM((kv_heads, block), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    kernel = functools.partial(_int8_kernel, block=block)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+    )(positions.astype(jnp.int32), q, ck_q, ck_s, cv_q, cv_s)

@@ -127,6 +127,11 @@ def _kv_nbytes(cache) -> int:
                    for x in jax.tree.leaves(cache)))
 
 
+def _kv_smax(cache) -> int:
+    """Cache sequence capacity on both representations."""
+    return (cache["q"] if isinstance(cache, dict) else cache).shape[2]
+
+
 def _kv_rows_len(rows) -> int:
     return int((rows["q"] if isinstance(rows, dict) else rows).shape[1])
 
@@ -432,7 +437,7 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths,
     # proxy, where cache reads are only ~19% of step bandwidth; see
     # ops/decode_attention.py for the full A/B. Default stays XLA.
     b = tokens.shape[0]
-    smax = (cache_k["q"] if isinstance(cache_k, dict) else cache_k).shape[2]
+    smax = _kv_smax(cache_k)
     kblock = min(256, smax)
     if smax % kblock:
         kernel = False  # non-pow2 max_seq: kernel tiling can't cover it
@@ -457,15 +462,29 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths,
         ck = _kv_set(ck, (batch_idx, positions), k)
         cv = _kv_set(cv, (batch_idx, positions), v)
         if kernel:
-            from kubeflow_tpu.ops.decode_attention import decode_attention
+            from kubeflow_tpu.ops.decode_attention import (
+                decode_attention,
+                decode_attention_int8,
+            )
 
             n = q.shape[2]
             kvh = cfg.n_kv_heads
             qg = q[:, 0].reshape(b, kvh, n // kvh, cfg.head_dim)
-            out = decode_attention(
-                qg, ck, cv, lengths, block=kblock,
-                interpret=jax.default_backend() != "tpu",
-            ).reshape(b, 1, n, cfg.head_dim)
+            interp = jax.default_backend() != "tpu"
+            if isinstance(ck, dict):
+                # Scales transpose to [B, KV, Smax] for the kernel's
+                # lane-aligned DMA (4 MB per layer -- free next to the
+                # cache reads it unlocks).
+                out = decode_attention_int8(
+                    qg, ck["q"], ck["s"].transpose(0, 2, 1),
+                    cv["q"], cv["s"].transpose(0, 2, 1), lengths,
+                    block=kblock, interpret=interp,
+                )
+            else:
+                out = decode_attention(
+                    qg, ck, cv, lengths, block=kblock, interpret=interp,
+                )
+            out = out.reshape(b, 1, n, cfg.head_dim)
         else:
             out = _gqa_attend(q, ck, cv, mask)
         out = _pj("bsnd,ndh->bsh", out, lp["attn"]["o_proj"]["kernel"])
@@ -642,7 +661,7 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
 
     b = tokens.shape[0]
     k_rows = chunk_toks.shape[1]
-    smax = (cache_k["q"] if isinstance(cache_k, dict) else cache_k).shape[2]
+    smax = _kv_smax(cache_k)
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     batch_idx = jnp.arange(b)[:, None]
     row = chunk_slots[:, None]
@@ -916,7 +935,7 @@ def _spec_block(cfg: LlamaConfig, m_steps: int, k_draft: int, w: dict,
     """
 
     b = tokens.shape[0]
-    smax = (cache_k["q"] if isinstance(cache_k, dict) else cache_k).shape[2]
+    smax = _kv_smax(cache_k)
     s = k_draft + 1
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     batch_idx = jnp.arange(b)[:, None]
@@ -1405,11 +1424,19 @@ class GenerationEngine:
         prefill_jit = jax.jit(partial(_prefill, cfg))
         block_jits = {}
 
-        # The Pallas decode kernel reads bf16 cache rows; under int8 KV
-        # it would need its own dequant DMA path (not wired) -- ignore
-        # the flag, same as under a mesh.
-        use_kernel = (self.decode_attn_kernel and self.mesh is None
-                      and self.kv_quant is None)
+        # Under int8 KV the kernel routes to decode_attention_int8
+        # (int8 DMA + VMEM dequant) -- on that path the kernel is not
+        # just bounded-span, it is the only reader that avoids XLA
+        # materializing a bf16 copy of the cache.
+        use_kernel = self.decode_attn_kernel and self.mesh is None
+        if (use_kernel and self.kv_quant
+                and jax.default_backend() == "tpu"
+                and (cfg.n_kv_heads % 4 or cfg.head_dim % 128)):
+            # Mosaic's int8 VMEM tiling needs KV a multiple of 4 and a
+            # 128-lane head_dim (llama-tiny's KV=2 fails to compile,
+            # measured r4); fall back to the XLA quantized path rather
+            # than crash the server at warmup.
+            use_kernel = False
 
         def _block_fn(n, filtered, want_lp):
             def fn(w, ck, cv, toks, lens, rng, temps, top_ks, top_ps):

@@ -686,6 +686,20 @@ class ModelServer:
                     '"input" must be a string, a list of strings, or '
                     "token-id array(s)", 400,
                 )
+            # Validate each item BEFORE enqueueing: the Batcher coalesces
+            # concurrent requests into one predict batch and fails the
+            # whole batch on any exception, so a malformed item must be
+            # rejected here or it poisons other clients' requests.
+            for i, item in enumerate(items):
+                ok = (isinstance(item, str) and item) or (
+                    isinstance(item, (list, tuple)) and item
+                    and all(isinstance(t, int) for t in item)
+                )
+                if not ok:
+                    raise InferenceError(
+                        f"input[{i}] must be a non-empty string or "
+                        "token-id list", 400,
+                    )
             # Through the model's Batcher, like the V1 route: the
             # repository's eviction guard watches batcher.inflight, so
             # an LRU unload cannot null the model mid-request; same-model

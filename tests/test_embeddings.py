@@ -238,3 +238,18 @@ def test_jax_embed_unknown_checkpoint_rejected():
     m = JaxEmbedModel("e", None, dict(TINY, checkpoint="latest"))
     with pytest.raises(InferenceError, match="checkpoint"):
         m.load()
+
+
+def test_mixed_validity_batch_rejected_before_batcher(embed_client):
+    """A request carrying one malformed item is rejected up front (400)
+    -- it must never reach the Batcher where it would poison other
+    clients' coalesced requests."""
+    c, loop = embed_client
+
+    async def go():
+        r = await c.post("/openai/v1/embeddings",
+                         json={"model": "emb", "input": ["ok", ""]})
+        return r.status, await r.json()
+
+    status, body = loop.run_until_complete(go())
+    assert status == 400 and "input[1]" in body["error"]

@@ -1122,10 +1122,16 @@ class TestKVQuantized:
         with pytest.raises(ValueError, match="kv_quant"):
             GenerationEngine(config=cfg, params=params, kv_quant="fp8")
 
-    def test_kernel_flag_ignored_under_kv_quant(self, tiny):
-        """decode_attn_kernel reads bf16 rows; with an int8 cache the
-        engine must fall back to the XLA path, not crash."""
+    def test_int8_kernel_matches_xla_path(self, tiny):
+        """decode_attn_kernel under kv_quant routes to the int8 Pallas
+        kernel (int8 DMA + VMEM dequant); its tokens must match the XLA
+        quantized path exactly -- both attend the SAME quantized rows,
+        so this is an exactness oracle, not a closeness one."""
         cfg, _, _, params = tiny
-        eng = GenerationEngine(config=cfg, params=params, max_slots=2,
-                               kv_quant="int8", decode_attn_kernel=True)
-        assert len(eng.generate([1, 2, 3], max_new_tokens=4)) == 4
+        plain = GenerationEngine(config=cfg, params=params, max_slots=2,
+                                 kv_quant="int8")
+        kern = GenerationEngine(config=cfg, params=params, max_slots=2,
+                                kv_quant="int8", decode_attn_kernel=True)
+        for prompt in ([1, 2, 3], list(range(1, 40))):
+            assert kern.generate(list(prompt), max_new_tokens=10) == \
+                plain.generate(list(prompt), max_new_tokens=10)
