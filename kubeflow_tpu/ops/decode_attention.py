@@ -48,7 +48,8 @@ bf16 kernel measures 761 on the same workload: format-independent).
 Where it WINS is capacity: the XLA int8-KV read materializes a bf16
 copy of the cache as a temp (12.3 GB for a 128-slot Smax=2048 decode
 block -- memory_analysis r4), so 128 slots @ 2048 OOMs in every XLA
-config; this kernel's VMEM dequant runs it at 1,087 tok/s. The engine
+config; this kernel's VMEM dequant runs it at 1,083-1,097 tok/s
+(SERVING_BENCH.json kv_capacity records the artifact run). The engine
 rule of thumb: kv_quant + decode_attn_kernel when the bf16 cache
 wouldn't fit; plain XLA otherwise.
 """
