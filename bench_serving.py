@@ -230,6 +230,26 @@ def bench_quantized(max_slots: int) -> dict:
     }
 
 
+def _clean_error(msg: str) -> str:
+    """Artifact-safe error text: strip ANSI codes from tunnel log dumps
+    and keep the ROOT-CAUSE line (the OOM/compiler error), not just the
+    first chars of a wrapper exception."""
+    import re
+
+    msg = re.sub(r"\x1b\[[0-9;]*m", "", msg)
+    lines = msg.splitlines() or [""]
+    root = next(
+        (ln.strip() for ln in lines
+         if "RESOURCE_EXHAUSTED" in ln or "Mosaic" in ln
+         or "out of memory" in ln or "Exceeded" in ln or "OOM" in ln),
+        "",
+    )
+    head = lines[0][:160]
+    if root and root not in lines[0]:
+        head += " ... " + root[:200]
+    return head
+
+
 def bench_kv_capacity(config: str = "int8+kv+kernel") -> dict:
     """The int8-KV capacity unlock: 128 slots x Smax=2048 on the 8B
     proxy needs a 17 GB bf16 cache (OOM on one 16 GB chip, and the XLA
@@ -272,26 +292,16 @@ def bench_kv_capacity(config: str = "int8+kv+kernel") -> dict:
             return {"config": tag, "tokens_per_sec": round(gen / dt, 1)}
         except Exception as e:  # noqa: BLE001 - OOM is the expected
             gc.collect()       # outcome for the bf16 control
-            import re
-
-            # The artifact must carry the ROOT CAUSE (the OOM line),
-            # not the first 120 chars of a wrapped remote-compile error
-            # with ANSI codes from the tunnel's log dump.
-            msg = re.sub(r"\x1b\[[0-9;]*m", "",
-                         f"{type(e).__name__}: {e}")
-            root = next(
-                (ln.strip() for ln in msg.splitlines()
-                 if "RESOURCE_EXHAUSTED" in ln or "Mosaic" in ln
-                 or "out of memory" in ln or "Exceeded" in ln
-                 or "OOM" in ln), "",
-            )
-            head = msg.splitlines()[0][:160]
-            if root and root not in head:
-                head += " ... " + root[:200]
-            return {"config": tag, "error": head}
+            return {"config": tag, "error": _clean_error(
+                f"{type(e).__name__}: {e}")}
 
     if config == "bf16":
         return run("bf16")
+    if config != "int8+kv+kernel":
+        raise SystemExit(
+            f"unknown kv_capacity config {config!r} "
+            "(bf16 | int8+kv+kernel)"
+        )
     return run("int8+kv+kernel", quantize="int8", kv_quant="int8",
                decode_attn_kernel=True)
 
@@ -561,10 +571,10 @@ def _run_phase(name: str, args: dict, timeout: int = 3000):
                 continue
         raise RuntimeError(
             f"no JSON from phase (rc={proc.returncode}): "
-            + proc.stderr[-300:]
+            + _clean_error(proc.stderr.strip() or "empty stderr")
         )
     except Exception as e:  # noqa: BLE001 - one phase must not kill the run
-        return {"error": f"{type(e).__name__}: {e}"[:300]}
+        return {"error": _clean_error(f"{type(e).__name__}: {e}")}
 
 
 def main() -> int:

@@ -41,17 +41,20 @@ implementation, and double-buffering + head-batched matmuls are the
 known path if a config with a larger cache:weights ratio (more slots,
 longer Smax, smaller model) makes the span bound matter.
 
-int8-cache variant, MEASURED (r4, same chip, 64 slots, 1024-token
-prompts, 256 new): throughput 760 tok/s vs 966 for the XLA int8 path
-and 921 bf16 XLA -- the kernel's fixed deficit above dominates (the
-bf16 kernel measures 761 on the same workload: format-independent).
-Where it WINS is capacity: the XLA int8-KV read materializes a bf16
-copy of the cache as a temp (12.3 GB for a 128-slot Smax=2048 decode
-block -- memory_analysis r4), so 128 slots @ 2048 OOMs in every XLA
-config; this kernel's VMEM dequant runs it at 1,083-1,097 tok/s
-(SERVING_BENCH.json kv_capacity records the artifact run). The engine
-rule of thumb: kv_quant + decode_attn_kernel when the bf16 cache
-wouldn't fit; plain XLA otherwise.
+int8-cache variant + double-buffered DMA, MEASURED (r4, same chip, 64
+slots, 1024-token prompts, 256 new): double-buffering (compute block j
+while j+1 streams) recovered +10% on the bf16 kernel (761 -> 836
+tok/s) and +5.5% on int8 (760 -> 802), but XLA full-span still leads
+where it can run (934 bf16 / 987 int8 on that workload) -- the
+remaining deficit is the per-KV-head [G=4, D] matmuls' MXU
+utilization plus pallas_call overhead inside the layer scan. Where the
+kernel WINS is capacity: the XLA int8-KV read materializes a bf16 copy
+of the cache as a temp (12.3 GB for a 128-slot Smax=2048 decode block
+-- memory_analysis r4), so 128 slots @ 2048 OOMs in every XLA config
+('Used 22.24G of 15.75G hbm'); this kernel's VMEM dequant runs it at
+1,125 tok/s (SERVING_BENCH.json kv_capacity). The engine rule of
+thumb: kv_quant + decode_attn_kernel when the bf16 cache wouldn't fit;
+plain XLA otherwise.
 """
 
 from __future__ import annotations
@@ -78,27 +81,42 @@ def _kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref,
     kv_heads, g, d = q.shape
     scale = 1.0 / (d ** 0.5)
 
+    # Double-buffered: VMEM scratch carries TWO [block, KV, D] buffers;
+    # iteration j computes on buffer j%2 while block j+1 streams into
+    # the other -- the DMA latency the single-buffered kernel exposed
+    # serially (its measured ~20% deficit vs XLA full-span) overlaps
+    # with the flash update.
+    def _copies(j, slot):
+        return (
+            pltpu.make_async_copy(
+                k_hbm.at[b, pl.ds(j * block, block)],
+                k_vmem.at[slot], sem_k.at[slot]),
+            pltpu.make_async_copy(
+                v_hbm.at[b, pl.ds(j * block, block)],
+                v_vmem.at[slot], sem_v.at[slot]),
+        )
+
+    for c in _copies(0, 0):
+        c.start()
+
     def body(j, carry):
         m, l, acc = carry
-        ck = pltpu.make_async_copy(
-            k_hbm.at[b, pl.ds(j * block, block)], k_vmem, sem_k
-        )
-        cv = pltpu.make_async_copy(
-            v_hbm.at[b, pl.ds(j * block, block)], v_vmem, sem_v
-        )
-        ck.start()
-        cv.start()
-        ck.wait()
-        cv.wait()
-        kblk = k_vmem[...].astype(jnp.float32)  # [block, KV, D]
-        vblk = v_vmem[...].astype(jnp.float32)
-        return _flash_update(q, kblk, vblk, mask_base(j), m, l, acc,
-                             kv_heads, scale)
+        slot = jax.lax.rem(j, 2)
 
-    def mask_base(j):
-        return j * block + jax.lax.broadcasted_iota(
+        @pl.when(j + 1 < nb)
+        def _():
+            for c in _copies(j + 1, 1 - slot):
+                c.start()
+
+        for c in _copies(j, slot):
+            c.wait()
+        kblk = k_vmem[slot].astype(jnp.float32)  # [block, KV, D]
+        vblk = v_vmem[slot].astype(jnp.float32)
+        mask = j * block + jax.lax.broadcasted_iota(
             jnp.int32, (g, block), 1
         ) < span
+        return _flash_update(q, kblk, vblk, mask, m, l, acc,
+                             kv_heads, scale)
 
     m0 = jnp.full((kv_heads, g, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((kv_heads, g, 1), jnp.float32)
@@ -124,33 +142,45 @@ def _int8_kernel(pos_ref, q_ref, k_hbm, ks_hbm, v_hbm, vs_hbm, o_ref,
     kv_heads, g, d = q.shape
     scale = 1.0 / (d ** 0.5)
 
+    # Scales arrive [B, KV, Smax] (engine transposes the [B,Smax,KV]
+    # cache layout per layer -- 4 MB, free): Smax as the minor dim
+    # makes the [KV, block] slice lane-aligned; a [block, KV] slice of
+    # the storage layout is not DMA-able (KV=8 < the 128-lane tile).
+    # Double-buffered like _kernel: compute on j%2, stream j+1.
+    def _copies(j, slot):
+        return (
+            pltpu.make_async_copy(
+                k_hbm.at[b, pl.ds(j * block, block)],
+                k_vmem.at[slot], sem_k.at[slot]),
+            pltpu.make_async_copy(
+                ks_hbm.at[b, :, pl.ds(j * block, block)],
+                ks_vmem.at[slot], sem_ks.at[slot]),
+            pltpu.make_async_copy(
+                v_hbm.at[b, pl.ds(j * block, block)],
+                v_vmem.at[slot], sem_v.at[slot]),
+            pltpu.make_async_copy(
+                vs_hbm.at[b, :, pl.ds(j * block, block)],
+                vs_vmem.at[slot], sem_vs.at[slot]),
+        )
+
+    for c in _copies(0, 0):
+        c.start()
+
     def body(j, carry):
         m, l, acc = carry
-        # Scales arrive [B, KV, Smax] (engine transposes the [B,Smax,KV]
-        # cache layout per layer -- 4 MB, free): Smax as the minor dim
-        # makes the [KV, block] slice lane-aligned; a [block, KV] slice
-        # of the storage layout is not DMA-able (KV=8 < the 128-lane
-        # tile).
-        copies = [
-            pltpu.make_async_copy(
-                k_hbm.at[b, pl.ds(j * block, block)], k_vmem, sem_k),
-            pltpu.make_async_copy(
-                ks_hbm.at[b, :, pl.ds(j * block, block)], ks_vmem,
-                sem_ks),
-            pltpu.make_async_copy(
-                v_hbm.at[b, pl.ds(j * block, block)], v_vmem, sem_v),
-            pltpu.make_async_copy(
-                vs_hbm.at[b, :, pl.ds(j * block, block)], vs_vmem,
-                sem_vs),
-        ]
-        for c in copies:
-            c.start()
-        for c in copies:
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < nb)
+        def _():
+            for c in _copies(j + 1, 1 - slot):
+                c.start()
+
+        for c in _copies(j, slot):
             c.wait()
-        kblk = (k_vmem[...].astype(jnp.float32)
-                * ks_vmem[...].T[..., None])    # [block, KV, D]
-        vblk = (v_vmem[...].astype(jnp.float32)
-                * vs_vmem[...].T[..., None])
+        kblk = (k_vmem[slot].astype(jnp.float32)
+                * ks_vmem[slot].T[..., None])   # [block, KV, D]
+        vblk = (v_vmem[slot].astype(jnp.float32)
+                * vs_vmem[slot].T[..., None])
         mask = j * block + jax.lax.broadcasted_iota(
             jnp.int32, (g, block), 1
         ) < span
@@ -221,10 +251,10 @@ def decode_attention(q, cache_k, cache_v, positions,
         out_specs=pl.BlockSpec((1, kv_heads, g, d),
                                lambda i, pos: (i, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((block, kv_heads, d), cache_k.dtype),
-            pltpu.VMEM((block, kv_heads, d), cache_v.dtype),
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((2, block, kv_heads, d), cache_k.dtype),
+            pltpu.VMEM((2, block, kv_heads, d), cache_v.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
     )
     kernel = functools.partial(_kernel, block=block)
@@ -269,14 +299,14 @@ def decode_attention_int8(q, ck_q, ck_s, cv_q, cv_s, positions,
         out_specs=pl.BlockSpec((1, kv_heads, g, d),
                                lambda i, pos: (i, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((block, kv_heads, d), jnp.int8),
-            pltpu.VMEM((kv_heads, block), jnp.float32),
-            pltpu.VMEM((block, kv_heads, d), jnp.int8),
-            pltpu.VMEM((kv_heads, block), jnp.float32),
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((2, block, kv_heads, d), jnp.int8),
+            pltpu.VMEM((2, kv_heads, block), jnp.float32),
+            pltpu.VMEM((2, block, kv_heads, d), jnp.int8),
+            pltpu.VMEM((2, kv_heads, block), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
     )
     kernel = functools.partial(_int8_kernel, block=block)
