@@ -237,16 +237,23 @@ def _clean_error(msg: str) -> str:
     import re
 
     msg = re.sub(r"\x1b\[[0-9;]*m", "", msg)
-    lines = msg.splitlines() or [""]
+    lines = [ln for ln in msg.splitlines() if ln.strip()] or [""]
+    keys = ("RESOURCE_EXHAUSTED", "Mosaic", "out of memory", "Exceeded",
+            "OOM")
     root = next(
-        (ln.strip() for ln in lines
-         if "RESOURCE_EXHAUSTED" in ln or "Mosaic" in ln
-         or "out of memory" in ln or "Exceeded" in ln or "OOM" in ln),
-        "",
+        (ln.strip() for ln in lines if any(k in ln for k in keys)), ""
     )
-    head = lines[0][:160]
-    if root and root not in lines[0]:
-        head += " ... " + root[:200]
+    if root:
+        # Window AROUND the keyword: a long wrapper prefix must not
+        # truncate the root cause back out.
+        idx = min(root.find(k) for k in keys if k in root)
+        root = root[max(0, idx - 40):idx + 160]
+    # A traceback's first line is boilerplate; its LAST line is the
+    # exception. Everything else leads with the wrapper exception.
+    head = (lines[-1] if lines[0].startswith("Traceback")
+            else lines[0])[:160]
+    if root and root not in head:
+        head += " ... " + root
     return head
 
 

@@ -30,16 +30,17 @@ Smax=2048, engine A/B via decode_attn_kernel): correctness exact to bf16
 (max diff 1 ulp vs XLA full-span), but throughput is PARITY at short
 contexts (622 vs 616 tok/s at 128-token prompts, where the span bound
 saves ~90% of cache reads) and 9% WORSE at 1024-token prompts (439 vs
-483). Why: on this proxy the full-span cache read is only ~19% of a
-decode step's HBM traffic (weights dominate at ~4.5 GB/step vs ~1.1 GB
-cache), capping the theoretical win at ~17%; the kernel's single-
-buffered DMA (no fetch/compute overlap), per-KV-head narrow [G, D]
-matmuls, and pallas_call overhead inside the layer scan consume that
-margin. The engine therefore keeps full-span XLA as the default
-(decode_attn_kernel=False); the kernel stays as the correct bounded-span
-implementation, and double-buffering + head-batched matmuls are the
-known path if a config with a larger cache:weights ratio (more slots,
-longer Smax, smaller model) makes the span bound matter.
+483, then single-buffered). Why: on this proxy the full-span cache read
+is only ~19% of a decode step's HBM traffic (weights dominate at ~4.5
+GB/step vs ~1.1 GB cache), capping the theoretical win at ~17%; DMA
+serialization, per-KV-head narrow [G, D] matmuls, and pallas_call
+overhead inside the layer scan consume that margin. The DMA is now
+DOUBLE-BUFFERED (compute block j while j+1 streams -- see the r4
+paragraph below for the measured recovery); the residual deficit vs
+XLA is the narrow matmuls' MXU utilization (G=4 rows on a 128x128
+array) plus pallas_call overhead, and head-batched matmuls remain the
+known next step if a config makes the span bound matter. The engine
+keeps full-span XLA as the default (decode_attn_kernel=False).
 
 int8-cache variant + double-buffered DMA, MEASURED (r4, same chip, 64
 slots, 1024-token prompts, 256 new): double-buffering (compute block j

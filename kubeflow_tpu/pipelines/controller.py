@@ -267,11 +267,30 @@ class PipelineController:
                             stale.get("kind", "JAXJob"),
                             self._job_name(name, k), ns,
                         )
+            # Per-step fan-out throttle (kfp ParallelFor parallelism):
+            # gate CREATION of new expansions while `parallelism` of
+            # this step's units run; existing units always advance (the
+            # completion that frees a slot re-reconciles via its job's
+            # watch event, giving a gated unit its turn).
+            step_running = sum(
+                1 for u in units if phases.get(u) == "Running"
+            )
             for unit, item in zip(units, items):
+                before = phases.get(unit, "Pending")
+                if (cfg.parallelism and before == "Pending"
+                        and step_running >= cfg.parallelism):
+                    phases[unit] = "Pending"
+                    continue
                 phases[unit], running = self._advance_unit(
                     pl, cfg, unit, item_mapping(item),
-                    phases.get(unit, "Pending"), running, limit,
+                    before, running, limit,
                 )
+                if phases[unit] == "Running" and before != "Running":
+                    step_running += 1
+                elif before == "Running" and phases[unit] in (
+                    "Succeeded", "Failed",
+                ):
+                    step_running -= 1
             unit_phases = [phases[u] for u in units]
             if any(p in ("Pending", "Running") for p in unit_phases):
                 phases[step] = "Running"

@@ -44,6 +44,7 @@ class _PipelineContext:
         self._names: set[str] = set()
         self.when_stack: list[str] = []   # active condition() blocks
         self.items: Any = None            # active for_each() items
+        self.items_parallelism: int = 0   # active for_each() throttle
         self.exit_handler: Optional[dict] = None
 
     def unique(self, base: str) -> str:
@@ -69,6 +70,8 @@ class _PipelineContext:
             extra["when"] = spec["when"]
         if self.items is not None:
             spec["with_items"] = self.items
+            if self.items_parallelism:
+                spec["parallelism"] = self.items_parallelism
             if isinstance(self.items, str):
                 extra["items"] = self.items
         if extra:
@@ -212,7 +215,7 @@ def condition(expr: str):
 
 
 @contextlib.contextmanager
-def for_each(items: Any):
+def for_each(items: Any, parallelism: int = 0):
     """kfp ``dsl.ParallelFor`` analog: each step created inside the block
     fans out into one job per item; the yielded placeholder (``${item}``,
     or ``${item.<key>}`` for dict items) substitutes into arguments.
@@ -221,9 +224,10 @@ def for_each(items: Any):
     steps join on ALL expansions; the fan-out step's ``.output`` is the
     JSON list of per-item outputs. Each step inside the block fans out
     independently (chain per-item work inside one component). Nesting is
-    not supported. ::
+    not supported. ``parallelism`` (kfp ParallelFor parallelism) caps how
+    many expansions run at once; 0 = unlimited. ::
 
-        with dsl.for_each(["a", "b", "c"]) as item:
+        with dsl.for_each(["a", "b", "c"], parallelism=2) as item:
             shard = process(name=item)
         merge(parts=shard.output)
     """
@@ -233,10 +237,12 @@ def for_each(items: Any):
     if ctx.items is not None:
         raise RuntimeError("nested for_each() is not supported")
     ctx.items = items
+    ctx.items_parallelism = int(parallelism)
     try:
         yield "${item}"
     finally:
         ctx.items = None
+        ctx.items_parallelism = 0
 
 
 def on_exit(step: Step) -> None:
@@ -259,6 +265,7 @@ def on_exit(step: Step) -> None:
     spec["dependencies"] = []
     spec.pop("when", None)
     spec.pop("with_items", None)
+    spec.pop("parallelism", None)
     ctx.exit_handler = spec
 
 

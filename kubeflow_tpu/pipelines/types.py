@@ -60,6 +60,11 @@ class PipelineStep(BaseModel):
     # Dependents of the step join on ALL expansions; its
     # ${steps.<name>.output} is the JSON list of per-item outputs.
     with_items: Optional[Any] = None
+    # Fan-out throttle (kfp ParallelFor parallelism / Argo
+    # withItems+parallelism analog): at most this many of the step's
+    # expansions run at once (0 = unlimited). Gates only job CREATION;
+    # running expansions always advance. Requires with_items.
+    parallelism: int = 0
 
 
 class PipelineSpec(BaseModel):
@@ -195,6 +200,15 @@ def validate_pipeline(p: Pipeline) -> None:
             raise PipelineValidationError(
                 f"step {s.name!r}: with_items must be a list or a "
                 "placeholder string rendering to a JSON list"
+            )
+        if s.parallelism < 0:
+            raise PipelineValidationError(
+                f"step {s.name!r}: parallelism must be >= 0"
+            )
+        if s.parallelism and s.with_items is None:
+            raise PipelineValidationError(
+                f"step {s.name!r}: parallelism only applies to "
+                "with_items fan-outs"
             )
     # Fan-out expansions are named "<step>-<i>"; a sibling step with such
     # a name would collide with them in phases/outputs/job names.

@@ -191,6 +191,10 @@ class ControlPlane:
                         self.h_isvc_detail),
                 web.get("/dashboard/experiment/{ns}/{name}",
                         self.h_experiment_detail),
+                # Pipeline drill-down (P9's run view): per-step/expansion
+                # phases, retries, outputs, conditions.
+                web.get("/dashboard/pipeline/{ns}/{name}",
+                        self.h_pipeline_detail),
                 # KFAM-equivalent access management API (P7).
                 web.get("/kfam/v1/bindings", self.h_kfam_list),
                 web.post("/kfam/v1/bindings", self.h_kfam_add),
@@ -786,6 +790,105 @@ class ControlPlane:
         )
         return web.Response(text=page, content_type="text/html")
 
+    async def h_pipeline_detail(self, req: web.Request) -> web.Response:
+        """Pipeline run drill-down (the kfp run-detail page's role,
+        SURVEY.md 3.4 P9): DAG steps in topological order with per-unit
+        (step and fan-out expansion) phase, dependencies, when/items,
+        retries, and captured outputs, plus the run's conditions."""
+        import html as _html
+
+        ns, name = req.match_info["ns"], req.match_info["name"]
+        raw = self.store.get("Pipeline", name, ns)
+        if raw is None:
+            return web.Response(status=404, text="pipeline not found")
+        spec = raw.get("spec", {})
+        status = raw.get("status", {})
+        phases = status.get("step_phases", {})
+        outputs = status.get("step_outputs", {})
+        retries = status.get("step_retries", {})
+
+        def out_snip(k: str) -> str:
+            v = outputs.get(k, "")
+            v = v if len(v) <= 80 else v[:77] + "..."
+            return _html.escape(v)
+
+        rows = []
+        for s in spec.get("steps", []):
+            sname = s["name"]
+            deps = ", ".join(s.get("dependencies", []))
+            flags = []
+            if s.get("when"):
+                flags.append("when")
+            if s.get("with_items") is not None:
+                par = s.get("parallelism") or ""
+                flags.append(f"fan-out{f' (par {par})' if par else ''}")
+            if s.get("cache"):
+                flags.append("cache")
+            if s.get("retry"):
+                flags.append(f"retry {s['retry']}")
+            rows.append(
+                f"<tr><td><b>{_html.escape(sname)}</b></td>"
+                f"<td>{_html.escape(deps)}</td>"
+                f"<td>{_html.escape(', '.join(flags))}</td>"
+                f"<td>{_html.escape(phases.get(sname, 'Pending'))}</td>"
+                f"<td>{retries.get(sname, '')}</td>"
+                f"<td>{out_snip(sname)}</td></tr>"
+            )
+            # Expansion units, in index order under their logical
+            # step. Gate on with_items like the controller's owned():
+            # a plain sibling step legally named "<step>-<i>" is NOT an
+            # expansion and must not render twice.
+            units = [] if s.get("with_items") is None else sorted(
+                (k for k in phases
+                 if k.rpartition("-")[0] == sname
+                 and k.rpartition("-")[2].isdigit()),
+                key=lambda k: int(k.rpartition("-")[2]),
+            )
+            for u in units:
+                rows.append(
+                    f"<tr><td>&nbsp;&nbsp;{_html.escape(u)}</td><td></td>"
+                    "<td></td>"
+                    f"<td>{_html.escape(phases.get(u, ''))}</td>"
+                    f"<td>{retries.get(u, '')}</td>"
+                    f"<td>{out_snip(u)}</td></tr>"
+                )
+        eh = spec.get("exit_handler")
+        if eh:
+            u = eh["name"]
+            rows.append(
+                f"<tr><td><i>{_html.escape(u)} (exit handler)</i></td>"
+                "<td></td><td></td>"
+                f"<td>{_html.escape(phases.get(u, 'Pending'))}</td>"
+                f"<td>{retries.get(u, '')}</td>"
+                f"<td>{out_snip(u)}</td></tr>"
+            )
+        conds = "".join(
+            f"<li>{_html.escape(c.get('type', ''))}"
+            f" ({_html.escape(c.get('reason', ''))})"
+            f" {_html.escape(c.get('message', ''))}</li>"
+            for c in status.get("conditions", [])
+        )
+        params = ", ".join(
+            f"{_html.escape(str(k))}={_html.escape(str(v))}"
+            for k, v in spec.get("parameters", {}).items()
+        )
+        page = (
+            "<!doctype html><html><head><title>pipeline "
+            f"{_html.escape(name)}</title><style>"
+            "body{font-family:monospace;margin:2em;background:#fafafa}"
+            "table{border-collapse:collapse}"
+            "td,th{border:1px solid #ccc;padding:3px 8px;font-size:13px}"
+            "</style></head><body>"
+            f"<h1>pipeline {_html.escape(ns)}/{_html.escape(name)}</h1>"
+            f"<p>parameters: {params or '(none)'}</p>"
+            "<h2>steps</h2><table><tr><th>step</th><th>deps</th>"
+            "<th>flags</th><th>phase</th><th>retries</th><th>output</th>"
+            "</tr>" + "".join(rows) + "</table>"
+            "<h2>conditions</h2><ul>" + conds + "</ul>"
+            '<p><a href="/dashboard">back</a></p></body></html>'
+        )
+        return web.Response(text=page, content_type="text/html")
+
     async def h_healthz(self, req: web.Request) -> web.Response:
         return web.json_response({"ok": True, "uptime": time.time() - self.started_at})
 
@@ -951,6 +1054,8 @@ async function main(){
         name = '<a href="dashboard/experiment/'+ns+'/'+name+'">'+name+'</a>';
       if (kind === "InferenceService")  // drill-down: replica metrics
         name = '<a href="dashboard/isvc/'+ns+'/'+name+'">'+name+'</a>';
+      if (kind === "Pipeline")  // drill-down: step/expansion phases
+        name = '<a href="dashboard/pipeline/'+ns+'/'+name+'">'+name+'</a>';
       const attrs = ' data-kind="'+esc(kind)+'" data-ns="'+ns
         +'" data-name="'+esc(o.metadata.name)+'"';
       let actions = '<button data-act="del"'+attrs+'>delete</button>';
