@@ -233,6 +233,82 @@ class OIPServicer:
             )
         return resp
 
+    async def ModelStreamGenerate(self, request, context):
+        """Server-streaming generation: one frame per token delta, then
+        a finished frame -- the gRPC analog of the SSE
+        /v2/models/{m}/generate_stream route, riding the SAME
+        ModelServer._stream_deltas core (split-codepoint withholding
+        included; stop= stops the engine without transport trimming,
+        matching the REST v2 generate semantics)."""
+        import time
+
+        self.server.request_count += 1
+        t0 = time.monotonic()
+        try:
+            model = self.repo.get(request.model_name)
+            if not model.ready:
+                raise InferenceError(
+                    f"model {request.model_name} is not ready", 503
+                )
+            self.repo.touch(request.model_name)
+            inst: dict = {}
+            if request.token_ids:
+                inst["token_ids"] = list(request.token_ids)
+            else:
+                inst["prompt"] = request.text_input
+            if request.max_new_tokens:
+                inst["max_new_tokens"] = request.max_new_tokens
+            if request.temperature:
+                inst["temperature"] = request.temperature
+            if request.top_k:
+                inst["top_k"] = request.top_k
+            if request.top_p:
+                inst["top_p"] = request.top_p
+            stops = [s for s in request.stop if s]
+            if stops:
+                # Engine-side stop only (slot frees at the match), the
+                # same semantics as the REST v2 generate routes -- no
+                # transport-level trim, so both transports stay
+                # token-exact (OpenAI routes own the trimming contract).
+                inst["stop"] = stops
+            stream = self.server._stream_deltas(model, inst)
+            # Prime before the first yield: submit-time errors (bad
+            # instance, dead engine) become clean gRPC statuses, not
+            # mid-stream aborts.
+            first = await anext(stream, None)
+        except ValueError as e:
+            # Engine-side request validation (empty/too-long prompt):
+            # the client's fault, same mapping as the SSE route's 400.
+            self.server.error_count += 1
+            self.server.predict_seconds += time.monotonic() - t0
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            return
+        except Exception as e:  # noqa: BLE001
+            self.server.error_count += 1
+            self.server.predict_seconds += time.monotonic() - t0
+            await context.abort(_grpc_status(e), str(e))
+            return
+        try:
+            if first is not None:
+                delta, tok, _ids = first
+                yield pb.ModelGenerateResponse(
+                    text_output=delta,
+                    token_id=tok if tok is not None else 0,
+                    has_token=tok is not None,
+                )
+                async for delta, tok, _ids in stream:
+                    yield pb.ModelGenerateResponse(
+                        text_output=delta,
+                        token_id=tok if tok is not None else 0,
+                        has_token=tok is not None,
+                    )
+            yield pb.ModelGenerateResponse(finished=True)
+        except Exception as e:  # noqa: BLE001 - mid-stream engine error:
+            self.server.error_count += 1  # count it and end with a
+            await context.abort(_grpc_status(e), str(e))  # mapped status
+        finally:
+            self.server.predict_seconds += time.monotonic() - t0
+
     async def RepositoryModelLoad(self, request, context):
         try:
             params = request.parameters
@@ -293,6 +369,13 @@ def _handlers(servicer: OIPServicer) -> grpc.GenericRpcHandler:
         "RepositoryModelUnload": unary(servicer.RepositoryModelUnload,
                                        pb.RepositoryModelUnloadRequest,
                                        pb.RepositoryModelUnloadResponse),
+        "ModelStreamGenerate": grpc.unary_stream_rpc_method_handler(
+            servicer.ModelStreamGenerate,
+            request_deserializer=pb.ModelGenerateRequest.FromString,
+            response_serializer=(
+                pb.ModelGenerateResponse.SerializeToString
+            ),
+        ),
     })
 
 
@@ -339,6 +422,13 @@ def client_stubs(channel: grpc.Channel) -> dict:
         "RepositoryModelUnload": u("RepositoryModelUnload",
                                    pb.RepositoryModelUnloadRequest,
                                    pb.RepositoryModelUnloadResponse),
+        "ModelStreamGenerate": channel.unary_stream(
+            f"/{SERVICE}/ModelStreamGenerate",
+            request_serializer=(
+                pb.ModelGenerateRequest.SerializeToString
+            ),
+            response_deserializer=pb.ModelGenerateResponse.FromString,
+        ),
     }
 
 
