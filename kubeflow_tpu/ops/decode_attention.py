@@ -42,13 +42,13 @@ array) plus pallas_call overhead, and head-batched matmuls remain the
 known next step if a config makes the span bound matter. The engine
 keeps full-span XLA as the default (decode_attn_kernel=False).
 
-int8-cache variant + double-buffered DMA, MEASURED (r4, same chip, 64
-slots, 1024-token prompts, 256 new): double-buffering (compute block j
-while j+1 streams) recovered +10% on the bf16 kernel (761 -> 836
-tok/s) and +5.5% on int8 (760 -> 802), but XLA full-span still leads
-where it can run (934 bf16 / 987 int8 on that workload) -- the
-remaining deficit is the per-KV-head [G=4, D] matmuls' MXU
-utilization plus pallas_call overhead inside the layer scan. Where the
+int8-cache variant, MEASURED (r4, same chip, 64 slots, 1024-token
+prompts, 256 new): double-buffering (compute block j while j+1
+streams) recovered +10% bf16 / +5.5% int8 over single-buffered, and
+head-BATCHED matmuls (_flash_update_batched, on by default) a further
++5-7% -- 871 bf16 / 851 int8 tok/s vs 934/987 for XLA full-span where
+XLA fits; the remaining gap is pallas_call overhead in the layer scan
+plus the block-diagonal redundancy. Where the
 kernel WINS is capacity: the XLA int8-KV read materializes a bf16 copy
 of the cache as a temp (12.3 GB for a 128-slot Smax=2048 decode block
 -- memory_analysis r4), so 128 slots @ 2048 OOMs in every XLA config
@@ -71,6 +71,12 @@ from jax.experimental.pallas import tpu as pltpu
 # 512 KiB -- large enough to amortize DMA issue cost, small enough that
 # double-buffering two of them fits VMEM comfortably.
 DEFAULT_BLOCK = 256
+
+# Head-batched matmuls (see _flash_update_batched): one MXU op over all
+# KV heads instead of KV narrow ones. Env-gated for A/B measurement.
+import os as _os
+
+BATCH_HEADS = _os.environ.get("KFTPU_DECODE_BATCH_HEADS", "1") != "0"
 
 
 def _kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref,
@@ -116,8 +122,8 @@ def _kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref,
         mask = j * block + jax.lax.broadcasted_iota(
             jnp.int32, (g, block), 1
         ) < span
-        return _flash_update(q, kblk, vblk, mask, m, l, acc,
-                             kv_heads, scale)
+        upd = (_flash_update_batched if BATCH_HEADS else _flash_update)
+        return upd(q, kblk, vblk, mask, m, l, acc, kv_heads, scale)
 
     m0 = jnp.full((kv_heads, g, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((kv_heads, g, 1), jnp.float32)
@@ -185,14 +191,55 @@ def _int8_kernel(pos_ref, q_ref, k_hbm, ks_hbm, v_hbm, vs_hbm, o_ref,
         mask = j * block + jax.lax.broadcasted_iota(
             jnp.int32, (g, block), 1
         ) < span
-        return _flash_update(q, kblk, vblk, mask, m, l, acc,
-                             kv_heads, scale)
+        upd = (_flash_update_batched if BATCH_HEADS else _flash_update)
+        return upd(q, kblk, vblk, mask, m, l, acc, kv_heads, scale)
 
     m0 = jnp.full((kv_heads, g, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((kv_heads, g, 1), jnp.float32)
     a0 = jnp.zeros((kv_heads, g, d), jnp.float32)
     _, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, a0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_update_batched(q, kblk, vblk, mask, m, l, acc, kv_heads,
+                          scale):
+    """Head-BATCHED flash update: all KV heads fold into ONE
+    [KV*G, D] x [D, KV*block] matmul via the block-diagonal trick --
+    the cross-head products are computed (KVx the needed FLOPs) and
+    masked away, trading redundant FLOPs for MXU utilization (KV*G=32
+    rows per op instead of G=4) and one dot issue instead of KV. Same
+    for the probs @ V side, with the probs scattered block-diagonally.
+    Numerics identical to _flash_update (verified exact in f32)."""
+    blk, _, d = kblk.shape
+    g = q.shape[1]
+    qa = q.reshape(kv_heads * g, d)
+    kcat = kblk.transpose(1, 0, 2).reshape(kv_heads * blk, d)
+    s_full = jax.lax.dot_general(
+        qa, kcat,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    ).reshape(kv_heads, g, kv_heads, blk) * scale
+    eye = (jax.lax.broadcasted_iota(jnp.int32, (kv_heads, kv_heads), 0)
+           == jax.lax.broadcasted_iota(jnp.int32, (kv_heads, kv_heads), 1)
+           ).astype(jnp.float32)
+    s = (s_full * eye[:, None, :, None]).sum(axis=2)       # [KV, G, blk]
+    s = jnp.where(mask[None], s, -jnp.inf)
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+    p_full = (p[:, :, None, :] * eye[:, None, :, None]).reshape(
+        kv_heads * g, kv_heads * blk
+    )
+    vcat = vblk.transpose(1, 0, 2).reshape(kv_heads * blk, d)
+    pv = jax.lax.dot_general(
+        p_full, vcat,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    ).reshape(kv_heads, g, d)
+    return m_new, l_new, acc * alpha + pv
 
 
 def _flash_update(q, kblk, vblk, mask, m, l, acc, kv_heads, scale):

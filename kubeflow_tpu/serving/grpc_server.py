@@ -288,20 +288,18 @@ class OIPServicer:
             self.server.predict_seconds += time.monotonic() - t0
             await context.abort(_grpc_status(e), str(e))
             return
+        def frame(delta, tok):
+            return pb.ModelGenerateResponse(
+                text_output=delta,
+                token_id=tok if tok is not None else 0,
+                has_token=tok is not None,
+            )
+
         try:
             if first is not None:
-                delta, tok, _ids = first
-                yield pb.ModelGenerateResponse(
-                    text_output=delta,
-                    token_id=tok if tok is not None else 0,
-                    has_token=tok is not None,
-                )
+                yield frame(first[0], first[1])
                 async for delta, tok, _ids in stream:
-                    yield pb.ModelGenerateResponse(
-                        text_output=delta,
-                        token_id=tok if tok is not None else 0,
-                        has_token=tok is not None,
-                    )
+                    yield frame(delta, tok)
             yield pb.ModelGenerateResponse(finished=True)
         except Exception as e:  # noqa: BLE001 - mid-stream engine error:
             self.server.error_count += 1  # count it and end with a
