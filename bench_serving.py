@@ -49,8 +49,11 @@ PRESET = os.environ.get("BENCH_PRESET", "llama3-8b-proxy")
 MAX_SEQ = int(os.environ.get("BENCH_MAX_SEQ", "512"))
 # Decode steps fused per dispatch in the THROUGHPUT sweep. 32 buys ~40%
 # over 8 on this dispatch-tunneled dev chip (measured 1,060 -> 1,490
-# tok/s at 32 slots); the latency phase stays at 8 -- bigger blocks
-# coarsen token-burst granularity, the wrong trade for ITL.
+# tok/s at 32 slots); 64 REGRESSES at the 256-slot knee (3,524 vs 3,635
+# measured r4 -- decode is compute-bound there, so bigger blocks only
+# add end-of-request overshoot waste). The latency phase stays at 8 --
+# bigger blocks coarsen token-burst granularity, the wrong trade for
+# ITL.
 DECODE_BLOCK = int(os.environ.get("BENCH_DECODE_BLOCK", "32"))
 LATENCY_DECODE_BLOCK = 8
 # Latency phase knobs. The latency workload runs at LONG prompt lengths
