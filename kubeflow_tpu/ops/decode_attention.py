@@ -73,14 +73,20 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK = 256
 
 # Head-batched matmuls (see _flash_update_batched): one MXU op over all
-# KV heads instead of KV narrow ones. Env-gated for A/B measurement.
+# KV heads instead of KV narrow ones. A/B-gated per CALL: the public
+# entry points take batch_heads=None meaning "read the env var now", so
+# tests and A/B harnesses can flip KFTPU_DECODE_BATCH_HEADS (or pass the
+# kwarg) after import -- an import-time read froze the gate process-wide.
 import os as _os
 
-BATCH_HEADS = _os.environ.get("KFTPU_DECODE_BATCH_HEADS", "1") != "0"
+
+def _batch_heads_default() -> bool:
+    return _os.environ.get("KFTPU_DECODE_BATCH_HEADS", "1") != "0"
 
 
 def _kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref,
-            k_vmem, v_vmem, sem_k, sem_v, *, block: int):
+            k_vmem, v_vmem, sem_k, sem_v, *, block: int,
+            batch_heads: bool):
     b = pl.program_id(0)
     span = pos_ref[b] + 1
     nb = pl.cdiv(span, block)
@@ -122,7 +128,7 @@ def _kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref,
         mask = j * block + jax.lax.broadcasted_iota(
             jnp.int32, (g, block), 1
         ) < span
-        upd = (_flash_update_batched if BATCH_HEADS else _flash_update)
+        upd = (_flash_update_batched if batch_heads else _flash_update)
         return upd(q, kblk, vblk, mask, m, l, acc, kv_heads, scale)
 
     m0 = jnp.full((kv_heads, g, 1), -jnp.inf, jnp.float32)
@@ -134,7 +140,8 @@ def _kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref,
 
 def _int8_kernel(pos_ref, q_ref, k_hbm, ks_hbm, v_hbm, vs_hbm, o_ref,
                  k_vmem, ks_vmem, v_vmem, vs_vmem,
-                 sem_k, sem_ks, sem_v, sem_vs, *, block: int):
+                 sem_k, sem_ks, sem_v, sem_vs, *, block: int,
+                 batch_heads: bool):
     """int8-cache variant: DMAs int8 rows (HALF the bf16 kernel's HBM
     traffic) plus their [block, KV] f32 scales, dequantizes in VMEM.
     This is the fix for the XLA int8-KV path's materialization: under
@@ -191,7 +198,7 @@ def _int8_kernel(pos_ref, q_ref, k_hbm, ks_hbm, v_hbm, vs_hbm, o_ref,
         mask = j * block + jax.lax.broadcasted_iota(
             jnp.int32, (g, block), 1
         ) < span
-        upd = (_flash_update_batched if BATCH_HEADS else _flash_update)
+        upd = (_flash_update_batched if batch_heads else _flash_update)
         return upd(q, kblk, vblk, mask, m, l, acc, kv_heads, scale)
 
     m0 = jnp.full((kv_heads, g, 1), -jnp.inf, jnp.float32)
@@ -272,18 +279,31 @@ def _flash_update(q, kblk, vblk, mask, m, l, acc, kv_heads, scale):
     return jnp.stack(ms), jnp.stack(ls), jnp.stack(accs)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("block", "interpret")
-)
 def decode_attention(q, cache_k, cache_v, positions,
                      block: int = DEFAULT_BLOCK,
-                     interpret: bool = False):
+                     interpret: bool = False,
+                     batch_heads: bool | None = None):
     """Bounded-span GQA decode attention over the in-place cache.
 
     q [B, KV, G, D]; cache_k/v [B, Smax, KV, D]; positions [B].
     Returns [B, KV, G, D] in q's dtype. Smax must be a multiple of
     ``block`` (engine max_seq is a power of two; pad otherwise).
+    batch_heads=None reads KFTPU_DECODE_BATCH_HEADS *here*, outside
+    jit -- resolving it inside the jitted impl would bake the first
+    call's env value into the trace cache and ignore later flips.
     """
+    if batch_heads is None:
+        batch_heads = _batch_heads_default()
+    return _decode_attention_jit(q, cache_k, cache_v, positions,
+                                 block=block, interpret=interpret,
+                                 batch_heads=batch_heads)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "interpret", "batch_heads")
+)
+def _decode_attention_jit(q, cache_k, cache_v, positions,
+                          block, interpret, batch_heads):
     b, smax, kv_heads, d = cache_k.shape
     if smax % block:
         raise ValueError(f"Smax={smax} not a multiple of block={block}")
@@ -305,7 +325,8 @@ def decode_attention(q, cache_k, cache_v, positions,
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
-    kernel = functools.partial(_kernel, block=block)
+    kernel = functools.partial(_kernel, block=block,
+                               batch_heads=batch_heads)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -317,19 +338,31 @@ def decode_attention(q, cache_k, cache_v, positions,
     )(positions.astype(jnp.int32), q, cache_k, cache_v)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("block", "interpret")
-)
 def decode_attention_int8(q, ck_q, ck_s, cv_q, cv_s, positions,
                           block: int = DEFAULT_BLOCK,
-                          interpret: bool = False):
+                          interpret: bool = False,
+                          batch_heads: bool | None = None):
     """Bounded-span GQA decode attention over an int8-quantized cache
     (engine kv_quant="int8": rows int8 [B, Smax, KV, D], scales handed
     in TRANSPOSED as [B, KV, Smax] for lane-aligned DMA). DMAs int8
     rows -- half the bf16 kernel's cache traffic -- and dequantizes in
     VMEM, which is the only way to read a quantized cache without XLA
     materializing the bf16 copy (see _int8_kernel's docstring for the
-    measured temp blowup)."""
+    measured temp blowup). batch_heads resolves from the env OUTSIDE
+    jit, like decode_attention."""
+    if batch_heads is None:
+        batch_heads = _batch_heads_default()
+    return _decode_attention_int8_jit(q, ck_q, ck_s, cv_q, cv_s,
+                                      positions, block=block,
+                                      interpret=interpret,
+                                      batch_heads=batch_heads)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "interpret", "batch_heads")
+)
+def _decode_attention_int8_jit(q, ck_q, ck_s, cv_q, cv_s, positions,
+                               block, interpret, batch_heads):
     b, smax, kv_heads, d = ck_q.shape
     if smax % block:
         raise ValueError(f"Smax={smax} not a multiple of block={block}")
@@ -357,7 +390,8 @@ def decode_attention_int8(q, ck_q, ck_s, cv_q, cv_s, positions,
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
-    kernel = functools.partial(_int8_kernel, block=block)
+    kernel = functools.partial(_int8_kernel, block=block,
+                               batch_heads=batch_heads)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,

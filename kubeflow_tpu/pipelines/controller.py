@@ -287,9 +287,12 @@ class PipelineController:
                 )
                 if phases[unit] == "Running" and before != "Running":
                     step_running += 1
-                elif before == "Running" and phases[unit] in (
-                    "Succeeded", "Failed",
-                ):
+                elif before == "Running" and phases[unit] != "Running":
+                    # Any exit from Running frees a parallelism token --
+                    # including Running->Pending when a failed job is
+                    # deleted for retry; counting only terminal phases
+                    # left step_running inflated for the rest of the
+                    # pass and under-admitted gated units.
                     step_running -= 1
             unit_phases = [phases[u] for u in units]
             if any(p in ("Pending", "Running") for p in unit_phases):

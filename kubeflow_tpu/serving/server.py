@@ -676,7 +676,7 @@ class ModelServer:
             if isinstance(raw, str):
                 items: list = [raw]
             elif isinstance(raw, list) and raw and all(
-                isinstance(t, int) for t in raw
+                type(t) is int for t in raw
             ):
                 items = [raw]  # one token-id array
             elif isinstance(raw, list) and raw:
@@ -691,9 +691,12 @@ class ModelServer:
             # whole batch on any exception, so a malformed item must be
             # rejected here or it poisons other clients' requests.
             for i, item in enumerate(items):
+                # type(t) is int, not isinstance: bool is an int
+                # subclass, so [[true, false]] would otherwise embed as
+                # token ids [1, 0] instead of being rejected.
                 ok = (isinstance(item, str) and item) or (
                     isinstance(item, (list, tuple)) and item
-                    and all(isinstance(t, int) for t in item)
+                    and all(type(t) is int for t in item)
                 )
                 if not ok:
                     raise InferenceError(

@@ -253,3 +253,20 @@ def test_mixed_validity_batch_rejected_before_batcher(embed_client):
 
     status, body = loop.run_until_complete(go())
     assert status == 400 and "input[1]" in body["error"]
+
+
+def test_bool_token_ids_rejected(embed_client):
+    """JSON booleans are int subclasses in Python; [[true, false]] must
+    be rejected as malformed, not silently embedded as token ids
+    [1, 0] (advisor finding, r4)."""
+    c, loop = embed_client
+
+    async def go():
+        r1 = await c.post("/openai/v1/embeddings",
+                          json={"model": "emb", "input": [[True, False]]})
+        r2 = await c.post("/openai/v1/embeddings",
+                          json={"model": "emb", "input": [True, False]})
+        return r1.status, r2.status
+
+    s1, s2 = loop.run_until_complete(go())
+    assert s1 == 400 and s2 == 400
