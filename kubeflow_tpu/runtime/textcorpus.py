@@ -1,0 +1,245 @@
+"""Offline text-corpus + tokenizer pipeline for real-text LM training.
+
+Round-4 verdict: every quality-sensitive serving number (speculative
+acceptance, int8 top-1 agreement, prefix-cache benefit) was measured on
+RANDOM weights, where greedy decode degenerates into cycles and the
+numbers say nothing about a trained model. This module is the fix's
+first half: build a real-text corpus from what the machine already has
+(this image has zero network egress -- Python source trees and
+/usr/share/doc are the in-image text), train a byte-level BPE tokenizer
+on it (the `tokenizers` crate ships with transformers), and encode to
+the ``.bin`` memmap convention ``runtime.data.file_tokens`` consumes.
+The second half is a normal JAXJob: ``model=llama data=<corpus.bin>``.
+
+Upstream parity note: the reference's training stack assumes users bring
+tokenized data (its examples shell out to HF datasets + tokenizers); a
+first-class corpus pipeline is the TPU-repo equivalent that works in an
+air-gapped image.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# Where real text lives in a stock Python image, in preference order.
+# Python source is genuine mixed natural-language/code text (docstrings,
+# comments, identifiers) with heavy cross-file repetition -- which is
+# exactly the distribution serving features like prompt-lookup
+# speculation and prefix caching are designed for.
+DEFAULT_ROOTS: tuple[str, ...] = (
+    "/opt/venv/lib/python3.12/site-packages",
+    "/usr/local/lib",
+    "/usr/lib/python3.11",
+    "/usr/share/doc",
+)
+
+_TEXT_EXTS = (".py", ".txt", ".md", ".rst", ".pyi")
+
+# Generated files are degenerate text (one-line protobufs, minified
+# bundles); they teach the model nothing and skew BPE merges.
+_SKIP_SUFFIXES = ("_pb2.py", "_pb2_grpc.py", ".min.js")
+_SKIP_DIRS = {"__pycache__", "node_modules", ".git", "tests", "test"}
+
+
+def iter_text_files(
+    roots: Sequence[str] = DEFAULT_ROOTS,
+    max_file_bytes: int = 512 * 1024,
+) -> Iterator[str]:
+    """Deterministic walk (sorted dirs/files) over readable text files."""
+    for root in roots:
+        if not os.path.isdir(root):
+            continue
+        for dirpath, dirs, files in os.walk(root):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+            for f in sorted(files):
+                if not f.endswith(_TEXT_EXTS):
+                    continue
+                if any(f.endswith(s) for s in _SKIP_SUFFIXES):
+                    continue
+                p = os.path.join(dirpath, f)
+                try:
+                    size = os.path.getsize(p)
+                except OSError:
+                    continue
+                if 0 < size <= max_file_bytes:
+                    yield p
+
+
+def build_corpus(
+    out_train: str,
+    out_heldout: str,
+    roots: Sequence[str] = DEFAULT_ROOTS,
+    max_bytes: int = 256 * 1024 * 1024,
+    holdout_every: int = 53,
+) -> dict:
+    """Concatenate files into train/heldout text (every ``holdout_every``-th
+    FILE is held out -- document-level holdout, so heldout prompts are
+    never literal substrings of the training stream). Documents are
+    separated by NUL, which the tokenizer maps to its document-boundary
+    token. Returns counts for the manifest."""
+    n_train = n_held = b_train = b_held = 0
+    with open(out_train, "w", encoding="utf-8", errors="replace") as ft, \
+            open(out_heldout, "w", encoding="utf-8", errors="replace") as fh:
+        for i, path in enumerate(iter_text_files(roots)):
+            if b_train >= max_bytes:
+                break
+            try:
+                with open(path, encoding="utf-8", errors="replace") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            if not text.strip():
+                continue
+            if i % holdout_every == 0:
+                fh.write(text)
+                fh.write("\x00")
+                n_held += 1
+                b_held += len(text)
+            else:
+                ft.write(text)
+                ft.write("\x00")
+                n_train += 1
+                b_train += len(text)
+    return {
+        "train_files": n_train, "heldout_files": n_held,
+        "train_bytes": b_train, "heldout_bytes": b_held,
+    }
+
+
+def train_bpe(
+    corpus_txt: str,
+    out_json: str,
+    vocab_size: int = 32768,
+) -> None:
+    """Byte-level BPE over the corpus (GPT-2-style: no unk token, every
+    byte reachable). vocab_size defaults to the llama3-1b preset's
+    32768 so the trained tokenizer drops straight into that geometry."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders, trainers
+
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=vocab_size,
+        special_tokens=["<doc>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    tok.train([corpus_txt], trainer)
+    tok.save(out_json)
+
+
+def encode_to_bin(
+    tokenizer_json: str,
+    txt_path: str,
+    out_bin: str,
+    chunk_bytes: int = 8 * 1024 * 1024,
+) -> int:
+    """Stream-encode text -> uint16 token ids in the ``.bin`` memmap
+    convention (runtime.data._load_token_stream). NUL document
+    boundaries become the <doc> special token. Splits on boundaries so
+    no chunk seam ever lands inside a document's BPE merge window...
+    except the pathological single-document-bigger-than-chunk case,
+    where the seam cost is one suboptimal merge. Returns token count."""
+    from tokenizers import Tokenizer
+
+    tok = Tokenizer.from_file(tokenizer_json)
+    doc_id = tok.token_to_id("<doc>")
+    assert doc_id is not None and doc_id < 65536
+    n = 0
+    with open(txt_path, encoding="utf-8") as f, open(out_bin, "wb") as out:
+        buf = ""
+        while True:
+            chunk = f.read(chunk_bytes)
+            if not chunk and not buf:
+                break
+            buf += chunk
+            if chunk:
+                # Encode only complete documents; carry the tail.
+                cut = buf.rfind("\x00")
+                if cut < 0:
+                    if len(buf) < 4 * chunk_bytes:
+                        continue
+                    # Oversized single document: flush what we have
+                    # WITHOUT a boundary token (the doc continues in the
+                    # next chunk; the seam costs one suboptimal merge,
+                    # never a dropped char or a false <doc>).
+                    arr = np.asarray(tok.encode(buf).ids, np.uint16)
+                    arr.tofile(out)
+                    n += arr.size
+                    buf = ""
+                    continue
+                docs, buf = buf[:cut], buf[cut + 1:]
+            else:
+                docs, buf = buf, ""
+            ids: list[int] = []
+            for doc in docs.split("\x00"):
+                if doc:
+                    ids.extend(tok.encode(doc).ids)
+                ids.append(doc_id)
+            arr = np.asarray(ids, np.uint16)
+            if ids and max(ids) >= 65536:
+                raise ValueError("token id overflows uint16")
+            arr.tofile(out)
+            n += arr.size
+    return n
+
+
+def prepare(
+    out_dir: str,
+    roots: Sequence[str] = DEFAULT_ROOTS,
+    max_bytes: int = 256 * 1024 * 1024,
+    vocab_size: int = 32768,
+    force: bool = False,
+) -> dict:
+    """One-call pipeline: corpus -> tokenizer -> train/heldout .bin +
+    a manifest.json. Idempotent unless force (the corpus build is
+    minutes of single-core work)."""
+    os.makedirs(out_dir, exist_ok=True)
+    manifest_path = os.path.join(out_dir, "manifest.json")
+    if os.path.exists(manifest_path) and not force:
+        with open(manifest_path) as f:
+            return json.load(f)
+    train_txt = os.path.join(out_dir, "train.txt")
+    held_txt = os.path.join(out_dir, "heldout.txt")
+    tok_json = os.path.join(out_dir, "tokenizer.json")
+    logger.info("building corpus under %s", out_dir)
+    stats = build_corpus(train_txt, held_txt, roots, max_bytes)
+    logger.info("training BPE tokenizer (vocab %d)", vocab_size)
+    train_bpe(train_txt, tok_json, vocab_size)
+    stats["train_tokens"] = encode_to_bin(
+        tok_json, train_txt, os.path.join(out_dir, "train.bin"))
+    stats["heldout_tokens"] = encode_to_bin(
+        tok_json, held_txt, os.path.join(out_dir, "heldout.bin"))
+    stats["vocab_size"] = vocab_size
+    stats["roots"] = list(roots)
+    with open(manifest_path, "w") as f:
+        json.dump(stats, f, indent=1)
+    return stats
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out-dir", default="data/textlm")
+    ap.add_argument("--max-mb", type=int, default=256)
+    ap.add_argument("--vocab-size", type=int, default=32768)
+    ap.add_argument("--force", action="store_true")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    stats = prepare(args.out_dir, max_bytes=args.max_mb * 1024 * 1024,
+                    vocab_size=args.vocab_size, force=args.force)
+    print(json.dumps(stats))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -522,7 +522,7 @@ def _logprob_outputs(logits, chosen):
 def _decode_block(cfg: LlamaConfig, n_steps: int, filtered: bool,
                   want_lp: bool, w: dict, cache_k, cache_v, tokens,
                   lengths, rng, temps, top_ks, top_ps,
-                  kernel: bool = False):
+                  kernel: bool = False, mask=None):
     """n_steps decode+sample iterations in ONE device program.
 
     Amortizes the host<->device dispatch roundtrip (dominant on remote
@@ -544,9 +544,12 @@ def _decode_block(cfg: LlamaConfig, n_steps: int, filtered: bool,
         # common case) must not pay the double [B, V] argsort + cumsum
         # of top-k/top-p -- measured 5x decode throughput on the 8B
         # proxy (128k vocab) when the filter ran unconditionally.
+        # mask is only sound for the FIRST step of a block (the legal
+        # set depends on each sampled token); constrained callers run
+        # n_steps=1, so the whole block is that first step.
         nxt = _sample(logits, step_rng, temps,
                       top_ks if filtered else None,
-                      top_ps if filtered else None)
+                      top_ps if filtered else None, mask)
         out = (nxt, *_logprob_outputs(logits, nxt)) if want_lp else nxt
         return (ck, cv, nxt, lens + 1), out
 
@@ -573,7 +576,7 @@ def _host_logprobs(row: np.ndarray, token: int, n: int) -> dict:
     }
 
 
-def _sample(logits, rng, temps, top_ks=None, top_ps=None):
+def _sample(logits, rng, temps, top_ks=None, top_ps=None, mask=None):
     """Per-slot sampling: temp<=0 means greedy; optional per-slot top-k
     (0 = off) and top-p/nucleus (>=1.0 = off) truncation applied before
     the categorical draw. logits [B,V]; temps/top_ks/top_ps [B].
@@ -581,8 +584,16 @@ def _sample(logits, rng, temps, top_ks=None, top_ps=None):
     Both filters are rank-based masks over the full vocab (sorted once),
     so the program stays one fixed-shape fusion -- no dynamic gather of
     a variable candidate set.
+
+    ``mask`` [B, V] bool (optional): constrained decoding
+    (serving.jsonmode) -- disallowed tokens drop to -inf BEFORE
+    greedy/temperature/filtering, so the constraint composes with every
+    sampling mode. All-False rows would sample token 0; the engine
+    finishes such requests host-side instead.
     """
 
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.float32(-1e30))
     greedy = jnp.argmax(logits, axis=-1)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
     if top_ks is not None or top_ps is not None:
@@ -609,7 +620,7 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
                  klen: int, filtered: bool, want_lp: bool, w: dict,
                  cache_k, cache_v, tokens, lengths, chunk_toks,
                  chunk_offs, chunk_clens, chunk_slots, rng, temps,
-                 top_ks, top_ps):
+                 top_ks, top_ps, mask=None):
     """Mixed batch in ONE device program (vLLM's chunked prefill, shaped
     for XLA): n_steps decode steps each fused with one prefill chunk,
     then m_tail chunk-only steps that finish the prompts without
@@ -734,9 +745,11 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
         )
         x_d = _rms(x_d, w["final_scale"], cfg.norm_eps)
         d_logits = _lm_logits(x_d[:, 0].astype(jnp.float32), w["lm_head"])
+        # Like _decode_block: mask only sound at n_steps=1 (caller
+        # enforces when constrained lanes are active).
         nxt = _sample(d_logits, step_rng, temps,
                       top_ks if filtered else None,
-                      top_ps if filtered else None)
+                      top_ps if filtered else None, mask)
         fin_logits = chunk_logits_latch(x_c, cclens, fin_logits)
         out = (nxt, *_logprob_outputs(d_logits, nxt)) if want_lp else nxt
         return (ck1, cv1, nxt, lens + 1, offs + cclens, fin_logits), out
@@ -1163,6 +1176,14 @@ class Request:
     # here and trims the stop text from its response. The matched tokens
     # stay in the result (ids and text must agree).
     stop_fn: Optional[Any] = None
+    # Constrained decoding (serving.jsonmode.JsonConstraint or any
+    # object with mask()/advance(id)/complete): the engine applies
+    # mask() inside the device sample, advances on each emitted token,
+    # and finishes the request at complete. Constrained requests force
+    # single-step dispatches (the legal set depends on the previous
+    # token), so they cost block-amortization -- documented in
+    # serving/jsonmode.py.
+    constraint: Optional[Any] = None
     # Top-N logprob capture: 0 = off; else each emitted token appends
     # {"logprob", "top_ids", "top_logprobs"} (f32 log-softmax of the RAW
     # logits -- pre-temperature, the OpenAI contract) to
@@ -1442,24 +1463,31 @@ class GenerationEngine:
             # than crash the server at warmup.
             use_kernel = False
 
-        def _block_fn(n, filtered, want_lp):
-            def fn(w, ck, cv, toks, lens, rng, temps, top_ks, top_ps):
+        def _block_fn(n, filtered, want_lp, masked=False):
+            def fn(w, ck, cv, toks, lens, rng, temps, top_ks, top_ps,
+                   *mask):
                 outs, ck, cv = _decode_block(
                     cfg, n, filtered, want_lp, w, ck, cv, toks, lens,
                     rng, temps, top_ks, top_ps, kernel=use_kernel,
+                    mask=mask[0] if masked else None,
                 )
                 return outs, _pin(ck), _pin(cv)
             return fn
 
         def decode_block_call(n, filtered, want_lp, ck, cv, toks, lens,
-                              rng, temps, top_ks, top_ps):
-            key = (n, filtered, want_lp)
+                              rng, temps, top_ks, top_ps, mask=None):
+            # ``masked`` is part of the jit key: the unmasked program
+            # (the common path) compiles byte-identical to before.
+            masked = mask is not None
+            key = (n, filtered, want_lp, masked)
             if key not in block_jits:
                 block_jits[key] = jax.jit(
-                    _block_fn(n, filtered, want_lp), donate_argnums=(1, 2)
+                    _block_fn(n, filtered, want_lp, masked),
+                    donate_argnums=(1, 2),
                 )
+            extra = (jnp.asarray(mask),) if masked else ()
             return block_jits[key](self.weights, ck, cv, toks, lens, rng,
-                                   temps, top_ks, top_ps)
+                                   temps, top_ks, top_ps, *extra)
 
         self._decode_block_call = decode_block_call
 
@@ -1467,21 +1495,24 @@ class GenerationEngine:
 
         def fused_call(n, m, klen, filtered, want_lp, ck, cv, toks,
                        lens, ctoks, coffs, cclens, cslots, rng, temps,
-                       top_ks, top_ps):
-            key = (n, m, klen, ctoks.shape[1], filtered, want_lp)
+                       top_ks, top_ps, mask=None):
+            masked = mask is not None
+            key = (n, m, klen, ctoks.shape[1], filtered, want_lp, masked)
             if key not in fused_jits:
                 def fn(w, ck, cv, toks, lens, ctoks, coffs, cclens,
-                       cslots, rng, temps, top_ks, top_ps):
+                       cslots, rng, temps, top_ks, top_ps, *mk):
                     outs, fin, ck, cv = _fused_block(
                         cfg, n, m, self._chunk, klen, filtered,
                         want_lp, w, ck, cv, toks, lens, ctoks, coffs,
                         cclens, cslots, rng, temps, top_ks, top_ps,
+                        mask=mk[0] if masked else None,
                     )
                     return outs, fin, _pin(ck), _pin(cv)
                 fused_jits[key] = jax.jit(fn, donate_argnums=(1, 2))
+            extra = (jnp.asarray(mask),) if masked else ()
             return fused_jits[key](self.weights, ck, cv, toks, lens,
                                    ctoks, coffs, cclens, cslots, rng,
-                                   temps, top_ks, top_ps)
+                                   temps, top_ks, top_ps, *extra)
 
         self._fused_call = fused_call
 
@@ -1707,13 +1738,16 @@ class GenerationEngine:
                     self.hist[slot, :len(req.prompt)] = req.prompt
                 self.active[slot] = req
                 self._maybe_capture_prefix(req)
-                if req.logprobs:
+                if req.logprobs or req.constraint is not None:
                     if logits_np is None:
                         logits_np = np.asarray(logits, np.float32)
+                tok = (self._host_first_token(logits_np[j], req)
+                       if req.constraint is not None else int(first[j]))
+                if req.logprobs:
                     req.logprob_data.append(_host_logprobs(
-                        logits_np[j], int(first[j]), req.logprobs
+                        logits_np[j], tok, req.logprobs
                     ))
-                self._emit(req, int(first[j]))
+                self._emit(req, tok)
 
     def _maybe_capture_prefix(self, req: Request) -> None:
         """Donate a freshly prefilled slot's leading KV rows to the
@@ -1732,6 +1766,54 @@ class GenerationEngine:
             return
         pk, pv = self._extract_call(plen, jnp.int32(req.slot))
         pc.insert(req.prompt, pk, pv)
+
+    def _pack_constraint_mask(self):
+        """[max_slots, vocab] bool of legal next tokens, or None when no
+        active slot is constrained (the common case: the unmasked jit
+        variants run and the mask upload is skipped entirely)."""
+        reqs = [r for r in self.active.values() if r.constraint is not None]
+        if not reqs:
+            return None
+        m = np.ones((self.max_slots, self.cfg.vocab_size), bool)
+        for req in reqs:
+            # Effective remaining = token budget AND cache headroom
+            # (whichever ends the request first bounds the closure).
+            allowed = req.constraint.mask(min(
+                req.max_new_tokens - len(req.generated),
+                self.cfg.max_seq - int(self.lengths[req.slot]),
+            ))
+            m[req.slot, :] = False
+            m[req.slot, :allowed.size] = allowed
+        return m
+
+    def _host_first_token(self, row: np.ndarray, req: Request) -> int:
+        """First token of a CONSTRAINED request, sampled host-side from
+        its prompt-end logits row (f32). Replicates _sample's semantics
+        (mask -> temperature -> top-k -> top-p) for one row; first
+        tokens are host events anyway, so no extra dispatch."""
+        row = row.astype(np.float64).copy()
+        allowed = req.constraint.mask(min(
+            req.max_new_tokens, self.cfg.max_seq - len(req.prompt),
+        ))
+        row[:min(allowed.size, row.size)][~allowed[:row.size]] = -np.inf
+        row[min(allowed.size, row.size):] = -np.inf
+        if req.temperature <= 0:
+            return int(row.argmax())
+        z = row / max(req.temperature, 1e-6)
+        order = np.argsort(-z)
+        if req.top_k > 0:
+            z[order[req.top_k:]] = -np.inf
+        if req.top_p < 1.0:
+            p = np.exp(z[order] - np.nanmax(z))
+            p = p / p.sum()
+            drop = (np.cumsum(p) - p) >= req.top_p
+            z[order[drop]] = -np.inf
+        p = np.exp(z - z[order[0]])
+        p = p / p.sum()
+        gen = np.random.default_rng(
+            (self.tokens_generated * 2654435761 + req.slot) & 0x7FFFFFFF
+        )
+        return int(gen.choice(row.size, p=p))
 
     def _pack_decode_lanes(self):
         """[max_slots] decode-lane arrays for the active slots; parked
@@ -1820,6 +1902,9 @@ class GenerationEngine:
                 self.cfg.max_seq - int(self.lengths[slot])
                 for slot in self.active
             )))
+        mask = self._pack_constraint_mask()
+        if mask is not None:
+            cap = 1  # constrained decode lanes: single-step dispatches
         n = 1
         while n * 2 <= cap and n < need:
             n *= 2
@@ -1863,7 +1948,7 @@ class GenerationEngine:
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(ctoks), jnp.asarray(coffs), jnp.asarray(cclens),
             jnp.asarray(cslots), self._next_rng(), jnp.asarray(temps),
-            jnp.asarray(top_ks), jnp.asarray(top_ps),
+            jnp.asarray(top_ks), jnp.asarray(top_ps), mask,
         )
         self._emit_decode_outs(outs, want_lp)
         first = None  # sampled lazily: not every dispatch finishes a row
@@ -1883,13 +1968,16 @@ class GenerationEngine:
                 self.hist[slot, :len(req.prompt)] = req.prompt
             self.active[slot] = req
             self._maybe_capture_prefix(req)
-            if req.logprobs:
+            if req.logprobs or req.constraint is not None:
                 if fin_np is None:
                     fin_np = np.asarray(fin_logits, np.float32)
+            tok = (self._host_first_token(fin_np[j], req)
+                   if req.constraint is not None else int(first[j]))
+            if req.logprobs:
                 req.logprob_data.append(
-                    _host_logprobs(fin_np[j], int(first[j]), req.logprobs)
+                    _host_logprobs(fin_np[j], tok, req.logprobs)
                 )
-            self._emit(req, int(first[j]))
+            self._emit(req, tok)
 
     def _emit(self, req: Request, token: int) -> None:
         req.generated.append(token)
@@ -1911,6 +1999,19 @@ class GenerationEngine:
                 logger.exception("on_token callback failed")  # kill the slot
         self.lengths[req.slot] += 1
         stopped = False
+        constrained_done = False
+        if req.constraint is not None:
+            # advance() False means the emitted token broke the
+            # grammar -- impossible while the mask is applied, but a
+            # defensive finish beats emitting unparseable output.
+            if not req.constraint.advance(token):
+                logger.warning("constraint rejected emitted token %d", token)
+                constrained_done = True
+            elif req.constraint.complete:
+                # Root value closed: finishing here (like a stop match)
+                # is what guarantees the result parses as exactly one
+                # JSON document.
+                constrained_done = True
         if req.stop_fn is not None:
             try:
                 stopped = bool(req.stop_fn(req.generated))
@@ -1918,6 +2019,7 @@ class GenerationEngine:
                 logger.exception("stop_fn failed")  # kill the slot
         done = (
             stopped
+            or constrained_done
             or (req.eos_id is not None and token == req.eos_id)
             or len(req.generated) >= req.max_new_tokens
             or self.lengths[req.slot] >= self.cfg.max_seq
@@ -1995,7 +2097,7 @@ class GenerationEngine:
             return False
         if self.speculative_k and all(
             r.temperature <= 0 and r.top_k == 0 and r.top_p >= 1.0
-            and not r.logprobs
+            and not r.logprobs and r.constraint is None
             for r in self.active.values()
         ):
             # Speculation preserves greedy outputs exactly; sampled /
@@ -2017,9 +2119,15 @@ class GenerationEngine:
             req.max_new_tokens - len(req.generated)
             for req in self.active.values()
         )
+        mask = self._pack_constraint_mask()
         n = 1
-        while n * 2 <= min(self.decode_block, max(remaining, 1), max(budget, 1)):
-            n *= 2
+        if mask is None:
+            while n * 2 <= min(self.decode_block, max(remaining, 1),
+                               max(budget, 1)):
+                n *= 2
+        # else: constrained slots are active -- the legal-token set
+        # depends on each sampled token, so dispatches are single-step
+        # for the whole batch (jsonmode.py documents the cost).
         tokens, temps, top_ks, top_ps, positions, filtered = (
             self._pack_decode_lanes()
         )
@@ -2028,7 +2136,7 @@ class GenerationEngine:
             n, filtered, want_lp, self.cache_k, self.cache_v,
             jnp.asarray(tokens), jnp.asarray(positions),
             self._next_rng(), jnp.asarray(temps),
-            jnp.asarray(top_ks), jnp.asarray(top_ps),
+            jnp.asarray(top_ks), jnp.asarray(top_ps), mask,
         )
         self._emit_decode_outs(outs, want_lp)
         return True
@@ -2084,11 +2192,12 @@ class GenerationEngine:
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 64,
                  temperature: float = 0.0,
                  eos_id: Optional[int] = None,
-                 top_k: int = 0, top_p: float = 1.0) -> List[int]:
+                 top_k: int = 0, top_p: float = 1.0,
+                 constraint=None) -> List[int]:
         """Synchronous single-request generation (drives step() inline)."""
 
         req = Request(list(prompt), max_new_tokens, temperature,
-                      top_k, top_p, eos_id)
+                      top_k, top_p, eos_id, constraint=constraint)
         fut = self.submit(req)
         if self._thread is not None:
             return fut.result(timeout=600)

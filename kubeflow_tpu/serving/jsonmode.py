@@ -1,0 +1,465 @@
+"""Constrained decoding: OpenAI ``response_format: json_object``.
+
+vLLM-class structured output for the TPU engine (SURVEY.md 3.3 S5
+delta), sized to what the contract needs: a character-level JSON
+valid-prefix automaton, lifted to token level by simulating each vocab
+token's string, produces a boolean vocab mask per decode step. The
+engine applies the mask INSIDE the device sample (engine._sample:
+disallowed logits -> -inf before greedy/temperature/top-k/top-p), so
+the constraint composes with every sampling mode; the automaton itself
+advances on the host with each emitted token.
+
+Design notes (TPU-first reasoning):
+- Per-step masks are inherently sequential (the allowed set depends on
+  the token just sampled), so constrained requests run at
+  decode-block=1 -- one dispatch per token, mask uploaded as a [B, V]
+  bool (V bytes/slot). That is the honest cost of JSON mode on a
+  remote-dispatch chip; unconstrained requests are untouched (the
+  masked program is a separate jit variant, so the common path compiles
+  byte-identical code to before).
+- Masks are cached by automaton state (state, literal-tail, stack):
+  steady-state decoding revisits a handful of states, so the
+  32k-token simulation sweep runs once per distinct state, not per
+  step. A first-character pre-filter prunes most of the vocab before
+  simulation.
+- Root is an OBJECT, opened immediately (no leading whitespace --
+  see _MAX_WS_RUN): that is what "json_object" promises, and it
+  sidesteps the bare-number ambiguity
+  (a top-level ``12`` is a valid prefix of ``123`` forever, so
+  completion would be undecidable).
+- When the automaton reaches the complete state the engine finishes
+  the request (like a stop match): the result text parses as exactly
+  one JSON object, with no trailing garbage to trim.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_WS = " \t\n\r"
+_HEX = "0123456789abcdefABCDEF"
+_MAX_DEPTH = 64
+
+
+class JsonFsm:
+    """Valid-prefix automaton for one object-rooted JSON document.
+
+    advance_char(c) -> bool consumes one character (False = the char
+    cannot extend any valid JSON document). ``complete`` is True once
+    the root object has closed (only whitespace may follow; the engine
+    finishes the request instead).
+    """
+
+    __slots__ = ("stack", "state", "lit", "key_str", "ws_run")
+
+    # Consecutive structural whitespace allowed. Whitespace never
+    # changes JSON semantics, but an unbounded allowance lets a
+    # weak/greedy model emit it forever and run out the token budget
+    # with the root object never opened (observed on random weights) --
+    # so the automaton treats it as a decoding POLICY: at most
+    # _MAX_WS_RUN in a row, none before the root '{'.
+    _MAX_WS_RUN = 2
+
+    def __init__(self) -> None:
+        self.stack: List[str] = []   # 'o' | 'a'
+        self.state = "start"
+        self.lit = ""                # remaining literal chars / hex count
+        self.key_str = False
+        self.ws_run = 0
+
+    # -- bookkeeping -----------------------------------------------------
+
+    def clone(self) -> "JsonFsm":
+        f = JsonFsm.__new__(JsonFsm)
+        f.stack = list(self.stack)
+        f.state = self.state
+        f.lit = self.lit
+        f.key_str = self.key_str
+        f.ws_run = self.ws_run
+        return f
+
+    def mask_key(self) -> Tuple:
+        return (self.state, self.lit, self.key_str, tuple(self.stack),
+                self.ws_run)
+
+    @property
+    def complete(self) -> bool:
+        return self.state == "after_value" and not self.stack
+
+    def min_close_chars(self) -> int:
+        """Fewest characters that complete the document from here (the
+        budget-forcing bound: every char is at least one token, and
+        byte-level BPE vocabularies contain every single byte, so a
+        char count lower-bounds the token count)."""
+        s = self.state
+        key_extra = 2 if self.key_str else 0  # ':' + shortest value '0'
+        if s == "start":
+            return 2  # '{' '}'
+        if s == "in_str":
+            cost = 1 + key_extra
+        elif s == "str_esc":
+            cost = 2 + key_extra
+        elif s == "str_u":
+            cost = int(self.lit) + 1 + key_extra
+        elif s == "lit":
+            cost = len(self.lit)
+        elif s in ("num_minus", "num_dot", "num_e", "num_esign"):
+            cost = 1  # one digit, then the number may end at a closer
+        elif s == "value":
+            cost = 1  # shortest value: a single digit
+        elif s == "expect_colon":
+            cost = 2  # ':' + digit
+        elif s == "expect_key_more":
+            cost = 4  # shortest member: '"' '"' ':' '0'
+        else:
+            # after_value / num_* that may end / expect_key (closes via
+            # '}') / arr_first (closes via ']') -- the closer is already
+            # counted in the stack term.
+            cost = 0
+        return cost + len(self.stack)
+
+    # -- transitions -----------------------------------------------------
+
+    def _end_value(self) -> None:
+        self.state = "after_value"
+
+    def _push(self, kind: str) -> bool:
+        if len(self.stack) >= _MAX_DEPTH:
+            return False
+        self.stack.append(kind)
+        return True
+
+    def _start_value(self, c: str) -> bool:
+        """Value-start dispatch shared by 'value' and 'arr_first'."""
+        if c == "{":
+            self.state = "expect_key"
+            return self._push("o")
+        if c == "[":
+            self.state = "arr_first"
+            return self._push("a")
+        if c == '"':
+            self.state = "in_str"
+            self.key_str = False
+            return True
+        if c == "-":
+            self.state = "num_minus"
+            return True
+        if c == "0":
+            self.state = "num_zero"
+            return True
+        if c in "123456789":
+            self.state = "num_int"
+            return True
+        if c == "t":
+            self.state, self.lit = "lit", "rue"
+            return True
+        if c == "f":
+            self.state, self.lit = "lit", "alse"
+            return True
+        if c == "n":
+            self.state, self.lit = "lit", "ull"
+            return True
+        return False
+
+    def _ws_ok(self) -> bool:
+        if self.ws_run >= self._MAX_WS_RUN:
+            return False
+        self.ws_run += 1
+        return True
+
+    def advance_char(self, c: str) -> bool:  # noqa: C901 - one automaton
+        ok = self._advance_char(c)
+        if ok and c not in _WS:
+            self.ws_run = 0
+        return ok
+
+    def _advance_char(self, c: str) -> bool:  # noqa: C901
+        s = self.state
+        if s == "start":
+            if c in _WS:
+                return False  # root opens immediately (see _MAX_WS_RUN)
+            if c == "{":
+                self.state = "expect_key"
+                return self._push("o")
+            return False
+        if s == "in_str":
+            if c == '"':
+                if self.key_str:
+                    self.state = "expect_colon"
+                else:
+                    self._end_value()
+                return True
+            if c == "\\":
+                self.state = "str_esc"
+                return True
+            return ord(c) >= 0x20
+        if s == "str_esc":
+            if c == "u":
+                self.state, self.lit = "str_u", "4"
+                return True
+            if c in '"\\/bfnrt':
+                self.state = "in_str"
+                return True
+            return False
+        if s == "str_u":
+            if c not in _HEX:
+                return False
+            left = int(self.lit) - 1
+            if left == 0:
+                self.state = "in_str"
+            else:
+                self.lit = str(left)
+            return True
+        if s == "lit":
+            if not self.lit or c != self.lit[0]:
+                return False
+            self.lit = self.lit[1:]
+            if not self.lit:
+                self._end_value()
+            return True
+        if s in ("num_minus", "num_zero", "num_int", "num_dot",
+                 "num_frac", "num_e", "num_esign", "num_exp"):
+            return self._advance_number(s, c)
+        if s == "value":
+            if c in _WS:
+                return self._ws_ok()
+            return self._start_value(c)
+        if s == "arr_first":
+            if c in _WS:
+                return self._ws_ok()
+            if c == "]":
+                self.stack.pop()
+                self._end_value()
+                return True
+            return self._start_value(c)
+        if s in ("expect_key", "expect_key_more"):
+            if c in _WS:
+                return self._ws_ok()
+            if c == '"':
+                self.state = "in_str"
+                self.key_str = True
+                return True
+            if s == "expect_key_more":
+                return False  # after a comma only a key may follow
+            if c == "}":
+                self.stack.pop()
+                self._end_value()
+                return True
+            return False
+        if s == "expect_colon":
+            if c in _WS:
+                return self._ws_ok()
+            if c == ":":
+                self.state = "value"
+                return True
+            return False
+        if s == "after_value":
+            if c in _WS:
+                return self._ws_ok()
+            if not self.stack:
+                return False  # root closed: nothing but whitespace
+            top = self.stack[-1]
+            if c == ",":
+                # "expect_key_more", not "expect_key": a comma promises
+                # another member, so '}' (trailing comma) is invalid.
+                self.state = "expect_key_more" if top == "o" else "value"
+                return True
+            if c == "}" and top == "o":
+                self.stack.pop()
+                self._end_value()
+                return True
+            if c == "]" and top == "a":
+                self.stack.pop()
+                self._end_value()
+                return True
+            return False
+        raise AssertionError(f"unknown state {s!r}")
+
+    def _advance_number(self, s: str, c: str) -> bool:
+        if s == "num_minus":
+            if c == "0":
+                self.state = "num_zero"
+                return True
+            if c in "123456789":
+                self.state = "num_int"
+                return True
+            return False
+        if s == "num_e":
+            if c in "+-":
+                self.state = "num_esign"
+                return True
+            if c.isdigit():
+                self.state = "num_exp"
+                return True
+            return False
+        if s == "num_esign":
+            if c.isdigit():
+                self.state = "num_exp"
+                return True
+            return False
+        if s == "num_dot":
+            if c.isdigit():
+                self.state = "num_frac"
+                return True
+            return False
+        # num_zero / num_int / num_frac / num_exp: may continue or end.
+        if s in ("num_zero",):
+            if c == ".":
+                self.state = "num_dot"
+                return True
+            if c in "eE":
+                self.state = "num_e"
+                return True
+        if s == "num_int":
+            if c.isdigit():
+                return True
+            if c == ".":
+                self.state = "num_dot"
+                return True
+            if c in "eE":
+                self.state = "num_e"
+                return True
+        if s == "num_frac":
+            if c.isdigit():
+                return True
+            if c in "eE":
+                self.state = "num_e"
+                return True
+        if s == "num_exp" and c.isdigit():
+            return True
+        # The number ends here; the char belongs to the enclosing
+        # structure -- re-dispatch it from after_value.
+        self._end_value()
+        return self._advance_char(c)
+
+    def advance_str(self, text: str) -> bool:
+        for c in text:
+            if not self.advance_char(c):
+                return False
+        return True
+
+
+class JsonTokenMasks:
+    """Token-level lift of JsonFsm for one vocabulary, with a mask cache
+    keyed by automaton state. Shared across requests (build once per
+    model: the per-token strings + first-char table cost one pass over
+    the vocab)."""
+
+    def __init__(self, vocab: Sequence[Optional[str]],
+                 vocab_size: Optional[int] = None) -> None:
+        self.vocab_size = vocab_size or len(vocab)
+        # Token id -> string; None/empty = never allowed (special
+        # tokens, ids past the tokenizer's range).
+        self.strings: List[Optional[str]] = [
+            (s if s else None) for s in vocab
+        ] + [None] * (self.vocab_size - len(vocab))
+        self.first = [s[0] if s else None for s in self.strings]
+        self._cache: Dict[Tuple, np.ndarray] = {}
+
+    # Budget forcing kicks in once this many tokens remain: below it,
+    # a token is only legal if the document can still CLOSE within the
+    # post-token budget (min_close_chars lower-bounds tokens-to-close).
+    # Without this, a weak model rambles inside a string until
+    # max_new_tokens and the output is an unparseable prefix.
+    FORCE_CLOSE_AT = 48
+
+    # Cache keys quantize ``remaining`` DOWN onto these buckets: a raw
+    # key would make every late-request step a cache miss (remaining
+    # decrements each token), re-running the full-vocab FSM sweep per
+    # token on the host critical path. Rounding down is conservative --
+    # a mask computed for a smaller budget only closes earlier, never
+    # emits an unclosable token.
+    _REMAINING_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48)
+
+    def mask_for(self, fsm: JsonFsm,
+                 remaining: Optional[int] = None) -> np.ndarray:
+        tight = remaining is not None and remaining <= self.FORCE_CLOSE_AT
+        if tight:
+            remaining = max(
+                b for b in self._REMAINING_BUCKETS if b <= max(remaining, 1)
+            )
+        key = fsm.mask_key() + ((remaining,) if tight else ())
+        m = self._cache.get(key)
+        if m is not None:
+            return m
+        # First-char pre-filter: one clone per DISTINCT first char.
+        ok_first: Dict[str, bool] = {}
+        m = np.zeros(self.vocab_size, bool)
+        for tid, s in enumerate(self.strings):
+            if s is None:
+                continue
+            c0 = self.first[tid]
+            ok = ok_first.get(c0)
+            if ok is None:
+                ok = ok_first[c0] = fsm.clone().advance_char(c0)
+            if not ok:
+                continue
+            if not tight and len(s) == 1:
+                m[tid] = True
+                continue
+            f2 = fsm.clone()
+            if not f2.advance_str(s):
+                continue
+            m[tid] = (not tight
+                      or f2.min_close_chars() <= remaining - 1)
+        if tight and not m.any():
+            # Budget already unsatisfiable (caller gave too few tokens):
+            # best effort -- fall back to the unrestricted valid set so
+            # generation stays grammatical as far as it goes.
+            m = self.mask_for(fsm)
+        self._cache[key] = m
+        return m
+
+
+class JsonConstraint:
+    """Per-request constraint object the engine consumes:
+    ``mask()`` -> [vocab] bool of currently-legal tokens,
+    ``advance(token_id)`` after each emitted token,
+    ``complete`` -> finish the request (output parses as one object)."""
+
+    def __init__(self, masks: JsonTokenMasks) -> None:
+        self.masks = masks
+        self.fsm = JsonFsm()
+
+    def mask(self, remaining: Optional[int] = None) -> np.ndarray:
+        return self.masks.mask_for(self.fsm, remaining)
+
+    def advance(self, token_id: int) -> bool:
+        s = (self.masks.strings[token_id]
+             if 0 <= token_id < len(self.masks.strings) else None)
+        if s is None:
+            return False
+        return self.fsm.advance_str(s)
+
+    @property
+    def complete(self) -> bool:
+        return self.fsm.complete
+
+
+def byte_vocab(vocab_size: int) -> List[Optional[str]]:
+    """Vocab strings for the ByteTokenizer: ids 0..255 are single
+    bytes (decoded latin-1-ish via utf-8 semantics: only ASCII ids map
+    to standalone chars; non-ASCII lead/continuation bytes cannot be
+    validated char-wise, so they are masked out -- constrained JSON
+    from a byte model is ASCII-only, which json.loads accepts with
+    \\u escapes available for everything else)."""
+    out: List[Optional[str]] = []
+    for i in range(min(vocab_size, 256)):
+        out.append(chr(i) if i < 0x80 else None)
+    return out
+
+
+def tokenizer_vocab_strings(tok, vocab_size: int) -> List[Optional[str]]:
+    """Per-token strings from a `tokenizers`/HF-style tokenizer via
+    single-id decode (byte-level BPE decodes any id standalone).
+    Special tokens decode to ""/markers that the FSM then rejects."""
+    out: List[Optional[str]] = []
+    for i in range(vocab_size):
+        try:
+            s = tok.decode([i])
+        except Exception:  # noqa: BLE001 - out-of-range id
+            s = None
+        out.append(s if s else None)
+    return out

@@ -203,6 +203,7 @@ class JaxLLMModel(Model):
         self.options = options
         self.engine = None
         self.tokenizer = None
+        self._json_mask_table = None  # built lazily (see _json_masks)
 
     def load(self) -> None:
         from kubeflow_tpu.serving.engine import GenerationEngine
@@ -215,6 +216,7 @@ class JaxLLMModel(Model):
         opts = self.options
         tok = opts.get("tokenizer", "byte")
         self.tokenizer = ByteTokenizer() if tok == "byte" else HFTokenizer(tok)
+        self._json_mask_table = None  # tokenizer changed: rebuild lazily
 
         params = None
         config = None
@@ -382,9 +384,40 @@ class JaxLLMModel(Model):
         )
         return lines
 
+    def _json_masks(self):
+        """Token-mask table for json_object constrained decoding, built
+        once per model from the live tokenizer (byte or BPE) and shared
+        across requests (serving/jsonmode.py caches per-state masks)."""
+        if self._json_mask_table is None:
+            from kubeflow_tpu.serving import jsonmode
+
+            vocab_size = self.engine.cfg.vocab_size
+            if isinstance(self.tokenizer, ByteTokenizer):
+                vocab = jsonmode.byte_vocab(vocab_size)
+            else:
+                vocab = jsonmode.tokenizer_vocab_strings(
+                    self.tokenizer, vocab_size)
+            self._json_mask_table = jsonmode.JsonTokenMasks(
+                vocab, vocab_size)
+        return self._json_mask_table
+
     def _build_request(self, inst: dict, ids: List[int], on_token=None):
         from kubeflow_tpu.serving.engine import Request
+        from kubeflow_tpu.serving.jsonmode import JsonConstraint
 
+        constraint = None
+        rf = inst.get("response_format")
+        if rf is not None:
+            # Normalize here, not just at the OpenAI route: V1 predict
+            # and V2 generate forward instances raw, and an unsupported
+            # value must fail loudly, never silently produce free text.
+            rtype = rf.get("type") if isinstance(rf, dict) else rf
+            if rtype == "json_object":
+                constraint = JsonConstraint(self._json_masks())
+            elif rtype not in (None, "text"):
+                raise InferenceError(
+                    f"unsupported response_format {rtype!r} "
+                    '(supported: "text", "json_object")', 400)
         stops = _stop_list(inst)
         return Request(
             prompt=ids,
@@ -396,6 +429,7 @@ class JaxLLMModel(Model):
             stop_fn=(make_stop_fn(self.tokenizer.decode, stops)
                      if stops else None),
             logprobs=int(inst.get("logprobs", 0) or 0),
+            constraint=constraint,
             on_token=on_token,
         )
 
@@ -420,7 +454,13 @@ class JaxLLMModel(Model):
                 slots.append(parsed)
                 continue
             ids, text_out = parsed
-            req = self._build_request(inst, ids)
+            try:
+                req = self._build_request(inst, ids)
+            except InferenceError as e:
+                # Same per-instance contract as _parse_instance: one bad
+                # knob (e.g. response_format) must not fail the batch.
+                slots.append({"error": str(e)})
+                continue
             slots.append((self.engine.submit(req), text_out))
         out = []
         for slot in slots:

@@ -306,7 +306,8 @@ class ModelServer:
         and the V1-instance shape ({"prompt"|"token_ids", ...}) alike."""
         inst = dict(body.get("parameters") or {})
         for k in ("prompt", "token_ids", "max_new_tokens", "temperature",
-                  "top_k", "top_p", "eos_id", "stop", "logprobs"):
+                  "top_k", "top_p", "eos_id", "stop", "logprobs",
+                  "response_format"):
             if k in body:
                 inst[k] = body[k]
         if "text_input" in body:
@@ -525,6 +526,19 @@ class ModelServer:
                 inst["logprobs"] = max(1, opt("top_logprobs", 0, int))
         elif body.get("logprobs") is not None:
             inst["logprobs"] = max(1, int(body["logprobs"]))
+        rf = body.get("response_format")
+        if rf is not None:
+            # OpenAI structured output: {"type": "text" | "json_object"}.
+            # json_object rides token-mask constrained decoding in the
+            # engine (serving/jsonmode.py); json_schema is out of scope
+            # and rejected explicitly rather than silently ignored.
+            rtype = rf.get("type") if isinstance(rf, dict) else rf
+            if rtype == "json_object":
+                inst["response_format"] = "json_object"
+            elif rtype not in (None, "text"):
+                raise InferenceError(
+                    f'unsupported response_format type {rtype!r} '
+                    '(supported: "text", "json_object")', 400)
         return inst
 
     @staticmethod
