@@ -31,14 +31,33 @@ def parse_args(argv=None):
     p.add_argument("--sequence", type=int, default=1)
     p.add_argument("--expert", type=int, default=1)
     p.add_argument("--pipe", type=int, default=1)
-    p.add_argument("--num-slices", type=int,
-                   default=int(os.environ.get("KFTPU_NUM_SLICES", "1")),
-                   help="multislice: data axis spans slices over DCN")
+    p.add_argument("--num-slices",
+                   default=os.environ.get("KFTPU_NUM_SLICES", "1"),
+                   help="multislice: data axis spans slices over DCN. "
+                        "'auto' = one slice per worker process, which "
+                        "makes elastic replica re-formation a "
+                        "slice-count resize (resharded restore)")
     p.add_argument(
         "--arg", action="append", default=[],
         help="task kwargs, key=value (int/float autocast)", metavar="K=V",
     )
     return p.parse_args(argv)
+
+
+def resolve_num_slices(value, num_processes: int) -> int:
+    """'auto' -> one slice per process: the reconciler's elastic
+    re-formation (fewer replicas after a failure or metric resize) then
+    IS slice-count elasticity -- the restarted workers rebuild the DCN
+    mesh at the surviving slice count and orbax reshards the restore
+    (SURVEY.md 5.3). Any int is an explicit override."""
+    if value == "auto":
+        return num_processes
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"--num-slices must be an int or 'auto', got {value!r}"
+        ) from None
 
 
 def _cast(v: str):
@@ -81,10 +100,11 @@ def main(argv=None) -> int:
 
     cfg = MeshConfig(data=-1, fsdp=args.fsdp, sequence=args.sequence,
                      tensor=args.tensor, expert=args.expert, pipe=args.pipe)
-    if args.num_slices > 1:
+    num_slices = resolve_num_slices(args.num_slices, ctx.num_processes)
+    if num_slices > 1:
         from kubeflow_tpu.parallel.mesh import build_multislice_mesh
 
-        mesh = build_multislice_mesh(cfg, num_slices=args.num_slices)
+        mesh = build_multislice_mesh(cfg, num_slices=num_slices)
     else:
         mesh = build_mesh(cfg)
     n_chips = len(jax.devices())

@@ -171,7 +171,10 @@ def encode_to_bin(
                     # WITHOUT a boundary token (the doc continues in the
                     # next chunk; the seam costs one suboptimal merge,
                     # never a dropped char or a false <doc>).
-                    arr = np.asarray(tok.encode(buf).ids, np.uint16)
+                    ids0 = tok.encode(buf).ids
+                    if ids0 and max(ids0) >= 65536:
+                        raise ValueError("token id overflows uint16")
+                    arr = np.asarray(ids0, np.uint16)
                     arr.tofile(out)
                     n += arr.size
                     buf = ""

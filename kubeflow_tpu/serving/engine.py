@@ -1807,6 +1807,11 @@ class GenerationEngine:
             p = np.exp(z[order] - np.nanmax(z))
             p = p / p.sum()
             drop = (np.cumsum(p) - p) >= req.top_p
+            # The top candidate always survives -- top_p=0 otherwise
+            # drops EVERY token, and exp(-inf - -inf) = NaN would kill
+            # the engine thread (the device _sample degrades to uniform
+            # there; keeping argmax is the saner host behavior).
+            drop[0] = False
             z[order[drop]] = -np.inf
         p = np.exp(z - z[order[0]])
         p = p / p.sum()

@@ -34,7 +34,8 @@ Design notes (TPU-first reasoning):
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -356,7 +357,11 @@ class JsonTokenMasks:
             (s if s else None) for s in vocab
         ] + [None] * (self.vocab_size - len(vocab))
         self.first = [s[0] if s else None for s in self.strings]
-        self._cache: Dict[Tuple, np.ndarray] = {}
+        # LRU-bounded: one vocab-size bool array per distinct automaton
+        # state; adversarially varied nesting would otherwise grow the
+        # table without bound over a server's lifetime.
+        self._cache: "OrderedDict[Tuple, np.ndarray]" = OrderedDict()
+        self._cache_cap = 256
 
     # Budget forcing kicks in once this many tokens remain: below it,
     # a token is only legal if the document can still CLOSE within the
@@ -383,6 +388,7 @@ class JsonTokenMasks:
         key = fsm.mask_key() + ((remaining,) if tight else ())
         m = self._cache.get(key)
         if m is not None:
+            self._cache.move_to_end(key)
             return m
         # First-char pre-filter: one clone per DISTINCT first char.
         ok_first: Dict[str, bool] = {}
@@ -410,6 +416,8 @@ class JsonTokenMasks:
             # generation stays grammatical as far as it goes.
             m = self.mask_for(fsm)
         self._cache[key] = m
+        while len(self._cache) > self._cache_cap:
+            self._cache.popitem(last=False)
         return m
 
 

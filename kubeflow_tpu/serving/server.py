@@ -307,7 +307,7 @@ class ModelServer:
         inst = dict(body.get("parameters") or {})
         for k in ("prompt", "token_ids", "max_new_tokens", "temperature",
                   "top_k", "top_p", "eos_id", "stop", "logprobs",
-                  "response_format"):
+                  "response_format", "stream_pacing"):
             if k in body:
                 inst[k] = body[k]
         if "text_input" in body:
@@ -358,13 +358,26 @@ class ModelServer:
         ends at the match with the stop text excluded (the engine-side
         stop_fn frees the slot; this trims the transport). Raises the
         engine error, if any, at the end. Shared by the V2
-        generate_stream and OpenAI SSE framings."""
+        generate_stream and OpenAI SSE framings.
+
+        PACING (on by default; ``stream_pacing: false`` opts out): the
+        engine's block decode delivers tokens in dispatch-boundary
+        BURSTS (decode_block at a time), so raw forwarding gives a
+        client ITL of 0ms within a burst and a whole block-time at its
+        edge. The drain below re-times emission at the measured steady
+        per-token rate (cumulative mean of arrival intervals), which is
+        what a human reader or a typewriter UI actually wants. The
+        trade: a token emits up to ~one block-time later than it
+        arrived (final-token latency grows by its in-burst index x
+        TPOT); throughput and TTFT are untouched (the first token is
+        never delayed, and the engine never waits on the transport)."""
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         done = object()
+        pacing = bool(inst.get("stream_pacing", True))
 
         def on_token(tok: int) -> None:  # engine thread
-            loop.call_soon_threadsafe(q.put_nowait, tok)
+            loop.call_soon_threadsafe(q.put_nowait, (tok, time.monotonic()))
 
         fut, decode = model.submit_stream(inst, on_token)
         fut.add_done_callback(
@@ -373,10 +386,31 @@ class ModelServer:
         ids: list = []
         text = ""
         stopped = False
+        t_prev = None    # previous ARRIVAL (rate estimation)
+        tpot = 0.0       # EMA of per-token arrival interval
+        next_t = 0.0     # earliest next emission
         while True:
-            tok = await q.get()
-            if tok is done:
+            item = await q.get()
+            if item is done:
                 break
+            tok, t_arr = item
+            if t_prev is not None and pacing:
+                # EMA over inter-arrival gaps: burst-interior gaps are
+                # ~0 and the dispatch boundary carries the whole block,
+                # so the EMA converges to block_time/block = steady
+                # TPOT within a couple of blocks, and re-converges fast
+                # if the engine's rate shifts (slots joining/leaving).
+                tpot = 0.9 * tpot + 0.1 * (t_arr - t_prev)
+                now = time.monotonic()
+                # Sleep toward the schedule, capped at 2 token-times;
+                # a growing backlog shrinks the sleep proportionally so
+                # buffered lag stays bounded (smoothly, no cliff) when
+                # the estimate runs slow or the engine finished early.
+                wait = min(next_t - now, 2.0 * tpot) / (1 + q.qsize() / 8)
+                if wait > 0:
+                    await asyncio.sleep(wait)
+                next_t = max(now, next_t) + tpot
+            t_prev = t_arr
             ids.append(tok)
             try:
                 full = decode(ids)
@@ -526,6 +560,13 @@ class ModelServer:
                 inst["logprobs"] = max(1, opt("top_logprobs", 0, int))
         elif body.get("logprobs") is not None:
             inst["logprobs"] = max(1, int(body["logprobs"]))
+        # Client-paced streaming opt-out: OpenAI's stream_options
+        # carries extensions; a top-level stream_pacing also works.
+        so = body.get("stream_options")
+        if isinstance(so, dict) and "pacing" in so:
+            inst["stream_pacing"] = bool(so["pacing"])
+        elif body.get("stream_pacing") is not None:
+            inst["stream_pacing"] = bool(body["stream_pacing"])
         rf = body.get("response_format")
         if rf is not None:
             # OpenAI structured output: {"type": "text" | "json_object"}.

@@ -975,3 +975,52 @@ class TestOptionalRuntimeHappyPaths:
         m = PaddleModel("pd", str(tmp_path), {})
         with pytest.raises(InferenceError, match="pdiparams"):
             m.load()
+
+
+def test_stream_pacing_smooths_bursts():
+    """Client-paced streaming (r4 verdict #3): block decode delivers
+    tokens in dispatch bursts; the SSE drain re-times them at the
+    measured steady rate. With pacing (default) most inter-event gaps
+    are non-trivial; with stream_pacing=false most gaps are the burst
+    interior's ~0. TTFT is untouched either way (first token never
+    sleeps)."""
+    import time as _time
+
+    from kubeflow_tpu.serving.runtimes.jax_llm_server import JaxLLMModel
+
+    m = JaxLLMModel("p", None, {"preset": "llama-tiny", "max_slots": 2,
+                                "decode_block": 8, "checkpoint": "none"})
+    m.load()
+    server = ModelServer(repository=ModelRepository())
+    server.repository.register(m)
+
+    async def collect(pacing: bool):
+        inst = {"prompt": "pace me", "max_new_tokens": 48,
+                "stream_pacing": pacing}
+        times = []
+        async for _delta, tok, _ids in server._stream_deltas(m, inst):
+            if tok is not None:
+                times.append(_time.monotonic())
+        return [b - a for a, b in zip(times, times[1:])]
+
+    loop = asyncio.new_event_loop()
+    try:
+        gaps_raw = loop.run_until_complete(collect(False))
+        gaps_paced = loop.run_until_complete(collect(True))
+    finally:
+        loop.close()
+        m.unload()
+    assert len(gaps_raw) == len(gaps_paced) == 47
+
+    import statistics
+
+    # RELATIVE comparison (absolute wall-clock thresholds flake on a
+    # loaded CI host): raw forwarding leaves burst-interior gaps at
+    # scheduling noise, pacing spreads the median toward TPOT -- the
+    # paced median must sit far above the raw one.
+    med_raw = statistics.median(gaps_raw)
+    med_paced = statistics.median(gaps_paced)
+    assert med_paced > 5 * max(med_raw, 1e-6), (med_raw, med_paced)
+    # Pacing must not reorder or drop: both decoded the same stream
+    # shape (47 gaps checked above) -- content equality is covered by
+    # the existing SSE tests.
