@@ -93,7 +93,7 @@ def check_flash_kernel() -> None:
 
 
 def run_config(batch: int, seq: int, steps: int, loss_chunk: int = 0,
-               remat_policy: str = "dots") -> dict:
+               remat_policy: str = "dots", **task_kwargs) -> dict:
     """One measured config: steady-state tokens/s + MFU at (batch, seq).
     State is freed before returning so back-to-back configs never hold
     two optimizer states in HBM."""
@@ -108,7 +108,7 @@ def run_config(batch: int, seq: int, steps: int, loss_chunk: int = 0,
     task = get_task(
         "llama", preset=PRESET, batch_size=batch, seq_len=seq,
         optimizer="adafactor", loss_chunk=loss_chunk,
-        remat_policy=remat_policy,
+        remat_policy=remat_policy, **task_kwargs,
     )
     mesh = build_mesh(MeshConfig(data=-1))
     n_chips = len(jax.devices())
@@ -153,9 +153,46 @@ def main() -> int:
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+    if len(sys.argv) > 1 and sys.argv[1] == "--int8-ab":
+        # Child: the int8-matmul config alone, one JSON line.
+        print(json.dumps(run_config(BATCH, SEQ, STEPS, int8_matmul=True)))
+        return 0
+
     check_flash_kernel()
 
     head = run_config(BATCH, SEQ, STEPS)
+    # int8 (AQT-style) training matmuls A/B (round-4 verdict #4): the
+    # one lever the MFU-plateau trace left open -- v5e's MXU doubles
+    # int8 throughput and matmuls own ~75% of the step. Same batch/seq,
+    # dynamic-quant forward + exact bf16 straight-through backward
+    # (ops/int8_matmul.py). Loss parity is part of the result: the A/B
+    # is only a win if the loss trace holds.
+    int8_ab = None
+    if os.environ.get("BENCH_INT8_MM", "1") != "0":
+        # In a SUBPROCESS: in-process phase ordering measurably
+        # contaminates this chip's numbers (bench_serving._run_phase
+        # records an identical A/B collapsing +22% -> +3%); the bf16
+        # baseline is the head config, measured first in THIS fresh
+        # process, so both sides run process-fresh.
+        import subprocess
+
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--int8-ab"],
+                capture_output=True, text=True, timeout=1800,
+            )
+            q = json.loads(proc.stdout.strip().splitlines()[-1])
+            int8_ab = {
+                "tokens_per_sec_per_chip": q["tokens_per_sec_per_chip"],
+                "vs_bf16": round(
+                    q["tokens_per_sec_per_chip"]
+                    / head["tokens_per_sec_per_chip"], 3),
+                "final_loss_bf16": head["final_loss"],
+                "final_loss_int8": q["final_loss"],
+                "step_time_ms": q["step_time_ms"],
+            }
+        except Exception as e:  # noqa: BLE001 - record, keep headline
+            int8_ab = {"error": f"{type(e).__name__}: {e}"[:200]}
     sweep = []
     for entry in SEQ_SWEEP:
         seq, batch = int(entry[0]), int(entry[1])
@@ -189,6 +226,7 @@ def main() -> int:
                     "params_b": head["params_b"],
                     "final_loss": final_loss,
                     "seq_sweep": sweep,
+                    "int8_matmul_ab": int8_ab,
                     "device": jax.devices()[0].device_kind,
                 },
             }

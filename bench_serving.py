@@ -123,6 +123,36 @@ def bench_one(max_slots: int) -> dict:
     }
 
 
+def _measured_reps(measure, n: int = 3) -> dict:
+    """Variance discipline (round-4 verdict #6): the axon tunnel moves
+    +-10-20% day to day and single runs were quoting deltas inside that
+    band. Each headline A/B pass now repeats n times INSIDE one
+    subprocess (same day, same process, interleaved nothing) and
+    reports median + spread; comparisons downstream call a delta that
+    fits inside the joined spreads 'parity'."""
+    import statistics
+
+    vals = [measure() for _ in range(n)]
+    med = statistics.median(vals)
+    return {
+        "tokens_per_sec": round(med, 1),
+        "reps": [round(v, 1) for v in vals],
+        "spread_pct": round((max(vals) - min(vals)) / med * 100.0, 1),
+    }
+
+
+def _ab_verdict(a: dict, b: dict) -> dict:
+    """Median ratio b/a plus a parity label when the delta sits inside
+    the two runs' combined spread."""
+    ratio = b["tokens_per_sec"] / a["tokens_per_sec"]
+    spread = (a["spread_pct"] + b["spread_pct"]) / 100.0 / 2
+    return {
+        "ratio": round(ratio, 3),
+        "verdict": ("parity" if abs(ratio - 1.0) <= max(spread, 0.02)
+                    else ("faster" if ratio > 1 else "slower")),
+    }
+
+
 def _pct(xs, q):
     import numpy as np
 
@@ -207,29 +237,29 @@ def bench_quantized(max_slots: int) -> dict:
         futs = [eng.submit(r) for r in make(max_slots)]  # warm/compile
         while any(not f.done() for f in futs):
             eng.step()
-        futs = [eng.submit(r) for r in make(max_slots * 2)]
-        t0 = _t.perf_counter()
-        while any(not f.done() for f in futs):
-            eng.step()
-        dt = _t.perf_counter() - t0
-        gen = sum(len(f.result()) for f in futs)
+
+        def one_pass():
+            futs = [eng.submit(r) for r in make(max_slots * 2)]
+            t0 = _t.perf_counter()
+            while any(not f.done() for f in futs):
+                eng.step()
+            dt = _t.perf_counter() - t0
+            return sum(len(f.result()) for f in futs) / dt
+
+        rep = _measured_reps(one_pass)
         wb = int(sum(x.size * x.dtype.itemsize
                      for x in __import__("jax").tree.leaves(eng.weights)))
         eng.close()
         gc.collect()
         return {"quantize": quantize, "kv_quant": kv_quant,
-                "tokens_per_sec": round(gen / dt, 1), "weight_bytes": wb}
+                "weight_bytes": wb, **rep}
 
     runs = [run(None), run("int8"), run("int8", "int8")]
     return {
         "max_slots": max_slots,
         "runs": runs,
-        "speedup": round(
-            runs[1]["tokens_per_sec"] / runs[0]["tokens_per_sec"], 3
-        ),
-        "speedup_kv": round(
-            runs[2]["tokens_per_sec"] / runs[0]["tokens_per_sec"], 3
-        ),
+        "int8_vs_bf16": _ab_verdict(runs[0], runs[1]),
+        "int8kv_vs_bf16": _ab_verdict(runs[0], runs[2]),
     }
 
 
@@ -314,6 +344,304 @@ def bench_kv_capacity(config: str = "int8+kv+kernel") -> dict:
         )
     return run("int8+kv+kernel", quantize="int8", kv_quant="int8",
                decode_attn_kernel=True)
+
+
+def bench_quality(ckpt: str = "data/ckpt-textlm-1b",
+                  tok_json: str = "data/textlm/tokenizer.json",
+                  heldout: str = "data/textlm/heldout.txt") -> dict:
+    # Relative paths anchor to the REPO, not the caller's cwd (the
+    # subprocess inherits whatever cwd the driver launched from).
+    _here = os.path.dirname(os.path.abspath(__file__))
+    ckpt, tok_json, heldout = (
+        p if os.path.isabs(p) else os.path.join(_here, p)
+        for p in (ckpt, tok_json, heldout)
+    )
+    """Quality-sensitive serving numbers on a TRAINED checkpoint.
+
+    Round-4's honest caveat was that speculative acceptance, int8
+    agreement, and prefix benefit were measured on random weights,
+    where greedy decode is degenerate. This phase replaces those notes:
+    the model is the llama3-1b preset (0.89B params, vocab 32768)
+    trained in-framework (JAXJob, runtime.entry) on the in-image
+    real-text corpus (runtime/textcorpus.py); prompts are HELD-OUT
+    documents (document-level holdout: never literal substrings of the
+    training stream).
+
+    Reported: heldout perplexity + teacher-forced top-1 agreement for
+    bf16 vs int8 weights (packed_forward_logits: the exact serving
+    dequant path), greedy-rollout divergence for int8 and int8+int8-KV,
+    prompt-lookup speculative acceptance + speedup with greedy
+    exactness vs the base engine, and prefix-cache TTFT on a
+    chat-shaped shared-system-prompt workload."""
+    import gc
+    import time as _t
+
+    import numpy as np
+    from tokenizers import Tokenizer
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.llama import PRESETS
+    from kubeflow_tpu.serving.engine import (
+        GenerationEngine,
+        Request,
+        pack_weights,
+        packed_forward_logits,
+        quantize_packed,
+    )
+    from kubeflow_tpu.serving.runtimes.jax_llm_server import (
+        load_params_from_checkpoint,
+    )
+
+    cfg = PRESETS["llama3-1b"]
+    params = load_params_from_checkpoint(ckpt, cfg)
+    tok = Tokenizer.from_file(tok_json)
+    with open(heldout, encoding="utf-8") as f:
+        docs = [d for d in f.read().split("\x00") if len(d) > 4000]
+    rng = np.random.default_rng(5)
+    rng.shuffle(docs)
+
+    n_prompts, plen, gen_len = 16, 256, 128
+    prompts = []
+    for d in docs:
+        ids = tok.encode(d).ids
+        if len(ids) >= plen + gen_len:
+            prompts.append(ids[:plen])
+        if len(prompts) == n_prompts:
+            break
+    assert len(prompts) == n_prompts, f"only {len(prompts)} heldout prompts"
+
+    ekw = dict(max_slots=8, max_seq=2048, decode_block=16)
+
+    def rollout(tag, **kw):
+        eng = GenerationEngine(preset="llama3-1b", params=params, **ekw,
+                               **kw)
+        futs = [eng.submit(Request(prompt=list(p), max_new_tokens=gen_len))
+                for p in prompts[:8]]  # warmup wave (compile)
+        while any(not f.done() for f in futs):
+            eng.step()
+        trajs = []
+        t0 = _t.perf_counter()
+        for wave in (prompts[:8], prompts[8:]):
+            futs = [eng.submit(Request(prompt=list(p),
+                                       max_new_tokens=gen_len))
+                    for p in wave]
+            while any(not f.done() for f in futs):
+                eng.step()
+            trajs.extend(f.result() for f in futs)
+        dt = _t.perf_counter() - t0
+        stats = eng.stats()
+        eng.close()
+        gc.collect()
+        return {"tag": tag, "trajs": trajs,
+                "tokens_per_sec": round(sum(len(t) for t in trajs) / dt, 1),
+                "spec": stats.get("spec")}
+
+    base = rollout("bf16")
+    spec = rollout("bf16+spec4", speculative_k=4)
+    i8 = rollout("int8", quantize="int8")
+    i8kv = rollout("int8+kv", quantize="int8", kv_quant="int8")
+
+    def agreement(a, b):
+        """Mean fraction of the rollout that matches before the first
+        divergence (greedy trajectories are identical after index 0
+        only while every argmax agrees)."""
+        fracs, exact = [], 0
+        for x, y in zip(a, b):
+            n = min(len(x), len(y))
+            i = next((k for k in range(n) if x[k] != y[k]), n)
+            fracs.append(i / n)
+            exact += int(i == n and len(x) == len(y))
+        return {"mean_agreed_prefix": round(float(np.mean(fracs)), 4),
+                "exact_sequences": exact, "n": len(a)}
+
+    spec_exact = sum(x == y for x, y in zip(base["trajs"], spec["trajs"]))
+
+    # Teacher-forced: per-position argmax + NLL through the PACKED
+    # weights (identical dequant to serving).
+    win, nwin, fb = 512, 8, 4
+    stream = []
+    for d in docs[24:]:  # disjoint from the prompt docs
+        stream.extend(tok.encode(d).ids)
+        if len(stream) >= win * nwin + 1:
+            break
+    wins = np.asarray([stream[i * win:(i + 1) * win + 1]
+                       for i in range(nwin)], np.int32)
+    w_bf16 = pack_weights(params, cfg)
+    w_int8 = jax.jit(quantize_packed)(w_bf16)
+
+    def tf_stats(w):
+        fwd = jax.jit(lambda w, t: packed_forward_logits(cfg, w, t))
+        nll, arg = [], []
+        for i in range(0, nwin, fb):
+            t = jnp.asarray(wins[i:i + fb, :-1])
+            tgt = wins[i:i + fb, 1:]
+            lg = np.asarray(fwd(w, t), np.float32)
+            m = lg.max(-1, keepdims=True)
+            lse = m[..., 0] + np.log(np.exp(lg - m).sum(-1))
+            nll.append((lse - np.take_along_axis(
+                lg, tgt[..., None], -1)[..., 0]).mean())
+            arg.append(lg.argmax(-1))
+        return float(np.mean(nll)), np.concatenate(arg)
+
+    nll_bf16, arg_bf16 = tf_stats(w_bf16)
+    nll_int8, arg_int8 = tf_stats(w_int8)
+    del w_bf16, w_int8
+    gc.collect()
+    tf_agree = float((arg_bf16 == arg_int8).mean())
+
+    # Prefix cache on a chat shape: shared REAL system prompt (a held-
+    # out doc's first 1024 tokens), unique real tails.
+    sys_ids = None
+    tails = []
+    for d in docs:  # the system prompt FIRST: a >=1024-token doc
+        ids = tok.encode(d).ids
+        if len(ids) >= 1024:
+            sys_ids = ids[:1024]
+            break
+    assert sys_ids is not None, "no >=1024-token heldout doc"
+    for d in docs:
+        ids = tok.encode(d).ids
+        if ids[:1024] == sys_ids:
+            continue
+        if len(ids) >= 64:
+            tails.append(ids[:64])
+        if len(tails) == 12:
+            break
+
+    def chat_ttft(cache_mb):
+        eng = GenerationEngine(preset="llama3-1b", params=params,
+                               prefix_cache_mb=cache_mb, prefix_block=128,
+                               **ekw)
+        ttfts = []
+        for i, tail in enumerate(tails):
+            req = Request(prompt=list(sys_ids) + list(tail),
+                          max_new_tokens=8)
+            t0 = _t.perf_counter()
+            first = {}
+            req.on_token = lambda tok, d=first: d.setdefault(
+                "t", _t.perf_counter())
+            fut = eng.submit(req)
+            while not fut.done():
+                eng.step()
+            ttfts.append(first["t"] - t0)
+        st = eng.stats()
+        pc = st.get("prefix_cache") or {}
+        eng.close()
+        gc.collect()
+        # First request is always a miss; steady state excludes it.
+        return {"ttft_steady_ms": round(
+                    float(np.mean(ttfts[1:])) * 1e3, 1),
+                "hits": pc.get("hits", 0)}
+
+    pc_off = chat_ttft(0)
+    pc_on = chat_ttft(256)
+
+    sample = tok.decode(base["trajs"][0])
+    return {
+        "model": "llama3-1b trained 6000 steps on in-image real text "
+                 "(see data/textlm/manifest.json); heldout prompts",
+        "heldout_nll": {"bf16": round(nll_bf16, 4),
+                        "int8": round(nll_int8, 4),
+                        "ppl_bf16": round(float(np.exp(nll_bf16)), 2),
+                        "ppl_int8": round(float(np.exp(nll_int8)), 2)},
+        "teacher_forced_top1_agreement_int8": round(tf_agree, 4),
+        "rollout_agreement": {
+            "int8": agreement(base["trajs"], i8["trajs"]),
+            "int8+kv": agreement(base["trajs"], i8kv["trajs"]),
+        },
+        "speculative": {
+            "k": 4,
+            "acceptance": (spec["spec"] or {}).get("acceptance"),
+            "tokens_per_sec_base": base["tokens_per_sec"],
+            "tokens_per_sec_spec": spec["tokens_per_sec"],
+            "greedy_exact_sequences": f"{spec_exact}/{len(prompts)}",
+        },
+        "prefix_cache_chat": {"off": pc_off, "on": pc_on},
+        "tokens_per_sec": {r["tag"]: r["tokens_per_sec"]
+                           for r in (base, spec, i8, i8kv)},
+        "sample_continuation": sample[:300],
+    }
+
+
+def bench_real_8b(max_slots: int = 32, smax: int = 2048,
+                  prompt_len: int = 512, new_tokens: int = 128) -> dict:
+    """The NORTH-STAR model itself: real `llama3-8b` (32 layers, 8.03B
+    params) served on the single 16 GiB chip. Every proxy number in this
+    file keeps 8B's layer geometry at 8/32 depth; this phase drops the
+    proxy. The fit is exactly the round-4 toolchain composed:
+
+    - int8 weights via streaming-quantized init (~8.1 GB resident; the
+      bf16 tree alone is 16 GB and can never touch the chip),
+    - int8 KV cache (134 MB/slot at Smax 2048 vs 268 MB bf16),
+    - the Pallas VMEM-dequant decode kernel (the XLA int8-KV read
+      materializes a bf16 temp and OOMs at these shapes).
+
+    Capacity math at Smax=2048: 15.75 - 8.1 (weights) - ~0.8 (programs,
+    logits [slots, 128256] f32, prefill temps) = ~6.8 GB for KV ->
+    ~48 slots ceiling; the sweep rows probe 8..48. Weights are random
+    (a perf phase: decode cost is weight-value-independent); quality
+    numbers live in the trained-checkpoint phase."""
+    import gc
+    import time as _t
+
+    import numpy as np
+
+    from kubeflow_tpu.serving.engine import GenerationEngine, Request
+
+    try:
+        eng = GenerationEngine(
+            preset="llama3-8b", max_slots=max_slots, max_seq=smax,
+            decode_block=DECODE_BLOCK, quantize="int8", kv_quant="int8",
+            decode_attn_kernel=True, streaming_init=True,
+        )
+    except Exception as e:  # noqa: BLE001 - OOM rows are data
+        gc.collect()
+        return {"max_slots": max_slots, "max_seq": smax,
+                "error": _clean_error(f"{type(e).__name__}: {e}")}
+    rng = np.random.default_rng(0)
+
+    def make(n):
+        return [Request(
+            prompt=rng.integers(1, 100000, prompt_len).tolist(),
+            max_new_tokens=new_tokens,
+        ) for _ in range(n)]
+
+    try:
+        futs = [eng.submit(r) for r in make(max_slots)]  # warmup+compile
+        while any(not f.done() for f in futs):
+            eng.step()
+        n0, s0 = eng.ttft_hist.n, eng.ttft_hist.sum
+
+        def one_pass():
+            futs = [eng.submit(r) for r in make(max_slots)]
+            t0 = _t.perf_counter()
+            while any(not f.done() for f in futs):
+                eng.step()
+            dt = _t.perf_counter() - t0
+            return sum(len(f.result()) for f in futs) / dt
+
+        rep = _measured_reps(one_pass)
+        dn = max(eng.ttft_hist.n - n0, 1)
+        out = {
+            "max_slots": max_slots, "max_seq": smax,
+            "prompt_len": prompt_len, "new_tokens": new_tokens,
+            **rep,
+            "ttft_mean_ms": round(
+                (eng.ttft_hist.sum - s0) / dn * 1e3, 1),
+            "params_b": round(eng.cfg.n_params() / 1e9, 3),
+            "weights_gb_int8": round(eng.cfg.n_params() / 2**30, 2),
+            "kv_gb": round(
+                2 * eng.cfg.n_layers * max_slots * smax
+                * eng.cfg.n_kv_heads * eng.cfg.head_dim / 2**30, 2),
+        }
+    except Exception as e:  # noqa: BLE001
+        out = {"max_slots": max_slots, "max_seq": smax,
+               "error": _clean_error(f"{type(e).__name__}: {e}")}
+    eng.close()
+    gc.collect()
+    return out
 
 
 def bench_prefix_cache() -> dict:
@@ -401,28 +729,33 @@ def bench_speculative() -> dict:
                 for p in prompts[:8]]
         while any(not f.done() for f in warm):
             eng.step()
-        futs = [eng.submit(Request(list(p), max_new_tokens=NEW_TOKENS))
-                for p in prompts]
-        t0 = time.perf_counter()
-        while any(not f.done() for f in futs):
-            eng.step()
-        dt = time.perf_counter() - t0
-        generated = sum(len(f.result()) for f in futs)
+
+        def one_pass():
+            futs = [eng.submit(Request(list(p), max_new_tokens=NEW_TOKENS))
+                    for p in prompts]
+            t0 = time.perf_counter()
+            while any(not f.done() for f in futs):
+                eng.step()
+            dt = time.perf_counter() - t0
+            return sum(len(f.result()) for f in futs) / dt
+
+        rep = _measured_reps(one_pass)
         stats = eng.stats().get("spec")
         eng.close()
         import gc
 
         gc.collect()
-        out = {"speculative_k": spec_k,
-               "tokens_per_sec": round(generated / dt, 1)}
+        out = {"speculative_k": spec_k, **rep}
         if stats:
             out["acceptance"] = stats["acceptance"]
         return out
 
-    return {
-        shape: [run(0, prompts), run(4, prompts)]
-        for shape, prompts in workloads.items()
-    }
+    out = {}
+    for shape, prompts in workloads.items():
+        off, on = run(0, prompts), run(4, prompts)
+        out[shape] = [off, on]
+        out[f"{shape}_verdict"] = _ab_verdict(off, on)
+    return out
 
 
 def bench_latency(prefill_chunk: int,
@@ -551,6 +884,10 @@ def _phase_dispatch(name: str, args: dict):
         return bench_quantized(int(args["max_slots"]))
     if name == "kv_capacity":
         return bench_kv_capacity(args.get("config", "int8+kv+kernel"))
+    if name == "real_8b":
+        return bench_real_8b(**args)
+    if name == "quality":
+        return bench_quality(**args)
     raise SystemExit(f"unknown phase {name!r}")
 
 
@@ -645,6 +982,34 @@ def main() -> int:
             _run_phase("kv_capacity", {"config": "int8+kv+kernel"}),
         ],
     }
+    # THE REAL 8B (round-5 headline): int8 weights + int8 KV + Pallas
+    # kernel serve the actual llama3-8b preset on this one chip. Slot
+    # rows each in their own subprocess (an OOM row must not poison the
+    # next); one long-context capacity row at Smax 8192.
+    real_8b = {
+        "workload": "real llama3-8b, int8 weights (streaming init) + "
+                    "int8 KV + Pallas decode kernel; 512-token prompts, "
+                    "128 new",
+        "rows": [
+            _run_phase("real_8b", {"max_slots": n},
+                       timeout=4200)
+            for n in (8, 16, 32, 48)
+        ],
+        "long_context": _run_phase(
+            "real_8b", {"max_slots": 8, "smax": 8192,
+                        "prompt_len": 4096, "new_tokens": 64},
+            timeout=4200),
+    }
+    # Quality-sensitive numbers on the TRAINED checkpoint (replaces the
+    # r4 random-weight mechanism-proof caveats); skipped gracefully if
+    # the checkpoint was not trained in this image.
+    here0 = os.path.dirname(os.path.abspath(__file__))
+    if os.path.isdir(os.path.join(here0, "data", "ckpt-textlm-1b")):
+        quality = _run_phase("quality", {}, timeout=4200)
+    else:
+        quality = {"skipped": "no trained checkpoint under data/ "
+                              "(run textcorpus prepare + the textlm "
+                              "JAXJob; see data/textlm/manifest.json)"}
     result = {
         "metric": f"{PRESET}_serving_decode_tokens_per_sec_per_chip",
         "value": best["tokens_per_sec"],
@@ -677,6 +1042,8 @@ def main() -> int:
             "speculative": spec,
             "quantized": quant,
             "kv_capacity": kv_cap,
+            "real_8b": real_8b,
+            "quality_trained_checkpoint": quality,
             "device": jax.devices()[0].device_kind,
             "note": "vs_baseline compares the best PRIOR-round artifact "
                     f"({PRIOR_BEST} tok/s/chip, round 3 uniform sweep; "
@@ -691,12 +1058,17 @@ def main() -> int:
                     "A/Bs a repeated-1024-token-system-prompt workload "
                     "(on this dispatch tunnel the ~100-300ms dispatch "
                     "floor caps the win; the compute saving shows fully "
-                    "on direct-attached chips). speculative acceptance "
-                    "is identical across workloads because RANDOM-weight "
-                    "greedy decode collapses into a prompt-independent "
-                    "cycle that prompt-lookup drafts perfectly -- "
-                    "mechanism proof, not a real-checkpoint acceptance "
-                    "estimate. quantized A/Bs bf16 vs weight-only int8 "
+                    "on direct-attached chips). A/B phases repeat each "
+                    "measured pass 3x in-process and report median + "
+                    "spread_pct; deltas inside the joined spread carry "
+                    "verdict=parity. the speculative phase's "
+                    "RANDOM-weight acceptance is a mechanism proof only "
+                    "(greedy decode on random weights collapses into a "
+                    "cycle prompt-lookup drafts perfectly); the REAL "
+                    "acceptance estimate now lives in "
+                    "quality_trained_checkpoint, measured on the "
+                    "trained llama3-1b over held-out text. quantized "
+                    "A/Bs bf16 vs weight-only int8 "
                     "on the uniform sweep at the best slot count (same "
                     "model, coarser weights -- reported separately, not "
                     "as the headline). Identical-code tunnel runs "

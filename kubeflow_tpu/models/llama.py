@@ -77,6 +77,12 @@ class LlamaConfig:
     experts_per_token: int = 2
     capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+    # int8 (AQT-style) training matmuls: dense projections + lm_head run
+    # int8 x int8 -> int32 on the MXU (2x peak on v5e) with dynamic
+    # per-row/col scales and an exact-bf16 straight-through backward.
+    # A/B lever for the training-MFU plateau (ops/int8_matmul.py);
+    # measured in bench.py via BENCH_INT8_MM=1.
+    int8_matmul: bool = False
 
     def __post_init__(self):
         if self.n_experts > 1 and self.experts_per_token > self.n_experts:
@@ -158,6 +164,16 @@ PRESETS: dict[str, LlamaConfig] = {
 from kubeflow_tpu.models.common import dt as _dt  # noqa: E402
 
 
+def _dot_general(cfg: "LlamaConfig"):
+    """None = stock lax.dot_general; int8_matmul swaps in the dynamic-
+    quant int8 MXU path (ops/int8_matmul.py) for every DenseGeneral."""
+    if not cfg.int8_matmul:
+        return None
+    from kubeflow_tpu.ops.int8_matmul import q8_dot_general
+
+    return q8_dot_general
+
+
 class RMSNorm(nn.Module):
     eps: float
     dtype: jnp.dtype
@@ -210,6 +226,7 @@ class Attention(nn.Module):
             use_bias=False,
             dtype=dtype,
             param_dtype=_dt(cfg.param_dtype),
+            dot_general=_dot_general(cfg),
         )
         q = dense(
             features=(cfg.n_heads, cfg.head_dim),
@@ -247,6 +264,7 @@ class Attention(nn.Module):
             use_bias=False,
             dtype=dtype,
             param_dtype=_dt(cfg.param_dtype),
+            dot_general=_dot_general(cfg),
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("heads", "kv", "embed")
             ),
@@ -265,6 +283,7 @@ class MLP(nn.Module):
         dense = partial(
             nn.DenseGeneral, use_bias=False, dtype=dtype,
             param_dtype=_dt(cfg.param_dtype),
+            dot_general=_dot_general(cfg),
         )
         gate = dense(
             features=cfg.intermediate,
@@ -497,6 +516,7 @@ class Llama(nn.Module):
             use_bias=False,
             dtype=_dt(cfg.dtype),
             param_dtype=_dt(cfg.param_dtype),
+            dot_general=_dot_general(cfg),
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("embed", "vocab")
             ),

@@ -1,0 +1,92 @@
+"""int8 (AQT-style) training matmuls for the v5e MXU.
+
+The round-4 profile pinned the training plateau on the matmuls
+themselves (73-77% of device time at ~87% of their own bf16 roofline);
+the one untried lever the trace left open is the MXU's 2x int8
+throughput (394.9 vs 197.4 TOP/s on v5e). This module is that lever:
+a drop-in ``dot_general`` for ``flax.linen.DenseGeneral`` that
+
+- dynamically quantizes both operands symmetric-int8 with per-row /
+  per-column scales over the CONTRACTING dims (AQT's "dynamic
+  quantization" recipe -- no calibration state to carry),
+- runs the dot as int8 x int8 -> int32 (``preferred_element_type``),
+  which XLA lowers onto the int8 MXU path,
+- rescales the int32 accumulator by the outer product of the scales,
+- and backpropagates STRAIGHT-THROUGH: the custom_vjp's backward is the
+  exact bf16 dot_general vjp, so gradients are what the unquantized
+  layer would produce (dgrad/wgrad FLOPs stay bf16 -- this measures the
+  FORWARD int8 win first; quantizing the backward only makes sense if
+  the forward shows one).
+
+Used by ``LlamaConfig(int8_matmul=True)`` -> BENCH_INT8_MM=1 A/B in
+bench.py. Either outcome is recorded: a throughput win at loss parity,
+or a negative result (the dynamic-quant absmax/round elementwise
+traffic eating the MXU gain at these shapes).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _q8(x, contract_dims):
+    """Symmetric int8 with scales over the contracting dims."""
+    a = jnp.abs(x.astype(jnp.float32))
+    amax = jnp.max(a, axis=contract_dims, keepdims=True)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+def _q8_forward(lhs, rhs, dimension_numbers, out_dtype):
+    (lc, rc), (lb, rb) = dimension_numbers
+    if lb or rb:
+        raise NotImplementedError("q8_dot_general: no batch dims "
+                                  "(DenseGeneral never passes any)")
+    lq, ls = _q8(lhs, tuple(lc))
+    rq, rs = _q8(rhs, tuple(rc))
+    y = lax.dot_general(lq, rq, dimension_numbers,
+                        preferred_element_type=jnp.int32)
+    # Output layout = lhs free dims then rhs free dims; the kept-dims
+    # scales squeeze onto exactly those axes.
+    ls_free = jnp.squeeze(ls, axis=tuple(lc))
+    rs_free = jnp.squeeze(rs, axis=tuple(rc))
+    scale = ls_free.reshape(ls_free.shape + (1,) * rs_free.ndim) * rs_free
+    return (y.astype(jnp.float32) * scale).astype(out_dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _q8_dg(lhs, rhs, dimension_numbers, out_dtype):
+    return _q8_forward(lhs, rhs, dimension_numbers, out_dtype)
+
+
+def _q8_dg_fwd(lhs, rhs, dimension_numbers, out_dtype):
+    return _q8_forward(lhs, rhs, dimension_numbers, out_dtype), (lhs, rhs)
+
+
+def _q8_dg_bwd(dimension_numbers, out_dtype, res, g):
+    lhs, rhs = res
+
+    def ref(l, r):
+        return lax.dot_general(l, r, dimension_numbers)
+
+    _, vjp = jax.vjp(ref, lhs, rhs)
+    dl, dr = vjp(g.astype(lhs.dtype))
+    return dl, dr
+
+
+_q8_dg.defvjp(_q8_dg_fwd, _q8_dg_bwd)
+
+
+def q8_dot_general(lhs, rhs, dimension_numbers, precision=None,
+                   preferred_element_type=None):
+    """flax ``DenseGeneral(dot_general=...)``-compatible signature.
+    precision/preferred_element_type from the caller are ignored: the
+    quantized path fixes int32 accumulation and returns the layer's
+    compute dtype (bf16 in training)."""
+    out_dtype = jnp.result_type(lhs, rhs)
+    return _q8_dg(lhs, rhs, dimension_numbers, out_dtype)

@@ -34,6 +34,7 @@ from kubeflow_tpu.controller import GangScheduler, JobController, ProcessLaunche
 from kubeflow_tpu.hpo import HPOController
 from kubeflow_tpu.hpo.obsdb import ObservationDB
 from kubeflow_tpu.hpo.types import Experiment, validate_experiment
+from kubeflow_tpu.server import webapps as _webapps
 from kubeflow_tpu.platform import (
     PlatformValidationError,
     PodDefault,
@@ -185,6 +186,10 @@ class ControlPlane:
                 # Central-dashboard equivalent (P5): one page over /apis/.
                 web.get("/dashboard", self.h_dashboard),
                 web.get("/", self.h_dashboard),
+                # Per-resource CRUD web apps (P6): notebooks /
+                # tensorboards / volumes, one focused app each over the
+                # same /apis routes (server/webapps.py).
+                web.get("/apps/{app}", _webapps.handle_app),
                 # Katib-UI-equivalent experiment drill-down (K8): trial
                 # table + objective plot for one experiment.
                 web.get("/dashboard/isvc/{ns}/{name}",

@@ -1,0 +1,247 @@
+"""Per-resource CRUD web apps (SURVEY.md 3.4 P6).
+
+The reference ships a separate single-purpose web app per workbench
+resource -- jupyter-web-app, tensorboards-web-app, volumes-web-app --
+each a list + create-form + actions UI over that resource's API. The
+central dashboard (P5, server/app.py) aggregates every kind; these
+pages are the P6 equivalents: one focused app per resource at
+``/apps/notebooks``, ``/apps/tensorboards``, ``/apps/volumes``, each
+driving exactly the same ``/apis/<Kind>`` routes the CLI uses (so
+authorization and validation are identical) with resource-specific
+columns and actions:
+
+- notebooks: phase, connect URL, restart count, idle time, stop/start
+  (the culling annotation), delete; create form = name/entrypoint/args.
+- tensorboards: phase, connect URL, job-or-logdir source, delete;
+  create form = name + job | log_dir.
+- volumes: phase, browse link (the traversal-safe volume_viewer),
+  path, delete; create form = name + path.
+
+Server-side shell + small fetch-driven table, same house style and the
+same XSS rule as the dashboard: object names never reach inline JS --
+buttons carry data-* attributes.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+_BASE_CSS = (
+    "body{font-family:monospace;margin:2em;background:#fafafa}"
+    "table{border-collapse:collapse;margin:.6em 0}"
+    "td,th{border:1px solid #ccc;padding:4px 8px;font-size:13px}"
+    "th{background:#eee;text-align:left}"
+    "button{font-family:monospace;font-size:12px;margin-left:4px}"
+    "form.create{margin:.4em 0 1em}"
+    "form.create input{font-family:monospace;font-size:12px;"
+    "margin-right:4px}"
+    "a{color:#06c}"
+)
+
+_SHARED_JS = """
+function esc(s){return String(s).replace(/[&<>"']/g,c=>({"&":"&amp;",
+  "<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));}
+function fail(e){document.getElementById("err").textContent=e;}
+function phaseOf(o){
+  // Same order as the central dashboard's phaseOf -- the two UIs must
+  // never disagree on an object's phase.
+  const act=(o.status&&o.status.conditions||[]).filter(c=>c.status)
+    .map(c=>c.type);
+  for(const t of ["Failed","Succeeded","Suspended","Restarting",
+                  "Running","Ready","Unready","Created"])
+    if(act.includes(t)) return t==="Created"?"Pending":t;
+  return "Pending";
+}
+async function api(path,opts){
+  const r=await fetch(path,opts);
+  if(!r.ok) throw path+": "+await r.text();
+  return r.status===204?null:r.json();
+}
+async function del(kind,ns,name){
+  if(!confirm("delete "+kind+" "+ns+"/"+name+"?")) return;
+  await api("../apis/"+kind+"/"+encodeURIComponent(ns)+"/"
+    +encodeURIComponent(name),{method:"DELETE"});
+  await render();
+}
+document.addEventListener("click",ev=>{
+  const b=ev.target.closest("button[data-act]");
+  if(!b) return;
+  const d=b.dataset;
+  if(d.act==="del") del(d.kind,d.ns,d.name).catch(fail);
+  else if(d.act==="stop") toggleStop(d.ns,d.name).catch(fail);
+});
+"""
+
+_NOTEBOOKS_JS = _SHARED_JS + """
+const STOP="kftpu.io/stopped";
+async function toggleStop(ns,name){
+  const o=await api("../apis/Notebook/"+encodeURIComponent(ns)+"/"
+    +encodeURIComponent(name));
+  o.metadata.annotations=o.metadata.annotations||{};
+  if(STOP in o.metadata.annotations) delete o.metadata.annotations[STOP];
+  else o.metadata.annotations[STOP]="notebooks-app";
+  await api("../apis/Notebook",{method:"POST",
+    headers:{"Content-Type":"application/json"},body:JSON.stringify(o)});
+  await render();
+}
+function idle(o){
+  const t=o.status&&o.status.last_activity;
+  return t?Math.round((Date.now()/1000-t)/60)+"m":"-";
+}
+async function render(){
+  const items=(await api("../apis/Notebook")).items;
+  const rows=items.map(o=>{
+    const m=o.metadata,ph=phaseOf(o),url=o.status&&o.status.url;
+    const stopped=(m.annotations||{})[STOP]!==undefined;
+    return "<tr><td>"+esc(m.namespace)+"</td><td>"+esc(m.name)
+      +"</td><td>"+esc(stopped?"Stopped":ph)+"</td><td>"
+      +(url&&!stopped?'<a href="'+esc(url)+'">connect</a>':"-")
+      +"</td><td>"+(o.status?o.status.restart_count:0)+"</td><td>"
+      +idle(o)+'</td><td><button data-act="stop" data-ns="'
+      +esc(m.namespace)+'" data-name="'+esc(m.name)+'">'
+      +(stopped?"start":"stop")+'</button>'
+      +'<button data-act="del" data-kind="Notebook" data-ns="'
+      +esc(m.namespace)+'" data-name="'+esc(m.name)
+      +'">delete</button></td></tr>';
+  }).join("");
+  document.getElementById("tbl").innerHTML=
+    "<tr><th>namespace</th><th>name</th><th>status</th><th>connect"
+    +"</th><th>restarts</th><th>idle</th><th>actions</th></tr>"+rows;
+}
+async function create(ev){
+  ev.preventDefault();
+  const f=ev.target,args=f.args.value.trim();
+  await api("../apis/Notebook",{method:"POST",
+    headers:{"Content-Type":"application/json"},
+    body:JSON.stringify({kind:"Notebook",
+      metadata:{name:f.name_.value,namespace:f.ns.value||"default"},
+      spec:{template:{entrypoint:f.entry.value,
+        args:args?args.split(/\\s+/):[]}}})});
+  f.reset();
+  await render();
+}
+render().catch(fail);
+"""
+
+_TENSORBOARDS_JS = _SHARED_JS + """
+async function render(){
+  const items=(await api("../apis/Tensorboard")).items;
+  const rows=items.map(o=>{
+    const m=o.metadata,url=o.status&&o.status.url;
+    const src=o.spec.job?("job: "+o.spec.job):("logdir: "
+      +(o.spec.log_dir||""));
+    return "<tr><td>"+esc(m.namespace)+"</td><td>"+esc(m.name)
+      +"</td><td>"+esc(phaseOf(o))+"</td><td>"+esc(src)+"</td><td>"
+      +(url?'<a href="'+esc(url)+'">open</a>':"-")
+      +'</td><td><button data-act="del" data-kind="Tensorboard" '
+      +'data-ns="'+esc(m.namespace)+'" data-name="'+esc(m.name)
+      +'">delete</button></td></tr>';
+  }).join("");
+  document.getElementById("tbl").innerHTML=
+    "<tr><th>namespace</th><th>name</th><th>status</th><th>source"
+    +"</th><th>url</th><th>actions</th></tr>"+rows;
+}
+async function create(ev){
+  ev.preventDefault();
+  const f=ev.target,spec={};
+  if(f.job.value) spec.job=f.job.value;
+  if(f.logdir.value) spec.log_dir=f.logdir.value;
+  await api("../apis/Tensorboard",{method:"POST",
+    headers:{"Content-Type":"application/json"},
+    body:JSON.stringify({kind:"Tensorboard",
+      metadata:{name:f.name_.value,namespace:f.ns.value||"default"},
+      spec:spec})});
+  f.reset();
+  await render();
+}
+render().catch(fail);
+"""
+
+_VOLUMES_JS = _SHARED_JS + """
+async function render(){
+  const items=(await api("../apis/VolumeViewer")).items;
+  const rows=items.map(o=>{
+    const m=o.metadata,url=o.status&&o.status.url;
+    return "<tr><td>"+esc(m.namespace)+"</td><td>"+esc(m.name)
+      +"</td><td>"+esc(phaseOf(o))+"</td><td>"+esc(o.spec.path)
+      +"</td><td>"+(url?'<a href="'+esc(url)+'">browse</a>':"-")
+      +'</td><td><button data-act="del" data-kind="VolumeViewer" '
+      +'data-ns="'+esc(m.namespace)+'" data-name="'+esc(m.name)
+      +'">delete</button></td></tr>';
+  }).join("");
+  document.getElementById("tbl").innerHTML=
+    "<tr><th>namespace</th><th>name</th><th>status</th><th>path"
+    +"</th><th>browse</th><th>actions</th></tr>"+rows;
+}
+async function create(ev){
+  ev.preventDefault();
+  const f=ev.target;
+  await api("../apis/VolumeViewer",{method:"POST",
+    headers:{"Content-Type":"application/json"},
+    body:JSON.stringify({kind:"VolumeViewer",
+      metadata:{name:f.name_.value,namespace:f.ns.value||"default"},
+      spec:{path:f.path.value}})});
+  f.reset();
+  await render();
+}
+render().catch(fail);
+"""
+
+
+def _page(title: str, form_html: str, js: str) -> str:
+    return (
+        "<!doctype html><html><head><title>" + title + "</title>"
+        "<style>" + _BASE_CSS + "</style></head><body>"
+        "<h1>" + title + "</h1><div id='err' style='color:#b00'></div>"
+        + form_html +
+        "<table id='tbl'></table>"
+        "<p><a href='../dashboard'>central dashboard</a></p>"
+        "<script>" + js + "</script></body></html>"
+    )
+
+
+NOTEBOOKS_PAGE = _page(
+    "notebooks",
+    "<form class='create' onsubmit='create(event)'>"
+    "<input name='name_' placeholder='name' required>"
+    "<input name='ns' placeholder='namespace (default)'>"
+    "<input name='entry' placeholder='entrypoint' value='python' required>"
+    "<input name='args' placeholder='args' size='30'>"
+    "<button>create notebook</button></form>",
+    _NOTEBOOKS_JS,
+)
+
+TENSORBOARDS_PAGE = _page(
+    "tensorboards",
+    "<form class='create' onsubmit='create(event)'>"
+    "<input name='name_' placeholder='name' required>"
+    "<input name='ns' placeholder='namespace (default)'>"
+    "<input name='job' placeholder='job name (or logdir below)'>"
+    "<input name='logdir' placeholder='log_dir' size='28'>"
+    "<button>create tensorboard</button></form>",
+    _TENSORBOARDS_JS,
+)
+
+VOLUMES_PAGE = _page(
+    "volumes",
+    "<form class='create' onsubmit='create(event)'>"
+    "<input name='name_' placeholder='name' required>"
+    "<input name='ns' placeholder='namespace (default)'>"
+    "<input name='path' placeholder='/path/to/volume' size='34' required>"
+    "<button>create viewer</button></form>",
+    _VOLUMES_JS,
+)
+
+_PAGES = {
+    "notebooks": NOTEBOOKS_PAGE,
+    "tensorboards": TENSORBOARDS_PAGE,
+    "volumes": VOLUMES_PAGE,
+}
+
+
+async def handle_app(req: web.Request) -> web.Response:
+    page = _PAGES.get(req.match_info["app"])
+    if page is None:
+        return web.Response(status=404, text="unknown app (notebooks, "
+                                             "tensorboards, volumes)")
+    return web.Response(text=page, content_type="text/html")

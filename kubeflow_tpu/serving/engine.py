@@ -283,6 +283,95 @@ def quantize_packed(w: dict) -> dict:
     }
 
 
+def quantized_random_init(cfg: LlamaConfig, seed: int = 0) -> dict:
+    """Random weights built DIRECTLY in the int8 serving representation.
+
+    The real ``llama3-8b`` preset is 16 GB in bf16 -- more than one
+    v5e's 15.75 GB HBM -- so the usual demo path (init bf16, then
+    quantize) can never run on the chip it is meant to fit. This
+    builder materializes each packed leaf already quantized: [L, ...]
+    leaves stream layer-by-layer through a lax.scan (peak extra HBM =
+    ONE layer's f32 temp, ~235 MB at 8B geometry), and the two
+    vocab-sized leaves run first while nothing else is resident. Peak
+    ~int8 total + 2 GB transient; final residency ~8.1 GB for 8B.
+
+    Weight values are lecun-normal like Llama.init, then symmetric
+    per-output-channel int8 exactly like quantize_packed -- the compute
+    path (and therefore a perf measurement) is identical to loading and
+    quantizing a real checkpoint; only the values are random. For real
+    weights at this scale use load_params_from_checkpoint + the one-jit
+    quantize load (its peak is checkpoint-dtype + int8, which fits for
+    a bf16 checkpoint read leaf-by-leaf from host RAM).
+    """
+    if cfg.n_experts > 1:
+        raise ValueError("quantized_random_init supports dense models "
+                         "only (8B is dense; MoE serves via TP)")
+    L, H = cfg.n_layers, cfg.hidden
+    N, D, KV = cfg.n_heads, cfg.head_dim, cfg.n_kv_heads
+    I, V = cfg.intermediate, cfg.vocab_size
+    key = jax.random.PRNGKey(seed)
+    keys = iter(jax.random.split(key, 16))
+
+    def q8_flat(k, shape, axes, fan_in):
+        """One non-stacked leaf (embed / lm_head), quantized in-jit so
+        the f32 temp is program-internal."""
+        def build(kk):
+            w = jax.random.normal(kk, shape, jnp.float32) * (fan_in ** -0.5)
+            amax = jnp.max(jnp.abs(w), axis=axes)
+            sc = jnp.maximum(amax, 1e-8) / 127.0
+            q = jnp.clip(jnp.round(w / jnp.expand_dims(sc, axes)),
+                         -127, 127).astype(jnp.int8)
+            return {"q": q, "s": sc}
+        return jax.jit(build)(k)
+
+    def q8_stacked(k, shape, axes, fan_in):
+        """One [L, *shape] leaf via scan: layer l's f32 temp is freed
+        before layer l+1 materializes."""
+        def body(carry, kk):
+            w = jax.random.normal(kk, shape, jnp.float32) * (fan_in ** -0.5)
+            amax = jnp.max(jnp.abs(w), axis=axes)
+            sc = jnp.maximum(amax, 1e-8) / 127.0
+            q = jnp.clip(jnp.round(w / jnp.expand_dims(sc, axes)),
+                         -127, 127).astype(jnp.int8)
+            return carry, (q, sc)
+
+        def build(kk):
+            _, (qs, ss) = jax.lax.scan(body, 0, jax.random.split(kk, L))
+            return {"q": qs, "s": ss}
+        return jax.jit(build)(k)
+
+    out = {
+        # Vocab-sized leaves first: transient f32 temp (V*H*4 ~ 2 GB at
+        # 8B) overlaps the SMALLEST resident footprint.
+        "embed": q8_flat(next(keys), (V, H), (1,), H),
+        "lm_head": q8_flat(next(keys), (H, V), (0,), H),
+        "final_scale": jnp.ones((H,), jnp.float32),
+        "layers": {
+            "attn": {
+                "q_proj": {"kernel": q8_stacked(
+                    next(keys), (H, N, D), (0,), H)},
+                "k_proj": {"kernel": q8_stacked(
+                    next(keys), (H, KV, D), (0,), H)},
+                "v_proj": {"kernel": q8_stacked(
+                    next(keys), (H, KV, D), (0,), H)},
+                "o_proj": {"kernel": q8_stacked(
+                    next(keys), (N, D, H), (0, 1), N * D)},
+            },
+            "mlp": {
+                "gate_proj": {"kernel": q8_stacked(
+                    next(keys), (H, I), (0,), H)},
+                "up_proj": {"kernel": q8_stacked(
+                    next(keys), (H, I), (0,), H)},
+                "down_proj": {"kernel": q8_stacked(
+                    next(keys), (I, H), (0,), I)},
+            },
+            "attn_norm": {"scale": jnp.ones((L, H), jnp.float32)},
+            "mlp_norm": {"scale": jnp.ones((L, H), jnp.float32)},
+        },
+    }
+    return out
+
+
 def _pj(eqn, x, kern):
     """einsum against a possibly int8-quantized kernel leaf. Quantized
     leaves are ``{"q": int8, "s": f32 per-output-channel}``; the scale's
@@ -398,6 +487,28 @@ def _prefill(cfg: LlamaConfig, w: dict, tokens, lengths):
     last = x[jnp.arange(k_rows), lengths - 1]  # [K, H]
     logits = _lm_logits(last.astype(jnp.float32), w["lm_head"])
     return logits, ks, vs
+
+
+def packed_forward_logits(cfg: LlamaConfig, w: dict, tokens):
+    """Teacher-forced full-sequence logits [B, S, V] (f32) through the
+    PACKED serving weights -- the same _pj projections the decode path
+    uses, so int8-quantized leaves dequantize exactly as they do in
+    serving. Exists for quality measurement (heldout perplexity, per-
+    position top-1 agreement bf16 vs int8) on trained checkpoints;
+    not a serving path."""
+    b, sq = tokens.shape
+    positions = jnp.arange(sq)[None, :]
+    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    x = _embed_rows(w, tokens, jnp.dtype(cfg.dtype))
+    causal = jnp.tril(jnp.ones((sq, sq), bool))[None]
+
+    def body(x, lp):
+        x, _k, _v = _layer_forward(cfg, lp, x, freqs, positions, causal)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, w["layers"])
+    x = _rms(x, w["final_scale"], cfg.norm_eps)
+    return _lm_logits(x.astype(jnp.float32), w["lm_head"])
 
 
 def _insert(cache_k, cache_v, k_seq, v_seq, slots):
@@ -1237,6 +1348,7 @@ class GenerationEngine:
         decode_attn_kernel: bool = False,
         quantize: Optional[str] = None,
         kv_quant: Optional[str] = None,
+        streaming_init: bool = False,
     ) -> None:
         # Max decode steps fused into one device program (power-of-2
         # sub-blocks keep the compile count bounded); 1 = per-token
@@ -1326,10 +1438,19 @@ class GenerationEngine:
                     f"{tuple(mesh.axis_names)}"
                 )
             _validate_tp(cfg, mesh.shape["tensor"])
-        if params is None:
+        self.streaming_init = bool(streaming_init)
+        if params is None and self.streaming_init:
+            if self.quantize != "int8" or mesh is not None:
+                raise ValueError(
+                    "streaming_init requires quantize='int8' and no mesh "
+                    "(its point is fitting a model whose bf16 tree "
+                    "exceeds one chip; TP shards instead)"
+                )
+        if params is None and not self.streaming_init:
             # Demo mode: random init (serving tests; real use loads
             # orbax). With a mesh, init sharded from birth — the full
-            # tree never exists on one device.
+            # tree never exists on one device. (streaming_init skips
+            # this entirely: at 8B the fp32 init tree alone is 32 GB.)
             if mesh is not None:
                 _, msh, init_fn = abstract_param_targets(cfg, mesh)
                 params = jax.jit(init_fn, out_shardings=msh)(
@@ -1343,7 +1464,10 @@ class GenerationEngine:
                     jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32)
                 )
                 params = nn.meta.unbox(raw)
-        if mesh is None:
+        if mesh is None and params is None and self.streaming_init:
+            # Already quantized leaf-by-leaf; nothing else to build.
+            self.weights = quantized_random_init(cfg, seed)
+        elif mesh is None:
             if self.quantize == "int8":
                 # Cast+quantize in ONE jit over the checkpoint-dtype
                 # tree: the bf16 intermediates are program-internal, so

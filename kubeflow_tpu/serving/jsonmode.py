@@ -391,7 +391,7 @@ class JsonTokenMasks:
             self._cache.move_to_end(key)
             return m
         # First-char pre-filter: one clone per DISTINCT first char.
-        ok_first: Dict[str, bool] = {}
+        ok_first: dict[str, bool] = {}
         m = np.zeros(self.vocab_size, bool)
         for tid, s in enumerate(self.strings):
             if s is None:

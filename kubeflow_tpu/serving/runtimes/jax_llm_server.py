@@ -171,7 +171,22 @@ def load_params_from_checkpoint(path: str, cfg, mesh=None) -> dict:
         tree = tree["params"]
     if not (isinstance(tree, dict) and "layers" in tree):
         raise InferenceError(f"checkpoint at {path} has no params", 500)
-    return {"params": tree}
+    return {"params": _unbox(tree)}
+
+
+def _unbox(tree):
+    """Strip flax partitioning metadata the GENERIC orbax restore keeps:
+    nn.with_logical_partitioning boxes every param, and a target-less
+    restore returns each box as a dict like {"value": arr, ...} instead
+    of the bare leaf (the sharded/abstract-target path never sees this
+    -- its targets are unboxed)."""
+    if isinstance(tree, dict):
+        if "value" in tree and not isinstance(tree["value"], dict) and (
+            set(tree) <= {"value", "names", "mesh", "rules", "unbox_fn"}
+        ):
+            return tree["value"]
+        return {k: _unbox(v) for k, v in tree.items()}
+    return tree
 
 
 def _restore_sharded(mgr, step: int, cfg, mesh) -> dict:

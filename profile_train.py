@@ -35,7 +35,8 @@ SEQ = int(os.environ.get("BENCH_SEQ", "1024"))
 TRACE_STEPS = int(os.environ.get("PROFILE_STEPS", "3"))
 
 
-def capture(trace_dir: str, unroll: bool) -> float:
+def capture(trace_dir: str, unroll: bool, batch: int = None,
+            seq: int = None, **task_kwargs) -> float:
     import jax
 
     from kubeflow_tpu.models import get_task
@@ -47,8 +48,10 @@ def capture(trace_dir: str, unroll: bool) -> float:
     # the production program shape for the step-time ground truth.
     task = get_task(
         "llama", preset=os.environ.get("BENCH_PRESET", "llama3-8b-proxy"),
-        batch_size=BATCH, seq_len=SEQ, optimizer="adafactor",
+        batch_size=batch or BATCH, seq_len=seq or SEQ,
+        optimizer="adafactor",
         **({"scan_layers": False} if unroll else {}),
+        **task_kwargs,
     )
     mesh = build_mesh(MeshConfig(data=-1))
     with mesh:
@@ -135,6 +138,26 @@ def main() -> int:
     scan = aggregate(scan_dir)
     unroll_s = capture(unroll_dir, unroll=True)
     unrolled = aggregate(unroll_dir)
+    # Long-sequence profile (round-4 verdict #7): where do the ~12 MFU
+    # points between seq 1024 (66.7%) and seq 8192 (54.8%) go? Same
+    # fit-config bench.py measures at 8192: batch 1, sequence-chunked
+    # CE (loss_chunk=1024), save-nothing remat. Scan program only --
+    # the unrolled variant holds per-layer activations and OOMs at
+    # this length.
+    long_out = None
+    if os.environ.get("PROFILE_LONG", "1") != "0":
+        try:
+            long_dir = os.path.join(TRACE_DIR, "seq8192")
+            long_s = capture(long_dir, unroll=False, batch=1, seq=8192,
+                             loss_chunk=1024, remat_policy="minimal")
+            long_out = {
+                "config": {"batch": 1, "seq": 8192, "loss_chunk": 1024,
+                           "remat_policy": "minimal"},
+                "step_time_ms": round(long_s * 1e3, 1),
+                **aggregate(long_dir),
+            }
+        except Exception as e:  # noqa: BLE001 - keep the 1024 profile
+            long_out = {"error": f"{type(e).__name__}: {e}"[:300]}
     out = {
         "config": {"batch": BATCH, "seq": SEQ, "steps": TRACE_STEPS,
                    "preset": "llama3-8b-proxy", "optimizer": "adafactor"},
@@ -142,6 +165,7 @@ def main() -> int:
         "scan": scan,
         "unrolled_step_time_ms": round(unroll_s * 1e3, 1),
         "unrolled": unrolled,
+        "seq8192": long_out,
         "note": "device-op time over traced steady-state steps; buckets "
                 "by XLA op-name heuristics. The production program scans "
                 "layers (opaque while.N in 'scan'); the 'unrolled' pass "

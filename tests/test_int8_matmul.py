@@ -1,0 +1,89 @@
+"""ops.int8_matmul: the dynamic-quant int8 MXU dot for training
+(AQT-style forward, exact bf16 straight-through backward)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.ops.int8_matmul import q8_dot_general
+
+
+def test_forward_close_to_exact():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 64)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+    dn = (((1,), (0,)), ((), ()))
+    got = np.asarray(q8_dot_general(x, w, dn))
+    want = np.asarray(x @ w)
+    # Symmetric per-row/col int8: relative error ~1/127 per operand.
+    np.testing.assert_allclose(got, want, atol=0.35, rtol=0.05)
+    rel = np.abs(got - want).mean() / np.abs(want).mean()
+    assert rel < 0.02, rel
+
+
+def test_multi_axis_contraction():
+    """DenseGeneral o_proj shape: [B,S,N,D] x [N,D,H] contracting 2 dims."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((2, 4, 3, 8)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((3, 8, 16)), jnp.float32)
+    dn = (((2, 3), (0, 1)), ((), ()))
+    got = np.asarray(q8_dot_general(x, w, dn))
+    want = np.asarray(jnp.einsum("bsnd,ndh->bsh", x, w))
+    rel = np.abs(got - want).mean() / np.abs(want).mean()
+    assert got.shape == want.shape and rel < 0.02
+
+
+def test_backward_is_exact_bf16_vjp():
+    """Straight-through: grads equal the UNQUANTIZED dot's grads."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+    dn = (((1,), (0,)), ((), ()))
+
+    def loss_q(x, w):
+        return jnp.sum(q8_dot_general(x, w, dn) ** 2) / 100
+
+    def loss_ref(x, w):
+        # Same cotangent as the quantized forward produces: feed the
+        # QUANTIZED output into the same reduction so g matches, then
+        # the STE contract is d(loss)/d(inputs) via the EXACT dot.
+        y = jax.lax.stop_gradient(q8_dot_general(x, w, dn))
+        return jnp.sum(y * jax.lax.dot_general(x, w, dn)) * 2 / 100 \
+            - jnp.sum(jax.lax.stop_gradient(y * y)) / 100
+
+    gq = jax.grad(loss_q, argnums=(0, 1))(x, w)
+    gr = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+    for a, b in zip(gq, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_rejects_batch_dims():
+    x = jnp.ones((2, 3, 4))
+    w = jnp.ones((2, 4, 5))
+    dn = (((2,), (1,)), ((0,), (0,)))
+    with pytest.raises(NotImplementedError):
+        q8_dot_general(x, w, dn)
+
+
+def test_train_step_loss_parity():
+    """llama-tiny: 5 int8_matmul steps track bf16 within a few 1e-3."""
+    from kubeflow_tpu.models import get_task
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    losses = {}
+    for flag in (False, True):
+        task = get_task("llama", preset="llama-tiny", batch_size=8,
+                        seq_len=32, optimizer="adafactor",
+                        int8_matmul=flag)
+        mesh = build_mesh(MeshConfig(data=-1))
+        state = task.init_state(jax.random.PRNGKey(0), mesh)
+        step = task.train_step_fn(mesh)
+        it = task.data_iter(1, 0, mesh)
+        out = []
+        for _ in range(5):
+            state, m = step(state, *next(it))
+            out.append(float(m["loss"]))
+        losses[flag] = out
+    np.testing.assert_allclose(losses[True], losses[False], rtol=5e-3)
