@@ -153,46 +153,66 @@ def main() -> int:
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-    if len(sys.argv) > 1 and sys.argv[1] == "--int8-ab":
-        # Child: the int8-matmul config alone, one JSON line.
-        print(json.dumps(run_config(BATCH, SEQ, STEPS, int8_matmul=True)))
+    if len(sys.argv) > 2 and sys.argv[1] == "--ab":
+        # A/B child: one config alone in a fresh process, one JSON line.
+        # Batch 4, not the headline 5: the int8 path's dynamic-quant
+        # temps (int8 operand copies + f32 absmax/rescale) add ~1 GB of
+        # program memory and OOM at batch 5 ("Used 16.74G" measured);
+        # the bf16 side runs the SAME batch so the ratio is clean.
+        kw = {"int8_matmul": True} if sys.argv[2] == "int8" else {}
+        print(json.dumps(run_config(
+            int(os.environ.get("BENCH_AB_BATCH", "4")), SEQ, STEPS, **kw)))
         return 0
 
-    check_flash_kernel()
-
-    head = run_config(BATCH, SEQ, STEPS)
     # int8 (AQT-style) training matmuls A/B (round-4 verdict #4): the
     # one lever the MFU-plateau trace left open -- v5e's MXU doubles
     # int8 throughput and matmuls own ~75% of the step. Same batch/seq,
     # dynamic-quant forward + exact bf16 straight-through backward
-    # (ops/int8_matmul.py). Loss parity is part of the result: the A/B
-    # is only a win if the loss trace holds.
+    # (ops/int8_matmul.py). Loss parity is part of the result.
+    # The child runs FIRST, before this process touches the chip: one
+    # TPU process at a time on this box, and in-process phase ordering
+    # measurably contaminates numbers (bench_serving._run_phase records
+    # an identical A/B collapsing +22% -> +3%). Both sides of the A/B
+    # are therefore process-fresh.
     int8_ab = None
     if os.environ.get("BENCH_INT8_MM", "1") != "0":
-        # In a SUBPROCESS: in-process phase ordering measurably
-        # contaminates this chip's numbers (bench_serving._run_phase
-        # records an identical A/B collapsing +22% -> +3%); the bf16
-        # baseline is the head config, measured first in THIS fresh
-        # process, so both sides run process-fresh.
         import subprocess
 
-        try:
+        def child(tag):
             proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--int8-ab"],
+                [sys.executable, os.path.abspath(__file__), "--ab", tag],
                 capture_output=True, text=True, timeout=1800,
             )
-            q = json.loads(proc.stdout.strip().splitlines()[-1])
+            out_lines = proc.stdout.strip().splitlines()
+            if not out_lines:
+                raise RuntimeError(
+                    f"{tag} child rc={proc.returncode}: "
+                    f"{proc.stderr[-300:]}")
+            return json.loads(out_lines[-1])
+
+        try:
+            b = child("bf16")
+            q = child("int8")
             int8_ab = {
-                "tokens_per_sec_per_chip": q["tokens_per_sec_per_chip"],
+                "batch": b["batch"],
+                "bf16_tokens_per_sec_per_chip":
+                    b["tokens_per_sec_per_chip"],
+                "int8_tokens_per_sec_per_chip":
+                    q["tokens_per_sec_per_chip"],
                 "vs_bf16": round(
                     q["tokens_per_sec_per_chip"]
-                    / head["tokens_per_sec_per_chip"], 3),
-                "final_loss_bf16": head["final_loss"],
+                    / b["tokens_per_sec_per_chip"], 3),
+                "final_loss_bf16": b["final_loss"],
                 "final_loss_int8": q["final_loss"],
-                "step_time_ms": q["step_time_ms"],
+                "step_time_ms_bf16": b["step_time_ms"],
+                "step_time_ms_int8": q["step_time_ms"],
             }
         except Exception as e:  # noqa: BLE001 - record, keep headline
-            int8_ab = {"error": f"{type(e).__name__}: {e}"[:200]}
+            int8_ab = {"error": f"{type(e).__name__}: {e}"[:300]}
+
+    check_flash_kernel()
+
+    head = run_config(BATCH, SEQ, STEPS)
     sweep = []
     for entry in SEQ_SWEEP:
         seq, batch = int(entry[0]), int(entry[1])
