@@ -18,10 +18,24 @@ a drop-in ``dot_general`` for ``flax.linen.DenseGeneral`` that
   FORWARD int8 win first; quantizing the backward only makes sense if
   the forward shows one).
 
-Used by ``LlamaConfig(int8_matmul=True)`` -> BENCH_INT8_MM=1 A/B in
-bench.py. Either outcome is recorded: a throughput win at loss parity,
-or a negative result (the dynamic-quant absmax/round elementwise
-traffic eating the MXU gain at these shapes).
+Used by ``LlamaConfig(int8_matmul=True)`` -> the BENCH_INT8_MM A/B in
+bench.py (fresh-process pair, same batch).
+
+MEASURED (2026-07-31, v5e, 8B-proxy, batch 4 x seq 1024, fresh
+subprocess per side): **negative result -- parity.** 9,167 int8 vs
+9,121 bf16 tokens/s/chip (ratio 1.005, far inside the tunnel's spread)
+at exact loss parity (12.263 both). Why the 2x MXU peak doesn't show:
+(1) the dynamic-quant prologue is pure HBM-bound elementwise work --
+absmax-reduce + round + clip over BOTH operands every matmul, with the
+weights re-quantized every step because they train; (2) the int8
+operand copies + f32 absmax/rescale temps add ~1 GB of program memory
+("Used 16.74G of 15.75G" at the headline batch 5 -- the A/B runs at
+batch 4 for this reason), costing batch headroom; (3) the backward
+stays bf16 by design (STE), capping the theoretical win at the
+forward's ~1/3 share of matmul FLOPs. A real win here needs static
+(calibrated) weight scales carried in the train state so the weight
+quantization leaves the step, plus an int8 backward -- recorded as the
+follow-up, not attempted blind.
 """
 
 from __future__ import annotations
