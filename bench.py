@@ -128,6 +128,29 @@ def run_config(batch: int, seq: int, steps: int, loss_chunk: int = 0,
         dt = (time.perf_counter() - t0) / steps
 
     tokens_per_sec = task.tokens_per_step / dt
+
+    # Tile-padding-aware prediction of the resident train state next to
+    # what the device actually reports (parallel/memory.padded_bytes:
+    # the (8,128)-tile model that catches minor-dim padding blowups at
+    # plan time) -- prediction-vs-allocation drift lands in the row.
+    from kubeflow_tpu.parallel.memory import padded_bytes
+
+    predicted = 0
+    for leaf in jax.tree.leaves(state):
+        if not hasattr(leaf, "dtype") or not hasattr(leaf, "shape"):
+            continue
+        shape = leaf.shape
+        try:
+            shape = leaf.sharding.shard_shape(leaf.shape)
+        except Exception:  # noqa: BLE001 - unsharded/abstract leaves
+            pass
+        predicted += padded_bytes(shape, leaf.dtype)
+    try:
+        mem_stats = jax.devices()[0].memory_stats() or {}
+    except Exception:  # noqa: BLE001 - stats are best-effort
+        mem_stats = {}
+    allocated = mem_stats.get("bytes_in_use")
+
     out = {
         "batch": batch,
         "seq_len": seq,
@@ -142,6 +165,9 @@ def run_config(batch: int, seq: int, steps: int, loss_chunk: int = 0,
         "final_loss": round(final_loss, 3),
         "n_chips": n_chips,
         "params_b": round(task.cfg.n_params() / 1e9, 3),
+        "predicted_hbm_bytes": int(predicted),
+        "allocated_hbm_bytes": (
+            int(allocated) if allocated is not None else None),
     }
     del state, step, batches, task
     gc.collect()

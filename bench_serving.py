@@ -420,13 +420,6 @@ def bench_kv_capacity(config: str = "int8+kv+kernel") -> dict:
 def bench_quality(ckpt: str = "data/ckpt-textlm-1b",
                   tok_json: str = "data/textlm/tokenizer.json",
                   heldout: str = "data/textlm/heldout.txt") -> dict:
-    # Relative paths anchor to the REPO, not the caller's cwd (the
-    # subprocess inherits whatever cwd the driver launched from).
-    _here = os.path.dirname(os.path.abspath(__file__))
-    ckpt, tok_json, heldout = (
-        p if os.path.isabs(p) else os.path.join(_here, p)
-        for p in (ckpt, tok_json, heldout)
-    )
     """Quality-sensitive serving numbers on a TRAINED checkpoint.
 
     Round-4's honest caveat was that speculative acceptance, int8
@@ -444,6 +437,13 @@ def bench_quality(ckpt: str = "data/ckpt-textlm-1b",
     prompt-lookup speculative acceptance + speedup with greedy
     exactness vs the base engine, and prefix-cache TTFT on a
     chat-shaped shared-system-prompt workload."""
+    # Relative paths anchor to the REPO, not the caller's cwd (the
+    # subprocess inherits whatever cwd the driver launched from).
+    _here = os.path.dirname(os.path.abspath(__file__))
+    ckpt, tok_json, heldout = (
+        p if os.path.isabs(p) else os.path.join(_here, p)
+        for p in (ckpt, tok_json, heldout)
+    )
     import gc
     import time as _t
 
@@ -636,6 +636,21 @@ def bench_quality(ckpt: str = "data/ckpt-textlm-1b",
     }
 
 
+def _allocated_hbm_bytes() -> "int | None":
+    """bytes_in_use on device 0, None where the backend doesn't report
+    memory stats -- the measured side of predicted_hbm_bytes."""
+    import jax
+
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 - stats are best-effort
+        return None
+    if not stats:
+        return None
+    val = stats.get("bytes_in_use")
+    return int(val) if val is not None else None
+
+
 def bench_real_8b(max_slots: int = 32, smax: int = 2048,
                   prompt_len: int = 512, new_tokens: int = 128,
                   max_prefill_tokens: int = 8192,
@@ -653,35 +668,50 @@ def bench_real_8b(max_slots: int = 32, smax: int = 2048,
 
     Capacity, MEASURED (r5): the naive math (15.75 - 8.1 weights =
     ~6.8 GB for KV -> ~48 slots) is NOT the binding constraint. The
-    decode-block program OOMs at 32 slots ("Used 20.36G", itemized):
-    XLA double-buffers the scan-carried int8 cache through the while
+    decode-block program OOMed at 32 slots ("Used 20.36G", itemized):
+    XLA double-buffered the scan-carried int8 cache through the while
     loop (2 x 2.00 GB AllocateBuffer temps for k/v at 32 slots -- the
-    donated carry is both written by _kv_set and read by the Pallas
-    custom-call each iteration, so it is not aliased in place), and the
-    [L, B, S, KV] f32 scale tensors pad 16x under the (8,128) tile
-    (KV=8 minor dim: 64 MB of data -> 1.00 GB allocated, x2 for k/v).
-    The recorded fix path: store scales transposed [L, B, KV, Smax]
-    (lane-aligned, kills the 2 GB of padding -- the kernel already
-    consumes this layout); the second half of the fix is MEASURED:
-    decode_block=1 has no scan carry (in-place donation), the 4 GB of
-    temps vanish (20.36 -> 15.80 G at 32 slots) and 30 slots run at
-    173 tok/s -- capacity mode, a tunnel-latency loss here but the
-    right trade on direct-attached chips. With the default block the
-    measured knee is 18 slots at Smax 2048; rows probe both. Weights
-    are random (a perf phase: decode cost is weight-value-independent);
-    quality numbers live in the trained-checkpoint phase."""
+    cache rode the layer scan's xs/ys streams, so each outer step
+    stacked a fresh full-size output cache), and the [L, B, S, KV] f32
+    scale tensors padded 16x under the (8,128) tile (KV=8 minor dim:
+    64 MB of data -> 1.00 GB allocated, x2 for k/v). Both halves of
+    the recorded fix path are NOW IMPLEMENTED in the engine: scales
+    store lane-aligned [L, B, KV, Smax] (kills the ~2 GB of padding;
+    the kernel consumes the storage layout directly, no per-step
+    transpose), and the decode/fused/spec layer loops carry the FULL
+    cache with layer-indexed scatters, so the donated buffers alias in
+    place at ANY decode block (r5's decode_block=1 capacity mode --
+    20.36 -> 15.80 G, 30 slots at 173 tok/s -- measured the same
+    structure by deleting the scan). Rows stamp predicted_hbm_bytes
+    from the tile-padding model (parallel/memory.kv_cache_plan) next
+    to the measured config so prediction-vs-allocation drift is data.
+    Weights are random (a perf phase: decode cost is
+    weight-value-independent); quality numbers live in the
+    trained-checkpoint phase."""
+    import dataclasses
     import gc
     import time as _t
 
     import numpy as np
 
+    from kubeflow_tpu.models.llama import PRESETS
+    from kubeflow_tpu.parallel.memory import kv_cache_plan
     from kubeflow_tpu.serving.engine import GenerationEngine, Request
 
     if decode_block is None:
         decode_block = DECODE_BLOCK
+    # Tile-padding-aware prediction, computable BEFORE any allocation
+    # (so OOM rows carry it too): int8 weights ~1 byte/param + the
+    # padded KV-cache plan.
+    cfg8 = dataclasses.replace(PRESETS["llama3-8b"], max_seq=smax)
+    plan = kv_cache_plan(cfg8, max_slots, kv_quant="int8")
     cfg_keys = {"max_slots": max_slots, "max_seq": smax,
                 "max_prefill_tokens": max_prefill_tokens,
-                "decode_block": decode_block}
+                "decode_block": decode_block,
+                "predicted_hbm_bytes": int(cfg8.n_params()
+                                           + plan["padded_bytes"]),
+                "kv_plan_padded_bytes": plan["padded_bytes"],
+                "kv_plan_pad_ratio": round(plan["pad_ratio"], 3)}
     try:
         eng = GenerationEngine(
             preset="llama3-8b", max_slots=max_slots, max_seq=smax,
@@ -729,6 +759,7 @@ def bench_real_8b(max_slots: int = 32, smax: int = 2048,
             "kv_gb": round(
                 2 * eng.cfg.n_layers * max_slots * smax
                 * eng.cfg.n_kv_heads * eng.cfg.head_dim / 2**30, 2),
+            "allocated_hbm_bytes": _allocated_hbm_bytes(),
         }
     except Exception as e:  # noqa: BLE001
         out = {**cfg_keys,

@@ -1,0 +1,60 @@
+"""Small shims over jax API renames, so one source tree runs on the
+jax the image ships AND on current releases.
+
+Covered here (the Pallas CompilerParams rename is shimmed locally in
+ops/decode_attention.py, same pattern):
+
+- ``jax.shard_map``: top-level promotion of
+  ``jax.experimental.shard_map.shard_map``. The promoted API renamed
+  ``check_rep`` -> ``check_vma`` and replaced ``auto`` (the mesh axes
+  NOT manual in the region) with ``axis_names`` (the axes that ARE).
+  ``shard_map`` below accepts the NEW spelling and translates when only
+  the experimental function exists.
+- ``jax.lax.axis_size``: newer jax exposes the STATIC size of a named
+  mesh axis directly; older jax only has ``jax.core.axis_frame``, which
+  returns that size as a plain int. Both are static (usable in Python
+  control flow / ``range``), unlike ``psum(1, axis)``.
+"""
+
+from typing import Optional
+
+import jax
+
+__all__ = ["axis_size", "shard_map"]
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named axis inside a shard_map/manual region."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.core.axis_frame(axis_name)
+
+
+def shard_map(f, mesh, in_specs, out_specs,
+              axis_names: Optional[frozenset] = None,
+              check_vma: Optional[bool] = None):
+    """``jax.shard_map`` with new-style kwargs on either jax lineage."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kw)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    kw = {}
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    # axis_names (axes that ARE manual) would translate to legacy
+    # ``auto`` = the complement -- but legacy partial-auto lowering
+    # emits a PartitionId instruction the XLA:CPU SPMD partitioner
+    # rejects (observed jaxlib 0.4.x). Running fully manual instead is
+    # value-equivalent for our callers: specs not naming the extra mesh
+    # axes replicate over them either way, at worst re-sharding an
+    # input that partial-auto would have left distributed.
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kw)

@@ -84,6 +84,12 @@ def _batch_heads_default() -> bool:
     return _os.environ.get("KFTPU_DECODE_BATCH_HEADS", "1") != "0"
 
 
+# jax renamed TPUCompilerParams -> CompilerParams across releases;
+# accept either so the kernel imports under both.
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams",
+                           getattr(pltpu, "TPUCompilerParams", None))
+
+
 def _kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref,
             k_vmem, v_vmem, sem_k, sem_v, *, block: int,
             batch_heads: bool):
@@ -156,10 +162,11 @@ def _int8_kernel(pos_ref, q_ref, k_hbm, ks_hbm, v_hbm, vs_hbm, o_ref,
     kv_heads, g, d = q.shape
     scale = 1.0 / (d ** 0.5)
 
-    # Scales arrive [B, KV, Smax] (engine transposes the [B,Smax,KV]
-    # cache layout per layer -- 4 MB, free): Smax as the minor dim
-    # makes the [KV, block] slice lane-aligned; a [block, KV] slice of
-    # the storage layout is not DMA-able (KV=8 < the 128-lane tile).
+    # Scales arrive [B, KV, Smax] -- since the lane-aligned layout
+    # refactor this IS the engine's storage layout (no per-step
+    # transpose): Smax as the minor dim makes the [KV, block] slice
+    # lane-aligned; a [block, KV] slice of the old [B,Smax,KV] layout
+    # is not DMA-able (KV=8 < the 128-lane tile).
     # Double-buffered like _kernel: compute on j%2, stream j+1.
     def _copies(j, slot):
         return (
@@ -332,7 +339,7 @@ def _decode_attention_jit(q, cache_k, cache_v, positions,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
         ),
     )(positions.astype(jnp.int32), q, cache_k, cache_v)
@@ -343,13 +350,24 @@ def decode_attention_int8(q, ck_q, ck_s, cv_q, cv_s, positions,
                           interpret: bool = False,
                           batch_heads: bool | None = None):
     """Bounded-span GQA decode attention over an int8-quantized cache
-    (engine kv_quant="int8": rows int8 [B, Smax, KV, D], scales handed
-    in TRANSPOSED as [B, KV, Smax] for lane-aligned DMA). DMAs int8
-    rows -- half the bf16 kernel's cache traffic -- and dequantizes in
-    VMEM, which is the only way to read a quantized cache without XLA
-    materializing the bf16 copy (see _int8_kernel's docstring for the
-    measured temp blowup). batch_heads resolves from the env OUTSIDE
-    jit, like decode_attention."""
+    (engine kv_quant="int8": rows int8 [B, Smax, KV, D], scales in the
+    engine's lane-aligned STORAGE layout [B, KV, Smax] -- the layout
+    contract is asserted below, since a transposed [B, Smax, KV] scale
+    would silently dequantize garbage). DMAs int8 rows -- half the bf16
+    kernel's cache traffic -- and dequantizes in VMEM, which is the
+    only way to read a quantized cache without XLA materializing the
+    bf16 copy (see _int8_kernel's docstring for the measured temp
+    blowup). batch_heads resolves from the env OUTSIDE jit, like
+    decode_attention."""
+    b, smax, kv_heads, _ = ck_q.shape
+    want = (b, kv_heads, smax)
+    if tuple(ck_s.shape) != want or tuple(cv_s.shape) != want:
+        raise ValueError(
+            "decode_attention_int8: scales must be lane-aligned "
+            f"[B, KV, Smax] = {want}; got k {tuple(ck_s.shape)} / "
+            f"v {tuple(cv_s.shape)}. The engine stores scales in this "
+            "layout (no per-step transpose on the decode path)."
+        )
     if batch_heads is None:
         batch_heads = _batch_heads_default()
     return _decode_attention_int8_jit(q, ck_q, ck_s, cv_q, cv_s,
@@ -397,7 +415,7 @@ def _decode_attention_int8_jit(q, ck_q, ck_s, cv_q, cv_s, positions,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
         ),
     )(positions.astype(jnp.int32), q, ck_q, ck_s, cv_q, cv_s)

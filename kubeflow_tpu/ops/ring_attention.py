@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kubeflow_tpu.compat import axis_size, shard_map
 from kubeflow_tpu.ops.attention import _repeat_kv
 
 _NEG_INF = -1e30  # finite "minus infinity": exp() underflows cleanly
@@ -50,7 +51,7 @@ def ring_attention(
     ``axis_name``. Local blocks are contiguous slices of the global
     sequence in axis order (device r owns positions [r*C, (r+1)*C))."""
 
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     # GQA expansion happens per-block INSIDE the loop: the ppermute carry
     # rotates the narrow [.., Hkv, D] blocks, so the wire/HBM cost keeps
@@ -136,7 +137,7 @@ def ring_attention_sharded(
         batch_axes = DEFAULT_RULES["batch"]
     qspec = P(batch_axes, axis_name, head_axis, None)
     fn = partial(ring_attention, axis_name=axis_name, causal=causal)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh,
         in_specs=(qspec, qspec, qspec),
         out_specs=qspec,

@@ -25,6 +25,8 @@ from functools import partial
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kubeflow_tpu.compat import axis_size, shard_map
+
 
 def _local_attention(q, k, v, causal):
     from kubeflow_tpu.ops.flash_attention import flash_attention
@@ -40,7 +42,7 @@ def ulysses_attention(
     axis_name: str = "sequence",
 ) -> jax.Array:
     """Per-shard body (already inside shard_map over ``axis_name``)."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n == 1:
         return _local_attention(q, k, v, causal)
     # seq-sharded -> head-sharded: split heads, gather sequence.
@@ -108,7 +110,7 @@ def ulysses_attention_sharded(
         v = _repeat_kv(v, n_rep)
     spec = P(batch_axes, axis_name, head_axis, None)
     fn = partial(ulysses_attention, causal=causal, axis_name=axis_name)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

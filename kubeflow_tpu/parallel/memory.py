@@ -14,9 +14,10 @@ operators the same way.
 from __future__ import annotations
 
 import math
-from typing import List
+from typing import Dict, List
 
 import jax
+import numpy as np
 from jax.sharding import NamedSharding
 
 HBM_BYTES = {
@@ -98,3 +99,103 @@ def activation_bytes_estimate(
     workspace = 4 * batch_local * seq_local * width * act_bytes
     logits = batch_local * seq_local * cfg.vocab_size * 4 // vocab_shards
     return resid + workspace + logits
+
+
+# ---------------------------------------------------------------------------
+# HBM tile padding (the 16x-scale-padding failure class, modeled)
+# ---------------------------------------------------------------------------
+
+# TPU HBM arrays tile the two minor dims: 128 lanes on the minor axis
+# and 8 sublanes x the per-32-bit-word packing on the second-minor
+# (f32 -> 8, bf16 -> 16, int8/fp8 -> 32). XLA lays an N-d array out as
+# its COLLAPSED 2-d image -- (prod(majors), minor) -- so only the minor
+# axis pays lane padding and the collapsed majors pay sublane padding.
+# This collapse model reproduces the round-5 device measurements
+# exactly: f32 scales [32, 32, 2048, 8] allocate 1.00 GiB (16x their
+# 64 MB of data: minor 8 -> 128 lanes) while the int8 cache
+# [32, 32, 2048, 8, 128] allocates its plain 2.0 GiB (minor already
+# 128); the lane-aligned [32, 32, 8, 2048] scale layout allocates ~1x.
+TILE_LANES = 128
+TILE_SUBLANES = 8
+
+
+def sublane_tile(dtype) -> int:
+    """Second-minor tile for ``dtype``: 8 sublanes x packing, where
+    packing is how many elements share a 32-bit word (f32 -> 8,
+    bf16 -> 16, int8 -> 32)."""
+    itemsize = np.dtype(dtype).itemsize
+    return TILE_SUBLANES * max(4 // itemsize, 1)
+
+
+def padded_bytes(shape, dtype) -> int:
+    """HBM bytes a ``shape``/``dtype`` array actually allocates under
+    the TPU tile model above. Scalars and size-0 arrays round to one
+    tile's minor row (they are noise at planning scale)."""
+    itemsize = np.dtype(dtype).itemsize
+    shape = tuple(int(d) for d in shape)
+    minor = shape[-1] if shape else 1
+    majors = math.prod(shape[:-1]) if len(shape) > 1 else 1
+    tile = sublane_tile(dtype)
+    pad_minor = -(-max(minor, 1) // TILE_LANES) * TILE_LANES
+    pad_major = -(-max(majors, 1) // tile) * tile
+    return pad_major * pad_minor * itemsize
+
+
+def pad_ratio(shape, dtype) -> float:
+    """padded_bytes / data bytes -- 1.0 means the layout is tile-clean,
+    16.0 is the r5 [.., Smax, KV] f32 scale blowup."""
+    data = max(math.prod(int(d) for d in shape), 1) * np.dtype(dtype).itemsize
+    return padded_bytes(shape, dtype) / data
+
+
+def kv_cache_plan(cfg, max_slots: int, *, kv_quant: str | None = None,
+                  lane_aligned_scales: bool = True,
+                  tensor_parallel: int = 1) -> Dict:
+    """Tile-padding-aware HBM plan for the serving engine's KV cache.
+
+    Predicts the padded allocation of every cache buffer the engine
+    creates for ``cfg`` (n_layers/max_seq/n_kv_heads/head_dim/dtype) at
+    ``max_slots`` slots, per device under ``tensor_parallel`` KV-head
+    sharding -- so the 16x scale-padding failure class shows up in
+    planning instead of as a runtime OOM. ``lane_aligned_scales=False``
+    models the pre-refactor [L, B, Smax, KV] scale layout (what r5
+    measured); the engine stores [L, B, KV, Smax] today.
+
+    Returns {"buffers": [{name, shape, dtype, data_bytes,
+    padded_bytes, pad_ratio}...], "data_bytes", "padded_bytes",
+    "pad_ratio"} -- totals across both k and v caches.
+    """
+    kv_local = cfg.n_kv_heads // tensor_parallel
+    buffers = []
+
+    def add(name, shape, dtype):
+        data = math.prod(shape) * np.dtype(dtype).itemsize
+        buffers.append({
+            "name": name,
+            "shape": tuple(shape),
+            "dtype": np.dtype(dtype).name,
+            "data_bytes": int(data),
+            "padded_bytes": int(padded_bytes(shape, dtype)),
+            "pad_ratio": float(pad_ratio(shape, dtype)),
+        })
+
+    rows = (cfg.n_layers, max_slots, cfg.max_seq, kv_local, cfg.head_dim)
+    for side in ("cache_k", "cache_v"):
+        if kv_quant == "int8":
+            add(f"{side}.q", rows, np.int8)
+            sshape = (
+                (cfg.n_layers, max_slots, kv_local, cfg.max_seq)
+                if lane_aligned_scales
+                else (cfg.n_layers, max_slots, cfg.max_seq, kv_local)
+            )
+            add(f"{side}.s", sshape, np.float32)
+        else:
+            add(side, rows, np.dtype(cfg.dtype))
+    data = sum(b["data_bytes"] for b in buffers)
+    padded = sum(b["padded_bytes"] for b in buffers)
+    return {
+        "buffers": buffers,
+        "data_bytes": int(data),
+        "padded_bytes": int(padded),
+        "pad_ratio": float(padded / max(data, 1)),
+    }

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from kubeflow_tpu.compat import shard_map
+
 
 def gpipe(
     stage_fn: Callable[[Any, jax.Array], tuple[jax.Array, jax.Array]],
@@ -118,7 +120,7 @@ def gpipe(
     # f32 across the shard_map boundary: every collective autodiff inserts
     # for the replicated input / stacked output then rides f32, which
     # XLA-CPU can promote safely; compute inside stays in x.dtype.
-    outputs, aux = jax.shard_map(
+    outputs, aux = shard_map(
         pipelined,
         mesh=mesh,
         in_specs=(P(axis), P()),

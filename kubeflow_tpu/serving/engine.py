@@ -5,8 +5,11 @@ forward). The reference's GPU LLM path is huggingfaceserver+vLLM (SURVEY.md
 3.3 S5); the TPU-native replacement is built around what XLA wants:
 
 - **Static shapes everywhere.** The KV cache is a fixed [L, B, Smax, KV, D]
-  buffer; prompts pad to a small set of prefill buckets, so there are
-  O(#buckets) compiles, not O(#lengths). Decode is one fixed-shape program.
+  buffer (int8 kv_quant adds f32 scales stored LANE-ALIGNED as
+  [L, B, KV, Smax] -- Smax minor, so the TPU (8,128) tile pads ~1x
+  instead of 16x; see _kv_set); prompts pad to a small set of prefill
+  buckets, so there are O(#buckets) compiles, not O(#lengths). Decode is
+  one fixed-shape program.
 - **Slot-based continuous batching.** New requests prefill into a free
   cache slot while other slots keep decoding; one decode step advances all
   active slots (vLLM's iteration-level scheduling, minus paging -- slab
@@ -95,7 +98,11 @@ def _kv_quantize(x):
     live cache every step, so int8 rows halve the second-largest HBM
     stream after the weights (dominant at long contexts). Scales fold
     into the attention SCORES (k) and PROBS (v) -- the cache-side
-    matmul operands stay int8 all the way to the MXU read."""
+    matmul operands stay int8 all the way to the MXU read.
+
+    Scales here come back in the VALUE's own [..., S, KV] order; the
+    cache STORES them lane-aligned, Smax minor ([..., KV, Smax]) -- see
+    _kv_set for why and how the writer re-derives the placement."""
     x32 = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(x32), axis=-1)
     s = jnp.maximum(amax, 1e-8) / 127.0
@@ -103,23 +110,63 @@ def _kv_quantize(x):
     return {"q": q, "s": s}
 
 
+def _scale_index(idx):
+    """Map a q-cache index (leading axes up to and including the Smax
+    selector, which comes LAST) onto the lane-aligned scale cache, whose
+    Smax axis sits after KV: q [..., B, Smax, KV, D] -> s [..., B, KV,
+    Smax]."""
+    return idx[:-1] + (slice(None), idx[-1])
+
+
 def _kv_set(cache, idx, val, mode=None):
     """cache.at[idx].set(val) for a plain bf16 cache or an int8-quantized
-    {"q","s"} cache (same leading index works for both leaves: "s" just
-    lacks the trailing D axis)."""
+    {"q","s"} cache. ``idx`` addresses the q layout's leading axes up to
+    and including Smax (its selector last).
+
+    Scale storage is LANE-ALIGNED: [..., KV, Smax], Smax (a 128
+    multiple) on the minor dim, so the f32 (8,128) HBM tile pads KV
+    against 8 sublanes instead of 16x against 128 lanes (measured r5:
+    64 MB of scales -> 1.00 GB allocated per cache under the old
+    [..., Smax, KV] layout at 32 slots x Smax 2048), and the Pallas
+    decode kernel DMAs scale rows without a per-step transpose. The
+    scale write re-derives its index/value order from idx's Smax
+    selector:
+
+    - a slice (prefill insert / prefix restore): the KV axis slots in
+      before it and the single advanced index (slots) stays in place,
+      so the update window is [..., KV, S] and the fresh [..., S, KV]
+      scales swap their last two axes to match;
+    - an array (per-step decode / chunk scatter): batch and position
+      arrays become SEPARATED advanced indices, which NumPy semantics
+      move to the front -- the update window is [batch..., S, KV],
+      exactly the quantizer's own output order."""
     kw = {"mode": mode} if mode else {}
     if isinstance(cache, dict):
         qs = _kv_quantize(val)
+        s = qs["s"]
+        if isinstance(idx[-1], slice):
+            s = jnp.swapaxes(s, -1, -2)
         return {"q": cache["q"].at[idx].set(qs["q"], **kw),
-                "s": cache["s"].at[idx].set(qs["s"], **kw)}
+                "s": cache["s"].at[_scale_index(idx)].set(s, **kw)}
     return cache.at[idx].set(val, **kw)
 
 
 def _kv_index(cache, idx):
-    """cache[idx] on both representations (leading-axis indexing only)."""
+    """cache[idx] on both representations. idx's Smax selector (last)
+    must be a slice; the returned scale rows keep the lane-aligned
+    [..., KV, S] order -- _gqa_attend's native broadcast layout."""
     if isinstance(cache, dict):
-        return {"q": cache["q"][idx], "s": cache["s"][idx]}
+        return {"q": cache["q"][idx], "s": cache["s"][_scale_index(idx)]}
     return cache[idx]
+
+
+def _kv_layer(cache, li):
+    """Layer ``li``'s slice of a full [L, ...] cache, both
+    representations -- the per-layer read view inside the decode loops,
+    which carry the FULL cache (see _decode) and index it here."""
+    if isinstance(cache, dict):
+        return {"q": cache["q"][li], "s": cache["s"][li]}
+    return cache[li]
 
 
 def _kv_nbytes(cache) -> int:
@@ -138,9 +185,11 @@ def _kv_rows_len(rows) -> int:
 
 def _gqa_attend(q, k, v, mask):
     """q [B,S,N,D] over k/v [B,T,KV,D] -- or int8-quantized {"q","s"}
-    caches, whose scales are folded OUT of the big matmuls: k's scale
-    multiplies the scores, v's scale pre-multiplies the probs, so both
-    cache operands cross HBM as int8. mask [B,S,T] True=visible."""
+    caches with lane-aligned scales [B,KV,T], whose scales are folded
+    OUT of the big matmuls: k's scale multiplies the scores, v's scale
+    pre-multiplies the probs, so both cache operands cross HBM as int8
+    and the [B,KV,T] rows broadcast straight into the [B,KV,G,S,T]
+    scores without a transpose. mask [B,S,T] True=visible."""
     b, s, n, d = q.shape
     kq, ks = (k["q"], k["s"]) if isinstance(k, dict) else (k, None)
     vq, vs = (v["q"], v["s"]) if isinstance(v, dict) else (v, None)
@@ -150,12 +199,12 @@ def _gqa_attend(q, k, v, mask):
         "bskgd,btkd->bkgst", q, kq.astype(q.dtype)
     ).astype(jnp.float32)
     if ks is not None:
-        scores = scores * ks.transpose(0, 2, 1)[:, :, None, None, :]
+        scores = scores * ks[:, :, None, None, :]
     scores = scores / np.sqrt(d)
     scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     if vs is not None:
-        probs = probs * vs.transpose(0, 2, 1)[:, :, None, None, :]
+        probs = probs * vs[:, :, None, None, :]
     out = jnp.einsum(
         "bkgst,btkd->bskgd", probs.astype(q.dtype), vq.astype(q.dtype)
     )
@@ -365,8 +414,12 @@ def quantized_random_init(cfg: LlamaConfig, seed: int = 0) -> dict:
                 "down_proj": {"kernel": q8_stacked(
                     next(keys), (I, H), (0,), I)},
             },
-            "attn_norm": {"scale": jnp.ones((L, H), jnp.float32)},
-            "mlp_norm": {"scale": jnp.ones((L, H), jnp.float32)},
+            # Serving dtype, matching _cast_packed's output for a real
+            # checkpoint (values are ones, so this is bitwise-neutral
+            # through _rms's f32 upcast) -- the trees must be leaf-for-
+            # leaf identical so perf runs compile the same program.
+            "attn_norm": {"scale": jnp.ones((L, H), jnp.dtype(cfg.dtype))},
+            "mlp_norm": {"scale": jnp.ones((L, H), jnp.dtype(cfg.dtype))},
         },
     }
     return out
@@ -564,9 +617,17 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths,
     mask = jnp.arange(smax)[None, None, :] <= positions[:, :, None]  # [B,1,Smax]
     batch_idx = jnp.arange(b)[:, None]
 
-    def body(carry, layer):
-        x = carry
-        lp, ck, cv = layer
+    def body(carry, xs):
+        # The FULL [L, ...] caches ride the CARRY (layer-indexed
+        # scatter/slice) instead of the xs/ys streams: scanned ys would
+        # make XLA stack a fresh full-size output cache per outer decode
+        # step -- the measured r5 2x2.00 GB temps that pushed 32 real-8B
+        # slots to 20.36 G. As a while-loop carry the donated buffers
+        # update in place and the program holds exactly one copy
+        # (regression-guarded by tests/test_serving_engine.py's
+        # compiled-memory check).
+        x, ck, cv = carry
+        lp, li = xs
         # Write current k/v into the cache *then* attend over it.
         h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps)
         q = _pj("bsh,hnd->bsnd", h, lp["attn"]["q_proj"]["kernel"])
@@ -574,8 +635,10 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths,
         v = _pj("bsh,hnd->bsnd", h, lp["attn"]["v_proj"]["kernel"])
         q = _rope(q, freqs, positions)
         k = _rope(k, freqs, positions)
-        ck = _kv_set(ck, (batch_idx, positions), k)
-        cv = _kv_set(cv, (batch_idx, positions), v)
+        ck = _kv_set(ck, (li, batch_idx, positions), k)
+        cv = _kv_set(cv, (li, batch_idx, positions), v)
+        ck_l = _kv_layer(ck, li)
+        cv_l = _kv_layer(cv, li)
         if kernel:
             from kubeflow_tpu.ops.decode_attention import (
                 decode_attention,
@@ -586,29 +649,32 @@ def _decode(cfg: LlamaConfig, w: dict, cache_k, cache_v, tokens, lengths,
             kvh = cfg.n_kv_heads
             qg = q[:, 0].reshape(b, kvh, n // kvh, cfg.head_dim)
             interp = jax.default_backend() != "tpu"
-            if isinstance(ck, dict):
-                # Scales transpose to [B, KV, Smax] for the kernel's
-                # lane-aligned DMA (4 MB per layer -- free next to the
-                # cache reads it unlocks).
+            if isinstance(ck_l, dict):
+                # Scales are STORED [B, KV, Smax] -- the kernel's
+                # lane-aligned DMA layout -- so the rows feed straight
+                # through (the per-step transpose this used to pay is
+                # gone with the storage-layout change).
                 out = decode_attention_int8(
-                    qg, ck["q"], ck["s"].transpose(0, 2, 1),
-                    cv["q"], cv["s"].transpose(0, 2, 1), lengths,
-                    block=kblock, interpret=interp,
+                    qg, ck_l["q"], ck_l["s"], cv_l["q"], cv_l["s"],
+                    lengths, block=kblock, interpret=interp,
                 )
             else:
                 out = decode_attention(
-                    qg, ck, cv, lengths, block=kblock, interpret=interp,
+                    qg, ck_l, cv_l, lengths, block=kblock, interpret=interp,
                 )
             out = out.reshape(b, 1, n, cfg.head_dim)
         else:
-            out = _gqa_attend(q, ck, cv, mask)
+            out = _gqa_attend(q, ck_l, cv_l, mask)
         out = _pj("bsnd,ndh->bsh", out, lp["attn"]["o_proj"]["kernel"])
         x = x + out
         h = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
         x = x + _ffn(cfg, lp, h)
-        return x, (ck, cv)
+        return (x, ck, cv), None
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (w["layers"], cache_k, cache_v))
+    (x, new_k, new_v), _ = jax.lax.scan(
+        body, (x, cache_k, cache_v),
+        (w["layers"], jnp.arange(cfg.n_layers)),
+    )
     x = _rms(x, w["final_scale"], cfg.norm_eps)
     logits = _lm_logits(x[:, 0].astype(jnp.float32), w["lm_head"])
     return logits, new_k, new_v
@@ -792,10 +858,11 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
     batch_idx = jnp.arange(b)[:, None]
     row = chunk_slots[:, None]
 
-    def chunk_layer(x_c, lp, ck, cv, c_pos, c_mask):
-        """Chunk lanes through one layer: write this chunk's K/V into
-        the row's slot, attend over the cache prefix (within-chunk
-        causality rides the position mask)."""
+    def chunk_layer(x_c, lp, li, ck, cv, c_pos, c_mask):
+        """Chunk lanes through one layer ``li`` of the FULL carried
+        caches: write this chunk's K/V into the row's slot, attend over
+        the cache prefix (within-chunk causality rides the position
+        mask)."""
         attn = lp["attn"]
         h = _rms(x_c, lp["attn_norm"]["scale"], cfg.norm_eps)
         q = _pj("bsh,hnd->bsnd", h, attn["q_proj"]["kernel"])
@@ -803,9 +870,9 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
         v = _pj("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
         q = _rope(q, freqs, c_pos)
         k = _rope(k, freqs, c_pos)
-        ck = _kv_set(ck, (row, c_pos), k, mode="drop")
-        cv = _kv_set(cv, (row, c_pos), v, mode="drop")
-        sl = (chunk_slots, slice(None, klen))
+        ck = _kv_set(ck, (li, row, c_pos), k, mode="drop")
+        cv = _kv_set(cv, (li, row, c_pos), v, mode="drop")
+        sl = (li, chunk_slots, slice(None, klen))
         keys = _kv_index(ck, sl)                          # [K,klen,KV,D]
         vals = _kv_index(cv, sl)
         out = _gqa_attend(q, keys, vals, c_mask)
@@ -830,10 +897,12 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
         x_d = _embed_rows(w, toks, jnp.dtype(cfg.dtype))[:, None, :]  # [B,1,H]
         x_c = _embed_rows(w, ctoks, jnp.dtype(cfg.dtype))             # [K,C,H]
 
-        def layer_body(carry2, layer):
-            x_d, x_c = carry2
-            lp, ck, cv = layer
-            x_c, ck, cv = chunk_layer(x_c, lp, ck, cv, c_pos, c_mask)
+        def layer_body(carry2, xs):
+            # Full caches in the carry, not the xs/ys streams -- same
+            # single-buffer rationale as _decode's body.
+            x_d, x_c, ck, cv = carry2
+            lp, li = xs
+            x_c, ck, cv = chunk_layer(x_c, lp, li, ck, cv, c_pos, c_mask)
             # Decode lanes (same math as _decode's body).
             attn = lp["attn"]
             h = _rms(x_d, lp["attn_norm"]["scale"], cfg.norm_eps)
@@ -842,17 +911,19 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
             v = _pj("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
             q = _rope(q, freqs, dec_pos)
             k = _rope(k, freqs, dec_pos)
-            ck = _kv_set(ck, (batch_idx, dec_pos), k)
-            cv = _kv_set(cv, (batch_idx, dec_pos), v)
-            out = _gqa_attend(q, ck, cv, dec_mask)
+            ck = _kv_set(ck, (li, batch_idx, dec_pos), k)
+            cv = _kv_set(cv, (li, batch_idx, dec_pos), v)
+            out = _gqa_attend(q, _kv_layer(ck, li), _kv_layer(cv, li),
+                              dec_mask)
             out = _pj("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
             x_d = x_d + out
             h = _rms(x_d, lp["mlp_norm"]["scale"], cfg.norm_eps)
             x_d = x_d + _ffn(cfg, lp, h)
-            return (x_d, x_c), (ck, cv)
+            return (x_d, x_c, ck, cv), None
 
-        (x_d, x_c), (ck1, cv1) = jax.lax.scan(
-            layer_body, (x_d, x_c), (w["layers"], ck0, cv0)
+        (x_d, x_c, ck1, cv1), _ = jax.lax.scan(
+            layer_body, (x_d, x_c, ck0, cv0),
+            (w["layers"], jnp.arange(cfg.n_layers)),
         )
         x_d = _rms(x_d, w["final_scale"], cfg.norm_eps)
         d_logits = _lm_logits(x_d[:, 0].astype(jnp.float32), w["lm_head"])
@@ -872,13 +943,15 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
         c_mask = jnp.arange(klen)[None, None, :] <= c_pos[:, :, None]
         x_c = _embed_rows(w, ctoks, jnp.dtype(cfg.dtype))
 
-        def layer_body(x_c, layer):
-            lp, ck, cv = layer
-            x_c, ck, cv = chunk_layer(x_c, lp, ck, cv, c_pos, c_mask)
-            return x_c, (ck, cv)
+        def layer_body(carry2, xs):
+            x_c, ck, cv = carry2
+            lp, li = xs
+            x_c, ck, cv = chunk_layer(x_c, lp, li, ck, cv, c_pos, c_mask)
+            return (x_c, ck, cv), None
 
-        x_c, (ck1, cv1) = jax.lax.scan(
-            layer_body, x_c, (w["layers"], ck0, cv0)
+        (x_c, ck1, cv1), _ = jax.lax.scan(
+            layer_body, (x_c, ck0, cv0),
+            (w["layers"], jnp.arange(cfg.n_layers)),
         )
         fin_logits = chunk_logits_latch(x_c, cclens, fin_logits)
         return (ck1, cv1, offs + cclens, fin_logits), None
@@ -1000,10 +1073,11 @@ def tp_cache_sharding(mesh):
 
 
 def tp_kv_scale_sharding(mesh):
-    """int8 KV-cache scale [L, B, Smax, KV]: same head split as the
-    cache it scales, so the scores/probs multiplies stay shard-local."""
+    """int8 KV-cache scale, lane-aligned storage [L, B, KV, Smax]: same
+    head split as the cache it scales, so the scores/probs multiplies
+    stay shard-local."""
     return jax.sharding.NamedSharding(
-        mesh, jax.sharding.PartitionSpec(None, None, None, "tensor")
+        mesh, jax.sharding.PartitionSpec(None, None, "tensor", None)
     )
 
 
@@ -1077,8 +1151,11 @@ def _spec_block(cfg: LlamaConfig, m_steps: int, k_draft: int, w: dict,
         mask = jnp.arange(smax)[None, None, :] <= positions[:, :, None]
         x = _embed_rows(w, tokens_in, jnp.dtype(cfg.dtype))  # [B,S,H]
 
-        def layer_body(x, layer):
-            lp, ck, cv = layer
+        def layer_body(carry2, xs):
+            # Full caches in the carry -- same single-buffer rationale
+            # as _decode's body.
+            x, ck, cv = carry2
+            lp, li = xs
             attn = lp["attn"]
             h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps)
             q = _pj("bsh,hnd->bsnd", h, attn["q_proj"]["kernel"])
@@ -1086,16 +1163,19 @@ def _spec_block(cfg: LlamaConfig, m_steps: int, k_draft: int, w: dict,
             v = _pj("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
             q = _rope(q, freqs, positions)
             k = _rope(k, freqs, positions)
-            ck = _kv_set(ck, (batch_idx, positions), k)
-            cv = _kv_set(cv, (batch_idx, positions), v)
-            out = _gqa_attend(q, ck, cv, mask)
+            ck = _kv_set(ck, (li, batch_idx, positions), k)
+            cv = _kv_set(cv, (li, batch_idx, positions), v)
+            out = _gqa_attend(q, _kv_layer(ck, li), _kv_layer(cv, li),
+                              mask)
             out = _pj("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
             x = x + out
             h = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
-            return x + _ffn(cfg, lp, h), (ck, cv)
+            return (x + _ffn(cfg, lp, h), ck, cv), None
 
-        x, (ck1, cv1) = jax.lax.scan(layer_body, x,
-                                     (w["layers"], ck0, cv0))
+        (x, ck1, cv1), _ = jax.lax.scan(
+            layer_body, (x, ck0, cv0),
+            (w["layers"], jnp.arange(cfg.n_layers)),
+        )
         x = _rms(x, w["final_scale"], cfg.norm_eps)
         g = jnp.argmax(
             _lm_logits(x.astype(jnp.float32), w["lm_head"]), axis=-1
@@ -1528,10 +1608,17 @@ class GenerationEngine:
         qsh = tp_cache_sharding(mesh) if mesh is not None else None
         if self.kv_quant == "int8":
             ssh = tp_kv_scale_sharding(mesh) if mesh is not None else None
+            # Scales store LANE-ALIGNED [L, B, KV, Smax]: Smax (a 128
+            # multiple) on the lanes, KV against the 8-sublane tile, so
+            # the f32 slab allocates ~its data bytes instead of the 16x
+            # (8,128)-tile blowup of [L, B, Smax, KV] (measured r5:
+            # 64 MB -> 1.00 GB per cache at 32 slots x Smax 2048).
+            sshape = (cfg.n_layers, max_slots, cfg.n_kv_heads,
+                      cfg.max_seq)
             self.cache_k = {"q": _zeros(kvshape, jnp.int8, qsh),
-                            "s": _zeros(kvshape[:-1], jnp.float32, ssh)}
+                            "s": _zeros(sshape, jnp.float32, ssh)}
             self.cache_v = {"q": _zeros(kvshape, jnp.int8, qsh),
-                            "s": _zeros(kvshape[:-1], jnp.float32, ssh)}
+                            "s": _zeros(sshape, jnp.float32, ssh)}
         else:
             self.cache_k = _zeros(kvshape, dt, qsh)
             self.cache_v = _zeros(kvshape, dt, qsh)
@@ -1687,10 +1774,14 @@ class GenerationEngine:
                     if isinstance(ck, dict):
                         # Stored rows are already quantized (extracted
                         # from a quantized cache): raw copy, no requant.
+                        # Scale rows live lane-aligned [L, KV, plen'].
+                        sidx = _scale_index(idx)
                         ck = {"q": ck["q"].at[idx].set(pk["q"][:, :plen]),
-                              "s": ck["s"].at[idx].set(pk["s"][:, :plen])}
+                              "s": ck["s"].at[sidx].set(
+                                  pk["s"][:, :, :plen])}
                         cv = {"q": cv["q"].at[idx].set(pv["q"][:, :plen]),
-                              "s": cv["s"].at[idx].set(pv["s"][:, :plen])}
+                              "s": cv["s"].at[sidx].set(
+                                  pv["s"][:, :, :plen])}
                     else:
                         ck = ck.at[idx].set(pk[:, :plen])
                         cv = cv.at[idx].set(pv[:, :plen])

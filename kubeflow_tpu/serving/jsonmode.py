@@ -30,6 +30,13 @@ Design notes (TPU-first reasoning):
 - When the automaton reaches the complete state the engine finishes
   the request (like a stop match): the result text parses as exactly
   one JSON object, with no trailing garbage to trim.
+
+Known limitation: token-string simulation decodes each id standalone,
+so byte-level BPE tokens carrying a fragment of a multi-byte UTF-8
+codepoint surface as U+FFFD and are masked out inside strings -- JSON
+mode effectively constrains string content to whole-codepoint tokens
+(ASCII is always safe; use ``\\uXXXX`` escapes for the rest). See
+tokenizer_vocab_strings for details.
 """
 
 from __future__ import annotations
@@ -462,7 +469,22 @@ def byte_vocab(vocab_size: int) -> List[Optional[str]]:
 def tokenizer_vocab_strings(tok, vocab_size: int) -> List[Optional[str]]:
     """Per-token strings from a `tokenizers`/HF-style tokenizer via
     single-id decode (byte-level BPE decodes any id standalone).
-    Special tokens decode to ""/markers that the FSM then rejects."""
+    Special tokens decode to ""/markers that the FSM then rejects.
+
+    LIMITATION (multi-byte UTF-8): a byte-level BPE token holding a
+    FRAGMENT of a multi-byte codepoint does not decode standalone --
+    ``tok.decode([i])`` yields U+FFFD for it, so the simulated string
+    diverges from what the token actually contributes mid-sequence.
+    Consequences: (a) such tokens are masked out inside JSON strings
+    even where the real bytes would be legal, so constrained output is
+    restricted to codepoints the vocabulary covers with whole-codepoint
+    tokens (ASCII always works; ``\\uXXXX`` escapes remain available
+    for the rest); (b) the min_close_chars token budget counts the
+    replacement char, not the fragment's true length, so the
+    force-close bound is computed against the simulated -- not actual
+    -- text. Fixing this needs byte-level vocab extraction (e.g.
+    ByteLevel alphabet inversion), deferred until a real tokenizer
+    rides this path in CI."""
     out: List[Optional[str]] = []
     for i in range(vocab_size):
         try:

@@ -1,0 +1,225 @@
+"""Parity locks between redundant implementations (CPU, tiny preset).
+
+1. quantized_random_init (the builder that materializes weights already
+   int8 so 8B fits a single v5e) vs quantize_packed(pack_weights(...))
+   (the real-checkpoint path): same tree, same leaf shapes/dtypes, and
+   bitwise the same quantization scheme for a fixed RNG stream -- a perf
+   number measured on random weights is only transferable if both paths
+   compile the identical program.
+2. _host_first_token (host-side first token of a constrained request)
+   vs _sample (the device sampler): same semantics on identical logit
+   rows for every deterministic mode, and agreement on the candidate
+   set for the sampled modes.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.llama import PRESETS, Llama
+from kubeflow_tpu.serving.engine import (
+    GenerationEngine,
+    Request,
+    _sample,
+    pack_weights,
+    quantize_packed,
+    quantized_random_init,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from flax import linen as nn
+
+    cfg = dataclasses.replace(PRESETS["llama-tiny"], remat=False)
+    model = Llama(cfg)
+    raw = jax.jit(model.init)(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    return cfg, nn.meta.unbox(raw)
+
+
+# --------------------------------------------------------------------------
+# quantized_random_init vs quantize_packed(pack_weights(...))
+# --------------------------------------------------------------------------
+
+
+class TestQuantizedRandomInitParity:
+    def test_tree_and_leaf_parity(self, tiny):
+        cfg, params = tiny
+        real = quantize_packed(pack_weights(params, cfg))
+        rand = quantized_random_init(cfg, seed=0)
+        assert (jax.tree_util.tree_structure(real)
+                == jax.tree_util.tree_structure(rand))
+        for (ka, va), (kb, vb) in zip(
+            jax.tree_util.tree_leaves_with_path(real),
+            jax.tree_util.tree_leaves_with_path(rand),
+        ):
+            path = jax.tree_util.keystr(ka)
+            assert path == jax.tree_util.keystr(kb)
+            assert va.shape == vb.shape, path
+            assert va.dtype == vb.dtype, path
+
+    def test_scheme_matches_quantize_packed_bitwise(self, tiny):
+        """Rebuild the builder's float weights from its documented RNG
+        stream, push them through quantize_packed's scheme, and demand
+        bitwise-identical q/s leaves: the builder must not drift into a
+        subtly different quantization than the checkpoint path."""
+        cfg, _ = tiny
+        L, H = cfg.n_layers, cfg.hidden
+        N, D, KV = cfg.n_heads, cfg.head_dim, cfg.n_kv_heads
+        V = cfg.vocab_size
+        keys = list(jax.random.split(jax.random.PRNGKey(0), 16))
+        rand = quantized_random_init(cfg, seed=0)
+
+        def q8(arr, axes):
+            a = arr.astype(jnp.float32)
+            amax = jnp.max(jnp.abs(a), axis=axes)
+            s = jnp.maximum(amax, 1e-8) / 127.0
+            q = jnp.clip(
+                jnp.round(a / jnp.expand_dims(s, axes)), -127, 127
+            ).astype(jnp.int8)
+            return {"q": q, "s": s}
+
+        # Leaf 0: embed [V, H], fan-in H, per-row scales.
+        w = jax.random.normal(keys[0], (V, H), jnp.float32) * (H ** -0.5)
+        want = jax.jit(lambda a: q8(a, (1,)))(w)
+        np.testing.assert_array_equal(np.asarray(want["q"]),
+                                      np.asarray(rand["embed"]["q"]))
+        np.testing.assert_array_equal(np.asarray(want["s"]),
+                                      np.asarray(rand["embed"]["s"]))
+
+        # Leaf 2: q_proj stacked [L, H, N, D] -- the builder's per-layer
+        # scan with axes (0,) must equal quantize_packed's axes (1,)
+        # over the stacked leaf.
+        per_layer = [
+            jax.random.normal(kk, (H, N, D), jnp.float32) * (H ** -0.5)
+            for kk in jax.random.split(keys[2], L)
+        ]
+        want = jax.jit(lambda a: q8(a, (1,)))(jnp.stack(per_layer))
+        got = rand["layers"]["attn"]["q_proj"]["kernel"]
+        np.testing.assert_array_equal(np.asarray(want["q"]),
+                                      np.asarray(got["q"]))
+        np.testing.assert_array_equal(np.asarray(want["s"]),
+                                      np.asarray(got["s"]))
+
+
+# --------------------------------------------------------------------------
+# _host_first_token vs _sample
+# --------------------------------------------------------------------------
+
+
+class _AllowAll:
+    def __init__(self, size):
+        self.size = size
+
+    def mask(self, n):
+        return np.ones(self.size, bool)
+
+
+class _AllowOnly:
+    def __init__(self, size, banned):
+        self.size = size
+        self.banned = banned
+
+    def mask(self, n):
+        m = np.ones(self.size, bool)
+        m[self.banned] = False
+        return m
+
+
+class _EngineStub:
+    """Just enough of GenerationEngine for the bound method."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.tokens_generated = 0
+
+    _host_first_token = GenerationEngine._host_first_token
+
+
+class TestHostSamplerParity:
+    V = 64
+
+    @pytest.fixture()
+    def stub(self, tiny):
+        return _EngineStub(tiny[0])
+
+    def _row(self, seed=0):
+        return np.random.default_rng(seed).normal(size=self.V).astype(
+            np.float32
+        )
+
+    def _device(self, row, temp, top_k=0, top_p=1.0, mask=None):
+        kw = {}
+        if top_k or top_p < 1.0:
+            kw = {"top_ks": jnp.asarray([top_k], jnp.int32),
+                  "top_ps": jnp.asarray([top_p], jnp.float32)}
+        if mask is not None:
+            kw["mask"] = jnp.asarray(mask[None])
+        out = _sample(jnp.asarray(row[None]), jax.random.PRNGKey(7),
+                      jnp.asarray([temp], jnp.float32), **kw)
+        return int(out[0])
+
+    def _host(self, stub, row, temp, top_k=0, top_p=1.0,
+              constraint=None):
+        req = Request([1, 2, 3], max_new_tokens=8, temperature=temp,
+                      top_k=top_k, top_p=top_p,
+                      constraint=constraint or _AllowAll(self.V))
+        req.slot = 0
+        return stub._host_first_token(row, req)
+
+    def test_greedy_matches(self, stub):
+        row = self._row()
+        assert self._host(stub, row, 0.0) == self._device(row, 0.0)
+        assert self._host(stub, row, 0.0) == int(row.argmax())
+
+    def test_greedy_respects_constraint_mask(self, stub):
+        row = self._row(1)
+        banned = [int(row.argmax())]
+        c = _AllowOnly(self.V, banned)
+        got = self._host(stub, row, 0.0, constraint=c)
+        assert got == self._device(row, 0.0, mask=c.mask(8))
+        assert got != banned[0]
+
+    def test_top_k_1_is_argmax_in_both(self, stub):
+        row = self._row(2)
+        assert (self._host(stub, row, 0.8, top_k=1)
+                == self._device(row, 0.8, top_k=1)
+                == int(row.argmax()))
+
+    def test_tiny_top_p_is_argmax_in_both(self, stub):
+        # top_p ~ 0 keeps only the head of the nucleus in both
+        # implementations (both explicitly keep the top candidate).
+        row = self._row(3)
+        assert (self._host(stub, row, 0.8, top_p=1e-6)
+                == self._device(row, 0.8, top_p=1e-6)
+                == int(row.argmax()))
+
+    def test_top_k_truncation_agrees_on_candidate_set(self, stub):
+        row = self._row(4)
+        top3 = set(np.argsort(-row)[:3].tolist())
+        for seed in range(4):
+            stub.tokens_generated = seed  # vary the host RNG stream
+            assert self._host(stub, row, 1.0, top_k=3) in top3
+        assert self._device(row, 1.0, top_k=3) in top3
+
+    def test_top_p_truncation_agrees_on_candidate_set(self, stub):
+        # Peaked row: nucleus at p=0.5 is a small, known set.
+        row = np.full(self.V, -10.0, np.float32)
+        row[5], row[9], row[11] = 4.0, 3.9, 3.8
+        z = row / 1.0
+        p = np.exp(z - z.max())
+        p /= p.sum()
+        order = np.argsort(-z)
+        keep = (np.cumsum(p[order]) - p[order]) < 0.5
+        nucleus = set(order[keep].tolist())
+        assert nucleus <= {5, 9, 11}
+        for seed in range(4):
+            stub.tokens_generated = seed
+            assert self._host(stub, row, 1.0, top_p=0.5) in nucleus
+        assert self._device(row, 1.0, top_p=0.5) in nucleus

@@ -1,0 +1,98 @@
+"""Tier-1 lock on the tile-padding HBM model (parallel/memory.py).
+
+The expected constants are DEVICE MEASUREMENTS from the round-5 real-8B
+capacity run (bench_serving.bench_real_8b): at 32 slots x Smax 2048 x
+KV 8 the old [L, B, Smax, KV] f32 scale layout allocated 1.00 GiB for
+64 MB of data (16x (8,128)-tile padding, x2 for k/v), while the int8
+cache rows allocated exactly their 2.0 GiB of data. The lane-aligned
+[L, B, KV, Smax] layout the engine stores today must plan at <= 1.1x
+data bytes. If this test fails, the planner's collapse-tile model has
+drifted from what the hardware was measured to do.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.parallel.memory import (
+    kv_cache_plan,
+    pad_ratio,
+    padded_bytes,
+    sublane_tile,
+)
+
+
+class TestPaddedBytes:
+    def test_r5_old_scale_layout_pads_16x(self):
+        # f32 [32, 32, 2048, 8]: KV=8 on the 128-lane minor dim.
+        shape = (32, 32, 2048, 8)
+        assert padded_bytes(shape, np.float32) == 1 * 2**30
+        assert pad_ratio(shape, np.float32) == 16.0
+
+    def test_lane_aligned_scale_layout_is_tile_clean(self):
+        # f32 [32, 32, 8, 2048]: Smax (a 128 multiple) minor, KV against
+        # the 8-sublane tile via the collapsed majors.
+        shape = (32, 32, 8, 2048)
+        assert padded_bytes(shape, np.float32) == 64 * 2**20
+        assert pad_ratio(shape, np.float32) == 1.0
+
+    def test_int8_cache_rows_allocate_data_bytes(self):
+        # int8 [32, 32, 2048, 8, 128]: D=128 minor, collapsed majors
+        # divisible by the (32,128) int8 tile -- measured exactly 2 GiB.
+        shape = (32, 32, 2048, 8, 128)
+        assert padded_bytes(shape, np.int8) == 2 * 2**30
+        assert pad_ratio(shape, np.int8) == 1.0
+
+    def test_sublane_tile_by_dtype(self):
+        assert sublane_tile(np.float32) == 8
+        assert sublane_tile("bfloat16") == 16
+        assert sublane_tile(np.int8) == 32
+
+    def test_minor_lane_padding(self):
+        assert padded_bytes((8, 1), np.float32) == 8 * 128 * 4
+
+    def test_collapsed_major_sublane_padding(self):
+        assert padded_bytes((3, 128), "bfloat16") == 16 * 128 * 2
+
+
+class TestKVCachePlan:
+    @pytest.fixture(scope="class")
+    def cfg8(self):
+        from kubeflow_tpu.models.llama import PRESETS
+
+        return dataclasses.replace(PRESETS["llama3-8b"], max_seq=2048)
+
+    def test_new_layout_scales_within_1p1x_of_data(self, cfg8):
+        plan = kv_cache_plan(cfg8, 32, kv_quant="int8")
+        scales = [b for b in plan["buffers"] if b["name"].endswith(".s")]
+        assert len(scales) == 2
+        for b in scales:
+            assert b["data_bytes"] == 64 * 2**20
+            assert b["pad_ratio"] <= 1.1
+        assert plan["pad_ratio"] <= 1.1
+
+    def test_old_layout_reproduces_r5_16x_blowup(self, cfg8):
+        plan = kv_cache_plan(cfg8, 32, kv_quant="int8",
+                             lane_aligned_scales=False)
+        scales = [b for b in plan["buffers"] if b["name"].endswith(".s")]
+        for b in scales:
+            assert b["data_bytes"] == 64 * 2**20
+            assert b["padded_bytes"] == 1 * 2**30
+            assert b["pad_ratio"] == 16.0
+        # The two scale buffers alone account for ~1.9 GB of pure
+        # padding -- the capacity the refactor reclaimed.
+        reclaimed = plan["padded_bytes"] - kv_cache_plan(
+            cfg8, 32, kv_quant="int8")["padded_bytes"]
+        assert reclaimed == 2 * (2**30 - 64 * 2**20)
+
+    def test_bf16_plan_tile_clean(self, cfg8):
+        plan = kv_cache_plan(cfg8, 32)
+        assert len(plan["buffers"]) == 2
+        assert plan["pad_ratio"] == 1.0
+        assert plan["padded_bytes"] == 2 * 32 * 32 * 2048 * 8 * 128 * 2
+
+    def test_tensor_parallel_divides_kv_heads(self, cfg8):
+        p1 = kv_cache_plan(cfg8, 32, kv_quant="int8")
+        p4 = kv_cache_plan(cfg8, 32, kv_quant="int8", tensor_parallel=4)
+        assert p4["data_bytes"] * 4 == p1["data_bytes"]

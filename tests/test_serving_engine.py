@@ -1069,11 +1069,12 @@ class TestKVQuantized:
                        (e_fp.cache_v, e_q.cache_v)):
             ref = np.asarray(cf[:, slot, :len(p)], np.float32)
             assert np.abs(ref).max() > 0  # rows actually written
-            deq = (np.asarray(cq["q"][:, slot, :len(p)], np.float32)
-                   * np.asarray(cq["s"][:, slot, :len(p)],
-                                np.float32)[..., None])
-            step = np.asarray(cq["s"][:, slot, :len(p)],
-                              np.float32)[..., None]
+            # Scales store lane-aligned [L, B, KV, Smax]; transpose the
+            # [L, KV, S] rows to the q rows' [L, S, KV] order.
+            sc = np.asarray(cq["s"][:, slot, :, :len(p)],
+                            np.float32).transpose(0, 2, 1)[..., None]
+            deq = np.asarray(cq["q"][:, slot, :len(p)], np.float32) * sc
+            step = sc
             err = np.abs(deq - ref)
             assert (err <= step * 0.5 + np.abs(ref) * 0.01 + 1e-6).all()
 
