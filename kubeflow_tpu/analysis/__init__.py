@@ -1,0 +1,71 @@
+"""JAX-aware static analysis: AST lint + trace-time jaxpr audits.
+
+Two tiers, one ratcheted baseline (docs/ANALYSIS.md has the full rule
+catalog and workflow):
+
+- Tier A (`astlint`): pure-AST rules over the package source -- host
+  syncs under jit, tracer branching, silent exception swallows, mutable
+  defaults, missing donation, unused imports.
+- Tier B (`jaxpr_audit`): traces the real train steps (mnist / llama /
+  bert / vit) and the serving engine's prefill / decode / insert on the
+  CPU backend, asserting donation consumption, bf16-region upcast
+  ceilings, shard_map collective counts, and zero steady-state
+  recompiles.
+
+`kftpu analyze --strict` is the CI gate: exit 0 iff nothing regressed
+vs the committed `baseline.json`.
+"""
+
+import logging
+import os
+import sys
+from typing import Dict, List, Tuple
+
+from kubeflow_tpu.analysis.report import (  # noqa: F401
+    BASELINE_PATH,
+    Comparison,
+    Finding,
+    compare,
+    load_baseline,
+    render_report,
+    write_baseline,
+)
+
+
+def ensure_cpu_backend(n_devices: int = 8) -> None:
+    """Pin jax to CPU with a virtual multi-device topology, mirroring
+    tests/conftest.py. A no-op once jax is already initialized."""
+    if "jax" not in sys.modules:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as e:  # kt-lint: disable=KT-SWALLOW01 -- best-effort:
+        # backend already locked in (e.g. a TPU-pinned interpreter); audits
+        # still run, collectives may skip on <2 devices.
+        logging.getLogger(__name__).debug("backend repin skipped: %s", e)
+
+
+def run_analysis(
+    trace: bool = True,
+    serving: bool = True,
+) -> Tuple[List[Finding], Dict[str, float]]:
+    """Run Tier A (always) and Tier B (``trace=True``); returns the
+    combined findings plus ratchet metrics."""
+    from kubeflow_tpu.analysis.astlint import lint_package
+
+    findings = list(lint_package())
+    metrics: Dict[str, float] = {}
+    if trace:
+        ensure_cpu_backend()
+        from kubeflow_tpu.analysis.jaxpr_audit import audit_all
+
+        audit_findings, metrics = audit_all(include_serving=serving)
+        findings.extend(audit_findings)
+    return findings, metrics

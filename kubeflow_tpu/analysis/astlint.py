@@ -1,0 +1,498 @@
+"""Tier A: JAX-aware AST lint over the kubeflow_tpu package.
+
+Pure-source analysis -- no imports of the linted modules, so it runs in
+milliseconds and cannot be broken by import-time side effects. Rules
+(catalog with rationale and examples in docs/ANALYSIS.md):
+
+- KT-SYNC01   host-device sync reachable from traced code (np.asarray,
+              .item(), .tolist(), .block_until_ready(), jax.device_get,
+              float()/int() of a traced name) -- each is a silent
+              device->host round trip that serializes the dispatch
+              pipeline when it appears under jit/scan/shard_map.
+- KT-BRANCH01 Python `if`/`while` on a traced function's own argument:
+              branching on a tracer either crashes (ConcretizationError)
+              or, for shape-dependent code, silently forks compilations.
+- KT-SWALLOW01 broad `except Exception` whose handler neither logs,
+              raises, returns, nor calls anything -- the failure mode
+              that turns a crashed reconciler into a silent stall.
+- KT-MUTDEF01 mutable default argument ([] / {} / set() / dict()).
+- KT-DONATE01 jax.jit of a carry-updating function (cache.at[...] /
+              apply_gradients) without donate_argnums: the old buffer
+              stays live across the update and doubles HBM.
+- KT-IMPORT01 unused module-level import (ruff F401 analog; the
+              container image has no ruff, so the check lives here).
+
+Suppression: a trailing same-line comment
+    # kt-lint: disable=KT-SYNC01 -- <justification>
+disables the named rule(s) for that line. The justification after
+``--`` is REQUIRED; a bare disable tag is ignored (and so the finding
+still fires), which keeps every suppression self-documenting.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from kubeflow_tpu.analysis.report import Finding
+
+# f(x) forms whose first callable argument is traced by JAX.
+_TRACING_ENTRY_ARGS: Dict[str, Tuple[int, ...]] = {
+    "jit": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+    "vmap": (0,),
+    "pmap": (0,),
+    "shard_map": (0,),
+    "scan": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "cond": (1, 2),
+    "custom_vjp": (0,),
+    "custom_jvp": (0,),
+    "make_jaxpr": (0,),
+    "eval_shape": (0,),
+}
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NUMPY_NAMES = {"np", "numpy", "onp"}
+
+_DISABLE_RE = re.compile(
+    r"#\s*kt-lint:\s*disable=([A-Z0-9,\-\s]+?)\s*--\s*\S"
+)
+_PB2_RE = re.compile(r"_pb2(_grpc)?\.py$")
+
+
+def _call_target_name(func: ast.AST) -> Optional[str]:
+    """Trailing identifier of a call target: jax.lax.scan -> 'scan'."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _resolve_fn_arg(node: ast.AST) -> Optional[str]:
+    """Name of the function referenced by a traced-callable argument.
+
+    Handles a bare Name, ``partial(f, ...)``, and ``module.f`` (returns
+    the attribute, resolved best-effort against local defs).
+    """
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call) and _call_target_name(node.func) == "partial":
+        if node.args:
+            return _resolve_fn_arg(node.args[0])
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class _Module:
+    def __init__(self, path: str, rel: str, source: str) -> None:
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # name -> FunctionDef nodes (same name in different scopes all
+        # recorded; trace-root resolution is best-effort by name).
+        self.defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, []).append(node)
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        if 1 <= line <= len(self.lines):
+            m = _DISABLE_RE.search(self.lines[line - 1])
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                return rule in rules
+        return False
+
+
+def _traced_roots(mod: _Module) -> Set[ast.AST]:
+    """Function defs whose bodies run under a JAX trace."""
+    roots: Set[ast.AST] = set()
+    # Decorated defs: @jax.jit / @jit / @partial(jax.jit, ...).
+    for nodes in mod.defs.values():
+        for node in nodes:
+            for deco in node.decorator_list:
+                target = deco.func if isinstance(deco, ast.Call) else deco
+                name = _call_target_name(target)
+                if name == "partial" and isinstance(deco, ast.Call) and deco.args:
+                    name = _call_target_name(deco.args[0])
+                if name in _TRACING_ENTRY_ARGS:
+                    roots.add(node)
+    # Call sites: jax.jit(step, ...), lax.scan(body, ...), shard_map(f, ...)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_target_name(node.func)
+        if name not in _TRACING_ENTRY_ARGS:
+            continue
+        for idx in _TRACING_ENTRY_ARGS[name]:
+            if idx < len(node.args):
+                fname = _resolve_fn_arg(node.args[idx])
+                if fname and fname in mod.defs:
+                    roots.update(mod.defs[fname])
+    return roots
+
+
+def _traced_defs(mod: _Module) -> Set[ast.AST]:
+    """Roots plus every def nested inside a root (trace-time closures)."""
+    traced = set(_traced_roots(mod))
+    for root in list(traced):
+        for sub in ast.walk(root):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                traced.add(sub)
+    return traced
+
+
+def _params_of(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    params = {n for n in names if n not in ("self", "cls")}
+    # static_argnames/static_argnums in a jit decorator mark Python-level
+    # (hashable) arguments: branching on those is the intended idiom.
+    for deco in getattr(fn, "decorator_list", ()):
+        if not isinstance(deco, ast.Call):
+            continue
+        for kw in deco.keywords:
+            if kw.arg == "static_argnames":
+                for node in ast.walk(kw.value):
+                    if isinstance(node, ast.Constant) and isinstance(
+                        node.value, str
+                    ):
+                        params.discard(node.value)
+            elif kw.arg == "static_argnums":
+                ordered = [p.arg for p in a.posonlyargs + a.args]
+                for node in ast.walk(kw.value):
+                    if isinstance(node, ast.Constant) and isinstance(
+                        node.value, int
+                    ) and 0 <= node.value < len(ordered):
+                        params.discard(ordered[node.value])
+    return params
+
+
+def _none_checked_names(test: ast.AST) -> Set[str]:
+    """Names whose only role in ``test`` is an `is (not) None` check --
+    the standard optional-argument dispatch, static at trace time."""
+    checked_nodes: Set[int] = set()
+    for node in ast.walk(test):
+        if (
+            isinstance(node, ast.Compare)
+            and isinstance(node.left, ast.Name)
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops)
+            and all(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in node.comparators
+            )
+        ):
+            checked_nodes.add(id(node.left))
+    # isinstance(x, ...) probes pytree STRUCTURE (e.g. dict-vs-array KV
+    # cache), which is static at trace time -- same bucket as is-None.
+    for node in ast.walk(test):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "isinstance"
+            and node.args
+            and isinstance(node.args[0], ast.Name)
+        ):
+            checked_nodes.add(id(node.args[0]))
+    only_checked: Set[str] = set()
+    plain: Set[str] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name):
+            (only_checked if id(node) in checked_nodes else plain).add(node.id)
+    # A name also used OUTSIDE a static check is genuinely branched on.
+    return only_checked - plain
+
+
+def _emit(
+    out: List[Finding], mod: _Module, rule: str, line: int, message: str
+) -> None:
+    if not mod.suppressed(line, rule):
+        out.append(Finding(rule=rule, path=mod.rel, line=line, message=message))
+
+
+# -- rule bodies ------------------------------------------------------------
+
+def _check_sync_and_branch(mod: _Module, out: List[Finding]) -> None:
+    traced = _traced_defs(mod)
+    seen_calls: Set[int] = set()
+    for fn in traced:
+        params = _params_of(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and id(node) not in seen_calls:
+                seen_calls.add(id(node))
+                func = node.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in _SYNC_METHODS
+                ):
+                    _emit(out, mod, "KT-SYNC01", node.lineno,
+                          f".{func.attr}() syncs device->host inside "
+                          f"traced fn {getattr(fn, 'name', '?')!r}")
+                elif (
+                    isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in _NUMPY_NAMES
+                    and func.attr in ("asarray", "array")
+                ):
+                    _emit(out, mod, "KT-SYNC01", node.lineno,
+                          f"{func.value.id}.{func.attr}() forces a host "
+                          f"copy inside traced fn "
+                          f"{getattr(fn, 'name', '?')!r}")
+                elif (
+                    isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "jax"
+                    and func.attr == "device_get"
+                ):
+                    _emit(out, mod, "KT-SYNC01", node.lineno,
+                          "jax.device_get inside traced fn "
+                          f"{getattr(fn, 'name', '?')!r}")
+                elif (
+                    isinstance(func, ast.Name)
+                    and func.id in ("float", "int")
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in params
+                ):
+                    _emit(out, mod, "KT-SYNC01", node.lineno,
+                          f"{func.id}() of traced argument "
+                          f"{node.args[0].id!r} concretizes on host")
+        # Branch rule: only this def's own statements, not nested defs
+        # (they get their own pass with their own params).
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                owner = _innermost_def(fn, node)
+                if owner is not fn:
+                    continue
+                names = {
+                    n.id for n in ast.walk(node.test)
+                    if isinstance(n, ast.Name)
+                }
+                hits = (names - _none_checked_names(node.test)) & params
+                if hits:
+                    _emit(out, mod, "KT-BRANCH01", node.lineno,
+                          "Python branch on traced argument(s) "
+                          f"{sorted(hits)} in {getattr(fn, 'name', '?')!r}")
+
+
+def _innermost_def(root: ast.AST, target: ast.AST) -> ast.AST:
+    """The nearest enclosing def of ``target`` within ``root``."""
+    owner = root
+    stack = [(root, root)]
+    while stack:
+        node, cur = stack.pop()
+        if node is target:
+            return cur
+        for child in ast.iter_child_nodes(node):
+            nxt = (
+                child
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                else cur
+            )
+            stack.append((child, nxt))
+    return owner
+
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad_except(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name) and t.id in _BROAD:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in _BROAD for e in t.elts
+        )
+    return False
+
+
+def _check_swallow(mod: _Module, out: List[Finding]) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad_except(node):
+            continue
+        acts = (
+            ast.Call, ast.Raise, ast.Return, ast.Await,
+            ast.Yield, ast.YieldFrom,
+        )
+        if any(isinstance(n, acts) for s in node.body for n in ast.walk(s)):
+            continue
+        _emit(out, mod, "KT-SWALLOW01", node.lineno,
+              "broad except swallows the error: no log/raise/return in "
+              "handler")
+
+
+def _check_mutable_defaults(mod: _Module, out: List[Finding]) -> None:
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for default in fn.args.defaults + [
+            d for d in fn.args.kw_defaults if d is not None
+        ]:
+            bad = isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(default, ast.Call)
+                and isinstance(default.func, ast.Name)
+                and default.func.id in ("list", "dict", "set")
+            )
+            if bad:
+                _emit(out, mod, "KT-MUTDEF01", default.lineno,
+                      f"mutable default argument in {fn.name!r}")
+
+
+def _has_carry_update(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr == "apply_gradients":
+                    return True
+                # cache.at[idx].set(...) / .add(...)
+                if (
+                    func.attr in ("set", "add")
+                    and isinstance(func.value, ast.Subscript)
+                    and isinstance(func.value.value, ast.Attribute)
+                    and func.value.value.attr == "at"
+                ):
+                    return True
+    return False
+
+
+def _check_donation(mod: _Module, out: List[Finding]) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _call_target_name(node.func) != "jit":
+            continue
+        kw = {k.arg for k in node.keywords}
+        if "donate_argnums" in kw or "donate_argnames" in kw:
+            continue
+        if not node.args:
+            continue
+        fname = _resolve_fn_arg(node.args[0])
+        if not fname or fname not in mod.defs:
+            continue
+        # ALL same-name defs must carry-update: generic inner names like
+        # ``fn`` recur per closure in one module, and flagging on ``any``
+        # would misattribute another closure's cache update to this jit.
+        if all(_has_carry_update(d) for d in mod.defs[fname]):
+            _emit(out, mod, "KT-DONATE01", node.lineno,
+                  f"jax.jit({fname}) updates a carry (.at[].set / "
+                  "apply_gradients) but declares no donate_argnums")
+
+
+def _check_unused_imports(mod: _Module, out: List[Finding]) -> None:
+    if os.path.basename(mod.path) == "__init__.py":
+        return  # re-export modules: every import is intentionally unused
+    imported: List[Tuple[str, int, str]] = []  # (binding, line, display)
+    import_nodes: Set[int] = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Import):
+            import_nodes.add(id(node))
+            for alias in node.names:
+                binding = alias.asname or alias.name.split(".")[0]
+                imported.append((binding, node.lineno, alias.name))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue  # compiler directive, never "used"
+            import_nodes.add(id(node))
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                binding = alias.asname or alias.name
+                imported.append((binding, node.lineno, alias.name))
+    if not imported:
+        return
+    used: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if id(node) in import_nodes:
+            continue
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                used.add(base.id)
+    # __all__ re-exports and docstring/annotation string references.
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            used.update(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", node.value))
+    noqa_re = re.compile(r"#\s*noqa\b(?::\s*([A-Z0-9, ]+))?")
+    for binding, line, display in imported:
+        if binding.startswith("_"):
+            continue
+        if binding not in used:
+            # Honor ruff/flake8 noqa for this rule (bare or F401): the
+            # deliberate-re-export idiom predates this linter.
+            if 1 <= line <= len(mod.lines):
+                m = noqa_re.search(mod.lines[line - 1])
+                if m and (m.group(1) is None or "F401" in m.group(1)):
+                    continue
+            _emit(out, mod, "KT-IMPORT01", line,
+                  f"unused import {display!r}")
+
+
+# -- driver -----------------------------------------------------------------
+
+RULES = (
+    _check_sync_and_branch,
+    _check_swallow,
+    _check_mutable_defaults,
+    _check_donation,
+    _check_unused_imports,
+)
+
+
+def lint_file(path: str, rel: Optional[str] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    mod = _Module(path, rel or path, source)
+    out: List[Finding] = []
+    for rule in RULES:
+        rule(mod, out)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def iter_python_files(root: str) -> Iterable[Tuple[str, str]]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d for d in dirnames if d not in ("__pycache__", ".git")
+        ]
+        for name in sorted(filenames):
+            if not name.endswith(".py") or _PB2_RE.search(name):
+                continue
+            path = os.path.join(dirpath, name)
+            yield path, os.path.relpath(path, os.path.dirname(root))
+
+
+def lint_package(package_root: Optional[str] = None) -> List[Finding]:
+    """Lint every .py under the kubeflow_tpu package (generated _pb2
+    files excluded)."""
+    if package_root is None:
+        package_root = os.path.dirname(os.path.dirname(__file__))
+    findings: List[Finding] = []
+    for path, rel in iter_python_files(package_root):
+        findings.extend(lint_file(path, rel))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

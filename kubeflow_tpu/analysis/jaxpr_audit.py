@@ -1,0 +1,436 @@
+"""Tier B: trace-time jaxpr audits over the repo's real entry points.
+
+Everything here runs on the CPU backend (an 8-virtual-device mesh when
+available): tracing and lowering are backend-faithful for the
+invariants we check, so the bugs tier-1 CPU tests cannot see -- dropped
+buffer donations, f32 upcasts in bf16 regions, recompiles in a
+steady-state serving loop, collective miscounts under shard_map -- are
+caught without a TPU in the loop.
+
+Mechanisms (all public, reused by tests to prove non-vacuity):
+
+- ``check_donation(jitted, args, ...)``: lowers the function and (a)
+  captures JAX's "Some donated buffers were not usable" warning --
+  a declared donation the compiler could NOT consume; (b) counts
+  ``tf.aliasing_output`` attributes in the lowered StableHLO -- the
+  positive proof that donation was plumbed through to XLA.
+- ``count_upcasts(fn, args)``: recursively walks the closed jaxpr
+  (descending into pjit/scan/cond/remat sub-jaxprs) counting
+  ``convert_element_type`` equations of bf16 -> f32. Deliberate
+  upcasts exist (softmax/logit accuracy), so this is a RATCHETED
+  metric, not a zero assertion.
+- ``count_collectives(fn, args)``: same walk, counting collective
+  primitives; audited entry points assert exact counts derived from
+  their declared sharding plan (ring = 2 ppermute for K/V rotation,
+  Ulysses = 4 all_to_alls for q/k/v/out resharding).
+- ``CompileWatch``: captures jax's compile log and records every
+  (function, abstract signature) pair; the serving audit runs one
+  warmup request, then a second request with shapes inside the same
+  padding buckets and fails on ANY compilation in the steady-state
+  round -- shape-signature churn is how serving latency quietly rots.
+
+Donation / recompile / collective violations are HARD findings (never
+grandfathered); upcast counts flow into the ratcheted baseline.
+"""
+
+from __future__ import annotations
+
+import logging
+import warnings
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kubeflow_tpu.analysis.report import Finding
+
+DONATION_WARNING = "donated buffers were not usable"
+
+# pbroadcast is deliberately absent: shard_map inserts it for
+# replication-rule bookkeeping (check_rep) and it moves zero bytes.
+_COLLECTIVES = (
+    "psum", "ppermute", "all_gather", "all_to_all", "reduce_scatter",
+    "pmax", "pmin",
+)
+
+
+# -- jaxpr walking ----------------------------------------------------------
+
+def _iter_eqns(jaxpr):
+    """Yield every eqn in a (Closed)Jaxpr, descending into sub-jaxprs
+    carried in params (pjit/scan/while/cond/remat/custom_* ...)."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)  # ClosedJaxpr -> Jaxpr
+    for eqn in inner.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            for sub in _as_jaxprs(val):
+                yield from _iter_eqns(sub)
+
+
+def _as_jaxprs(val):
+    if hasattr(val, "eqns") or hasattr(val, "jaxpr"):
+        return [val]
+    if isinstance(val, (tuple, list)):
+        return [v for v in val if hasattr(v, "eqns") or hasattr(v, "jaxpr")]
+    return []
+
+
+def count_upcasts(fn, args, from_dtype="bfloat16", to_dtype="float32") -> int:
+    """Number of convert_element_type eqns casting from_dtype->to_dtype
+    anywhere in fn's jaxpr (sub-jaxprs included)."""
+    import jax
+    import jax.numpy as jnp
+
+    src = jnp.dtype(from_dtype)
+    dst = jnp.dtype(to_dtype)
+    closed = jax.make_jaxpr(fn)(*args)
+    n = 0
+    for eqn in _iter_eqns(closed):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        new = eqn.params.get("new_dtype")
+        if new is None or jnp.dtype(new) != dst:
+            continue
+        invar = eqn.invars[0]
+        if getattr(invar, "aval", None) is not None and (
+            jnp.dtype(invar.aval.dtype) == src
+        ):
+            n += 1
+    return n
+
+
+def count_collectives(fn, args) -> Dict[str, int]:
+    """Counts of collective primitives in fn's jaxpr, zero-suppressed."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    counts: Dict[str, int] = {}
+    for eqn in _iter_eqns(closed):
+        name = eqn.primitive.name
+        if name in _COLLECTIVES:
+            counts[name] = counts.get(name, 0) + 1
+    return counts
+
+
+# -- donation ---------------------------------------------------------------
+
+def check_donation(
+    jitted,
+    args: Sequence,
+    entry: str,
+    min_aliased: Optional[int] = None,
+) -> List[Finding]:
+    """Lower ``jitted`` at ``args`` and verify declared donations are
+    consumed. Returns hard findings (empty list = pass)."""
+    findings: List[Finding] = []
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        lowered = jitted.lower(*args)
+        text = lowered.as_text()
+    for w in caught:
+        if DONATION_WARNING in str(w.message):
+            findings.append(Finding(
+                rule="KT-AUDIT-DONATE", path=entry, line=0, hard=True,
+                message=f"declared donation not consumed: {w.message}",
+            ))
+    aliased = text.count("tf.aliasing_output")
+    if min_aliased is not None and aliased < min_aliased:
+        findings.append(Finding(
+            rule="KT-AUDIT-DONATE", path=entry, line=0, hard=True,
+            message=(
+                f"only {aliased} output alias(es) in lowered HLO, "
+                f"expected >= {min_aliased}: donation dropped"
+            ),
+        ))
+    return findings
+
+
+class DonationWatch:
+    """Capture donation-unusable warnings across arbitrary code (e.g. a
+    whole serving warmup, where the jits live in closures)."""
+
+    def __init__(self) -> None:
+        self.messages: List[str] = []
+
+    def __enter__(self):
+        self._ctx = warnings.catch_warnings(record=True)
+        self._caught = self._ctx.__enter__()
+        warnings.simplefilter("always")
+        return self
+
+    def __exit__(self, *exc):
+        for w in self._caught:
+            if DONATION_WARNING in str(w.message):
+                self.messages.append(str(w.message))
+        return self._ctx.__exit__(*exc)
+
+    def findings(self, entry: str) -> List[Finding]:
+        return [
+            Finding(rule="KT-AUDIT-DONATE", path=entry, line=0, hard=True,
+                    message=f"declared donation not consumed: {m}")
+            for m in self.messages
+        ]
+
+
+# -- recompile detection ----------------------------------------------------
+
+class CompileWatch:
+    """Record every XLA compilation (function name + abstract signature)
+    issued while the context is active, via jax's compile log."""
+
+    _LOGGERS = ("jax._src.interpreters.pxla", "jax._src.dispatch")
+
+    def __init__(self) -> None:
+        self.compiles: List[str] = []
+
+    def __enter__(self):
+        import jax
+
+        class _H(logging.Handler):
+            def emit(_self, record):
+                msg = record.getMessage()
+                if msg.startswith("Compiling "):
+                    self.compiles.append(msg)
+
+        self._handler = _H(level=logging.DEBUG)
+        self._prev = jax.config.jax_log_compiles
+        jax.config.update("jax_log_compiles", True)
+        self._restore = []
+        for name in self._LOGGERS:
+            lg = logging.getLogger(name)
+            # propagate=False keeps jax_log_compiles' WARNING firehose off
+            # the user's stderr; our handler still sees every record.
+            self._restore.append((lg, lg.level, lg.propagate))
+            lg.addHandler(self._handler)
+            lg.propagate = False
+            if lg.level > logging.DEBUG or lg.level == logging.NOTSET:
+                lg.setLevel(logging.DEBUG)
+        return self
+
+    def __exit__(self, *exc):
+        import jax
+
+        jax.config.update("jax_log_compiles", self._prev)
+        for lg, level, prop in self._restore:
+            lg.removeHandler(self._handler)
+            lg.setLevel(level)
+            lg.propagate = prop
+        return False
+
+    def signatures(self) -> List[str]:
+        # "Compiling <name> with global shapes and types [...]" -- the
+        # whole message IS the abstract signature hash key.
+        return list(self.compiles)
+
+
+# -- entry-point audits -----------------------------------------------------
+
+def _mesh():
+    import jax
+
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    return build_mesh(MeshConfig(), devices=jax.devices())
+
+
+TRAIN_TASKS = {
+    "mnist": dict(batch_size=8),
+    "llama": dict(preset="llama-tiny", batch_size=8, seq_len=16),
+    "bert": dict(preset="bert-tiny", batch_size=8, seq_len=16),
+    "vit": dict(preset="vit-tiny", batch_size=8),
+}
+
+# bf16-activation tasks whose upcast count is a ratcheted metric.
+_BF16_TASKS = ("llama",)
+
+
+def audit_train_steps(
+    tasks: Optional[Sequence[str]] = None,
+) -> Tuple[List[Finding], Dict[str, float]]:
+    import jax
+
+    from kubeflow_tpu.models import get_task
+
+    findings: List[Finding] = []
+    metrics: Dict[str, float] = {}
+    mesh = _mesh()
+    for name in tasks or sorted(TRAIN_TASKS):
+        entry = f"train.{name}"
+        task = get_task(name, **TRAIN_TASKS[name])
+        state = task.init_state(jax.random.PRNGKey(0), mesh)
+        step = task.train_step_fn(mesh)
+        jitted = getattr(step, "jitted", step)
+        if not hasattr(jitted, "lower"):
+            findings.append(Finding(
+                rule="KT-AUDIT-DONATE", path=entry, line=0, hard=True,
+                message="train step exposes no .lower/.jitted; cannot "
+                        "verify donation",
+            ))
+            continue
+        batch = next(iter(task.data_iter(1, 0, mesh)))
+        # Every array leaf of the donated state must come back aliased:
+        # a train step that double-buffers its TrainState doubles the
+        # optimizer+param HBM footprint (PR 1's bug class).
+        n_state_leaves = len(jax.tree.leaves(state))
+        findings.extend(check_donation(
+            jitted, (state, *batch), entry, min_aliased=n_state_leaves,
+        ))
+        if name in _BF16_TASKS:
+            metrics[f"upcasts.{entry}"] = count_upcasts(
+                jitted, (state, *batch)
+            )
+    return findings, metrics
+
+
+def audit_serving_engine() -> Tuple[List[Finding], Dict[str, float]]:
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.llama import PRESETS
+    from kubeflow_tpu.serving.engine import GenerationEngine
+
+    findings: List[Finding] = []
+    metrics: Dict[str, float] = {}
+    cfg = dataclasses.replace(PRESETS["llama-tiny"], max_seq=64)
+
+    with DonationWatch() as warmup_donations, CompileWatch() as warm_watch:
+        eng = GenerationEngine(config=cfg, max_slots=2, decode_block=4)
+        # Warmup: compiles prefill (one length bucket), insert, decode
+        # blocks, sampling. The prompt/token counts are chosen so round
+        # two below stays inside every bucket warmed here.
+        eng.generate([3, 5, 7], max_new_tokens=6)
+    findings.extend(warmup_donations.findings("serve.warmup"))
+    if not warm_watch.signatures():
+        # The warmup MUST compile; zero events means the compile-log
+        # capture is broken and the steady-state check below is vacuous.
+        findings.append(Finding(
+            rule="KT-AUDIT-RECOMPILE", path="serve.warmup", line=0,
+            hard=True,
+            message="compile watcher recorded nothing during warmup; "
+                    "recompile detection is not functioning",
+        ))
+
+    # Steady state: same buckets, different content/length -> the jit
+    # caches must absorb everything. Any compile here is a recompile bug.
+    with CompileWatch() as watch, DonationWatch() as steady_donations:
+        eng.generate([2, 4], max_new_tokens=6)
+    findings.extend(steady_donations.findings("serve.steady"))
+    for sig in watch.signatures():
+        findings.append(Finding(
+            rule="KT-AUDIT-RECOMPILE", path="serve.steady", line=0,
+            hard=True,
+            message=f"steady-state serving loop recompiled: {sig[:200]}",
+        ))
+
+    reg = getattr(eng, "_jit_registry", None)
+    if reg is None:
+        findings.append(Finding(
+            rule="KT-AUDIT-DONATE", path="serve.insert", line=0, hard=True,
+            message="engine exposes no _jit_registry; cannot verify "
+                    "insert/decode donation",
+        ))
+        return findings, metrics
+
+    # Insert: both caches are donated; every cache leaf must alias out.
+    tokens = jnp.zeros((1, 32), jnp.int32)
+    lengths = jnp.asarray([5], jnp.int32)
+    _, k_seq, v_seq = eng._prefill(tokens, lengths)
+    slots = jnp.asarray([0], jnp.int32)
+    n_cache_leaves = len(jax.tree.leaves((eng.cache_k, eng.cache_v)))
+    findings.extend(check_donation(
+        reg["insert"],
+        (eng.cache_k, eng.cache_v, k_seq, v_seq, slots),
+        "serve.insert", min_aliased=n_cache_leaves,
+    ))
+
+    # Decode block: donated KV carry. The engine populated its per-key
+    # jit cache during warmup; audit each compiled variant with the
+    # argument shapes the engine itself uses.
+    b = eng.max_slots
+    toks = jnp.zeros((b,), jnp.int32)  # 1-D decode lanes (_pack_decode_lanes)
+    lens = jnp.zeros((b,), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+    temps = jnp.zeros((b,), jnp.float32)
+    tks = jnp.zeros((b,), jnp.int32)
+    tps = jnp.ones((b,), jnp.float32)
+    for key, jfn in sorted(reg["decode_block"].items(), key=repr):
+        n, filtered, want_lp, masked = key
+        if masked:
+            continue  # mask aval depends on live vocab state; warmup
+            # already covered it via DonationWatch.
+        args = (eng.weights, eng.cache_k, eng.cache_v, toks, lens, rng,
+                temps, tks, tps)
+        findings.extend(check_donation(
+            jfn, args, f"serve.decode_block[n={n}]",
+            min_aliased=n_cache_leaves,
+        ))
+
+    # Upcast ratchet over the bf16 prefill path (weights are arguments,
+    # so the count covers embed->layers->logits end to end).
+    metrics["upcasts.serve.prefill"] = count_upcasts(
+        reg["prefill"], (eng.weights, tokens, lengths)
+    )
+    return findings, metrics
+
+
+def audit_collectives() -> Tuple[List[Finding], Dict[str, float]]:
+    """Ring/Ulysses shard_map bodies: collective counts must match the
+    declared plan exactly -- a missing ppermute breaks causality, an
+    extra all_gather silently re-materializes the full sequence."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    findings: List[Finding] = []
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return findings, {}
+
+    seq = min(4, n_dev)
+    expected = {
+        # K and V each rotate once per ring step; the jaxpr carries the
+        # pair once (inside the fori_loop body's skip-last-hop cond).
+        "ring_attention": ({"ppermute": 2}, "sequence"),
+        # q, k, v reshard seq->heads plus one out reshard heads->seq.
+        "ulysses_attention": ({"all_to_all": 4}, "sequence"),
+    }
+
+    mesh = build_mesh(MeshConfig(data=1, sequence=seq),
+                      devices=jax.devices()[:seq])
+    q = jnp.zeros((2, 16, 4, 8), jnp.float32)
+    k = jnp.zeros((2, 16, 4, 8), jnp.float32)
+    v = jnp.zeros((2, 16, 4, 8), jnp.float32)
+
+    from kubeflow_tpu.ops.ring_attention import ring_attention_sharded
+    from kubeflow_tpu.ops.ulysses import ulysses_attention_sharded
+
+    for name, fn in (
+        ("ring_attention", ring_attention_sharded),
+        ("ulysses_attention", ulysses_attention_sharded),
+    ):
+        want, _axis = expected[name]
+        got = count_collectives(
+            partial(fn, mesh=mesh, causal=True), (q, k, v)
+        )
+        if got != want:
+            findings.append(Finding(
+                rule="KT-AUDIT-COLLECTIVE", path=f"ops.{name}", line=0,
+                hard=True,
+                message=f"collective counts {got} != declared plan {want} "
+                        f"on a {seq}-way sequence mesh",
+            ))
+    return findings, {}
+
+
+def audit_all(
+    include_serving: bool = True,
+) -> Tuple[List[Finding], Dict[str, float]]:
+    findings: List[Finding] = []
+    metrics: Dict[str, float] = {}
+    for fn in ([audit_train_steps, audit_collectives]
+               + ([audit_serving_engine] if include_serving else [])):
+        f, m = fn()
+        findings.extend(f)
+        metrics.update(m)
+    return findings, metrics

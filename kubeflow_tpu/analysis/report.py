@@ -1,0 +1,182 @@
+"""Findings, the ratcheted baseline, and the analyze exit-code contract.
+
+Both analysis tiers (astlint's source findings and jaxpr_audit's
+trace-time findings) funnel through one `Finding` shape and one
+committed baseline file (`kubeflow_tpu/analysis/baseline.json`).
+
+The baseline is a RATCHET, not an allowlist of lines:
+
+- findings are aggregated to ``(rule, path)`` counts, so line churn from
+  unrelated edits never invalidates the baseline;
+- a count above its baseline entry (or a brand-new ``(rule, path)``
+  pair) is a NEW finding and fails ``analyze --strict`` (exit 1);
+- a count below baseline is progress: strict still passes, and
+  ``analyze --update-baseline`` re-snapshots so the ceiling drops.
+  The committed file may therefore only shrink over time.
+- trace-time *metrics* (e.g. bf16->f32 upcast counts per entry point)
+  ratchet the same way under the ``metrics`` key: current value above
+  the recorded one fails, below passes and can be re-snapshotted.
+
+Hard invariants (broken donation, recompiles in a steady-state serving
+loop, collective-count mismatches) never enter the baseline: they fail
+strict unconditionally -- grandfathering a dropped donation would defeat
+the point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str      # e.g. "KT-SWALLOW01"
+    path: str      # repo-relative file, or entry-point name for audits
+    line: int      # 1-based; 0 for trace-level findings
+    message: str
+    # Hard findings bypass the ratchet: they fail strict even if an
+    # identical (rule, path) count exists in the baseline.
+    hard: bool = False
+
+    @property
+    def group(self) -> Tuple[str, str]:
+        return (self.rule, self.path)
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: {self.rule}: {self.message}"
+
+
+def group_counts(findings: List[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        if f.hard:
+            continue
+        key = f"{f.rule}:{f.path}"
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def load_baseline(path: Optional[str] = None) -> dict:
+    path = path or BASELINE_PATH
+    if not os.path.exists(path):
+        return {"counts": {}, "metrics": {}, "initial_total": None}
+    with open(path) as f:
+        data = json.load(f)
+    data.setdefault("counts", {})
+    data.setdefault("metrics", {})
+    data.setdefault("initial_total", None)
+    return data
+
+
+def write_baseline(
+    findings: List[Finding],
+    metrics: Dict[str, float],
+    path: Optional[str] = None,
+    initial_total: Optional[int] = None,
+) -> dict:
+    path = path or BASELINE_PATH
+    prior = load_baseline(path)
+    counts = group_counts(findings)
+    data = {
+        # The very first scan's total is pinned forever so the ratchet's
+        # history is auditable: current total must stay strictly below
+        # it once the first fixes land.
+        "initial_total": (
+            initial_total
+            if initial_total is not None
+            else (prior.get("initial_total") or sum(counts.values()))
+        ),
+        "total": sum(counts.values()),
+        "counts": dict(sorted(counts.items())),
+        "metrics": dict(sorted(metrics.items())),
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return data
+
+
+@dataclasses.dataclass
+class Comparison:
+    new: List[Finding]            # above-baseline or hard findings
+    fixed: List[str]              # group keys whose count dropped
+    regressed_metrics: Dict[str, Tuple[float, float]]  # name -> (base, cur)
+
+    @property
+    def clean(self) -> bool:
+        return not self.new and not self.regressed_metrics
+
+
+def compare(
+    findings: List[Finding],
+    metrics: Dict[str, float],
+    baseline: dict,
+) -> Comparison:
+    base_counts: Dict[str, int] = baseline.get("counts", {})
+    counts = group_counts(findings)
+    new: List[Finding] = [f for f in findings if f.hard]
+    for key, n in sorted(counts.items()):
+        allowed = base_counts.get(key, 0)
+        if n > allowed:
+            # Surface the actual findings for the over-budget group; all
+            # of them, since we cannot tell old from new by line.
+            rule, _, path = key.partition(":")
+            over = [
+                f for f in findings
+                if not f.hard and f.rule == rule and f.path == path
+            ]
+            excess = n - allowed
+            new.extend(over[:excess] if allowed else over)
+    fixed = [
+        key for key, allowed in sorted(base_counts.items())
+        if counts.get(key, 0) < allowed
+    ]
+    regressed = {}
+    base_metrics = baseline.get("metrics", {})
+    for name, value in sorted(metrics.items()):
+        if name in base_metrics and value > base_metrics[name]:
+            regressed[name] = (base_metrics[name], value)
+    return Comparison(new=new, fixed=fixed, regressed_metrics=regressed)
+
+
+def render_report(
+    findings: List[Finding],
+    metrics: Dict[str, float],
+    cmp: Comparison,
+    as_json: bool = False,
+) -> str:
+    if as_json:
+        return json.dumps(
+            {
+                "total": len(findings),
+                "new": [dataclasses.asdict(f) for f in cmp.new],
+                "fixed": cmp.fixed,
+                "regressed_metrics": {
+                    k: {"baseline": b, "current": c}
+                    for k, (b, c) in cmp.regressed_metrics.items()
+                },
+                "metrics": metrics,
+                "counts": group_counts(findings),
+                "clean": cmp.clean,
+            },
+            indent=2,
+        )
+    lines = []
+    lines.append(
+        f"{len(findings)} finding(s) total; "
+        f"{len(cmp.new)} new vs baseline, {len(cmp.fixed)} group(s) fixed"
+    )
+    for f in cmp.new:
+        lines.append(f"  NEW  {f.format()}")
+    for key in cmp.fixed:
+        lines.append(f"  FIXED {key} (run analyze --update-baseline)")
+    for name, (b, c) in cmp.regressed_metrics.items():
+        lines.append(f"  METRIC {name}: {b} -> {c} (regression)")
+    lines.append("clean" if cmp.clean else "NEW FINDINGS: fix or justify")
+    return "\n".join(lines)

@@ -200,6 +200,34 @@ def cmd_events(args, client: TrainingClient) -> int:
     return 0
 
 
+def cmd_analyze(args, _client) -> int:
+    """Static analysis gate (local; no control-plane server involved).
+
+    Exit-code contract (stable for CI): 0 = clean vs the committed
+    baseline, 1 = new findings or regressed metrics. --update-baseline
+    re-snapshots after fixes so the ratchet only ever tightens.
+    """
+    from kubeflow_tpu import analysis
+
+    findings, metrics = analysis.run_analysis(
+        trace=not args.no_trace, serving=not args.no_serving
+    )
+    baseline = analysis.load_baseline(args.baseline)
+    cmp = analysis.compare(findings, metrics, baseline)
+    if args.update_baseline:
+        data = analysis.write_baseline(
+            findings, metrics, path=args.baseline
+        )
+        print(f"baseline updated: {data['total']} grandfathered finding(s)"
+              f" (initial scan had {data['initial_total']})")
+        return 0
+    print(analysis.render_report(findings, metrics, cmp,
+                                 as_json=args.json))
+    if args.strict and not cmp.clean:
+        return 1
+    return 0
+
+
 def cmd_serve(args, _client) -> int:
     from kubeflow_tpu.server.app import main as server_main
 
@@ -253,6 +281,24 @@ def main(argv=None) -> int:
     sp.add_argument("-n", "--namespace", default="default")
     sp.set_defaults(fn=cmd_events)
 
+    sp = sub.add_parser(
+        "analyze",
+        help="JAX-aware static analysis (AST lint + trace-time audits)",
+    )
+    sp.add_argument("--strict", action="store_true",
+                    help="exit 1 on findings above the baseline ratchet")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    sp.add_argument("--update-baseline", action="store_true",
+                    help="re-snapshot the ratchet after fixes")
+    sp.add_argument("--no-trace", action="store_true",
+                    help="tier A (AST) only; skip jaxpr audits")
+    sp.add_argument("--no-serving", action="store_true",
+                    help="skip the serving-engine audit (fastest trace run)")
+    sp.add_argument("--baseline", default=None,
+                    help="baseline path (default: committed baseline.json)")
+    sp.set_defaults(fn=cmd_analyze)
+
     sp = sub.add_parser("serve", help="run the control-plane server")
     sp.add_argument("--state-dir", default=os.path.expanduser("~/.kftpu"))
     sp.add_argument("--port", type=int, default=7450)
@@ -260,7 +306,8 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_serve)
 
     args = p.parse_args(argv)
-    client = TrainingClient(args.server) if args.cmd != "serve" else None
+    local_cmds = ("serve", "analyze")  # no control-plane client needed
+    client = TrainingClient(args.server) if args.cmd not in local_cmds else None
     try:
         return args.fn(args, client)
     except ApiError as e:

@@ -20,7 +20,30 @@ from typing import Optional
 
 import jax
 
-__all__ = ["axis_size", "shard_map"]
+__all__ = ["axis_size", "inside_manual_region", "shard_map"]
+
+
+def inside_manual_region() -> bool:
+    """True when tracing inside a shard_map manual region (e.g. the gpipe
+    pipeline body). Nested shard_maps and GSPMD sharding constraints are
+    both rejected there, so callers fall back (GSPMD attention, no-op
+    constraint). New jax exposes the abstract mesh's axis types; on older
+    jax any bound named axis means a manual region is on the trace stack,
+    because the legacy fallback in :func:`shard_map` below always runs
+    fully manual."""
+    mesh_fn = getattr(jax.sharding, "get_abstract_mesh", None)
+    if mesh_fn is not None:
+        mesh = mesh_fn()
+        return any(
+            "Manual" in str(t) for t in getattr(mesh, "axis_types", ())
+        )
+    try:
+        from jax._src import core as _src_core
+
+        return bool(_src_core.get_axis_env().axis_sizes)
+    except Exception:  # kt-lint: disable=KT-SWALLOW01 -- private-API probe
+        # across jax lineages; absence just means "not manual".
+        return False
 
 
 def axis_size(axis_name) -> int:

@@ -15,7 +15,6 @@ slice consumption, not just pod count.
 from __future__ import annotations
 
 import enum
-import time
 from typing import Any, List, Optional, Union
 
 from pydantic import BaseModel, ConfigDict, Field

@@ -69,4 +69,8 @@ def with_mesh_context(mesh: Mesh, jitted):
         with mesh_context(mesh):
             return jitted(*args, **kw)
 
+    # The underlying jitted fn stays reachable for trace-time tooling
+    # (analysis.jaxpr_audit lowers it to verify donation/dtype/compile
+    # invariants without running a step).
+    wrapped.jitted = jitted
     return wrapped

@@ -720,8 +720,6 @@ class LlamaTask(TrainTask):
         return logits, aux
 
     def train_step_fn(self, mesh: Mesh):
-        from kubeflow_tpu.parallel.mesh import mesh_context
-
         shardings = self._shardings(mesh)
         batch_sharding = NamedSharding(mesh, spec_for(("batch", "length")))
 
@@ -786,11 +784,9 @@ class LlamaTask(TrainTask):
 
         # mesh_context makes the mesh visible to ring attention at trace
         # time (the first call traces; later calls hit the jit cache).
-        def wrapped(state, tokens, targets):
-            with mesh_context(mesh):
-                return jitted(state, tokens, targets)
+        from kubeflow_tpu.models.common import with_mesh_context
 
-        return wrapped
+        return with_mesh_context(mesh, jitted)
 
     # -- data -------------------------------------------------------------
 

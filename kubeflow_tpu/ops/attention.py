@@ -127,15 +127,12 @@ def dot_product_attention(
 
 
 def _inside_manual_region() -> bool:
-    """True when tracing inside a shard_map manual region (e.g. the gpipe
-    pipeline body). The ring's own full-mesh shard_map cannot nest there --
-    the context mesh has Manual axis types -- so auto dispatch falls back
+    """The ring's own full-mesh shard_map cannot nest inside a manual
+    region (e.g. the gpipe pipeline body), so auto dispatch falls back
     to GSPMD attention (correct; K/V all-gathered within the stage)."""
-    try:
-        mesh = jax.sharding.get_abstract_mesh()
-    except AttributeError:  # older jax
-        return False
-    return any("Manual" in str(t) for t in getattr(mesh, "axis_types", ()))
+    from kubeflow_tpu.compat import inside_manual_region
+
+    return inside_manual_region()
 
 
 def _cp_shardable_base(q: jax.Array, k: jax.Array, mesh) -> bool:

@@ -24,7 +24,6 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import dataclasses
-import math
 from typing import Optional, Sequence
 
 import jax

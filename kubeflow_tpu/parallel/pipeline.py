@@ -89,6 +89,11 @@ def gpipe(
             y, aux = stage_fn(params, inp)
             # Tick t is a real microbatch for rank r iff r <= t < r + M.
             valid = (t >= rank) & (t < rank + n_microbatches)
+            # aux_acc stays rank-1 [1]: a rank-0 carry here becomes a
+            # rank-0 residual of the shard_map partial-eval, and the
+            # transpose then fails its out-spec rank check (_SpecError,
+            # jax 0.4.x legacy shard_map) -- scalars cannot carry a
+            # P(axis) spec.
             aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
             out_idx = jnp.clip(t - (n_stages - 1), 0, n_microbatches - 1)
             prev = jax.lax.dynamic_index_in_dim(
@@ -105,7 +110,7 @@ def gpipe(
         recv0 = jnp.zeros_like(xs[0])
         (_, outputs, aux_acc), _ = jax.lax.scan(
             tick,
-            (recv0, outputs0, jnp.float32(0.0)),
+            (recv0, outputs0, jnp.zeros((1,), jnp.float32)),
             jnp.arange(n_ticks),
         )
         # Stack per-rank results on a leading stage dim and let GSPMD move
@@ -113,7 +118,7 @@ def gpipe(
         # simpler, but XLA-CPU's AllReducePromotion pass crashes on bf16
         # all-reduces -- observed jaxlib 0.9.0 -- and the transpose of a
         # replicated input is exactly such a psum).
-        return outputs.astype(jnp.float32)[None], aux_acc[None]
+        return outputs.astype(jnp.float32)[None], aux_acc
 
     from jax.sharding import PartitionSpec as P
 

@@ -87,6 +87,13 @@ def with_logical_constraint(
 
         mesh = active_mesh()
     if mesh is not None:
+        from kubeflow_tpu.compat import inside_manual_region
+
+        if inside_manual_region():
+            # Inside a shard_map manual region (e.g. the gpipe body) a
+            # GSPMD constraint naming manual axes is rejected outright;
+            # the per-shard layout is already fixed there, so skip.
+            return x
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
     # No mesh anywhere (single-device model.apply outside the runtime):
     # constraints are advisory, so skip rather than demand a mesh context.

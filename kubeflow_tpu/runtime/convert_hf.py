@@ -139,7 +139,9 @@ def save_as_orbax(variables: Dict[str, Any], out_dir: str,
     # whole point of a conversion artifact is to move it).
     state = {
         "params": variables,
-        "step": np.int64(step),
+        # 0-d ndarray, not np.int64: orbax's StandardCheckpointHandler
+        # accepts ndarrays but rejects bare numpy scalar types.
+        "step": np.asarray(step, dtype=np.int64),
         "opt_state": {},
     }
     mgr = ocp.CheckpointManager(out_dir)

@@ -493,7 +493,11 @@ class ControlPlane:
         if req.method == "POST" and req.path.startswith("/apis/"):
             try:
                 body = await req.json()
-            except Exception:  # noqa: BLE001 -- malformed -> handler 400s
+            except Exception as e:  # noqa: BLE001 -- malformed -> handler
+                # 400s; log the parse error so client bugs are diagnosable
+                # from the server side instead of vanishing.
+                logger.debug("malformed JSON body on %s %s: %s",
+                             req.method, req.path, e)
                 body = None
             else:
                 if not isinstance(body, dict):
@@ -1106,7 +1110,11 @@ def main(argv=None) -> int:
             import jax
 
             chips = max(len(jax.devices()), 1)
-        except Exception:
+        except Exception as e:  # noqa: BLE001 -- no jax / no backend is a
+            # supported control-plane-only deployment, but say so: a typo'd
+            # TPU env silently degrading to 1 chip cost a debugging session.
+            logger.warning("jax device probe failed (%s); --chips "
+                           "defaulting to 1", e)
             chips = 1
 
     cp = ControlPlane(args.state_dir, total_chips=chips)

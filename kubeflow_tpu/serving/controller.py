@@ -1056,8 +1056,14 @@ class ISVCController:
                         svc.ready_event.set()
                         self._enqueue(*_key_parts(key))
                         return
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 -- not-ready is normal
+                # while the replica boots, but a swallowed probe error
+                # also hid real bugs (bad port, garbage healthz JSON);
+                # debug-log with replica context so stalls are traceable.
+                logger.debug(
+                    "readiness probe %s[%d] port %d: %s", key, index,
+                    rep.port, e,
+                )
             await asyncio.sleep(self.probe_interval)
 
     async def on_worker_exit(self, ref: WorkerRef, code: int) -> bool:

@@ -1810,6 +1810,19 @@ class GenerationEngine:
         self._prefill = _prefill_call
         self._insert = insert_jit
         self._sample = sample_call
+        # Introspection surface for analysis.jaxpr_audit: the live jit
+        # objects (the dicts are the same mutable caches the dispatch
+        # closures fill in), so donation/recompile invariants can be
+        # checked against exactly what serves traffic.
+        self._jit_registry = {
+            "prefill": prefill_jit,
+            "insert": insert_jit,
+            "decode_block": block_jits,
+            "fused": fused_jits,
+            "spec": spec_jits,
+            "extract": extract_jits,
+            "restore": restore_jits,
+        }
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._wake = threading.Event()

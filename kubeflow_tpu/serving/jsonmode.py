@@ -41,10 +41,13 @@ tokenizer_vocab_strings for details.
 
 from __future__ import annotations
 
+import logging
 from collections import OrderedDict
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 _WS = " \t\n\r"
 _HEX = "0123456789abcdefABCDEF"
@@ -486,10 +489,19 @@ def tokenizer_vocab_strings(tok, vocab_size: int) -> List[Optional[str]]:
     ByteLevel alphabet inversion), deferred until a real tokenizer
     rides this path in CI."""
     out: List[Optional[str]] = []
+    failed = 0
+    last_err: Optional[BaseException] = None
     for i in range(vocab_size):
         try:
             s = tok.decode([i])
-        except Exception:  # noqa: BLE001 - out-of-range id
+        except Exception as e:  # kt-lint: disable=KT-SWALLOW01 -- per-id
+            # decode failures (special/out-of-range ids) are expected and
+            # per-id logging would spam 32k lines; summarized below.
             s = None
+            failed += 1
+            last_err = e
         out.append(s if s else None)
+    if failed:
+        logger.debug("vocab extraction: %d/%d ids failed to decode "
+                     "(last error: %s)", failed, vocab_size, last_err)
     return out

@@ -155,7 +155,9 @@ def load_params_from_checkpoint(path: str, cfg, mesh=None) -> dict:
                 type(e).__name__, e,
             )
     if restored is None:
-        restored = mgr.restore(step)
+        # Target-less StandardRestore: this orbax lineage cannot infer a
+        # handler for the saved "default" item from a bare restore(step).
+        restored = mgr.restore(step, args=ocp.args.StandardRestore())
     mgr.close()
     # Unwrap to the MODEL param tree: a TrainState checkpoint nests it as
     # state["params"]["params"] (TrainState.params holds the variables

@@ -1,0 +1,335 @@
+"""Tier-1 gate + non-vacuity tests for kubeflow_tpu.analysis.
+
+Three layers:
+
+1. The gate itself: AST lint + full jaxpr audits must be clean against
+   the committed baseline.json ratchet (exactly what `kftpu analyze
+   --strict` enforces in CI).
+2. Non-vacuity: every lint rule fires on a minimal bad example, and the
+   trace-time auditors catch a deliberately-broken donation and a
+   deliberate bf16->f32 upcast. A gate that cannot fail is no gate.
+3. Ratchet mechanics: grandfathered counts may only decrease, hard
+   findings are never grandfathered, and the CLI exit-code contract
+   (0 clean / 1 new findings) holds.
+"""
+
+import json
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from kubeflow_tpu import analysis
+from kubeflow_tpu.analysis import astlint, jaxpr_audit
+from kubeflow_tpu.analysis.report import Finding, compare, group_counts
+
+REPO_ROOT = __file__.rsplit("/tests/", 1)[0]
+
+
+def lint_source(tmp_path, source):
+    p = tmp_path / "snippet.py"
+    p.write_text(source)
+    return astlint.lint_file(str(p), rel="snippet.py")
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# Tier A non-vacuity: each rule must fire on a minimal bad example.
+# ---------------------------------------------------------------------------
+
+def test_sync_rule_fires_on_item_under_jit(tmp_path):
+    findings = lint_source(tmp_path, (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.item()\n"
+    ))
+    assert "KT-SYNC01" in rules_of(findings)
+
+
+def test_sync_rule_quiet_outside_tracing(tmp_path):
+    findings = lint_source(tmp_path, (
+        "def f(x):\n"
+        "    return x.item()\n"
+    ))
+    assert "KT-SYNC01" not in rules_of(findings)
+
+
+def test_branch_rule_fires_on_traced_if(tmp_path):
+    findings = lint_source(tmp_path, (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+    ))
+    assert "KT-BRANCH01" in rules_of(findings)
+
+
+def test_branch_rule_allows_none_and_static_checks(tmp_path):
+    findings = lint_source(tmp_path, (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnames=('block',))\n"
+        "def f(x, mask=None, block=4):\n"
+        "    if mask is not None:\n"
+        "        x = x * mask\n"
+        "    if block > 2:\n"
+        "        x = x + 1\n"
+        "    return x\n"
+    ))
+    assert "KT-BRANCH01" not in rules_of(findings)
+
+
+def test_swallow_rule_fires_and_respects_logging(tmp_path):
+    bad = lint_source(tmp_path, (
+        "def f(g):\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    ))
+    assert "KT-SWALLOW01" in rules_of(bad)
+    ok = lint_source(tmp_path, (
+        "import logging\n"
+        "def f(g):\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception as e:\n"
+        "        logging.getLogger(__name__).debug('boom: %s', e)\n"
+    ))
+    assert "KT-SWALLOW01" not in rules_of(ok)
+
+
+def test_mutable_default_rule(tmp_path):
+    findings = lint_source(tmp_path, "def f(a, acc=[]):\n    return acc\n")
+    assert "KT-MUTDEF01" in rules_of(findings)
+
+
+def test_donation_rule_fires_on_carry_update_without_donate(tmp_path):
+    src = (
+        "import jax\n"
+        "def step(state, batch):\n"
+        "    return state.at[0].set(batch)\n"
+        "train = jax.jit(step)\n"
+    )
+    assert "KT-DONATE01" in rules_of(lint_source(tmp_path, src))
+    fixed = src.replace("jax.jit(step)",
+                        "jax.jit(step, donate_argnums=(0,))")
+    assert "KT-DONATE01" not in rules_of(lint_source(tmp_path, fixed))
+
+
+def test_unused_import_rule_and_noqa(tmp_path):
+    findings = lint_source(tmp_path, "import os\nimport sys\nprint(sys.path)\n")
+    assert [f.rule for f in findings] == ["KT-IMPORT01"]
+    assert findings[0].line == 1
+    quiet = lint_source(tmp_path, "import os  # noqa: F401\n")
+    assert quiet == []
+
+
+def test_suppression_requires_justification(tmp_path):
+    base = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:{tag}\n"
+        "        return x\n"
+        "    return -x\n"
+    )
+    with_reason = base.format(
+        tag="  # kt-lint: disable=KT-BRANCH01 -- toy example")
+    assert "KT-BRANCH01" not in rules_of(lint_source(tmp_path, with_reason))
+    # A bare tag with no `-- why` is ignored: suppressions must be
+    # justified or they do not count.
+    bare = base.format(tag="  # kt-lint: disable=KT-BRANCH01")
+    assert "KT-BRANCH01" in rules_of(lint_source(tmp_path, bare))
+
+
+# ---------------------------------------------------------------------------
+# Tier B non-vacuity: deliberately-broken programs must be caught.
+# ---------------------------------------------------------------------------
+
+def test_broken_donation_is_caught():
+    import jax
+    import jax.numpy as jnp
+
+    # Output shape differs from the donated input, so XLA cannot alias
+    # the buffer: the declared donation is silently dropped -- exactly
+    # what the auditor exists to catch.
+    broken = jax.jit(lambda x: x[:2], donate_argnums=(0,))
+    findings = jaxpr_audit.check_donation(
+        broken, (jnp.zeros((8,), jnp.float32),), "toy.broken", min_aliased=1
+    )
+    assert findings and all(f.rule == "KT-AUDIT-DONATE" for f in findings)
+    assert all(f.hard for f in findings)
+
+    ok = jax.jit(lambda x: x + 1.0, donate_argnums=(0,))
+    assert jaxpr_audit.check_donation(
+        ok, (jnp.zeros((8,), jnp.float32),), "toy.ok", min_aliased=1
+    ) == []
+
+
+def test_bf16_upcast_is_caught():
+    import jax.numpy as jnp
+
+    def leaky(x):
+        return x.astype(jnp.float32) * 2.0  # deliberate bf16 -> f32
+
+    x = jnp.zeros((4,), jnp.bfloat16)
+    assert jaxpr_audit.count_upcasts(leaky, (x,)) >= 1
+    assert jaxpr_audit.count_upcasts(lambda x: x * 2.0, (x,)) == 0
+
+
+def test_recompile_watch_sees_shape_driven_recompiles():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x * 2.0)
+    # Allocate outside the watch: jnp.zeros itself compiles a broadcast
+    # kernel per new shape, which would pollute the census.
+    x4, x4b = jnp.zeros((4,), jnp.float32), jnp.ones((4,), jnp.float32)
+    x6 = jnp.zeros((6,), jnp.float32)
+    with jaxpr_audit.CompileWatch() as warm:
+        f(x4)
+    assert len(warm.signatures()) >= 1
+    with jaxpr_audit.CompileWatch() as steady:
+        f(x4b)  # same abstract signature: cache hit
+        f(x6)   # new shape -> exactly one recompile
+    sigs = steady.signatures()
+    assert len(sigs) == 1 and "[6]" in sigs[0]
+
+
+def test_collective_census_empty_for_local_fn():
+    import jax.numpy as jnp
+
+    assert jaxpr_audit.count_collectives(
+        lambda x: x + 1.0, (jnp.zeros((4,), jnp.float32),)
+    ) == {}
+
+
+# ---------------------------------------------------------------------------
+# Ratchet mechanics.
+# ---------------------------------------------------------------------------
+
+def _soft(rule="KT-X01", path="a.py", line=1):
+    return Finding(rule=rule, path=path, line=line, message="m")
+
+
+def test_ratchet_counts_only_decrease():
+    baseline = {"counts": {"KT-X01:a.py": 2}, "metrics": {}}
+    at_budget = compare([_soft(), _soft(line=9)], {}, baseline)
+    assert at_budget.clean
+    over = compare([_soft(), _soft(line=9), _soft(line=12)], {}, baseline)
+    assert not over.clean and len(over.new) == 1
+    under = compare([_soft()], {}, baseline)
+    assert under.clean and under.fixed == ["KT-X01:a.py"]
+
+
+def test_hard_findings_never_grandfathered():
+    hard = Finding(rule="KT-AUDIT-DONATE", path="e", line=0,
+                   message="m", hard=True)
+    baseline = {"counts": group_counts([hard]), "metrics": {}}
+    assert group_counts([hard]) == {}  # hard findings are not countable
+    assert not compare([hard], {}, baseline).clean
+
+
+def test_metric_ratchet():
+    baseline = {"counts": {}, "metrics": {"upcasts.t": 5}}
+    assert compare([], {"upcasts.t": 5}, baseline).clean
+    assert compare([], {"upcasts.t": 4}, baseline).clean
+    worse = compare([], {"upcasts.t": 6}, baseline)
+    assert not worse.clean and worse.regressed_metrics == {"upcasts.t": (5, 6)}
+
+
+# ---------------------------------------------------------------------------
+# CLI exit-code contract (run_analysis stubbed: wiring under test, not jax).
+# ---------------------------------------------------------------------------
+
+def _run_cli(monkeypatch, capsys, findings, metrics, argv):
+    from kubeflow_tpu.cli import main as cli_main
+
+    monkeypatch.setattr(analysis, "run_analysis",
+                        lambda **kw: (findings, metrics))
+    rc = cli_main.main(["analyze", *argv])
+    return rc, capsys.readouterr().out
+
+
+def test_cli_strict_clean_exits_zero(monkeypatch, capsys, tmp_path):
+    base = tmp_path / "b.json"
+    base.write_text(json.dumps({"counts": {}, "metrics": {}}))
+    rc, out = _run_cli(monkeypatch, capsys, [], {},
+                       ["--strict", "--json", "--baseline", str(base)])
+    assert rc == 0
+    assert json.loads(out)["clean"] is True
+
+
+def test_cli_strict_new_finding_exits_one(monkeypatch, capsys, tmp_path):
+    base = tmp_path / "b.json"
+    base.write_text(json.dumps({"counts": {}, "metrics": {}}))
+    rc, out = _run_cli(monkeypatch, capsys, [_soft()], {},
+                       ["--strict", "--json", "--baseline", str(base)])
+    assert rc == 1
+    assert json.loads(out)["clean"] is False
+
+
+def test_cli_update_then_ratchet(monkeypatch, capsys, tmp_path):
+    base = tmp_path / "b.json"
+    rc, _ = _run_cli(monkeypatch, capsys, [_soft()], {},
+                     ["--update-baseline", "--baseline", str(base)])
+    assert rc == 0
+    data = json.loads(base.read_text())
+    assert data["total"] == 1 and data["initial_total"] == 1
+    # Grandfathered finding passes strict...
+    rc, _ = _run_cli(monkeypatch, capsys, [_soft()], {},
+                     ["--strict", "--baseline", str(base)])
+    assert rc == 0
+    # ...but one more in the same group fails it.
+    rc, _ = _run_cli(monkeypatch, capsys, [_soft(), _soft(line=7)], {},
+                     ["--strict", "--baseline", str(base)])
+    assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# The gate itself.
+# ---------------------------------------------------------------------------
+
+def test_lint_package_clean_vs_baseline():
+    findings = astlint.lint_package()
+    cmp = compare(findings, {}, analysis.load_baseline())
+    assert cmp.clean, f"new lint findings: {cmp.new}"
+
+
+def test_full_audit_clean_vs_baseline():
+    findings, metrics = analysis.run_analysis(trace=True, serving=True)
+    cmp = compare(findings, metrics, analysis.load_baseline())
+    assert cmp.clean, (
+        f"analysis gate regressed: new={cmp.new} "
+        f"metrics={cmp.regressed_metrics}"
+    )
+    # The committed ratchet reflects a real initial scan that was then
+    # burned down: strictly fewer grandfathered findings than found.
+    baseline = analysis.load_baseline()
+    assert baseline["total"] < baseline["initial_total"]
+
+
+@pytest.mark.skipif(shutil.which("ruff") is None,
+                    reason="ruff not in this environment")
+def test_ruff_clean():
+    proc = subprocess.run(
+        [shutil.which("ruff"), "check", "kubeflow_tpu", "tests"],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_module_entrypoint_help():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubeflow_tpu.cli.main", "analyze", "--help"],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0 and "--strict" in proc.stdout
