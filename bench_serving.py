@@ -159,6 +159,76 @@ def _pct(xs, q):
     return round(float(np.percentile(np.asarray(xs), q)) * 1000.0, 1)
 
 
+def bench_pipeline(max_slots: int = 16) -> dict:
+    """Dispatch-pipeline A/B: pipeline_depth 0 (sequential
+    dispatch-sync-consume) vs 1 (block N+1 chained off device-resident
+    carry while block N's outputs are consumed). Uniform saturated
+    decode at the LATENCY block size (8): small blocks cross the
+    host<->device boundary most often, so the per-block host gap is the
+    largest fraction of the loop there -- the overlap win shows at
+    small blocks or nowhere. Each engine's own host_gap_ms_ema gauge is
+    reported next to the throughput median so the delta is attributable
+    to the gap closing, not ambient tunnel noise."""
+    import gc
+
+    import numpy as np
+
+    from kubeflow_tpu.serving.engine import GenerationEngine, Request
+
+    def run(depth: int) -> dict:
+        eng = GenerationEngine(
+            preset=PRESET, max_slots=max_slots, max_seq=MAX_SEQ,
+            decode_block=LATENCY_DECODE_BLOCK, pipeline_depth=depth,
+        )
+        rng = np.random.default_rng(3)
+
+        def make_requests(n):
+            return [
+                Request(
+                    prompt=rng.integers(1, 1000, PROMPT_LEN).tolist(),
+                    max_new_tokens=NEW_TOKENS,
+                )
+                for _ in range(n)
+            ]
+
+        futs = [eng.submit(r) for r in make_requests(max_slots)]
+        while any(not f.done() for f in futs):
+            eng.step()
+
+        def measure() -> float:
+            ms = [eng.submit(r) for r in make_requests(max_slots * 2)]
+            t0 = time.perf_counter()
+            while any(not f.done() for f in ms):
+                eng.step()
+            dt = time.perf_counter() - t0
+            return sum(len(f.result()) for f in ms) / dt
+
+        out = _measured_reps(measure)
+        s = eng.stats()
+        out["gauges"] = {
+            k: s[k] for k in (
+                "dispatch_depth", "host_gap_ms_ema",
+                "overshoot_tokens_discarded", "decode_dispatches",
+            )
+        }
+        eng.close()
+        gc.collect()
+        return out
+
+    a = run(0)
+    b = run(1)
+    return {
+        "workload": (
+            f"uniform saturated decode, {max_slots} slots, "
+            f"decode_block={LATENCY_DECODE_BLOCK}, {PROMPT_LEN}-token "
+            f"prompts, {NEW_TOKENS} new"
+        ),
+        "depth0": a,
+        "depth1": b,
+        **_ab_verdict(a, b),
+    }
+
+
 def bench_throughput_mixed(max_slots: int) -> dict:
     """Throughput on the REALISTIC workload shape (mixed prompt/output
     lengths, all slots kept busy) -- the uniform sweep above is the
@@ -1007,6 +1077,8 @@ def _phase_dispatch(name: str, args: dict):
         return bench_speculative()
     if name == "quantized":
         return bench_quantized(int(args["max_slots"]))
+    if name == "pipeline":
+        return bench_pipeline(int(args.get("max_slots", 16)))
     if name == "kv_capacity":
         return bench_kv_capacity(args.get("config", "int8+kv+kernel"))
     if name == "real_8b":
@@ -1071,7 +1143,7 @@ def main() -> int:
             # A forgotten phase name must not fall through to the full
             # multi-hour orchestrated run.
             print("usage: bench_serving.py --phase "
-                  "<slot|mixed|latency|prefix|spec|quantized|"
+                  "<slot|mixed|latency|prefix|spec|quantized|pipeline|"
                   "kv_capacity> ['<json-args>']", file=sys.stderr)
             return 2
         args = json.loads(sys.argv[3]) if len(sys.argv) > 3 else {}
@@ -1113,6 +1185,10 @@ def main() -> int:
     # compute-bound and int8 is neutral -- measured r4: 3,645 bf16 vs
     # 3,631 int8+kv at 256).
     quant = _run_phase("quantized", {"max_slots": 32})
+    # Dispatch-pipeline depth-0 vs depth-1 A/B at the latency block
+    # size (small blocks = max host-gap exposure); records the engines'
+    # host_gap_ms_ema gauge so future rounds track host-gap regression.
+    pipeline = _run_phase("pipeline", {"max_slots": 16})
     # THE REAL 8B (round-5 headline): int8 weights + int8 KV + Pallas
     # kernel serve the actual llama3-8b preset on this one chip. Slot
     # rows each in their own subprocess (an OOM row must not poison the
@@ -1204,6 +1280,7 @@ def main() -> int:
             "prefix_cache": prefix,
             "speculative": spec,
             "quantized": quant,
+            "pipeline_ab": pipeline,
             "kv_capacity": kv_cap,
             "real_8b": real_8b,
             "quality_trained_checkpoint": quality,
@@ -1234,7 +1311,13 @@ def main() -> int:
                     "A/Bs bf16 vs weight-only int8 "
                     "on the uniform sweep at the best slot count (same "
                     "model, coarser weights -- reported separately, not "
-                    "as the headline). Identical-code tunnel runs "
+                    "as the headline). pipeline_ab A/Bs dispatch depth "
+                    "0 vs 1 (overlapped decode dispatch, "
+                    "docs/SERVING.md) on uniform saturated decode at "
+                    "the latency block size, with each engine's "
+                    "host_gap_ms_ema gauge attached so host-gap "
+                    "regressions are tracked, not inferred. "
+                    "Identical-code tunnel runs "
                     "spread roughly "
                     "+/-10-20% day to day (r3's engine re-measured 686 "
                     "tok/s at 16 slots on this round's run day vs its "

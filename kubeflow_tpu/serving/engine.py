@@ -17,6 +17,16 @@ forward). The reference's GPU LLM path is huggingfaceserver+vLLM (SURVEY.md
   defeats fusion; Smax bounds the slab).
 - **Donated cache buffers.** decode/insert donate the cache so XLA updates
   it in place in HBM -- no per-token cache copies.
+- **Depth-1 dispatch pipeline.** The decode block hands back its final
+  token/position carry as DEVICE arrays; the scheduler chains them into
+  the next block's dispatch, starts the outputs streaming home with
+  copy_to_host_async, and only then consumes the previous block (EOS /
+  stop detection, logprobs, stream callbacks) while the new block runs.
+  Slots that finish mid-flight produce bounded overshoot the host
+  already discards by design, and decode sampling keys are a pure
+  function of (request nonce, position), so pipeline_depth=1 emits
+  bit-identical streams to pipeline_depth=0. Admissions, constraint
+  mode, and spec-decode drain the pipeline first (docs/SERVING.md).
 - **Layer-stacked params + lax.scan** over layers: mirrors the training
   model's nn.scan layout, so orbax training checkpoints drop straight in;
   one compiled layer body.
@@ -29,6 +39,7 @@ linen -- inference wants explicit state, not module state.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import logging
 import queue
 import threading
@@ -698,7 +709,7 @@ def _logprob_outputs(logits, chosen):
 
 def _decode_block(cfg: LlamaConfig, n_steps: int, filtered: bool,
                   want_lp: bool, w: dict, cache_k, cache_v, tokens,
-                  lengths, rng, temps, top_ks, top_ps,
+                  lengths, rng, temps, top_ks, top_ps, nonces,
                   kernel: bool = False, mask=None):
     """n_steps decode+sample iterations in ONE device program.
 
@@ -709,14 +720,32 @@ def _decode_block(cfg: LlamaConfig, n_steps: int, filtered: bool,
     never attended (the decode mask is position-bounded) and prefill
     overwrites them on slot reuse.
 
+    Sampling keys are derived PER ROW and PER POSITION:
+    fold_in(fold_in(rng, nonces[b]), position) with ``rng`` a fixed
+    base key, ``nonces`` the per-request counter stamped at submit(),
+    and ``position`` the scan-carried length. A token's draw therefore
+    depends only on (request, position) -- NOT on which block it lands
+    in or what else is in flight -- so the pipelined dispatcher
+    (pipeline_depth=1) emits bit-identical streams to the sequential
+    one (pipeline_depth=0), block partitioning included.
+
     ``want_lp`` (STATIC) additionally emits per-step logprob outputs --
     gated because the extra [B, V] log-softmax + top-k passes are pure
     waste for the no-logprobs common case.
+
+    Returns (outs, ck, cv, last_tokens [B], last_positions [B]) -- the
+    final carry rides back as DEVICE arrays so a chained next block can
+    consume them without a host round trip.
     """
 
-    def body(carry, step_rng):
+    def body(carry, _):
         ck, cv, toks, lens = carry
         logits, ck, cv = _decode(cfg, w, ck, cv, toks, lens, kernel)
+        keys = jax.vmap(
+            lambda nonce, pos: jax.random.fold_in(
+                jax.random.fold_in(rng, nonce), pos
+            )
+        )(nonces, lens)
         # ``filtered`` is STATIC: the all-greedy/unfiltered batch (the
         # common case) must not pay the double [B, V] argsort + cumsum
         # of top-k/top-p -- measured 5x decode throughput on the 8B
@@ -724,17 +753,17 @@ def _decode_block(cfg: LlamaConfig, n_steps: int, filtered: bool,
         # mask is only sound for the FIRST step of a block (the legal
         # set depends on each sampled token); constrained callers run
         # n_steps=1, so the whole block is that first step.
-        nxt = _sample(logits, step_rng, temps,
-                      top_ks if filtered else None,
-                      top_ps if filtered else None, mask)
+        nxt = _sample_rows(logits, keys, temps,
+                           top_ks if filtered else None,
+                           top_ps if filtered else None, mask)
         out = (nxt, *_logprob_outputs(logits, nxt)) if want_lp else nxt
         return (ck, cv, nxt, lens + 1), out
 
-    rngs = jax.random.split(rng, n_steps)
-    (ck, cv, _, _), outs = jax.lax.scan(
-        body, (cache_k, cache_v, tokens, lengths), rngs
+    (ck, cv, last, lens), outs = jax.lax.scan(
+        body, (cache_k, cache_v, tokens, lengths), None, length=n_steps
     )
-    return outs, ck, cv  # outs [n_steps, B] (or the logprob tuple)
+    # outs [n_steps, B] (or the logprob tuple)
+    return outs, ck, cv, last, lens
 
 
 def _host_logprobs(row: np.ndarray, token: int, n: int) -> dict:
@@ -753,10 +782,10 @@ def _host_logprobs(row: np.ndarray, token: int, n: int) -> dict:
     }
 
 
-def _sample(logits, rng, temps, top_ks=None, top_ps=None, mask=None):
-    """Per-slot sampling: temp<=0 means greedy; optional per-slot top-k
-    (0 = off) and top-p/nucleus (>=1.0 = off) truncation applied before
-    the categorical draw. logits [B,V]; temps/top_ks/top_ps [B].
+def _filter_scaled(logits, temps, top_ks=None, top_ps=None, mask=None):
+    """Shared sampling front half: constraint mask, temperature scaling,
+    and the rank-based top-k/top-p truncation. Returns (greedy [B],
+    scaled [B,V]) ready for a categorical draw.
 
     Both filters are rank-based masks over the full vocab (sorted once),
     so the program stays one fixed-shape fusion -- no dynamic gather of
@@ -789,7 +818,40 @@ def _sample(logits, rng, temps, top_ks=None, top_ps=None, mask=None):
             keep_sorted = (cum - probs) < top_ps[:, None]
             keep = jnp.take_along_axis(keep_sorted, ranks, axis=-1)
             scaled = jnp.where(keep, scaled, neg)
+    return greedy, scaled
+
+
+def _sample(logits, rng, temps, top_ks=None, top_ps=None, mask=None):
+    """Per-slot sampling: temp<=0 means greedy; optional per-slot top-k
+    (0 = off) and top-p/nucleus (>=1.0 = off) truncation applied before
+    the categorical draw. logits [B,V]; temps/top_ks/top_ps [B].
+
+    One batch-wide categorical from a single ``rng`` -- the right shape
+    for host-chained call sites (admission first tokens, fused/spec
+    paths) where a fresh key is split per dispatch.
+    """
+
+    greedy, scaled = _filter_scaled(logits, temps, top_ks, top_ps, mask)
     sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+
+def _sample_rows(logits, keys, temps, top_ks=None, top_ps=None,
+                 mask=None):
+    """Like ``_sample`` but with an independent PRNG key PER ROW
+    (``keys`` [B, key_size]). Decode blocks derive each row's key from
+    (request nonce, token position) so the draw for a given token is a
+    pure function of the request and position -- invariant to how the
+    engine partitions steps into blocks, which is what lets the
+    pipelined dispatcher (pipeline_depth=1) stay bit-identical to the
+    sequential one. Attention is slot-local, so rows are independent
+    and the per-row draw loses nothing to the batch-wide one.
+    """
+
+    greedy, scaled = _filter_scaled(logits, temps, top_ks, top_ps, mask)
+    sampled = jax.vmap(
+        lambda k, row: jax.random.categorical(k, row)
+    )(keys, scaled)
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
@@ -1390,6 +1452,10 @@ class Request:
     on_token: Optional[Any] = None
     # Filled by the scheduler:
     slot: int = -1
+    # Per-request sampling nonce (stamped at submit): decode-block keys
+    # are fold_in(fold_in(base, nonce), position), so a request's draws
+    # are independent of batch composition and block partitioning.
+    nonce: int = 0
     prefilled: int = 0  # prompt tokens already in the cache (chunked path)
     generated: List[int] = dataclasses.field(default_factory=list)
     # Per-token logprob records, parallel to ``generated`` (only when
@@ -1398,6 +1464,31 @@ class Request:
     # Observability timestamps (engine-internal).
     submit_t: float = 0.0
     last_emit_t: float = 0.0
+
+
+@dataclasses.dataclass
+class _Inflight:
+    """One dispatched-but-unconsumed decode block (pipeline_depth=1).
+
+    ``outs`` are DEVICE arrays still streaming home; ``last``/``lens``
+    are the block's final token/position carry, kept on device so the
+    next block can chain off them without a host round trip. The
+    sampling lane arrays ride along because a chained dispatch reuses
+    them verbatim -- no host state changed between the two dispatches,
+    so re-packing would produce identical arrays anyway.
+    """
+
+    n: int
+    outs: Any
+    last: Any
+    lens: Any
+    temps: Any
+    top_ks: Any
+    top_ps: Any
+    nonces: Any
+    filtered: bool
+    want_lp: bool
+    slots: tuple
 
 
 class GenerationEngine:
@@ -1429,6 +1520,7 @@ class GenerationEngine:
         quantize: Optional[str] = None,
         kv_quant: Optional[str] = None,
         streaming_init: bool = False,
+        pipeline_depth: int = 1,
     ) -> None:
         # Max decode steps fused into one device program (power-of-2
         # sub-blocks keep the compile count bounded); 1 = per-token
@@ -1676,19 +1768,21 @@ class GenerationEngine:
 
         def _block_fn(n, filtered, want_lp, masked=False):
             def fn(w, ck, cv, toks, lens, rng, temps, top_ks, top_ps,
-                   *mask):
-                outs, ck, cv = _decode_block(
+                   nonces, *mask):
+                outs, ck, cv, last, lens = _decode_block(
                     cfg, n, filtered, want_lp, w, ck, cv, toks, lens,
-                    rng, temps, top_ks, top_ps, kernel=use_kernel,
-                    mask=mask[0] if masked else None,
+                    rng, temps, top_ks, top_ps, nonces,
+                    kernel=use_kernel, mask=mask[0] if masked else None,
                 )
-                return outs, _pin(ck), _pin(cv)
+                return outs, _pin(ck), _pin(cv), last, lens
             return fn
 
         def decode_block_call(n, filtered, want_lp, ck, cv, toks, lens,
-                              rng, temps, top_ks, top_ps, mask=None):
+                              rng, temps, top_ks, top_ps, nonces,
+                              mask=None):
             # ``masked`` is part of the jit key: the unmasked program
             # (the common path) compiles byte-identical to before.
+            self._note_dispatch(decode=True)
             masked = mask is not None
             key = (n, filtered, want_lp, masked)
             if key not in block_jits:
@@ -1698,7 +1792,7 @@ class GenerationEngine:
                 )
             extra = (jnp.asarray(mask),) if masked else ()
             return block_jits[key](self.weights, ck, cv, toks, lens, rng,
-                                   temps, top_ks, top_ps, *extra)
+                                   temps, top_ks, top_ps, nonces, *extra)
 
         self._decode_block_call = decode_block_call
 
@@ -1707,6 +1801,7 @@ class GenerationEngine:
         def fused_call(n, m, klen, filtered, want_lp, ck, cv, toks,
                        lens, ctoks, coffs, cclens, cslots, rng, temps,
                        top_ks, top_ps, mask=None):
+            self._note_dispatch(decode=False)
             masked = mask is not None
             key = (n, m, klen, ctoks.shape[1], filtered, want_lp, masked)
             if key not in fused_jits:
@@ -1730,6 +1825,7 @@ class GenerationEngine:
         spec_jits = {}
 
         def spec_call(m, ck, cv, toks, lens, hist):
+            self._note_dispatch(decode=False)
             if m not in spec_jits:
                 def fn(w, ck, cv, toks, lens, hist):
                     outs, counts, ck, cv = _spec_block(
@@ -1804,6 +1900,7 @@ class GenerationEngine:
 
         def _prefill_call(tokens, lengths):
             # Accept a scalar for the single-prompt case (tests/oracles).
+            self._note_dispatch(decode=False)
             lengths = jnp.atleast_1d(jnp.asarray(lengths, jnp.int32))
             return prefill_jit(self.weights, tokens, lengths)
 
@@ -1830,6 +1927,26 @@ class GenerationEngine:
         self.requests_finished = 0
         self.ttft_hist = LatencyHistogram()
         self.itl_hist = LatencyHistogram()
+        # -- overlapped dispatch pipeline ------------------------------
+        # 0 = fully sequential (dispatch, sync, consume); 1 = keep one
+        # decode block in flight and consume the previous block's
+        # host-bound outputs while it runs. Depth >1 buys nothing (one
+        # block already covers the host work) so the knob clamps.
+        self.pipeline_depth = min(1, max(0, int(pipeline_depth)))
+        # Per-request sampling nonces (see _decode_block): a plain
+        # itertools counter -- CPython-atomic, so submit() needs no lock.
+        self._req_counter = itertools.count()
+        # Base key for per-row decode sampling; distinct from the
+        # _next_rng chain (which admissions/fused/spec keep consuming)
+        # so an extra in-flight dispatch can never shift that chain.
+        self._decode_rng = jax.random.fold_in(
+            jax.random.PRNGKey(seed), 0xDEC0DE
+        )
+        self._inflight = None  # _InflightBlock | None
+        self._gap_t: Optional[float] = None
+        self.decode_dispatches = 0
+        self.host_gap_ms_ema: Optional[float] = None
+        self.overshoot_tokens_discarded = 0
 
     # -- scheduling core ---------------------------------------------------
 
@@ -1846,6 +1963,7 @@ class GenerationEngine:
             )
             return req.future
         req.submit_t = time.perf_counter()
+        req.nonce = next(self._req_counter)
         self.pending.put(req)
         self._wake.set()
         return req.future
@@ -2061,6 +2179,7 @@ class GenerationEngine:
         # future occupant -- a row first becomes visible (mask: key <=
         # query position) in the very decode step that overwrites it.
         positions = np.full(self.max_slots, self.cfg.max_seq - 1, np.int32)
+        nonces = np.zeros(self.max_slots, np.int32)
         for slot, req in self.active.items():
             tokens[slot] = req.generated[-1]
             temps[slot] = req.temperature
@@ -2069,35 +2188,118 @@ class GenerationEngine:
             # lengths[slot] already counts the last generated token, whose
             # K/V is not in the cache yet: its position is lengths-1.
             positions[slot] = max(int(self.lengths[slot]) - 1, 0)
+            nonces[slot] = req.nonce
         filtered = any(
             req.top_k > 0 or req.top_p < 1.0
             for req in self.active.values()
         )
-        return tokens, temps, top_ks, top_ps, positions, filtered
+        return tokens, temps, top_ks, top_ps, positions, nonces, filtered
 
-    def _emit_decode_outs(self, outs, want_lp: bool) -> None:
+    def _emit_run(self, req: Request, toks: np.ndarray, lp=None) -> int:
+        """Emit a run of consecutive decode tokens for ONE request and
+        return how many were accepted (the caller discards the rest as
+        overshoot). ``lp`` is the request's (logprobs [n], top_ids
+        [n,K], top_logprobs [n,K]) slice when the dispatch carried
+        logprob outputs.
+
+        Fast path is vectorized numpy -- EOS via compare+flatnonzero,
+        budget/headroom as mins, one bulk append -- with logprob
+        records, histogram writes, latency observations, and on_token
+        callbacks produced in exactly the order the per-token loop
+        produced them. Host predicates (stop_fn / constraint) must see
+        every token as it lands, so those requests take the per-token
+        path unchanged."""
+        n = len(toks)
+        if req.stop_fn is not None or req.constraint is not None:
+            for j in range(n):
+                if lp is not None and req.logprobs:
+                    kk = min(req.logprobs, LOGPROBS_K)
+                    req.logprob_data.append({
+                        "logprob": float(lp[0][j]),
+                        "top_ids": lp[1][j, :kk].tolist(),
+                        "top_logprobs": lp[2][j, :kk].tolist(),
+                    })
+                self._emit(req, int(toks[j]))
+                if req.slot not in self.active:  # finished mid-run
+                    return j + 1
+            return n
+        budget = req.max_new_tokens - len(req.generated)
+        headroom = self.cfg.max_seq - int(self.lengths[req.slot])
+        k = min(n, budget, headroom)
+        if k <= 0:  # defensive: a no-budget request is already finished
+            return 0
+        done = k >= budget or k >= headroom
+        if req.eos_id is not None:
+            hits = np.flatnonzero(toks[:k] == req.eos_id)
+            if hits.size:
+                k = int(hits[0]) + 1
+                done = True
+        if lp is not None and req.logprobs:
+            kk = min(req.logprobs, LOGPROBS_K)
+            for j in range(k):
+                req.logprob_data.append({
+                    "logprob": float(lp[0][j]),
+                    "top_ids": lp[1][j, :kk].tolist(),
+                    "top_logprobs": lp[2][j, :kk].tolist(),
+                })
+        slot = req.slot
+        base = int(self.lengths[slot])
+        acc = toks[:k]
+        first = not req.generated
+        req.generated.extend(int(t) for t in acc)
+        self.tokens_generated += k
+        if self.hist is not None:
+            end = min(base + k, self.cfg.max_seq)
+            if end > base:
+                self.hist[slot, base:end] = acc[:end - base]
+        now = time.perf_counter()
+        if first:
+            self.ttft_hist.observe(now - req.submit_t)
+        else:
+            # First token of the run carries the cross-dispatch gap;
+            # the rest landed in the same block (the per-token loop
+            # observed microseconds for them -- same bucket as 0).
+            self.itl_hist.observe(now - req.last_emit_t)
+        for _ in range(k - 1):
+            self.itl_hist.observe(0.0)
+        req.last_emit_t = now
+        if req.on_token is not None:
+            for t in acc:
+                try:
+                    req.on_token(int(t))
+                except Exception:  # noqa: BLE001 - a bad stream sink must
+                    logger.exception("on_token callback failed")  # not kill
+        self.lengths[slot] += k
+        if done:
+            self._finish(req)
+        return k
+
+    def _emit_decode_outs(self, outs, want_lp: bool,
+                          dispatch_slots=None) -> None:
         """Emit a dispatch's [n, B] decode tokens in step order; slots
         finishing mid-block drop their overshoot. With ``want_lp`` the
         dispatch also returned per-step logprob arrays, recorded
-        parallel to each request's generated ids."""
+        parallel to each request's generated ids. ``dispatch_slots``
+        (pipelined consume) is the active set AT DISPATCH TIME: a lane
+        whose slot freed while the block was in flight is discarded
+        whole -- garbage-safe by the parked-row invariant."""
         if want_lp:
             toks, lps, tids, tlps = (np.asarray(o) for o in outs)
         else:
             toks = np.asarray(outs)
         n = toks.shape[0]
-        for slot in list(self.active):
-            req = self.active[slot]
-            for j in range(n):
-                if want_lp and req.logprobs:
-                    k = min(req.logprobs, LOGPROBS_K)
-                    req.logprob_data.append({
-                        "logprob": float(lps[j, slot]),
-                        "top_ids": tids[j, slot, :k].tolist(),
-                        "top_logprobs": tlps[j, slot, :k].tolist(),
-                    })
-                self._emit(req, int(toks[j, slot]))
-                if slot not in self.active:  # finished: drop overshoot
-                    break
+        slots = (list(self.active) if dispatch_slots is None
+                 else dispatch_slots)
+        for slot in slots:
+            req = self.active.get(slot)
+            if req is None:  # freed mid-flight
+                self.overshoot_tokens_discarded += n
+                continue
+            lp = None
+            if want_lp and req.logprobs:
+                lp = (lps[:, slot], tids[:, slot], tlps[:, slot])
+            k = self._emit_run(req, toks[:, slot], lp)
+            self.overshoot_tokens_discarded += n - k
 
     def _fused_step(self) -> None:
         """One mixed dispatch: n decode steps fused with prefill chunks,
@@ -2172,7 +2374,9 @@ class GenerationEngine:
             # discarded, so they don't need covering.
             max_end = max(max_end, pos)
         klen = self._bucket(max_end)
-        tokens, temps, top_ks, top_ps, positions, filtered = (
+        # (nonces unused: the fused path samples from the _next_rng
+        # chain -- it never pipelines, so chain order is stable.)
+        tokens, temps, top_ks, top_ps, positions, _nonces, filtered = (
             self._pack_decode_lanes()
         )
         want_lp = any(req.logprobs for req in self.active.values())
@@ -2288,6 +2492,19 @@ class GenerationEngine:
             "prefill_backlog_tokens": backlog_tokens,
             "tokens_generated": self.tokens_generated,
             "requests_finished": self.requests_finished,
+            # Overlapped-dispatch pipeline gauges (docs/SERVING.md):
+            # configured depth, EMA of the host-side bubble between a
+            # block's outputs landing and the next dispatch (the gap
+            # depth-1 exists to hide), and tokens decoded past a
+            # request's accepted stream (EOS/budget overshoot +
+            # mid-flight-freed lanes -- discarded by design).
+            "dispatch_depth": self.pipeline_depth,
+            "decode_dispatches": self.decode_dispatches,
+            "host_gap_ms_ema": (
+                round(self.host_gap_ms_ema, 3)
+                if self.host_gap_ms_ema is not None else 0.0
+            ),
+            "overshoot_tokens_discarded": self.overshoot_tokens_discarded,
         }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
@@ -2320,8 +2537,15 @@ class GenerationEngine:
     def step(self) -> bool:
         """Admit pending, then run one mixed dispatch: a fused
         chunk+decode program when any slot is mid-prefill, else a pure
-        decode block. Returns True if work ran."""
+        decode block. With ``pipeline_depth=1`` at slot saturation the
+        NEXT block is chained off the current one's device-resident
+        carry before its outputs are consumed, so the host work
+        (EOS/stop detection, logprobs, stream callbacks) overlaps the
+        chained block's device time; the chained block is left in
+        flight for the next step. Returns True if work ran."""
 
+        if self._inflight is not None:
+            return self._pipeline_step()
         self._admit()
         if self.prefilling:
             self._fused_step()
@@ -2361,18 +2585,162 @@ class GenerationEngine:
         # else: constrained slots are active -- the legal-token set
         # depends on each sampled token, so dispatches are single-step
         # for the whole batch (jsonmode.py documents the cost).
-        tokens, temps, top_ks, top_ps, positions, filtered = (
+        tokens, temps, top_ks, top_ps, positions, nonces, filtered = (
             self._pack_decode_lanes()
         )
         want_lp = any(req.logprobs for req in self.active.values())
-        outs, self.cache_k, self.cache_v = self._decode_block_call(
-            n, filtered, want_lp, self.cache_k, self.cache_v,
-            jnp.asarray(tokens), jnp.asarray(positions),
-            self._next_rng(), jnp.asarray(temps),
-            jnp.asarray(top_ks), jnp.asarray(top_ps), mask,
+        jt, jk, jp, jn = (jnp.asarray(temps), jnp.asarray(top_ks),
+                          jnp.asarray(top_ps), jnp.asarray(nonces))
+        outs, self.cache_k, self.cache_v, last, lens = (
+            self._decode_block_call(
+                n, filtered, want_lp, self.cache_k, self.cache_v,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                self._decode_rng, jt, jk, jp, jn, mask,
+            )
         )
-        self._emit_decode_outs(outs, want_lp)
+        fl = _Inflight(n, outs, last, lens, jt, jk, jp, jn, filtered,
+                       want_lp, tuple(self.active))
+        if mask is not None:
+            self._consume_block(fl, behind=False)
+            return True
+        self._pipeline_advance(fl)
         return True
+
+    def _pipeline_step(self) -> bool:
+        fl = self._inflight
+        self._inflight = None
+        self._pipeline_advance(fl)
+        return True
+
+    def _pipeline_advance(self, fl: _Inflight) -> None:
+        """Consume block N with block N+1 already on device: chain the
+        next dispatch off N's device carry FIRST (stream callbacks must
+        never sit between two dispatches), then materialize and emit
+        N's outputs while N+1 runs. Every step thus emits exactly one
+        block -- same cadence as depth-0 -- whether it entered with a
+        fresh dispatch or an in-flight one. Any finish discovered
+        during the consume drains the chained block immediately: a
+        freed slot must never be re-admitted under a still-in-flight
+        stale lane."""
+        n_next = self._pipeline_next(fl)
+        if n_next == 0:
+            self._consume_block(fl, behind=False)
+            return
+        nxt = self._dispatch_chained(fl, n_next)
+        fins = self.requests_finished
+        self._consume_block(fl, behind=True)
+        if self.requests_finished != fins:
+            # Mid-flight finish (EOS before the predicted budget):
+            # drain now; the freed lane's overshoot is discarded whole.
+            self._consume_block(nxt, behind=False)
+        else:
+            self._copy_async(nxt)
+            self._inflight = nxt
+
+    def _pipeline_next(self, fl: _Inflight) -> int:
+        """Size of the block to chain onto an in-flight one, or 0 to
+        drain. Mirrors step()'s own block-size choice under the
+        PREDICTED post-block state (host lengths/generated trail the
+        device by ``fl.n`` until the consume); any event the chained
+        dispatch couldn't honor -- an admission, a constraint turning
+        on, spec eligibility, a predicted in-block finish -- forces a
+        drain back to the sequential path."""
+        if self.pipeline_depth < 1 or not self.active or self.prefilling:
+            return 0
+        if self.free_slots:
+            # A free slot means an admission could arrive between steps
+            # (submit() is async); a block held in flight would delay it
+            # a full block. The pipeline only engages at slot
+            # saturation, where it pays for itself and no admission can
+            # proceed anyway.
+            return 0
+        if any(r.constraint is not None for r in self.active.values()):
+            return 0
+        if self.speculative_k and all(
+            r.temperature <= 0 and r.top_k == 0 and r.top_p >= 1.0
+            and not r.logprobs and r.constraint is None
+            for r in self.active.values()
+        ):
+            return 0  # the drained batch takes the spec path instead
+        n_prev = fl.n
+        rem_pred = min(
+            self.cfg.max_seq - int(self.lengths[slot]) - n_prev
+            for slot in self.active
+        )
+        if rem_pred < 1:
+            return 0
+        if min(
+            req.max_new_tokens - len(req.generated) - n_prev
+            for req in self.active.values()
+        ) <= 0:
+            return 0  # someone exhausts their budget in flight: drain
+        budget_pred = max(
+            req.max_new_tokens - len(req.generated) - n_prev
+            for req in self.active.values()
+        )
+        n = 1
+        while n * 2 <= min(self.decode_block, rem_pred,
+                           max(budget_pred, 1)):
+            n *= 2
+        return n
+
+    def _dispatch_chained(self, fl: _Inflight, n: int) -> _Inflight:
+        """Dispatch block N+1 straight off block N's device carry --
+        tokens and positions never touch the host."""
+        outs, self.cache_k, self.cache_v, last, lens = (
+            self._decode_block_call(
+                n, fl.filtered, fl.want_lp, self.cache_k, self.cache_v,
+                fl.last, fl.lens, self._decode_rng, fl.temps,
+                fl.top_ks, fl.top_ps, fl.nonces,
+            )
+        )
+        return _Inflight(n, outs, last, lens, fl.temps, fl.top_ks,
+                         fl.top_ps, fl.nonces, fl.filtered, fl.want_lp,
+                         fl.slots)
+
+    @staticmethod
+    def _copy_async(fl: _Inflight) -> None:
+        outs = fl.outs if isinstance(fl.outs, tuple) else (fl.outs,)
+        for o in outs:
+            o.copy_to_host_async()
+
+    def _consume_block(self, fl: _Inflight, behind: bool) -> None:
+        """Materialize an in-flight block's outputs (the only blocking
+        host sync of a steady-state pipelined step) and emit them. With
+        ``behind`` a newer block is already queued on device, so this
+        consume opens NO host gap -- record 0 directly; otherwise start
+        the gap clock that the next dispatch closes."""
+        if fl.want_lp:
+            outs = tuple(np.asarray(o) for o in fl.outs)
+        else:
+            outs = np.asarray(fl.outs)
+        if behind:
+            self._ema_gap(0.0)
+        else:
+            self._gap_t = time.perf_counter()
+        self._emit_decode_outs(outs, fl.want_lp, dispatch_slots=fl.slots)
+        if not self.active:
+            # Going idle: time to the next dispatch is queue wait, not
+            # pipeline bubble -- don't count it.
+            self._gap_t = None
+
+    def _note_dispatch(self, decode: bool) -> None:
+        """Called at every device dispatch: closes any open host-gap
+        window (the gauge is 'outputs materialized -> next device
+        work') and counts pure decode blocks for the host-sync audit."""
+        if decode:
+            self.decode_dispatches += 1
+        if self._gap_t is not None:
+            self._ema_gap((time.perf_counter() - self._gap_t) * 1000.0)
+            self._gap_t = None
+
+    def _ema_gap(self, ms: float) -> None:
+        if self.host_gap_ms_ema is None:
+            self.host_gap_ms_ema = ms
+        else:
+            self.host_gap_ms_ema = (
+                0.9 * self.host_gap_ms_ema + 0.1 * ms
+            )
 
     # -- convenience / threaded driver ------------------------------------
 
@@ -2408,19 +2776,17 @@ class GenerationEngine:
         )
         outs = np.asarray(outs)      # [m, B, k+1]
         counts = np.asarray(counts)  # [m, B]
+        width = outs.shape[2]
         for slot in list(self.active):
             req = self.active[slot]
             self.spec_steps += m
             self.spec_emitted += int(counts[:, slot].sum())
-            done = False
-            for si in range(m):
-                for t in range(int(counts[si, slot])):
-                    self._emit(req, int(outs[si, slot, t]))
-                    if slot not in self.active:
-                        done = True  # finished: drop overshoot
-                        break
-                if done:
-                    break
+            # Accepted drafts per step, flattened row-major == exactly
+            # the per-(step, draft) order the nested loop emitted in.
+            keep = np.arange(width)[None, :] < counts[:, slot][:, None]
+            run = outs[:, slot, :][keep]
+            k = self._emit_run(req, run)
+            self.overshoot_tokens_discarded += run.size - k
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 64,
                  temperature: float = 0.0,
@@ -2468,6 +2834,7 @@ class GenerationEngine:
         a dropped engine waits for the cyclic GC while its multi-GB HBM
         buffers stay live, and the next engine OOMs. Unusable after."""
         self.stop()
+        self._inflight = None  # holds device outs + the chain carry
         self.weights = None
         self.cache_k = None
         self.cache_v = None

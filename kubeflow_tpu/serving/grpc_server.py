@@ -225,6 +225,20 @@ class OIPServicer:
             model_name=request.model_name, id=request.id,
         )
         resp.outputs.extend(dict_to_tensor(d) for d in outputs)
+        # Mirror the REST route: engine-backed models annotate the
+        # response with their dispatch-pipeline gauges through the
+        # existing OIP `parameters` map (no proto change needed).
+        try:
+            model = self.repo.get(request.model_name)
+        except InferenceError:
+            model = None  # raced an unload; gauges are best-effort
+        gauges = getattr(model, "engine_gauges", None)
+        if gauges is not None:
+            for key, val in gauges().items():
+                if isinstance(val, float):
+                    resp.parameters[key].double_param = val
+                else:
+                    resp.parameters[key].int64_param = int(val)
         if self.server.payload_logger is not None:
             await self.server._log_response(
                 request.model_name,

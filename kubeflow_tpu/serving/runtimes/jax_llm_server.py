@@ -288,6 +288,9 @@ class JaxLLMModel(Model):
             decode_attn_kernel=bool(opts.get("decode_attn_kernel", False)),
             quantize=opts.get("quantize") or None,
             kv_quant=opts.get("kv_quant") or None,
+            # Overlapped decode dispatch (docs/SERVING.md): 0 restores
+            # the fully sequential dispatch-sync-consume loop.
+            pipeline_depth=int(opts.get("pipeline_depth", 1)),
             mesh=mesh,
         )
         if config is not None:
@@ -332,6 +335,29 @@ class JaxLLMModel(Model):
     def render_chat(self, messages) -> Optional[str]:
         return self.tokenizer.chat_prompt(messages)
 
+    def metadata(self) -> dict:
+        """V2 model metadata plus a live ``engine`` gauges section, so
+        GET /v2/models/{m} answers "is the pipeline actually hiding the
+        host gap" without a Prometheus scrape. The extra key is legal
+        V2 (unknown fields are ignored) and the gRPC ModelMetadata
+        mapper simply drops it."""
+        out = super().metadata()
+        if self.engine is not None:
+            out["engine"] = self.engine_gauges()
+        return out
+
+    def engine_gauges(self) -> dict:
+        """Cheap pipeline gauges (plain attribute reads -- safe on the
+        per-request path, unlike full stats() which walks containers)."""
+        eng = self.engine
+        gap = eng.host_gap_ms_ema
+        return {
+            "dispatch_depth": eng.pipeline_depth,
+            "decode_dispatches": eng.decode_dispatches,
+            "host_gap_ms_ema": round(gap, 3) if gap is not None else 0.0,
+            "overshoot_tokens_discarded": eng.overshoot_tokens_discarded,
+        }
+
     def prom_metrics(self) -> List[str]:
         """Engine observability (SURVEY.md 5.5): scheduler gauges +
         TTFT/ITL histograms, per model."""
@@ -358,6 +384,16 @@ class JaxLLMModel(Model):
             f"{s['tokens_generated']}",
             f"kftpu_engine_requests_finished_total{{{lab}}} "
             f"{s['requests_finished']}",
+            # Dispatch-pipeline gauges: configured depth, EMA of the
+            # host bubble between a block landing and the next dispatch
+            # (~0 when overlapped), and tokens decoded past accepted
+            # streams (EOS/budget overshoot -- discarded by design).
+            f"kftpu_engine_dispatch_depth{{{lab}}} {s['dispatch_depth']}",
+            f"kftpu_engine_decode_dispatches_total{{{lab}}} "
+            f"{s['decode_dispatches']}",
+            f"kftpu_engine_host_gap_ms{{{lab}}} {s['host_gap_ms_ema']}",
+            f"kftpu_engine_overshoot_tokens_total{{{lab}}} "
+            f"{s['overshoot_tokens_discarded']}",
         ]
         if "weight_bytes" in s:
             # Present only when quantized (the int8-footprint gauge; the

@@ -286,6 +286,13 @@ class ModelServer:
             resp = {
                 "model_name": name, "id": body.get("id", ""), "outputs": outputs,
             }
+            # OIP response `parameters` map: live dispatch-pipeline
+            # gauges for engine-backed models (docs/SERVING.md), the
+            # same payload the gRPC ModelInfer response carries. Plain
+            # models expose no gauges and the key stays absent.
+            gauges = getattr(self.repository.get(name), "engine_gauges", None)
+            if gauges is not None:
+                resp["parameters"] = gauges()
             await self._log_response(name, resp, rid)
             return web.json_response(resp)
         except json.JSONDecodeError:

@@ -204,6 +204,50 @@ def test_recompile_watch_sees_shape_driven_recompiles():
     assert len(sigs) == 1 and "[6]" in sigs[0]
 
 
+def test_host_transfer_watch_counts_device_arrays_only():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    x = jnp.zeros((4,), jnp.float32)
+    h = np.zeros((4,), np.float32)
+    with jaxpr_audit.HostTransferWatch() as w:
+        np.asarray(x)      # device -> host: counts
+        np.asarray(h)      # already host-side: free
+        np.array([1, 2])   # fresh host data: free
+    assert w.count == 1
+    with jaxpr_audit.HostTransferWatch() as w2:
+        jax.device_get(x)
+    assert w2.count == 1
+    # Patches restored on exit: plain conversions still work.
+    assert np.asarray(x).shape == (4,)
+
+
+def test_host_sync_audit_catches_midloop_sync():
+    """Non-vacuity for the steady-state sync bound: an engine whose
+    step blocks on an EXTRA device->host transfer per block must be
+    flagged hard. A bound that cannot fail is no bound."""
+    import dataclasses
+
+    import numpy as np
+
+    from kubeflow_tpu.models.llama import PRESETS
+    from kubeflow_tpu.serving.engine import GenerationEngine
+
+    cfg = dataclasses.replace(PRESETS["llama-tiny"], max_seq=64)
+    eng = GenerationEngine(config=cfg, max_slots=2, decode_block=4)
+    orig = eng.step
+
+    def leaky_step():
+        ran = orig()
+        np.asarray(eng.cache_k)  # deliberate mid-loop sync
+        return ran
+
+    eng.step = leaky_step
+    findings, _ = jaxpr_audit.audit_decode_host_syncs(eng)
+    assert any(f.rule == "KT-AUDIT-HOSTSYNC" and f.hard for f in findings)
+
+
 def test_collective_census_empty_for_local_fn():
     import jax.numpy as jnp
 

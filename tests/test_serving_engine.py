@@ -1174,3 +1174,173 @@ class TestKVQuantized:
         for prompt in ([1, 2, 3], list(range(1, 40))):
             assert kern.generate(list(prompt), max_new_tokens=10) == \
                 plain.generate(list(prompt), max_new_tokens=10)
+
+
+class TestDispatchPipeline:
+    """Depth-1 decode dispatch pipeline (docs/SERVING.md): at slot
+    saturation, block N+1 chains off block N's device-resident
+    last-token/length carry BEFORE N's outputs are consumed, so the
+    host-side emission overlaps the chained block's device time.
+    Per-row nonce RNG makes sampling block-partition-invariant, so the
+    contract is BIT-identical streams vs pipeline_depth=0 -- token ids,
+    logprob records, spec stats, everything."""
+
+    @staticmethod
+    def _drive(eng, reqs):
+        futs = [eng.submit(r) for r in reqs]
+        while any(not f.done() for f in futs):
+            eng.step()
+        return [f.result() for f in futs]
+
+    @staticmethod
+    def _count_chained(eng):
+        """Instrument chained dispatches so engagement is asserted, not
+        assumed -- a silently-sequential depth-1 engine would make every
+        equality below vacuous."""
+        box = [0]
+        orig = eng._dispatch_chained
+
+        def counted(fl, n):
+            box[0] += 1
+            return orig(fl, n)
+
+        eng._dispatch_chained = counted
+        return box
+
+    def test_depth1_identical_to_depth0_mixed_batch(self, tiny):
+        """Saturated mixed batch -- greedy, top-k, top-p, logprobs --
+        streams and logprob records must match depth-0 exactly, and the
+        depth-1 engine must actually have pipelined."""
+        cfg, _, _, params = tiny
+
+        def mk():
+            return [
+                Request([1, 2, 3], max_new_tokens=12),
+                Request([4, 5], max_new_tokens=12, temperature=1.0,
+                        top_k=8),
+                Request([6, 7, 8], max_new_tokens=12, temperature=0.9,
+                        top_p=0.9),
+                Request([9], max_new_tokens=12, logprobs=2),
+            ]
+
+        outs, recs, chained = {}, {}, {}
+        for depth in (0, 1):
+            eng = GenerationEngine(config=cfg, params=params, max_slots=4,
+                                   decode_block=4, pipeline_depth=depth)
+            box = self._count_chained(eng)
+            reqs = mk()
+            outs[depth] = self._drive(eng, reqs)
+            recs[depth] = [r.logprob_data for r in reqs]
+            chained[depth] = box[0]
+        assert outs[1] == outs[0]
+        assert recs[1] == recs[0]  # byte-identical record ordering
+        assert chained[0] == 0 and chained[1] > 0
+
+    def test_depth1_identical_spec_path(self, tiny):
+        """A spec-eligible batch drains the pipeline (the chained block
+        can't speculate); streams AND acceptance stats must match."""
+        cfg, _, _, params = tiny
+        got = {}
+        for depth in (0, 1):
+            eng = GenerationEngine(config=cfg, params=params, max_slots=2,
+                                   decode_block=8, speculative_k=2,
+                                   pipeline_depth=depth)
+            o = self._drive(eng, [Request([1, 2, 3], max_new_tokens=16),
+                                  Request([7, 8], max_new_tokens=16)])
+            got[depth] = (o, eng.spec_steps, eng.spec_emitted)
+        assert got[1] == got[0]
+        assert got[1][1] > 0  # the spec path actually ran
+
+    def test_midflight_finish_drains_and_slot_reuse_clean(self, tiny):
+        """EOS lands mid-block while a chained block is in flight: the
+        in-flight block must drain (overshoot discarded whole), the
+        survivor's stream must be untouched, and the freed slot must
+        serve a NEW request correctly -- no stale in-flight lane may
+        ever feed a re-admitted slot."""
+        cfg, _, _, params = tiny
+        ref = GenerationEngine(config=cfg, params=params, max_slots=2,
+                               pipeline_depth=0)
+        probe = ref.generate([4, 5, 6], max_new_tokens=20)
+        eos = probe[8]  # finishes at token 9 of 20: mid-block at block 8
+        got = {}
+        for depth in (0, 1):
+            eng = GenerationEngine(config=cfg, params=params, max_slots=2,
+                                   decode_block=8, pipeline_depth=depth)
+            short = Request([4, 5, 6], max_new_tokens=20, eos_id=eos)
+            long = Request([10, 11], max_new_tokens=30)
+            o = self._drive(eng, [short, long])
+            # Freed slot reuse after a pipelined finish:
+            reuse = eng.generate([4, 5, 6], max_new_tokens=6)
+            got[depth] = (o, reuse, eng.overshoot_tokens_discarded)
+        assert got[1][0] == got[0][0]
+        assert got[1][1] == got[0][1]
+        assert got[0][0][0][-1] == eos  # the EOS really fired mid-run
+        assert got[1][2] >= got[0][2] >= 0
+
+    def test_cancelled_future_midstream_does_not_corrupt_batch(self, tiny):
+        """Cancelling one request's future mid-decode (stop_fn raising /
+        consumer walking away) must not perturb the other lanes under
+        the pipeline."""
+        cfg, _, _, params = tiny
+        got = {}
+        for depth in (0, 1):
+            eng = GenerationEngine(config=cfg, params=params, max_slots=2,
+                                   decode_block=4, pipeline_depth=depth)
+            stopper = Request([4, 5, 6], max_new_tokens=24,
+                              stop_fn=lambda gen: len(gen) >= 5)
+            keeper = Request([10, 11], max_new_tokens=24)
+            o = self._drive(eng, [stopper, keeper])
+            got[depth] = o
+        assert got[1] == got[0]
+        assert len(got[1][0]) == 5
+
+    def test_stats_gauges(self, tiny):
+        cfg, _, _, params = tiny
+        eng = GenerationEngine(config=cfg, params=params, max_slots=2,
+                               decode_block=4, pipeline_depth=1)
+        self._drive(eng, [Request([1, 2], max_new_tokens=12),
+                          Request([3, 4], max_new_tokens=12)])
+        st = eng.stats()
+        assert st["dispatch_depth"] == 1
+        assert st["decode_dispatches"] > 0
+        assert st["host_gap_ms_ema"] >= 0.0
+        assert st["overshoot_tokens_discarded"] >= 0
+        e0 = GenerationEngine(config=cfg, params=params, max_slots=2,
+                              pipeline_depth=0)
+        assert e0.stats()["dispatch_depth"] == 0
+
+    def test_vectorized_emission_matches_per_token_path(self, tiny):
+        """A no-op stop_fn forces the per-token emission loop; without
+        it the vectorized fast path runs. Same engine config, greedy:
+        streams and logprob records must be identical -- the fast path
+        is an optimization, never a semantic."""
+        cfg, _, _, params = tiny
+
+        def run(slow):
+            eng = GenerationEngine(config=cfg, params=params, max_slots=2,
+                                   decode_block=8, pipeline_depth=1)
+            kw = {"stop_fn": (lambda gen: False)} if slow else {}
+            reqs = [Request([1, 2, 3], max_new_tokens=12, logprobs=2, **kw),
+                    Request([4, 5], max_new_tokens=12, **kw)]
+            return self._drive(eng, reqs), [r.logprob_data for r in reqs]
+
+        fast, slow = run(False), run(True)
+        assert fast == slow
+
+    def test_streaming_order_and_counts_under_pipeline(self, tiny):
+        """on_token callbacks fire for every token in stream order in
+        both depths (emission happens at the consume, never between two
+        dispatches -- order is all a callback can observe)."""
+        cfg, _, _, params = tiny
+        got = {}
+        for depth in (0, 1):
+            seen = {0: [], 1: []}
+            eng = GenerationEngine(config=cfg, params=params, max_slots=2,
+                                   decode_block=4, pipeline_depth=depth)
+            reqs = [Request([1, 2, 3], max_new_tokens=10,
+                            on_token=lambda t, i=i: seen[i].append(t))
+                    for i in range(2)]
+            outs = self._drive(eng, reqs)
+            assert seen[0] == outs[0] and seen[1] == outs[1]
+            got[depth] = outs
+        assert got[1] == got[0]
