@@ -174,10 +174,57 @@ def run_config(batch: int, seq: int, steps: int, loss_chunk: int = 0,
     return out
 
 
+def _pop_trace_out():
+    """Strip ``--trace-out PATH`` from argv; returns PATH or None.  When
+    set, tracing is enabled for this run (env-propagated, so the A/B
+    subprocess children dump per-process traces the parent merges)."""
+    if "--trace-out" not in sys.argv:
+        return None
+    i = sys.argv.index("--trace-out")
+    if i + 1 >= len(sys.argv):
+        print("--trace-out requires a path", file=sys.stderr)
+        raise SystemExit(2)
+    path = sys.argv[i + 1]
+    del sys.argv[i:i + 2]
+    from kubeflow_tpu.obs import trace as obs_trace
+
+    os.environ[obs_trace.ENV_TRACE] = "1"
+    os.environ[obs_trace.ENV_TRACE_DIR] = os.path.abspath(path) + ".procs"
+    return path
+
+
+def _merge_trace_out(trace_out, plane_export):
+    """Merge this process's trace with the per-process dumps the
+    children wrote into ``<trace_out>.procs`` -> one Perfetto JSON."""
+    import glob
+
+    from kubeflow_tpu.obs import trace as obs_trace
+
+    docs = [plane_export]
+    for fn in sorted(glob.glob(
+            os.path.join(os.path.abspath(trace_out) + ".procs",
+                         "trace-*.json"))):
+        try:
+            with open(fn) as f:
+                docs.append(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            continue
+    merged = obs_trace.merge(docs)
+    with open(trace_out, "w") as f:
+        json.dump(merged, f)
+    return {"path": os.path.abspath(trace_out),
+            "span_counts": obs_trace.span_counts(merged)}
+
+
 def main() -> int:
     import jax
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    trace_out = _pop_trace_out()
+    from kubeflow_tpu.obs import trace as obs_trace
+
+    obs_trace.activate_from_env(plane="runtime", label="bench")
 
     if len(sys.argv) > 2 and sys.argv[1] == "--ab":
         # A/B child: one config alone in a fresh process, one JSON line.
@@ -188,6 +235,7 @@ def main() -> int:
         kw = {"int8_matmul": True} if sys.argv[2] == "int8" else {}
         print(json.dumps(run_config(
             int(os.environ.get("BENCH_AB_BATCH", "4")), SEQ, STEPS, **kw)))
+        obs_trace.write_process_trace()
         return 0
 
     # int8 (AQT-style) training matmuls A/B (round-4 verdict #4): the
@@ -256,28 +304,28 @@ def main() -> int:
     final_loss = head["final_loss"]
     n_chips = head["n_chips"]
     dt = head["step_time_ms"] / 1e3
-    print(
-        json.dumps(
-            {
-                "metric": f"{PRESET}_train_tokens_per_sec_per_chip",
-                "value": round(per_chip, 1),
-                "unit": "tokens/s/chip",
-                "vs_baseline": round(mfu / 0.50, 3),
-                "extra": {
-                    "mfu": mfu,
-                    "step_time_ms": round(dt * 1e3, 1),
-                    "batch": BATCH,
-                    "seq_len": SEQ,
-                    "n_chips": n_chips,
-                    "params_b": head["params_b"],
-                    "final_loss": final_loss,
-                    "seq_sweep": sweep,
-                    "int8_matmul_ab": int8_ab,
-                    "device": jax.devices()[0].device_kind,
-                },
-            }
-        )
-    )
+    result = {
+        "metric": f"{PRESET}_train_tokens_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.50, 3),
+        "extra": {
+            "mfu": mfu,
+            "step_time_ms": round(dt * 1e3, 1),
+            "batch": BATCH,
+            "seq_len": SEQ,
+            "n_chips": n_chips,
+            "params_b": head["params_b"],
+            "final_loss": final_loss,
+            "seq_sweep": sweep,
+            "int8_matmul_ab": int8_ab,
+            "device": jax.devices()[0].device_kind,
+        },
+    }
+    if trace_out:
+        result["extra"]["trace"] = _merge_trace_out(
+            trace_out, obs_trace.recorder().export())
+    print(json.dumps(result))
     return 0
 
 

@@ -1133,10 +1133,54 @@ def _run_phase(name: str, args: dict, timeout: int = 3000,
         return {"error": _clean_error(f"{type(e).__name__}: {e}")}
 
 
+def _pop_trace_out():
+    """Strip ``--trace-out PATH`` from argv; returns PATH or None.  When
+    set, tracing is env-propagated to every phase subprocess: each child
+    dumps ``trace-serving-<pid>.json`` into ``<PATH>.procs`` and the
+    parent merges them into one Perfetto JSON at PATH."""
+    if "--trace-out" not in sys.argv:
+        return None
+    i = sys.argv.index("--trace-out")
+    if i + 1 >= len(sys.argv):
+        print("--trace-out requires a path", file=sys.stderr)
+        raise SystemExit(2)
+    path = sys.argv[i + 1]
+    del sys.argv[i:i + 2]
+    from kubeflow_tpu.obs import trace as obs_trace
+
+    os.environ[obs_trace.ENV_TRACE] = "1"
+    os.environ[obs_trace.ENV_TRACE_DIR] = os.path.abspath(path) + ".procs"
+    return path
+
+
+def _merge_trace_out(trace_out):
+    import glob
+
+    from kubeflow_tpu.obs import trace as obs_trace
+
+    docs = [obs_trace.recorder().export()]
+    for fn in sorted(glob.glob(
+            os.path.join(os.path.abspath(trace_out) + ".procs",
+                         "trace-*.json"))):
+        try:
+            with open(fn) as f:
+                docs.append(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            continue
+    merged = obs_trace.merge(docs)
+    with open(trace_out, "w") as f:
+        json.dump(merged, f)
+    return {"path": os.path.abspath(trace_out),
+            "span_counts": obs_trace.span_counts(merged)}
+
+
 def main() -> int:
     import jax
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    trace_out = _pop_trace_out()
+    from kubeflow_tpu.obs import trace as obs_trace
 
     if len(sys.argv) > 1 and sys.argv[1] == "--phase":
         if len(sys.argv) < 3:
@@ -1147,7 +1191,10 @@ def main() -> int:
                   "kv_capacity> ['<json-args>']", file=sys.stderr)
             return 2
         args = json.loads(sys.argv[3]) if len(sys.argv) > 3 else {}
+        obs_trace.activate_from_env(
+            plane="serving", label=f"bench-{sys.argv[2]}")
         print(json.dumps(_phase_dispatch(sys.argv[2], args)), flush=True)
+        obs_trace.write_process_trace()
         return 0
 
     runs = []
@@ -1328,6 +1375,8 @@ def main() -> int:
                     "reproduces standalone via --phase.",
         },
     }
+    if trace_out:
+        result["trace"] = _merge_trace_out(trace_out)
     print(json.dumps(result), flush=True)
     here = os.path.dirname(os.path.abspath(__file__))
     with open(os.path.join(here, "SERVING_BENCH.json"), "w") as f:

@@ -225,7 +225,11 @@ class HostTransferWatch:
         return False
 
 
-def audit_decode_host_syncs(eng) -> Tuple[List[Finding], Dict[str, float]]:
+def audit_decode_host_syncs(
+    eng,
+    entry: str = "serve.decode",
+    metric: str = "serve.host_syncs_per_block",
+) -> Tuple[List[Finding], Dict[str, float]]:
     """Steady-state decode must block on the host AT MOST once per
     decode block (the single consume of a landed block's outputs); a
     second sync means an ``np.asarray`` snuck between two dispatches
@@ -256,7 +260,7 @@ def audit_decode_host_syncs(eng) -> Tuple[List[Finding], Dict[str, float]]:
         eng.step()
     if blocks <= 0:
         findings.append(Finding(
-            rule="KT-AUDIT-HOSTSYNC", path="serve.decode", line=0,
+            rule="KT-AUDIT-HOSTSYNC", path=entry, line=0,
             hard=True,
             message="host-sync audit drove no decode blocks; the "
                     "steady-state sync bound was not exercised",
@@ -264,14 +268,37 @@ def audit_decode_host_syncs(eng) -> Tuple[List[Finding], Dict[str, float]]:
         return findings, metrics
     if w.count > blocks:
         findings.append(Finding(
-            rule="KT-AUDIT-HOSTSYNC", path="serve.decode", line=0,
+            rule="KT-AUDIT-HOSTSYNC", path=entry, line=0,
             hard=True,
             message=f"{w.count} blocking host syncs over {blocks} decode "
                     f"blocks at steady state (bound: 1 per block) -- a "
                     f"sync sits between dispatches",
         ))
-    metrics["serve.host_syncs_per_block"] = round(w.count / blocks, 4)
+    metrics[metric] = round(w.count / blocks, 4)
     return findings, metrics
+
+
+def audit_decode_host_syncs_traced(eng) -> Tuple[List[Finding], Dict[str, float]]:
+    """Re-run the steady-state host-sync bound WITH span tracing on.
+
+    The span recorder is required to be consumption-side only: a span
+    around the decode loop must never materialize a ``jax.Array`` (no
+    numpy on device values inside ``_record``). If instrumentation ever
+    regresses into the dispatch path, this audit's
+    ``serve.host_syncs_per_block_traced`` metric rises above the
+    untraced bound and strict mode fails."""
+    from kubeflow_tpu.obs import trace
+
+    was = trace.enabled()
+    trace.configure(enabled=True, plane="serving", label="jaxpr-audit")
+    try:
+        return audit_decode_host_syncs(
+            eng,
+            entry="serve.decode.traced",
+            metric="serve.host_syncs_per_block_traced",
+        )
+    finally:
+        trace.configure(enabled=was)
 
 
 # -- recompile detection ----------------------------------------------------
@@ -482,6 +509,12 @@ def audit_serving_engine() -> Tuple[List[Finding], Dict[str, float]]:
     sync_findings, sync_metrics = audit_decode_host_syncs(eng)
     findings.extend(sync_findings)
     metrics.update(sync_metrics)
+
+    # Same bound with span tracing ON: instrumentation is required to be
+    # consumption-side only, so the traced ratchet must match.
+    traced_findings, traced_metrics = audit_decode_host_syncs_traced(eng)
+    findings.extend(traced_findings)
+    metrics.update(traced_metrics)
     return findings, metrics
 
 

@@ -228,6 +228,58 @@ def cmd_analyze(args, _client) -> int:
     return 0
 
 
+def cmd_trace(args, _client) -> int:
+    """``kftpu trace dump``: merge per-process trace dumps (the
+    ``trace-*.json`` files workers/controllers write into
+    KFTPU_TRACE_DIR) plus live serving ``/debug/trace`` fetches into ONE
+    Chrome trace-event JSON, loadable at https://ui.perfetto.dev."""
+    from kubeflow_tpu.obs import trace as obs_trace
+
+    docs = []
+    tdir = args.dir or os.environ.get(obs_trace.ENV_TRACE_DIR, "")
+    if tdir and os.path.isdir(tdir):
+        for name in sorted(os.listdir(tdir)):
+            if name.startswith("trace-") and name.endswith(".json"):
+                path = os.path.join(tdir, name)
+                try:
+                    with open(path) as f:
+                        docs.append(json.load(f))
+                except (OSError, json.JSONDecodeError) as e:
+                    print(f"skipping {path}: {e}", file=sys.stderr)
+    for url in args.serving:
+        import urllib.request
+
+        if "://" not in url:
+            url = f"http://{url}"
+        if not url.endswith("/debug/trace"):
+            url = url.rstrip("/") + "/debug/trace"
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                docs.append(json.load(r))
+        except Exception as e:  # noqa: BLE001 - a dead replica must not
+            print(f"skipping {url}: {e}", file=sys.stderr)  # kill the dump
+    if not docs:
+        raise SystemExit(
+            "error: no trace documents found -- set KFTPU_TRACE_DIR (or "
+            "--dir) to a directory of trace-*.json dumps, or point "
+            "--serving at a live replica"
+        )
+    merged = obs_trace.merge(docs)
+    if args.out == "-":
+        json.dump(merged, sys.stdout)
+        print()
+        return 0
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    counts = dict(obs_trace.span_counts(merged))
+    total = counts.pop("total", 0)
+    per = ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+    print(f"wrote {args.out}: {len(docs)} document(s), {total} span(s)"
+          + (f" ({per})" if per else ""))
+    print("view: https://ui.perfetto.dev -> Open trace file")
+    return 0
+
+
 def cmd_serve(args, _client) -> int:
     from kubeflow_tpu.server.app import main as server_main
 
@@ -299,6 +351,21 @@ def main(argv=None) -> int:
                     help="baseline path (default: committed baseline.json)")
     sp.set_defaults(fn=cmd_analyze)
 
+    sp = sub.add_parser(
+        "trace", help="distributed trace tools (Perfetto export)"
+    )
+    sp.add_argument("action", choices=("dump",),
+                    help="dump: merge per-process trace-*.json files and "
+                         "live serving /debug/trace into one JSON")
+    sp.add_argument("--dir", default=None,
+                    help="trace dump directory (default: $KFTPU_TRACE_DIR)")
+    sp.add_argument("--serving", action="append", default=[], metavar="URL",
+                    help="serving replica base URL to fetch /debug/trace "
+                         "from (repeatable)")
+    sp.add_argument("--out", default="trace-merged.json",
+                    help="output path ('-' = stdout)")
+    sp.set_defaults(fn=cmd_trace)
+
     sp = sub.add_parser("serve", help="run the control-plane server")
     sp.add_argument("--state-dir", default=os.path.expanduser("~/.kftpu"))
     sp.add_argument("--port", type=int, default=7450)
@@ -306,7 +373,7 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_serve)
 
     args = p.parse_args(argv)
-    local_cmds = ("serve", "analyze")  # no control-plane client needed
+    local_cmds = ("serve", "analyze", "trace")  # no control-plane client needed
     client = TrainingClient(args.server) if args.cmd not in local_cmds else None
     try:
         return args.fn(args, client)

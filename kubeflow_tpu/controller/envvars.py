@@ -17,6 +17,7 @@ from __future__ import annotations
 import json
 
 from kubeflow_tpu.api.types import JobKind, ReplicaType, TrainJob
+from kubeflow_tpu.obs import trace
 
 # Env names for the JAXJob contract, read by kubeflow_tpu.runtime.bootstrap.
 ENV_COORDINATOR = "JAX_COORDINATOR_ADDRESS"
@@ -31,6 +32,13 @@ ENV_RESUME = "KFTPU_RESUME"
 ENV_PROFILE_DIR = "KFTPU_PROFILE_DIR"
 ENV_PROFILE_START = "KFTPU_PROFILE_START"
 ENV_PROFILE_STEPS = "KFTPU_PROFILE_STEPS"
+# Trace-context propagation (kubeflow_tpu.obs.trace): when the
+# controller process records a trace, every spawned worker joins it --
+# same trace id, per-process dump dir -- so one Perfetto timeline shows
+# reconcile -> spawn -> per-step spans.
+ENV_TRACE = "KFTPU_TRACE"
+ENV_TRACE_ID = "KFTPU_TRACE_ID"
+ENV_TRACE_DIR = "KFTPU_TRACE_DIR"
 
 
 def _flat_ranks(job: TrainJob, replicas_override: dict[ReplicaType, int]) -> list[tuple[ReplicaType, int]]:
@@ -92,6 +100,7 @@ def rendezvous_env(
         env[ENV_PROFILE_DIR] = prof.dir or ""
         env[ENV_PROFILE_START] = str(prof.start_step)
         env[ENV_PROFILE_STEPS] = str(prof.num_steps)
+    env.update(trace.propagation_env())
 
     if job.kind == JobKind.JAXJob:
         env.update(

@@ -27,6 +27,7 @@ import os
 import shutil
 import tempfile
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -46,6 +47,8 @@ from kubeflow_tpu.controller.envvars import (
 from kubeflow_tpu.controller.gang import GangScheduler
 from kubeflow_tpu.controller.launcher import BaseLauncher, SpawnRequest, WorkerRef
 from kubeflow_tpu.controller.restarts import should_restart
+from kubeflow_tpu.obs import trace
+from kubeflow_tpu.obs.registry import REGISTRY
 from kubeflow_tpu.utils.ports import allocate_port
 
 logger = logging.getLogger(__name__)
@@ -99,6 +102,11 @@ class _JobRuntime:
 
 
 class JobController:
+    # Bounded per-job event history: a crash-looping job records one
+    # event per restart forever; beyond this many, the oldest Event
+    # objects are garbage-collected from the store.
+    EVENTS_PER_JOB = 128
+
     def __init__(
         self,
         store,
@@ -119,6 +127,9 @@ class JobController:
         self._queued: set[tuple[str, str, str]] = set()
         self._stopped = asyncio.Event()
         self._event_seq = 0
+        # job key -> deque of (event name, namespace) in record order,
+        # for the per-job event GC above.
+        self._job_events: dict[str, deque] = {}
         # Gang-restart crash-loop protection: no respawn before this time.
         self._backoff_until: dict[str, float] = {}
         # Worker-count targets for metric-driven elastic re-formation,
@@ -224,6 +235,13 @@ class JobController:
     # -- reconcile --------------------------------------------------------
 
     async def _reconcile(self, kind: str, namespace: str, name: str) -> None:
+        with trace.span("reconcile", plane="controller", track="reconciler",
+                        kind=kind, job=f"{namespace}/{name}"):
+            await self._reconcile_inner(kind, namespace, name)
+
+    async def _reconcile_inner(
+        self, kind: str, namespace: str, name: str
+    ) -> None:
         obj = self.store.get(kind, name, namespace)
         key = f"{namespace}/{name}"
         if obj is None:
@@ -369,6 +387,15 @@ class JobController:
         return chips <= self.gang.free_chips + freed
 
     async def _try_admit_and_spawn(self, kind: str, job: TrainJob) -> bool:
+        with trace.span("admit+spawn", plane="controller",
+                        track="reconciler", job=job.key) as sp:
+            admitted = await self._try_admit_and_spawn_inner(kind, job)
+            sp.annotate(admitted=admitted)
+            return admitted
+
+    async def _try_admit_and_spawn_inner(
+        self, kind: str, job: TrainJob
+    ) -> bool:
         desired = self._desired_world(job)
         if not desired:
             return False  # zero-replica job: nothing to run (suspended shape)
@@ -688,6 +715,11 @@ class JobController:
         loop = asyncio.get_running_loop()
 
         def check() -> None:
+            with trace.span("hang-check", plane="controller",
+                            track="reconciler", job=job.key):
+                check_inner()
+
+        def check_inner() -> None:
             if self._runtimes.get(job.key) is not rt:
                 return  # torn down or gang-restarted; stale timer
             # Re-read the CURRENT spec each fire: the operator may have
@@ -824,6 +856,11 @@ class JobController:
         reservation, and send it back through admission (where it queues at
         its own priority and later resumes from its latest checkpoint, the
         same path as a gang restart -- SURVEY.md 5.3/5.4)."""
+        with trace.span("evict", plane="controller", track="reconciler",
+                        victim=victim_key, by=by):
+            await self._evict_inner(victim_key, by)
+
+    async def _evict_inner(self, victim_key: str, by: str) -> None:
         ns, name = victim_key.split("/", 1)
         # Preemption must not reset crash-loop protection: teardown pops
         # _backoff_until, but a victim evicted mid-backoff would then
@@ -881,7 +918,9 @@ class JobController:
             workdir=rs.template.workdir,
             exec_=rs.template.exec_,
         )
-        return await self.launcher.spawn(req)
+        with trace.span("spawn", plane="controller", track="reconciler",
+                        worker=f"{job.key}/{rtype.value.lower()}-{index}"):
+            return await self.launcher.spawn(req)
 
     async def _sync_status(
         self, kind: str, job: TrainJob, rt: _JobRuntime, status_before: dict
@@ -986,12 +1025,16 @@ class JobController:
             self.backoff_max,
             self.backoff_base * (2 ** (job.status.restart_count - 1)),
         )
-        await self._teardown(job.key, release=False)
-        self._backoff_until[job.key] = time.time() + delay
-        job.status.set_condition(ConditionType.Restarting, reason, detail)
-        self._record_event(job, reason, detail)
-        self._enqueue_later(delay + 0.01, kind, job.namespace, job.name)
-        self._persist(kind, job, status_before)
+        with trace.span("gang-restart", plane="controller",
+                        track="reconciler", job=job.key, reason=reason,
+                        restart=job.status.restart_count,
+                        backoff_s=round(delay, 3)):
+            await self._teardown(job.key, release=False)
+            self._backoff_until[job.key] = time.time() + delay
+            job.status.set_condition(ConditionType.Restarting, reason, detail)
+            self._record_event(job, reason, detail)
+            self._enqueue_later(delay + 0.01, kind, job.namespace, job.name)
+            self._persist(kind, job, status_before)
 
     async def _handle_hang(
         self, kind: str, job: TrainJob, rt: _JobRuntime, status_before: dict
@@ -1067,22 +1110,25 @@ class JobController:
 
     async def _teardown(self, key: str, release: bool) -> None:
         rt = self._runtimes.pop(key, None)
-        if rt is not None:
-            refs = list(rt.workers.values())
-            rt.workers.clear()  # mark refs stale before killing
-            for ref in refs:
-                await self.launcher.kill(ref)
-            if rt.hostfile_path:
-                try:
-                    os.unlink(rt.hostfile_path)
-                except OSError:
-                    pass
-        if release:
-            self.gang.release(key)
-            self._backoff_until.pop(key, None)
-        # Capacity freed: someone in the queue may now fit, and elastic jobs
-        # formed below spec size may be able to grow.
-        self.kick_pending(exclude=key)
+        with trace.span("teardown", plane="controller", track="reconciler",
+                        job=key, release=release,
+                        workers=len(rt.workers) if rt else 0):
+            if rt is not None:
+                refs = list(rt.workers.values())
+                rt.workers.clear()  # mark refs stale before killing
+                for ref in refs:
+                    await self.launcher.kill(ref)
+                if rt.hostfile_path:
+                    try:
+                        os.unlink(rt.hostfile_path)
+                    except OSError:
+                        pass
+            if release:
+                self.gang.release(key)
+                self._backoff_until.pop(key, None)
+            # Capacity freed: someone in the queue may now fit, and elastic
+            # jobs formed below spec size may be able to grow.
+            self.kick_pending(exclude=key)
 
     def kick_pending(self, exclude: str = "") -> None:
         """Re-enqueue every gang that might now be admissible (called on
@@ -1118,16 +1164,33 @@ class JobController:
 
     def _record_event(self, job: TrainJob, reason: str, message: str) -> None:
         self._event_seq += 1
+        name = f"{job.name}-{self._event_seq}"
         self.store.put(
             "Event",
             {
                 "metadata": {
-                    "name": f"{job.name}-{self._event_seq}",
+                    "name": name,
                     "namespace": job.namespace,
                 },
                 "involved": job.key,
                 "reason": reason,
                 "message": message,
                 "time": time.time(),
+                # Ordering clock: wall time can step backwards (NTP);
+                # event ordering/age math wants CLOCK_MONOTONIC.
+                "monotonic": time.monotonic(),
             },
         )
+        # Bounded history per job: GC the oldest Event objects once a
+        # (typically crash-looping) job exceeds the budget.
+        dq = self._job_events.setdefault(job.key, deque())
+        dq.append((name, job.namespace))
+        while len(dq) > self.EVENTS_PER_JOB:
+            old_name, old_ns = dq.popleft()
+            self.store.delete("Event", old_name, old_ns)
+        REGISTRY.counter(
+            "kftpu_controller_events_total", {"reason": reason}
+        ).inc()
+        # Events double as instant markers on the controller timeline.
+        trace.instant(f"event:{reason}", plane="controller",
+                      track="reconciler", job=job.key, message=message)

@@ -1,0 +1,13 @@
+"""Unified observability plane (ISSUE 5).
+
+``obs.trace`` is the span recorder shared by all three planes
+(controller reconcile loop, training runtime, serving engine): bounded
+ring buffer, context-manager spans, Chrome trace-event JSON export that
+loads in Perfetto. ``obs.registry`` is the one Counter/Gauge/Histogram
+substrate behind every Prometheus exposition the repo emits -- label
+escaping lives in exactly one place.
+"""
+
+from kubeflow_tpu.obs import registry, trace
+
+__all__ = ["registry", "trace"]

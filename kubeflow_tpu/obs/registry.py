@@ -1,0 +1,220 @@
+"""Shared Counter/Gauge/Histogram types + the ONE Prometheus formatter.
+
+Every Prometheus exposition the repo emits (serving /metrics, the
+control-plane server, reconciler event counters, mirrored training step
+metrics) renders through ``sample_line`` below, so label escaping --
+backslash, double quote, newline, per the text-format spec -- lives in
+exactly one place.  The output shape is deliberately identical to the
+hand-formatted lines this module replaced: bare samples, labels joined
+with ``,``, histogram ``le`` bounds stringified from the float bound
+(``le="0.005"``), ``_sum`` at six decimals.  Existing scrapers
+(``hpo/metrics.py``, external Prometheus) see no diff.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+LabelArg = Union[None, str, Mapping[str, Any]]
+
+
+def escape_label_value(v: Any) -> str:
+    """Prometheus text-format label-value escaping.  The single place a
+    label value (e.g. a dynamically admitted model name) is sanitized."""
+    return (str(v).replace("\\", "\\\\")
+            .replace('"', '\\"').replace("\n", "\\n"))
+
+
+def render_labels(labels: LabelArg) -> str:
+    """``k="v",k2="v2"`` (no braces).  Accepts a mapping, an already-
+    rendered string (legacy call sites), or None."""
+    if labels is None:
+        return ""
+    if isinstance(labels, str):
+        return labels
+    return ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in labels.items()
+    )
+
+
+def format_value(v: Any) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float) and v != v:  # NaN
+        return "NaN"
+    return str(v)
+
+
+def sample_line(name: str, labels: LabelArg, value: Any) -> str:
+    lab = render_labels(labels)
+    if lab:
+        return f"{name}{{{lab}}} {format_value(value)}"
+    return f"{name} {format_value(value)}"
+
+
+class Counter:
+    """Monotonic counter.  ``inc`` is a lock-protected add; reads are a
+    plain attribute load (ints are torn-read safe in CPython)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, labels: LabelArg = None,
+                 help: str = "") -> None:
+        self.name = name
+        self.labels = labels
+        self.help = help
+        self.value: Union[int, float] = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: Union[int, float] = 1) -> None:
+        with self._lock:
+            self.value += n
+
+    def lines(self) -> List[str]:
+        return [sample_line(self.name, self.labels, self.value)]
+
+
+class Gauge:
+    """Settable value; optionally pull-based via ``set_fn`` (sampled at
+    exposition time -- how the engine ``stats()`` gauges are ported
+    without a background updater)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, labels: LabelArg = None,
+                 help: str = "") -> None:
+        self.name = name
+        self.labels = labels
+        self.help = help
+        self.value: Union[int, float] = 0
+        self._fn: Optional[Callable[[], Any]] = None
+
+    def set(self, v: Union[int, float]) -> None:
+        self.value = v
+
+    def inc(self, n: Union[int, float] = 1) -> None:
+        self.value += n
+
+    def dec(self, n: Union[int, float] = 1) -> None:
+        self.value -= n
+
+    def set_fn(self, fn: Callable[[], Any]) -> "Gauge":
+        self._fn = fn
+        return self
+
+    def lines(self) -> List[str]:
+        v = self._fn() if self._fn is not None else self.value
+        return [sample_line(self.name, self.labels, v)]
+
+
+class Histogram:
+    """Prometheus cumulative histogram: per-bucket counts, ``_sum`` and
+    ``_count``; allocation-free observe (one list walk)."""
+
+    kind = "histogram"
+
+    def __init__(self, buckets: Sequence[float], name: str = "",
+                 labels: LabelArg = None, help: str = "") -> None:
+        # Upper bounds in ascending order, +Inf implicit.
+        self.buckets: Tuple[float, ...] = tuple(buckets)
+        assert list(self.buckets) == sorted(self.buckets), \
+            "histogram buckets must ascend"
+        self.name = name
+        self.labels = labels
+        self.help = help
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.sum += v
+            self.n += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    def prom_lines(self, name: Optional[str] = None,
+                   labels: LabelArg = None) -> List[str]:
+        """Cumulative exposition.  ``le`` ascends and ``+Inf`` equals
+        ``_count`` by construction."""
+        name = name or self.name
+        lab = render_labels(self.labels if labels is None else labels)
+        sep = f"{lab}," if lab else ""
+        out = []
+        cum = 0
+        for b, c in zip(self.buckets, self.counts):
+            cum += c
+            out.append(f'{name}_bucket{{{sep}le="{b}"}} {cum}')
+        out.append(f'{name}_bucket{{{sep}le="+Inf"}} {self.n}')
+        out.append(sample_line(f"{name}_sum", lab, f"{self.sum:.6f}"))
+        out.append(sample_line(f"{name}_count", lab, self.n))
+        return out
+
+    def lines(self) -> List[str]:
+        return self.prom_lines()
+
+
+class Registry:
+    """Name+labels -> metric store with one exposition walk.
+
+    ``counter``/``gauge``/``histogram`` are get-or-create (idempotent
+    across scrapes/restarts of the owning component); ``expose`` renders
+    every registered metric through the shared formatter in registration
+    order."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[Tuple[str, str], Any] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name: str, labels: LabelArg, help: str, **kw):
+        key = (name, render_labels(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name=name, labels=labels, help=help, **kw)
+                self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, labels: LabelArg = None,
+                help: str = "") -> Counter:
+        return self._get(Counter, name, labels, help)
+
+    def gauge(self, name: str, labels: LabelArg = None,
+              help: str = "") -> Gauge:
+        return self._get(Gauge, name, labels, help)
+
+    def histogram(self, name: str, buckets: Sequence[float],
+                  labels: LabelArg = None, help: str = "") -> Histogram:
+        return self._get(Histogram, name, labels, help, buckets=buckets)
+
+    def register(self, metric) -> None:
+        """Adopt an externally-constructed metric (e.g. an engine-owned
+        histogram) into this registry's exposition."""
+        key = (metric.name, render_labels(metric.labels))
+        with self._lock:
+            self._metrics[key] = metric
+
+    def expose(self) -> List[str]:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.lines())
+        return lines
+
+    def catalog(self) -> List[Tuple[str, str, str]]:
+        """(name, kind, rendered-labels) rows -- docs / debug listing."""
+        with self._lock:
+            return [(m.name, m.kind, render_labels(m.labels))
+                    for m in self._metrics.values()]
+
+
+# Process-wide default registry: runtime step metrics and controller
+# event counters land here.  Serving models keep per-instance registries
+# (their lifetime follows model load/evict).
+REGISTRY = Registry()

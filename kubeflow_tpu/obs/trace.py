@@ -1,0 +1,460 @@
+"""Bounded ring-buffer span recorder with Chrome trace-event export.
+
+One recorder per process, shared by all three planes.  Spans are
+context managers; ``instant`` records point events; nesting flows
+through a contextvar so child spans inherit the enclosing span's plane
+and track without threading state through call signatures.  When
+tracing is disabled, ``span()`` returns a shared no-op object -- the
+whole call is one global load, one attribute check, and a singleton
+return, well under the 2 microsecond budget the serving hot paths
+demand.
+
+Export is Chrome trace-event JSON (the ``traceEvents`` array form)
+loadable in Perfetto / chrome://tracing.  ``pid`` encodes the plane
+(controller=1 / runtime=2 / serving=3, offset by the OS pid so merged
+multi-process traces never collide), ``tid`` is one track per
+component; ``M`` metadata events carry the human-readable names.
+Timestamps come from ``time.perf_counter_ns`` (CLOCK_MONOTONIC --
+system-wide on Linux), so traces exported by the controller, a spawned
+worker, and the serving server merge onto one consistent timeline.
+
+Trace context propagates controller -> worker through the
+``KFTPU_TRACE_*`` env vars (see ``propagation_env`` /
+``activate_from_env``); ``controller/envvars.py`` injects them into
+worker environments and ``runtime/bootstrap.py`` adopts them and opens
+the worker's root span.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+# --------------------------------------------------------------------------
+# Propagation env vars (controller -> worker).
+# --------------------------------------------------------------------------
+ENV_TRACE = "KFTPU_TRACE"            # "1": enable tracing in this process
+ENV_TRACE_ID = "KFTPU_TRACE_ID"      # shared id tying a distributed trace together
+ENV_TRACE_DIR = "KFTPU_TRACE_DIR"    # directory for per-process trace dumps
+ENV_TRACE_BUFFER = "KFTPU_TRACE_BUFFER"  # ring capacity override (events)
+
+DEFAULT_CAPACITY = 65536
+
+# Plane -> pid base.  The OS pid is folded in so two runtime workers (or
+# a controller and a same-plane test process) exporting separately still
+# merge without (pid, tid) collisions.
+_PLANE_IDS = {"controller": 1, "runtime": 2, "serving": 3}
+_OTHER_PLANE_ID = 9
+
+_CURRENT: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "kftpu_trace_current", default=None
+)
+
+
+def _now_us() -> float:
+    return time.perf_counter_ns() / 1000.0
+
+
+class _NullSpan:
+    """Shared no-op returned while tracing is disabled (and for nesting
+    fallbacks): enter/exit do nothing, annotations vanish."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+    def annotate(self, **kw: Any) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """A live duration span: records ``B`` on enter, ``E`` on exit."""
+
+    __slots__ = ("_rec", "name", "plane", "track", "_args", "_token", "_extra")
+
+    def __init__(self, rec: "TraceRecorder", name: str, plane: Optional[str],
+                 track: Optional[str], args: Optional[Dict[str, Any]]) -> None:
+        self._rec = rec
+        self.name = name
+        self.plane = plane
+        self.track = track
+        self._args = args
+        self._token: Optional[contextvars.Token] = None
+        self._extra: Optional[Dict[str, Any]] = None
+
+    def annotate(self, **kw: Any) -> None:
+        """Attach args to the closing ``E`` event (e.g. a drain reason
+        only known at the end of the block)."""
+        if self._extra is None:
+            self._extra = kw
+        else:
+            self._extra.update(kw)
+
+    def __enter__(self) -> "Span":
+        parent = _CURRENT.get()
+        if parent is not None:
+            if self.plane is None:
+                self.plane = parent.plane
+            if self.track is None:
+                self.track = parent.track
+        if self.plane is None:
+            self.plane = self._rec.default_plane
+        if self.track is None:
+            self.track = threading.current_thread().name
+        self._token = _CURRENT.set(self)
+        self._rec._record("B", self.name, self.plane, self.track,
+                          _now_us(), self._args)
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        if self._token is not None:
+            _CURRENT.reset(self._token)
+        self._rec._record("E", self.name, self.plane, self.track,
+                          _now_us(), self._extra)
+        return False
+
+
+class TraceRecorder:
+    """Thread-safe bounded event ring.  All mutation is one deque append
+    under one lock; exports snapshot and sanitize without stopping the
+    recorder."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=max(16, int(capacity)))
+        self._recorded = 0
+        self.enabled = False
+        self.trace_id: Optional[str] = None
+        self.default_plane = "runtime"
+        self.process_label = ""
+
+    # -- recording ---------------------------------------------------------
+    def _record(self, ph: str, name: str, plane: str, track: str,
+                ts: float, args: Optional[Dict[str, Any]]) -> None:
+        with self._lock:
+            self._events.append((ph, name, plane, track, ts, args))
+            self._recorded += 1
+
+    @property
+    def capacity(self) -> int:
+        return self._events.maxlen or 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._recorded - len(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._recorded = 0
+
+    # -- export ------------------------------------------------------------
+    def snapshot(self) -> List[Tuple]:
+        with self._lock:
+            return list(self._events)
+
+    def export(self) -> Dict[str, Any]:
+        """Chrome trace-event JSON (dict form).  The snapshot is
+        sanitized so the structural invariants hold regardless of ring
+        eviction or still-open spans: every ``B`` has a matching ``E``
+        on its tid, orphaned ``E`` events (begin evicted) are dropped,
+        and per-tid timestamps are non-decreasing."""
+        events = sorted(self.snapshot(), key=lambda e: e[4])
+        ospid = os.getpid() % 100000
+        pids: Dict[str, int] = {}
+        tids: Dict[Tuple[str, str], int] = {}
+        out: List[Dict[str, Any]] = []
+        meta: List[Dict[str, Any]] = []
+        open_stacks: Dict[Tuple[str, str], List[Dict[str, Any]]] = {}
+        last_ts = 0.0
+
+        def _pid(plane: str) -> int:
+            if plane not in pids:
+                base = _PLANE_IDS.get(plane, _OTHER_PLANE_ID)
+                pids[plane] = base * 100000 + ospid
+                label = self.process_label or f"pid {os.getpid()}"
+                meta.append({"ph": "M", "name": "process_name",
+                             "pid": pids[plane], "tid": 0,
+                             "args": {"name": f"{plane}: {label}"}})
+                meta.append({"ph": "M", "name": "process_sort_index",
+                             "pid": pids[plane], "tid": 0,
+                             "args": {"sort_index": base}})
+            return pids[plane]
+
+        def _tid(plane: str, track: str) -> int:
+            key = (plane, track)
+            if key not in tids:
+                tids[key] = len(tids) + 1
+                meta.append({"ph": "M", "name": "thread_name",
+                             "pid": _pid(plane), "tid": tids[key],
+                             "args": {"name": track}})
+            return tids[key]
+
+        for ph, name, plane, track, ts, args in events:
+            last_ts = max(last_ts, ts)
+            ev: Dict[str, Any] = {
+                "ph": ph, "name": name, "cat": plane, "ts": ts,
+                "pid": _pid(plane), "tid": _tid(plane, track),
+            }
+            if args:
+                ev["args"] = dict(args)
+            if ph == "B":
+                open_stacks.setdefault((plane, track), []).append(ev)
+            elif ph == "E":
+                stack = open_stacks.get((plane, track))
+                if not stack:
+                    # Begin fell off the ring: an unmatched E would
+                    # break B/E balance -- drop it.
+                    continue
+                stack.pop()
+            elif ph == "i":
+                ev["s"] = "t"
+            out.append(ev)
+        # Close spans still open at export time (root spans of a live
+        # process, the ring snapshotted mid-span).
+        for (plane, track), stack in open_stacks.items():
+            for ev in reversed(stack):
+                out.append({"ph": "E", "name": ev["name"], "cat": plane,
+                            "ts": last_ts, "pid": ev["pid"],
+                            "tid": ev["tid"],
+                            "args": {"truncated": True}})
+        return {
+            "traceEvents": meta + out,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "trace_id": self.trace_id or "",
+                "recorded": self._recorded,
+                "dropped": self.dropped,
+            },
+        }
+
+    def write(self, path: str) -> str:
+        data = self.export()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+        return path
+
+
+_RECORDER = TraceRecorder()
+
+
+# --------------------------------------------------------------------------
+# Module-level API (what instrumentation sites call).
+# --------------------------------------------------------------------------
+def recorder() -> TraceRecorder:
+    return _RECORDER
+
+
+def enabled() -> bool:
+    return _RECORDER.enabled
+
+
+def span(name: str, plane: Optional[str] = None, track: Optional[str] = None,
+         **args: Any):
+    """Context-manager span.  Near-free when tracing is off."""
+    rec = _RECORDER
+    if not rec.enabled:
+        return _NULL_SPAN
+    return Span(rec, name, plane, track, args or None)
+
+
+def instant(name: str, plane: Optional[str] = None,
+            track: Optional[str] = None, ts: Optional[float] = None,
+            **args: Any) -> None:
+    """Point event ('i' phase, thread scope)."""
+    rec = _RECORDER
+    if not rec.enabled:
+        return
+    parent = _CURRENT.get()
+    if parent is not None:
+        plane = plane or parent.plane
+        track = track or parent.track
+    rec._record("i", name, plane or rec.default_plane,
+                track or threading.current_thread().name,
+                _now_us() if ts is None else ts, args or None)
+
+
+def begin(name: str, plane: Optional[str] = None,
+          track: Optional[str] = None, **args: Any) -> None:
+    """Open a span manually (cross-thread pairs, e.g. queue-wait that
+    begins on the submitting thread and ends on the engine thread).
+    Callers own the matching ``end`` on the SAME track; a begin whose
+    end never arrives is closed at export with truncated=True."""
+    rec = _RECORDER
+    if not rec.enabled:
+        return
+    rec._record("B", name, plane or rec.default_plane,
+                track or threading.current_thread().name, _now_us(),
+                args or None)
+
+
+def end(name: str, plane: Optional[str] = None,
+        track: Optional[str] = None, **args: Any) -> None:
+    rec = _RECORDER
+    if not rec.enabled:
+        return
+    rec._record("E", name, plane or rec.default_plane,
+                track or threading.current_thread().name, _now_us(),
+                args or None)
+
+
+def current_span():
+    """The innermost live span in this context (None when untracked)."""
+    return _CURRENT.get()
+
+
+def configure(enabled: Optional[bool] = None, plane: Optional[str] = None,
+              label: Optional[str] = None, capacity: Optional[int] = None,
+              trace_id: Optional[str] = None) -> TraceRecorder:
+    rec = _RECORDER
+    if capacity is not None and capacity != rec.capacity:
+        with rec._lock:
+            rec._events = deque(rec._events, maxlen=max(16, int(capacity)))
+    if plane is not None:
+        rec.default_plane = plane
+    if label is not None:
+        rec.process_label = label
+    if trace_id is not None:
+        rec.trace_id = trace_id
+    if enabled is not None:
+        if enabled and rec.trace_id is None:
+            rec.trace_id = new_trace_id()
+        rec.enabled = bool(enabled)
+    return rec
+
+
+def reset() -> None:
+    """Test hook: drop all state (including a capacity override) and
+    disable."""
+    rec = _RECORDER
+    rec.enabled = False
+    rec.trace_id = None
+    rec.default_plane = "runtime"
+    rec.process_label = ""
+    with rec._lock:
+        rec._events = deque(maxlen=DEFAULT_CAPACITY)
+        rec._recorded = 0
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def trace_id() -> Optional[str]:
+    return _RECORDER.trace_id
+
+
+# --------------------------------------------------------------------------
+# Cross-process propagation.
+# --------------------------------------------------------------------------
+def propagation_env() -> Dict[str, str]:
+    """Env vars a parent injects into children so one distributed trace
+    spans controller -> worker.  Empty when tracing is off."""
+    rec = _RECORDER
+    if not rec.enabled:
+        return {}
+    env = {ENV_TRACE: "1", ENV_TRACE_ID: rec.trace_id or new_trace_id()}
+    tdir = os.environ.get(ENV_TRACE_DIR)
+    if tdir:
+        env[ENV_TRACE_DIR] = tdir
+    return env
+
+
+def activate_from_env(environ=None, plane: str = "runtime",
+                      label: str = "") -> bool:
+    """Adopt trace context from the environment (worker side).  Returns
+    True when tracing was switched on."""
+    environ = os.environ if environ is None else environ
+    if environ.get(ENV_TRACE) != "1":
+        return False
+    cap = None
+    raw = environ.get(ENV_TRACE_BUFFER)
+    if raw:
+        try:
+            cap = int(raw)
+        except ValueError:
+            cap = None
+    configure(enabled=True, plane=plane, label=label, capacity=cap,
+              trace_id=environ.get(ENV_TRACE_ID) or None)
+    return True
+
+
+def dump_dir(environ=None) -> Optional[str]:
+    environ = os.environ if environ is None else environ
+    return environ.get(ENV_TRACE_DIR) or None
+
+
+def write_process_trace(environ=None, name: Optional[str] = None) -> Optional[str]:
+    """Write this process's trace into KFTPU_TRACE_DIR (if configured and
+    tracing is on).  Workers call this at exit so ``kftpu trace dump``
+    can merge per-process files into one timeline."""
+    rec = _RECORDER
+    if not rec.enabled:
+        return None
+    tdir = dump_dir(environ)
+    if not tdir:
+        return None
+    fname = name or f"trace-{rec.default_plane}-{os.getpid()}.json"
+    return rec.write(os.path.join(tdir, fname))
+
+
+# --------------------------------------------------------------------------
+# Merging (``kftpu trace dump``).
+# --------------------------------------------------------------------------
+def merge(documents: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Concatenate exported trace documents into one.  Per-process pid
+    offsets make this collision-free; perf_counter timestamps share
+    CLOCK_MONOTONIC so the merged timeline is consistent on one host."""
+    events: List[Dict[str, Any]] = []
+    ids: List[str] = []
+    recorded = dropped = 0
+    for doc in documents:
+        events.extend(doc.get("traceEvents", []))
+        other = doc.get("otherData", {})
+        tid = other.get("trace_id")
+        if tid and tid not in ids:
+            ids.append(tid)
+        recorded += int(other.get("recorded", 0))
+        dropped += int(other.get("dropped", 0))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"trace_id": ",".join(ids), "recorded": recorded,
+                      "dropped": dropped},
+    }
+
+
+def span_counts(doc: Dict[str, Any]) -> Dict[str, int]:
+    """Per-plane completed-span counts for a trace document (used by the
+    bench --trace-out summaries)."""
+    counts: Dict[str, int] = {}
+    total = 0
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") == "B":
+            counts[ev.get("cat", "?")] = counts.get(ev.get("cat", "?"), 0) + 1
+            total += 1
+    counts["total"] = total
+    return counts

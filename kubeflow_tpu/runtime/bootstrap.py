@@ -15,6 +15,8 @@ import logging
 import os
 from typing import Optional
 
+from kubeflow_tpu.obs import trace
+
 logger = logging.getLogger(__name__)
 
 
@@ -33,6 +35,10 @@ class WorkerContext:
     profile_dir: Optional[str] = None
     profile_start: int = 0
     profile_steps: int = 0
+    # Trace context adopted from KFTPU_TRACE_* (obs.trace): tracing=True
+    # means this worker records spans into the controller's trace id.
+    tracing: bool = False
+    trace_id: Optional[str] = None
 
     @property
     def is_coordinator(self) -> bool:
@@ -54,6 +60,8 @@ def read_context() -> WorkerContext:
         profile_dir=env.get("KFTPU_PROFILE_DIR") or None,
         profile_start=int(env.get("KFTPU_PROFILE_START", "0")),
         profile_steps=int(env.get("KFTPU_PROFILE_STEPS", "0")),
+        tracing=env.get(trace.ENV_TRACE) == "1",
+        trace_id=env.get(trace.ENV_TRACE_ID) or None,
     )
 
 
@@ -65,6 +73,21 @@ def initialize(ctx: Optional[WorkerContext] = None) -> WorkerContext:
     transport to configure; the mesh + pjit handle the rest.
     """
     ctx = ctx or read_context()
+    if ctx.tracing:
+        # Join the controller's trace: same id, runtime plane, one root
+        # span that parents everything this worker records.  The root
+        # stays open for the process lifetime; export closes it.
+        trace.activate_from_env(
+            plane="runtime",
+            label=f"{ctx.job_name}/{ctx.replica_type.lower()}-"
+                  f"{ctx.replica_index}",
+        )
+        root = trace.span(
+            "worker", plane="runtime", track="train-loop",
+            job=ctx.job_name, replica=ctx.replica_index,
+            replica_type=ctx.replica_type, process_id=ctx.process_id,
+        )
+        root.__enter__()
     if ctx.num_processes > 1:
         import jax
 
@@ -72,9 +95,12 @@ def initialize(ctx: Optional[WorkerContext] = None) -> WorkerContext:
             "jax.distributed.initialize coordinator=%s procs=%d id=%d",
             ctx.coordinator, ctx.num_processes, ctx.process_id,
         )
-        jax.distributed.initialize(
-            coordinator_address=ctx.coordinator,
-            num_processes=ctx.num_processes,
-            process_id=ctx.process_id,
-        )
+        with trace.span("jax.distributed.initialize", plane="runtime",
+                        coordinator=ctx.coordinator or "",
+                        procs=ctx.num_processes):
+            jax.distributed.initialize(
+                coordinator_address=ctx.coordinator,
+                num_processes=ctx.num_processes,
+                process_id=ctx.process_id,
+            )
     return ctx

@@ -13,6 +13,8 @@ import logging
 import os
 from typing import Any, Optional
 
+from kubeflow_tpu.obs import trace
+
 logger = logging.getLogger(__name__)
 
 
@@ -50,9 +52,15 @@ class Checkpointer:
             return False
         import orbax.checkpoint as ocp
 
-        return self._mgr.save(
-            step, args=ocp.args.StandardSave(state), force=force
-        )
+        # Async save: this span covers the dispatch, not the background
+        # write -- the visible cost the step loop actually pays.
+        with trace.span("ckpt.save", plane="runtime", step=step,
+                        force=force) as sp:
+            saved = self._mgr.save(
+                step, args=ocp.args.StandardSave(state), force=force
+            )
+            sp.annotate(saved=bool(saved))
+        return saved
 
     def restore(self, step: Optional[int], target: Any) -> Any:
         """Restore ``step`` (or latest) into the sharding/structure of
@@ -65,9 +73,10 @@ class Checkpointer:
         import orbax.checkpoint as ocp
 
         logger.info("restoring checkpoint step=%d from %s", step, self.directory)
-        return self._mgr.restore(
-            step, args=ocp.args.StandardRestore(target)
-        )
+        with trace.span("ckpt.restore", plane="runtime", step=int(step)):
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(target)
+            )
 
     def wait(self) -> None:
         if self._mgr:

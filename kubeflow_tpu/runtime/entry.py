@@ -17,6 +17,8 @@ import logging
 import os
 import sys
 
+from kubeflow_tpu.obs import trace
+
 logger = logging.getLogger(__name__)
 
 
@@ -153,42 +155,52 @@ def main(argv=None) -> int:
         data = task.data_iter(ctx.num_processes, ctx.process_id, mesh, args.seed)
         metrics = {}
         for step in range(start_step, args.steps):
-            # >= not ==: a checkpoint resume landing inside (or past the
-            # start of) the window still traces the remaining steps.
-            if (profiling and not prof_active
-                    and step >= ctx.profile_start
-                    and step < ctx.profile_start + ctx.profile_steps):
-                os.makedirs(profile_dir, exist_ok=True)
-                jax.profiler.start_trace(profile_dir)
-                prof_active = True
-                mlog.emit(event="profile_start", step=step, dir=profile_dir)
-            batch = next(data)
-            # Transient-fault semantics: the injected death fires only in a
-            # fresh (non-resumed) incarnation, so restart+resume recovers --
-            # the scenario SURVEY.md 5.3 tests. A permanent fault is just a
-            # crashing entrypoint; backoff_limit covers that path.
-            if (step == fault_step and ctx.process_id == fault_rank
-                    and start_step == 0):
-                logger.error("fault injection: rank %d dying at step %d",
-                             ctx.process_id, step)
-                ckpt.wait()
-                os._exit(137)
-            state, metrics = step_fn(state, *batch)
-            if prof_active and step >= ctx.profile_start + ctx.profile_steps - 1:
-                # Sync so the trace includes real device work, not just
-                # dispatch (transfer = sync on this backend, bench.py note).
-                float(metrics["loss"])
-                jax.profiler.stop_trace()
-                prof_active = False
-                mlog.emit(event="profile_end", step=step, dir=profile_dir)
-            ckpt.maybe_save(step, state)
-            if step % args.log_every == 0 or step == args.steps - 1:
-                mlog.log_step(
-                    step, float(metrics["loss"]),
-                    tokens=task.tokens_per_step,
-                    **{k: f"{float(v):.4f}" for k, v in metrics.items()
-                       if k != "loss"},
-                )
+            with trace.span("step", plane="runtime", step=step):
+                # >= not ==: a checkpoint resume landing inside (or past the
+                # start of) the window still traces the remaining steps.
+                if (profiling and not prof_active
+                        and step >= ctx.profile_start
+                        and step < ctx.profile_start + ctx.profile_steps):
+                    os.makedirs(profile_dir, exist_ok=True)
+                    jax.profiler.start_trace(profile_dir)
+                    prof_active = True
+                    mlog.emit(event="profile_start", step=step,
+                              dir=profile_dir)
+                with trace.span("data-wait"):
+                    batch = next(data)
+                # Transient-fault semantics: the injected death fires only
+                # in a fresh (non-resumed) incarnation, so restart+resume
+                # recovers -- the scenario SURVEY.md 5.3 tests. A permanent
+                # fault is just a crashing entrypoint; backoff_limit covers
+                # that path.
+                if (step == fault_step and ctx.process_id == fault_rank
+                        and start_step == 0):
+                    logger.error("fault injection: rank %d dying at step %d",
+                                 ctx.process_id, step)
+                    ckpt.wait()
+                    os._exit(137)
+                with trace.span("dispatch"):
+                    state, metrics = step_fn(state, *batch)
+                if (prof_active
+                        and step >= ctx.profile_start + ctx.profile_steps - 1):
+                    # Sync so the trace includes real device work, not just
+                    # dispatch (transfer = sync on this backend, bench.py
+                    # note).
+                    float(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    prof_active = False
+                    mlog.emit(event="profile_end", step=step,
+                              dir=profile_dir)
+                ckpt.maybe_save(step, state)
+                if step % args.log_every == 0 or step == args.steps - 1:
+                    # The float() is where the host blocks on the device
+                    # step -- the device-sync share of the breakdown.
+                    with trace.span("device-sync"):
+                        loss = float(metrics["loss"])
+                        extra = {k: f"{float(v):.4f}"
+                                 for k, v in metrics.items() if k != "loss"}
+                    mlog.log_step(step, loss, tokens=task.tokens_per_step,
+                                  **extra)
         if prof_active:  # window extended past the last step
             jax.profiler.stop_trace()
             mlog.emit(event="profile_end", step=args.steps - 1, dir=profile_dir)
@@ -198,6 +210,9 @@ def main(argv=None) -> int:
         final_loss = float(metrics["loss"]) if metrics else float("nan")
         mlog.emit(event="train_end", final_step=args.steps - 1,
                   final_loss=f"{final_loss:.6f}")
+    # Per-process trace dump (KFTPU_TRACE_DIR): merged by `kftpu trace
+    # dump` into the controller's timeline.
+    trace.write_process_trace()
     return 0
 
 

@@ -15,6 +15,9 @@ import sys
 import time
 from typing import Optional, TextIO
 
+from kubeflow_tpu.obs import registry as _obs_registry
+from kubeflow_tpu.obs import trace as _obs_trace
+
 PREFIX = "KFTPU-METRIC"
 _LINE_RE = re.compile(rf"^{PREFIX}\s+(.*)$")
 _KV_RE = re.compile(r"([A-Za-z0-9_./-]+)=([^\s]+)")
@@ -68,6 +71,12 @@ class MetricLogger:
             return
         now = time.perf_counter()
         fields = {"step": step, "loss": f"{loss:.6f}"}
+        # Mirror into the shared metrics registry (obs.registry): same
+        # numbers a Prometheus scrape of this process would see.  The
+        # KFTPU-METRIC stdout line below stays the HPO contract.
+        gauge = _obs_registry.REGISTRY.gauge
+        gauge("kftpu_train_step").set(step)
+        gauge("kftpu_train_loss").set(loss)
         if self._last_time is not None and self._last_step is not None and tokens:
             dsteps = max(step - self._last_step, 1)
             dt = now - self._last_time
@@ -75,11 +84,14 @@ class MetricLogger:
             fields["tokens_per_sec"] = f"{tps:.1f}"
             fields["tokens_per_sec_per_chip"] = f"{tps / self.n_chips:.1f}"
             fields["step_time_ms"] = f"{dt * 1e3 / dsteps:.1f}"
+            gauge("kftpu_train_tokens_per_sec").set(round(tps, 1))
+            gauge("kftpu_train_step_time_ms").set(round(dt * 1e3 / dsteps, 1))
             if self.flops_per_token:
                 if self.peak is None:
                     self.peak = peak_flops_per_chip()
                 mfu = (tps * self.flops_per_token) / (self.peak * self.n_chips)
                 fields["mfu"] = f"{mfu:.4f}"
+                gauge("kftpu_train_mfu").set(round(mfu, 4))
         self._last_time = now
         self._last_step = step
         fields.update({k: v for k, v in extra.items()})
@@ -88,6 +100,13 @@ class MetricLogger:
     def emit(self, **fields) -> None:
         if not self.enabled:
             return
+        # Tie stdout metric lines to the active trace: trace_id is one
+        # more k=v token, matched by the same _KV_RE the HPO collector
+        # already uses -- the line grammar does not move.
+        if _obs_trace.enabled() and "trace_id" not in fields:
+            tid = _obs_trace.trace_id()
+            if tid:
+                fields["trace_id"] = tid
         body = " ".join(f"{k}={v}" for k, v in fields.items())
         print(f"{PREFIX} {body}", file=self.stream, flush=True)
 

@@ -34,6 +34,7 @@ from kubeflow_tpu.controller import GangScheduler, JobController, ProcessLaunche
 from kubeflow_tpu.hpo import HPOController
 from kubeflow_tpu.hpo.obsdb import ObservationDB
 from kubeflow_tpu.hpo.types import Experiment, validate_experiment
+from kubeflow_tpu.obs import registry as obs_registry
 from kubeflow_tpu.server import webapps as _webapps
 from kubeflow_tpu.platform import (
     PlatformValidationError,
@@ -183,6 +184,7 @@ class ControlPlane:
                 web.get("/observations/{ns}/{name}", self.h_observations),
                 web.get("/healthz", self.h_healthz),
                 web.get("/metrics", self.h_metrics),
+                web.get("/debug/trace", self.h_debug_trace),
                 # Central-dashboard equivalent (P5): one page over /apis/.
                 web.get("/dashboard", self.h_dashboard),
                 web.get("/", self.h_dashboard),
@@ -901,17 +903,28 @@ class ControlPlane:
     async def h_healthz(self, req: web.Request) -> web.Response:
         return web.json_response({"ok": True, "uptime": time.time() - self.started_at})
 
+    async def h_debug_trace(self, req: web.Request) -> web.Response:
+        """Live Chrome trace-event export of this process's span ring
+        (controller plane); `kftpu trace dump --serving` merges it."""
+        from kubeflow_tpu.obs import trace as obs_trace
+
+        return web.json_response(obs_trace.recorder().export())
+
     async def h_metrics(self, req: web.Request) -> web.Response:
+        sample = obs_registry.sample_line
         lines = [
-            f"kftpu_chips_total {self.gang.total_chips}",
-            f"kftpu_chips_used {self.gang.used_chips}",
-            f"kftpu_gangs_pending {len(self.gang.pending())}",
-            f"kftpu_uptime_seconds {time.time() - self.started_at:.0f}",
+            sample("kftpu_chips_total", None, self.gang.total_chips),
+            sample("kftpu_chips_used", None, self.gang.used_chips),
+            sample("kftpu_gangs_pending", None, len(self.gang.pending())),
+            sample("kftpu_uptime_seconds", None,
+                   f"{time.time() - self.started_at:.0f}"),
         ]
         for kind in self.store.kinds():
-            lines.append(
-                f'kftpu_objects{{kind="{kind}"}} {len(self.store.list(kind))}'
-            )
+            lines.append(sample("kftpu_objects", {"kind": kind},
+                                len(self.store.list(kind))))
+        # Process-wide registry: reconciler event counters (and anything
+        # else this process registered) share the scrape.
+        lines.extend(obs_registry.REGISTRY.expose())
         return web.Response(text="\n".join(lines) + "\n")
 
 
@@ -1117,6 +1130,12 @@ def main(argv=None) -> int:
                            "defaulting to 1", e)
             chips = 1
 
+    # Adopt KFTPU_TRACE_* so reconcile/spawn/evict spans record in this
+    # process; workers and replicas inherit the context via spawn env.
+    from kubeflow_tpu.obs import trace as obs_trace
+
+    obs_trace.activate_from_env(plane="controller", label="control-plane")
+
     cp = ControlPlane(args.state_dir, total_chips=chips)
     # Transformer replicas call predictors back through this ingress;
     # wildcard binds are not dialable, so point callbacks at loopback.
@@ -1130,6 +1149,9 @@ def main(argv=None) -> int:
         args.host, args.port, args.state_dir, chips,
     )
     web.run_app(app, host=args.host, port=args.port, print=None)
+    # Graceful shutdown: drop this process's spans where `kftpu trace
+    # dump` merges them.
+    obs_trace.write_process_trace()
     return 0
 
 

@@ -39,6 +39,7 @@ import aiohttp
 from aiohttp import web
 
 from kubeflow_tpu.controller.launcher import BaseLauncher, SpawnRequest, WorkerRef
+from kubeflow_tpu.obs import trace
 from kubeflow_tpu.serving.types import (
     KIND,
     TRAINED_MODEL_KIND,
@@ -967,6 +968,9 @@ class ISVCController:
         ns, name = isvc.metadata.namespace, isvc.metadata.name
         service_key = service_key or f"{ns}/{name}"
         env = {"PORT": str(port)}
+        # Trace context rides into serving replicas exactly as it does
+        # into training workers (controller/envvars.py).
+        env.update(trace.propagation_env())
         if service_key.endswith((TRANSFORMER_SUFFIX, EXPLAINER_SUFFIX)):
             # Transformer/explainer processes call the predictor back
             # through the activator (scale-from-zero applies), pinned to

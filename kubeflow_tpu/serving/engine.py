@@ -58,6 +58,8 @@ from kubeflow_tpu.models.llama import (
     Llama,
     rope_frequencies,
 )
+from kubeflow_tpu.obs import registry as obs_registry
+from kubeflow_tpu.obs import trace
 
 logger = logging.getLogger(__name__)
 
@@ -1265,40 +1267,18 @@ def _spec_block(cfg: LlamaConfig, m_steps: int, k_draft: int, w: dict,
 # ---------------------------------------------------------------------------
 
 
-class LatencyHistogram:
-    """Prometheus-style cumulative histogram, host-side and allocation
-    free on the hot path (one list walk per observe)."""
+class LatencyHistogram(obs_registry.Histogram):
+    """Serving latency histogram on the shared obs.registry.Histogram
+    (ms-derived second buckets; the ``le`` strings -- "0.005", "0.01",
+    ... -- are bit-identical to the pre-port format). Kept as a named
+    subclass so engine call sites read as before and the bucket ladder
+    stays a serving-owned constant."""
 
     BUCKETS_MS = (5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
                   2500.0, 5000.0)
 
     def __init__(self) -> None:
-        self.counts = [0] * (len(self.BUCKETS_MS) + 1)
-        self.sum = 0.0
-        self.n = 0
-
-    def observe(self, seconds: float) -> None:
-        ms = seconds * 1000.0
-        self.sum += seconds
-        self.n += 1
-        for i, b in enumerate(self.BUCKETS_MS):
-            if ms <= b:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
-
-    def prom_lines(self, name: str, labels: str) -> List[str]:
-        out = []
-        cum = 0
-        for b, c in zip(self.BUCKETS_MS, self.counts):
-            cum += c
-            out.append(
-                f'{name}_bucket{{{labels},le="{b / 1000.0}"}} {cum}'
-            )
-        out.append(f'{name}_bucket{{{labels},le="+Inf"}} {self.n}')
-        out.append(f"{name}_sum{{{labels}}} {self.sum:.6f}")
-        out.append(f"{name}_count{{{labels}}} {self.n}")
-        return out
+        super().__init__(tuple(b / 1000.0 for b in self.BUCKETS_MS))
 
 
 class PrefixCache:
@@ -1943,6 +1923,7 @@ class GenerationEngine:
             jax.random.PRNGKey(seed), 0xDEC0DE
         )
         self._inflight = None  # _InflightBlock | None
+        self._drain_reason = ""  # why _pipeline_next last returned 0
         self._gap_t: Optional[float] = None
         self.decode_dispatches = 0
         self.host_gap_ms_ema: Optional[float] = None
@@ -1964,6 +1945,13 @@ class GenerationEngine:
             return req.future
         req.submit_t = time.perf_counter()
         req.nonce = next(self._req_counter)
+        if trace.enabled():
+            # Cross-thread span: B here (submitter), E in _admit (engine
+            # thread) -- same explicit per-request track keeps the pair
+            # balanced under async interleaving.
+            trace.begin("queue-wait", plane="serving",
+                        track=f"req/{req.nonce}", nonce=req.nonce,
+                        prompt_len=len(req.prompt))
         self.pending.put(req)
         self._wake.set()
         return req.future
@@ -1985,6 +1973,13 @@ class GenerationEngine:
         every sequence's KV into its slot. Serial per-prompt prefill was
         the throughput bottleneck at high request rates (one dispatch +
         an underfilled MXU per prompt)."""
+        if not (self.free_slots and (
+                self._backlog or not self.pending.empty())):
+            return  # nothing to admit: no span either (step() calls every tick)
+        with trace.span("admit", plane="serving", track="engine"):
+            self._admit_batches()
+
+    def _admit_batches(self) -> None:
         while self.free_slots and (
             self._backlog or not self.pending.empty()
         ):
@@ -2000,6 +1995,9 @@ class GenerationEngine:
                     except queue.Empty:
                         break
                 if req.future.cancelled():
+                    if trace.enabled():
+                        trace.end("queue-wait", plane="serving",
+                                  track=f"req/{req.nonce}", cancelled=True)
                     continue
                 if self.prefix_cache is not None:
                     # Longest cached block-aligned prefix, capped at
@@ -2010,10 +2008,18 @@ class GenerationEngine:
                     )
                     if plen:
                         slot = self.free_slots.pop()
-                        self.cache_k, self.cache_v = self._restore_call(
-                            self.cache_k, self.cache_v, entry["k"],
-                            entry["v"], jnp.int32(slot), plen,
-                        )
+                        if trace.enabled():
+                            trace.end("queue-wait", plane="serving",
+                                      track=f"req/{req.nonce}")
+                            trace.instant("prefix-cache.hit",
+                                          plane="serving", track="engine",
+                                          nonce=req.nonce, plen=plen)
+                        with trace.span("prefix.restore", plane="serving",
+                                        track="engine", plen=plen):
+                            self.cache_k, self.cache_v = self._restore_call(
+                                self.cache_k, self.cache_v, entry["k"],
+                                entry["v"], jnp.int32(slot), plen,
+                            )
                         req.slot = slot
                         req.prefilled = plen
                         self.prefilling[slot] = req
@@ -2025,6 +2031,9 @@ class GenerationEngine:
                     # chunk across steps (_fused_step) so admission
                     # never stalls decoding slots for the whole prompt.
                     req.slot = self.free_slots.pop()
+                    if trace.enabled():
+                        trace.end("queue-wait", plane="serving",
+                                  track=f"req/{req.nonce}", chunked=True)
                     req.prefilled = 0
                     self.prefilling[req.slot] = req
                     took_chunked = True
@@ -2038,9 +2047,13 @@ class GenerationEngine:
                     s = max(self._bucket(len(r.prompt))
                             for r in reqs + [req])
                     if k * s > self.max_prefill_tokens:
+                        # Still queued: its queue-wait span stays open.
                         self._backlog.insert(0, req)
                         deferred = True
                         break
+                if trace.enabled():
+                    trace.end("queue-wait", plane="serving",
+                              track=f"req/{req.nonce}")
                 reqs.append(req)
             if not reqs:
                 if took_chunked or deferred:
@@ -2049,51 +2062,54 @@ class GenerationEngine:
             k_real = len(reqs)
             kbucket = _pow2_bucket(k_real)
             bucket = max(self._bucket(len(r.prompt)) for r in reqs)
-            padded = np.zeros((kbucket, bucket), np.int32)
-            lengths = np.ones(kbucket, np.int32)  # dummy rows: 1 token
-            for j, r in enumerate(reqs):
-                padded[j, : len(r.prompt)] = r.prompt
-                lengths[j] = len(r.prompt)
-            logits, ks, vs = self._prefill(jnp.asarray(padded), lengths)
-            slots = [self.free_slots.pop() for _ in reqs]
-            # Keep kbucket shapes end-to-end (bounded compile count):
-            # dummy rows scatter to an out-of-range slot (dropped) and
-            # sample greedily into a discarded lane.
-            padded_slots = np.full(kbucket, self.max_slots, np.int32)
-            padded_slots[:k_real] = slots
-            self.cache_k, self.cache_v = self._insert(
-                self.cache_k, self.cache_v, ks, vs,
-                jnp.asarray(padded_slots),
-            )
-            temps = np.zeros(kbucket, np.float32)
-            top_ks = np.zeros(kbucket, np.int32)
-            top_ps = np.ones(kbucket, np.float32)
-            for j, r in enumerate(reqs):
-                temps[j] = r.temperature
-                top_ks[j] = r.top_k
-                top_ps[j] = r.top_p
-            first = np.asarray(self._sample(
-                logits, self._next_rng(), jnp.asarray(temps),
-                top_ks, top_ps,
-            ))
-            logits_np = None
-            for j, (req, slot) in enumerate(zip(reqs, slots)):
-                req.slot = slot
-                self.lengths[slot] = len(req.prompt)
-                if self.hist is not None:
-                    self.hist[slot, :len(req.prompt)] = req.prompt
-                self.active[slot] = req
-                self._maybe_capture_prefix(req)
-                if req.logprobs or req.constraint is not None:
-                    if logits_np is None:
-                        logits_np = np.asarray(logits, np.float32)
-                tok = (self._host_first_token(logits_np[j], req)
-                       if req.constraint is not None else int(first[j]))
-                if req.logprobs:
-                    req.logprob_data.append(_host_logprobs(
-                        logits_np[j], tok, req.logprobs
-                    ))
-                self._emit(req, tok)
+            with trace.span("prefill.batch", plane="serving",
+                            track="engine", k=k_real, kbucket=kbucket,
+                            bucket=bucket):
+                padded = np.zeros((kbucket, bucket), np.int32)
+                lengths = np.ones(kbucket, np.int32)  # dummy rows: 1 token
+                for j, r in enumerate(reqs):
+                    padded[j, : len(r.prompt)] = r.prompt
+                    lengths[j] = len(r.prompt)
+                logits, ks, vs = self._prefill(jnp.asarray(padded), lengths)
+                slots = [self.free_slots.pop() for _ in reqs]
+                # Keep kbucket shapes end-to-end (bounded compile count):
+                # dummy rows scatter to an out-of-range slot (dropped) and
+                # sample greedily into a discarded lane.
+                padded_slots = np.full(kbucket, self.max_slots, np.int32)
+                padded_slots[:k_real] = slots
+                self.cache_k, self.cache_v = self._insert(
+                    self.cache_k, self.cache_v, ks, vs,
+                    jnp.asarray(padded_slots),
+                )
+                temps = np.zeros(kbucket, np.float32)
+                top_ks = np.zeros(kbucket, np.int32)
+                top_ps = np.ones(kbucket, np.float32)
+                for j, r in enumerate(reqs):
+                    temps[j] = r.temperature
+                    top_ks[j] = r.top_k
+                    top_ps[j] = r.top_p
+                first = np.asarray(self._sample(
+                    logits, self._next_rng(), jnp.asarray(temps),
+                    top_ks, top_ps,
+                ))
+                logits_np = None
+                for j, (req, slot) in enumerate(zip(reqs, slots)):
+                    req.slot = slot
+                    self.lengths[slot] = len(req.prompt)
+                    if self.hist is not None:
+                        self.hist[slot, :len(req.prompt)] = req.prompt
+                    self.active[slot] = req
+                    self._maybe_capture_prefix(req)
+                    if req.logprobs or req.constraint is not None:
+                        if logits_np is None:
+                            logits_np = np.asarray(logits, np.float32)
+                    tok = (self._host_first_token(logits_np[j], req)
+                           if req.constraint is not None else int(first[j]))
+                    if req.logprobs:
+                        req.logprob_data.append(_host_logprobs(
+                            logits_np[j], tok, req.logprobs
+                        ))
+                    self._emit(req, tok)
 
     def _maybe_capture_prefix(self, req: Request) -> None:
         """Donate a freshly prefilled slot's leading KV rows to the
@@ -2255,6 +2271,10 @@ class GenerationEngine:
         now = time.perf_counter()
         if first:
             self.ttft_hist.observe(now - req.submit_t)
+            if trace.enabled():
+                trace.instant("first-token", plane="serving",
+                              track=f"req/{req.nonce}", nonce=req.nonce,
+                              ttft_ms=round((now - req.submit_t) * 1e3, 3))
         else:
             # First token of the run carries the cross-dispatch gap;
             # the rest landed in the same block (the per-token loop
@@ -2308,7 +2328,11 @@ class GenerationEngine:
         token when the dispatch returns and join the decode lanes next
         dispatch, so TTFT ~= one mixed dispatch that carries at most
         prefill_decode_steps of decode work."""
+        with trace.span("prefill.fused", plane="serving", track="engine",
+                        rows=len(self.prefilling)) as sp:
+            self._fused_step_inner(sp)
 
+    def _fused_step_inner(self, sp=trace._NULL_SPAN) -> None:
         items = list(self.prefilling.items())
         c = self._chunk
         # Chunk-lane admission budget, same spirit (and knob) as the
@@ -2374,6 +2398,9 @@ class GenerationEngine:
             # discarded, so they don't need covering.
             max_end = max(max_end, pos)
         klen = self._bucket(max_end)
+        # Chunk-shape annotations: mixed decode steps, chunk-only tail
+        # steps, chunk size, attention klen bucket for this dispatch.
+        sp.annotate(mixed_steps=n, tail_steps=m, chunk=c, klen=klen)
         # (nonces unused: the fused path samples from the _next_rng
         # chain -- it never pipelines, so chain order is stable.)
         tokens, temps, top_ks, top_ps, positions, _nonces, filtered = (
@@ -2424,6 +2451,10 @@ class GenerationEngine:
         now = time.perf_counter()
         if len(req.generated) == 1:
             self.ttft_hist.observe(now - req.submit_t)
+            if trace.enabled():
+                trace.instant("first-token", plane="serving",
+                              track=f"req/{req.nonce}", nonce=req.nonce,
+                              ttft_ms=round((now - req.submit_t) * 1e3, 3))
         else:
             # Engine-side gap; block decode makes these bursty (the
             # dispatch boundary carries the whole block's latency).
@@ -2601,7 +2632,7 @@ class GenerationEngine:
         fl = _Inflight(n, outs, last, lens, jt, jk, jp, jn, filtered,
                        want_lp, tuple(self.active))
         if mask is not None:
-            self._consume_block(fl, behind=False)
+            self._consume_block(fl, behind=False, drain="constraint-mask")
             return True
         self._pipeline_advance(fl)
         return True
@@ -2624,7 +2655,8 @@ class GenerationEngine:
         stale lane."""
         n_next = self._pipeline_next(fl)
         if n_next == 0:
-            self._consume_block(fl, behind=False)
+            self._consume_block(fl, behind=False,
+                                drain=self._drain_reason)
             return
         nxt = self._dispatch_chained(fl, n_next)
         fins = self.requests_finished
@@ -2632,7 +2664,8 @@ class GenerationEngine:
         if self.requests_finished != fins:
             # Mid-flight finish (EOS before the predicted budget):
             # drain now; the freed lane's overshoot is discarded whole.
-            self._consume_block(nxt, behind=False)
+            self._consume_block(nxt, behind=False,
+                                drain="mid-flight-finish")
         else:
             self._copy_async(nxt)
             self._inflight = nxt
@@ -2646,6 +2679,9 @@ class GenerationEngine:
         on, spec eligibility, a predicted in-block finish -- forces a
         drain back to the sequential path."""
         if self.pipeline_depth < 1 or not self.active or self.prefilling:
+            self._drain_reason = ("prefilling" if self.prefilling
+                                  else "idle" if not self.active
+                                  else "depth-0")
             return 0
         if self.free_slots:
             # A free slot means an admission could arrive between steps
@@ -2653,14 +2689,17 @@ class GenerationEngine:
             # a full block. The pipeline only engages at slot
             # saturation, where it pays for itself and no admission can
             # proceed anyway.
+            self._drain_reason = "free-slots"
             return 0
         if any(r.constraint is not None for r in self.active.values()):
+            self._drain_reason = "constraint"
             return 0
         if self.speculative_k and all(
             r.temperature <= 0 and r.top_k == 0 and r.top_p >= 1.0
             and not r.logprobs and r.constraint is None
             for r in self.active.values()
         ):
+            self._drain_reason = "spec-eligible"
             return 0  # the drained batch takes the spec path instead
         n_prev = fl.n
         rem_pred = min(
@@ -2668,11 +2707,13 @@ class GenerationEngine:
             for slot in self.active
         )
         if rem_pred < 1:
+            self._drain_reason = "cache-headroom"
             return 0
         if min(
             req.max_new_tokens - len(req.generated) - n_prev
             for req in self.active.values()
         ) <= 0:
+            self._drain_reason = "budget-exhausted"
             return 0  # someone exhausts their budget in flight: drain
         budget_pred = max(
             req.max_new_tokens - len(req.generated) - n_prev
@@ -2704,25 +2745,34 @@ class GenerationEngine:
         for o in outs:
             o.copy_to_host_async()
 
-    def _consume_block(self, fl: _Inflight, behind: bool) -> None:
+    def _consume_block(self, fl: _Inflight, behind: bool,
+                       drain: str = "") -> None:
         """Materialize an in-flight block's outputs (the only blocking
         host sync of a steady-state pipelined step) and emit them. With
         ``behind`` a newer block is already queued on device, so this
         consume opens NO host gap -- record 0 directly; otherwise start
-        the gap clock that the next dispatch closes."""
-        if fl.want_lp:
-            outs = tuple(np.asarray(o) for o in fl.outs)
-        else:
-            outs = np.asarray(fl.outs)
-        if behind:
-            self._ema_gap(0.0)
-        else:
-            self._gap_t = time.perf_counter()
-        self._emit_decode_outs(outs, fl.want_lp, dispatch_slots=fl.slots)
-        if not self.active:
-            # Going idle: time to the next dispatch is queue wait, not
-            # pipeline bubble -- don't count it.
-            self._gap_t = None
+        the gap clock that the next dispatch closes.
+
+        ``drain``: why the pipeline drained instead of chaining (empty
+        when ``behind`` -- a chained block IS in flight). The span is
+        consumption-side instrumentation only: it brackets the one
+        np.asarray sync this method already performs and adds none."""
+        with trace.span("decode-block.consume", plane="serving",
+                        track="engine", n=fl.n,
+                        depth=1 if behind else 0, drain=drain):
+            if fl.want_lp:
+                outs = tuple(np.asarray(o) for o in fl.outs)
+            else:
+                outs = np.asarray(fl.outs)
+            if behind:
+                self._ema_gap(0.0)
+            else:
+                self._gap_t = time.perf_counter()
+            self._emit_decode_outs(outs, fl.want_lp, dispatch_slots=fl.slots)
+            if not self.active:
+                # Going idle: time to the next dispatch is queue wait, not
+                # pipeline bubble -- don't count it.
+                self._gap_t = None
 
     def _note_dispatch(self, decode: bool) -> None:
         """Called at every device dispatch: closes any open host-gap

@@ -15,6 +15,7 @@ import logging
 import os
 from typing import Any, Callable, Dict, Optional
 
+from kubeflow_tpu.obs import trace
 from kubeflow_tpu.serving.model import Model, ModelRepository
 from kubeflow_tpu.serving.server import ModelServer
 from kubeflow_tpu.serving.storage import model_path
@@ -63,6 +64,12 @@ def serve_main(factory: ModelFactory, argv=None) -> int:
 
     faulthandler.register(_signal.SIGUSR1)
 
+    # Adopt the controller's trace context (KFTPU_TRACE_*) so replica
+    # spans land in the same distributed trace as reconcile/spawn.
+    trace.activate_from_env(
+        plane="serving", label=args.model_name or "multi-model"
+    )
+
     options = json.loads(args.options_json)
     model_dir = args.model_dir or os.path.abspath("./models")
 
@@ -102,4 +109,7 @@ def serve_main(factory: ModelFactory, argv=None) -> int:
         args.model_name, args.host, args.port, path,
     )
     server.run(host=args.host, port=args.port)
+    # Graceful shutdown: leave this replica's spans where `kftpu trace
+    # dump` merges them (live fetches go through GET /debug/trace).
+    trace.write_process_trace()
     return 0

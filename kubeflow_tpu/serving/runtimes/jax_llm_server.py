@@ -221,6 +221,8 @@ class JaxLLMModel(Model):
         self.engine = None
         self.tokenizer = None
         self._json_mask_table = None  # built lazily (see _json_masks)
+        self._prom = None  # per-model obs.registry.Registry (see prom_metrics)
+        self._prom_engine = None  # engine the registry was built for
 
     def load(self) -> None:
         from kubeflow_tpu.serving.engine import GenerationEngine
@@ -360,82 +362,81 @@ class JaxLLMModel(Model):
 
     def prom_metrics(self) -> List[str]:
         """Engine observability (SURVEY.md 5.5): scheduler gauges +
-        TTFT/ITL histograms, per model."""
+        TTFT/ITL histograms, per model -- every line rendered through
+        the shared obs.registry formatter, so label escaping (a
+        dynamically admitted model name with a quote/backslash/newline
+        must not corrupt the whole scrape) lives in exactly one place.
+        ``*_total`` lines are engine-owned monotone counters exposed by
+        value; the per-model registry is rebuilt when the engine is
+        (re)loaded so a fresh engine never inherits stale series."""
         if self.engine is None:
             return []
-        # Prometheus exposition label escaping: a dynamically admitted
-        # model name with a quote/backslash/newline must not corrupt the
-        # whole scrape.
-        def _esc(v) -> str:
-            return (str(v).replace("\\", "\\\\")
-                    .replace('"', '\\"').replace("\n", "\\n"))
+        from kubeflow_tpu.obs import registry as obs_registry
 
-        lab = f'model="{_esc(self.name)}"'
+        if self._prom is None or self._prom_engine is not self.engine:
+            self._prom = obs_registry.Registry()
+            self._prom_engine = self.engine
+        reg = self._prom
+        lab = {"model": self.name}
         s = self.engine.stats()
-        lines = [
-            f"kftpu_engine_queue_depth{{{lab}}} {s['queue_depth']}",
-            f"kftpu_engine_slots_active{{{lab}}} {s['slots_active']}",
-            f"kftpu_engine_slots_prefilling{{{lab}}} "
-            f"{s['slots_prefilling']}",
-            f"kftpu_engine_max_slots{{{lab}}} {s['max_slots']}",
-            f"kftpu_engine_prefill_backlog_tokens{{{lab}}} "
-            f"{s['prefill_backlog_tokens']}",
-            f"kftpu_engine_tokens_generated_total{{{lab}}} "
-            f"{s['tokens_generated']}",
-            f"kftpu_engine_requests_finished_total{{{lab}}} "
-            f"{s['requests_finished']}",
+        for key, stat in (
+            ("kftpu_engine_queue_depth", "queue_depth"),
+            ("kftpu_engine_slots_active", "slots_active"),
+            ("kftpu_engine_slots_prefilling", "slots_prefilling"),
+            ("kftpu_engine_max_slots", "max_slots"),
+            ("kftpu_engine_prefill_backlog_tokens",
+             "prefill_backlog_tokens"),
+            ("kftpu_engine_tokens_generated_total", "tokens_generated"),
+            ("kftpu_engine_requests_finished_total", "requests_finished"),
             # Dispatch-pipeline gauges: configured depth, EMA of the
             # host bubble between a block landing and the next dispatch
             # (~0 when overlapped), and tokens decoded past accepted
             # streams (EOS/budget overshoot -- discarded by design).
-            f"kftpu_engine_dispatch_depth{{{lab}}} {s['dispatch_depth']}",
-            f"kftpu_engine_decode_dispatches_total{{{lab}}} "
-            f"{s['decode_dispatches']}",
-            f"kftpu_engine_host_gap_ms{{{lab}}} {s['host_gap_ms_ema']}",
-            f"kftpu_engine_overshoot_tokens_total{{{lab}}} "
-            f"{s['overshoot_tokens_discarded']}",
-        ]
+            ("kftpu_engine_dispatch_depth", "dispatch_depth"),
+            ("kftpu_engine_decode_dispatches_total", "decode_dispatches"),
+            ("kftpu_engine_host_gap_ms", "host_gap_ms_ema"),
+            ("kftpu_engine_overshoot_tokens_total",
+             "overshoot_tokens_discarded"),
+        ):
+            reg.gauge(key, lab).set(s[stat])
         if "weight_bytes" in s:
             # Present only when quantized (the int8-footprint gauge; the
             # quantize mode itself rides the label).
-            lines.append(
-                f"kftpu_engine_weight_bytes"
-                f'{{{lab},quantize="{_esc(s["quantize"])}"}} '
-                f"{s['weight_bytes']}"
-            )
+            reg.gauge(
+                "kftpu_engine_weight_bytes",
+                {"model": self.name, "quantize": s["quantize"]},
+            ).set(s["weight_bytes"])
         if "kv_cache_bytes" in s:
-            lines.append(
-                f"kftpu_engine_kv_cache_bytes"
-                f'{{{lab},kv_quant="{_esc(s["kv_quant"])}"}} '
-                f"{s['kv_cache_bytes']}"
-            )
+            reg.gauge(
+                "kftpu_engine_kv_cache_bytes",
+                {"model": self.name, "kv_quant": s["kv_quant"]},
+            ).set(s["kv_cache_bytes"])
         sp = s.get("spec")
         if sp is not None:
-            lines += [
-                f"kftpu_engine_spec_steps_total{{{lab}}} {sp['steps']}",
-                f"kftpu_engine_spec_tokens_total{{{lab}}} "
-                f"{sp['emitted']}",
-                f"kftpu_engine_spec_acceptance{{{lab}}} "
-                f"{sp['acceptance']}",
-            ]
+            reg.gauge("kftpu_engine_spec_steps_total", lab).set(sp["steps"])
+            reg.gauge("kftpu_engine_spec_tokens_total",
+                      lab).set(sp["emitted"])
+            reg.gauge("kftpu_engine_spec_acceptance",
+                      lab).set(sp["acceptance"])
         pc = s.get("prefix_cache")
         if pc is not None:
-            lines += [
-                f"kftpu_engine_prefix_cache_entries{{{lab}}} "
-                f"{pc['entries']}",
-                f"kftpu_engine_prefix_cache_bytes{{{lab}}} {pc['bytes']}",
-                f"kftpu_engine_prefix_cache_hits_total{{{lab}}} "
-                f"{pc['hits']}",
-                f"kftpu_engine_prefix_cache_misses_total{{{lab}}} "
-                f"{pc['misses']}",
-            ]
-        lines += self.engine.ttft_hist.prom_lines(
-            "kftpu_engine_ttft_seconds", lab
-        )
-        lines += self.engine.itl_hist.prom_lines(
-            "kftpu_engine_itl_seconds", lab
-        )
-        return lines
+            reg.gauge("kftpu_engine_prefix_cache_entries",
+                      lab).set(pc["entries"])
+            reg.gauge("kftpu_engine_prefix_cache_bytes",
+                      lab).set(pc["bytes"])
+            reg.gauge("kftpu_engine_prefix_cache_hits_total",
+                      lab).set(pc["hits"])
+            reg.gauge("kftpu_engine_prefix_cache_misses_total",
+                      lab).set(pc["misses"])
+        # Engine-owned histograms join the same exposition walk
+        # (register is keyed, so re-registering each scrape is a no-op).
+        for hist, hname in (
+            (self.engine.ttft_hist, "kftpu_engine_ttft_seconds"),
+            (self.engine.itl_hist, "kftpu_engine_itl_seconds"),
+        ):
+            hist.name, hist.labels = hname, lab
+            reg.register(hist)
+        return reg.expose()
 
     def _json_masks(self):
         """Token-mask table for json_object constrained decoding, built

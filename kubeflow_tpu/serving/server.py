@@ -31,6 +31,8 @@ from typing import Optional
 
 from aiohttp import web
 
+from kubeflow_tpu.obs import registry as obs_registry
+from kubeflow_tpu.obs import trace as obs_trace
 from kubeflow_tpu.serving.model import TRACE, InferenceError, ModelRepository
 
 logger = logging.getLogger(__name__)
@@ -53,6 +55,12 @@ class ModelServer:
         self.request_count = 0
         self.error_count = 0
         self.predict_seconds = 0.0
+        # Server-level counters expose through the shared registry
+        # formatter (h_metrics); the attribute ints above stay the
+        # increment sites (hot handlers touch a plain int, the registry
+        # sees the value at scrape time).
+        self.metrics = obs_registry.Registry()
+        self._stream_seq = 0  # stream-emit span track ids
 
     # -- app --------------------------------------------------------------
 
@@ -61,6 +69,7 @@ class ModelServer:
         app.add_routes([
             web.get("/healthz", self.h_healthz),
             web.get("/metrics", self.h_metrics),
+            web.get("/debug/trace", self.h_debug_trace),
             # V1
             web.get("/v1/models/{m}", self.h_v1_status),
             web.post("/v1/models/{m:[^:]+}:predict", self.h_v1_predict),
@@ -137,11 +146,14 @@ class ModelServer:
         })
 
     async def h_metrics(self, req: web.Request) -> web.Response:
-        lines = [
-            f"kftpu_server_requests_total {self.request_count}",
-            f"kftpu_server_errors_total {self.error_count}",
-            f"kftpu_server_predict_seconds_total {self.predict_seconds:.6f}",
-        ]
+        m = self.metrics
+        m.counter("kftpu_server_requests_total").value = self.request_count
+        m.counter("kftpu_server_errors_total").value = self.error_count
+        # Pre-formatted at six decimals: the exact pre-port line format.
+        m.counter("kftpu_server_predict_seconds_total").value = (
+            f"{self.predict_seconds:.6f}"
+        )
+        lines = m.expose()
         for name in self.repository.names():
             try:
                 lines += self.repository.get(name).prom_metrics()
@@ -149,6 +161,12 @@ class ModelServer:
                 logger.exception(  # failure must not break the scrape
                     "prom_metrics failed for %s", name)
         return web.Response(text="\n".join(lines) + "\n")
+
+    async def h_debug_trace(self, req: web.Request) -> web.Response:
+        """This process's span recorder as Chrome trace-event JSON --
+        loadable in Perfetto directly, or merged across planes by
+        ``kftpu trace dump``. Empty trace when tracing is off."""
+        return web.json_response(obs_trace.recorder().export())
 
     # -- V1 ----------------------------------------------------------------
 
@@ -354,6 +372,28 @@ class ModelServer:
             self.predict_seconds += time.monotonic() - t0
 
     async def _stream_deltas(self, model, inst, stops=()):
+        """Traced wrapper over ``_stream_deltas_inner``: one
+        ``stream-emit`` span per streaming request on its own track
+        (streams interleave on the event loop, so a shared track would
+        unbalance B/E pairs), annotated with the emitted event count."""
+        if not obs_trace.enabled():
+            async for item in self._stream_deltas_inner(model, inst, stops):
+                yield item
+            return
+        self._stream_seq += 1
+        track = f"stream/{self._stream_seq}"
+        obs_trace.begin("stream-emit", plane="serving", track=track,
+                        model=model.name)
+        events = 0
+        try:
+            async for item in self._stream_deltas_inner(model, inst, stops):
+                events += 1
+                yield item
+        finally:
+            obs_trace.end("stream-emit", plane="serving", track=track,
+                          events=events)
+
+    async def _stream_deltas_inner(self, model, inst, stops=()):
         """Async generator over one streaming generation: yields
         (delta_text, token_id_or_None, ids_so_far) per event, handling
         the engine-thread bridge and split-codepoint withholding (deltas

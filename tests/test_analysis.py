@@ -248,6 +248,43 @@ def test_host_sync_audit_catches_midloop_sync():
     assert any(f.rule == "KT-AUDIT-HOSTSYNC" and f.hard for f in findings)
 
 
+def test_traced_host_sync_audit_catches_sync_inside_span():
+    """Non-vacuity for the TRACED sync bound: a blocking sync planted
+    INSIDE a span in the decode loop must still be flagged -- proving
+    the traced audit watches the same net and that spans do not mask
+    (or legitimize) host materializations."""
+    import dataclasses
+
+    import numpy as np
+
+    from kubeflow_tpu.models.llama import PRESETS
+    from kubeflow_tpu.obs import trace
+    from kubeflow_tpu.serving.engine import GenerationEngine
+
+    cfg = dataclasses.replace(PRESETS["llama-tiny"], max_seq=64)
+    eng = GenerationEngine(config=cfg, max_slots=2, decode_block=4)
+    orig = eng.step
+
+    def leaky_step():
+        ran = orig()
+        with trace.span("leaky", plane="serving", track="engine"):
+            np.asarray(eng.cache_k)  # deliberate sync inside a span
+        return ran
+
+    eng.step = leaky_step
+    try:
+        findings, metrics = jaxpr_audit.audit_decode_host_syncs_traced(eng)
+        restored_off = not trace.enabled()
+    finally:
+        trace.reset()
+    assert any(
+        f.rule == "KT-AUDIT-HOSTSYNC" and f.hard
+        and f.path == "serve.decode.traced"
+        for f in findings
+    )
+    assert restored_off  # audit restored the recorder state
+
+
 def test_collective_census_empty_for_local_fn():
     import jax.numpy as jnp
 
