@@ -53,15 +53,16 @@ PRESET = os.environ.get("BENCH_PRESET", "llama3-8b-proxy")
 # the REAL sequence lengths too, batch shrunk to fit HBM per seq
 # ("seq:batch" pairs; empty disables the sweep). The headline metric
 # stays the seq-1024 row for round-over-round comparability.
-# "seq:batch[:loss_chunk[:remat_policy]]" -- loss_chunk enables the
-# sequence-chunked cross entropy and remat_policy=minimal the
-# save-nothing layer remat (models/llama.py): at 8192 the fp32 logits +
-# grad and the saved [L,S,intermediate] dots exceed one chip's HBM
-# without them.
+# "seq:batch[:loss_chunk[:remat_policy]]" -- a bare "seq:batch" entry
+# lets the per-seq-len tuner (parallel/tuner.py) pick attention impl,
+# remat policy, loss chunk, and flash block size from the HBM model;
+# giving loss_chunk/remat_policy explicitly PINS those knobs (operator
+# override, recorded as pinned in the row). The 8192 row is
+# tuner-selected by default -- it used to hand-pin 1024:minimal.
 SEQ_SWEEP = [
     tuple(pair.split(":"))
     for pair in os.environ.get(
-        "BENCH_SEQ_SWEEP", "2048:2,4096:1,8192:1:1024:minimal"
+        "BENCH_SEQ_SWEEP", "2048:2,4096:1,8192:1"
     ).split(",") if pair
 ]
 
@@ -174,6 +175,46 @@ def run_config(batch: int, seq: int, steps: int, loss_chunk: int = 0,
     return out
 
 
+def _tune_row(seq: int, batch: int) -> dict:
+    """Tuner-selected knobs for one sweep row (parallel/tuner.py): the
+    HBM model prunes infeasible (impl, remat, chunk, block) points and a
+    coarse step-time model ranks the rest. Returns the row's ``tuned``
+    record; ``task_kwargs`` inside it feeds run_config."""
+    import jax
+
+    from kubeflow_tpu.models.llama import PRESETS
+    from kubeflow_tpu.parallel.tuner import tune_train_config
+
+    cfg = PRESETS[PRESET]
+    try:
+        hbm = (jax.devices()[0].memory_stats() or {}).get("bytes_limit")
+    except Exception:  # noqa: BLE001 - stats are best-effort
+        hbm = None
+    r = tune_train_config(
+        cfg, batch, seq,
+        n_devices=len(jax.devices()),
+        hbm_bytes=hbm,
+        on_tpu=jax.default_backend() == "tpu",
+    )
+    return {
+        "attention_impl": r.attention_impl,
+        "remat_policy": r.remat_policy,
+        "loss_chunk": r.loss_chunk,
+        "block_sizes": r.flash_block,
+        "predicted_hbm_bytes": r.predicted_hbm_bytes,
+        "n_feasible": r.n_feasible,
+        "n_candidates": r.n_candidates,
+        "pinned": False,
+    }
+
+
+def _pop_flag(flag: str) -> bool:
+    if flag not in sys.argv:
+        return False
+    sys.argv.remove(flag)
+    return True
+
+
 def _pop_trace_out():
     """Strip ``--trace-out PATH`` from argv; returns PATH or None.  When
     set, tracing is enabled for this run (env-propagated, so the A/B
@@ -222,6 +263,11 @@ def main() -> int:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
     trace_out = _pop_trace_out()
+    # --seq-sweep-only: just the per-seq-len curve (tuner-selected rows),
+    # skipping the headline config and the int8 A/B children -- the fast
+    # path for long-context work, and composable with --trace-out (each
+    # row runs under its own bench.seq_sweep.<seq> span).
+    sweep_only = _pop_flag("--seq-sweep-only")
     from kubeflow_tpu.obs import trace as obs_trace
 
     obs_trace.activate_from_env(plane="runtime", label="bench")
@@ -249,7 +295,7 @@ def main() -> int:
     # an identical A/B collapsing +22% -> +3%). Both sides of the A/B
     # are therefore process-fresh.
     int8_ab = None
-    if os.environ.get("BENCH_INT8_MM", "1") != "0":
+    if not sweep_only and os.environ.get("BENCH_INT8_MM", "1") != "0":
         import subprocess
 
         def child(tag):
@@ -286,19 +332,54 @@ def main() -> int:
 
     check_flash_kernel()
 
-    head = run_config(BATCH, SEQ, STEPS)
+    head = None if sweep_only else run_config(BATCH, SEQ, STEPS)
     sweep = []
     for entry in SEQ_SWEEP:
         seq, batch = int(entry[0]), int(entry[1])
-        chunk = int(entry[2]) if len(entry) > 2 else 0
-        rp = entry[3] if len(entry) > 3 else "dots"
+        if len(entry) > 2:
+            # Operator-pinned knobs (legacy "seq:batch:chunk[:remat]"
+            # form) bypass the tuner but are recorded as pinned.
+            tuned = {
+                "attention_impl": "auto",
+                "remat_policy": entry[3] if len(entry) > 3 else "dots",
+                "loss_chunk": int(entry[2]),
+                "block_sizes": None,
+                "pinned": True,
+            }
+        else:
+            tuned = _tune_row(seq, batch)
         try:
-            sweep.append(
-                run_config(batch, seq, max(STEPS // 2, 3), chunk, rp)
-            )
+            with obs_trace.span(f"bench.seq_sweep.{seq}",
+                                plane="runtime"):
+                row = run_config(
+                    batch, seq, max(STEPS // 2, 3),
+                    tuned["loss_chunk"], tuned["remat_policy"],
+                    attention_impl=tuned["attention_impl"],
+                    flash_block=tuned["block_sizes"],
+                )
         except Exception as e:  # noqa: BLE001 - record, don't lose the headline
-            sweep.append({"seq_len": seq, "batch": batch,
-                          "error": f"{type(e).__name__}: {e}"[:200]})
+            row = {"seq_len": seq, "batch": batch,
+                   "error": f"{type(e).__name__}: {e}"[:200]}
+        row["tuned"] = tuned
+        sweep.append(row)
+    if sweep_only:
+        curve = [r["mfu"] for r in sweep if "mfu" in r]
+        result = {
+            "metric": f"{PRESET}_seq_sweep_min_mfu",
+            "value": round(min(curve), 4) if curve else None,
+            "unit": "mfu",
+            "vs_baseline": round(min(curve) / 0.50, 3) if curve else None,
+            "extra": {
+                "seq_sweep": sweep,
+                "n_chips": len(jax.devices()),
+                "device": jax.devices()[0].device_kind,
+            },
+        }
+        if trace_out:
+            result["extra"]["trace"] = _merge_trace_out(
+                trace_out, obs_trace.recorder().export())
+        print(json.dumps(result))
+        return 0
     per_chip = head["tokens_per_sec_per_chip"]
     mfu = head["mfu"]
     final_loss = head["final_loss"]

@@ -160,14 +160,15 @@ def _pct(xs, q):
 
 
 def bench_pipeline(max_slots: int = 16) -> dict:
-    """Dispatch-pipeline A/B: pipeline_depth 0 (sequential
-    dispatch-sync-consume) vs 1 (block N+1 chained off device-resident
-    carry while block N's outputs are consumed). Uniform saturated
-    decode at the LATENCY block size (8): small blocks cross the
-    host<->device boundary most often, so the per-block host gap is the
-    largest fraction of the loop there -- the overlap win shows at
-    small blocks or nowhere. Each engine's own host_gap_ms_ema gauge is
-    reported next to the throughput median so the delta is attributable
+    """Dispatch-pipeline depth sweep: pipeline_depth 0 (sequential
+    dispatch-sync-consume) vs the lane-deque depths 1, 2, 4 (up to N
+    blocks chained off device-resident carries while older outputs are
+    consumed). Uniform saturated decode at the LATENCY block size (8):
+    small blocks cross the host<->device boundary most often, so the
+    per-block host gap is the largest fraction of the loop there -- the
+    overlap win shows at small blocks or nowhere. Each arm's own gauges
+    (host_gap_ms_ema, dispatch_inflight, overshoot_max_per_drain) are
+    reported next to the throughput median so a delta is attributable
     to the gap closing, not ambient tunnel noise."""
     import gc
 
@@ -179,6 +180,7 @@ def bench_pipeline(max_slots: int = 16) -> dict:
         eng = GenerationEngine(
             preset=PRESET, max_slots=max_slots, max_seq=MAX_SEQ,
             decode_block=LATENCY_DECODE_BLOCK, pipeline_depth=depth,
+            drain_overshoot_bound=max(depth, 1) * LATENCY_DECODE_BLOCK,
         )
         rng = np.random.default_rng(3)
 
@@ -207,26 +209,31 @@ def bench_pipeline(max_slots: int = 16) -> dict:
         s = eng.stats()
         out["gauges"] = {
             k: s[k] for k in (
-                "dispatch_depth", "host_gap_ms_ema",
-                "overshoot_tokens_discarded", "decode_dispatches",
+                "dispatch_depth", "dispatch_inflight", "host_gap_ms_ema",
+                "overshoot_tokens_discarded", "overshoot_max_per_drain",
+                "decode_dispatches",
             )
         }
         eng.close()
         gc.collect()
         return out
 
-    a = run(0)
-    b = run(1)
-    return {
+    arms = {depth: run(depth) for depth in (0, 1, 2, 4)}
+    result = {
         "workload": (
             f"uniform saturated decode, {max_slots} slots, "
             f"decode_block={LATENCY_DECODE_BLOCK}, {PROMPT_LEN}-token "
             f"prompts, {NEW_TOKENS} new"
         ),
-        "depth0": a,
-        "depth1": b,
-        **_ab_verdict(a, b),
     }
+    for depth, arm in arms.items():
+        result[f"depth{depth}"] = arm
+        if depth > 0:
+            result[f"depth{depth}_vs_depth0"] = _ab_verdict(arms[0], arm)
+    # Headline ratio/verdict stay the depth-1 arm for round-over-round
+    # comparability with earlier SERVING_BENCH rounds.
+    result.update(_ab_verdict(arms[0], arms[1]))
+    return result
 
 
 def bench_throughput_mixed(max_slots: int) -> dict:

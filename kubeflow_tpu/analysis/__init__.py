@@ -21,6 +21,11 @@ import os
 import sys
 from typing import Dict, List, Tuple
 
+from kubeflow_tpu.analysis.perf import (  # noqa: F401
+    PERF_BASELINE_PATH,
+    check_perf,
+    load_perf_baseline,
+)
 from kubeflow_tpu.analysis.report import (  # noqa: F401
     BASELINE_PATH,
     Comparison,

@@ -233,9 +233,11 @@ def audit_decode_host_syncs(
     """Steady-state decode must block on the host AT MOST once per
     decode block (the single consume of a landed block's outputs); a
     second sync means an ``np.asarray`` snuck between two dispatches
-    and the TPU idles at every block boundary again. Holds in BOTH
-    pipeline modes: sequential consumes each block once, pipelined
-    consumes block N under block N+1."""
+    and the TPU idles at every block boundary again. Holds at EVERY
+    pipeline depth: sequential consumes each block once, a depth-N
+    pipeline consumes block N under its queued successor lanes --
+    audit_serving_engine re-runs this bound per depth (the ``.d2`` /
+    ``.d4`` metric variants)."""
     from kubeflow_tpu.serving.engine import Request
 
     findings: List[Finding] = []
@@ -509,6 +511,35 @@ def audit_serving_engine() -> Tuple[List[Finding], Dict[str, float]]:
     sync_findings, sync_metrics = audit_decode_host_syncs(eng)
     findings.extend(sync_findings)
     metrics.update(sync_metrics)
+
+    # Same bound at the DEEPER pipeline depths depth-N dispatch allows:
+    # pipeline_depth / drain_overshoot_bound are plain host attributes
+    # (no new compiles -- the same decode jits serve every depth), so
+    # the one warmed engine re-runs the window per depth. A depth whose
+    # fill loop ever syncs between dispatches regresses its own
+    # ratcheted metric (serve.host_syncs_per_block.dN, ceiling 1.0).
+    saved = (eng.pipeline_depth, eng.drain_overshoot_bound)
+    try:
+        for depth in (2, 4):
+            eng.pipeline_depth = depth
+            # Let the lane deque actually reach ``depth`` full blocks;
+            # the default bound (2 * decode_block) would clamp depth 4.
+            eng.drain_overshoot_bound = depth * eng.decode_block
+            d_findings, d_metrics = audit_decode_host_syncs(
+                eng,
+                entry=f"serve.decode.d{depth}",
+                metric=f"serve.host_syncs_per_block.d{depth}",
+            )
+            findings.extend(d_findings)
+            metrics.update(d_metrics)
+    finally:
+        eng.pipeline_depth, eng.drain_overshoot_bound = saved
+    # Worst single-drain queued-lane discard across every depth driven
+    # above -- perf_baseline.json caps it (an unbounded drain is a perf
+    # regression, not a correctness one: outputs stay bit-identical).
+    metrics["serve.overshoot_max_per_drain"] = float(
+        eng.overshoot_max_per_drain
+    )
 
     # Same bound with span tracing ON: instrumentation is required to be
     # consumption-side only, so the traced ratchet must match.

@@ -1,0 +1,202 @@
+"""Perf-curve ratchet: the bench curves are CI contracts, not folklore.
+
+The repo commits its measured perf artifacts (``BENCH_r*.json`` train
+rounds, ``SERVING_BENCH.json`` slot sweeps) and this module checks them
+against ``perf_baseline.json`` floors every ``kftpu analyze`` run, so a
+curve regression fails --strict the same way a dropped donation does
+instead of landing silently and surfacing three rounds later as "why is
+8192 slow again".
+
+Three check families, one baseline file:
+
+- ``train.mfu_floor_by_seq``: per-sequence-length MFU floors over the
+  newest committed train bench round (headline row + seq_sweep rows).
+  A sweep row that disappears or errors trips the floor too -- silently
+  shrinking the curve is the oldest regression-hiding trick.
+- ``serving.tok_s_floor_by_slots``: per-slot-count tokens/sec floors
+  over the committed serving slot sweep.
+- ``ceilings``: upper bounds on live analysis metrics -- the per-depth
+  steady-state host-sync bound (``serve.host_syncs_per_block[.dN]``)
+  and the worst per-drain queued-lane discard
+  (``serve.overshoot_max_per_drain``), both produced by the Tier-B
+  serving audit in the same analyze run.
+
+Floors sit ~5-8% under the measured values (run-to-run tunnel noise);
+tightening them after a win is a one-line baseline edit, the ratchet
+direction the rest of analysis/ already uses. Violations are HARD
+findings (rules KT-PERF-MFU / KT-PERF-TOKS / KT-PERF-CEIL): they are
+never grandfathered by the finding-count baseline.
+
+Missing artifact FILES skip quietly (an installed package has no bench
+history; tests/test_analysis.py proves the checks fire when the data is
+present), but an artifact that exists with a floor'd row absent or
+errored is a finding.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from kubeflow_tpu.analysis.report import Finding
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+PERF_BASELINE_PATH = os.path.join(_HERE, "perf_baseline.json")
+# kubeflow_tpu/analysis/ -> repo root, where the bench artifacts live.
+_REPO_ROOT = os.path.dirname(os.path.dirname(_HERE))
+
+
+def load_perf_baseline(path: Optional[str] = None) -> dict:
+    """The committed floors/ceilings; {} when absent (checks no-op)."""
+    path = path or PERF_BASELINE_PATH
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _load_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def latest_train_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str]:
+    """Newest committed train round's parsed bench dict.
+
+    ``BENCH_r*.json`` wraps the bench's JSON line under ``parsed``
+    (alongside the runner's cmd/rc/tail); older or hand-written
+    artifacts may be the bare dict -- accept both. Returns
+    (parsed_dict_or_None, artifact_name)."""
+    root = root or _REPO_ROOT
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                       reverse=True):
+        doc = _load_json(path)
+        if doc is None:
+            continue
+        parsed = doc.get("parsed", doc)
+        if isinstance(parsed, dict) and isinstance(parsed.get("extra"), dict):
+            return parsed, os.path.basename(path)
+    return None, ""
+
+
+def serving_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str]:
+    root = root or _REPO_ROOT
+    path = os.path.join(root, "SERVING_BENCH.json")
+    doc = _load_json(path)
+    if doc is None or not isinstance(doc.get("extra"), dict):
+        return None, ""
+    return doc, os.path.basename(path)
+
+
+def _train_mfu_by_seq(parsed: dict) -> Dict[int, Optional[float]]:
+    """seq_len -> measured MFU from the headline row + seq_sweep rows;
+    None marks a row that errored (present but unmeasured)."""
+    extra = parsed.get("extra", {})
+    out: Dict[int, Optional[float]] = {}
+    if isinstance(extra.get("seq_len"), int) and "mfu" in extra:
+        out[extra["seq_len"]] = extra["mfu"]
+    for row in extra.get("seq_sweep") or []:
+        if not isinstance(row, dict) or "seq_len" not in row:
+            continue
+        out[int(row["seq_len"])] = row.get("mfu")
+    return out
+
+
+def check_perf(
+    baseline: dict,
+    *,
+    root: Optional[str] = None,
+    metrics: Optional[Dict[str, float]] = None,
+) -> Tuple[List[Finding], Dict[str, float]]:
+    """Evaluate the perf baseline. Returns (hard findings, measured) --
+    ``measured`` echoes every value a floor/ceiling was checked against
+    (keyed like the baseline) so reports show margin, not just pass."""
+    findings: List[Finding] = []
+    measured: Dict[str, float] = {}
+
+    # -- train MFU floors --------------------------------------------------
+    floors = (baseline.get("train") or {}).get("mfu_floor_by_seq") or {}
+    if floors:
+        parsed, artifact = latest_train_bench(root)
+        if parsed is not None:
+            mfu_by_seq = _train_mfu_by_seq(parsed)
+            for seq_s, floor in sorted(floors.items(), key=lambda kv: int(kv[0])):
+                seq = int(seq_s)
+                mfu = mfu_by_seq.get(seq)
+                if mfu is None:
+                    findings.append(Finding(
+                        rule="KT-PERF-MFU", path=artifact, line=0, hard=True,
+                        message=(
+                            f"seq {seq}: no measured MFU row in {artifact} "
+                            f"(floor {floor}) -- the curve shrank or the "
+                            f"row errored"
+                        ),
+                    ))
+                    continue
+                measured[f"train.mfu.seq{seq}"] = float(mfu)
+                if mfu < floor:
+                    findings.append(Finding(
+                        rule="KT-PERF-MFU", path=artifact, line=0, hard=True,
+                        message=(
+                            f"seq {seq}: MFU {mfu} below ratchet floor "
+                            f"{floor} ({artifact})"
+                        ),
+                    ))
+
+    # -- serving tok/s floors ----------------------------------------------
+    floors = (baseline.get("serving") or {}).get("tok_s_floor_by_slots") or {}
+    if floors:
+        doc, artifact = serving_bench(root)
+        if doc is not None:
+            by_slots = {
+                int(row["max_slots"]): row.get("tokens_per_sec")
+                for row in doc["extra"].get("sweep") or []
+                if isinstance(row, dict) and "max_slots" in row
+            }
+            for slots_s, floor in sorted(floors.items(),
+                                         key=lambda kv: int(kv[0])):
+                slots = int(slots_s)
+                toks = by_slots.get(slots)
+                if toks is None:
+                    findings.append(Finding(
+                        rule="KT-PERF-TOKS", path=artifact, line=0, hard=True,
+                        message=(
+                            f"{slots} slots: no tokens_per_sec row in "
+                            f"{artifact} (floor {floor})"
+                        ),
+                    ))
+                    continue
+                measured[f"serving.tok_s.slots{slots}"] = float(toks)
+                if toks < floor:
+                    findings.append(Finding(
+                        rule="KT-PERF-TOKS", path=artifact, line=0, hard=True,
+                        message=(
+                            f"{slots} slots: {toks} tok/s below ratchet "
+                            f"floor {floor} ({artifact})"
+                        ),
+                    ))
+
+    # -- live-metric ceilings ----------------------------------------------
+    # Checked against THIS analyze run's Tier-B metrics; a ceiling whose
+    # metric the run didn't produce (--no-trace / --no-serving) skips.
+    for name, ceiling in sorted((baseline.get("ceilings") or {}).items()):
+        value = (metrics or {}).get(name)
+        if value is None:
+            continue
+        measured[f"ceiling.{name}"] = float(value)
+        if value > ceiling:
+            findings.append(Finding(
+                rule="KT-PERF-CEIL", path=name, line=0, hard=True,
+                message=(
+                    f"{name} = {value} exceeds ceiling {ceiling} "
+                    f"(perf_baseline.json)"
+                ),
+            ))
+    return findings, measured

@@ -212,17 +212,26 @@ def cmd_analyze(args, _client) -> int:
     findings, metrics = analysis.run_analysis(
         trace=not args.no_trace, serving=not args.no_serving
     )
+    # Perf-curve ratchet: committed bench floors + live-metric ceilings.
+    # Violations are hard findings, so they ride the same strict gate and
+    # are never grandfathered by --update-baseline (hard != countable).
+    perf_findings, perf_measured = analysis.check_perf(
+        analysis.load_perf_baseline(args.perf_baseline), metrics=metrics
+    )
+    findings.extend(perf_findings)
     baseline = analysis.load_baseline(args.baseline)
     cmp = analysis.compare(findings, metrics, baseline)
     if args.update_baseline:
+        # Raw metrics only: perf_measured values are floor-checked (lower
+        # is worse) and must not enter the higher-is-worse metric ratchet.
         data = analysis.write_baseline(
             findings, metrics, path=args.baseline
         )
         print(f"baseline updated: {data['total']} grandfathered finding(s)"
               f" (initial scan had {data['initial_total']})")
         return 0
-    print(analysis.render_report(findings, metrics, cmp,
-                                 as_json=args.json))
+    print(analysis.render_report(findings, dict(metrics, **perf_measured),
+                                 cmp, as_json=args.json))
     if args.strict and not cmp.clean:
         return 1
     return 0
@@ -349,6 +358,9 @@ def main(argv=None) -> int:
                     help="skip the serving-engine audit (fastest trace run)")
     sp.add_argument("--baseline", default=None,
                     help="baseline path (default: committed baseline.json)")
+    sp.add_argument("--perf-baseline", default=None,
+                    help="perf-curve ratchet path "
+                         "(default: committed perf_baseline.json)")
     sp.set_defaults(fn=cmd_analyze)
 
     sp = sub.add_parser(

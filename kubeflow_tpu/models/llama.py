@@ -71,6 +71,10 @@ class LlamaConfig:
     remat_policy: str = "dots"
     scan_layers: bool = True
     attention_impl: str = "auto"
+    # Cap on the flash kernel's seq tile (None = largest legal tile).
+    # A per-seq-len tuner knob: long sequences can prefer smaller tiles
+    # when the bigger tile's VMEM working set evicts the K/V stream.
+    flash_block: Optional[int] = None
     # MoE (Mixtral-style: every layer's FFN is a router + n_experts SwiGLU
     # experts when n_experts > 1; token-choice top-k with static capacity).
     n_experts: int = 1
@@ -256,7 +260,8 @@ class Attention(nn.Module):
         # decode step (kubeflow_tpu.serving.engine) with proper position
         # masking rather than threading cache state through linen.
         out = dot_product_attention(
-            q, k, v, causal=True, impl=cfg.attention_impl
+            q, k, v, causal=True, impl=cfg.attention_impl,
+            flash_block=cfg.flash_block
         )
         out = nn.DenseGeneral(
             features=cfg.hidden,
@@ -566,26 +571,48 @@ def chunked_cross_entropy(hidden: jax.Array, w_lm: jax.Array,
     activation. Chunking trades one extra lm_head matmul per chunk (in
     the backward) for that memory; use for long sequences that otherwise
     OOM, not as the default (the straight path is faster when it fits).
+
+    A seq length that is not a multiple of ``chunk`` is handled by
+    zero-padding the tail chunk and masking its CE contribution; the
+    mean still divides by the REAL token count, so the value is exact
+    (and the divisible case traces the identical unmasked scan).
     """
     b, s, h = hidden.shape
-    if s % chunk:
-        raise ValueError(f"seq {s} not divisible by loss_chunk {chunk}")
-    n = s // chunk
+    if chunk <= 0:
+        raise ValueError(f"loss_chunk must be positive, got {chunk}")
+    pad = -s % chunk
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+    n = (s + pad) // chunk
     hid = hidden.reshape(b, n, chunk, h).transpose(1, 0, 2, 3)
     tg = targets.reshape(b, n, chunk).transpose(1, 0, 2)
 
     @jax.checkpoint
-    def chunk_loss(hc, tc):
+    def chunk_loss(hc, tc, mc=None):
         logits = (hc @ w_lm).astype(jnp.float32)
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, tc
-        ).sum()
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
+        if mc is not None:
+            ce = ce * mc
+        return ce.sum()
 
-    def body(acc, xs):
-        hc, tc = xs
-        return acc + chunk_loss(hc, tc), None
+    if pad:
+        valid = (jnp.arange(s + pad) < s).astype(jnp.float32)
+        vm = jnp.broadcast_to(valid, (b, s + pad))
+        vm = vm.reshape(b, n, chunk).transpose(1, 0, 2)
 
-    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hid, tg))
+        def body(acc, xs):
+            hc, tc, mc = xs
+            return acc + chunk_loss(hc, tc, mc), None
+
+        xs = (hid, tg, vm)
+    else:
+        def body(acc, xs):
+            hc, tc = xs
+            return acc + chunk_loss(hc, tc), None
+
+        xs = (hid, tg)
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), xs)
     return total / (b * s)
 
 

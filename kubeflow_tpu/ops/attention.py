@@ -62,8 +62,12 @@ def dot_product_attention(
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
     impl: str = "auto",
+    flash_block: Optional[int] = None,
 ) -> jax.Array:
     """Attention entry point. impl: auto | xla | flash | ring | ulysses.
+    ``flash_block`` caps the flash kernel's tile size (tuner knob; None
+    keeps the kernel's largest-legal-tile default, other impls ignore
+    it).
 
     ``ring`` shards the sequence dim over the mesh's ``sequence`` axis via
     shard_map + ppermute (context parallelism); ``ulysses`` uses one
@@ -122,7 +126,8 @@ def dot_product_attention(
     if impl == "flash":
         from kubeflow_tpu.ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        return flash_attention(q, k, v, causal=causal,
+                               segment_ids=segment_ids, block=flash_block)
     return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
 
 

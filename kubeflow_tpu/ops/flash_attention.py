@@ -30,12 +30,17 @@ def _kernel():
     return fa
 
 
-def _block_sizes(seq_q: int, seq_k: int):
+def _block_sizes(seq_q: int, seq_k: int, block: Optional[int] = None):
     fa = _kernel()
     # Largest 128-multiple <= 512 dividing both seqs (the kernel requires
-    # exact tiling; e.g. seq 640 must use 128, not 512).
-    b = next(c for c in (512, 384, 256, 128)
-             if seq_q % c == 0 and seq_k % c == 0)
+    # exact tiling; e.g. seq 640 must use 128, not 512). An explicit
+    # ``block`` (the tuner's knob) caps the choice instead of replacing
+    # it, so an untileable request degrades to the best legal tile
+    # rather than a kernel error.
+    cands = (512, 384, 256, 128)
+    if block is not None:
+        cands = tuple(c for c in cands if c <= block) or (128,)
+    b = next(c for c in cands if seq_q % c == 0 and seq_k % c == 0)
     return fa.BlockSizes(
         block_q=b, block_k_major=b, block_k=b, block_b=1,
         block_q_major_dkv=b, block_k_major_dkv=b, block_k_dkv=b,
@@ -50,6 +55,7 @@ def flash_attention(
     v: jax.Array,
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
+    block: Optional[int] = None,
 ) -> jax.Array:
     from kubeflow_tpu.ops.attention import xla_attention
 
@@ -81,6 +87,6 @@ def flash_attention(
         causal=causal,
         segment_ids=seg,
         sm_scale=1.0 / (q.shape[-1] ** 0.5),
-        block_sizes=_block_sizes(q.shape[1], k.shape[1]),
+        block_sizes=_block_sizes(q.shape[1], k.shape[1], block),
     )
     return out.transpose(0, 2, 1, 3)

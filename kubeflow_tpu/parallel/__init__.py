@@ -8,6 +8,10 @@ everything else lives here, in the runtime.
 """
 
 from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh  # noqa: F401
+from kubeflow_tpu.parallel.tuner import (  # noqa: F401
+    TuneResult,
+    tune_train_config,
+)
 from kubeflow_tpu.parallel.sharding import (  # noqa: F401
     LogicalAxisRules,
     logical_sharding,

@@ -1,0 +1,229 @@
+"""Per-seq-len training-config tuner: pick (attention impl, remat
+policy, loss chunk, flash block) abstractly, before any slice-hours burn.
+
+Why it exists: the bench's long-sequence rows used to hand-pin their
+memory knobs (`8192:1:1024:minimal` in SEQ_SWEEP) because nobody wanted
+to re-derive "what fits" per geometry. But everything needed to derive
+it is already known abstractly -- ``parallel.memory`` models per-device
+state and activation bytes without touching a device -- so the tuner
+enumerates the small config lattice, prunes the points that cannot fit
+the chip's HBM, and ranks the survivors with a simple step-time cost
+model. The bench records the chosen config per sweep row; on-hardware
+autotuning (running the top-k candidates for real) can later re-rank
+the same candidate list, the pruning stays.
+
+The knobs and their memory/time trade:
+
+- ``attention_impl``: flash is O(S) HBM; xla materializes B*heads*S^2
+  f32 scores (fine short, fatal at 8k); ring/ulysses shard S over the
+  mesh's ``sequence`` axis (only candidates when that axis exists).
+- ``remat_policy``: "dots" saves per-layer matmul outputs (faster
+  backward, ~(2I + 2H + H) * B * S extra live bytes per layer);
+  "minimal" saves only the residual stream (~10-15% step-time cost).
+- ``loss_chunk``: 0 materializes the [B, S, V] f32 logits (+grad);
+  chunking caps that at [B, chunk, V] for one extra lm_head matmul per
+  chunk in the backward.
+- ``flash_block``: cap on the flash kernel's seq tile; smaller tiles
+  shrink the VMEM working set at slightly worse MXU utilization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from kubeflow_tpu.parallel.memory import HBM_BYTES, activation_bytes_estimate
+
+# Leave headroom for XLA scratch, collectives buffers, and the tile
+# padding the abstract estimate does not model.
+_USABLE_HBM_FRACTION = 0.95
+
+# bytes/param resident per device (before the fsdp divisor): f32 master
+# plus the optimizer moments. Adafactor's factored second moment is
+# O(rows + cols) -- noise at planning scale; adam keeps two full f32
+# moments. The transient bf16 compute casts are per-layer under scan and
+# ride the activation workspace term instead.
+_STATE_BYTES_PER_PARAM = {"adafactor": 4, "sgd": 4}
+_STATE_BYTES_DEFAULT = 12  # adam-family: master + 2 moments
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneResult:
+    """One tuned sweep-row config plus the evidence for it."""
+
+    attention_impl: str
+    remat_policy: str
+    loss_chunk: int
+    flash_block: Optional[int]
+    predicted_hbm_bytes: int
+    hbm_budget_bytes: int
+    n_candidates: int
+    n_feasible: int
+    pinned: bool = False  # True when the operator pinned knobs via env
+
+    def as_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    def task_kwargs(self) -> Dict:
+        """kwargs for get_task()/LlamaConfig overrides."""
+        return {
+            "attention_impl": self.attention_impl,
+            "flash_block": self.flash_block,
+            "loss_chunk": self.loss_chunk,
+            "remat_policy": self.remat_policy,
+        }
+
+
+def candidate_lattice(
+    seq_len: int,
+    *,
+    sequence_shards: int = 1,
+    on_tpu: bool = True,
+) -> List[Tuple[str, str, int, Optional[int]]]:
+    """(impl, remat_policy, loss_chunk, flash_block) points to consider.
+
+    With a real ``sequence`` mesh axis the context-parallel impls are
+    the only ones that shard S; otherwise flash (TPU) and xla compete.
+    loss_chunk candidates prefer divisors of ``seq_len`` (the masked
+    ragged tail is exact but wastes a partial chunk of lm_head work).
+    """
+    if sequence_shards > 1:
+        impls = ["ring", "ulysses"]
+    elif on_tpu:
+        impls = ["flash", "xla"]
+    else:
+        impls = ["xla"]
+
+    chunks = [0] + [c for c in (4096, 2048, 1024, 512)
+                    if c < seq_len and seq_len % c == 0]
+    if len(chunks) == 1 and seq_len > 512:
+        chunks.append(512)  # ragged tail beats OOM
+
+    out: List[Tuple[str, str, int, Optional[int]]] = []
+    for impl in impls:
+        blocks: List[Optional[int]] = [None]
+        if impl == "flash":
+            blocks += [b for b in (256, 128) if seq_len % b == 0]
+        for remat in ("dots", "minimal"):
+            for chunk in chunks:
+                for block in blocks:
+                    out.append((impl, remat, chunk, block))
+    return out
+
+
+def predict_step_bytes(
+    cfg,
+    batch_local: int,
+    seq_len: int,
+    *,
+    impl: str,
+    remat_policy: str,
+    loss_chunk: int,
+    n_devices: int = 1,
+    sequence_shards: int = 1,
+    vocab_shards: int = 1,
+    optimizer: str = "adafactor",
+) -> int:
+    """Per-device bytes for one train step of the candidate, built on
+    ``memory.activation_bytes_estimate`` with the knobs applied."""
+    seq_local = seq_len // max(sequence_shards, 1)
+    base = activation_bytes_estimate(
+        cfg, batch_local, seq_local, vocab_shards=vocab_shards
+    )
+    # Swap the estimate's full-logits term for the chunked one.
+    logits_full = batch_local * seq_local * cfg.vocab_size * 4 // vocab_shards
+    if loss_chunk > 0:
+        chunk = min(loss_chunk, seq_local)
+        base -= logits_full
+        base += batch_local * chunk * cfg.vocab_size * 4 // vocab_shards
+    if remat_policy == "dots":
+        # The policy's saved matmul outputs live across the whole
+        # backward (the recompute workspace does not); the widest save
+        # per layer is the gate/up intermediate.
+        base += cfg.n_layers * batch_local * seq_local * cfg.intermediate * 2
+    if impl == "xla":
+        # Materialized f32 scores + probs for one (remat'd) layer.
+        base += 2 * batch_local * cfg.n_heads * seq_local * seq_local * 4
+    spp = _STATE_BYTES_PER_PARAM.get(optimizer, _STATE_BYTES_DEFAULT)
+    state = cfg.n_params() * spp // max(n_devices, 1)
+    return state + base
+
+
+def _step_cost(impl: str, remat_policy: str, loss_chunk: int,
+               flash_block: Optional[int], seq_len: int) -> float:
+    """Relative step-time model, lower = faster. Coarse on purpose: it
+    only has to ORDER the feasible points, and the dominant effects
+    (minimal-remat recompute, xla's O(S^2) traffic, chunked lm_head
+    recompute) are an order louder than anything it ignores."""
+    cost = 1.0
+    if remat_policy == "minimal":
+        cost *= 1.12  # full-layer backward recompute
+    if impl == "xla":
+        cost *= 1.0 + 0.25 * (seq_len / 8192.0)  # S^2 HBM traffic
+    elif impl == "ulysses":
+        cost *= 1.02  # two all-to-alls vs the ring's overlapped ppermute
+    if loss_chunk > 0:
+        # One extra lm_head matmul per chunk in the backward, plus scan
+        # overhead that grows as chunks shrink.
+        cost *= 1.03 + 0.01 * min(seq_len / max(loss_chunk, 1), 16) / 16
+    if flash_block is not None:
+        cost *= 1.0 + 0.02 * (128.0 / flash_block)  # smaller tile, more
+        # grid steps and revisits of the online-softmax state
+    return cost
+
+
+def tune_train_config(
+    cfg,
+    batch_size: int,
+    seq_len: int,
+    *,
+    n_devices: int = 1,
+    chip: str = "v5e",
+    hbm_bytes: Optional[int] = None,
+    sequence_shards: int = 1,
+    vocab_shards: int = 1,
+    on_tpu: bool = True,
+    optimizer: str = "adafactor",
+) -> TuneResult:
+    """Pick the fastest (attention_impl, remat_policy, loss_chunk,
+    flash_block) predicted to fit ``chip``'s HBM at this geometry.
+
+    Candidates whose predicted per-device bytes exceed the usable HBM
+    budget are pruned via the ``parallel.memory`` model; survivors are
+    ranked by the coarse step-time model. When NOTHING fits, the
+    minimum-memory point is returned (feasibility is a prediction, not
+    a guarantee -- better to run the best-effort config than refuse).
+    """
+    budget = int((hbm_bytes or HBM_BYTES.get(chip, HBM_BYTES["v5e"]))
+                 * _USABLE_HBM_FRACTION)
+    batch_local = max(batch_size // max(n_devices // sequence_shards, 1), 1)
+    cands = candidate_lattice(
+        seq_len, sequence_shards=sequence_shards, on_tpu=on_tpu
+    )
+    scored = []
+    for impl, remat, chunk, block in cands:
+        bytes_ = predict_step_bytes(
+            cfg, batch_local, seq_len,
+            impl=impl, remat_policy=remat, loss_chunk=chunk,
+            n_devices=n_devices, sequence_shards=sequence_shards,
+            vocab_shards=vocab_shards, optimizer=optimizer,
+        )
+        cost = _step_cost(impl, remat, chunk, block, seq_len)
+        scored.append((bytes_ <= budget, cost, bytes_,
+                       (impl, remat, chunk, block)))
+    feasible = [s for s in scored if s[0]]
+    if feasible:
+        _, _, bytes_, best = min(feasible, key=lambda s: (s[1], s[2]))
+    else:
+        _, _, bytes_, best = min(scored, key=lambda s: (s[2], s[1]))
+    impl, remat, chunk, block = best
+    return TuneResult(
+        attention_impl=impl,
+        remat_policy=remat,
+        loss_chunk=chunk,
+        flash_block=block,
+        predicted_hbm_bytes=int(bytes_),
+        hbm_budget_bytes=budget,
+        n_candidates=len(cands),
+        n_feasible=len(feasible),
+    )

@@ -17,14 +17,15 @@ forward). The reference's GPU LLM path is huggingfaceserver+vLLM (SURVEY.md
   defeats fusion; Smax bounds the slab).
 - **Donated cache buffers.** decode/insert donate the cache so XLA updates
   it in place in HBM -- no per-token cache copies.
-- **Depth-1 dispatch pipeline.** The decode block hands back its final
-  token/position carry as DEVICE arrays; the scheduler chains them into
-  the next block's dispatch, starts the outputs streaming home with
-  copy_to_host_async, and only then consumes the previous block (EOS /
-  stop detection, logprobs, stream callbacks) while the new block runs.
-  Slots that finish mid-flight produce bounded overshoot the host
-  already discards by design, and decode sampling keys are a pure
-  function of (request nonce, position), so pipeline_depth=1 emits
+- **Depth-N dispatch pipeline.** The decode block hands back its final
+  token/position carry as DEVICE arrays; the scheduler chains up to
+  pipeline_depth successor blocks into a lane deque, starts their
+  outputs streaming home with copy_to_host_async, and only then
+  consumes the oldest block (EOS / stop detection, logprobs, stream
+  callbacks) while the queued blocks run. Slots that finish mid-flight
+  produce overshoot the host discards by design -- bounded per drain by
+  drain_overshoot_bound -- and decode sampling keys are a pure function
+  of (request nonce, position), so ANY pipeline_depth emits
   bit-identical streams to pipeline_depth=0. Admissions, constraint
   mode, and spec-decode drain the pipeline first (docs/SERVING.md).
 - **Layer-stacked params + lax.scan** over layers: mirrors the training
@@ -38,6 +39,7 @@ linen -- inference wants explicit state, not module state.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import itertools
 import logging
@@ -1448,14 +1450,16 @@ class Request:
 
 @dataclasses.dataclass
 class _Inflight:
-    """One dispatched-but-unconsumed decode block (pipeline_depth=1).
+    """One dispatched-but-unconsumed decode block (a pipeline lane).
 
     ``outs`` are DEVICE arrays still streaming home; ``last``/``lens``
     are the block's final token/position carry, kept on device so the
     next block can chain off them without a host round trip. The
     sampling lane arrays ride along because a chained dispatch reuses
     them verbatim -- no host state changed between the two dispatches,
-    so re-packing would produce identical arrays anyway.
+    so re-packing would produce identical arrays anyway. At
+    pipeline_depth=N up to N of these sit queued in the engine's lane
+    deque (oldest first) behind the block being consumed.
     """
 
     n: int
@@ -1501,6 +1505,7 @@ class GenerationEngine:
         kv_quant: Optional[str] = None,
         streaming_init: bool = False,
         pipeline_depth: int = 1,
+        drain_overshoot_bound: Optional[int] = None,
     ) -> None:
         # Max decode steps fused into one device program (power-of-2
         # sub-blocks keep the compile count bounded); 1 = per-token
@@ -1908,11 +1913,26 @@ class GenerationEngine:
         self.ttft_hist = LatencyHistogram()
         self.itl_hist = LatencyHistogram()
         # -- overlapped dispatch pipeline ------------------------------
-        # 0 = fully sequential (dispatch, sync, consume); 1 = keep one
-        # decode block in flight and consume the previous block's
-        # host-bound outputs while it runs. Depth >1 buys nothing (one
-        # block already covers the host work) so the knob clamps.
-        self.pipeline_depth = min(1, max(0, int(pipeline_depth)))
+        # 0 = fully sequential (dispatch, sync, consume); N >= 1 keeps
+        # up to N decode blocks in flight behind the one being consumed,
+        # each chained off the previous block's device-resident carry.
+        # Depth 1 hides one block's host consume; deeper lanes cover
+        # consumes that occasionally outlast a block (logprob-heavy
+        # batches, slow stream callbacks, dispatch-tunnel jitter) at the
+        # cost of more discarded overshoot when a drain hits -- which
+        # drain_overshoot_bound caps.
+        self.pipeline_depth = max(0, int(pipeline_depth))
+        # Device-computed tokens at risk BEYOND the block being consumed
+        # (what a mid-flight finish throws away per freed lane, per
+        # drain). _pipeline_fill shrinks chained blocks to fit the
+        # remaining budget, so deep pipelines queue smaller blocks near
+        # the bound instead of stalling. None -> 2 * decode_block (depth
+        # 1 is never clamped: one queued block always fits); <= 0
+        # disables the bound -- visible in overshoot_max_per_drain,
+        # which the perf ratchet (analysis/perf_baseline.json) caps.
+        if drain_overshoot_bound is None:
+            drain_overshoot_bound = 2 * self.decode_block
+        self.drain_overshoot_bound = int(drain_overshoot_bound)
         # Per-request sampling nonces (see _decode_block): a plain
         # itertools counter -- CPython-atomic, so submit() needs no lock.
         self._req_counter = itertools.count()
@@ -1922,12 +1942,19 @@ class GenerationEngine:
         self._decode_rng = jax.random.fold_in(
             jax.random.PRNGKey(seed), 0xDEC0DE
         )
-        self._inflight = None  # _InflightBlock | None
+        # Queued in-flight lanes, oldest first (consumed FIFO). Length
+        # is bounded by pipeline_depth; stats() exports it live as
+        # dispatch_inflight.
+        self._inflight: collections.deque = collections.deque()
         self._drain_reason = ""  # why _pipeline_next last returned 0
         self._gap_t: Optional[float] = None
         self.decode_dispatches = 0
         self.host_gap_ms_ema: Optional[float] = None
         self.overshoot_tokens_discarded = 0
+        # Largest queued-lane discard of any single drain event (the
+        # depth-dependent part of overshoot; head-block overshoot exists
+        # at depth 0 too and is excluded).
+        self.overshoot_max_per_drain = 0
 
     # -- scheduling core ---------------------------------------------------
 
@@ -2524,18 +2551,22 @@ class GenerationEngine:
             "tokens_generated": self.tokens_generated,
             "requests_finished": self.requests_finished,
             # Overlapped-dispatch pipeline gauges (docs/SERVING.md):
-            # configured depth, EMA of the host-side bubble between a
-            # block's outputs landing and the next dispatch (the gap
-            # depth-1 exists to hide), and tokens decoded past a
-            # request's accepted stream (EOS/budget overshoot +
-            # mid-flight-freed lanes -- discarded by design).
+            # CONFIGURED depth vs the LIVE queued-lane count, EMA of the
+            # host-side bubble between a block's outputs landing and
+            # the next dispatch (the gap the pipeline exists to hide),
+            # tokens decoded past a request's accepted stream
+            # (EOS/budget overshoot + mid-flight-freed lanes --
+            # discarded by design), and the worst single-drain
+            # queued-lane discard (bounded by drain_overshoot_bound).
             "dispatch_depth": self.pipeline_depth,
+            "dispatch_inflight": len(self._inflight),
             "decode_dispatches": self.decode_dispatches,
             "host_gap_ms_ema": (
                 round(self.host_gap_ms_ema, 3)
                 if self.host_gap_ms_ema is not None else 0.0
             ),
             "overshoot_tokens_discarded": self.overshoot_tokens_discarded,
+            "overshoot_max_per_drain": self.overshoot_max_per_drain,
         }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
@@ -2568,14 +2599,14 @@ class GenerationEngine:
     def step(self) -> bool:
         """Admit pending, then run one mixed dispatch: a fused
         chunk+decode program when any slot is mid-prefill, else a pure
-        decode block. With ``pipeline_depth=1`` at slot saturation the
-        NEXT block is chained off the current one's device-resident
-        carry before its outputs are consumed, so the host work
-        (EOS/stop detection, logprobs, stream callbacks) overlaps the
-        chained block's device time; the chained block is left in
-        flight for the next step. Returns True if work ran."""
+        decode block. With ``pipeline_depth>=1`` at slot saturation up
+        to that many NEXT blocks are chained off the current one's
+        device-resident carry before its outputs are consumed, so the
+        host work (EOS/stop detection, logprobs, stream callbacks)
+        overlaps the queued blocks' device time; queued blocks are left
+        in flight for later steps. Returns True if work ran."""
 
-        if self._inflight is not None:
+        if self._inflight:
             return self._pipeline_step()
         self._admit()
         if self.prefilling:
@@ -2638,46 +2669,80 @@ class GenerationEngine:
         return True
 
     def _pipeline_step(self) -> bool:
-        fl = self._inflight
-        self._inflight = None
-        self._pipeline_advance(fl)
+        self._pipeline_advance(self._inflight.popleft())
         return True
 
     def _pipeline_advance(self, fl: _Inflight) -> None:
-        """Consume block N with block N+1 already on device: chain the
-        next dispatch off N's device carry FIRST (stream callbacks must
-        never sit between two dispatches), then materialize and emit
-        N's outputs while N+1 runs. Every step thus emits exactly one
+        """Consume block N with its successors already on device: top
+        up the lane deque FIRST (stream callbacks must never sit
+        between two dispatches), then materialize and emit N's outputs
+        while the queued lanes run. Every step thus emits exactly one
         block -- same cadence as depth-0 -- whether it entered with a
         fresh dispatch or an in-flight one. Any finish discovered
-        during the consume drains the chained block immediately: a
+        during the consume drains every queued lane immediately: a
         freed slot must never be re-admitted under a still-in-flight
         stale lane."""
-        n_next = self._pipeline_next(fl)
-        if n_next == 0:
+        self._pipeline_fill(fl)
+        if not self._inflight:
             self._consume_block(fl, behind=False,
                                 drain=self._drain_reason)
             return
-        nxt = self._dispatch_chained(fl, n_next)
         fins = self.requests_finished
         self._consume_block(fl, behind=True)
         if self.requests_finished != fins:
             # Mid-flight finish (EOS before the predicted budget):
             # drain now; the freed lane's overshoot is discarded whole.
-            self._consume_block(nxt, behind=False,
-                                drain="mid-flight-finish")
-        else:
-            self._copy_async(nxt)
-            self._inflight = nxt
+            self._drain_inflight("mid-flight-finish")
 
-    def _pipeline_next(self, fl: _Inflight) -> int:
-        """Size of the block to chain onto an in-flight one, or 0 to
-        drain. Mirrors step()'s own block-size choice under the
-        PREDICTED post-block state (host lengths/generated trail the
-        device by ``fl.n`` until the consume); any event the chained
-        dispatch couldn't honor -- an admission, a constraint turning
-        on, spec eligibility, a predicted in-block finish -- forces a
-        drain back to the sequential path."""
+    def _pipeline_fill(self, fl: _Inflight) -> None:
+        """Chain blocks off the deepest in-flight carry until the lane
+        deque holds ``pipeline_depth`` blocks, the drain predicate says
+        stop, or the next block would push queued-token exposure past
+        ``drain_overshoot_bound``. Near the bound chained blocks SHRINK
+        (power-of-2) rather than stop, so a deep pipeline keeps lanes
+        queued at reduced block size instead of collapsing to depth 1."""
+        while len(self._inflight) < self.pipeline_depth:
+            queued = sum(b.n for b in self._inflight)
+            n = self._pipeline_next(fl.n + queued)
+            if n == 0:
+                return
+            if self.drain_overshoot_bound > 0:
+                while n > self.drain_overshoot_bound - queued:
+                    n //= 2
+                if n < 1:
+                    self._drain_reason = "overshoot-bound"
+                    return
+            tail = self._inflight[-1] if self._inflight else fl
+            nxt = self._dispatch_chained(tail, n)
+            self._copy_async(nxt)
+            self._inflight.append(nxt)
+
+    def _drain_inflight(self, reason: str) -> None:
+        """Consume every queued lane now, oldest first (emission order
+        is dispatch order, so non-finished slots' tokens stay exact).
+        A freed slot's tokens in these lanes are discarded whole by
+        _emit_decode_outs; the per-drain queued-lane discard delta
+        feeds overshoot_max_per_drain, the gauge the perf ratchet
+        bounds (an unbounded pipeline shows up there, not in a hang)."""
+        before = self.overshoot_tokens_discarded
+        while self._inflight:
+            blk = self._inflight.popleft()
+            if self._inflight:
+                self._consume_block(blk, behind=True)
+            else:
+                self._consume_block(blk, behind=False, drain=reason)
+        delta = self.overshoot_tokens_discarded - before
+        if delta > self.overshoot_max_per_drain:
+            self.overshoot_max_per_drain = delta
+
+    def _pipeline_next(self, n_pending: int) -> int:
+        """Size of the next block to chain, or 0 to drain. Mirrors
+        step()'s own block-size choice under the PREDICTED state after
+        every in-flight block lands (host lengths/generated trail the
+        device by ``n_pending`` tokens until the consumes); any event a
+        chained dispatch couldn't honor -- an admission, a constraint
+        turning on, spec eligibility, a predicted in-block finish --
+        forces a drain back to the sequential path."""
         if self.pipeline_depth < 1 or not self.active or self.prefilling:
             self._drain_reason = ("prefilling" if self.prefilling
                                   else "idle" if not self.active
@@ -2701,7 +2766,7 @@ class GenerationEngine:
         ):
             self._drain_reason = "spec-eligible"
             return 0  # the drained batch takes the spec path instead
-        n_prev = fl.n
+        n_prev = n_pending
         rem_pred = min(
             self.cfg.max_seq - int(self.lengths[slot]) - n_prev
             for slot in self.active
@@ -2759,7 +2824,7 @@ class GenerationEngine:
         np.asarray sync this method already performs and adds none."""
         with trace.span("decode-block.consume", plane="serving",
                         track="engine", n=fl.n,
-                        depth=1 if behind else 0, drain=drain):
+                        depth=len(self._inflight), drain=drain):
             if fl.want_lp:
                 outs = tuple(np.asarray(o) for o in fl.outs)
             else:
@@ -2884,7 +2949,7 @@ class GenerationEngine:
         a dropped engine waits for the cyclic GC while its multi-GB HBM
         buffers stay live, and the next engine OOMs. Unusable after."""
         self.stop()
-        self._inflight = None  # holds device outs + the chain carry
+        self._inflight.clear()  # lanes hold device outs + chain carries
         self.weights = None
         self.cache_k = None
         self.cache_v = None

@@ -291,8 +291,11 @@ class JaxLLMModel(Model):
             quantize=opts.get("quantize") or None,
             kv_quant=opts.get("kv_quant") or None,
             # Overlapped decode dispatch (docs/SERVING.md): 0 restores
-            # the fully sequential dispatch-sync-consume loop.
+            # the fully sequential dispatch-sync-consume loop; N >= 2
+            # queues deeper lane deques with drain_overshoot_bound
+            # capping per-drain discarded tokens.
             pipeline_depth=int(opts.get("pipeline_depth", 1)),
+            drain_overshoot_bound=opts.get("drain_overshoot_bound"),
             mesh=mesh,
         )
         if config is not None:
@@ -354,10 +357,15 @@ class JaxLLMModel(Model):
         eng = self.engine
         gap = eng.host_gap_ms_ema
         return {
+            # Configured depth vs the LIVE queued-lane count: inflight
+            # == depth means the pipeline is saturated; 0 at depth > 0
+            # means it is draining (admissions/constraints/spec).
             "dispatch_depth": eng.pipeline_depth,
+            "dispatch_inflight": len(eng._inflight),
             "decode_dispatches": eng.decode_dispatches,
             "host_gap_ms_ema": round(gap, 3) if gap is not None else 0.0,
             "overshoot_tokens_discarded": eng.overshoot_tokens_discarded,
+            "overshoot_max_per_drain": eng.overshoot_max_per_drain,
         }
 
     def prom_metrics(self) -> List[str]:
@@ -388,15 +396,19 @@ class JaxLLMModel(Model):
              "prefill_backlog_tokens"),
             ("kftpu_engine_tokens_generated_total", "tokens_generated"),
             ("kftpu_engine_requests_finished_total", "requests_finished"),
-            # Dispatch-pipeline gauges: configured depth, EMA of the
-            # host bubble between a block landing and the next dispatch
-            # (~0 when overlapped), and tokens decoded past accepted
-            # streams (EOS/budget overshoot -- discarded by design).
+            # Dispatch-pipeline gauges: configured depth + live queued
+            # lanes, EMA of the host bubble between a block landing and
+            # the next dispatch (~0 when overlapped), tokens decoded
+            # past accepted streams (EOS/budget overshoot -- discarded
+            # by design), and the worst per-drain queued-lane discard.
             ("kftpu_engine_dispatch_depth", "dispatch_depth"),
+            ("kftpu_engine_dispatch_inflight", "dispatch_inflight"),
             ("kftpu_engine_decode_dispatches_total", "decode_dispatches"),
             ("kftpu_engine_host_gap_ms", "host_gap_ms_ema"),
             ("kftpu_engine_overshoot_tokens_total",
              "overshoot_tokens_discarded"),
+            ("kftpu_engine_overshoot_max_per_drain",
+             "overshoot_max_per_drain"),
         ):
             reg.gauge(key, lab).set(s[stat])
         if "weight_bytes" in s:

@@ -414,3 +414,79 @@ def test_cli_module_entrypoint_help():
         cwd=REPO_ROOT, capture_output=True, text=True,
     )
     assert proc.returncode == 0 and "--strict" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Perf-curve ratchet (analysis/perf.py): the committed bench curves are
+# CI contracts. Shipped floors pass against shipped artifacts; a planted
+# regression fails `kftpu analyze --strict` with exit 1.
+# ---------------------------------------------------------------------------
+
+def test_perf_shipped_baseline_passes_shipped_artifacts():
+    baseline = analysis.load_perf_baseline()
+    assert baseline, "committed perf_baseline.json must load"
+    findings, measured = analysis.check_perf(baseline)
+    assert findings == [], [f.message for f in findings]
+    # The floors actually looked at data (non-vacuous skip detection).
+    assert any(k.startswith("train.mfu.seq") for k in measured)
+    assert any(k.startswith("serving.tok_s.slots") for k in measured)
+
+
+def test_perf_planted_mfu_regression_exits_one(monkeypatch, capsys, tmp_path):
+    bad = analysis.load_perf_baseline()
+    bad["train"]["mfu_floor_by_seq"]["8192"] = 0.99
+    p = tmp_path / "perf.json"
+    p.write_text(json.dumps(bad))
+    rc, out = _run_cli(monkeypatch, capsys, [], {},
+                       ["--strict", "--json", "--perf-baseline", str(p)])
+    assert rc == 1
+    doc = json.loads(out)
+    assert doc["clean"] is False
+    assert any(f["rule"] == "KT-PERF-MFU" and f["hard"]
+               for f in doc["new"])
+
+
+def test_perf_planted_serving_regression_exits_one(monkeypatch, capsys,
+                                                   tmp_path):
+    bad = analysis.load_perf_baseline()
+    bad["serving"]["tok_s_floor_by_slots"]["256"] = 1e9
+    p = tmp_path / "perf.json"
+    p.write_text(json.dumps(bad))
+    rc, out = _run_cli(monkeypatch, capsys, [], {},
+                       ["--strict", "--json", "--perf-baseline", str(p)])
+    assert rc == 1
+    assert any(f["rule"] == "KT-PERF-TOKS"
+               for f in json.loads(out)["new"])
+
+
+def test_perf_vanished_sweep_row_is_a_finding(tmp_path):
+    # A curve that silently shrinks (row dropped/errored) trips the floor.
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "parsed": {"extra": {"seq_len": 1024, "mfu": 0.7, "seq_sweep": [
+            {"seq_len": 8192, "mfu": None, "error": "OOM"},
+        ]}},
+    }))
+    baseline = {"train": {"mfu_floor_by_seq": {"1024": 0.6, "8192": 0.5}}}
+    findings, _ = analysis.check_perf(baseline, root=str(tmp_path))
+    assert [f.rule for f in findings] == ["KT-PERF-MFU"]
+    assert "8192" in findings[0].message
+
+
+def test_perf_ceilings_check_live_metrics():
+    baseline = {"ceilings": {"serve.host_syncs_per_block.d4": 1.0}}
+    ok, _ = analysis.check_perf(baseline,
+                                metrics={"serve.host_syncs_per_block.d4": 1.0})
+    assert ok == []
+    bad, _ = analysis.check_perf(baseline,
+                                 metrics={"serve.host_syncs_per_block.d4": 1.5})
+    assert [f.rule for f in bad] == ["KT-PERF-CEIL"]
+    # Metric not produced this run (--no-trace / --no-serving): skip.
+    skipped, measured = analysis.check_perf(baseline, metrics={})
+    assert skipped == [] and measured == {}
+
+
+def test_perf_missing_artifact_files_skip_quietly(tmp_path):
+    # Installed-package case: no bench history on disk, no findings.
+    findings, measured = analysis.check_perf(
+        analysis.load_perf_baseline(), root=str(tmp_path), metrics={})
+    assert findings == [] and measured == {}

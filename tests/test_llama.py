@@ -170,6 +170,32 @@ def test_chunked_cross_entropy_matches_straight():
         )
 
 
+def test_chunked_cross_entropy_ragged_tail_exact():
+    """A seq length that does not divide loss_chunk must not raise: the
+    masked tail chunk must make the loss exactly match an unpadded
+    divisor-chunk evaluation (same tokens, same divisor)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models.llama import chunked_cross_entropy
+
+    b, s, h, v = 2, 28, 16, 64  # 28 % 8 == 4: ragged tail
+    hidden = jax.random.normal(jax.random.PRNGKey(0), (b, s, h),
+                               jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (h, v), jnp.float32)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (b, s), 0, v)
+    ragged = chunked_cross_entropy(hidden, w, targets, 8)
+    exact = chunked_cross_entropy(hidden, w, targets, 4)  # divides 28
+    np.testing.assert_allclose(float(ragged), float(exact), rtol=1e-6)
+    # And under jit with grads (the production path).
+    g = jax.jit(jax.grad(
+        lambda hd: chunked_cross_entropy(hd, w, targets, 8)))(hidden)
+    assert np.all(np.isfinite(np.asarray(g)))
+    with np.testing.assert_raises(ValueError):
+        chunked_cross_entropy(hidden, w, targets, 0)
+
+
 def test_chunked_loss_train_step_runs():
     """Task plumbing: loss_chunk wires through get_task/train_step."""
     import jax

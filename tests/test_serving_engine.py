@@ -1177,13 +1177,14 @@ class TestKVQuantized:
 
 
 class TestDispatchPipeline:
-    """Depth-1 decode dispatch pipeline (docs/SERVING.md): at slot
-    saturation, block N+1 chains off block N's device-resident
-    last-token/length carry BEFORE N's outputs are consumed, so the
-    host-side emission overlaps the chained block's device time.
+    """Depth-N decode dispatch pipeline (docs/SERVING.md): at slot
+    saturation, up to N chained blocks sit in a lane deque, each
+    dispatched off the previous block's device-resident last-token/
+    length carry BEFORE that block's outputs are consumed, so host-side
+    emission overlaps the chained blocks' device time.
     Per-row nonce RNG makes sampling block-partition-invariant, so the
-    contract is BIT-identical streams vs pipeline_depth=0 -- token ids,
-    logprob records, spec stats, everything."""
+    contract is BIT-identical streams vs pipeline_depth=0 at ANY depth
+    -- token ids, logprob records, spec stats, everything."""
 
     @staticmethod
     def _drive(eng, reqs):
@@ -1308,6 +1309,122 @@ class TestDispatchPipeline:
         e0 = GenerationEngine(config=cfg, params=params, max_slots=2,
                               pipeline_depth=0)
         assert e0.stats()["dispatch_depth"] == 0
+
+    @staticmethod
+    def _max_inflight(eng):
+        """Track the deepest lane-deque occupancy seen, so depth-N tests
+        assert the pipeline genuinely went multi-lane deep."""
+        box = [0]
+        orig = eng._dispatch_chained
+
+        def counted(fl, n):
+            box[0] = max(box[0], len(eng._inflight) + 1)
+            return orig(fl, n)
+
+        eng._dispatch_chained = counted
+        return box
+
+    def test_depthN_identical_to_depth0_mixed_batch(self, tiny):
+        """Depth 2 and 4 with a saturated mixed batch -- greedy, top-k,
+        top-p, logprobs -- must be bit-identical to depth 0, and the
+        deque must actually have held more than one lane."""
+        cfg, _, _, params = tiny
+
+        def mk():
+            return [
+                Request([1, 2, 3], max_new_tokens=16),
+                Request([4, 5], max_new_tokens=16, temperature=1.0,
+                        top_k=8),
+                Request([6, 7, 8], max_new_tokens=16, temperature=0.9,
+                        top_p=0.9),
+                Request([9], max_new_tokens=16, logprobs=2),
+            ]
+
+        outs, recs = {}, {}
+        for d in (0, 2, 4):
+            eng = GenerationEngine(config=cfg, params=params, max_slots=4,
+                                   decode_block=4, pipeline_depth=d,
+                                   drain_overshoot_bound=4 * d if d else None)
+            box = self._max_inflight(eng)
+            reqs = mk()
+            outs[d] = self._drive(eng, reqs)
+            recs[d] = [r.logprob_data for r in reqs]
+            if d:
+                assert box[0] > 1, "pipeline never went multi-lane deep"
+        for d in (2, 4):
+            assert outs[d] == outs[0]
+            assert recs[d] == recs[0]
+
+    def test_depthN_identical_spec_path(self, tiny):
+        """Speculative decoding under a deep pipeline: streams AND
+        acceptance stats must match depth 0 exactly."""
+        cfg, _, _, params = tiny
+        got = {}
+        for d in (0, 2, 4):
+            eng = GenerationEngine(config=cfg, params=params, max_slots=2,
+                                   decode_block=8, speculative_k=2,
+                                   pipeline_depth=d)
+            o = self._drive(eng, [Request([1, 2, 3], max_new_tokens=16),
+                                  Request([7, 8], max_new_tokens=16)])
+            got[d] = (o, eng.spec_steps, eng.spec_emitted)
+        for d in (2, 4):
+            assert got[d] == got[0]
+        assert got[0][1] > 0  # the spec path actually ran
+
+    def test_depthN_midflight_eos_bounded_overshoot(self, tiny):
+        """EOS mid-block with queued lanes in flight: the drain must be
+        exact (streams match depth 0) and the per-drain queued-lane
+        discard must respect drain_overshoot_bound."""
+        cfg, _, _, params = tiny
+        ref = GenerationEngine(config=cfg, params=params, max_slots=2,
+                               pipeline_depth=0)
+        probe = ref.generate([4, 5, 6], max_new_tokens=12)
+        eos = probe[8]  # finishes at token 9 of 16: mid-block, mid-deque
+        got = {}
+        for d in (0, 2, 4):
+            bound = 2 * d if d else None
+            eng = GenerationEngine(config=cfg, params=params, max_slots=2,
+                                   decode_block=4, pipeline_depth=d,
+                                   drain_overshoot_bound=bound)
+            o = self._drive(eng,
+                            [Request([4, 5, 6], max_new_tokens=16,
+                                     eos_id=eos),
+                             Request([10, 11], max_new_tokens=16)])
+            reuse = eng.generate([4, 5, 6], max_new_tokens=6)
+            got[d] = (o, reuse)
+            if d:
+                assert eng.overshoot_max_per_drain <= bound
+        for d in (2, 4):
+            assert got[d] == got[0]
+        assert got[0][0][0][-1] == eos  # the EOS really fired mid-run
+
+    def test_unbounded_drain_caught_by_perf_ratchet(self, tiny):
+        """Non-vacuity for the perf ceiling: disable the overshoot bound
+        (drain_overshoot_bound <= 0), force a deep mid-flight drain, and
+        the shipped perf_baseline ceiling must flag it as a hard
+        KT-PERF-CEIL finding. A ratchet that can't fire is no ratchet."""
+        from kubeflow_tpu import analysis
+
+        cfg, _, _, params = tiny
+        ref = GenerationEngine(config=cfg, params=params, max_slots=2,
+                               pipeline_depth=0)
+        probe = ref.generate([4, 5, 6], max_new_tokens=12)
+        eos = probe[8]
+        eng = GenerationEngine(config=cfg, params=params, max_slots=2,
+                               decode_block=8, pipeline_depth=4,
+                               drain_overshoot_bound=-1)
+        self._drive(eng, [Request([4, 5, 6], max_new_tokens=40, eos_id=eos),
+                          Request([10, 11], max_new_tokens=40)])
+        worst = eng.stats()["overshoot_max_per_drain"]
+        ceilings = analysis.load_perf_baseline()["ceilings"]
+        assert worst > ceilings["serve.overshoot_max_per_drain"], (
+            "unbounded deep drain did not exceed the shipped ceiling -- "
+            "the non-vacuity scenario needs retuning")
+        findings, _ = analysis.check_perf(
+            {"ceilings": ceilings},
+            metrics={"serve.overshoot_max_per_drain": float(worst)})
+        assert [f.rule for f in findings] == ["KT-PERF-CEIL"]
+        assert all(f.hard for f in findings)
 
     def test_vectorized_emission_matches_per_token_path(self, tiny):
         """A no-op stop_fn forces the per-token emission loop; without

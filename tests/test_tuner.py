@@ -1,0 +1,125 @@
+"""Per-seq-len training-config tuner (parallel/tuner.py).
+
+The tuner's contract: enumerate the (attention impl, remat policy,
+loss chunk, flash block) lattice, prune what the HBM model says cannot
+fit, and rank the rest so the bench's sweep rows stop hand-pinning
+memory knobs. These tests pin the *behavioral* properties -- monotone
+memory response, correct pruning direction, the known hand-pins being
+re-derived -- not exact byte counts.
+"""
+
+import pytest
+
+from kubeflow_tpu.models.llama import PRESETS
+from kubeflow_tpu.parallel.tuner import (
+    TuneResult,
+    candidate_lattice,
+    predict_step_bytes,
+    tune_train_config,
+)
+
+
+def test_lattice_respects_mesh_and_backend():
+    flat = candidate_lattice(8192, sequence_shards=1, on_tpu=True)
+    impls = {c[0] for c in flat}
+    assert impls == {"flash", "xla"}
+    # flash rows get block candidates, xla rows don't.
+    assert any(c[0] == "flash" and c[3] is not None for c in flat)
+    assert all(c[3] is None for c in flat if c[0] == "xla")
+
+    cp = candidate_lattice(8192, sequence_shards=4, on_tpu=True)
+    assert {c[0] for c in cp} == {"ring", "ulysses"}
+
+    cpu = candidate_lattice(8192, sequence_shards=1, on_tpu=False)
+    assert {c[0] for c in cpu} == {"xla"}
+
+
+def test_lattice_prefers_divisor_chunks():
+    for _, _, chunk, _ in candidate_lattice(8192):
+        assert chunk == 0 or 8192 % chunk == 0
+
+
+def test_memory_model_orders_the_knobs():
+    """Each knob must move predicted bytes the documented direction."""
+    cfg = PRESETS["llama3-8b-proxy"]
+    kw = dict(n_devices=1, impl="flash", remat_policy="dots", loss_chunk=0)
+    base = predict_step_bytes(cfg, 1, 8192, **kw)
+    chunked = predict_step_bytes(cfg, 1, 8192, **{**kw, "loss_chunk": 1024})
+    minimal = predict_step_bytes(
+        cfg, 1, 8192, **{**kw, "remat_policy": "minimal"})
+    xla = predict_step_bytes(cfg, 1, 8192, **{**kw, "impl": "xla"})
+    assert chunked < base      # chunked CE drops the full f32 logits
+    assert minimal < base      # minimal remat drops the saved dots
+    assert xla > base          # xla materializes the S^2 scores
+    # Sequence sharding shrinks the local activation footprint.
+    shard = predict_step_bytes(
+        cfg, 1, 8192, n_devices=4, impl="ring", remat_policy="dots",
+        loss_chunk=0, sequence_shards=4)
+    assert shard < base
+
+
+def test_tuner_rederives_the_8192_hand_pin():
+    """The row bench.py used to pin by hand (proxy preset, batch 1, seq
+    8192 on a 16 GB chip) must come out of the tuner as a chunked-loss
+    config that the HBM model predicts to fit -- and with feasible
+    candidates actually pruned (the full-logits points are infeasible)."""
+    cfg = PRESETS["llama3-8b-proxy"]
+    r = tune_train_config(cfg, 1, 8192, n_devices=1, chip="v5e")
+    assert isinstance(r, TuneResult)
+    assert r.loss_chunk > 0
+    assert r.predicted_hbm_bytes <= r.hbm_budget_bytes
+    assert 0 < r.n_feasible < r.n_candidates
+    assert r.attention_impl == "flash"  # xla's S^2 scores cannot fit
+
+
+def test_tuner_short_seq_picks_the_fast_path():
+    """At seq 1024 everything fits, so the ranker must not reach for the
+    memory levers (chunk 0, dots remat -- the measured-fastest config)."""
+    cfg = PRESETS["llama3-8b-proxy"]
+    r = tune_train_config(cfg, 5, 1024, n_devices=1, chip="v5e")
+    assert r.n_feasible > 0
+    assert r.loss_chunk == 0
+    assert r.remat_policy == "dots"
+
+
+def test_tuner_infeasible_falls_back_to_min_memory():
+    """When nothing fits (full 8B on one 16 GB chip) the tuner returns
+    the minimum-memory point instead of refusing."""
+    cfg = PRESETS["llama3-8b"]
+    r = tune_train_config(cfg, 1, 8192, n_devices=1, chip="v5e")
+    assert r.n_feasible == 0
+    assert r.remat_policy == "minimal" and r.loss_chunk > 0
+
+
+def test_tuner_sequence_axis_uses_context_parallel():
+    cfg = PRESETS["llama3-8b"]
+    r = tune_train_config(cfg, 2, 8192, n_devices=8, sequence_shards=4,
+                          chip="v5e")
+    assert r.attention_impl in ("ring", "ulysses")
+
+
+def test_task_kwargs_round_trip_into_config():
+    """TuneResult.task_kwargs must be accepted by get_task and land on
+    the model config (the bench's actual consumption path)."""
+    from kubeflow_tpu.models import get_task
+
+    cfg = PRESETS["llama-tiny"]
+    r = tune_train_config(cfg, 2, 64, n_devices=1, on_tpu=False)
+    kw = r.task_kwargs()
+    chunk = kw.pop("loss_chunk")
+    task = get_task("llama", preset="llama-tiny", batch_size=2,
+                    seq_len=64, loss_chunk=chunk, **kw)
+    assert task.cfg.attention_impl == r.attention_impl
+    assert task.cfg.flash_block == r.flash_block
+    assert task.cfg.remat_policy == r.remat_policy
+
+
+@pytest.mark.parametrize("block,expect", [(None, 512), (256, 256),
+                                          (200, 128), (64, 128)])
+def test_flash_block_cap_degrades_gracefully(block, expect):
+    """The flash kernel's block override is a cap, not a hard set: an
+    untileable request degrades to the best legal tile."""
+    pytest.importorskip("jax.experimental.pallas.ops.tpu.flash_attention")
+    from kubeflow_tpu.ops.flash_attention import _block_sizes
+
+    assert _block_sizes(1024, 1024, block).block_q == expect
