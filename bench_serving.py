@@ -1068,6 +1068,661 @@ FRONTIER_BLOCKS = tuple(
 )
 
 
+# ---------------------------------------------------------------------------
+# Fleet phase: multi-replica data plane (prefix-affinity router over N
+# worker replicas in subprocesses; docs/FLEET.md)
+# ---------------------------------------------------------------------------
+#
+# One chip (or one CPU core) cannot host two compute-bound engines, so
+# the scaling arms run CALIBRATED SIMULATION workers: each worker is a
+# real subprocess with the REAL PrefixCache, real queueing (slot thread
+# pool + serialized prefill admission, the engine's actual admission
+# shape), and service times taken from the measured single-chip sweep
+# (extra.sweep tokens/sec). What the arms measure for real: the Router's
+# placement quality (affinity hit rates, spill/steer/shed decisions,
+# per-replica balance) over real inter-process transport. What is
+# modeled: per-token compute time. Rows are annotated mode=
+# "sim-calibrated" so nobody reads them as chip throughput. The disagg
+# arm runs REAL llama-tiny engines (CPU-portable) end to end: prefill
+# replica -> KV packet -> decode replica, token-parity-checked against a
+# monolithic engine, with the admit->route->prefill->kv-handoff->decode
+# span chain stitched across all three processes.
+
+
+def _fleet_worker_main(cfg: dict) -> int:
+    """Subprocess side of the fleet phase: one replica, JSON-line RPC on
+    stdin/stdout. Ops: gen / stats / export_prefix / import_prefix /
+    stop. Sync replies carry no "id"; gen replies do (the parent routes
+    on that)."""
+    import base64
+    import queue as queue_mod
+    import threading
+
+    from kubeflow_tpu.obs import trace as obs_trace
+
+    rid = str(cfg.get("rid", "0"))
+    role = cfg.get("role", "mixed")
+    obs_trace.activate_from_env(
+        plane="serving", label=f"fleet-{cfg['backend']}-{rid}")
+    out_lock = threading.Lock()
+
+    def reply(msg):
+        with out_lock:
+            sys.stdout.write(json.dumps(msg) + "\n")
+            sys.stdout.flush()
+
+    if cfg["backend"] == "sim":
+        import numpy as np
+
+        from kubeflow_tpu.serving.engine import PrefixCache
+
+        block = int(cfg.get("block", 128))
+        pc = PrefixCache(block,
+                         int(float(cfg.get("cache_mb", 64)) * (1 << 20)))
+        pc_lock = threading.Lock()
+        max_slots = int(cfg.get("max_slots", 8))
+        scale = float(cfg.get("time_scale", 0.05))
+        prefill_rate = float(cfg.get("prefill_tok_per_s", 3000.0))
+        decode_rate = float(cfg.get("decode_tok_per_slot", 14.4))
+        q: "queue_mod.Queue" = queue_mod.Queue()
+        state = {"active": 0, "ema": None, "tokens": 0, "done": 0}
+        st_lock = threading.Lock()
+        # ONE prefill program at a time -- the engine's real admission
+        # shape, and the mechanism behind the 386 tok/s mixed-workload
+        # soft spot (a long prefill blocks every admission behind it).
+        prefill_lock = threading.Lock()
+
+        def serve():
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                t_arr, op = item
+                with st_lock:
+                    state["active"] += 1
+                prompt = list(op["prompt"])
+                ntok = int(op["new_tokens"])
+                with pc_lock:
+                    hit_plen, _entry = pc.lookup(prompt, len(prompt) - 1)
+                with prefill_lock:
+                    time.sleep((len(prompt) - hit_plen)
+                               / prefill_rate * scale)
+                ttft_ms = (time.perf_counter() - t_arr) / scale * 1000.0
+                time.sleep(ntok / decode_rate * scale)
+                covered = (len(prompt) // block) * block
+                if covered:
+                    rows = np.zeros((1, covered, 1, 1), np.int8)
+                    with pc_lock:
+                        pc.insert(prompt[:covered], rows, rows)
+                with st_lock:
+                    state["active"] -= 1
+                    state["tokens"] += ntok
+                    state["done"] += 1
+                    ema = state["ema"]
+                    state["ema"] = (
+                        ttft_ms if ema is None
+                        else 0.2 * ttft_ms + 0.8 * ema
+                    )
+                reply({"id": op["id"], "rid": rid,
+                       "ttft_ms": round(ttft_ms, 3), "tokens": ntok,
+                       "hit_len": hit_plen, "plen": len(prompt)})
+
+        threads = [threading.Thread(target=serve, daemon=True)
+                   for _ in range(max_slots)]
+        for t in threads:
+            t.start()
+        reply({"ready": True, "rid": rid})
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            op = json.loads(line)
+            if op["op"] == "gen":
+                q.put((time.perf_counter(), op))
+            elif op["op"] == "stats":
+                with st_lock:
+                    st = {
+                        "queue_depth": q.qsize(),
+                        "slots_active": state["active"],
+                        "max_slots": max_slots,
+                        "ttft_ema_ms": round(state["ema"] or 0.0, 3),
+                        "tokens_generated": state["tokens"],
+                        "requests_finished": state["done"],
+                    }
+                with pc_lock:
+                    st["cache"] = pc.stats()
+                reply({"stats": st})
+            elif op["op"] == "stop":
+                break
+        for _ in threads:
+            q.put(None)
+        for t in threads:
+            t.join(timeout=5)
+        if obs_trace.enabled():
+            obs_trace.instant(
+                "engine-stats", plane="serving", track="engine",
+                queue_depth=0, slots_active=0,
+                ttft_ema_ms=round(state["ema"] or 0.0, 3),
+                tokens_generated=state["tokens"],
+                requests_finished=state["done"])
+        reply({"stopped": True})
+        obs_trace.write_process_trace()
+        return 0
+
+    # backend == "engine": a REAL GenerationEngine (llama-tiny runs on
+    # CPU), serving ops synchronously -- the disagg arm sends one op at
+    # a time, so no slot concurrency is needed here.
+    from kubeflow_tpu.serving import router as rt
+    from kubeflow_tpu.serving.engine import GenerationEngine, Request
+
+    eng = GenerationEngine(
+        preset=cfg.get("preset", "llama-tiny"),
+        max_slots=int(cfg.get("max_slots", 2)),
+        max_seq=int(cfg.get("max_seq", 96)),
+        decode_block=int(cfg.get("decode_block", 4)),
+        prefix_cache_mb=int(cfg.get("prefix_cache_mb", 16)),
+        prefix_block=int(cfg.get("prefix_block", 8)),
+        kv_quant=cfg.get("kv_quant"),
+    )
+    reply({"ready": True, "rid": rid})
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        op = json.loads(line)
+        kind = op["op"]
+        if kind == "gen":
+            span = "decode" if role == "decode" else "generate"
+            t0 = time.perf_counter()
+            with obs_trace.span(span, plane="serving", track="engine",
+                                rid=rid):
+                fut = eng.submit(Request(
+                    prompt=list(op["prompt"]),
+                    max_new_tokens=int(op["new_tokens"]),
+                    temperature=0.0))
+                while not fut.done():
+                    eng.step()
+                toks = list(fut.result())
+            reply({"id": op["id"], "rid": rid, "tokens": toks,
+                   "ttft_ms": round((time.perf_counter() - t0) * 1000, 1),
+                   "hit_len": 0, "plen": len(op["prompt"])})
+        elif kind == "export_prefix":
+            with obs_trace.span("prefill", plane="serving",
+                                track="engine", rid=rid):
+                prompt = list(op["prompt"])
+                plen = eng.ensure_prefix(prompt)
+                pkt = eng.export_prefix(prompt) if plen else None
+            if pkt is None:
+                reply({"packet_b64": None})
+            else:
+                buf = rt.pack_kv_packet(pkt["tokens"], pkt["k"],
+                                        pkt["v"],
+                                        block=eng.prefix_cache.block)
+                reply({"packet_b64": base64.b64encode(buf).decode()})
+        elif kind == "import_prefix":
+            got = rt.unpack_kv_packet(base64.b64decode(op["packet_b64"]))
+            reply({"plen": eng.import_prefix(got)})
+        elif kind == "stats":
+            reply({"stats": eng.stats()})
+        elif kind == "stop":
+            break
+    eng.close()  # .stop() inside emits the engine-stats trace instant
+    reply({"stopped": True})
+    obs_trace.write_process_trace()
+    return 0
+
+
+class _FleetWorker:
+    """Parent-side handle on one --fleet-worker subprocess. gen replies
+    land on the shared ``done_q``; sync RPCs (stats/export/import) are
+    serialized per worker and answered on a private queue."""
+
+    def __init__(self, cfg: dict, done_q) -> None:
+        import queue as queue_mod
+        import subprocess
+        import threading
+
+        self.rid = str(cfg["rid"])
+        self.role = cfg.get("role", "mixed")
+        env = dict(os.environ)
+        # Workers NEVER take the chip: sim workers only need the
+        # PrefixCache class, and two engine workers cannot share one
+        # TPU -- llama-tiny on CPU is the point of the disagg arm.
+        env["JAX_PLATFORMS"] = "cpu"
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--fleet-worker",
+             json.dumps(cfg)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+            env=env)
+        self._done_q = done_q
+        self._sync_q: "queue_mod.Queue" = queue_mod.Queue()
+        self._wlock = threading.Lock()
+        self._rpc_lock = threading.Lock()
+        threading.Thread(target=self._read, daemon=True).start()
+
+    def _read(self) -> None:
+        for line in self.proc.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            (self._done_q if "id" in msg else self._sync_q).put(msg)
+
+    def send(self, op: dict) -> None:
+        with self._wlock:
+            self.proc.stdin.write(json.dumps(op) + "\n")
+            self.proc.stdin.flush()
+
+    def rpc(self, op: dict, timeout: float = 300.0) -> dict:
+        with self._rpc_lock:
+            self.send(op)
+            return self._sync_q.get(timeout=timeout)
+
+    def wait_ready(self, timeout: float = 600.0) -> None:
+        msg = self._sync_q.get(timeout=timeout)
+        if not msg.get("ready"):
+            raise RuntimeError(f"worker {self.rid}: bad hello {msg}")
+
+    def stop(self, timeout: float = 30.0) -> None:
+        try:
+            self.send({"op": "stop"})
+            self._sync_q.get(timeout=timeout)  # "stopped"
+            self.proc.wait(timeout=timeout)
+        except Exception:  # noqa: BLE001 - bench teardown must not hang
+            self.proc.kill()
+
+
+def _fleet_pct(xs, q):
+    import numpy as np
+
+    if not xs:
+        return 0.0
+    return round(float(np.percentile(np.asarray(xs), q)), 1)
+
+
+def _drive_fleet(workers, reqs, rate_rps, scale, router=None,
+                 route_fn=None, poll_sim_s=1.0):
+    """Open-loop Poisson driver over N workers. Arrival times and all
+    reported times are SIM-domain (wall / scale). With a router, each
+    request routes by prefix key and sheds count as offered-but-dropped;
+    otherwise route_fn(i) picks the worker."""
+    import queue as queue_mod
+    import random as random_mod
+    import threading
+
+    from kubeflow_tpu.serving import router as rt
+
+    done_q = workers[0]._done_q
+    by_rid = {w.rid: w for w in workers}
+    arrival_rng = random_mod.Random(1234)
+    stop_poll = threading.Event()
+
+    def poll():
+        while not stop_poll.is_set():
+            for w in workers:
+                try:
+                    st = w.rpc({"op": "stats"}, timeout=30).get("stats")
+                except Exception:  # noqa: BLE001 - worker churn
+                    continue
+                if router is not None and st:
+                    router.update_load(w.rid, st)
+            stop_poll.wait(poll_sim_s * scale)
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    results, shed = [], []
+    state = {"t_last": time.perf_counter()}
+
+    def record(msg):
+        results.append(msg)
+        state["t_last"] = time.perf_counter()
+        if router is not None:
+            router.finish_request(msg["rid"], ttft_ms=msg.get("ttft_ms"))
+
+    t_start = time.perf_counter()
+    t_next, in_flight, sent = t_start, 0, 0
+    for i, (prompt, ntok) in enumerate(reqs):
+        t_next += arrival_rng.expovariate(rate_rps) * scale
+        while True:
+            dt = t_next - time.perf_counter()
+            if dt <= 0:
+                break
+            try:
+                record(done_q.get(timeout=dt))
+                in_flight -= 1
+            except queue_mod.Empty:
+                break
+        if router is not None:
+            d = router.route(
+                rt.prefix_route_key(prompt, block=router.cfg.block),
+                prompt_len=len(prompt))
+            if d.kind == "shed":
+                shed.append(d.retry_after_s)
+                continue
+            rid = d.replica if d.replica in by_rid else workers[0].rid
+            router.start_request(rid)
+        else:
+            rid = route_fn(i)
+        by_rid[rid].send({"op": "gen", "id": i, "prompt": prompt,
+                          "new_tokens": ntok})
+        sent += 1
+        in_flight += 1
+    while in_flight > 0:
+        record(done_q.get(timeout=600))
+        in_flight -= 1
+    stop_poll.set()
+    poller.join(timeout=10)
+    dur_sim = max(1e-9, (state["t_last"] - t_start) / scale)
+    tokens = sum(
+        r["tokens"] if isinstance(r["tokens"], int) else len(r["tokens"])
+        for r in results)
+    ttfts = [r["ttft_ms"] for r in results]
+    per = {}
+    for r in results:
+        per[r["rid"]] = per.get(r["rid"], 0) + 1
+    out = {
+        "requests": sent,
+        "shed": len(shed),
+        "shed_rate": round(len(shed) / max(1, sent + len(shed)), 3),
+        "duration_s": round(dur_sim, 2),
+        "tokens": tokens,
+        "tokens_per_sec": round(tokens / dur_sim, 1),
+        "ttft_ms": {"p50": _fleet_pct(ttfts, 50),
+                    "p99": _fleet_pct(ttfts, 99)},
+        "prefix_hit_rate": round(
+            sum(r["hit_len"] for r in results)
+            / max(1, sum(r["plen"] for r in results)), 3),
+        "per_replica_requests": per,
+    }
+    if shed:
+        out["retry_after_s_sample"] = shed[:3]
+    if router is not None:
+        rs = router.stats()
+        out["router"] = {k: rs[k] for k in
+                         ("requests", "spilled", "steered", "shed",
+                          "disagg")}
+    return out
+
+
+def _fleet_workload(kind: str, n: int, block: int, rng):
+    """(prompt, new_tokens) list. uniform: 12 prefix families sharing 2
+    blocks + a unique tail (the repeated-system-prompt shape). mixed:
+    60% of those shorts + 40% LONG prefill-heavy prompts (15 blocks, 8
+    new tokens -- the RAG shape) that share only their FIRST block: one
+    affinity key, unique tails. Unsteered routing parks every long on
+    the same replica, whose serialized prefill admission becomes the
+    fleet bottleneck -- the multi-replica face of the single-engine
+    mixed-workload soft spot (extra.throughput_mixed's 386 tok/s)."""
+    fams = [rng.integers(1, 1000, 2 * block).tolist() for _ in range(12)]
+    long_head = rng.integers(1, 1000, block).tolist()
+    reqs = []
+    for i in range(n):
+        if kind == "mixed" and i % 5 in (3, 4):
+            prompt = long_head + rng.integers(1, 1000,
+                                              14 * block).tolist()
+            reqs.append((prompt, 8))
+        else:
+            fam = fams[int(rng.integers(0, len(fams)))]
+            prompt = fam + rng.integers(1, 1000, 32).tolist()
+            reqs.append((prompt, 64))
+    return reqs
+
+
+def bench_fleet(args: dict) -> dict:
+    import base64
+    import queue as queue_mod
+
+    import numpy as np
+
+    from kubeflow_tpu.obs import trace as obs_trace
+    from kubeflow_tpu.serving import router as rt
+
+    block = int(args.get("block", 128))
+    scale = float(args.get("time_scale", 0.05))
+    slots = int(args.get("max_slots", 8))
+    n_req = int(args.get("requests", 80))
+    prefill_rate = float(args.get("prefill_tok_per_s", 3000.0))
+    decode_rate = args.get("decode_tok_per_slot")
+    calib_src = "args"
+    if not decode_rate:
+        try:
+            here = os.path.dirname(os.path.abspath(__file__))
+            with open(os.path.join(here, "SERVING_BENCH.json")) as f:
+                prior = json.load(f)
+            best = max(prior["extra"]["sweep"],
+                       key=lambda r: r.get("tokens_per_sec", 0))
+            decode_rate = best["tokens_per_sec"] / best["max_slots"]
+            calib_src = (
+                f"SERVING_BENCH.json extra.sweep max_slots="
+                f"{best['max_slots']} on {prior['extra'].get('device')}")
+        except Exception:  # noqa: BLE001 - fresh checkout
+            decode_rate, calib_src = 14.4, "builtin default"
+    decode_rate = float(decode_rate)
+
+    def spawn(n, prefill=None, cache_mb=None):
+        done_q = queue_mod.Queue()
+        ws = [_FleetWorker({
+            "backend": "sim", "rid": str(i), "role": "mixed",
+            "block": block, "max_slots": slots, "time_scale": scale,
+            "prefill_tok_per_s": prefill or prefill_rate,
+            "decode_tok_per_slot": decode_rate,
+            "cache_mb": cache_mb if cache_mb is not None else 64,
+        }, done_q) for i in range(n)]
+        for w in ws:
+            w.wait_ready(timeout=300)
+        return ws
+
+    def run_arm(n_workers, reqs, rate, *, affinity=True, slo=None,
+                long_thr=None, prefill=None, spill=True, cache_mb=None,
+                name=""):
+        ws = spawn(n_workers, prefill=prefill, cache_mb=cache_mb)
+        try:
+            router = route_fn = None
+            if affinity:
+                router = rt.Router(rt.RouterConfig(
+                    block=block, slo_ttft_ms=slo,
+                    long_prompt_threshold=long_thr,
+                    # spill=False: PLAIN consistent hashing, the naive
+                    # baseline the queue-aware policy is judged against.
+                    spill_threshold=(1.0 if spill else 1e18),
+                ), name=name or "fleet")
+                for w in ws:
+                    router.add_replica(w.rid, role=w.role,
+                                       max_slots=slots)
+            else:
+                route_fn = lambda i: ws[i % len(ws)].rid  # noqa: E731
+            return _drive_fleet(ws, reqs, rate, scale, router=router,
+                                route_fn=route_fn)
+        finally:
+            for w in ws:
+                w.stop()
+
+    # Service-time model => arrival rates. One replica's saturated
+    # capacity with the short request (2 blocks + 32 prompt, 64 new):
+    t_short = (2 * block + 32) / prefill_rate + 64.0 / decode_rate
+    cap1 = slots / t_short                    # req/s, one replica
+    # 2.5x single capacity: N=1 saturates while N=2's arrivals stay
+    # live through most of its run, so spill can keep rebalancing --
+    # a sharper burst leaves the drain tail pinned to whichever
+    # replica the hash favored and under-reads the scaling.
+    sat_rate = 2.5 * cap1
+    paced_rate = 1.2 * cap1                   # ~60% of the N=2 fleet
+
+    rng = np.random.default_rng(7)
+    uni = _fleet_workload("uniform", n_req, block, rng)
+    n1 = run_arm(1, uni, sat_rate, name="n1")
+    n2 = run_arm(2, uni, sat_rate, name="n2")
+    # Paced hit-rate A/B runs with a BOUNDED per-replica cache (~8 of
+    # the 12 families fit): affinity keeps each family's entry resident
+    # on its home replica, while round-robin needs every family cached
+    # on BOTH replicas and churns the LRU -- the fleet-level cache
+    # composition argument (docs/FLEET.md), not just cold misses.
+    paced_cache_mb = 8 * 2 * (2 * block) / (1 << 20)
+    n2_paced = run_arm(2, uni, paced_rate, cache_mb=paced_cache_mb,
+                       name="n2-paced")
+    n2_rand = run_arm(2, uni, paced_rate, cache_mb=paced_cache_mb,
+                      affinity=False)
+    # Mixed arms model long-CONTEXT prefill (800 tok/s, the sustained
+    # long-prompt rate, vs the short-burst 3000): the serialized
+    # admission cost the queue-aware policy exists to spread. A/B is
+    # NAIVE consistent hashing (no spill, no steering -- every long
+    # piles onto its one affinity home) vs the full policy.
+    mix_prefill = float(args.get("long_prefill_tok_per_s", 800.0))
+    mixed_reqs = _fleet_workload("mixed", n_req + 24, block,
+                                 np.random.default_rng(11))
+    t_mix = (2 * block + 32) / mix_prefill + 64.0 / decode_rate
+    mix_rate = 2.5 * slots / t_mix
+    mix_naive = run_arm(2, mixed_reqs, mix_rate, prefill=mix_prefill,
+                        spill=False, name="mixed-naive")
+    mix_routed = run_arm(2, mixed_reqs, mix_rate, prefill=mix_prefill,
+                         long_thr=4 * block, name="mixed-routed")
+    # Overload: 8x one replica's capacity with a 400ms TTFT SLO. Early
+    # sheds come from the router-side in_flight pressure floor; once
+    # queued completions feed the TTFT EMA, the estimate blows past the
+    # SLO and shedding locks in.
+    overload_reqs = _fleet_workload("uniform", 150, block,
+                                    np.random.default_rng(23))
+    overload = run_arm(2, overload_reqs, 8.0 * cap1, slo=400.0,
+                       name="overload")
+
+    disagg: dict
+    if args.get("with_disagg", True):
+        disagg = _fleet_disagg_arm(base64, queue_mod, np, obs_trace, rt)
+    else:
+        disagg = {"skipped": "with_disagg=false"}
+
+    return {
+        "mode": "sim-calibrated",
+        "device": "cpu-sim",
+        "calibration": {
+            "decode_tok_per_slot": round(decode_rate, 2),
+            "prefill_tok_per_s": prefill_rate,
+            "source": calib_src,
+            "time_scale": scale,
+            "max_slots_per_replica": slots,
+        },
+        "workload": {
+            "arrivals": "poisson",
+            "uniform": f"12 families x (2x{block} shared + 32 unique) "
+                       "prompt, 64 new",
+            "mixed": f"60% uniform shorts + 40% long ({15 * block} "
+                     "prompt sharing one head block, 8 new; prefill "
+                     f"{int(float(args.get('long_prefill_tok_per_s', 800.0)))} tok/s)",
+            "requests": n_req,
+        },
+        "n1_saturated": n1,
+        "n2_saturated": n2,
+        "aggregate_speedup": round(
+            n2["tokens_per_sec"] / max(1e-9, n1["tokens_per_sec"]), 3),
+        "n2_paced": n2_paced,
+        "n2_paced_random": n2_rand,
+        "affinity_hit_rate": n2_paced["prefix_hit_rate"],
+        "random_hit_rate": n2_rand["prefix_hit_rate"],
+        "mixed": {
+            "naive_affinity": mix_naive,
+            "routed": mix_routed,
+            "routed_speedup": round(
+                mix_routed["tokens_per_sec"]
+                / max(1e-9, mix_naive["tokens_per_sec"]), 3),
+        },
+        "overload": overload,
+        "disagg": disagg,
+        "note": (
+            "sim-calibrated scaling arms: REAL Router + PrefixCache + "
+            "subprocess transport; per-token service time taken from "
+            "the measured single-chip sweep (see calibration.source). "
+            "Placement/affinity/shed numbers are real measurements of "
+            "the data plane; tokens_per_sec is sim-domain, NOT chip "
+            "throughput. disagg runs real llama-tiny engines."
+        ),
+    }
+
+
+def _fleet_disagg_arm(base64, queue_mod, np, obs_trace, rt) -> dict:
+    """Real-engine disaggregation: prefill worker -> KV packet ->
+    decode worker -> greedy decode, token-parity-checked against a
+    monolithic in-process engine, with the full span chain
+    (admit -> route -> prefill -> kv-handoff -> decode) across the
+    three processes."""
+    ecfg = {"backend": "engine", "preset": "llama-tiny", "max_slots": 2,
+            "max_seq": 96, "decode_block": 4, "prefix_cache_mb": 16,
+            "prefix_block": 8}
+    done_q = queue_mod.Queue()
+    pre = _FleetWorker(dict(ecfg, rid="pre0", role="prefill"), done_q)
+    dec = _FleetWorker(dict(ecfg, rid="dec0", role="decode"), done_q)
+    try:
+        pre.wait_ready(timeout=900)
+        dec.wait_ready(timeout=900)
+        prompt = np.random.default_rng(3).integers(1, 400, 20).tolist()
+        router = rt.Router(
+            rt.RouterConfig(block=8, long_prompt_threshold=16),
+            name="disagg")
+        router.add_replica("pre0", role="prefill", max_slots=2)
+        router.add_replica("dec0", role="decode", max_slots=2)
+        with obs_trace.span("admit", plane="serving", track="router"):
+            d = router.route(rt.prefix_route_key(prompt, block=8),
+                             prompt_len=len(prompt))
+            plen = nbytes = 0
+            with obs_trace.span("kv-handoff", plane="serving",
+                                track="router"):
+                r1 = pre.rpc({"op": "export_prefix", "prompt": prompt},
+                             timeout=900)
+                if r1.get("packet_b64"):
+                    nbytes = len(base64.b64decode(r1["packet_b64"]))
+                    r2 = dec.rpc({"op": "import_prefix",
+                                  "packet_b64": r1["packet_b64"]},
+                                 timeout=900)
+                    plen = int(r2.get("plen", 0))
+            dec.send({"op": "gen", "id": 0, "prompt": prompt,
+                      "new_tokens": 8})
+            toks = done_q.get(timeout=900)["tokens"]
+    finally:
+        pre.stop(timeout=120)
+        dec.stop(timeout=120)
+    # Monolithic reference: same preset/seed => identical weights, and
+    # greedy decode is deterministic -- the tokens must match exactly.
+    from kubeflow_tpu.serving.engine import GenerationEngine, Request
+
+    mono = GenerationEngine(preset="llama-tiny", max_slots=2, max_seq=96,
+                            decode_block=4)
+    fut = mono.submit(Request(prompt=list(prompt), max_new_tokens=8,
+                              temperature=0.0))
+    while not fut.done():
+        mono.step()
+    ref = list(fut.result())
+    mono.close()
+    out = {"route_kind": d.kind, "prefill_replica": d.prefill_replica,
+           "decode_replica": d.replica, "handoff_plen": plen,
+           "handoff_bytes": nbytes, "tokens": list(toks),
+           "reference": ref, "token_parity": list(toks) == ref}
+    # With tracing on, prove the cross-process chain from the dumps the
+    # workers just wrote (+ this process's own live recorder).
+    tdir = os.environ.get(obs_trace.ENV_TRACE_DIR, "")
+    if obs_trace.enabled():
+        names = {"admit": 0, "route": 0, "prefill": 0, "kv-handoff": 0,
+                 "decode": 0}
+        docs = [obs_trace.recorder().export()]
+        if tdir and os.path.isdir(tdir):
+            for fn in sorted(os.listdir(tdir)):
+                if fn.startswith("trace-") and fn.endswith(".json"):
+                    try:
+                        with open(os.path.join(tdir, fn)) as f:
+                            docs.append(json.load(f))
+                    except (OSError, json.JSONDecodeError):
+                        continue
+        for doc in docs:
+            for ev in doc.get("traceEvents", []):
+                if ev.get("name") in names and ev.get("ph") in (
+                        "B", "i", "I"):
+                    names[ev["name"]] += 1
+        out["trace_chain"] = names
+        out["trace_chain_complete"] = all(v > 0 for v in names.values())
+    return out
+
+
 def _phase_dispatch(name: str, args: dict):
     """Run one named phase in THIS process (the subprocess side)."""
     if name == "slot":
@@ -1094,6 +1749,8 @@ def _phase_dispatch(name: str, args: dict):
         return bench_quality(**args)
     if name == "paced_itl":
         return bench_paced_itl(**args)
+    if name == "fleet":
+        return bench_fleet(args)
     raise SystemExit(f"unknown phase {name!r}")
 
 
@@ -1182,6 +1839,11 @@ def _merge_trace_out(trace_out):
 
 
 def main() -> int:
+    if len(sys.argv) > 2 and sys.argv[1] == "--fleet-worker":
+        # Replica subprocess of the fleet phase -- no TPU, no argparse,
+        # and no full-run fallthrough (see _fleet_worker_main).
+        return _fleet_worker_main(json.loads(sys.argv[2]))
+
     import jax
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -1195,7 +1857,7 @@ def main() -> int:
             # multi-hour orchestrated run.
             print("usage: bench_serving.py --phase "
                   "<slot|mixed|latency|prefix|spec|quantized|pipeline|"
-                  "kv_capacity> ['<json-args>']", file=sys.stderr)
+                  "kv_capacity|fleet> ['<json-args>']", file=sys.stderr)
             return 2
         args = json.loads(sys.argv[3]) if len(sys.argv) > 3 else {}
         obs_trace.activate_from_env(
@@ -1216,6 +1878,13 @@ def main() -> int:
     # cap at the measured safe bound for 2048-seq bf16 cache + weights.
     mixed = _run_phase("mixed",
                        {"max_slots": min(best["max_slots"], 64)})
+    # Multi-replica data plane (docs/FLEET.md): sim workers calibrated
+    # from THIS run's sweep; the disagg arm runs real llama-tiny
+    # engines on CPU (never the chip).
+    fleet = _run_phase("fleet", {
+        "decode_tok_per_slot": round(
+            best["tokens_per_sec"] / max(1, best["max_slots"]), 2),
+    }, timeout=1800)
     lat = dict(prefill_chunk=PREFILL_CHUNK,
                decode_block=LATENCY_DECODE_BLOCK,
                n_requests=LAT_REQUESTS)
@@ -1314,6 +1983,7 @@ def main() -> int:
                 f"{NEW_TOKENS} new tokens, all slots busy"
             ),
             "throughput_mixed": mixed,
+            "fleet": fleet,
             "prompt_len": PROMPT_LEN,
             "new_tokens": NEW_TOKENS,
             "decode_block": DECODE_BLOCK,

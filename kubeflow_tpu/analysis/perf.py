@@ -7,7 +7,7 @@ curve regression fails --strict the same way a dropped donation does
 instead of landing silently and surfacing three rounds later as "why is
 8192 slow again".
 
-Three check families, one baseline file:
+Four check families, one baseline file:
 
 - ``train.mfu_floor_by_seq``: per-sequence-length MFU floors over the
   newest committed train bench round (headline row + seq_sweep rows).
@@ -15,6 +15,13 @@ Three check families, one baseline file:
   shrinking the curve is the oldest regression-hiding trick.
 - ``serving.tok_s_floor_by_slots``: per-slot-count tokens/sec floors
   over the committed serving slot sweep.
+- ``fleet``: floors/ceilings over the committed multi-replica fleet
+  bench (``SERVING_BENCH.json`` extra.fleet -- bench_serving.py's fleet
+  phase): N=2 aggregate-speedup and mixed-workload routed-speedup
+  floors, paced TTFT p99 ceiling, affinity-vs-random hit-rate gain
+  floor, overload shed-rate sanity range, and required disaggregation
+  invariants (KV-handoff token parity, complete cross-process span
+  chain). Rule KT-PERF-FLEET.
 - ``ceilings``: upper bounds on live analysis metrics -- the per-depth
   steady-state host-sync bound (``serve.host_syncs_per_block[.dN]``)
   and the worst per-drain queued-lane discard
@@ -109,6 +116,119 @@ def _train_mfu_by_seq(parsed: dict) -> Dict[int, Optional[float]]:
     return out
 
 
+def _fleet_metric(fleet: dict, path: str):
+    cur = fleet
+    for part in path.split("."):
+        cur = cur.get(part) if isinstance(cur, dict) else None
+        if cur is None:
+            return None
+    return cur
+
+
+def _check_fleet(fleet_base: dict, fleet: dict, artifact: str,
+                 measured: Dict[str, float]) -> List[Finding]:
+    """The extra.fleet floors: each configured bound against its metric.
+    A bound whose metric is absent from the artifact is a finding (same
+    shrunk-curve rule as the sweep rows)."""
+    findings: List[Finding] = []
+
+    def _bound(mpath: str, key: str, kind: str, mkey: str) -> None:
+        limit = fleet_base.get(key)
+        if limit is None:
+            return
+        val = _fleet_metric(fleet, mpath)
+        if val is None:
+            findings.append(Finding(
+                rule="KT-PERF-FLEET", path=artifact, line=0, hard=True,
+                message=(
+                    f"fleet.{mpath}: missing from {artifact} "
+                    f"({key}={limit})"
+                ),
+            ))
+            return
+        measured[mkey] = float(val)
+        bad = val < limit if kind == "floor" else val > limit
+        if bad:
+            word = "below ratchet floor" if kind == "floor" else \
+                "exceeds ceiling"
+            findings.append(Finding(
+                rule="KT-PERF-FLEET", path=artifact, line=0, hard=True,
+                message=(
+                    f"fleet.{mpath} = {val} {word} {limit} ({artifact})"
+                ),
+            ))
+
+    _bound("aggregate_speedup", "aggregate_speedup_floor", "floor",
+           "fleet.aggregate_speedup")
+    _bound("mixed.routed_speedup", "mixed_routed_speedup_floor", "floor",
+           "fleet.mixed_routed_speedup")
+    _bound("n2_paced.ttft_ms.p99", "paced_ttft_p99_ms_ceiling",
+           "ceiling", "fleet.paced_ttft_p99_ms")
+
+    gain_floor = fleet_base.get("affinity_hit_gain_floor")
+    if gain_floor is not None:
+        aff = fleet.get("affinity_hit_rate")
+        rand = fleet.get("random_hit_rate")
+        if aff is None or rand is None:
+            findings.append(Finding(
+                rule="KT-PERF-FLEET", path=artifact, line=0, hard=True,
+                message=(
+                    f"fleet affinity/random hit rates missing from "
+                    f"{artifact} (affinity_hit_gain_floor={gain_floor})"
+                ),
+            ))
+        else:
+            gain = float(aff) - float(rand)
+            measured["fleet.affinity_hit_gain"] = round(gain, 4)
+            if gain < gain_floor:
+                findings.append(Finding(
+                    rule="KT-PERF-FLEET", path=artifact, line=0, hard=True,
+                    message=(
+                        f"fleet affinity hit-rate gain {gain:.3f} "
+                        f"(affinity {aff} vs random {rand}) below floor "
+                        f"{gain_floor} ({artifact})"
+                    ),
+                ))
+
+    shed_range = fleet_base.get("overload_shed_rate_range")
+    if shed_range:
+        shed = _fleet_metric(fleet, "overload.shed_rate")
+        lo, hi = float(shed_range[0]), float(shed_range[1])
+        if shed is None:
+            findings.append(Finding(
+                rule="KT-PERF-FLEET", path=artifact, line=0, hard=True,
+                message=(
+                    f"fleet.overload.shed_rate missing from {artifact} "
+                    f"(range [{lo}, {hi}])"
+                ),
+            ))
+        else:
+            measured["fleet.overload_shed_rate"] = float(shed)
+            if not lo <= shed <= hi:
+                findings.append(Finding(
+                    rule="KT-PERF-FLEET", path=artifact, line=0, hard=True,
+                    message=(
+                        f"fleet.overload.shed_rate = {shed} outside "
+                        f"sanity range [{lo}, {hi}]: shedding either "
+                        f"never fired under 8x overload or rejected "
+                        f"most of the load ({artifact})"
+                    ),
+                ))
+
+    for key in fleet_base.get("disagg_required") or []:
+        val = _fleet_metric(fleet, f"disagg.{key}")
+        if val is not True:
+            findings.append(Finding(
+                rule="KT-PERF-FLEET", path=artifact, line=0, hard=True,
+                message=(
+                    f"fleet.disagg.{key} = {val!r}, expected true: the "
+                    f"prefill->decode handoff lost bit-exactness or its "
+                    f"span chain ({artifact})"
+                ),
+            ))
+    return findings
+
+
 def check_perf(
     baseline: dict,
     *,
@@ -182,6 +302,24 @@ def check_perf(
                             f"floor {floor} ({artifact})"
                         ),
                     ))
+
+    # -- fleet (multi-replica data plane) floors ---------------------------
+    fleet_base = baseline.get("fleet") or {}
+    if fleet_base:
+        doc, artifact = serving_bench(root)
+        if doc is not None:
+            fleet = doc["extra"].get("fleet")
+            if not isinstance(fleet, dict) or "aggregate_speedup" not in fleet:
+                findings.append(Finding(
+                    rule="KT-PERF-FLEET", path=artifact, line=0, hard=True,
+                    message=(
+                        f"no extra.fleet section in {artifact} (fleet "
+                        f"floors set) -- the fleet bench vanished"
+                    ),
+                ))
+            else:
+                findings.extend(_check_fleet(fleet_base, fleet, artifact,
+                                             measured))
 
     # -- live-metric ceilings ----------------------------------------------
     # Checked against THIS analyze run's Tier-B metrics; a ceiling whose

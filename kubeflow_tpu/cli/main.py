@@ -285,6 +285,20 @@ def cmd_trace(args, _client) -> int:
     per = ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
     print(f"wrote {args.out}: {len(docs)} document(s), {total} span(s)"
           + (f" ({per})" if per else ""))
+    for plane, summ in sorted(obs_trace.plane_summaries(merged).items()):
+        line = (f"  {plane}: {summ['spans']} span(s), "
+                f"{summ['instants']} instant(s)")
+        routes = summ.get("routes")
+        if routes:
+            line += " | router " + " ".join(
+                f"{k}={v}" for k, v in sorted(routes.items()))
+        print(line)
+        for pid, eng in sorted((summ.get("engines") or {}).items()):
+            print(f"    engine pid {pid}: queue={eng['queue_depth']} "
+                  f"active={eng['slots_active']} "
+                  f"ttft_ema={eng['ttft_ema_ms']}ms "
+                  f"tokens={eng['tokens_generated']} "
+                  f"finished={eng['requests_finished']}")
     print("view: https://ui.perfetto.dev -> Open trace file")
     return 0
 

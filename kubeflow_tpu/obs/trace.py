@@ -458,3 +458,46 @@ def span_counts(doc: Dict[str, Any]) -> Dict[str, int]:
             total += 1
     counts["total"] = total
     return counts
+
+
+def plane_summaries(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """Per-plane roll-up of a merged trace document, for ``kftpu trace
+    dump``'s human summary: span + instant counts per plane, plus the
+    serving fleet signals -- each engine process's final ``engine-stats``
+    snapshot (queue depth, TTFT EMA, tokens) and the router's ``route``
+    decision mix (direct/spilled/steered/shed/disagg)."""
+    out: Dict[str, Dict[str, Any]] = {}
+
+    def plane_of(ev: Dict[str, Any]) -> Dict[str, Any]:
+        return out.setdefault(
+            ev.get("cat", "?"), {"spans": 0, "instants": 0}
+        )
+
+    for ev in doc.get("traceEvents", []):
+        ph = ev.get("ph")
+        if ph == "B":
+            plane_of(ev)["spans"] += 1
+        elif ph in ("i", "I"):
+            p = plane_of(ev)
+            p["instants"] += 1
+            args = ev.get("args") or {}
+            if ev.get("name") == "engine-stats":
+                # Latest snapshot wins per emitting process (events are
+                # time-ordered within a process dump).
+                eng = p.setdefault("engines", {})
+                eng[str(ev.get("pid", "?"))] = {
+                    "queue_depth": args.get("queue_depth", 0),
+                    "slots_active": args.get("slots_active", 0),
+                    "ttft_ema_ms": args.get("ttft_ema_ms", 0.0),
+                    "tokens_generated": args.get("tokens_generated", 0),
+                    "requests_finished": args.get("requests_finished", 0),
+                }
+            elif ev.get("name") == "route":
+                routes = p.setdefault("routes", {})
+                kind = str(args.get("kind", "direct"))
+                routes[kind] = routes.get(kind, 0) + 1
+                if args.get("spilled"):
+                    routes["spilled"] = routes.get("spilled", 0) + 1
+                if args.get("steered"):
+                    routes["steered"] = routes.get("steered", 0) + 1
+    return out

@@ -40,6 +40,11 @@ from aiohttp import web
 
 from kubeflow_tpu.controller.launcher import BaseLauncher, SpawnRequest, WorkerRef
 from kubeflow_tpu.obs import trace
+from kubeflow_tpu.serving.router import (
+    Router,
+    RouterConfig,
+    prefix_route_key,
+)
 from kubeflow_tpu.serving.types import (
     KIND,
     TRAINED_MODEL_KIND,
@@ -107,11 +112,15 @@ class _Replica:
 
     def __init__(self, index: int, port: int, ref: WorkerRef,
                  comp_fp: Optional[str] = None,
-                 grpc_port: Optional[int] = None) -> None:
+                 grpc_port: Optional[int] = None,
+                 role: str = "mixed") -> None:
         self.index = index
         self.port = port
         self.grpc_port = grpc_port
         self.ref = ref
+        # Fleet data-plane role (docs/FLEET.md): "prefill" replicas take
+        # KV-handoff prefills only, never routed decode traffic.
+        self.role = role
         self.ready = False
         self.in_flight = 0  # proxied requests on this replica (drain gate)
         self.started_at = time.time()
@@ -895,8 +904,20 @@ class ISVCController:
             # Bundled runtimes serve OIP gRPC alongside HTTP; custom
             # entrypoints aren't assumed to accept the flag.
             grpc_port = allocate_port() if comp.custom is None else None
+            # Disaggregated routing: the first routing.prefill_replicas
+            # live replicas of the revision hold the prefill role; the
+            # count re-fills as replicas churn.
+            role = "mixed"
+            if (comp.routing is not None
+                    and comp.routing.prefill_replicas > 0):
+                n_pre = sum(
+                    1 for r in current.values() if r.role == "prefill"
+                )
+                role = ("prefill"
+                        if n_pre < comp.routing.prefill_replicas
+                        else "decode")
             req = self._spawn_request(isvc, comp, index, port, key,
-                                      grpc_port=grpc_port)
+                                      grpc_port=grpc_port, role=role)
             try:
                 ref = await self.launcher.spawn(req)
             except Exception:
@@ -904,7 +925,7 @@ class ISVCController:
                     self.gang.release(res_key)
                 raise
             rep = _Replica(index, port, ref, comp_fp=comp_fp,
-                           grpc_port=grpc_port)
+                           grpc_port=grpc_port, role=role)
             rep.res_key = res_key
             svc.replicas[index] = rep
             current[index] = rep
@@ -964,13 +985,18 @@ class ISVCController:
     def _spawn_request(self, isvc: InferenceService, comp: ComponentSpec,
                        index: int, port: int,
                        service_key: Optional[str] = None,
-                       grpc_port: Optional[int] = None) -> SpawnRequest:
+                       grpc_port: Optional[int] = None,
+                       role: str = "mixed") -> SpawnRequest:
         ns, name = isvc.metadata.namespace, isvc.metadata.name
         service_key = service_key or f"{ns}/{name}"
         env = {"PORT": str(port)}
         # Trace context rides into serving replicas exactly as it does
         # into training workers (controller/envvars.py).
         env.update(trace.propagation_env())
+        if role != "mixed":
+            # Surfaced by the replica's /healthz (trace labels + the
+            # activator's load poll); behavior lives in the router.
+            env["KFTPU_REPLICA_ROLE"] = role
         if service_key.endswith((TRANSFORMER_SUFFIX, EXPLAINER_SUFFIX)):
             # Transformer/explainer processes call the predictor back
             # through the activator (scale-from-zero applies), pinned to
@@ -1335,6 +1361,12 @@ class Activator:
                  cold_start_timeout: float = 180.0) -> None:
         self.controller = controller
         self.cold_start_timeout = cold_start_timeout
+        # Prefix-affinity data plane (docs/FLEET.md): one Router per
+        # service key, engaged only when the predictor spec carries a
+        # ``routing`` block. Load-poll tasks live in the controller's
+        # _probe_tasks map so the run loop's shutdown path cancels them.
+        self._routers: Dict[str, Router] = {}
+        self._router_fps: Dict[str, str] = {}
 
     @staticmethod
     async def _wants_stream(req: web.Request) -> bool:
@@ -1365,7 +1397,19 @@ class Activator:
             component=req.headers.get("X-Kftpu-Component", "").lower(),
             query_string=req.query_string,
         )
-        return web.Response(body=payload, status=status, content_type=ctype)
+        headers = {}
+        if status == 429:
+            # proxy() returns a bare 3-tuple (the InferenceGraph calls
+            # it in-process), so shed metadata rides the JSON payload
+            # and is lifted into the standard header here.
+            try:
+                ra = json.loads(payload).get("retry_after_s")
+                if ra is not None:
+                    headers["Retry-After"] = str(max(1, math.ceil(ra)))
+            except Exception as e:  # noqa: BLE001 - payload stays as-is
+                logger.debug("429 payload without retry_after_s: %s", e)
+        return web.Response(body=payload, status=status, content_type=ctype,
+                            headers=headers)
 
     async def _handle_stream(self, req: web.Request,
                              tail: str) -> web.StreamResponse:
@@ -1374,18 +1418,26 @@ class Activator:
         to the PREDICTOR (token streams don't compose with the
         transformer's whole-payload pre/postprocess contract)."""
         ns, name = req.match_info["ns"], req.match_info["name"]
+        body = await req.read()
         err, svc, replica = await self._route(ns, name, tail,
-                                              component=PRIMARY)
+                                              component=PRIMARY, body=body)
         if err is not None:
             status, payload, ctype = err
+            headers = {}
+            if status == 429:
+                try:
+                    ra = json.loads(payload).get("retry_after_s")
+                    if ra is not None:
+                        headers["Retry-After"] = str(max(1, math.ceil(ra)))
+                except Exception as e:  # noqa: BLE001
+                    logger.debug("429 payload without retry_after_s: %s", e)
             return web.Response(body=payload, status=status,
-                                content_type=ctype)
+                                content_type=ctype, headers=headers)
         out: Optional[web.StreamResponse] = None
         try:
             url = f"http://127.0.0.1:{replica.port}/{tail}"
             if req.query_string:
                 url += f"?{req.query_string}"
-            body = await req.read()
             async with self.controller._http.request(
                 "POST", url, data=body if body else None,
                 headers={"Content-Type":
@@ -1437,7 +1489,8 @@ class Activator:
         the ingress component, cold-starting if needed. Returns
         (status, payload bytes, content type)."""
 
-        err, svc, replica = await self._route(ns, name, tail, component)
+        err, svc, replica = await self._route(ns, name, tail, component,
+                                              body=body)
         if err is not None:
             return err
         try:
@@ -1464,6 +1517,7 @@ class Activator:
 
     async def _route(
         self, ns: str, name: str, tail: str, component: str = "",
+        body: Optional[bytes] = None,
     ) -> tuple:
         """Routing + replica reservation shared by the buffered and
         streaming paths: canary split, transformer ingress, multi-model
@@ -1568,6 +1622,29 @@ class Activator:
                         f"model {mname} is not placed yet "
                         "(placement in progress)",
                     )
+        routing_raw = None
+        if prefer is None and not key.endswith(
+            (TRANSFORMER_SUFFIX, EXPLAINER_SUFFIX)
+        ):
+            routing_raw = ((raw.get("spec") or {}).get("predictor")
+                           or {}).get("routing")
+        if (routing_raw
+                and routing_raw.get("policy", "prefix") == "prefix"
+                and svc.ready_replicas()):
+            # Prefix-affinity data plane (docs/FLEET.md). Engaged only
+            # with ready replicas: the cold-start path below already
+            # owns the wait-and-replay dance, and an empty ring has no
+            # affinity to offer anyway.
+            shed_err, replica = await self._router_route(
+                key, svc, routing_raw, ns, tail, body
+            )
+            if shed_err is not None:
+                self._release(svc, None)
+                return shed_err, None, None
+            if replica is not None:
+                replica.in_flight += 1
+                return None, svc, replica
+            # fall through (router had no healthy candidate)
         try:
             replica = await self._get_replica(key, svc, prefer)
         except BaseException:
@@ -1610,3 +1687,191 @@ class Activator:
                 return None
         svc.rr = (svc.rr + 1) % len(ready)
         return ready[svc.rr]
+
+    # -- prefix-affinity data plane (docs/FLEET.md) ---------------------
+
+    @staticmethod
+    def _affinity_text(body: Optional[bytes]) -> str:
+        """Pull the routing-relevant prompt text out of a request body.
+        Covers the repo's inference dialects: v1 {"instances": [...]},
+        v2/generate {"prompt"| "inputs"}, OpenAI {"messages": [...]}.
+        Non-JSON or unrecognized bodies hash raw bytes -- identical
+        payloads still co-locate, they just don't share a prefix key
+        with a differently-framed equivalent."""
+        if not body:
+            return ""
+        try:
+            data = json.loads(body)
+        except Exception:  # noqa: BLE001
+            return body.decode("utf-8", "replace")
+        if not isinstance(data, dict):
+            return body.decode("utf-8", "replace")
+        for k in ("prompt", "inputs", "text_input"):
+            v = data.get(k)
+            if isinstance(v, str) and v:
+                return v
+        msgs = data.get("messages")
+        if isinstance(msgs, list) and msgs:
+            parts = []
+            for m in msgs:
+                if isinstance(m, dict) and isinstance(m.get("content"), str):
+                    parts.append(m["content"])
+            if parts:
+                return "\n".join(parts)
+        inst = data.get("instances")
+        if isinstance(inst, list) and inst:
+            return json.dumps(inst[0], sort_keys=True)
+        return body.decode("utf-8", "replace")
+
+    def _router_for(self, key: str, routing_raw: dict) -> Router:
+        fp = json.dumps(routing_raw, sort_keys=True)
+        router = self._routers.get(key)
+        if router is None or self._router_fps.get(key) != fp:
+            router = Router(
+                RouterConfig(
+                    vnodes=int(routing_raw.get("vnodes", 64)),
+                    slo_ttft_ms=routing_raw.get("slo_ttft_ms"),
+                    long_prompt_threshold=routing_raw.get(
+                        "long_prompt_threshold_chars"),
+                ),
+                name=key,
+            )
+            self._routers[key] = router
+            self._router_fps[key] = fp
+        return router
+
+    async def _router_route(
+        self, key: str, svc: _Service, routing_raw: dict,
+        ns: str, tail: str, body: Optional[bytes],
+    ) -> tuple:
+        """Returns (shed_err3 | None, replica | None). (None, None)
+        means the router abstained -- caller falls back to round-robin.
+        svc.in_flight is already held by _route; this neither takes nor
+        releases it."""
+        router = self._router_for(key, routing_raw)
+        ready = svc.ready_replicas()
+        router.sync_replicas({
+            str(r.index): {"role": getattr(r, "role", "mixed")}
+            for r in ready
+        })
+        # Router-side in_flight mirrors the activator's per-replica
+        # reservation counts (leak-free by construction: _release owns
+        # the decrement of the source of truth).
+        by_rid = {str(r.index): r for r in ready}
+        for rid, rep in by_rid.items():
+            load = router.replicas.get(rid)
+            if load is not None:
+                load.in_flight = rep.in_flight
+        self._ensure_load_poll(key, float(
+            routing_raw.get("load_poll_seconds", 2.0)))
+        text = self._affinity_text(body)
+        decision = router.route(
+            prefix_route_key(text), prompt_len=len(text)
+        )
+        if decision.kind == "shed":
+            payload = json.dumps({
+                "error": "overloaded: estimated TTFT "
+                         f"{decision.est_ttft_ms:.0f}ms exceeds SLO",
+                "retry_after_s": decision.retry_after_s,
+            }).encode()
+            return (429, payload, "application/json"), None
+        if decision.kind == "none" or decision.replica not in by_rid:
+            return None, None
+        replica = by_rid[decision.replica]
+        if decision.kind == "disagg":
+            pre = by_rid.get(decision.prefill_replica or "")
+            if pre is None:
+                # Prefill replicas are load-polled but not in the ready
+                # decode set by_rid -- look them up directly.
+                pre = next(
+                    (r for r in ready
+                     if str(r.index) == decision.prefill_replica), None)
+            if pre is not None and pre is not replica:
+                await self._disagg_handoff(pre, replica, tail, text)
+        return None, replica
+
+    async def _disagg_handoff(self, pre: "_Replica", dec: "_Replica",
+                              tail: str, text: str) -> None:
+        """Prefill ``text`` on the prefill replica and ship its KV
+        packet to the decode replica over the runtime's prefix
+        export/import endpoints. Best-effort: any failure logs and
+        falls back to the decode replica prefilling locally -- the
+        response stays correct either way."""
+        m = re.search(r"v[12]/models/([^/:]+)", tail)
+        if m is None:
+            return
+        mname, http = m.group(1), self.controller._http
+        t0 = time.monotonic()
+        try:
+            with trace.span("kv-handoff", plane="serving", track="router",
+                            prefill=pre.index, decode=dec.index):
+                async with http.post(
+                    f"http://127.0.0.1:{pre.port}/v2/models/{mname}"
+                    "/prefix/export",
+                    json={"prompt": text},
+                ) as resp:
+                    if resp.status != 200:
+                        return  # 204: under one block; 4xx/5xx: skip
+                    packet = await resp.read()
+                async with http.post(
+                    f"http://127.0.0.1:{dec.port}/v2/models/{mname}"
+                    "/prefix/import",
+                    data=packet,
+                    headers={"Content-Type": "application/octet-stream"},
+                ) as resp:
+                    resp.raise_for_status()
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            logger.warning(
+                "kv-handoff %s: prefill %d -> decode %d failed after "
+                "%.2fs (%s); decode replica will prefill locally",
+                mname, pre.index, dec.index, time.monotonic() - t0, e,
+            )
+
+    def _ensure_load_poll(self, key: str, interval: float) -> None:
+        ctrl = self.controller
+        tkey = f"loadpoll#{key}"
+        t = ctrl._probe_tasks.get(tkey)
+        if t is None or t.done():
+            ctrl._probe_tasks[tkey] = asyncio.create_task(
+                self._load_poll(key, interval)
+            )
+
+    async def _load_poll(self, key: str, interval: float) -> None:
+        """Per-service poll feeding /healthz ``load`` gauges into the
+        router (queue depth, active slots, TTFT EMA). Ends itself when
+        the service or its router goes away; the controller's shutdown
+        path cancels it via _probe_tasks."""
+        ctrl = self.controller
+        while not ctrl._stopped.is_set():
+            svc = ctrl.services.get(key)
+            router = self._routers.get(key)
+            if svc is None or router is None or not svc.replicas:
+                return
+            for rep in svc.ready_replicas():
+                try:
+                    async with ctrl._http.get(
+                        f"http://127.0.0.1:{rep.port}/healthz",
+                        timeout=aiohttp.ClientTimeout(total=2.0),
+                    ) as resp:
+                        data = await resp.json()
+                except Exception as e:  # noqa: BLE001 - replica churn
+                    logger.debug("load poll %s replica %s: %s",
+                                 key, rep.index, e)
+                    continue
+                load = (data or {}).get("load") or {}
+                agg = {"queue_depth": 0, "slots_active": 0, "max_slots": 0}
+                ema = 0.0
+                for stats in load.values():
+                    agg["queue_depth"] += int(stats.get("queue_depth", 0))
+                    agg["slots_active"] += int(
+                        stats.get("slots_active", 0))
+                    agg["max_slots"] += int(stats.get("max_slots", 0))
+                    ema = max(ema, float(stats.get("ttft_ema_ms", 0.0)))
+                if load:
+                    router.update_load(str(rep.index), {
+                        **agg, "ttft_ema_ms": ema or None,
+                    })
+            try:
+                await asyncio.sleep(interval)
+            except asyncio.CancelledError:
+                return

@@ -1912,6 +1912,11 @@ class GenerationEngine:
         self.requests_finished = 0
         self.ttft_hist = LatencyHistogram()
         self.itl_hist = LatencyHistogram()
+        # Live TTFT EMA (ms): the router's load signal (docs/FLEET.md).
+        # The histogram answers distribution questions after the fact;
+        # routing needs one current number per replica, cheap to read
+        # from the scrape thread.
+        self.ttft_ms_ema: Optional[float] = None
         # -- overlapped dispatch pipeline ------------------------------
         # 0 = fully sequential (dispatch, sync, consume); N >= 1 keeps
         # up to N decode blocks in flight behind the one being consumed,
@@ -2156,6 +2161,99 @@ class GenerationEngine:
         pk, pv = self._extract_call(plen, jnp.int32(req.slot))
         pc.insert(req.prompt, pk, pv)
 
+    # -- disaggregated prefill/decode (serving/router.py) ------------------
+    #
+    # A prefill replica runs ensure_prefix + export_prefix; the packet
+    # travels through router.pack_kv_packet's wire format; a decode
+    # replica runs import_prefix and then serves the original request,
+    # whose admission hits the imported entry and takes the normal
+    # prefix-restore + remainder-prefill path. The arrays cross AS
+    # STORED (int8 kv_quant: q [L,P,KV,D] + lane-aligned f32 scales
+    # [L,KV,Smax]), so decode after a handoff is bit-identical to
+    # decode after a local capture of the same prefix.
+
+    def ensure_prefix(self, prompt: Sequence[int],
+                      timeout: float = 120.0) -> int:
+        """Prefill ``prompt`` into the prefix cache without serving it:
+        the prefill-replica entry point. Returns the covered
+        (block-multiple) length, 0 when the prompt is under one block
+        or the entry didn't fit the cache budget. Runs the engine
+        inline when no engine thread is live (tests/benches)."""
+        pc = self.prefix_cache
+        if pc is None:
+            raise RuntimeError("ensure_prefix needs prefix_cache_mb > 0")
+        plen = (len(prompt) // pc.block) * pc.block
+        if plen < pc.block:
+            return 0
+        full = pc.chain_hashes(prompt, plen)[-1][1]
+        if full in pc.entries:
+            return plen
+        # One generated token is the cheapest admission that completes
+        # prefill (capture happens at prefill completion); greedy so
+        # the sampling RNG chain is irrelevant.
+        fut = self.submit(Request(prompt=list(prompt), max_new_tokens=1,
+                                  temperature=0.0))
+        if self._thread is not None and self._thread.is_alive():
+            fut.result(timeout)
+        else:
+            deadline = time.perf_counter() + timeout
+            while not fut.done():
+                if time.perf_counter() > deadline:
+                    raise TimeoutError("ensure_prefix prefill timed out")
+                self.step()
+            fut.result()
+        return plen if full in pc.entries else 0
+
+    def export_prefix(self, prompt: Sequence[int]) -> Optional[dict]:
+        """Longest cached prefix of ``prompt`` as host arrays:
+        {"tokens", "plen", "k", "v"} ready for router.pack_kv_packet,
+        or None on a cache miss."""
+        pc = self.prefix_cache
+        if pc is None:
+            return None
+        plen, entry = pc.lookup(prompt, len(prompt))
+        if not plen:
+            return None
+        return {
+            "tokens": list(prompt[:plen]),
+            "plen": plen,
+            "k": jax.device_get(entry["k"]),
+            "v": jax.device_get(entry["v"]),
+        }
+
+    def import_prefix(self, packet: dict) -> int:
+        """Adopt an unpacked handoff packet (router.unpack_kv_packet)
+        into this engine's prefix cache. Validates block granularity
+        and KV layout against this engine's configuration -- a bf16
+        packet cannot land in an int8 cache (and vice versa): restore
+        scatters raw rows, so a layout mismatch would corrupt the
+        slot. Returns the covered length actually inserted."""
+        pc = self.prefix_cache
+        if pc is None:
+            raise RuntimeError("import_prefix needs prefix_cache_mb > 0")
+        if packet["block"] != pc.block:
+            raise ValueError(
+                f"packet block {packet['block']} != engine prefix_block "
+                f"{pc.block}"
+            )
+        quantized = isinstance(packet["k"], dict)
+        if quantized != (self.kv_quant == "int8"):
+            raise ValueError(
+                f"packet layout {packet['layout']!r} does not match "
+                f"engine kv_quant={self.kv_quant!r}"
+            )
+
+        def _dev(rows):
+            if isinstance(rows, dict):
+                return {"q": jnp.asarray(rows["q"]),
+                        "s": jnp.asarray(rows["s"])}
+            return jnp.asarray(rows)
+
+        tokens = packet["tokens"]
+        pc.insert(tokens, _dev(packet["k"]), _dev(packet["v"]))
+        full = pc.chain_hashes(tokens, packet["plen"])[-1][1]
+        return packet["plen"] if full in pc.entries else 0
+
     def _pack_constraint_mask(self):
         """[max_slots, vocab] bool of legal next tokens, or None when no
         active slot is constrained (the common case: the unmasked jit
@@ -2297,7 +2395,7 @@ class GenerationEngine:
                 self.hist[slot, base:end] = acc[:end - base]
         now = time.perf_counter()
         if first:
-            self.ttft_hist.observe(now - req.submit_t)
+            self._note_ttft(now - req.submit_t)
             if trace.enabled():
                 trace.instant("first-token", plane="serving",
                               track=f"req/{req.nonce}", nonce=req.nonce,
@@ -2477,7 +2575,7 @@ class GenerationEngine:
             self.hist[req.slot, self.lengths[req.slot]] = token
         now = time.perf_counter()
         if len(req.generated) == 1:
-            self.ttft_hist.observe(now - req.submit_t)
+            self._note_ttft(now - req.submit_t)
             if trace.enabled():
                 trace.instant("first-token", plane="serving",
                               track=f"req/{req.nonce}", nonce=req.nonce,
@@ -2521,6 +2619,14 @@ class GenerationEngine:
         )
         if done:
             self._finish(req)
+
+    def _note_ttft(self, seconds: float, alpha: float = 0.2) -> None:
+        self.ttft_hist.observe(seconds)
+        ms = seconds * 1e3
+        self.ttft_ms_ema = (
+            ms if self.ttft_ms_ema is None
+            else alpha * ms + (1 - alpha) * self.ttft_ms_ema
+        )
 
     def _finish(self, req: Request) -> None:
         slot = req.slot
@@ -2567,6 +2673,10 @@ class GenerationEngine:
             ),
             "overshoot_tokens_discarded": self.overshoot_tokens_discarded,
             "overshoot_max_per_drain": self.overshoot_max_per_drain,
+            "ttft_ema_ms": (
+                round(self.ttft_ms_ema, 3)
+                if self.ttft_ms_ema is not None else 0.0
+            ),
         }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
@@ -2936,6 +3046,18 @@ class GenerationEngine:
         self._thread.start()
 
     def stop(self) -> None:
+        if trace.enabled():
+            # Final load snapshot into the process trace: `kftpu trace
+            # dump` aggregates these per plane (queue depth / TTFT EMA
+            # per replica) without scraping a live /metrics.
+            trace.instant(
+                "engine-stats", plane="serving", track="engine",
+                queue_depth=self.pending.qsize() + len(self._backlog),
+                slots_active=len(self.active),
+                ttft_ema_ms=round(self.ttft_ms_ema or 0.0, 3),
+                tokens_generated=self.tokens_generated,
+                requests_finished=self.requests_finished,
+            )
         if self._thread is not None:
             self._stop.set()
             self._wake.set()

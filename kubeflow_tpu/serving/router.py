@@ -1,0 +1,586 @@
+"""Multi-replica serving data plane: prefix-affinity consistent-hash
+routing, queue/TTFT-aware balancing with load shedding, and the
+prefill/decode disaggregation KV-handoff wire format.
+
+The engine (serving/engine.py) is one process; the controller
+(serving/controller.py) already runs N of them behind an activator that
+round-robins. This module is the missing routing brain, shared by the
+activator, bench_serving.py's fleet phase, and tests:
+
+* ``prefix_route_key`` -- the affinity key. Token prompts hash with the
+  SAME blake2b chain scheme and block granularity as the engine's
+  PrefixCache first block (seed ``b"kftpu-prefix"``), so two prompts
+  that would share a cache entry inside one engine also land on the
+  same replica -- the per-replica prefix cache composes into a
+  fleet-level one without any shared state. The controller-side
+  activator sees text, not tokens; byte inputs hash a byte-span of the
+  same nominal size under a distinct seed (documented approximation:
+  preserves the shared-prefix property, never collides with token keys).
+
+* ``ConsistentHashRing`` -- vnode consistent hashing. Adding or
+  removing one replica moves only ~1/N of the keyspace (tested), so a
+  scale event doesn't flush every replica's prefix cache, and
+  ``candidates(key, n)`` yields the next-distinct replicas clockwise
+  for power-of-two-choices spill.
+
+* ``Router`` -- policy: affinity primary, queue/TTFT-EMA-aware second
+  choice, long-prompt steering (to the prefill pool when disaggregated,
+  else to the least-loaded candidate), and load shedding with a
+  computed Retry-After when every candidate's TTFT estimate exceeds the
+  SLO. Pure host code, no jax import -- safe inside the controller.
+
+* ``pack_kv_packet``/``unpack_kv_packet`` -- the disaggregation wire
+  format. int8 KV-quantized entries ship exactly as the engine stores
+  them since PR 1: ``q`` int8 [L, P, KV, D] plus scales ``s`` f32
+  LANE-ALIGNED [L, KV, Smax] (sequence on the 128-lane minor axis), so
+  a handoff is a raw byte copy on both ends -- no transpose, no
+  requant, and decode attends bit-identically to a local prefill.
+  ``handoff_prefix`` drives a full prefill-replica -> decode-replica
+  transfer between two engines and stitches ``kv-handoff`` spans into
+  the obs plane (docs/OBSERVABILITY.md) under the propagated trace id.
+
+See docs/FLEET.md for the full model.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import hashlib
+import json
+import struct
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from kubeflow_tpu.obs import registry as obs_registry
+from kubeflow_tpu.obs import trace
+
+# ---------------------------------------------------------------------------
+# Affinity keys (PrefixCache chain-hash scheme)
+# ---------------------------------------------------------------------------
+
+# Must match PrefixCache.chain_hashes exactly: the router's token key IS
+# the engine cache's first-block chain hash (tested against it).
+PREFIX_HASH_SEED = b"kftpu-prefix"
+_BYTES_HASH_SEED = b"kftpu-prefix-bytes"
+DEFAULT_BLOCK = 128
+
+
+def chain_hash(tokens: Sequence[int], block: int = DEFAULT_BLOCK):
+    """(covered_len, hash) of the longest block-multiple prefix --
+    PrefixCache.chain_hashes' last row, recomputed jax-free so the
+    controller can verify packets without importing the engine."""
+    n = (len(tokens) // block) * block
+    h = PREFIX_HASH_SEED
+    for end in range(block, n + 1, block):
+        blk = np.asarray(tokens[end - block:end], np.int64).tobytes()
+        h = hashlib.blake2b(h + blk, digest_size=16).digest()
+    return n, h
+
+
+def prefix_route_key(prompt: Union[Sequence[int], bytes, str],
+                     block: int = DEFAULT_BLOCK) -> bytes:
+    """16-byte affinity key for a prompt.
+
+    Tokens: blake2b(seed + first block) -- identical to the engine
+    PrefixCache's first-block chain hash for prompts >= one block, so
+    router affinity granularity IS cache-entry granularity. Shorter
+    prompts hash whatever tokens exist (shared short prompts still
+    co-locate; the different input length keeps keys distinct).
+
+    Text/bytes (the activator, which has no tokenizer): hash the first
+    ``4 * block`` bytes under a separate seed -- ~4 chars/token keeps
+    the span comparable to one token block, and a shared system-prompt
+    prefix longer than that span still yields one key.
+    """
+    if isinstance(prompt, str):
+        prompt = prompt.encode("utf-8", "surrogatepass")
+    if isinstance(prompt, (bytes, bytearray)):
+        span = bytes(prompt[: 4 * block])
+        return hashlib.blake2b(_BYTES_HASH_SEED + span,
+                               digest_size=16).digest()
+    blk = np.asarray(list(prompt[:block]), np.int64).tobytes()
+    return hashlib.blake2b(PREFIX_HASH_SEED + blk, digest_size=16).digest()
+
+
+# ---------------------------------------------------------------------------
+# Consistent-hash ring
+# ---------------------------------------------------------------------------
+
+
+class ConsistentHashRing:
+    """Classic vnode ring over replica ids (any hashable str).
+
+    ``candidates(key, n)`` walks clockwise from the key's point and
+    returns the first n DISTINCT replicas -- candidate 0 is the affinity
+    home, candidate 1 the deterministic spill target. With v vnodes per
+    replica, adding one replica to an N-replica ring claims ~1/(N+1) of
+    the keyspace and leaves every other key's home untouched.
+    """
+
+    def __init__(self, vnodes: int = 64) -> None:
+        self.vnodes = max(1, int(vnodes))
+        self._points: List[tuple] = []  # sorted (point:int, rid)
+        self._nodes: set = set()
+
+    def _vnode_points(self, rid: str):
+        for v in range(self.vnodes):
+            d = hashlib.blake2b(f"{rid}#{v}".encode(), digest_size=8)
+            yield int.from_bytes(d.digest(), "big")
+
+    def add(self, rid: str) -> None:
+        if rid in self._nodes:
+            return
+        self._nodes.add(rid)
+        for p in self._vnode_points(rid):
+            bisect.insort(self._points, (p, rid))
+
+    def remove(self, rid: str) -> None:
+        if rid not in self._nodes:
+            return
+        self._nodes.discard(rid)
+        self._points = [pt for pt in self._points if pt[1] != rid]
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def nodes(self) -> set:
+        return set(self._nodes)
+
+    def candidates(self, key: bytes, n: int = 2) -> List[str]:
+        if not self._points:
+            return []
+        point = int.from_bytes(
+            hashlib.blake2b(key, digest_size=8).digest(), "big"
+        )
+        i = bisect.bisect_right(self._points, (point, "￿"))
+        out: List[str] = []
+        seen: set = set()
+        for j in range(len(self._points)):
+            _, rid = self._points[(i + j) % len(self._points)]
+            if rid not in seen:
+                seen.add(rid)
+                out.append(rid)
+                if len(out) >= n:
+                    break
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Replica load + routing policy
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ReplicaLoad:
+    """Router-side view of one replica (fed by /healthz ``load`` or by
+    the fleet bench's worker stats; ``in_flight`` is the router's own
+    routed-not-finished count, covering the window before a request
+    shows up in the replica's queue gauges)."""
+
+    rid: str
+    role: str = "mixed"  # mixed | prefill | decode
+    max_slots: int = 8
+    queue_depth: int = 0
+    slots_active: int = 0
+    in_flight: int = 0
+    ttft_ema_ms: Optional[float] = None
+    healthy: bool = True
+    last_load_t: float = 0.0
+
+    def pressure(self) -> float:
+        """Demand over capacity, in units of 'full engines'. 0 = idle,
+        1.0 = every slot busy, >1 = queueing. The router-side in_flight
+        floor covers stale gauges (burst routed between load polls)."""
+        demand = max(self.queue_depth + self.slots_active, self.in_flight)
+        return demand / max(1, self.max_slots)
+
+    def est_ttft_ms(self, default_ms: float = 50.0) -> float:
+        """TTFT estimate for one MORE request on this replica: the
+        observed EMA scaled by queueing pressure (a request landing on a
+        replica with a full queue waits ~pressure engine-drains)."""
+        base = self.ttft_ema_ms if self.ttft_ema_ms else default_ms
+        return base * (1.0 + max(0.0, self.pressure()))
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    block: int = DEFAULT_BLOCK
+    vnodes: int = 64
+    # Second choice engages only past this pressure on the primary AND
+    # when the spill target is at least spill_margin less loaded --
+    # affinity is worth a bounded amount of queueing, not unbounded.
+    spill_threshold: float = 1.0
+    spill_margin: float = 0.5
+    # TTFT SLO: None disables shedding. Shed only when EVERY candidate's
+    # estimate exceeds it (a loaded primary with a healthy second choice
+    # spills instead of shedding).
+    slo_ttft_ms: Optional[float] = None
+    default_ttft_ms: float = 50.0
+    # Long-prompt steering: prompts at/over this many tokens (or chars
+    # for byte keys) bypass affinity -- to the prefill pool when one
+    # exists, else to the least-pressured candidate. None disables.
+    long_prompt_threshold: Optional[int] = None
+    # Retry-After clamp (seconds) for shed responses.
+    retry_after_min_s: float = 0.25
+    retry_after_max_s: float = 8.0
+
+
+@dataclasses.dataclass
+class RouteDecision:
+    kind: str                      # "direct" | "disagg" | "shed" | "none"
+    replica: Optional[str] = None          # decode/serving target
+    prefill_replica: Optional[str] = None  # disagg only
+    spilled: bool = False          # second choice taken
+    steered: bool = False          # long-prompt steering taken
+    est_ttft_ms: float = 0.0
+    retry_after_s: float = 0.0     # shed only
+
+
+class Router:
+    """Prefix-affinity, load-aware request router over N replicas.
+
+    Pure host-side policy: feed it replica membership (``add_replica`` /
+    ``remove_replica``), load snapshots (``update_load``), and observed
+    TTFTs (``observe_ttft``); ask it ``route(key, prompt_len)``. The
+    caller owns transport. Thread-compatible the way the engine's stats
+    are: dict/attribute ops only, no invariants spanning statements.
+    """
+
+    def __init__(self, config: Optional[RouterConfig] = None,
+                 name: str = "default") -> None:
+        self.cfg = config or RouterConfig()
+        self.name = name
+        self.ring = ConsistentHashRing(self.cfg.vnodes)
+        self.replicas: Dict[str, ReplicaLoad] = {}
+        reg = obs_registry.REGISTRY
+        lab = {"router": name}
+        self.c_requests = reg.counter("kftpu_router_requests_total", lab)
+        self.c_spilled = reg.counter("kftpu_router_spilled_total", lab)
+        self.c_steered = reg.counter("kftpu_router_steered_total", lab)
+        self.c_shed = reg.counter("kftpu_router_shed_total", lab)
+        self.c_disagg = reg.counter("kftpu_router_disagg_total", lab)
+
+    # -- membership ------------------------------------------------------
+
+    def add_replica(self, rid: str, role: str = "mixed",
+                    max_slots: int = 8) -> None:
+        """Prefill-role replicas serve handoffs only: they take load
+        queries but never join the ring (no decode traffic lands there
+        by hash)."""
+        rid = str(rid)
+        self.replicas[rid] = ReplicaLoad(
+            rid=rid, role=role, max_slots=max(1, int(max_slots))
+        )
+        if role != "prefill":
+            self.ring.add(rid)
+
+    def remove_replica(self, rid: str) -> None:
+        rid = str(rid)
+        self.replicas.pop(rid, None)
+        self.ring.remove(rid)
+
+    def sync_replicas(self, live: Dict[str, dict]) -> None:
+        """Reconcile membership to ``{rid: {"role", "max_slots"}}`` --
+        the activator calls this with the ready-replica set before each
+        route so scale events never leave the ring stale."""
+        for rid in list(self.replicas):
+            if rid not in live:
+                self.remove_replica(rid)
+        for rid, meta in live.items():
+            if rid not in self.replicas:
+                self.add_replica(rid, role=meta.get("role", "mixed"),
+                                 max_slots=meta.get("max_slots", 8))
+
+    # -- load signals ----------------------------------------------------
+
+    def update_load(self, rid: str, stats: Dict[str, Any]) -> None:
+        """Ingest an engine load snapshot (the ``load`` section of
+        /healthz, or engine.stats() directly)."""
+        rep = self.replicas.get(str(rid))
+        if rep is None:
+            return
+        rep.queue_depth = int(stats.get("queue_depth", rep.queue_depth))
+        rep.slots_active = int(stats.get("slots_active", rep.slots_active))
+        if stats.get("max_slots"):
+            rep.max_slots = int(stats["max_slots"])
+        ema = stats.get("ttft_ema_ms")
+        if ema:
+            rep.ttft_ema_ms = float(ema)
+        rep.healthy = bool(stats.get("healthy", True))
+        rep.last_load_t = time.monotonic()
+
+    def observe_ttft(self, rid: str, ttft_ms: float,
+                     alpha: float = 0.2) -> None:
+        """Client-side TTFT EMA update -- keeps estimates live between
+        load polls (same alpha as the engine's own ttft_ema_ms)."""
+        rep = self.replicas.get(str(rid))
+        if rep is None:
+            return
+        rep.ttft_ema_ms = (
+            ttft_ms if rep.ttft_ema_ms is None
+            else alpha * ttft_ms + (1 - alpha) * rep.ttft_ema_ms
+        )
+
+    def start_request(self, rid: str) -> None:
+        rep = self.replicas.get(str(rid))
+        if rep is not None:
+            rep.in_flight += 1
+
+    def finish_request(self, rid: str,
+                       ttft_ms: Optional[float] = None) -> None:
+        rep = self.replicas.get(str(rid))
+        if rep is not None:
+            rep.in_flight = max(0, rep.in_flight - 1)
+        if ttft_ms is not None:
+            self.observe_ttft(rid, ttft_ms)
+
+    # -- policy ----------------------------------------------------------
+
+    def route(self, key: bytes, prompt_len: int = 0) -> RouteDecision:
+        """One routing decision; no state change beyond counters (the
+        caller pairs start_request/finish_request around transport)."""
+        cfg = self.cfg
+        self.c_requests.inc()
+        cands = [
+            self.replicas[r]
+            for r in self.ring.candidates(key, 2)
+            if r in self.replicas and self.replicas[r].healthy
+        ]
+        if not cands:
+            return RouteDecision(kind="none")
+        long_prompt = (
+            cfg.long_prompt_threshold is not None
+            and prompt_len >= cfg.long_prompt_threshold
+        )
+        prefill_pool = [
+            r for r in self.replicas.values()
+            if r.role == "prefill" and r.healthy
+        ]
+        decision: RouteDecision
+        if long_prompt and prefill_pool:
+            # Disaggregated: the prompt prefills on a dedicated replica
+            # (chosen by least pressure -- prefill work has no affinity
+            # value, its KV ships out) and decodes on the affinity home,
+            # which receives the KV packet and keeps its interactive
+            # traffic's TTFT out of the long prefill's shadow.
+            pre = min(prefill_pool, key=lambda r: r.pressure())
+            decision = RouteDecision(
+                kind="disagg", replica=cands[0].rid,
+                prefill_replica=pre.rid, steered=True,
+                est_ttft_ms=cands[0].est_ttft_ms(cfg.default_ttft_ms),
+            )
+            self.c_steered.inc()
+            self.c_disagg.inc()
+        elif long_prompt:
+            # No prefill pool: steer the long prompt to the least-
+            # pressured candidate instead of its affinity home -- a long
+            # prefill monopolizes admission, and parking it on the
+            # busiest replica is exactly the 386 tok/s mixed-workload
+            # failure mode (SERVING_BENCH.json).
+            tgt = min(cands, key=lambda r: r.pressure())
+            decision = RouteDecision(
+                kind="direct", replica=tgt.rid,
+                steered=tgt.rid != cands[0].rid,
+                est_ttft_ms=tgt.est_ttft_ms(cfg.default_ttft_ms),
+            )
+            if decision.steered:
+                self.c_steered.inc()
+        else:
+            primary = cands[0]
+            chosen, spilled = primary, False
+            if (len(cands) > 1
+                    and primary.pressure() >= cfg.spill_threshold
+                    and cands[1].pressure()
+                    <= primary.pressure() - cfg.spill_margin):
+                chosen, spilled = cands[1], True
+            decision = RouteDecision(
+                kind="direct", replica=chosen.rid, spilled=spilled,
+                est_ttft_ms=chosen.est_ttft_ms(cfg.default_ttft_ms),
+            )
+            if spilled:
+                self.c_spilled.inc()
+        if cfg.slo_ttft_ms is not None:
+            ests = [r.est_ttft_ms(cfg.default_ttft_ms) for r in cands]
+            if min(ests) > cfg.slo_ttft_ms:
+                # Overload everywhere the key may go: shed with a
+                # Retry-After sized to the estimated excess (how long
+                # the backlog needs to drain back under the SLO).
+                retry = min(
+                    max((min(ests) - cfg.slo_ttft_ms) / 1000.0,
+                        cfg.retry_after_min_s),
+                    cfg.retry_after_max_s,
+                )
+                self.c_shed.inc()
+                decision = RouteDecision(
+                    kind="shed", est_ttft_ms=min(ests),
+                    retry_after_s=round(retry, 3),
+                )
+        if trace.enabled():
+            trace.instant(
+                "route", plane="serving", track="router",
+                kind=decision.kind, replica=decision.replica or "",
+                spilled=decision.spilled, steered=decision.steered,
+                est_ttft_ms=round(decision.est_ttft_ms, 2),
+            )
+        return decision
+
+    def stats(self) -> dict:
+        return {
+            "replicas": {
+                r.rid: {
+                    "role": r.role,
+                    "pressure": round(r.pressure(), 3),
+                    "queue_depth": r.queue_depth,
+                    "slots_active": r.slots_active,
+                    "in_flight": r.in_flight,
+                    "ttft_ema_ms": (
+                        round(r.ttft_ema_ms, 3) if r.ttft_ema_ms else 0.0
+                    ),
+                }
+                for r in self.replicas.values()
+            },
+            "requests": self.c_requests.value,
+            "spilled": self.c_spilled.value,
+            "steered": self.c_steered.value,
+            "shed": self.c_shed.value,
+            "disagg": self.c_disagg.value,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Disaggregation wire format (KV handoff packets)
+# ---------------------------------------------------------------------------
+
+PACKET_MAGIC = b"KFTPKV1\n"
+_HDR_LEN = struct.Struct("<I")
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 et al register through ml_dtypes (a jax dependency,
+        # importable without pulling jax itself).
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def pack_kv_packet(tokens: Sequence[int], k_rows: Any, v_rows: Any, *,
+                   block: int = DEFAULT_BLOCK,
+                   trace_id: Optional[str] = None,
+                   extra: Optional[dict] = None) -> bytes:
+    """Serialize one prefix-cache entry for transport.
+
+    ``tokens`` are the covered prompt tokens (a block multiple);
+    ``k_rows``/``v_rows`` are HOST arrays exactly as the engine stores
+    them -- bf16 [L, P, KV, D], or for int8 kv_quant a dict of ``q``
+    int8 [L, P, KV, D] and ``s`` f32 lane-aligned [L, KV, Smax] (the
+    PR 1 layout; shipped raw, no transpose). Layout:
+
+        magic | u32 header_len | header JSON | tensor bytes, in order
+
+    The header carries the PrefixCache chain hash of ``tokens`` so the
+    importer proves token-exact prefix identity before touching its
+    cache, plus the propagated trace id for cross-process span
+    stitching.
+    """
+    n_cov, h = chain_hash(tokens, block)
+    if n_cov != len(tokens) or n_cov == 0:
+        raise ValueError(
+            f"tokens must be a nonzero multiple of block={block}, "
+            f"got {len(tokens)}"
+        )
+    tensors: List[dict] = []
+    blobs: List[bytes] = []
+
+    def _add(tname: str, arr: Any) -> None:
+        arr = np.ascontiguousarray(arr)
+        tensors.append({"name": tname, "dtype": str(arr.dtype),
+                        "shape": list(arr.shape)})
+        blobs.append(arr.tobytes())
+
+    _add("tokens", np.asarray(list(tokens), np.int32))
+    quantized = isinstance(k_rows, dict)
+    for prefix, rows in (("k", k_rows), ("v", v_rows)):
+        if isinstance(rows, dict):
+            _add(prefix + ".q", rows["q"])
+            _add(prefix + ".s", rows["s"])
+        else:
+            _add(prefix, rows)
+    header = {
+        "version": 1,
+        "block": block,
+        "plen": len(tokens),
+        "layout": ("int8-lane[L,KV,Smax]" if quantized
+                   else "bf16[L,P,KV,D]"),
+        "chain_hash": h.hex(),
+        "trace_id": trace_id or trace.trace_id() or "",
+        "tensors": tensors,
+    }
+    if extra:
+        header.update(extra)
+    hdr = json.dumps(header).encode()
+    return b"".join([PACKET_MAGIC, _HDR_LEN.pack(len(hdr)), hdr] + blobs)
+
+
+def unpack_kv_packet(buf: bytes) -> dict:
+    """Inverse of pack_kv_packet; verifies magic and the chain hash
+    (corrupt or re-tokenized packets fail closed -- a wrong prefix in a
+    decode replica's cache would silently poison every later hit)."""
+    if buf[:len(PACKET_MAGIC)] != PACKET_MAGIC:
+        raise ValueError("not a KV handoff packet (bad magic)")
+    off = len(PACKET_MAGIC)
+    (hlen,) = _HDR_LEN.unpack_from(buf, off)
+    off += _HDR_LEN.size
+    header = json.loads(buf[off:off + hlen].decode())
+    off += hlen
+    arrays: Dict[str, np.ndarray] = {}
+    for t in header["tensors"]:
+        dt = _np_dtype(t["dtype"])
+        n = int(np.prod(t["shape"])) * dt.itemsize if t["shape"] else dt.itemsize
+        arr = np.frombuffer(buf[off:off + n], dtype=dt)
+        arrays[t["name"]] = arr.reshape(t["shape"])
+        off += n
+    tokens = arrays["tokens"].tolist()
+    n_cov, h = chain_hash(tokens, header["block"])
+    if n_cov != header["plen"] or h.hex() != header["chain_hash"]:
+        raise ValueError("KV packet chain-hash mismatch")
+    if "k.q" in arrays:
+        k_rows: Any = {"q": arrays["k.q"], "s": arrays["k.s"]}
+        v_rows: Any = {"q": arrays["v.q"], "s": arrays["v.s"]}
+    else:
+        k_rows, v_rows = arrays["k"], arrays["v"]
+    return {"tokens": tokens, "plen": header["plen"], "k": k_rows,
+            "v": v_rows, "block": header["block"],
+            "layout": header["layout"],
+            "trace_id": header.get("trace_id") or None, "header": header}
+
+
+def handoff_prefix(src_engine: Any, dst_engine: Any,
+                   prompt: Sequence[int], *,
+                   timeout: float = 120.0) -> Optional[dict]:
+    """Prefill ``prompt`` on ``src_engine`` and hand its KV prefix to
+    ``dst_engine`` through the wire format (full pack -> bytes ->
+    unpack round trip, same path a cross-process transport takes).
+    Returns {"plen", "bytes"} or None when the prompt is under one
+    block (nothing to hand off -- the decode replica just prefills).
+    """
+    block = src_engine.prefix_cache.block
+    with trace.span("kv-handoff", plane="serving", track="router",
+                    prompt_len=len(prompt)):
+        plen = src_engine.ensure_prefix(prompt, timeout=timeout)
+        if not plen:
+            return None
+        pkt = src_engine.export_prefix(prompt)
+        if pkt is None:
+            return None
+        buf = pack_kv_packet(pkt["tokens"], pkt["k"], pkt["v"],
+                             block=block)
+        got = unpack_kv_packet(buf)
+        dst_engine.import_prefix(got)
+        trace.instant("kv-handoff.bytes", plane="serving",
+                      track="router", plen=plen, nbytes=len(buf))
+        return {"plen": plen, "bytes": len(buf)}

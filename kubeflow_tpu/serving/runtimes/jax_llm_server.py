@@ -357,6 +357,16 @@ class JaxLLMModel(Model):
         eng = self.engine
         gap = eng.host_gap_ms_ema
         return {
+            # Router load signals (docs/FLEET.md): queue pressure and
+            # the live TTFT EMA, mirrored into /healthz by the server
+            # so the activator's load poll is one cheap GET.
+            "queue_depth": eng.pending.qsize() + len(eng._backlog),
+            "slots_active": len(eng.active),
+            "max_slots": eng.max_slots,
+            "ttft_ema_ms": (
+                round(eng.ttft_ms_ema, 3)
+                if eng.ttft_ms_ema is not None else 0.0
+            ),
             # Configured depth vs the LIVE queued-lane count: inflight
             # == depth means the pipeline is saturated; 0 at depth > 0
             # means it is draining (admissions/constraints/spec).
@@ -409,6 +419,10 @@ class JaxLLMModel(Model):
              "overshoot_tokens_discarded"),
             ("kftpu_engine_overshoot_max_per_drain",
              "overshoot_max_per_drain"),
+            # Live TTFT EMA (ms): the per-replica routing signal
+            # (docs/FLEET.md) -- the histogram gives the distribution,
+            # this gives the router's one current number.
+            ("kftpu_engine_ttft_ema_ms", "ttft_ema_ms"),
         ):
             reg.gauge(key, lab).set(s[stat])
         if "weight_bytes" in s:
@@ -449,6 +463,49 @@ class JaxLLMModel(Model):
             hist.name, hist.labels = hname, lab
             reg.register(hist)
         return reg.expose()
+
+    def export_prefix_packet(self, prompt: Optional[str] = None,
+                             token_ids: Optional[List[int]] = None,
+                             ensure: bool = True) -> Optional[bytes]:
+        """Prefill-replica half of the disaggregated handoff
+        (docs/FLEET.md): prefill the prompt into the prefix cache (when
+        ``ensure``) and serialize the covered entry through the
+        router wire format. None when nothing is coverable (prompt
+        under one prefix block)."""
+        from kubeflow_tpu.serving import router as _router
+
+        if self.engine is None or self.engine.prefix_cache is None:
+            raise InferenceError(
+                "disaggregated handoff needs prefix_cache_mb > 0", 409
+            )
+        ids = list(token_ids) if token_ids else self.tokenizer.encode(
+            prompt or ""
+        )
+        if not ids:
+            raise InferenceError("empty prompt", 400)
+        if ensure:
+            self.engine.ensure_prefix(ids)
+        pkt = self.engine.export_prefix(ids)
+        if pkt is None:
+            return None
+        return _router.pack_kv_packet(
+            pkt["tokens"], pkt["k"], pkt["v"],
+            block=self.engine.prefix_cache.block,
+        )
+
+    def import_prefix_packet(self, buf: bytes) -> int:
+        """Decode-replica half: adopt a packed KV prefix so the next
+        request sharing it restores instead of prefilling."""
+        from kubeflow_tpu.serving import router as _router
+
+        if self.engine is None or self.engine.prefix_cache is None:
+            raise InferenceError(
+                "disaggregated handoff needs prefix_cache_mb > 0", 409
+            )
+        try:
+            return self.engine.import_prefix(_router.unpack_kv_packet(buf))
+        except ValueError as e:
+            raise InferenceError(f"bad KV packet: {e}", 400)
 
     def _json_masks(self):
         """Token-mask table for json_object constrained decoding, built

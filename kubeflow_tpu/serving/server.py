@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 from typing import Optional
 
@@ -82,6 +83,13 @@ class ModelServer:
             web.get("/v2/models/{m}/ready", self.h_v2_model_ready),
             web.post("/v2/models/{m}/infer", self.h_v2_infer),
             web.post("/v2/models/{m}/generate", self.h_v2_generate),
+            # Disaggregated prefill/decode KV handoff (docs/FLEET.md):
+            # export serializes a prefilled prefix-cache entry through
+            # the router wire format; import adopts one.
+            web.post("/v2/models/{m}/prefix/export",
+                     self.h_v2_prefix_export),
+            web.post("/v2/models/{m}/prefix/import",
+                     self.h_v2_prefix_import),
             web.post("/v2/models/{m}/generate_stream",
                      self.h_v2_generate_stream),
             web.post("/v2/repository/models/{m}/load", self.h_v2_load),
@@ -139,11 +147,78 @@ class ModelServer:
     # -- health / metrics --------------------------------------------------
 
     async def h_healthz(self, req: web.Request) -> web.Response:
-        return web.json_response({
+        out = {
             "ok": True, "ready": self._ready(),
             "models": self.repository.names(),
             "uptime": time.time() - self.started_at,
-        })
+        }
+        # Router load signals (docs/FLEET.md): per-model queue/TTFT
+        # gauges so the activator's load poll is this one GET instead
+        # of a Prometheus scrape + parse. Additive key -- old probers
+        # only read "ready".
+        role = os.environ.get("KFTPU_REPLICA_ROLE", "")
+        if role:
+            out["role"] = role
+        load = {}
+        for n in self.repository.names():
+            model = self.repository.get(n)
+            gauges = getattr(model, "engine_gauges", None)
+            if gauges is None or getattr(model, "engine", None) is None:
+                continue
+            g = gauges()
+            load[n] = {k: g[k] for k in (
+                "queue_depth", "slots_active", "max_slots", "ttft_ema_ms"
+            ) if k in g}
+        if load:
+            out["load"] = load
+        return web.json_response(out)
+
+    async def h_v2_prefix_export(self, req: web.Request) -> web.Response:
+        name = req.match_info["m"]
+        try:
+            model = self.repository.get(name)
+            if not model.ready:
+                raise InferenceError(f"model {name} is not ready", 503)
+            fn = getattr(model, "export_prefix_packet", None)
+            if fn is None:
+                raise InferenceError(
+                    f"model {name} does not support KV handoff", 501
+                )
+            body = await req.json()
+            # ensure_prefix blocks on an engine-thread prefill: keep the
+            # event loop serving while it runs.
+            buf = await asyncio.to_thread(
+                fn, body.get("prompt"), body.get("token_ids"),
+                bool(body.get("ensure", True)),
+            )
+        except json.JSONDecodeError:
+            return web.json_response({"error": "body must be JSON"},
+                                     status=400)
+        except InferenceError as e:
+            return self._err(e)
+        if buf is None:
+            # Prompt under one prefix block: nothing to hand off, the
+            # decode replica just prefills it locally.
+            return web.Response(status=204)
+        return web.Response(body=buf,
+                            content_type="application/octet-stream")
+
+    async def h_v2_prefix_import(self, req: web.Request) -> web.Response:
+        name = req.match_info["m"]
+        try:
+            model = self.repository.get(name)
+            if not model.ready:
+                raise InferenceError(f"model {name} is not ready", 503)
+            fn = getattr(model, "import_prefix_packet", None)
+            if fn is None:
+                raise InferenceError(
+                    f"model {name} does not support KV handoff", 501
+                )
+            buf = await req.read()
+            plen = await asyncio.to_thread(fn, buf)
+        except InferenceError as e:
+            return self._err(e)
+        return web.json_response({"plen": plen})
 
     async def h_metrics(self, req: web.Request) -> web.Response:
         m = self.metrics

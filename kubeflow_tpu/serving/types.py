@@ -91,6 +91,37 @@ class MultiModelSpec(BaseModel):
     max_models_per_replica: int = Field(default=4, ge=1)
 
 
+class RoutingSpec(BaseModel):
+    """Fleet data-plane routing for a multi-replica component
+    (serving/router.py, docs/FLEET.md). Absent -> the legacy
+    round-robin activator path, byte-for-byte.
+
+    ``policy="prefix"`` consistent-hash-routes requests on the prompt
+    prefix (the activator keys on the leading request-body text; the
+    granularity matches the engine prefix cache) so per-replica prefix
+    caches compose into a fleet-level one, with queue/TTFT-aware
+    second-choice spill. ``slo_ttft_ms`` arms load shedding: when every
+    candidate's TTFT estimate exceeds it, the activator answers 429
+    with a computed Retry-After. ``long_prompt_threshold_chars`` steers
+    long prompts off their affinity home (to the prefill pool when
+    ``prefill_replicas`` > 0 -- disaggregated mode, where the prefill
+    replica hands the KV prefix to the decode replica over the packet
+    wire format -- else to the least-loaded candidate)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    policy: str = "prefix"  # prefix | round_robin
+    vnodes: int = Field(default=64, ge=1)
+    slo_ttft_ms: Optional[float] = Field(default=None, gt=0)
+    long_prompt_threshold_chars: Optional[int] = Field(default=None, ge=1)
+    # First N replica indexes spawn as dedicated prefill replicas
+    # (KFTPU_REPLICA_ROLE=prefill): they never take decode traffic,
+    # only handoff prefills.
+    prefill_replicas: int = Field(default=0, ge=0)
+    # Activator -> replica /healthz load-poll period (seconds).
+    load_poll_seconds: float = Field(default=2.0, gt=0)
+
+
 class ComponentSpec(BaseModel):
     """One ISVC component (predictor or transformer)."""
 
@@ -100,6 +131,7 @@ class ComponentSpec(BaseModel):
     custom: Optional[CustomSpec] = None
     multi_model: Optional[MultiModelSpec] = None
     logger: Optional[LoggerSpec] = None
+    routing: Optional[RoutingSpec] = None
     resources: Resources = Field(default_factory=Resources)
     min_replicas: int = 1  # 0 = scale-to-zero
     max_replicas: int = 1
@@ -298,6 +330,24 @@ def validate_isvc(isvc: InferenceService) -> None:
             )
         if comp.target_concurrency <= 0:
             raise ServingValidationError(f"{label}: target_concurrency must be > 0")
+        if comp.routing is not None:
+            if comp.routing.policy not in ("prefix", "round_robin"):
+                raise ServingValidationError(
+                    f"{label}: routing.policy must be prefix|round_robin"
+                )
+            if label != "predictor":
+                raise ServingValidationError(
+                    "routing applies to predictors only (transformer/"
+                    "explainer hops forward to the routed predictor)"
+                )
+            if comp.routing.prefill_replicas >= max(
+                comp.min_replicas, comp.max_replicas
+            ):
+                raise ServingValidationError(
+                    f"{label}: routing.prefill_replicas "
+                    f"{comp.routing.prefill_replicas} must leave at "
+                    "least one decode replica (< max_replicas)"
+                )
         if comp.multi_model is not None:
             if label != "predictor":
                 raise ServingValidationError(

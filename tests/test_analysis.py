@@ -430,6 +430,7 @@ def test_perf_shipped_baseline_passes_shipped_artifacts():
     # The floors actually looked at data (non-vacuous skip detection).
     assert any(k.startswith("train.mfu.seq") for k in measured)
     assert any(k.startswith("serving.tok_s.slots") for k in measured)
+    assert any(k.startswith("fleet.") for k in measured)
 
 
 def test_perf_planted_mfu_regression_exits_one(monkeypatch, capsys, tmp_path):
@@ -470,6 +471,62 @@ def test_perf_vanished_sweep_row_is_a_finding(tmp_path):
     findings, _ = analysis.check_perf(baseline, root=str(tmp_path))
     assert [f.rule for f in findings] == ["KT-PERF-MFU"]
     assert "8192" in findings[0].message
+
+
+def test_perf_planted_fleet_regression_exits_one(monkeypatch, capsys,
+                                                 tmp_path):
+    bad = analysis.load_perf_baseline()
+    bad["fleet"]["aggregate_speedup_floor"] = 99.0
+    p = tmp_path / "perf.json"
+    p.write_text(json.dumps(bad))
+    rc, out = _run_cli(monkeypatch, capsys, [], {},
+                       ["--strict", "--json", "--perf-baseline", str(p)])
+    assert rc == 1
+    assert any(f["rule"] == "KT-PERF-FLEET" and f["hard"]
+               for f in json.loads(out)["new"])
+
+
+def test_perf_fleet_section_vanishing_is_a_finding(tmp_path):
+    # An artifact WITH a sweep but WITHOUT extra.fleet trips the floor
+    # (the fleet bench silently dropped out of the orchestrated run).
+    (tmp_path / "SERVING_BENCH.json").write_text(json.dumps({
+        "extra": {"sweep": [{"max_slots": 8, "tokens_per_sec": 400.0}]},
+    }))
+    baseline = {"fleet": {"aggregate_speedup_floor": 1.5}}
+    findings, _ = analysis.check_perf(baseline, root=str(tmp_path))
+    assert [f.rule for f in findings] == ["KT-PERF-FLEET"]
+    assert "vanished" in findings[0].message
+
+
+def test_perf_fleet_disagg_invariants_required(tmp_path):
+    doc = {"extra": {"sweep": [], "fleet": {
+        "aggregate_speedup": 1.9,
+        "disagg": {"token_parity": False},
+    }}}
+    (tmp_path / "SERVING_BENCH.json").write_text(json.dumps(doc))
+    baseline = {"fleet": {
+        "aggregate_speedup_floor": 1.7,
+        "disagg_required": ["token_parity", "trace_chain_complete"],
+    }}
+    findings, measured = analysis.check_perf(baseline, root=str(tmp_path))
+    assert measured["fleet.aggregate_speedup"] == 1.9
+    msgs = [f.message for f in findings]
+    assert len(findings) == 2 and all(
+        f.rule == "KT-PERF-FLEET" for f in findings)
+    assert any("token_parity = False" in m for m in msgs)
+    assert any("trace_chain_complete = None" in m for m in msgs)
+
+
+def test_perf_fleet_shed_rate_sanity_range(tmp_path):
+    doc = {"extra": {"sweep": [], "fleet": {
+        "aggregate_speedup": 1.9,
+        "overload": {"shed_rate": 0.0},
+    }}}
+    (tmp_path / "SERVING_BENCH.json").write_text(json.dumps(doc))
+    baseline = {"fleet": {"overload_shed_rate_range": [0.15, 0.85]}}
+    findings, _ = analysis.check_perf(baseline, root=str(tmp_path))
+    assert [f.rule for f in findings] == ["KT-PERF-FLEET"]
+    assert "never fired" in findings[0].message
 
 
 def test_perf_ceilings_check_live_metrics():

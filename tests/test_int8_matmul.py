@@ -67,6 +67,10 @@ def test_rejects_batch_dims():
         q8_dot_general(x, w, dn)
 
 
+# slow: tier-1 triage 2026-08 -- the gate crept past its 870s budget
+# and was killed mid-suite; this composition test keeps its core
+# contract covered by a faster sibling in tier-1.
+@pytest.mark.slow
 def test_train_step_loss_parity():
     """llama-tiny: 5 int8_matmul steps track bf16 within a few 1e-3."""
     from kubeflow_tpu.models import get_task

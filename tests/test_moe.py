@@ -143,6 +143,10 @@ class TestExpertParallelTraining:
             "pipe", "expert", "fsdp", "tensor"
         )
 
+    # slow: tier-1 triage 2026-08 -- the gate crept past its 870s budget
+    # and was killed mid-suite; this composition test keeps its core
+    # contract covered by a faster sibling in tier-1.
+    @pytest.mark.slow
     def test_moe_matches_across_mesh_layouts(self):
         """Same seed, same data: expert-parallel mesh == single-layout."""
         outs = []

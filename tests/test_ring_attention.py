@@ -92,6 +92,10 @@ def test_auto_dispatch_uses_ring_only_with_sequence_axis():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
 
 
+# slow: tier-1 triage 2026-08 -- the gate crept past its 870s budget
+# and was killed mid-suite; this composition test keeps its core
+# contract covered by a faster sibling in tier-1.
+@pytest.mark.slow
 def test_llama_train_step_with_ring_attention():
     """Full sharded train step with sequence=4: loss finite and close to
     the same step on a sequence=1 mesh (same data, same init)."""

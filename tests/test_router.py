@@ -1,0 +1,444 @@
+"""Serving router tests: affinity-key parity with the engine's
+PrefixCache, consistent-hash ring movement bounds, spill/steer/shed
+policy, the KV-handoff wire format, and cross-engine handoff token
+parity.
+
+Token-exact assertions compare engine-vs-engine (same preset + seed =>
+identical weights, greedy decode is deterministic), matching the
+convention in test_serving_engine.py.
+"""
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.obs import trace as obs_trace
+from kubeflow_tpu.serving.router import (
+    ConsistentHashRing,
+    RouteDecision,
+    Router,
+    RouterConfig,
+    chain_hash,
+    pack_kv_packet,
+    prefix_route_key,
+    unpack_kv_packet,
+)
+
+# ---------------------------------------------------------------------------
+# Affinity keys
+# ---------------------------------------------------------------------------
+
+
+def test_route_key_matches_prefix_cache_chain_hash():
+    # The router's token key must BE the engine cache's first-block
+    # chain hash -- that identity is what makes per-replica caches
+    # compose into a fleet-level one.
+    from kubeflow_tpu.serving.engine import PrefixCache
+
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, 1000, 40).tolist()
+    pc = PrefixCache(block=8, capacity_bytes=1 << 20)
+    assert prefix_route_key(prompt, block=8) == pc.chain_hashes(prompt, 8)[0][1]
+    # chain_hash (full covered prefix) matches the cache's last row too.
+    n, h = chain_hash(prompt, block=8)
+    assert n == 40
+    assert (n, h) == pc.chain_hashes(prompt, len(prompt))[-1]
+
+
+def test_route_key_prefix_families_colocate():
+    shared = list(range(100, 228))  # one 128-token block
+    a = prefix_route_key(shared + [1, 2, 3])
+    b = prefix_route_key(shared + [9, 8, 7, 6])
+    c = prefix_route_key(list(range(500, 628)) + [1, 2, 3])
+    assert a == b
+    assert a != c
+
+
+def test_route_key_text_and_bytes():
+    sys_prompt = "You are a helpful assistant. " * 40  # > 512 chars
+    a = prefix_route_key(sys_prompt + "What is 2+2?")
+    b = prefix_route_key(sys_prompt + "Summarize this document.")
+    assert a == b
+    assert prefix_route_key("completely different") != a
+    # Byte keys hash under a distinct seed: a token list and its byte
+    # rendering never collide.
+    assert prefix_route_key(b"\x01\x02\x03") != prefix_route_key([1, 2, 3])
+
+
+def test_short_prompt_keys_distinct_by_length():
+    assert prefix_route_key([1, 2, 3]) != prefix_route_key([1, 2, 3, 4])
+
+
+# ---------------------------------------------------------------------------
+# Consistent-hash ring
+# ---------------------------------------------------------------------------
+
+
+def _keys(n):
+    return [prefix_route_key([i, i + 1, i + 2]) for i in range(n)]
+
+
+def test_ring_add_moves_bounded_fraction():
+    ring = ConsistentHashRing(vnodes=64)
+    for i in range(8):
+        ring.add(f"r{i}")
+    keys = _keys(2000)
+    before = {k: ring.candidates(k, 1)[0] for k in keys}
+    ring.add("r8")
+    after = {k: ring.candidates(k, 1)[0] for k in keys}
+    moved = sum(1 for k in keys if before[k] != after[k])
+    # Expected ~1/9 of the keyspace; generous slack for vnode variance.
+    assert 0.02 < moved / len(keys) < 0.25
+    # Every moved key landed on the NEW replica -- existing homes only
+    # lose keys to the newcomer, never to each other.
+    assert all(after[k] == "r8" for k in keys if before[k] != after[k])
+
+
+def test_ring_remove_only_moves_victims_keys():
+    ring = ConsistentHashRing(vnodes=64)
+    for i in range(8):
+        ring.add(f"r{i}")
+    keys = _keys(2000)
+    before = {k: ring.candidates(k, 1)[0] for k in keys}
+    ring.remove("r3")
+    after = {k: ring.candidates(k, 1)[0] for k in keys}
+    for k in keys:
+        if before[k] != "r3":
+            assert after[k] == before[k]
+        else:
+            assert after[k] != "r3"
+
+
+def test_ring_candidates_distinct_and_deterministic():
+    ring = ConsistentHashRing(vnodes=32)
+    for i in range(4):
+        ring.add(f"r{i}")
+    k = prefix_route_key([7, 7, 7])
+    c1 = ring.candidates(k, 3)
+    assert len(c1) == len(set(c1)) == 3
+    assert c1 == ring.candidates(k, 3)
+    assert ring.candidates(k, 10) == ring.candidates(k, 4)  # caps at N
+
+
+# ---------------------------------------------------------------------------
+# Routing policy
+# ---------------------------------------------------------------------------
+
+
+def _router(n=2, **cfg):
+    r = Router(RouterConfig(**cfg), name="test")
+    for i in range(n):
+        r.add_replica(f"r{i}", max_slots=8)
+    return r
+
+
+def test_route_affinity_is_sticky():
+    r = _router(4)
+    key = prefix_route_key(list(range(128)))
+    first = r.route(key).replica
+    assert all(r.route(key).replica == first for _ in range(10))
+
+
+def test_spill_engages_only_under_pressure_gap():
+    r = _router(2, spill_threshold=1.0, spill_margin=0.5)
+    key = prefix_route_key(list(range(128)))
+    home = r.route(key).replica
+    other = ({"r0", "r1"} - {home}).pop()
+    # Idle: no spill.
+    assert not r.route(key).spilled
+    # Home saturated, other idle: spill to the second choice.
+    r.update_load(home, {"queue_depth": 8, "slots_active": 8})
+    d = r.route(key)
+    assert d.spilled and d.replica == other
+    # Other equally saturated: margin not met, stay home (affinity is
+    # worth bounded queueing).
+    r.update_load(other, {"queue_depth": 8, "slots_active": 8})
+    d = r.route(key)
+    assert not d.spilled and d.replica == home
+
+
+def test_long_prompt_steers_to_least_loaded():
+    r = _router(2, long_prompt_threshold=512)
+    key = prefix_route_key(list(range(128)))
+    home = r.route(key, prompt_len=10).replica
+    other = ({"r0", "r1"} - {home}).pop()
+    # Pressure 0.75: below the spill threshold (shorts stay home) but
+    # enough that least-pressure steering prefers the idle replica.
+    r.update_load(home, {"slots_active": 6})
+    d = r.route(key, prompt_len=2048)
+    assert d.kind == "direct" and d.steered and d.replica == other
+    # Short prompts keep their affinity home under that same load.
+    assert r.route(key, prompt_len=10).replica == home
+
+
+def test_prefill_replica_never_in_ring_and_disagg_route():
+    r = Router(RouterConfig(long_prompt_threshold=512), name="test")
+    r.add_replica("pre0", role="prefill", max_slots=8)
+    r.add_replica("d0", role="decode", max_slots=8)
+    r.add_replica("d1", role="decode", max_slots=8)
+    # No short-prompt traffic ever hashes onto the prefill replica.
+    for i in range(50):
+        d = r.route(prefix_route_key([i] * 3), prompt_len=3)
+        assert d.replica in ("d0", "d1")
+    # Long prompt: disagg -- prefill on the pool, decode on affinity.
+    d = r.route(prefix_route_key(list(range(128))), prompt_len=2048)
+    assert d.kind == "disagg"
+    assert d.prefill_replica == "pre0"
+    assert d.replica in ("d0", "d1")
+    assert d.steered
+    assert r.stats()["disagg"] == 1
+
+
+def test_shed_when_all_candidates_over_slo():
+    r = _router(2, slo_ttft_ms=100.0)
+    key = prefix_route_key(list(range(128)))
+    # One healthy candidate: spill, don't shed.
+    r.update_load("r0", {"ttft_ema_ms": 500.0, "queue_depth": 8,
+                         "slots_active": 8})
+    assert r.route(key).kind == "direct"
+    # Both over: shed, Retry-After = (min est - slo)/1000 clamped.
+    r.update_load("r1", {"ttft_ema_ms": 500.0, "queue_depth": 8,
+                         "slots_active": 8})
+    d = r.route(key)
+    assert d.kind == "shed" and d.replica is None
+    # est = 500 * (1 + 16/8) = 1500ms => retry (1500-100)/1000 = 1.4s
+    assert d.retry_after_s == pytest.approx(1.4, abs=0.01)
+    assert r.stats()["shed"] == 1
+    # Clamps: tiny excess floors at retry_after_min_s.
+    r2 = _router(1, slo_ttft_ms=100.0, retry_after_min_s=0.25)
+    r2.update_load("r0", {"ttft_ema_ms": 101.0})
+    d2 = r2.route(key)
+    assert d2.kind == "shed" and d2.retry_after_s == 0.25
+
+
+def test_sync_replicas_and_unhealthy_and_empty():
+    r = _router(2)
+    assert r.route(b"x" * 16).kind == "direct"
+    r.sync_replicas({"r1": {"role": "mixed", "max_slots": 4},
+                     "r2": {"role": "mixed", "max_slots": 4}})
+    assert set(r.replicas) == {"r1", "r2"}
+    assert r.replicas["r2"].max_slots == 4
+    r.update_load("r1", {"healthy": False})
+    r.update_load("r2", {"healthy": False})
+    assert r.route(b"x" * 16).kind == "none"
+    r.sync_replicas({})
+    assert r.route(b"x" * 16).kind == "none"
+
+
+def test_update_load_ignores_falsy_gauges():
+    r = _router(1)
+    r.update_load("r0", {"queue_depth": 3, "max_slots": 0,
+                         "ttft_ema_ms": None})
+    rep = r.replicas["r0"]
+    assert rep.max_slots == 8 and rep.ttft_ema_ms is None
+    assert rep.queue_depth == 3
+    r.observe_ttft("r0", 100.0)
+    r.observe_ttft("r0", 200.0)  # EMA alpha=0.2: 0.2*200 + 0.8*100
+    assert rep.ttft_ema_ms == pytest.approx(120.0)
+
+
+def test_start_finish_request_in_flight_pressure():
+    r = _router(1)
+    for _ in range(16):
+        r.start_request("r0")
+    assert r.replicas["r0"].pressure() == pytest.approx(2.0)
+    for _ in range(16):
+        r.finish_request("r0", ttft_ms=80.0)
+    assert r.replicas["r0"].in_flight == 0
+    assert r.replicas["r0"].ttft_ema_ms == pytest.approx(80.0, abs=20.0)
+
+
+# ---------------------------------------------------------------------------
+# KV-handoff wire format
+# ---------------------------------------------------------------------------
+
+
+def _packet_arrays(quantized):
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(1, 500, 16).tolist()
+    if quantized:
+        k = {"q": rng.integers(-127, 127, (2, 16, 2, 4), dtype=np.int8),
+             "s": rng.random((2, 2, 16), dtype=np.float32)}
+        v = {"q": rng.integers(-127, 127, (2, 16, 2, 4), dtype=np.int8),
+             "s": rng.random((2, 2, 16), dtype=np.float32)}
+    else:
+        import ml_dtypes
+
+        k = rng.random((2, 16, 2, 4), dtype=np.float32).astype(
+            ml_dtypes.bfloat16)
+        v = rng.random((2, 16, 2, 4), dtype=np.float32).astype(
+            ml_dtypes.bfloat16)
+    return tokens, k, v
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_packet_roundtrip_byte_exact(quantized):
+    tokens, k, v = _packet_arrays(quantized)
+    buf = pack_kv_packet(tokens, k, v, block=8, trace_id="t123")
+    got = unpack_kv_packet(buf)
+    assert got["tokens"] == tokens
+    assert got["plen"] == 16 and got["block"] == 8
+    assert got["trace_id"] == "t123"
+    if quantized:
+        assert got["layout"] == "int8-lane[L,KV,Smax]"
+        for name, ref in (("k", k), ("v", v)):
+            assert got[name]["q"].tobytes() == ref["q"].tobytes()
+            assert got[name]["s"].tobytes() == ref["s"].tobytes()
+            assert got[name]["q"].shape == ref["q"].shape
+            assert got[name]["s"].shape == ref["s"].shape
+    else:
+        assert got["layout"] == "bf16[L,P,KV,D]"
+        assert got["k"].tobytes() == k.tobytes()
+        assert got["v"].tobytes() == v.tobytes()
+        assert got["k"].dtype == k.dtype
+
+
+def test_packet_rejects_corruption():
+    tokens, k, v = _packet_arrays(False)
+    buf = pack_kv_packet(tokens, k, v, block=8)
+    with pytest.raises(ValueError, match="magic"):
+        unpack_kv_packet(b"NOTAPKT!" + buf[8:])
+    # Flip one token byte: chain hash no longer matches -- fail closed.
+    corrupt = bytearray(buf)
+    idx = buf.index(np.asarray(tokens, np.int32).tobytes())
+    corrupt[idx] ^= 0xFF
+    with pytest.raises(ValueError, match="chain-hash"):
+        unpack_kv_packet(bytes(corrupt))
+    # Non-block-multiple token count never packs.
+    with pytest.raises(ValueError, match="multiple"):
+        pack_kv_packet(tokens[:10], k, v, block=8)
+
+
+# ---------------------------------------------------------------------------
+# Cross-engine handoff: token parity vs monolithic
+# ---------------------------------------------------------------------------
+
+
+# slow: spins up three real llama-tiny GenerationEngines per param on
+# CPU (~15s each); tier-1 keeps the pure-numpy packet byte-exactness
+# tests above, and the perf ratchet pins fleet.disagg.token_parity.
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_quant", [None, "int8"])
+def test_handoff_token_parity(kv_quant):
+    from kubeflow_tpu.serving.engine import GenerationEngine, Request
+    from kubeflow_tpu.serving.router import handoff_prefix
+
+    kw = dict(preset="llama-tiny", max_slots=2, max_seq=64,
+              decode_block=4, prefix_cache_mb=16, prefix_block=8,
+              kv_quant=kv_quant)
+    prompt = np.random.default_rng(3).integers(1, 400, 20).tolist()
+
+    def _gen(eng):
+        fut = eng.submit(Request(prompt=list(prompt), max_new_tokens=8,
+                                 temperature=0.0))
+        while not fut.done():
+            eng.step()
+        return list(fut.result())
+
+    src = GenerationEngine(**kw)
+    dst = GenerationEngine(**kw)
+    try:
+        res = handoff_prefix(src, dst, prompt)
+        assert res is not None
+        assert res["plen"] == 16  # 20 tokens -> 2 full blocks of 8
+        assert res["bytes"] > 0
+        # The decode replica now holds the prefix: generating there hits
+        # the imported entry and must match a monolithic engine exactly.
+        got = _gen(dst)
+        assert dst.prefix_cache.hits >= 1
+    finally:
+        src.close()
+        dst.close()
+    mono = GenerationEngine(**kw)
+    try:
+        ref = _gen(mono)
+    finally:
+        mono.close()
+    assert got == ref
+
+
+@pytest.mark.slow  # two real engines just to prove a noop (~4s on CPU)
+def test_handoff_under_one_block_is_noop():
+    from kubeflow_tpu.serving.engine import GenerationEngine
+    from kubeflow_tpu.serving.router import handoff_prefix
+
+    kw = dict(preset="llama-tiny", max_slots=2, max_seq=64,
+              decode_block=4, prefix_cache_mb=16, prefix_block=8)
+    src = GenerationEngine(**kw)
+    dst = GenerationEngine(**kw)
+    try:
+        assert handoff_prefix(src, dst, [1, 2, 3]) is None
+    finally:
+        src.close()
+        dst.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet-level affinity benefit (pure-python cache composition model)
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_beats_round_robin_hit_rate():
+    # 8 prompt families over 2 replicas whose caches hold 6 entries
+    # each: affinity keeps every family resident on its home, while
+    # round-robin (in random arrival order, so families don't stripe
+    # neatly onto replicas) needs all 8 cached on BOTH replicas and
+    # churns the LRU.
+    families = [list(range(f * 128, f * 128 + 128)) for f in range(8)]
+    order = np.random.default_rng(5).permutation(
+        [i % 8 for i in range(160)])
+    reqs = [families[f] for f in order]
+
+    def run(route):
+        caches = {r: [] for r in ("r0", "r1")}  # LRU, capacity 6
+        hits = 0
+        for i, p in enumerate(reqs):
+            c = caches[route(i, p)]
+            key = tuple(p)
+            if key in c:
+                hits += 1
+                c.remove(key)
+            c.append(key)
+            del c[:-6]
+        return hits / len(reqs)
+
+    router = _router(2)
+    affinity = run(lambda i, p: router.route(prefix_route_key(p)).replica)
+    rr = run(lambda i, p: f"r{i % 2}")
+    assert affinity > 0.9
+    assert affinity > rr + 0.15
+
+
+# ---------------------------------------------------------------------------
+# Obs plane: route instants and plane summaries
+# ---------------------------------------------------------------------------
+
+
+def test_route_emits_trace_instants_and_plane_summary():
+    rec = obs_trace.recorder()
+    was = rec.enabled
+    rec.enabled = True
+    rec.clear()
+    try:
+        r = _router(2, slo_ttft_ms=100.0)
+        key = prefix_route_key(list(range(128)))
+        r.route(key)
+        r.update_load("r0", {"ttft_ema_ms": 900.0})
+        r.update_load("r1", {"ttft_ema_ms": 900.0})
+        r.route(key)
+        obs_trace.instant("engine-stats", plane="serving", track="engine",
+                          queue_depth=2, slots_active=1, ttft_ema_ms=33.0,
+                          tokens_generated=10, requests_finished=4)
+        doc = rec.export()
+    finally:
+        rec.enabled = was
+        rec.clear()
+    serving = obs_trace.plane_summaries(doc)["serving"]
+    assert serving["routes"]["direct"] == 1
+    assert serving["routes"]["shed"] == 1
+    (eng,) = serving["engines"].values()
+    assert eng["queue_depth"] == 2 and eng["ttft_ema_ms"] == 33.0
+
+
+def test_route_decision_defaults():
+    d = RouteDecision(kind="none")
+    assert d.replica is None and not d.spilled and d.retry_after_s == 0.0

@@ -184,6 +184,75 @@ def test_autoscale_up_under_load(cp_client):
     loop.run_until_complete(run())
 
 
+def test_prefix_routing_activator_path(cp_client):
+    # The fleet router (serving/router.py, docs/FLEET.md) engages only
+    # when the predictor spec carries `routing`; this drives the full
+    # activator path: ring sync from ready replicas, affinity route,
+    # in-flight bookkeeping, and the load-poll task.
+    cp, client, loop = cp_client
+
+    async def run():
+        spec = isvc("routed", min_r=2, max_r=2)
+        spec["spec"]["predictor"]["routing"] = {
+            "policy": "prefix", "vnodes": 16, "load_poll_seconds": 0.2,
+        }
+        r = await client.post("/apis/InferenceService", json=spec)
+        assert r.status == 200, await r.text()
+        await wait_for(
+            lambda: (_status(cp, "routed").get("predictor", {})
+                     .get("ready_replicas") or 0) >= 2,
+            msg="2 replicas ready",
+        )
+        for _ in range(4):
+            r = await client.post(
+                "/serving/default/routed/v1/models/routed:predict",
+                json={"instances": ["affinity-demo"]},
+            )
+            assert r.status == 200, await r.text()
+        router = cp.activator._routers["default/routed"]
+        st = router.stats()
+        assert st["requests"] >= 4
+        assert set(st["replicas"]) == {"0", "1"}
+        # An idle 2-replica fleet neither spills nor sheds.
+        assert st["spilled"] == 0 and st["shed"] == 0
+        r = await client.delete("/apis/InferenceService/default/routed")
+        assert (await r.json())["deleted"]
+
+    loop.run_until_complete(run())
+
+
+def test_routing_slo_shed_returns_429_retry_after(cp_client):
+    cp, client, loop = cp_client
+
+    async def run():
+        spec = isvc("shedding", min_r=1, max_r=1)
+        # An SLO no estimate can meet (estimates floor at the 50ms
+        # default TTFT): every route sheds, which is exactly the
+        # surface under test -- 429 + Retry-After header + JSON body.
+        spec["spec"]["predictor"]["routing"] = {
+            "policy": "prefix", "slo_ttft_ms": 0.001,
+        }
+        r = await client.post("/apis/InferenceService", json=spec)
+        assert r.status == 200, await r.text()
+        await wait_for(
+            lambda: _status(cp, "shedding").get("predictor", {})
+            .get("ready_replicas"),
+            msg="replica ready",
+        )
+        r = await client.post(
+            "/serving/default/shedding/v1/models/shedding:predict",
+            json={"instances": [1]},
+        )
+        assert r.status == 429, await r.text()
+        assert r.headers.get("Retry-After") == "1"
+        body = await r.json()
+        assert body["retry_after_s"] >= 0.25
+        assert cp.activator._routers["default/shedding"].stats()[
+            "shed"] >= 1
+
+    loop.run_until_complete(run())
+
+
 def test_jax_llm_isvc_end_to_end(cp_client):
     """BASELINE config #5 shape: jax-format ISVC -> GenerationEngine replica
     -> V1 predict through the activator (tiny preset, random init)."""

@@ -268,6 +268,10 @@ class TestTensorParallelServing:
         q = tp.weights["layers"]["attn"]["q_proj"]["kernel"]
         assert "tensor" in str(q.sharding.spec)
 
+    # slow: tier-1 triage 2026-08 -- the gate crept past its 870s budget
+    # and was killed mid-suite; this composition test keeps its core
+    # contract covered by a faster sibling in tier-1.
+    @pytest.mark.slow
     def test_tp_moe_identical(self):
         cfg = self._f32("llama-tiny-moe")
         base = GenerationEngine(config=cfg, max_slots=2, decode_block=4)
@@ -1371,6 +1375,10 @@ class TestDispatchPipeline:
             assert got[d] == got[0]
         assert got[0][1] > 0  # the spec path actually ran
 
+    # slow: tier-1 triage 2026-08 -- the gate crept past its 870s budget
+    # and was killed mid-suite; this composition test keeps its core
+    # contract covered by a faster sibling in tier-1.
+    @pytest.mark.slow
     def test_depthN_midflight_eos_bounded_overshoot(self, tiny):
         """EOS mid-block with queued lanes in flight: the drain must be
         exact (streams match depth 0) and the per-drain queued-lane

@@ -38,6 +38,10 @@ def _steps(task, mesh, state, batches):
     return state, losses
 
 
+# slow: tier-1 triage 2026-08 -- the gate crept past its 870s budget
+# and was killed mid-suite; this composition test keeps its core
+# contract covered by a faster sibling in tier-1.
+@pytest.mark.slow
 def test_slice_downsize_and_grow_with_resharded_restore(tmp_path):
     task = _task()
     devs = jax.devices()

@@ -109,6 +109,10 @@ class TestUlysses:
         qd = q[:, :16]
         assert not ulysses_shardable(qd, k, mesh)
 
+    # slow: tier-1 triage 2026-08 -- the gate crept past its 870s budget
+    # and was killed mid-suite; this composition test keeps its core
+    # contract covered by a faster sibling in tier-1.
+    @pytest.mark.slow
     def test_llama_trains_with_ulysses(self):
         task = get_task(
             "llama", preset="llama-tiny", batch_size=4, seq_len=64,
