@@ -24,6 +24,8 @@ from typing import Dict, List, Tuple
 from kubeflow_tpu.analysis.perf import (  # noqa: F401
     PERF_BASELINE_PATH,
     check_perf,
+    latest_reshard_bench,
+    latest_train_bench,
     load_perf_baseline,
 )
 from kubeflow_tpu.analysis.report import (  # noqa: F401

@@ -237,7 +237,13 @@ def audit_decode_host_syncs(
     pipeline depth: sequential consumes each block once, a depth-N
     pipeline consumes block N under its queued successor lanes --
     audit_serving_engine re-runs this bound per depth (the ``.d2`` /
-    ``.d4`` metric variants)."""
+    ``.d4`` metric variants). The denominator is blocks CONSUMED in
+    the window, not blocks dispatched: a deep pipeline pre-fills its
+    lane deque before the window opens and the remaining-budget
+    predictor then clamps fresh dispatches, so a window can legally
+    consume (and pay its one sync for) more blocks than it dispatches
+    -- counting dispatches flagged depth 4 as 2 syncs/block on slow
+    hosts when every consume was the single legitimate one."""
     from kubeflow_tpu.serving.engine import Request
 
     findings: List[Finding] = []
@@ -245,7 +251,11 @@ def audit_decode_host_syncs(
     # Enough requests to SATURATE the slots: the dispatch pipeline only
     # engages when no slot is free, and the pipelined mode is exactly
     # what this audit must cover (consume of block N under block N+1).
-    budget = 4 * eng.decode_block + 8
+    # The extra depth*decode_block headroom keeps the remaining-budget
+    # predictor from clamping dispatch inside the watched window at
+    # deeper pipeline depths (the deque is pre-filled before it opens).
+    depth = max(1, getattr(eng, "pipeline_depth", 1))
+    budget = (4 + 2 * depth) * eng.decode_block + 8
     futs = [
         eng.submit(Request([2 + i, 4 + i, 6 + i], max_new_tokens=budget))
         for i in range(len(eng.free_slots))
@@ -253,11 +263,11 @@ def audit_decode_host_syncs(
     # Admission (prefill + first token) and the first decode dispatch
     # run OUTSIDE the watch: the window below is pure steady state.
     eng.step()
-    d0 = eng.decode_dispatches
+    c0 = eng.decode_blocks_consumed
     with HostTransferWatch() as w:
         for _ in range(4):
             eng.step()
-    blocks = eng.decode_dispatches - d0
+    blocks = eng.decode_blocks_consumed - c0
     while any(not f.done() for f in futs):  # drain so the engine ends clean
         eng.step()
     if blocks <= 0:

@@ -74,13 +74,12 @@ def _load_json(path: str) -> Optional[dict]:
     return doc if isinstance(doc, dict) else None
 
 
-def latest_train_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str]:
-    """Newest committed train round's parsed bench dict.
-
-    ``BENCH_r*.json`` wraps the bench's JSON line under ``parsed``
-    (alongside the runner's cmd/rc/tail); older or hand-written
-    artifacts may be the bare dict -- accept both. Returns
-    (parsed_dict_or_None, artifact_name)."""
+def _latest_bench_with(root: Optional[str],
+                       keys: Tuple[str, ...]) -> Tuple[Optional[dict], str]:
+    """Newest ``BENCH_r*.json`` whose parsed ``extra`` carries any of
+    ``keys``. Rounds are phase-scoped (a reshard-only round has no MFU
+    curve and vice versa), so each check family must find the newest
+    round of ITS phase, not just the newest file."""
     root = root or _REPO_ROOT
     for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
                        reverse=True):
@@ -88,9 +87,27 @@ def latest_train_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str]
         if doc is None:
             continue
         parsed = doc.get("parsed", doc)
-        if isinstance(parsed, dict) and isinstance(parsed.get("extra"), dict):
+        if not isinstance(parsed, dict):
+            continue
+        extra = parsed.get("extra")
+        if isinstance(extra, dict) and any(k in extra for k in keys):
             return parsed, os.path.basename(path)
     return None, ""
+
+
+def latest_train_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str]:
+    """Newest committed train round's parsed bench dict.
+
+    ``BENCH_r*.json`` wraps the bench's JSON line under ``parsed``
+    (alongside the runner's cmd/rc/tail); older or hand-written
+    artifacts may be the bare dict -- accept both. Returns
+    (parsed_dict_or_None, artifact_name)."""
+    return _latest_bench_with(root, ("mfu", "seq_sweep"))
+
+
+def latest_reshard_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str]:
+    """Newest committed ``bench.py --reshard`` round (extra.reshard)."""
+    return _latest_bench_with(root, ("reshard",))
 
 
 def serving_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str]:
@@ -229,6 +246,102 @@ def _check_fleet(fleet_base: dict, fleet: dict, artifact: str,
     return findings
 
 
+def _check_reshard(rbase: dict, rows: List[dict], artifact: str,
+                   measured: Dict[str, float]) -> List[Finding]:
+    """KT-PERF-RESHARD: the live-reshard curve (bench.py --reshard).
+
+    The elasticity contract per transition row: reshard_seconds under
+    the ceiling (the ISSUE bar is << the 90 s checkpoint-restart
+    budget), zero host staging on grow-like paths (a grow that stages
+    through host RAM is a planner bug -- every source shard has a live
+    surviving holder), faster than the measured checkpoint-restart for
+    the same state, and bitwise parity against the orbax restore. A
+    required transition that vanished from the curve is a finding."""
+    findings: List[Finding] = []
+    by_transition: Dict[str, dict] = {}
+    for row in rows:
+        if isinstance(row, dict) and "transition" in row:
+            by_transition.setdefault(str(row["transition"]), row)
+
+    ceiling = rbase.get("reshard_seconds_ceiling")
+    host_ceiling = rbase.get("host_staged_bytes_ceiling_growlike")
+    growlike = ("grow", "re-split")
+    for trans in rbase.get("transitions_required") or []:
+        row = by_transition.get(trans)
+        if row is None or "reshard_seconds" not in row:
+            findings.append(Finding(
+                rule="KT-PERF-RESHARD", path=artifact, line=0, hard=True,
+                message=(
+                    f"reshard: no measured '{trans}' transition row in "
+                    f"{artifact} -- the elasticity curve shrank"
+                ),
+            ))
+            continue
+        secs = float(row["reshard_seconds"])
+        measured[f"reshard.{trans}.seconds"] = secs
+        if ceiling is not None and secs > ceiling:
+            findings.append(Finding(
+                rule="KT-PERF-RESHARD", path=artifact, line=0, hard=True,
+                message=(
+                    f"reshard.{trans}: {secs}s exceeds ceiling "
+                    f"{ceiling}s ({artifact})"
+                ),
+            ))
+        if (host_ceiling is not None and trans in growlike
+                and row.get("host_staged_bytes") is not None):
+            staged = int(row["host_staged_bytes"])
+            measured[f"reshard.{trans}.host_staged_bytes"] = staged
+            if staged > host_ceiling:
+                findings.append(Finding(
+                    rule="KT-PERF-RESHARD", path=artifact, line=0,
+                    hard=True,
+                    message=(
+                        f"reshard.{trans}: {staged} B host-staged on a "
+                        f"grow-like path (ceiling {host_ceiling}) -- "
+                        f"every source shard has a surviving holder, "
+                        f"staging means the planner lost D2D routes "
+                        f"({artifact})"
+                    ),
+                ))
+        if rbase.get("require_faster_than_restart"):
+            restart = row.get("checkpoint_restart_seconds")
+            if restart is None:
+                findings.append(Finding(
+                    rule="KT-PERF-RESHARD", path=artifact, line=0,
+                    hard=True,
+                    message=(
+                        f"reshard.{trans}: no checkpoint_restart_seconds "
+                        f"baseline in the row ({artifact})"
+                    ),
+                ))
+            else:
+                measured[f"reshard.{trans}.vs_restart"] = (
+                    round(float(restart) / secs, 2) if secs > 0 else 0.0)
+                if secs >= float(restart):
+                    findings.append(Finding(
+                        rule="KT-PERF-RESHARD", path=artifact, line=0,
+                        hard=True,
+                        message=(
+                            f"reshard.{trans}: {secs}s is not faster "
+                            f"than the measured checkpoint-restart "
+                            f"{restart}s -- the fast path lost its "
+                            f"reason to exist ({artifact})"
+                        ),
+                    ))
+        if (rbase.get("require_bitwise_parity")
+                and row.get("bitwise_parity_vs_restore") is not True):
+            findings.append(Finding(
+                rule="KT-PERF-RESHARD", path=artifact, line=0, hard=True,
+                message=(
+                    f"reshard.{trans}: bitwise parity vs the orbax "
+                    f"restore is {row.get('bitwise_parity_vs_restore')!r}"
+                    f" -- a fast path that changes bits is a "
+                    f"correctness bug, not a perf win ({artifact})"
+                ),
+            ))
+    return findings
+
+
 def check_perf(
     baseline: dict,
     *,
@@ -320,6 +433,14 @@ def check_perf(
             else:
                 findings.extend(_check_fleet(fleet_base, fleet, artifact,
                                              measured))
+
+    # -- live-reshard (elasticity) curve -----------------------------------
+    rbase = baseline.get("reshard") or {}
+    if rbase:
+        parsed, artifact = latest_reshard_bench(root)
+        if parsed is not None:
+            rows = (parsed.get("extra") or {}).get("reshard") or []
+            findings.extend(_check_reshard(rbase, rows, artifact, measured))
 
     # -- live-metric ceilings ----------------------------------------------
     # Checked against THIS analyze run's Tier-B metrics; a ceiling whose

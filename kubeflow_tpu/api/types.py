@@ -193,6 +193,15 @@ class ElasticPolicy(BaseModel):
     metric: Optional[str] = None
     target_value: Optional[float] = Field(default=None, gt=0)
     metric_poll_seconds: float = Field(default=10.0, gt=0)
+    # Live in-memory resharding (parallel/reshard.py): a resize is sent
+    # to the running workers as a resize command instead of a gang
+    # teardown -- the worker reshards its live state onto the new mesh
+    # (a data-plane transfer measured in seconds, no orbax round-trip)
+    # and acks over KFTPU-METRIC. Falls back to the checkpoint-restart
+    # path when the plan is infeasible or the ack times out. Requires a
+    # checkpoint dir (the fallback path and the command file live there).
+    reshard_in_place: bool = False
+    reshard_timeout_seconds: float = Field(default=60.0, gt=0)
 
 
 class CheckpointPolicy(BaseModel):

@@ -39,6 +39,16 @@ ENV_PROFILE_STEPS = "KFTPU_PROFILE_STEPS"
 ENV_TRACE = "KFTPU_TRACE"
 ENV_TRACE_ID = "KFTPU_TRACE_ID"
 ENV_TRACE_DIR = "KFTPU_TRACE_DIR"
+# Live reshard-in-place resize (parallel/reshard.py): path of the JSON
+# resize-command file the reconciler writes and the worker's step loop
+# polls. Lives beside the checkpoint directory -- the one location both
+# sides already share, and the fallback path's home.
+ENV_RESIZE_FILE = "KFTPU_RESIZE_FILE"
+
+
+def resize_file_path(checkpoint_dir: str) -> str:
+    """Single source of truth for the resize-command file location."""
+    return f"{checkpoint_dir.rstrip('/')}.resize.json"
 
 
 def _flat_ranks(job: TrainJob, replicas_override: dict[ReplicaType, int]) -> list[tuple[ReplicaType, int]]:
@@ -95,6 +105,9 @@ def rendezvous_env(
         env[ENV_RESUME] = "1" if job.spec.checkpoint.resume else "0"
         env["KFTPU_CKPT_INTERVAL"] = str(job.spec.checkpoint.interval_steps)
         env["KFTPU_CKPT_KEEP"] = str(job.spec.checkpoint.keep)
+        el = job.spec.elastic
+        if el is not None and el.reshard_in_place:
+            env[ENV_RESIZE_FILE] = resize_file_path(job.spec.checkpoint.dir)
     prof = job.spec.profiling
     if prof.enabled:
         env[ENV_PROFILE_DIR] = prof.dir or ""

@@ -22,6 +22,7 @@ the new size -> respawn with resume env (SURVEY.md 5.3).
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 import shutil
@@ -43,6 +44,7 @@ from kubeflow_tpu.api.validation import SUCCESS_POLICY_REPLICA
 from kubeflow_tpu.controller.envvars import (
     mpi_hostfile_content,
     rendezvous_env,
+    resize_file_path,
 )
 from kubeflow_tpu.controller.gang import GangScheduler
 from kubeflow_tpu.controller.launcher import BaseLauncher, SpawnRequest, WorkerRef
@@ -92,6 +94,14 @@ class _JobRuntime:
     # metric-scaler timer and consumed by reconcile.
     resize_to: Optional[int] = None
     metrics_armed: bool = False
+    # Live reshard-in-place resize (parallel/reshard.py): monotonically
+    # increasing command seq, the in-flight command as
+    # (seq, target, deadline), and the fallback latch set when a command
+    # was nacked or timed out (routes the NEXT resize attempt through
+    # the checkpoint-restart path instead).
+    reshard_seq: int = 0
+    reshard_pending: Optional[tuple] = None
+    reshard_fallback: bool = False
     # On-disk MPI hostfile for this gang generation; removed at teardown.
     hostfile_path: Optional[str] = None
     # Hang detection's step-progress memory: worker_id -> (last KFTPU-METRIC
@@ -305,18 +315,30 @@ class JobController:
             )
             el = job.spec.elastic
             if el is not None and el.metric is not None and n != current:
-                self._record_event(
-                    job, "ElasticMetricResize",
-                    f"metric {el.metric} drives "
-                    f"{current} -> {n} workers",
-                )
-                self._resize_hints[key] = n
-                await self._teardown(key, release=True)
-                rt = None
-                job.status.set_condition(
-                    ConditionType.Restarting, "ElasticMetricResize"
-                )
-                job.status.formed_replicas = None
+                if (el.reshard_in_place and not rt.reshard_fallback
+                        and rt.reshard_pending is None
+                        and job.kind == JobKind.JAXJob
+                        and job.spec.checkpoint.dir):
+                    # Fast path: send the resize to the LIVE gang as an
+                    # in-memory reshard command -- no teardown, no orbax
+                    # round-trip. The ack timer below falls back to the
+                    # checkpoint-restart path on nack/timeout.
+                    self._initiate_reshard_in_place(kind, job, rt, n,
+                                                    current)
+                else:
+                    rt.reshard_fallback = False
+                    self._record_event(
+                        job, "ElasticMetricResize",
+                        f"metric {el.metric} drives "
+                        f"{current} -> {n} workers",
+                    )
+                    self._resize_hints[key] = n
+                    await self._teardown(key, release=True)
+                    rt = None
+                    job.status.set_condition(
+                        ConditionType.Restarting, "ElasticMetricResize"
+                    )
+                    job.status.formed_replicas = None
             else:
                 # Resize skipped (policy raced away / target already
                 # current): the scaler timer died delivering the flag;
@@ -627,6 +649,115 @@ class JobController:
             loop.call_later(el_now.metric_poll_seconds, check)
 
         loop.call_later(el.metric_poll_seconds, check)
+
+    def _initiate_reshard_in_place(
+        self, kind: str, job: TrainJob, rt: _JobRuntime, n: int,
+        current: int,
+    ) -> None:
+        """Resize the LIVE gang: write the resize-command file the
+        workers poll (runtime.entry), arm the ack timer. The workers
+        reshard their state in memory (parallel/reshard.py) and ack
+        over KFTPU-METRIC; the process world is untouched -- the resize
+        is a data-plane transfer, not a gang re-formation. In the
+        single-host control plane the target is the logical slice
+        count the worker re-forms its mesh at."""
+        el = job.spec.elastic
+        rt.reshard_seq += 1
+        seq = rt.reshard_seq
+        path = resize_file_path(job.spec.checkpoint.dir)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"seq": seq, "num_slices": n,
+                       "target_replicas": n}, f)
+        os.replace(tmp, path)  # atomic: workers never see a torn write
+        rt.reshard_pending = (
+            seq, n, time.time() + el.reshard_timeout_seconds
+        )
+        self._record_event(
+            job, "ReshardInPlace",
+            f"live reshard {current} -> {n} (seq {seq}), "
+            f"gang stays up",
+        )
+        self._schedule_reshard_ack(kind, job, rt)
+
+    def _schedule_reshard_ack(
+        self, kind: str, job: TrainJob, rt: _JobRuntime
+    ) -> None:
+        """Poll worker logs for the reshard ack (reshard_seq/reshard_ok
+        KFTPU-METRIC fields). Ack -> record completion and the measured
+        reshard_seconds; nack or deadline -> remove the command file,
+        latch the fallback, and send the resize back through the normal
+        checkpoint-restart teardown path."""
+        loop = asyncio.get_running_loop()
+        pending = rt.reshard_pending
+        if pending is None:
+            return
+        seq, n, deadline = pending
+        poll = min(1.0, max(0.05, (deadline - time.time()) / 10))
+
+        def fallback(reason: str) -> None:
+            rt.reshard_pending = None
+            rt.reshard_fallback = True
+            try:
+                os.unlink(resize_file_path(job.spec.checkpoint.dir))
+            except OSError:
+                pass
+            self._record_event(
+                job, "ReshardFallback",
+                f"{reason}; falling back to checkpoint-restart",
+            )
+            rt.resize_to = n
+            self._enqueue(kind, job.namespace, job.name)
+
+        def check() -> None:
+            with trace.span("reshard-ack", plane="controller",
+                            track="reconciler", job=job.key, seq=seq):
+                check_inner()
+
+        def check_inner() -> None:
+            if (self._runtimes.get(job.key) is not rt
+                    or rt.reshard_pending != (seq, n, deadline)):
+                return  # torn down / superseded
+            ack = self._read_worker_metric(rt, "reshard_seq")
+            if ack is not None and int(ack) >= seq:
+                ok = self._read_worker_metric(rt, "reshard_ok")
+                if ok is not None and int(ok) == 1:
+                    rt.reshard_pending = None
+                    rt.reshard_fallback = False
+                    secs = self._read_worker_metric(rt, "reshard_seconds")
+                    if secs is not None:
+                        REGISTRY.gauge(
+                            "kftpu_controller_reshard_seconds"
+                        ).set(round(secs, 3))
+                    # The gang's logical width changed without a
+                    # re-formation; the scaler computes its next delta
+                    # from the new size.
+                    rt.formed_replicas = n
+                    rt.metrics_armed = False
+                    self._record_event(
+                        job, "ReshardComplete",
+                        f"live reshard to {n} in "
+                        f"{secs if secs is not None else '?'}s "
+                        f"(no restart)",
+                    )
+                    _, obj = self._find_job(job.namespace, job.name)
+                    if obj is not None:
+                        cur = TrainJob.from_dict(obj)
+                        before = cur.status.model_dump(mode="json")
+                        cur.status.formed_replicas = n
+                        self._persist(kind, cur, before)
+                    self._enqueue(kind, job.namespace, job.name)
+                else:
+                    fallback(f"worker nacked reshard seq {seq} "
+                             "(infeasible plan)")
+                return
+            if time.time() > deadline:
+                fallback(f"no reshard ack for seq {seq} within "
+                         f"{job.spec.elastic.reshard_timeout_seconds}s")
+                return
+            loop.call_later(poll, check)
+
+        loop.call_later(poll, check)
 
     def _read_worker_metric(
         self, rt: _JobRuntime, metric: str
@@ -1123,6 +1254,20 @@ class JobController:
                         os.unlink(rt.hostfile_path)
                     except OSError:
                         pass
+                if rt.reshard_seq:
+                    # A resize-command file must not outlive its gang
+                    # generation: a respawned worker starts at seq 0 and
+                    # would re-apply the stale command.
+                    ns, name = key.split("/", 1)
+                    _, obj = self._find_job(ns, name)
+                    if obj is not None:
+                        ckdir = (TrainJob.from_dict(obj)
+                                 .spec.checkpoint.dir)
+                        if ckdir:
+                            try:
+                                os.unlink(resize_file_path(ckdir))
+                            except OSError:
+                                pass
             if release:
                 self.gang.release(key)
                 self._backoff_until.pop(key, None)

@@ -17,3 +17,14 @@ from kubeflow_tpu.parallel.sharding import (  # noqa: F401
     logical_sharding,
     with_logical_constraint,
 )
+# NOTE: import the reshard() entry point from the submodule
+# (``kubeflow_tpu.parallel.reshard``) -- re-exporting the function here
+# would shadow the submodule of the same name.
+from kubeflow_tpu.parallel.reshard import (  # noqa: F401
+    InfeasibleReshardError,
+    LeafPlan,
+    ReshardPlan,
+    execute_plan,
+    plan_reshard,
+    transplant_spec,
+)

@@ -148,6 +148,51 @@ def pad_ratio(shape, dtype) -> float:
     return padded_bytes(shape, dtype) / data
 
 
+def reshard_peak_bytes(per_leaf_src: List[Dict[int, int]],
+                       per_leaf_dst: List[Dict[int, int]],
+                       *, in_place: bool = False) -> int:
+    """Peak per-device HBM residency (tile-padded) while a live
+    reshard plan (parallel/reshard.py) executes.
+
+    Inputs are per-leaf dicts of device-id -> padded shard bytes, in
+    execution (leaf) order, for the source and target shardings.
+
+    - Staged executor (grow/shrink, ``in_place=False``): leaves move
+      one at a time through device_put and the executor cannot free
+      sources early (a moved leaf may alias shards that stayed put),
+      so the worst moment holds the full source AND the full target
+      residency on a device: src_total + dst_total. Conservative --
+      aliased unmoved shards are double-counted -- which is the right
+      side to err on for an OOM gate.
+    - In-place executor (pure re-split, ``in_place=True``): one
+      donating jit identity; XLA frees each input buffer as its output
+      lands, so the worst moment holds ~everything plus one leaf
+      double-booked during its copy.
+
+    Plans whose peak exceeds the per-device HBM budget are rejected
+    *before* they OOM (``ReshardPlan.feasible``)."""
+    devs: set = set()
+    for d in per_leaf_src:
+        devs.update(d)
+    for d in per_leaf_dst:
+        devs.update(d)
+    peak = 0
+    for dev in devs:
+        src_tot = sum(d.get(dev, 0) for d in per_leaf_src)
+        dst_tot = sum(d.get(dev, 0) for d in per_leaf_dst)
+        if in_place:
+            biggest = max(
+                (s.get(dev, 0) + t.get(dev, 0)
+                 for s, t in zip(per_leaf_src, per_leaf_dst)),
+                default=0,
+            )
+            dev_peak = max(src_tot, dst_tot) + biggest
+        else:
+            dev_peak = src_tot + dst_tot
+        peak = max(peak, dev_peak)
+    return int(peak)
+
+
 def kv_cache_plan(cfg, max_slots: int, *, kv_quant: str | None = None,
                   lane_aligned_scales: bool = True,
                   tensor_parallel: int = 1) -> Dict:

@@ -1,0 +1,471 @@
+"""Live parallelism reconfiguration: in-memory state resharding.
+
+Resize, preemption, and autoscaling all reduce to the same operation:
+the SAME logical train state, partitioned over a DIFFERENT mesh. The
+checkpoint-restart path pays a full orbax round-trip (serialize to disk,
+kill the gang, respawn, restore) for what is fundamentally a
+device-to-device re-partitioning. Tenplex (PAPERS.md, "Dynamic
+Parallelism for Deep Learning using Parallelizable Tensor Collections")
+frames resize/reshard as transforms on live tensor collections; this
+module is that data plane:
+
+1. **Plan** (``plan_reshard``): for every leaf of a (possibly donated)
+   pytree sharded on mesh A, compute the transfer to the same logical
+   value sharded on mesh B -- source/target ``PartitionSpec``, bytes
+   that must cross a device boundary, and the bytes a *shrinking*
+   device set forces through host RAM (a departing slice's exclusive
+   shards have no ICI path to the survivors; they ride the host NIC,
+   exactly like ``runtime/convert_hf.py``'s host-side layout mapping).
+   Target specs default to the source spec transplanted onto mesh B:
+   both come from the one logical-axis rules table
+   (``parallel/sharding.py``), so "re-split DP into TP" is literally
+   the same spec over a mesh whose axis sizes changed.
+2. **Feasibility**: the plan embeds ``parallel/memory.py``'s
+   peak-transfer-footprint term (tile-padded source + target residency
+   during the copy) and is rejected *before* it OOMs, and marked
+   infeasible when a needed shard's only holders are lost devices
+   (worker death mid-transfer) -- the caller falls back to
+   checkpoint-restart (``runtime/checkpoint.py``).
+3. **Execute** (``execute_plan``): pure re-splits (same device set) run
+   as ONE donating jit identity -- XLA moves shards over ICI in place,
+   no second copy of the state. Grow/shrink (device set changes) use
+   per-leaf ``jax.device_put``; leaves whose plan requires host staging
+   first pull exactly the departing-exclusive shard regions to host
+   numpy (the real cost a multi-host shrink pays), then transfer.
+   Values are never recomputed or re-reduced, so a resumed loss curve
+   is bit-exact against the checkpoint-restart path to the same mesh.
+
+Spans ``reshard.plan`` / ``reshard.transfer`` and the
+``kftpu_train_reshard_seconds`` gauge ride the obs plane, so a resize
+shows up in ``kftpu trace dump`` like any other control-plane act.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.obs import trace
+from kubeflow_tpu.obs.registry import REGISTRY
+
+Region = Tuple[Tuple[int, int], ...]  # ((start, stop) per dim)
+
+
+class InfeasibleReshardError(RuntimeError):
+    """The transfer plan cannot run (OOM or lost source shards); take
+    the checkpoint-restart path instead."""
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafPlan:
+    """Transfer spec for one pytree leaf."""
+
+    path: str
+    shape: Tuple[int, ...]
+    dtype: str
+    src_spec: str
+    dst_spec: str
+    #: "noop" (no bytes cross a device), "d2d" (device-to-device only),
+    #: "host" (some regions must stage through host RAM), "opaque"
+    #: (non-array leaf, passed through).
+    mode: str
+    bytes_logical: int = 0
+    bytes_moved: int = 0
+    host_staged_bytes: int = 0
+    # Execution detail (not part of the serializable summary): target
+    # sharding, and the exact regions to pull through the host.
+    dst_sharding: Any = dataclasses.field(
+        default=None, repr=False, compare=False)
+    staged_regions: Tuple[Region, ...] = dataclasses.field(
+        default=(), repr=False, compare=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshardPlan:
+    """Full-state transfer plan from mesh A to mesh B."""
+
+    src_mesh_shape: Dict[str, int]
+    dst_mesh_shape: Dict[str, int]
+    #: "re-split" (same devices), "grow" (dst strictly adds devices),
+    #: "shrink" (dst strictly removes), "migrate" (both).
+    transition: str
+    leaves: Tuple[LeafPlan, ...]
+    bytes_total: int
+    bytes_moved: int
+    host_staged_bytes: int
+    #: parallel/memory.py peak-transfer-footprint term: worst
+    #: per-device HBM residency (tile-padded) while the plan executes.
+    peak_transfer_bytes: int
+    hbm_bytes: Optional[int]
+    feasible: bool
+    infeasible_reason: str = ""
+
+    def summary(self) -> Dict[str, Any]:
+        """JSON-ready roll-up (what the bench and events record)."""
+        return {
+            "transition": self.transition,
+            "src_mesh": {k: v for k, v in self.src_mesh_shape.items()
+                         if v > 1},
+            "dst_mesh": {k: v for k, v in self.dst_mesh_shape.items()
+                         if v > 1},
+            "n_leaves": len(self.leaves),
+            "bytes_total": self.bytes_total,
+            "bytes_moved": self.bytes_moved,
+            "host_staged_bytes": self.host_staged_bytes,
+            "peak_transfer_bytes": self.peak_transfer_bytes,
+            "feasible": self.feasible,
+            "infeasible_reason": self.infeasible_reason,
+        }
+
+
+def transplant_spec(spec: P, dst_mesh: Mesh) -> P:
+    """The source PartitionSpec re-read against mesh B's axis table.
+
+    Both meshes name axes from the same ``parallel.mesh.AXES`` set and
+    both specs come from the same logical rules, so a DP->TP re-split
+    is the *unchanged* spec over changed axis sizes. Axis names absent
+    from the target mesh fall back to replication on that dim."""
+    parts: List[Any] = []
+    for entry in tuple(spec):
+        if entry is None:
+            parts.append(None)
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        keep = tuple(a for a in axes if a in dst_mesh.shape)
+        if not keep:
+            parts.append(None)
+        elif len(keep) == 1:
+            parts.append(keep[0])
+        else:
+            parts.append(keep)
+    return P(*parts)
+
+
+def target_shardings(state: Any, dst_mesh: Mesh,
+                     overrides: Optional[Dict[str, P]] = None):
+    """Per-leaf NamedShardings on mesh B for a live state on mesh A.
+
+    ``overrides`` maps leaf-path substrings to explicit PartitionSpecs
+    (the escape hatch when a relayout is not spec-preserving)."""
+    overrides = overrides or {}
+
+    def one(path, leaf):
+        name = jax.tree_util.keystr(path)
+        for frag, spec in overrides.items():
+            if frag in name:
+                return NamedSharding(dst_mesh, spec)
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            spec = transplant_spec(sh.spec, dst_mesh)
+            # Uneven-shard fixup: an axis that divided the dim on mesh A
+            # may not on mesh B (12 rows over data=4 -> data=8). GSPMD
+            # rejects indivisible shardings, so degrade that dim to
+            # replicated -- same policy the divisibility linter
+            # (parallel/memory.py) enforces at trace time.
+            parts = []
+            for d, entry in enumerate(tuple(spec)):
+                if entry is not None:
+                    axes = (entry,) if isinstance(entry, str) \
+                        else tuple(entry)
+                    n = math.prod(dst_mesh.shape[a] for a in axes)
+                    if int(leaf.shape[d]) % n != 0:
+                        entry = None
+                parts.append(entry)
+            return NamedSharding(dst_mesh, P(*parts))
+        return NamedSharding(dst_mesh, P())
+
+    return jax.tree_util.tree_map_with_path(one, state)
+
+
+def _regions(sharding, shape) -> Dict[Region, List[Any]]:
+    """Distinct shard regions -> devices holding them (replication
+    collapses: every holder is listed). Uneven trailing shards come out
+    of ``devices_indices_map`` with their true (smaller) extents."""
+    out: Dict[Region, List[Any]] = {}
+    for dev, idx in sharding.devices_indices_map(tuple(shape)).items():
+        region = tuple(
+            sl.indices(dim)[:2] for sl, dim in zip(idx, shape)
+        ) if shape else ()
+        out.setdefault(region, []).append(dev)
+    return out
+
+def _overlap(a: Region, b: Region) -> int:
+    """Element count of the intersection of two regions."""
+    vol = 1
+    for (a0, a1), (b0, b1) in zip(a, b):
+        lo, hi = max(a0, b0), min(a1, b1)
+        if hi <= lo:
+            return 0
+        vol *= hi - lo
+    return vol
+
+
+def _region_elems(r: Region) -> int:
+    return math.prod(hi - lo for lo, hi in r) if r else 1
+
+
+def plan_reshard(
+    state: Any,
+    dst_mesh: Mesh,
+    *,
+    dst_shardings: Any = None,
+    overrides: Optional[Dict[str, P]] = None,
+    hbm_bytes: Optional[int] = None,
+    lost_devices: Iterable[Any] = (),
+) -> ReshardPlan:
+    """Compute the A->B transfer plan for a live sharded pytree.
+
+    ``lost_devices``: devices (or device ids) whose data is GONE (the
+    preemption/death case, not a graceful shrink) -- a leaf region held
+    only by lost devices makes the plan infeasible and the caller must
+    restore from the checkpoint instead. ``hbm_bytes``: per-device HBM
+    budget for the peak-transfer feasibility check; ``None`` tries the
+    backend's reported limit and otherwise skips the check."""
+    from kubeflow_tpu.parallel.memory import padded_bytes
+
+    t0 = time.perf_counter()
+    with trace.span("reshard.plan", plane="runtime") as sp:
+        if dst_shardings is None:
+            dst_shardings = target_shardings(state, dst_mesh, overrides)
+        lost_ids = {getattr(d, "id", d) for d in lost_devices}
+        dst_devs = {d.id for d in dst_mesh.devices.ravel()}
+        if hbm_bytes is None:
+            try:
+                hbm_bytes = (dst_mesh.devices.ravel()[0].memory_stats()
+                             or {}).get("bytes_limit")
+            except (AttributeError, NotImplementedError, RuntimeError,
+                    ValueError):  # stats are backend-optional
+                hbm_bytes = None
+
+        leaves_src, treedef = jax.tree_util.tree_flatten(state)
+        paths = [
+            jax.tree_util.keystr(p)
+            for p, _ in jax.tree_util.tree_flatten_with_path(state)[0]
+        ]
+        dst_flat = treedef.flatten_up_to(dst_shardings)
+
+        src_dev_ids: set = set()
+        src_mesh_shape: Dict[str, int] = {}
+        plans: List[LeafPlan] = []
+        infeasible_reason = ""
+        # Per-leaf, per-device tile-padded shard bytes, in leaf order --
+        # the input to parallel/memory.py's peak-transfer-footprint
+        # model (source not yet freed + target already materialized).
+        per_leaf_src: List[Dict[int, int]] = []
+        per_leaf_dst: List[Dict[int, int]] = []
+
+        for name, leaf, dst_sh in zip(paths, leaves_src, dst_flat):
+            if not hasattr(leaf, "shape") or not hasattr(leaf, "dtype") \
+                    or not hasattr(leaf, "sharding"):
+                plans.append(LeafPlan(
+                    path=name, shape=(), dtype="", src_spec="-",
+                    dst_spec="-", mode="opaque"))
+                per_leaf_src.append({})
+                per_leaf_dst.append({})
+                continue
+            shape = tuple(int(d) for d in leaf.shape)
+            dtype = np.dtype(leaf.dtype)
+            src_sh = leaf.sharding
+            if not src_mesh_shape and isinstance(src_sh, NamedSharding):
+                src_mesh_shape = {
+                    k: int(v) for k, v in src_sh.mesh.shape.items()}
+            src_map = _regions(src_sh, shape)
+            dst_map = _regions(dst_sh, shape)
+            src_by_dev = {
+                d.id: region
+                for region, devs in src_map.items() for d in devs
+            }
+            src_dev_ids.update(src_by_dev)
+
+            # Shard-level availability: a source region survives if any
+            # holder is in the target device set (ICI/D2D path) and is
+            # not lost; it stages through host if all its live holders
+            # are departing; it is GONE if every holder is lost.
+            staged: List[Region] = []
+            staged_elems = 0
+            for region, devs in src_map.items():
+                live = [d for d in devs if d.id not in lost_ids]
+                if not live:
+                    infeasible_reason = (
+                        f"{name}: shard {region} only held by lost "
+                        f"devices {[getattr(d, 'id', d) for d in devs]}"
+                    )
+                    continue
+                if not any(d.id in dst_devs for d in live):
+                    staged.append(region)
+                    staged_elems += _region_elems(region)
+
+            moved = 0
+            for region, devs in dst_map.items():
+                need = _region_elems(region)
+                for dev in devs:
+                    have = src_by_dev.get(dev.id)
+                    local = _overlap(region, have) if have is not None \
+                        else 0
+                    moved += (need - local) * dtype.itemsize
+
+            host_staged = staged_elems * dtype.itemsize
+            bytes_logical = math.prod(shape) * dtype.itemsize \
+                if shape else dtype.itemsize
+            mode = ("host" if host_staged else
+                    "d2d" if moved else "noop")
+            plans.append(LeafPlan(
+                path=name, shape=shape, dtype=dtype.name,
+                src_spec=str(getattr(src_sh, "spec", P())),
+                dst_spec=str(dst_sh.spec), mode=mode,
+                bytes_logical=int(bytes_logical),
+                bytes_moved=int(moved),
+                host_staged_bytes=int(host_staged),
+                dst_sharding=dst_sh, staged_regions=tuple(staged),
+            ))
+            src_b = {}
+            for region, devs in src_map.items():
+                pb = padded_bytes([hi - lo for lo, hi in region], dtype)
+                for d in devs:
+                    src_b[d.id] = src_b.get(d.id, 0) + pb
+            dst_b = {}
+            for region, devs in dst_map.items():
+                pb = padded_bytes([hi - lo for lo, hi in region], dtype)
+                for d in devs:
+                    dst_b[d.id] = dst_b.get(d.id, 0) + pb
+            per_leaf_src.append(src_b)
+            per_leaf_dst.append(dst_b)
+
+        grow = bool(dst_devs - src_dev_ids)
+        shrink = bool(src_dev_ids - dst_devs)
+        transition = ("migrate" if grow and shrink else
+                      "grow" if grow else
+                      "shrink" if shrink else "re-split")
+
+        from kubeflow_tpu.parallel.memory import reshard_peak_bytes
+
+        peak = reshard_peak_bytes(
+            per_leaf_src, per_leaf_dst, in_place=transition == "re-split"
+        )
+
+        feasible = not infeasible_reason
+        if feasible and hbm_bytes and peak > hbm_bytes:
+            feasible = False
+            infeasible_reason = (
+                f"peak transfer footprint {peak} B exceeds per-device "
+                f"HBM budget {hbm_bytes} B"
+            )
+
+        plan = ReshardPlan(
+            src_mesh_shape=src_mesh_shape,
+            dst_mesh_shape={k: int(v) for k, v in dst_mesh.shape.items()},
+            transition=transition,
+            leaves=tuple(plans),
+            bytes_total=sum(lp.bytes_logical for lp in plans),
+            bytes_moved=sum(lp.bytes_moved for lp in plans),
+            host_staged_bytes=sum(lp.host_staged_bytes for lp in plans),
+            peak_transfer_bytes=int(peak),
+            hbm_bytes=hbm_bytes,
+            feasible=feasible,
+            infeasible_reason=infeasible_reason,
+        )
+        sp.annotate(transition=transition,
+                    bytes_moved=plan.bytes_moved,
+                    host_staged_bytes=plan.host_staged_bytes,
+                    peak_transfer_bytes=plan.peak_transfer_bytes,
+                    feasible=feasible,
+                    plan_ms=round((time.perf_counter() - t0) * 1e3, 2))
+    return plan
+
+
+def _stage_departing(leaf, lp: LeafPlan) -> int:
+    """Pull the departing-exclusive shard regions to host numpy -- the
+    real cost a multi-host shrink pays (survivors ingest these over the
+    host network; on a single-process backend the subsequent transfer
+    rides the same device_put). Returns bytes actually staged."""
+    wanted = set(lp.staged_regions)
+    staged = 0
+    shape = lp.shape
+    for s in leaf.addressable_shards:
+        region = tuple(
+            sl.indices(dim)[:2] for sl, dim in zip(s.index, shape)
+        ) if shape else ()
+        if region in wanted:
+            wanted.discard(region)  # one pull per distinct region
+            host = np.asarray(s.data)
+            staged += host.nbytes
+            del host
+    return staged
+
+
+def execute_plan(state: Any, plan: ReshardPlan, *,
+                 donate: bool = False) -> Any:
+    """Run the plan: same logical values, mesh-B shardings.
+
+    Pure re-splits transfer the whole state through one donating jit
+    identity (XLA reshards in place -- no second copy); grow/shrink go
+    leaf-by-leaf through device_put with the planned host staging
+    executed first. ``donate=True`` frees each source leaf as its
+    the source state on the re-split fast path (one donating jit: XLA
+    reshards in place, no second copy of the state) and invalidates
+    the caller's ``state``; the staged grow/shrink path always keeps
+    src+dst resident (budgeted by the plan's peak term). Raises
+    InfeasibleReshardError on infeasible plans: the caller's fallback
+    is the checkpoint-restart path."""
+    if not plan.feasible:
+        raise InfeasibleReshardError(plan.infeasible_reason)
+    t0 = time.perf_counter()
+    with trace.span("reshard.transfer", plane="runtime",
+                    transition=plan.transition) as sp:
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        lps = [lp for lp in plan.leaves if lp.mode != "opaque"]
+        arr_idx = [i for i, leaf in enumerate(leaves)
+                   if hasattr(leaf, "sharding")]
+        if len(lps) != len(arr_idx):
+            raise InfeasibleReshardError(
+                f"plan has {len(lps)} array leaves, state has "
+                f"{len(arr_idx)}: plan was built for a different state"
+            )
+        staged_bytes = 0
+        if plan.transition == "re-split":
+            args = tuple(leaves[i] for i in arr_idx)
+            outs = jax.jit(
+                lambda xs: xs,
+                out_shardings=tuple(lp.dst_sharding for lp in lps),
+                donate_argnums=0 if donate else (),
+            )(args)
+            for i, out in zip(arr_idx, outs):
+                leaves[i] = out
+        else:
+            for i, lp in zip(arr_idx, lps):
+                if lp.mode == "host":
+                    staged_bytes += _stage_departing(leaves[i], lp)
+                # No eager source free here even when donating:
+                # device_put aliases shards that stay put, so deleting
+                # the source can tear down the target's buffers. The
+                # plan's peak term budgets full src+dst residency for
+                # this path (parallel/memory.py reshard_peak_bytes).
+                leaves[i] = jax.device_put(leaves[i], lp.dst_sharding)
+        out_state = jax.tree_util.tree_unflatten(treedef, leaves)
+        # Block until the transfer lands: callers time this (and the
+        # next dispatch must not race a half-moved state).
+        for i in arr_idx:
+            leaves[i].block_until_ready()
+        dt = time.perf_counter() - t0
+        sp.annotate(bytes_moved=plan.bytes_moved,
+                    host_staged_bytes=staged_bytes,
+                    transfer_s=round(dt, 4))
+    REGISTRY.gauge(
+        "kftpu_train_reshard_seconds",
+        help="wall seconds of the last live state reshard (transfer)",
+    ).set(round(dt, 4))
+    return out_state
+
+
+def reshard(state: Any, dst_mesh: Mesh, *, donate: bool = False,
+            **plan_kwargs) -> Tuple[Any, ReshardPlan]:
+    """Plan + execute in one call. Raises InfeasibleReshardError when
+    the plan is rejected (caller falls back to checkpoint-restart)."""
+    plan = plan_reshard(state, dst_mesh, **plan_kwargs)
+    return execute_plan(state, plan, donate=donate), plan

@@ -18,6 +18,42 @@ from kubeflow_tpu.obs import trace
 logger = logging.getLogger(__name__)
 
 
+class ReshardHandoff:
+    """Process-local live-state handoff beside the orbax path.
+
+    A component about to trigger a resize publishes its live state here
+    keyed by the checkpoint directory; the restore side takes it and
+    reshards it onto the new mesh in memory (parallel/reshard.py) --
+    seconds of device transfers instead of an orbax disk round-trip.
+    The store is process-local by design: it covers in-process resizes
+    (runtime.entry's reshard-in-place path), co-located restart tests,
+    and Podracer-style learner->actor weight publication; a cold process
+    finds nothing here and falls back to orbax, which is exactly the
+    checkpoint-restart path the controller expects."""
+
+    _store: dict = {}
+
+    @classmethod
+    def publish(cls, key: str, step: int, state: Any) -> None:
+        cls._store[key] = (int(step), state)
+
+    @classmethod
+    def take(cls, key: str) -> Optional[tuple]:
+        """Pop and return ``(step, state)`` or None. Single-consumer:
+        the state may be donated by the resharder, so it must not stay
+        referenced here."""
+        return cls._store.pop(key, None)
+
+    @classmethod
+    def peek_step(cls, key: str) -> Optional[int]:
+        item = cls._store.get(key)
+        return item[0] if item else None
+
+    @classmethod
+    def clear(cls) -> None:
+        cls._store.clear()
+
+
 class Checkpointer:
     """Thin orbax CheckpointManager wrapper bound to one job's directory."""
 
@@ -77,6 +113,45 @@ class Checkpointer:
             return self._mgr.restore(
                 step, args=ocp.args.StandardRestore(target)
             )
+
+    def restore_or_handoff(self, step: Optional[int], target: Any,
+                           mesh=None) -> tuple[Any, Optional[int]]:
+        """Reshard-handoff fast path beside ``restore()``.
+
+        If a live state was published for this directory (ReshardHandoff)
+        at a step no older than the latest on-disk checkpoint, reshard it
+        onto ``mesh`` in memory -- no orbax round-trip -- and return
+        ``(state, handoff_step)``. Otherwise fall back to the plain
+        ``restore()`` and return ``(state, None)``; an infeasible
+        handoff plan (lost shards, OOM) also falls back. ``target`` must
+        be the freshly initialized state on the new mesh, exactly as
+        ``restore()`` wants it."""
+        if self.directory and mesh is not None:
+            item = ReshardHandoff.take(self.directory)
+            if item is not None:
+                hstep, hstate = item
+                latest = self.latest_step()
+                if latest is None or hstep >= latest:
+                    from kubeflow_tpu.parallel.reshard import (
+                        InfeasibleReshardError,
+                        reshard,
+                    )
+
+                    try:
+                        state, plan = reshard(hstate, mesh, donate=True)
+                        logger.info(
+                            "reshard handoff: step=%d %s (%d B moved, "
+                            "%d B host-staged) -- no orbax round-trip",
+                            hstep, plan.transition, plan.bytes_moved,
+                            plan.host_staged_bytes,
+                        )
+                        return state, hstep
+                    except InfeasibleReshardError as e:
+                        logger.warning(
+                            "reshard handoff infeasible (%s); falling "
+                            "back to orbax restore", e,
+                        )
+        return self.restore(step, target), None
 
     def wait(self) -> None:
         if self._mgr:

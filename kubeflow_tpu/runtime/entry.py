@@ -13,13 +13,35 @@ stand-in for a preempted worker in restart/resume tests.
 from __future__ import annotations
 
 import argparse
+import contextlib
+import json
 import logging
 import os
 import sys
+import time
 
 from kubeflow_tpu.obs import trace
 
 logger = logging.getLogger(__name__)
+
+
+def read_resize_command(path, last_seq: int):
+    """Parse the controller's resize-command file (KFTPU_RESIZE_FILE,
+    written by the reconciler's reshard-in-place mode). Returns the
+    command dict when it carries a seq newer than ``last_seq``, else
+    None (missing, malformed-while-being-written, or already handled)."""
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            cmd = json.load(f)
+    except (OSError, ValueError):
+        return None
+    try:
+        seq = int(cmd.get("seq", 0))
+    except (TypeError, ValueError):
+        return None
+    return cmd if seq > last_seq else None
 
 
 def parse_args(argv=None):
@@ -129,10 +151,28 @@ def main(argv=None) -> int:
             keep=int(os.environ.get("KFTPU_CKPT_KEEP", "3")),
         )
         start_step = 0
-        if ckpt.enabled and ctx.resume and ckpt.latest_step() is not None:
-            state = ckpt.restore(None, state)
-            start_step = int(ckpt.latest_step()) + 1
-            logger.info("resumed from checkpoint at step %d", start_step)
+        if ckpt.enabled and ctx.resume:
+            from kubeflow_tpu.runtime.checkpoint import ReshardHandoff
+
+            has_handoff = (
+                ckpt.directory is not None
+                and ReshardHandoff.peek_step(ckpt.directory) is not None
+            )
+            if has_handoff or ckpt.latest_step() is not None:
+                # Fast path: a live handoff published in this process
+                # reshards in memory; otherwise the orbax (resharding)
+                # restore -- same blessed values either way.
+                state, hstep = ckpt.restore_or_handoff(None, state, mesh)
+                if hstep is None:
+                    # Fell back to orbax (or an infeasible handoff with
+                    # no checkpoint behind it: start fresh).
+                    latest = ckpt.latest_step()
+                    hstep = int(latest) if latest is not None else -1
+                start_step = hstep + 1
+                logger.info(
+                    "resumed at step %d via %s", start_step,
+                    "reshard handoff" if hstep is not None else "orbax",
+                )
 
         mlog = MetricLogger(
             enabled=ctx.process_id == 0,
@@ -154,7 +194,68 @@ def main(argv=None) -> int:
 
         data = task.data_iter(ctx.num_processes, ctx.process_id, mesh, args.seed)
         metrics = {}
+        # Reshard-in-place resize (parallel/reshard.py): the reconciler
+        # writes a command file instead of tearing the gang down; the
+        # step loop applies it between steps as a live device-to-device
+        # state transfer and acks over KFTPU-METRIC. The DATA STREAM is
+        # mesh-independent (same seeded host batches, only their
+        # sharding changes), so fast-forwarding a fresh iterator by the
+        # batches already consumed keeps the loss curve bit-exact
+        # against the checkpoint-restart path onto the same mesh.
+        resize_file = os.environ.get("KFTPU_RESIZE_FILE")
+        resize_seq = 0
+        batches_seen = 0
+        resize_cm = contextlib.ExitStack()
         for step in range(start_step, args.steps):
+            cmd = read_resize_command(resize_file, resize_seq)
+            if cmd is not None:
+                resize_seq = int(cmd.get("seq", 0))
+                t0 = time.perf_counter()
+                n_slices = int(cmd.get("num_slices", num_slices))
+                n_devs = int(cmd.get("devices", 0))
+                devs = jax.devices()[:n_devs] if n_devs else None
+                try:
+                    if n_slices > 1:
+                        from kubeflow_tpu.parallel.mesh import (
+                            build_multislice_mesh,
+                        )
+
+                        new_mesh = build_multislice_mesh(
+                            cfg, num_slices=n_slices, devices=devs)
+                    else:
+                        new_mesh = build_mesh(cfg, devices=devs)
+                    state, plan = task.reshard_state(state, new_mesh)
+                except Exception as e:  # infeasible plan, bad geometry
+                    # Keep training on the old mesh; the nack tells the
+                    # controller to fall back to checkpoint-restart.
+                    logger.warning("in-place resize failed: %s", e)
+                    mlog.emit(event="reshard", reshard_seq=resize_seq,
+                              reshard_ok=0, step=step)
+                else:
+                    mesh = new_mesh
+                    num_slices = n_slices
+                    resize_cm.close()
+                    resize_cm.enter_context(mesh)
+                    step_fn = task.train_step_fn(mesh)
+                    data = task.data_iter(
+                        ctx.num_processes, ctx.process_id, mesh, args.seed)
+                    for _ in range(batches_seen):
+                        next(data)
+                    dt = time.perf_counter() - t0
+                    logger.info(
+                        "live reshard at step %d: %s in %.3fs "
+                        "(%d B moved, %d B host-staged)", step,
+                        plan.transition, dt, plan.bytes_moved,
+                        plan.host_staged_bytes,
+                    )
+                    mlog.emit(
+                        event="reshard", reshard_seq=resize_seq,
+                        reshard_ok=1, reshard_seconds=f"{dt:.3f}",
+                        reshard_transition=plan.transition,
+                        reshard_bytes_moved=plan.bytes_moved,
+                        reshard_host_staged_bytes=plan.host_staged_bytes,
+                        step=step,
+                    )
             with trace.span("step", plane="runtime", step=step):
                 # >= not ==: a checkpoint resume landing inside (or past the
                 # start of) the window still traces the remaining steps.
@@ -168,6 +269,7 @@ def main(argv=None) -> int:
                               dir=profile_dir)
                 with trace.span("data-wait"):
                     batch = next(data)
+                    batches_seen += 1
                 # Transient-fault semantics: the injected death fires only
                 # in a fresh (non-resumed) incarnation, so restart+resume
                 # recovers -- the scenario SURVEY.md 5.3 tests. A permanent
@@ -201,6 +303,7 @@ def main(argv=None) -> int:
                                  for k, v in metrics.items() if k != "loss"}
                     mlog.log_step(step, loss, tokens=task.tokens_per_step,
                                   **extra)
+        resize_cm.close()
         if prof_active:  # window extended past the last step
             jax.profiler.stop_trace()
             mlog.emit(event="profile_end", step=args.steps - 1, dir=profile_dir)

@@ -36,6 +36,21 @@ class TrainTask(abc.ABC):
     ) -> Iterator[tuple[jax.Array, ...]]:
         """Yield device-ready global batch arrays."""
 
+    def reshard_state(self, state: Any, new_mesh: Mesh, **plan_kwargs):
+        """Consume a mid-run resize: the SAME logical state, live, on
+        ``new_mesh`` -- no checkpoint round-trip (parallel/reshard.py).
+
+        The default transplants every leaf's PartitionSpec onto the new
+        mesh, which is correct for any state built from the logical-axis
+        rules (models.common.state_shardings). Tasks whose layout is
+        mesh-dependent beyond the spec (rare) override this. Returns
+        ``(new_state, ReshardPlan)``; raises InfeasibleReshardError when
+        the plan is rejected -- the caller then takes the
+        checkpoint-restart path. The input state is donated."""
+        from kubeflow_tpu.parallel.reshard import reshard
+
+        return reshard(state, new_mesh, donate=True, **plan_kwargs)
+
 
 def host_to_global(mesh: Mesh, spec: P, local_arr) -> jax.Array:
     """Assemble a global array from this process's local shard.

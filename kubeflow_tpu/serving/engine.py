@@ -1954,6 +1954,11 @@ class GenerationEngine:
         self._drain_reason = ""  # why _pipeline_next last returned 0
         self._gap_t: Optional[float] = None
         self.decode_dispatches = 0
+        # Blocks whose outputs were materialized on the host. Trails
+        # decode_dispatches by len(_inflight); the host-sync audit's
+        # steady-state denominator (a window can consume blocks that
+        # were dispatched before it opened).
+        self.decode_blocks_consumed = 0
         self.host_gap_ms_ema: Optional[float] = None
         self.overshoot_tokens_discarded = 0
         # Largest queued-lane discard of any single drain event (the
@@ -2667,6 +2672,7 @@ class GenerationEngine:
             "dispatch_depth": self.pipeline_depth,
             "dispatch_inflight": len(self._inflight),
             "decode_dispatches": self.decode_dispatches,
+            "decode_blocks_consumed": self.decode_blocks_consumed,
             "host_gap_ms_ema": (
                 round(self.host_gap_ms_ema, 3)
                 if self.host_gap_ms_ema is not None else 0.0
@@ -2935,6 +2941,7 @@ class GenerationEngine:
         with trace.span("decode-block.consume", plane="serving",
                         track="engine", n=fl.n,
                         depth=len(self._inflight), drain=drain):
+            self.decode_blocks_consumed += 1
             if fl.want_lp:
                 outs = tuple(np.asarray(o) for o in fl.outs)
             else:

@@ -431,6 +431,7 @@ def test_perf_shipped_baseline_passes_shipped_artifacts():
     assert any(k.startswith("train.mfu.seq") for k in measured)
     assert any(k.startswith("serving.tok_s.slots") for k in measured)
     assert any(k.startswith("fleet.") for k in measured)
+    assert any(k.startswith("reshard.") for k in measured)
 
 
 def test_perf_planted_mfu_regression_exits_one(monkeypatch, capsys, tmp_path):
@@ -527,6 +528,106 @@ def test_perf_fleet_shed_rate_sanity_range(tmp_path):
     findings, _ = analysis.check_perf(baseline, root=str(tmp_path))
     assert [f.rule for f in findings] == ["KT-PERF-FLEET"]
     assert "never fired" in findings[0].message
+
+
+def _reshard_row(transition, **kw):
+    row = {"transition": transition, "reshard_seconds": 0.1,
+           "host_staged_bytes": 0, "checkpoint_restart_seconds": 1.0,
+           "bitwise_parity_vs_restore": True}
+    row.update(kw)
+    return row
+
+
+def test_perf_planted_reshard_regression_exits_one(monkeypatch, capsys,
+                                                   tmp_path):
+    bad = analysis.load_perf_baseline()
+    bad["reshard"]["reshard_seconds_ceiling"] = 0.0
+    p = tmp_path / "perf.json"
+    p.write_text(json.dumps(bad))
+    rc, out = _run_cli(monkeypatch, capsys, [], {},
+                       ["--strict", "--json", "--perf-baseline", str(p)])
+    assert rc == 1
+    assert any(f["rule"] == "KT-PERF-RESHARD" and f["hard"]
+               for f in json.loads(out)["new"])
+
+
+def test_perf_reshard_vanished_transition_is_a_finding(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "parsed": {"extra": {"reshard": [_reshard_row("grow")]}},
+    }))
+    baseline = {"reshard": {
+        "transitions_required": ["re-split", "grow", "shrink"],
+        "reshard_seconds_ceiling": 4.5,
+    }}
+    findings, measured = analysis.check_perf(baseline, root=str(tmp_path))
+    assert measured["reshard.grow.seconds"] == 0.1
+    assert sorted(f.rule for f in findings) == ["KT-PERF-RESHARD"] * 2
+    msgs = " ".join(f.message for f in findings)
+    assert "re-split" in msgs and "shrink" in msgs
+
+
+def test_perf_reshard_growlike_host_staging_is_a_finding(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "parsed": {"extra": {"reshard": [
+            _reshard_row("grow", host_staged_bytes=4096),
+            # Host staging on SHRINK is legitimate (departing-exclusive
+            # shards have nowhere else to live) -- no finding.
+            _reshard_row("shrink", host_staged_bytes=1 << 20),
+        ]}},
+    }))
+    baseline = {"reshard": {
+        "transitions_required": ["grow", "shrink"],
+        "host_staged_bytes_ceiling_growlike": 0,
+    }}
+    findings, _ = analysis.check_perf(baseline, root=str(tmp_path))
+    assert [f.rule for f in findings] == ["KT-PERF-RESHARD"]
+    assert "4096 B host-staged" in findings[0].message
+
+
+def test_perf_reshard_slower_than_restart_or_bit_drift_fails(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "parsed": {"extra": {"reshard": [
+            _reshard_row("grow", reshard_seconds=2.0,
+                         checkpoint_restart_seconds=1.5),
+            _reshard_row("shrink", bitwise_parity_vs_restore=False),
+        ]}},
+    }))
+    baseline = {"reshard": {
+        "transitions_required": ["grow", "shrink"],
+        "require_faster_than_restart": True,
+        "require_bitwise_parity": True,
+    }}
+    findings, measured = analysis.check_perf(baseline, root=str(tmp_path))
+    msgs = " ".join(f.message for f in findings)
+    assert len(findings) == 2
+    assert "not faster" in msgs and "changes bits" in msgs
+    assert measured["reshard.grow.vs_restart"] == 0.75
+
+
+def test_perf_artifact_discovery_is_phase_scoped(tmp_path):
+    # A newer reshard-only round must NOT shadow the older round that
+    # carries the MFU curve (and vice versa): each family reads the
+    # newest artifact of ITS phase.
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "parsed": {"extra": {"seq_len": 1024, "mfu": 0.7,
+                             "seq_sweep": []}},
+    }))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+        "parsed": {"extra": {"reshard": [_reshard_row("grow")]}},
+    }))
+    train, tname = analysis.latest_train_bench(str(tmp_path))
+    resh, rname = analysis.latest_reshard_bench(str(tmp_path))
+    assert tname == "BENCH_r01.json" and "mfu" in train["extra"]
+    assert rname == "BENCH_r02.json" and "reshard" in resh["extra"]
+    baseline = {
+        "train": {"mfu_floor_by_seq": {"1024": 0.6}},
+        "reshard": {"transitions_required": ["grow"],
+                    "reshard_seconds_ceiling": 4.5},
+    }
+    findings, measured = analysis.check_perf(baseline, root=str(tmp_path))
+    assert findings == [], [f.message for f in findings]
+    assert measured["train.mfu.seq1024"] == 0.7
+    assert measured["reshard.grow.seconds"] == 0.1
 
 
 def test_perf_ceilings_check_live_metrics():

@@ -946,3 +946,109 @@ class TestMetricDrivenElastic:
                 assert envs[-1]["JAX_NUM_PROCESSES"] == "1"
 
         asyncio.run(run())
+
+
+class TestReshardInPlace:
+    """ElasticPolicy.reshard_in_place: a metric-driven resize goes to the
+    LIVE gang as an in-memory reshard command (parallel/reshard.py) --
+    no teardown, no orbax round-trip -- with checkpoint-restart as the
+    fallback on nack/timeout."""
+
+    @staticmethod
+    def _job(tmp_path, **el_kw):
+        from kubeflow_tpu.api import ElasticPolicy
+        from kubeflow_tpu.api.types import CheckpointPolicy
+
+        return make_job(
+            "rsj", replicas=2, tpu=1,
+            checkpoint=CheckpointPolicy(dir=str(tmp_path / "ck")),
+            elastic=ElasticPolicy(
+                min_replicas=1, max_replicas=4, max_restarts=5,
+                metric="queue_depth", target_value=100.0,
+                metric_poll_seconds=0.05, reshard_in_place=True,
+                reshard_timeout_seconds=2.0, **el_kw,
+            ),
+        )
+
+    def test_success_keeps_gang_up(self, tmp_path):
+        async def run():
+            from kubeflow_tpu.controller.envvars import resize_file_path
+
+            async with Harness(total_chips=8) as h:
+                def metric(rt, m):
+                    # Worker acks whatever seq the controller wrote.
+                    return {"queue_depth": 200.0, "reshard_seq": 1.0,
+                            "reshard_ok": 1.0,
+                            "reshard_seconds": 0.42}.get(m)
+
+                h.ctl._read_worker_metric = metric
+                h.submit(self._job(tmp_path))
+                await h.wait_phase("rsj", "Running")
+                spawned0 = len(h.launcher.spawned)
+                # ceil(2 * 200/100) = 4: resize rides the reshard path.
+                await h.wait(
+                    lambda: (lambda j: j is not None
+                             and j.status.formed_replicas == 4)(
+                                 h.job("rsj")),
+                    msg="in-place resize to 4",
+                )
+                # The command file carried the new logical width.
+                import json as _json
+
+                cmd = _json.loads(
+                    open(resize_file_path(str(tmp_path / "ck"))).read())
+                assert cmd == {"seq": 1, "num_slices": 4,
+                               "target_replicas": 4}
+                reasons = [
+                    e["reason"] for e in h.store.list("Event")
+                    if e.get("involved") == "default/rsj"
+                ]
+                assert "ReshardInPlace" in reasons, reasons
+                assert "ReshardComplete" in reasons, reasons
+                # The whole point: no teardown, no re-spawn, no restart.
+                assert "ElasticMetricResize" not in reasons, reasons
+                assert len(h.launcher.spawned) == spawned0
+                assert h.job("rsj").status.restart_count == 0
+
+        asyncio.run(run())
+
+    def test_nack_falls_back_to_checkpoint_restart(self, tmp_path):
+        async def run():
+            from kubeflow_tpu.controller.envvars import resize_file_path
+
+            async with Harness(total_chips=8) as h:
+                def metric(rt, m):
+                    # Worker acks the seq but reports the plan infeasible.
+                    return {"queue_depth": 200.0, "reshard_seq": 1.0,
+                            "reshard_ok": 0.0}.get(m)
+
+                h.ctl._read_worker_metric = metric
+                h.submit(self._job(tmp_path))
+                await h.wait_phase("rsj", "Running")
+                spawned0 = len(h.launcher.spawned)
+                await h.wait(
+                    lambda: (lambda j: j is not None
+                             and j.status.formed_replicas == 4)(
+                                 h.job("rsj")),
+                    msg="fallback resize to 4",
+                )
+                reasons = [
+                    e["reason"] for e in h.store.list("Event")
+                    if e.get("involved") == "default/rsj"
+                ]
+                assert "ReshardInPlace" in reasons, reasons
+                assert "ReshardFallback" in reasons, reasons
+                # Nack routes the SAME resize through the blessed
+                # teardown/re-form path: gang re-spawned at 4.
+                assert "ElasticMetricResize" in reasons, reasons
+                await h.wait(
+                    lambda: len(h.launcher.spawned) == spawned0 + 4,
+                    msg="gang re-formed at 4 workers",
+                )
+                # Stale command must not outlive the gang generation.
+                import os as _os
+
+                assert not _os.path.exists(
+                    resize_file_path(str(tmp_path / "ck")))
+
+        asyncio.run(run())
