@@ -1,0 +1,385 @@
+"""Deterministic scheduler-simulation bench (KT-PERF-SCHED family).
+
+Drives the SAME policy code the live controller runs
+(``kubeflow_tpu/controller/scheduler.py``) through a discrete-event
+cluster simulation and A/Bs three arms over one synthetic mixed tenancy
+(train + HPO sweep + serving scale-ups):
+
+- ``fifo``       -- the pre-scheduler baseline: gangs admitted in strict
+                    arrival order at spec size, no backfill past the
+                    queue head (gang semantics), first-fit placement,
+                    no resize, no preemption. This is what the repo's
+                    controller did before ROADMAP item 2.
+- ``sched_blind`` -- the full multi-tenant policy with the contention
+                    term zeroed (``contention_weight=0``): measures how
+                    much of the win is fairness/elasticity vs placement.
+- ``sched``      -- the headline: contention-aware packing, weighted
+                    max-min fairness, SLO preemption, reshard-aware
+                    migration gating.
+
+Both simulated worlds and the policy's internal cost model share ONE
+contention physics (``contention_factor``), so the aware arm wins by
+*placing* better, not by being graded on friendlier physics. Actuation
+costs are the measured ones: same-domain resizes on reshard-capable
+jobs pause for the worst measured live-reshard transition from the
+latest reshard bench artifact (BENCH_r06: ~0.19 s), domain moves and
+preemption-restarts pause for the checkpoint-restart budget (90 s) --
+which is exactly why the planner's migration gate matters.
+
+Deterministic by construction: no wall-clock, no RNG; fixed dt ticks.
+Output is the ``parsed`` payload for ``BENCH_r07.json`` (the artifact
+``analysis/perf.py::_check_sched`` ratchets).
+
+Run:  python bench_sched.py            # JSON to stdout
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubeflow_tpu.controller.scheduler import (
+    Domain,
+    MultiTenantPolicy,
+    Placement,
+    PolicyConfig,
+    SchedJob,
+    contention_factor,
+    jains_index,
+    scale_efficiency,
+)
+
+DT = 0.5                 # sim tick (s)
+REPLAN_EVERY = 5.0       # scheduler round cadence (s)
+RESTART_SECONDS = 90.0   # checkpoint-restart pause budget (spec, PR 8)
+HORIZON = 1e9            # no-progress watchdog
+
+
+@dataclass
+class SimJob:
+    """One job in the simulated mix."""
+
+    key: str
+    tenant: str
+    weight: float
+    workload: str            # serving | train | hpo
+    min_chips: int
+    max_chips: int
+    intensity: float         # collective intensity (census-derived)
+    per_chip: float          # solo tok/s per chip
+    work: float              # tokens to produce before Succeeded
+    arrival: float
+    reshardable: bool = False
+    spec_chips: int = 0      # FIFO arm's fixed gang size
+
+    # mutable sim state
+    done: float = 0.0
+    placement: Optional[Placement] = None
+    pause_until: float = 0.0
+    started: bool = False
+    finish: Optional[float] = None
+    preemptions: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.spec_chips:
+            self.spec_chips = self.max_chips
+
+
+def job_mix() -> List[SimJob]:
+    """The mixed train+HPO+serving tenancy (3 tenants, 10 jobs).
+
+    Two collective-heavy train jobs (ring-attention-class intensity
+    0.85) that co-located run at ~0.63x each; an HPO sweep of six
+    collective-light trials arriving over time; two serving scale-ups
+    arriving mid-run whose minimums force preemption of HPO trials.
+    """
+    jobs = [
+        SimJob("acme/train-a", "acme", 2.0, "train", 4, 12, 0.85,
+               1000.0, 3_200_000, 0.0, reshardable=True, spec_chips=8),
+        SimJob("beta/train-b", "beta", 1.0, "train", 4, 12, 0.85,
+               1000.0, 2_800_000, 0.0, reshardable=True, spec_chips=8),
+    ]
+    for i, arrival in enumerate((0.0, 0.0, 0.0, 0.0, 60.0, 80.0)):
+        jobs.append(SimJob(
+            f"gamma/hpo-{i}", "gamma", 1.0, "hpo", 4, 4, 0.2,
+            900.0, 400_000, arrival, spec_chips=4,
+        ))
+    # Serving scale-ups: min demand high enough that, with both trains
+    # at elastic minimum and the live HPO trials, minimums exceed the
+    # 32-chip cluster -> SLO preemption fires.
+    jobs.append(SimJob("acme/serve-a", "acme", 2.0, "serving", 8, 8,
+                       0.15, 1500.0, 900_000, 120.0, spec_chips=8))
+    jobs.append(SimJob("beta/serve-b", "beta", 1.0, "serving", 8, 8,
+                       0.15, 1500.0, 700_000, 150.0, spec_chips=8))
+    return jobs
+
+
+def domains() -> List[Domain]:
+    # Two interconnect domains of 16 chips: large enough that two train
+    # gangs CAN share one (which is exactly the contention-blind
+    # failure mode the aware arm avoids).
+    return [Domain("d0", 16), Domain("d1", 16)]
+
+
+def progress_rates(jobs: List[SimJob], alpha: float) -> Dict[str, float]:
+    """tok/s for every placed, unpaused job under the shared contention
+    physics: intensity-weighted slowdown from domain co-residents."""
+    by_dom: Dict[str, float] = {}
+    for j in jobs:
+        if j.placement is not None:
+            by_dom[j.placement.domain] = (
+                by_dom.get(j.placement.domain, 0.0) + j.intensity)
+    rates = {}
+    for j in jobs:
+        p = j.placement
+        if p is None:
+            continue
+        others = by_dom[p.domain] - j.intensity
+        rates[j.key] = (j.per_chip * p.chips * scale_efficiency(p.chips)
+                        * contention_factor(j.intensity, others, alpha))
+    return rates
+
+
+@dataclass
+class ArmResult:
+    makespan: float
+    goodput: float                      # total tokens / makespan
+    fairness: float                     # Jain over weighted tenant rates
+    preemptions: int
+    migrations: int
+    migration_seconds: float
+    per_job: List[dict] = field(default_factory=list)
+
+
+def finalize(jobs: List[SimJob], t: float, preemptions: int,
+             migrations: int, migration_seconds: float) -> ArmResult:
+    total = sum(j.work for j in jobs)
+    makespan = max(j.finish for j in jobs)
+    # Weighted fairness at TENANT granularity (what the two-level
+    # water-filling promises): tenant service rate = tenant tokens over
+    # the tenant's active span, normalized by tenant weight.
+    tenants: Dict[str, List[SimJob]] = {}
+    for j in jobs:
+        tenants.setdefault(j.tenant, []).append(j)
+    norm_rates = []
+    for members in tenants.values():
+        tok = sum(m.work for m in members)
+        span = (max(m.finish for m in members)
+                - min(m.arrival for m in members))
+        w = max(m.weight for m in members)
+        norm_rates.append((tok / max(span, 1e-9)) / w)
+    return ArmResult(
+        makespan=round(makespan, 1),
+        goodput=round(total / makespan, 1),
+        fairness=round(jains_index(norm_rates), 4),
+        preemptions=preemptions,
+        migrations=migrations,
+        migration_seconds=round(migration_seconds, 2),
+        per_job=[{
+            "job": j.key, "tenant": j.tenant, "class": j.workload,
+            "arrival": j.arrival, "finish": round(j.finish, 1),
+            "preemptions": j.preemptions,
+        } for j in sorted(jobs, key=lambda j: j.key)],
+    )
+
+
+# --------------------------------------------------------------------------
+# FIFO-gang baseline arm.
+# --------------------------------------------------------------------------
+def run_fifo(alpha: float) -> ArmResult:
+    jobs = job_mix()
+    doms = domains()
+    t = 0.0
+    while any(j.finish is None for j in jobs) and t < HORIZON:
+        live = [j for j in jobs if j.finish is None and j.arrival <= t]
+        # Admit strictly in arrival order at spec size; the queue head
+        # blocks everyone behind it (gang semantics, no backfill).
+        free = {d.name: d.chips for d in doms}
+        for j in live:
+            if j.placement is not None:
+                free[j.placement.domain] -= j.placement.chips
+        for j in sorted((j for j in live if j.placement is None),
+                        key=lambda j: (j.arrival, j.key)):
+            fit = next((d for d in doms
+                        if free[d.name] >= j.spec_chips), None)
+            if fit is None:
+                break  # head-of-line: nothing behind may jump the queue
+            j.placement = Placement(fit.name, j.spec_chips)
+            j.started = True
+            free[fit.name] -= j.spec_chips
+        rates = progress_rates(live, alpha)
+        for j in live:
+            r = rates.get(j.key)
+            if r is None:
+                continue
+            j.done += r * DT
+            if j.done >= j.work:
+                j.finish = t + DT
+                j.placement = None
+        t += DT
+    return finalize(jobs, t, 0, 0, 0.0)
+
+
+# --------------------------------------------------------------------------
+# Policy arms (contention-aware and -blind share this driver).
+# --------------------------------------------------------------------------
+def run_policy(alpha: float, contention_weight: float,
+               reshard_seconds: float) -> ArmResult:
+    jobs = job_mix()
+    doms = domains()
+    cfg = PolicyConfig(
+        contention_weight=contention_weight,
+        contention_alpha=alpha,
+        reshard_seconds=reshard_seconds,
+        restart_seconds=RESTART_SECONDS,
+        round_horizon_seconds=REPLAN_EVERY,
+    )
+    policy = MultiTenantPolicy(doms, cfg)
+    t = 0.0
+    next_round = 0.0
+    preemptions = migrations = 0
+    migration_seconds = 0.0
+    seq = {j.key: i for i, j in enumerate(jobs)}
+    while any(j.finish is None for j in jobs) and t < HORIZON:
+        live = [j for j in jobs if j.finish is None and j.arrival <= t]
+        if t >= next_round and live:
+            next_round = t + REPLAN_EVERY
+            view = [SchedJob(
+                key=j.key, tenant=j.tenant, weight=j.weight,
+                workload=j.workload, min_chips=j.min_chips,
+                max_chips=j.max_chips,
+                collective_intensity=j.intensity,
+                arrival_seq=seq[j.key], reshardable=j.reshardable,
+                current=j.placement, tok_s_per_chip=j.per_chip,
+            ) for j in sorted(live, key=lambda j: seq[j.key])]
+            plan = policy.plan(view)
+            by_key = {j.key: j for j in live}
+            for dec in plan.decisions:
+                j = by_key[dec.job]
+                if j.pause_until > t and dec.action in (
+                        "grow", "shrink", "migrate", "preempt"):
+                    continue  # a resize is already actuating: never stack
+                if dec.action in ("queue",):
+                    continue
+                if dec.action == "preempt":
+                    j.placement = None
+                    j.preemptions += 1
+                    preemptions += 1
+                    continue
+                if dec.placement is None:
+                    continue
+                if dec.action == "admit":
+                    j.placement = dec.placement
+                    if j.started:
+                        # resume-from-checkpoint after preemption
+                        j.pause_until = t + RESTART_SECONDS
+                        migration_seconds += RESTART_SECONDS
+                    j.started = True
+                elif dec.action in ("grow", "shrink", "migrate"):
+                    j.placement = dec.placement
+                    j.pause_until = t + dec.cost_seconds
+                    migrations += 1
+                    migration_seconds += dec.cost_seconds
+        rates = progress_rates(
+            [j for j in live if j.pause_until <= t], alpha)
+        for j in live:
+            r = rates.get(j.key)
+            if r is None:
+                continue
+            j.done += r * DT
+            if j.done >= j.work:
+                j.finish = t + DT
+                j.placement = None
+                next_round = t + DT  # replan on completion: backfill now
+        if any(j.arrival > t and j.arrival <= t + DT for j in jobs):
+            next_round = t + DT  # replan on arrival
+        t += DT
+    return finalize(jobs, t, preemptions, migrations, migration_seconds)
+
+
+# --------------------------------------------------------------------------
+def measured_reshard_seconds(root: str = ".") -> tuple:
+    """Worst measured live-reshard transition from the latest reshard
+    bench artifact -- the scheduler's migration-cost accounting must use
+    the MEASURED number (ISSUE 11), not a flattering guess."""
+    from kubeflow_tpu.analysis import latest_reshard_bench
+
+    parsed, artifact = latest_reshard_bench(root)
+    if parsed is None:
+        return 0.2, "default (no reshard bench artifact found)"
+    rows = parsed.get("extra", {}).get("reshard", [])
+    secs = max((r.get("reshard_seconds", 0.0) for r in rows),
+               default=0.2)
+    return secs, artifact
+
+
+def main() -> int:
+    alpha = 0.8
+    reshard_s, cost_source = measured_reshard_seconds()
+    fifo = run_fifo(alpha)
+    blind = run_policy(alpha, contention_weight=0.0,
+                       reshard_seconds=reshard_s)
+    sched = run_policy(alpha, contention_weight=1.0,
+                       reshard_seconds=reshard_s)
+
+    def dump(a: ArmResult) -> dict:
+        return {
+            "makespan_s": a.makespan,
+            "aggregate_goodput_tok_s": a.goodput,
+            "weighted_fairness_index": a.fairness,
+            "preemptions": a.preemptions,
+            "migrations": a.migrations,
+            "migration_seconds": a.migration_seconds,
+            "per_job": a.per_job,
+        }
+
+    result = {
+        "metric": "sched_goodput_vs_fifo",
+        "value": round(sched.goodput / fifo.goodput, 3),
+        "unit": "x",
+        "vs_baseline": round(sched.goodput / fifo.goodput, 3),
+        "extra": {
+            "sched": {
+                "goodput_vs_fifo": round(sched.goodput / fifo.goodput, 3),
+                "contention_gain": round(sched.goodput / blind.goodput, 3),
+                "fairness_index": sched.fairness,
+                "arms": {
+                    "fifo": dump(fifo),
+                    "sched_blind": dump(blind),
+                    "sched": dump(sched),
+                },
+                "cluster": {
+                    "domains": [{"name": d.name, "chips": d.chips}
+                                for d in domains()],
+                    "total_chips": sum(d.chips for d in domains()),
+                    "jobs": len(job_mix()),
+                    "tenants": 3,
+                },
+                "migration": {
+                    "reshard_seconds_used": reshard_s,
+                    "restart_seconds_used": RESTART_SECONDS,
+                    "cost_source": cost_source,
+                },
+                "sim": {
+                    "dt_s": DT,
+                    "replan_every_s": REPLAN_EVERY,
+                    "contention_alpha": alpha,
+                },
+                "honesty": (
+                    "policy code is the production scheduler module; the "
+                    "cluster is simulated (deterministic discrete-event, "
+                    "shared contention physics across all arms) -- arms "
+                    "differ only in policy, and migration pauses use the "
+                    "measured live-reshard seconds from the reshard bench"
+                ),
+            },
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,7 @@ from kubeflow_tpu.analysis.perf import (  # noqa: F401
     PERF_BASELINE_PATH,
     check_perf,
     latest_reshard_bench,
+    latest_sched_bench,
     latest_train_bench,
     load_perf_baseline,
 )

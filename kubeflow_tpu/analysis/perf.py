@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import glob
 import json
+import math
 import os
 from typing import Dict, List, Optional, Tuple
 
@@ -108,6 +109,11 @@ def latest_train_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str]
 def latest_reshard_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str]:
     """Newest committed ``bench.py --reshard`` round (extra.reshard)."""
     return _latest_bench_with(root, ("reshard",))
+
+
+def latest_sched_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str]:
+    """Newest committed ``bench_sched.py`` round (extra.sched)."""
+    return _latest_bench_with(root, ("sched",))
 
 
 def serving_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str]:
@@ -342,6 +348,84 @@ def _check_reshard(rbase: dict, rows: List[dict], artifact: str,
     return findings
 
 
+def _check_sched(sbase: dict, sched: dict, artifact: str,
+                 measured: Dict[str, float],
+                 root: Optional[str]) -> List[Finding]:
+    """KT-PERF-SCHED: the multi-tenant scheduler A/B (bench_sched.py).
+
+    The scheduling contract: aggregate goodput over the mixed
+    train+HPO+serving tenancy at least ``goodput_vs_fifo_floor`` times
+    the FIFO-gang baseline arm, the contention-aware arm beating the
+    contention-blind ablation, the weighted fairness index above its
+    floor, and -- non-negotiably -- the migration-cost accounting using
+    the MEASURED live-reshard seconds from the reshard bench, not a
+    flattering constant (a sim that underprices its own migrations
+    would report free repacking)."""
+    findings: List[Finding] = []
+
+    def _floor(metric: str, key: str) -> None:
+        limit = sbase.get(key)
+        if limit is None:
+            return
+        val = sched.get(metric)
+        if val is None:
+            findings.append(Finding(
+                rule="KT-PERF-SCHED", path=artifact, line=0, hard=True,
+                message=(
+                    f"sched.{metric}: missing from {artifact} "
+                    f"({key}={limit})"
+                ),
+            ))
+            return
+        measured[f"sched.{metric}"] = float(val)
+        if val < limit:
+            findings.append(Finding(
+                rule="KT-PERF-SCHED", path=artifact, line=0, hard=True,
+                message=(
+                    f"sched.{metric} = {val} below ratchet floor "
+                    f"{limit} ({artifact})"
+                ),
+            ))
+
+    _floor("goodput_vs_fifo", "goodput_vs_fifo_floor")
+    _floor("contention_gain", "contention_gain_floor")
+    _floor("fairness_index", "fairness_index_floor")
+
+    if sbase.get("require_measured_migration_cost"):
+        mig = sched.get("migration")
+        used = (mig or {}).get("reshard_seconds_used")
+        if not isinstance(mig, dict) or used is None \
+                or not mig.get("cost_source"):
+            findings.append(Finding(
+                rule="KT-PERF-SCHED", path=artifact, line=0, hard=True,
+                message=(
+                    f"sched.migration.reshard_seconds_used/cost_source "
+                    f"missing from {artifact}: migration-cost accounting "
+                    f"must cite the measured reshard bench"
+                ),
+            ))
+        else:
+            measured["sched.migration.reshard_seconds_used"] = float(used)
+            rparsed, rartifact = latest_reshard_bench(root)
+            rows = ((rparsed or {}).get("extra") or {}).get("reshard") or []
+            actual = max((float(r.get("reshard_seconds", 0.0))
+                          for r in rows if isinstance(r, dict)),
+                         default=None)
+            if actual is not None and not math.isclose(
+                    float(used), actual, rel_tol=0.05):
+                findings.append(Finding(
+                    rule="KT-PERF-SCHED", path=artifact, line=0, hard=True,
+                    message=(
+                        f"sched.migration.reshard_seconds_used = {used} "
+                        f"does not match the measured worst live-reshard "
+                        f"transition {actual}s in {rartifact}: the sim's "
+                        f"migration pricing drifted from the measured "
+                        f"data plane"
+                    ),
+                ))
+    return findings
+
+
 def check_perf(
     baseline: dict,
     *,
@@ -441,6 +525,24 @@ def check_perf(
         if parsed is not None:
             rows = (parsed.get("extra") or {}).get("reshard") or []
             findings.extend(_check_reshard(rbase, rows, artifact, measured))
+
+    # -- multi-tenant scheduler (bench_sched) ------------------------------
+    sbase = baseline.get("sched") or {}
+    if sbase:
+        parsed, artifact = latest_sched_bench(root)
+        if parsed is not None:
+            sched = (parsed.get("extra") or {}).get("sched")
+            if not isinstance(sched, dict):
+                findings.append(Finding(
+                    rule="KT-PERF-SCHED", path=artifact, line=0, hard=True,
+                    message=(
+                        f"no extra.sched section in {artifact} (sched "
+                        f"floors set) -- the scheduler bench vanished"
+                    ),
+                ))
+            else:
+                findings.extend(_check_sched(sbase, sched, artifact,
+                                             measured, root))
 
     # -- live-metric ceilings ----------------------------------------------
     # Checked against THIS analyze run's Tier-B metrics; a ceiling whose

@@ -161,6 +161,16 @@ class SchedulingPolicy(BaseModel):
     min_available: Optional[int] = None
     queue: str = "default"
     priority: int = 0
+    # Multi-tenant scheduler inputs (controller/scheduler.py). ``tenant``
+    # groups jobs for cluster-level weighted max-min fairness (defaults
+    # to the job's namespace when unset); ``weight`` is the tenant/job
+    # share in the water-filling; ``priority_class`` fixes the workload
+    # class used for SLO-aware preemption ordering (serving preempts
+    # train preempts hpo) -- unset, the class is inferred from the
+    # ``kftpu.io/workload-class`` annotation or the queue name.
+    tenant: Optional[str] = None
+    weight: float = Field(default=1.0, gt=0)
+    priority_class: Optional[Literal["serving", "train", "hpo"]] = None
     # "Never" (default): the gang waits in the queue for free capacity.
     # "PreemptLowerPriority": a gang that cannot be admitted may evict
     # strictly-lower-priority running gangs (Volcano preempt action /
@@ -202,6 +212,12 @@ class ElasticPolicy(BaseModel):
     # checkpoint dir (the fallback path and the command file live there).
     reshard_in_place: bool = False
     reshard_timeout_seconds: float = Field(default=60.0, gt=0)
+    # Cede resize authority to the cluster scheduler: when True the
+    # per-job metric scaler is disarmed (the cluster scheduler's rounds
+    # become the single writer of resize decisions, so the two paths can
+    # never issue concurrent resizes for one job). ``metric`` may still
+    # be set -- it then only feeds the scheduler's throughput model.
+    scheduler_managed: bool = False
 
 
 class CheckpointPolicy(BaseModel):

@@ -303,6 +303,100 @@ def cmd_trace(args, _client) -> int:
     return 0
 
 
+def cmd_sched(args, _client) -> int:
+    """``kftpu sched plan``: run one multi-tenant scheduling round and
+    print the assignment diff, without actuating anything.
+
+    File mode (``-f`` YAMLs, repeatable) plans the given specs onto an
+    empty cluster -- a what-if for capacity planning. Server mode (no
+    ``-f``) plans over the live control plane's jobs, seeding current
+    placements from ``status.formed_replicas``, so the diff shows what
+    the next live round would change."""
+    from kubeflow_tpu.api.types import ReplicaType, TrainJob
+    from kubeflow_tpu.api.validation import apply_defaults
+    from kubeflow_tpu.controller.scheduler import (
+        Domain,
+        MultiTenantPolicy,
+        Placement,
+        sched_job_from_spec,
+    )
+
+    domains = []
+    for part in args.domains.split(","):
+        name, _, chips = part.partition("=")
+        try:
+            domains.append(Domain(name.strip(), int(chips)))
+        except ValueError:
+            raise SystemExit(
+                f"error: bad --domains entry {part!r} (want name=chips)")
+
+    jobs = []
+    if args.filename:
+        for path in args.filename:
+            try:
+                f = sys.stdin if path == "-" else open(path)
+            except OSError as e:
+                raise SystemExit(f"error: cannot read {path}: {e.strerror}")
+            with f:
+                try:
+                    docs = [d for d in yaml.safe_load_all(f) if d]
+                except yaml.YAMLError as e:
+                    raise SystemExit(f"error: invalid YAML in {path}: {e}")
+            for doc in docs:
+                job = apply_defaults(TrainJob.from_dict(doc))
+                jobs.append(sched_job_from_spec(job, arrival_seq=len(jobs)))
+    else:
+        from kubeflow_tpu.controller.reconciler import JOB_KINDS
+
+        client = TrainingClient(args.server)
+        live = []
+        for kind in JOB_KINDS:
+            for obj in client.list(kind, args.namespace):
+                job = TrainJob.from_dict(obj)
+                if job.status.phase.value in ("Succeeded", "Failed",
+                                              "Suspended"):
+                    continue
+                live.append(job)
+        live.sort(key=lambda j: (j.metadata.creation_time or 0, j.key))
+        for i, job in enumerate(live):
+            spec = job.spec.replica_specs.get(ReplicaType.Worker)
+            per = spec.resources.tpu if spec is not None else 0
+            formed = job.status.formed_replicas
+            current = (Placement(domains[0].name, formed * per)
+                       if formed and per else None)
+            jobs.append(sched_job_from_spec(job, arrival_seq=i,
+                                            current=current))
+    if not jobs:
+        print("no schedulable jobs")
+        return 0
+
+    plan = MultiTenantPolicy(domains).plan(jobs)
+    placed = plan.placements
+    rows = []
+    for sj in jobs:
+        dec = next(d for d in plan.decisions if d.job == sj.key)
+        new = placed.get(sj.key)
+        cur = (f"{sj.current.chips}@{sj.current.domain}"
+               if sj.current else "-")
+        tgt = f"{new.chips}@{new.domain}" if new else "-"
+        rows.append((sj.key, sj.tenant, sj.workload, cur, tgt, dec.action,
+                     f"{dec.cost_seconds:g}", dec.reason))
+    header = ("JOB", "TENANT", "CLASS", "CURRENT", "PLANNED", "ACTION",
+              "COST_S", "REASON")
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    for r in [header] + rows:
+        print("  ".join(str(v).ljust(w) for v, w in zip(r, widths)).rstrip())
+    print(f"plan: {plan.summary()}  preemptions={plan.preemptions} "
+          f"migrations={plan.migrations}  "
+          f"capacity={sum(d.chips for d in domains)} chips "
+          f"across {len(domains)} domain(s)")
+    if not args.dry_run:
+        print("note: sched plan never actuates; the live round runs inside "
+              "the controller (ElasticPolicy.scheduler_managed)")
+    return 0
+
+
 def cmd_serve(args, _client) -> int:
     from kubeflow_tpu.server.app import main as server_main
 
@@ -392,6 +486,25 @@ def main(argv=None) -> int:
                     help="output path ('-' = stdout)")
     sp.set_defaults(fn=cmd_trace)
 
+    sp = sub.add_parser(
+        "sched",
+        help="multi-tenant scheduler tools (dry-run planning)",
+    )
+    sp.add_argument("action", choices=("plan",),
+                    help="plan: one scheduling round, print the "
+                         "assignment diff, actuate nothing")
+    sp.add_argument("-f", "--filename", action="append", default=[],
+                    help="plan these YAML specs onto an empty cluster "
+                         "instead of the live server's jobs (repeatable)")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.add_argument("--domains", default="d0=16,d1=16",
+                    help="comma-separated name=chips interconnect domains "
+                         "(default: d0=16,d1=16)")
+    sp.add_argument("--dry-run", action="store_true",
+                    help="explicit no-actuation marker (plan is always "
+                         "dry; suppresses the reminder note)")
+    sp.set_defaults(fn=cmd_sched)
+
     sp = sub.add_parser("serve", help="run the control-plane server")
     sp.add_argument("--state-dir", default=os.path.expanduser("~/.kftpu"))
     sp.add_argument("--port", type=int, default=7450)
@@ -399,7 +512,8 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_serve)
 
     args = p.parse_args(argv)
-    local_cmds = ("serve", "analyze", "trace")  # no control-plane client needed
+    # No control-plane client needed (sched builds its own in server mode).
+    local_cmds = ("serve", "analyze", "trace", "sched")
     client = TrainingClient(args.server) if args.cmd not in local_cmds else None
     try:
         return args.fn(args, client)

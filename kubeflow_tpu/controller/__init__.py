@@ -16,3 +16,11 @@ from kubeflow_tpu.controller.launcher import (  # noqa: F401
     WorkerRef,
 )
 from kubeflow_tpu.controller.reconciler import JobController  # noqa: F401
+from kubeflow_tpu.controller.scheduler import (  # noqa: F401
+    ClusterScheduler,
+    Domain,
+    MultiTenantPolicy,
+    Placement,
+    PolicyConfig,
+    SchedJob,
+)

@@ -348,6 +348,21 @@ class GangScheduler:
         self._reserved.pop(job_key, None)
         self._pending.pop(job_key, None)
 
+    def resize_reservation(self, job_key: str, chips: int) -> bool:
+        """Adjust an admitted gang's chip hold in place (live reshard: the
+        logical slice width changed but the process world survived, so
+        chips move while the process count stays). Without this, an
+        in-place shrink would never return capacity to the pool and the
+        scheduler's packing gains could not admit anyone. Returns False
+        for unknown keys or a grow that doesn't fit."""
+        res = self._reserved.get(job_key)
+        if res is None:
+            return False
+        if chips > res.chips and chips - res.chips > self.free_chips:
+            return False
+        res.chips = chips
+        return True
+
     def drop_pending(self, job_key: str) -> None:
         """Remove a queued (not admitted) entry — used when a caller
         re-queues the same job at a different demand, so stale sizes

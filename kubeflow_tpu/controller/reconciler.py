@@ -314,7 +314,8 @@ class JobController:
                 1 for t, _ in rt.formed_world if t == ReplicaType.Worker.value
             )
             el = job.spec.elastic
-            if el is not None and el.metric is not None and n != current:
+            if el is not None and n != current and (
+                    el.metric is not None or el.scheduler_managed):
                 if (el.reshard_in_place and not rt.reshard_fallback
                         and rt.reshard_pending is None
                         and job.kind == JobKind.JAXJob
@@ -327,9 +328,11 @@ class JobController:
                                                     current)
                 else:
                     rt.reshard_fallback = False
+                    driver = (f"metric {el.metric}" if el.metric is not None
+                              else "cluster scheduler")
                     self._record_event(
                         job, "ElasticMetricResize",
-                        f"metric {el.metric} drives "
+                        f"{driver} drives "
                         f"{current} -> {n} workers",
                     )
                     self._resize_hints[key] = n
@@ -346,7 +349,8 @@ class JobController:
                 rt.metrics_armed = False
         elif (rt is not None and rt.formed_replicas is not None
                 and (job.spec.elastic is None
-                     or job.spec.elastic.metric is None)
+                     or (job.spec.elastic.metric is None
+                         and not job.spec.elastic.scheduler_managed))
                 and self._can_grow(job, rt)):
             # Formed at reduced size (elastic); full size now fits: grow.
             self._record_event(
@@ -608,7 +612,11 @@ class JobController:
         CURRENT spec is re-read each fire so the policy can be retuned
         or removed on a running job."""
         el = job.spec.elastic
-        if el is None or el.metric is None or rt.metrics_armed:
+        # scheduler_managed cedes resize authority to the cluster
+        # scheduler's rounds: the per-job scaler never arms, so the two
+        # paths cannot issue concurrent resizes for one job.
+        if (el is None or el.metric is None or el.scheduler_managed
+                or rt.metrics_armed):
             return
         rt.metrics_armed = True
         loop = asyncio.get_running_loop()
@@ -625,6 +633,7 @@ class JobController:
             cur = TrainJob.from_dict(obj)
             el_now = cur.spec.elastic
             if (el_now is None or el_now.metric is None
+                    or el_now.scheduler_managed
                     or cur.status.phase.value in ("Succeeded", "Failed")):
                 rt.metrics_armed = False  # disabled live; reconcile re-arms
                 return
@@ -734,6 +743,13 @@ class JobController:
                     # from the new size.
                     rt.formed_replicas = n
                     rt.metrics_armed = False
+                    # The gang's chip hold tracks the new logical width:
+                    # an in-place shrink returns capacity to the pool
+                    # (the scheduler's packing relies on this), a grow
+                    # charges it.
+                    chips, _ = self.gang.demand(job, replicas_override=n)
+                    if self.gang.resize_reservation(job.key, chips):
+                        self.kick_pending(exclude=job.key)
                     self._record_event(
                         job, "ReshardComplete",
                         f"live reshard to {n} in "

@@ -1,0 +1,772 @@
+"""Multi-tenant cluster scheduler: throughput-measured packing.
+
+Sits between job admission and the reconciler's spawn/evict/reshard
+actuators (ROADMAP item 2, Gavel-style). Where the ``GangScheduler``
+answers "does this gang fit *now*", this layer answers "what should the
+WHOLE cluster run, at what size, and where" -- across train jobs, HPO
+trials, and serving replicas owned by different tenants -- using
+*measured* throughput (the KFTPU-METRIC tok/s gauges the reconciler
+already reads) rather than declared demand.
+
+Three policy ingredients, each pure and separately testable:
+
+- **Weighted max-min fairness** (``waterfill``): chips are water-filled
+  across tenants by tenant weight, then across each tenant's jobs, so
+  a tenant with weight 2 converges to twice the chips of a weight-1
+  tenant whenever both are unsaturated -- the classic progressive
+  filling that maximizes the minimum normalized allocation.
+- **SLO-aware preemption** (``preemption_rank``): when the sum of
+  minimum demands exceeds capacity, victims are chosen lowest class
+  first -- HPO trials before train jobs before serving replicas
+  (a serving scale-up must never wait behind a hyperparameter sweep),
+  youngest-first within a class to minimize lost work.
+- **Collective-contention-aware placement** (``place``): two
+  ring-allreduce/all-to-all-heavy jobs (classified from the PR 2
+  Tier-B collective census, see ``CENSUS_INTENSITY``) sharing one
+  interconnect domain slow each other down (PAPERS.md ring-allreduce
+  contention); placement charges a pairwise intensity product per
+  domain and steers heavy jobs apart when an emptier domain exists.
+
+**Reshard-aware migration** is what changes the economics: a chip-count
+change on a job with ``ElasticPolicy.reshard_in_place`` actuates through
+the PR 8 live-reshard command file (~0.2 s measured, BENCH_r06) instead
+of a ~90 s checkpoint-restart, so the planner can afford frequent small
+reallocation rounds. Every candidate change is gated on its actuation
+cost: expected gain over the round horizon must exceed the throughput
+lost while paused (``PolicyConfig.migration_min_gain``), with domain
+moves priced at the restart cost (cross-host state transfer is PR 8's
+open headroom, not yet in-place).
+
+``bench_sched.py`` drives these same policy functions through a
+deterministic cluster simulation (FIFO-gang baseline arm vs the full
+policy vs a contention-blind ablation); the measured curves land in
+``BENCH_r07.json`` and are ratcheted as the hard KT-PERF-SCHED family
+in ``analysis/perf.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kubeflow_tpu.obs import trace
+from kubeflow_tpu.obs.registry import REGISTRY
+
+# Workload classes in preemption-precedence order: under capacity
+# pressure the LAST class listed is evicted first. Serving scale-ups
+# preempt HPO trials before train jobs (ISSUE 11 / Gavel SLO policies).
+WORKLOAD_CLASSES = ("serving", "train", "hpo")
+
+# Collective-intensity priors folded from the PR 2 Tier-B collective
+# census (analysis/jaxpr_audit.audit_collectives): the declared per-step
+# collective plans -- ring attention rotates K/V via ppermute every step
+# (2 per step on the sequence mesh), ulysses reshards q/k/v/out through
+# 4 all_to_alls, plain DP carries one gradient all-reduce, flash/local
+# attention is compute-bound. Scores are 0..1 interconnect pressure.
+CENSUS_INTENSITY = {
+    "ring": 0.9,        # ppermute x2 per step: bandwidth-bound ring
+    "ulysses": 0.8,     # all_to_all x4: bisection-heavy
+    "allreduce": 0.6,   # DP gradient all-reduce once per step
+    "flash": 0.3,       # compute-bound, collective-light
+    "serving": 0.15,    # decode is latency- not bandwidth-bound
+    "none": 0.1,
+}
+
+# Job-spec annotations the classifier honors (metadata.annotations).
+ANN_COLLECTIVE_PROFILE = "kftpu.io/collective-profile"
+ANN_WORKLOAD_CLASS = "kftpu.io/workload-class"
+
+
+@dataclasses.dataclass(frozen=True)
+class Domain:
+    """One interconnect domain (an ICI pod / slice): jobs placed on the
+    same domain share its interconnect and contend on collectives."""
+
+    name: str
+    chips: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Placement:
+    domain: str
+    chips: int
+
+
+@dataclasses.dataclass
+class SchedJob:
+    """The scheduler's view of one job (spec + measured throughput)."""
+
+    key: str
+    tenant: str = "default"
+    weight: float = 1.0
+    workload: str = "train"          # one of WORKLOAD_CLASSES
+    min_chips: int = 1
+    max_chips: int = 1
+    collective_intensity: float = 0.1
+    arrival_seq: int = 0             # FIFO tiebreak (youngest = largest)
+    reshardable: bool = False        # ElasticPolicy.reshard_in_place
+    current: Optional[Placement] = None
+    # Measured solo tok/s per chip (the throughput model's scale); a
+    # prior until the first KFTPU-METRIC sample arrives.
+    tok_s_per_chip: float = 1000.0
+    # Latest measured aggregate tok/s (None = no sample yet).
+    measured_tok_s: Optional[float] = None
+
+
+@dataclasses.dataclass
+class Decision:
+    """One job's outcome for a scheduling round."""
+
+    job: str
+    action: str  # keep | admit | grow | shrink | migrate | preempt | queue
+    placement: Optional[Placement]
+    # Actuation price of this decision in seconds of paused throughput
+    # (0 for keep/admit/queue; measured reshard vs restart otherwise).
+    cost_seconds: float = 0.0
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class Plan:
+    decisions: List[Decision]
+    preemptions: int = 0
+    migrations: int = 0
+
+    @property
+    def placements(self) -> Dict[str, Optional[Placement]]:
+        return {d.job: d.placement for d in self.decisions}
+
+    def summary(self) -> str:
+        by_action: Dict[str, int] = {}
+        for d in self.decisions:
+            by_action[d.action] = by_action.get(d.action, 0) + 1
+        parts = [f"{a}={n}" for a, n in sorted(by_action.items())]
+        return " ".join(parts) or "empty"
+
+
+@dataclasses.dataclass
+class PolicyConfig:
+    """Knobs of the multi-tenant policy. ``contention_weight=0`` is the
+    contention-blind ablation arm (placement degrades to first-fit);
+    the physics coefficient ``contention_alpha`` is shared with the
+    bench simulator so policy and world agree on what contention costs."""
+
+    contention_weight: float = 1.0
+    contention_alpha: float = 0.8
+    # Actuation costs (seconds of paused throughput). reshard_seconds
+    # defaults to the worst measured BENCH_r06 transition; callers
+    # (bench, live loop) override with the current measured value.
+    reshard_seconds: float = 0.2
+    restart_seconds: float = 90.0
+    # A change must buy at least this multiple of its pause cost in
+    # extra tokens over the horizon, or the job keeps its placement.
+    migration_min_gain: float = 1.2
+    round_horizon_seconds: float = 60.0
+
+
+def contention_factor(own: float, others_sum: float,
+                      alpha: float = 0.8) -> float:
+    """Throughput multiplier for a job of collective intensity ``own``
+    sharing a domain with co-residents of summed intensity
+    ``others_sum``. 1.0 alone; two 0.9-intensity ring jobs co-located
+    each run at ~0.6x. The ONE definition both the policy's cost model
+    and the bench simulator use."""
+    return 1.0 / (1.0 + alpha * own * others_sum)
+
+
+def scale_efficiency(chips: int, kappa: float = 0.015) -> float:
+    """Mild sublinear scaling of one job across chips (collective
+    latency grows with participants)."""
+    return 1.0 / (1.0 + kappa * max(chips - 1, 0))
+
+
+def job_rate(job: SchedJob, chips: int, others_sum: float,
+             alpha: float = 0.8) -> float:
+    """Modeled tok/s for ``job`` at ``chips`` sharing a domain with
+    summed foreign intensity ``others_sum``."""
+    if chips <= 0:
+        return 0.0
+    return (job.tok_s_per_chip * chips * scale_efficiency(chips)
+            * contention_factor(job.collective_intensity, others_sum,
+                                alpha))
+
+
+def waterfill(demands: Sequence[Tuple[str, float, int, int]],
+              capacity: int) -> Dict[str, int]:
+    """Weighted max-min integer water-filling.
+
+    ``demands`` rows are (key, weight, min, max). Every key first gets
+    its min (caller guarantees sum(min) <= capacity -- preemption runs
+    before fairness); remaining chips go one at a time to the
+    unsaturated key with the smallest allocation/weight (stable key
+    order on ties), the discrete progressive-filling algorithm. The
+    result maximizes the minimum normalized allocation: no key can gain
+    without taking from a key at an equal-or-lower normalized share.
+    """
+    alloc = {k: mn for k, _, mn, _ in demands}
+    caps = {k: mx for k, _, _, mx in demands}
+    weights = {k: max(w, 1e-9) for k, w, _, _ in demands}
+    order = [k for k, _, _, _ in demands]
+    remaining = capacity - sum(alloc.values())
+    if remaining < 0:
+        raise ValueError(
+            f"waterfill: sum of minimums {sum(alloc.values())} exceeds "
+            f"capacity {capacity} (preempt first)"
+        )
+    while remaining > 0:
+        candidates = [k for k in order if alloc[k] < caps[k]]
+        if not candidates:
+            break
+        k = min(candidates, key=lambda k: (alloc[k] / weights[k],
+                                           order.index(k)))
+        alloc[k] += 1
+        remaining -= 1
+    return alloc
+
+
+def fair_shares(jobs: Sequence[SchedJob], capacity: int) -> Dict[str, int]:
+    """Two-level weighted max-min: chips across TENANTS by tenant
+    weight, then across each tenant's jobs by job weight. Tenant weight
+    is the max of its members' weights (one spec field, ``scheduling.
+    weight``, doubles as the tenant's share when tenants are 1:1 with
+    jobs -- the common case in tests and the bench)."""
+    by_tenant: Dict[str, List[SchedJob]] = {}
+    for j in jobs:
+        by_tenant.setdefault(j.tenant, []).append(j)
+    tenant_rows = []
+    for tenant in sorted(by_tenant):
+        members = by_tenant[tenant]
+        tenant_rows.append((
+            tenant,
+            max(m.weight for m in members),
+            sum(m.min_chips for m in members),
+            sum(m.max_chips for m in members),
+        ))
+    tenant_alloc = waterfill(tenant_rows, capacity)
+    alloc: Dict[str, int] = {}
+    for tenant in sorted(by_tenant):
+        members = by_tenant[tenant]
+        rows = [(m.key, m.weight, m.min_chips, m.max_chips)
+                for m in sorted(members, key=lambda m: m.key)]
+        alloc.update(waterfill(rows, tenant_alloc[tenant]))
+    return alloc
+
+
+def preemption_rank(job: SchedJob) -> Tuple[int, int]:
+    """Victim ordering under pressure: higher rank = evicted first.
+    HPO before train before serving; youngest-first within a class."""
+    try:
+        cls = WORKLOAD_CLASSES.index(job.workload)
+    except ValueError:
+        cls = WORKLOAD_CLASSES.index("train")
+    return (cls, job.arrival_seq)
+
+
+def select_preemptions(jobs: Sequence[SchedJob],
+                       capacity: int) -> List[str]:
+    """Minimum-demand overflow resolution: evict (queue) jobs in
+    ``preemption_rank`` order until the surviving minimums fit."""
+    total_min = sum(j.min_chips for j in jobs)
+    if total_min <= capacity:
+        return []
+    victims: List[str] = []
+    for j in sorted(jobs, key=preemption_rank, reverse=True):
+        victims.append(j.key)
+        total_min -= j.min_chips
+        if total_min <= capacity:
+            break
+    return victims
+
+
+def place(jobs: Sequence[SchedJob], alloc: Dict[str, int],
+          domains: Sequence[Domain],
+          config: PolicyConfig) -> Dict[str, Placement]:
+    """Assign each allocated job to ONE interconnect domain (slice
+    atomicity: a gang never straddles domains here).
+
+    Candidate layouts are built largest-allocation-first and compared by
+    (chips placed, lower pairwise contention cost, jobs kept in their
+    current domain) -- in that order, because the costs are ordered the
+    same way: an idle chip loses 100% of its throughput, a contended one
+    loses ~40%, and a domain move costs one ~90 s checkpoint-restart.
+
+    The default layout is STICKY: a job with a live placement keeps its
+    domain whenever its new chip count still fits there (a same-domain
+    resize is a ~0.2 s live reshard, so fairness re-allocations must not
+    cause re-placements as a side effect), and new jobs fill remaining
+    space steered by pairwise contention (own intensity x already-placed
+    intensity, scaled by ``contention_weight``; 0 = first-fit, the
+    contention-blind ablation). Only when a sticky layout strands an
+    allocated gang (fragmentation: total free chips suffice but no
+    single domain fits it) are full re-pack layouts considered --
+    stickiness yields to admission, and the migration gate in ``plan``
+    prices the resulting forced moves.
+    """
+    order = sorted(
+        (j for j in jobs if alloc.get(j.key, 0) > 0),
+        key=lambda j: (-alloc[j.key], j.key),
+    )
+    biggest = max(d.chips for d in domains)
+    dom_index = {d.name: i for i, d in enumerate(domains)}
+
+    def build(sticky: bool, weight: float):
+        free = {d.name: d.chips for d in domains}
+        load = {d.name: 0.0 for d in domains}  # summed placed intensity
+        pl: Dict[str, Placement] = {}
+        pair_cost = 0.0
+        loose: List[SchedJob] = []
+        if sticky:
+            for j in order:
+                chips = min(alloc[j.key], biggest)
+                if (j.current is not None and j.current.domain in free
+                        and free[j.current.domain] >= chips):
+                    pl[j.key] = Placement(j.current.domain, chips)
+                    free[j.current.domain] -= chips
+                    pair_cost += (j.collective_intensity
+                                  * load[j.current.domain])
+                    load[j.current.domain] += j.collective_intensity
+                else:
+                    loose.append(j)
+        else:
+            loose = list(order)
+        for j in loose:
+            chips = min(alloc[j.key], biggest)
+            fits = [d for d in domains if free[d.name] >= chips]
+            if not fits:
+                continue  # stays queued this round; capacity fragmented
+            best = min(fits, key=lambda d: (
+                weight * j.collective_intensity * load[d.name],
+                dom_index[d.name]))
+            pl[j.key] = Placement(best.name, chips)
+            free[best.name] -= chips
+            pair_cost += j.collective_intensity * load[best.name]
+            load[best.name] += j.collective_intensity
+        placed_chips = sum(p.chips for p in pl.values())
+        kept = sum(
+            1 for j in order
+            if j.current is not None and j.key in pl
+            and pl[j.key].domain == j.current.domain
+        )
+        return pl, (placed_chips, -pair_cost, kept)
+
+    w = config.contention_weight
+    layouts = [build(True, w)]
+    if w > 0:
+        layouts.append(build(True, 0.0))
+    best_pl, best_score = max(layouts, key=lambda t: t[1])
+    if len(best_pl) < len(order):
+        # A gang was stranded by fragmentation: let full re-packs
+        # compete (their forced moves get priced by the migration gate).
+        layouts.append(build(False, w))
+        if w > 0:
+            layouts.append(build(False, 0.0))
+        best_pl, best_score = max(layouts, key=lambda t: t[1])
+    return best_pl
+
+
+class MultiTenantPolicy:
+    """The full policy: preempt -> water-fill -> place -> gate each
+    change on its reshard/restart actuation cost."""
+
+    def __init__(self, domains: Sequence[Domain],
+                 config: Optional[PolicyConfig] = None) -> None:
+        self.domains = list(domains)
+        self.config = config or PolicyConfig()
+
+    @property
+    def capacity(self) -> int:
+        return sum(d.chips for d in self.domains)
+
+    def change_cost(self, job: SchedJob, new: Optional[Placement]) -> float:
+        """Seconds of paused throughput to actuate a placement change.
+        Same-domain chip-count changes ride the live-reshard path when
+        the job opted in (measured ~0.2 s); domain moves and
+        non-reshardable resizes pay the checkpoint-restart price."""
+        cur = job.current
+        if cur is None or new is None or cur == new:
+            return 0.0
+        if cur.domain == new.domain and job.reshardable:
+            return self.config.reshard_seconds
+        return self.config.restart_seconds
+
+    def plan(self, jobs: Sequence[SchedJob]) -> Plan:
+        cfg = self.config
+        jobs = sorted(jobs, key=lambda j: (j.arrival_seq, j.key))
+        victims = set(select_preemptions(jobs, self.capacity))
+        runnable = [j for j in jobs if j.key not in victims]
+        alloc = fair_shares(runnable, self.capacity)
+        placements = place(runnable, alloc, self.domains, cfg)
+
+        # Reshard-aware gating: revert changes whose expected token gain
+        # over the round horizon doesn't cover the actuation pause.
+        by_key = {j.key: j for j in jobs}
+        load: Dict[str, float] = {d.name: 0.0 for d in self.domains}
+        for k, p in placements.items():
+            load[p.domain] += by_key[k].collective_intensity
+        cur_load: Dict[str, float] = {d.name: 0.0 for d in self.domains}
+        for j in jobs:
+            if j.current is not None and j.current.domain in cur_load:
+                cur_load[j.current.domain] += j.collective_intensity
+        reverted: Dict[str, Placement] = {}
+        for j in runnable:
+            new = placements.get(j.key)
+            cur = j.current
+            if cur is None or new is None or new == cur:
+                continue
+            if new.domain == cur.domain and new.chips < cur.chips:
+                # A same-domain shrink is the water-filling taking chips
+                # back for someone else (fairness / an arriving SLO
+                # gang) -- never the job's own choice, so the gate must
+                # not let the job keep what the cluster reclaimed.
+                continue
+            cost = self.change_cost(j, new)
+            if cost <= 0.0:
+                continue
+            others_new = load.get(new.domain, 0.0) - j.collective_intensity
+            others_cur = (cur_load.get(cur.domain, 0.0)
+                          - j.collective_intensity)
+            new_rate = job_rate(j, new.chips, max(others_new, 0.0),
+                                cfg.contention_alpha)
+            cur_rate = job_rate(j, cur.chips, max(others_cur, 0.0),
+                                cfg.contention_alpha)
+            gain = (new_rate - cur_rate) * cfg.round_horizon_seconds
+            if gain < cost * new_rate * cfg.migration_min_gain:
+                reverted[j.key] = cur
+        if reverted:
+            # Keep reverted jobs where they are when their old slot is
+            # still free under the new layout; otherwise accept the move
+            # (the slot was given away -- staying put is not an option).
+            free = {d.name: d.chips for d in self.domains}
+            for k, p in placements.items():
+                if k not in reverted:
+                    free[p.domain] -= p.chips
+            for k, cur in sorted(reverted.items()):
+                if free.get(cur.domain, 0) >= cur.chips:
+                    placements[k] = cur
+                    free[cur.domain] -= cur.chips
+                else:
+                    new = placements[k]
+                    free[new.domain] -= new.chips
+
+        decisions: List[Decision] = []
+        preemptions = migrations = 0
+        for j in jobs:
+            if j.key in victims:
+                if j.current is not None:
+                    preemptions += 1
+                    decisions.append(Decision(
+                        j.key, "preempt", None,
+                        cost_seconds=cfg.restart_seconds,
+                        reason="minimum demand exceeds capacity; "
+                               f"{j.workload} evicted first",
+                    ))
+                else:
+                    decisions.append(Decision(j.key, "queue", None))
+                continue
+            new = placements.get(j.key)
+            cur = j.current
+            if new is None:
+                decisions.append(Decision(
+                    j.key, "preempt" if cur is not None else "queue",
+                    None,
+                    cost_seconds=cfg.restart_seconds if cur else 0.0,
+                    reason="no domain fits the allocation",
+                ))
+                if cur is not None:
+                    preemptions += 1
+            elif cur is None:
+                decisions.append(Decision(j.key, "admit", new))
+            elif new == cur:
+                decisions.append(Decision(j.key, "keep", new))
+            elif new.domain != cur.domain:
+                migrations += 1
+                decisions.append(Decision(
+                    j.key, "migrate", new,
+                    cost_seconds=self.change_cost(j, new),
+                    reason="contention-aware re-placement",
+                ))
+            else:
+                action = "grow" if new.chips > cur.chips else "shrink"
+                migrations += 1
+                decisions.append(Decision(
+                    j.key, action, new,
+                    cost_seconds=self.change_cost(j, new),
+                    reason="live reshard" if j.reshardable
+                           else "checkpoint-restart resize",
+                ))
+        return Plan(decisions, preemptions=preemptions,
+                    migrations=migrations)
+
+
+# --------------------------------------------------------------------------
+# Spec -> SchedJob classification (shared by the live loop and the CLI).
+# --------------------------------------------------------------------------
+def classify_workload(job) -> str:
+    """Workload class of a TrainJob: explicit ``priority_class`` on the
+    scheduling policy wins, then the ``kftpu.io/workload-class``
+    annotation, then the queue name, else train."""
+    sched = job.spec.run_policy.scheduling
+    pc = getattr(sched, "priority_class", None)
+    if pc in WORKLOAD_CLASSES:
+        return pc
+    ann = job.metadata.annotations.get(ANN_WORKLOAD_CLASS)
+    if ann in WORKLOAD_CLASSES:
+        return ann
+    if sched.queue in WORKLOAD_CLASSES:
+        return sched.queue
+    return "train"
+
+
+def classify_intensity(job) -> float:
+    """Collective intensity of a TrainJob from the census priors: the
+    ``kftpu.io/collective-profile`` annotation names a census row (or a
+    literal 0..1 float); otherwise the workload class prior applies
+    (multi-worker train jobs carry at least the DP all-reduce)."""
+    ann = job.metadata.annotations.get(ANN_COLLECTIVE_PROFILE)
+    if ann:
+        if ann in CENSUS_INTENSITY:
+            return CENSUS_INTENSITY[ann]
+        try:
+            return min(max(float(ann), 0.0), 1.0)
+        except ValueError:
+            pass
+    workload = classify_workload(job)
+    if workload == "serving":
+        return CENSUS_INTENSITY["serving"]
+    from kubeflow_tpu.api.types import ReplicaType
+
+    spec = job.spec.replica_specs.get(ReplicaType.Worker)
+    if workload == "train" and spec is not None and spec.replicas > 1:
+        return CENSUS_INTENSITY["allreduce"]
+    return CENSUS_INTENSITY["none"]
+
+
+def sched_job_from_spec(job, arrival_seq: int = 0,
+                        current: Optional[Placement] = None,
+                        measured_tok_s: Optional[float] = None) -> SchedJob:
+    """Build the scheduler's view of a TrainJob spec. ``current`` is the
+    live placement (domain + chips the gang holds); ``measured_tok_s``
+    the latest KFTPU-METRIC sample."""
+    from kubeflow_tpu.api.types import ReplicaType
+
+    sched = job.spec.run_policy.scheduling
+    spec = job.spec.replica_specs.get(ReplicaType.Worker)
+    per_worker = spec.resources.tpu if spec is not None else 0
+    replicas = spec.replicas if spec is not None else 0
+    el = job.spec.elastic
+    if el is not None:
+        min_chips = el.min_replicas * per_worker
+        max_chips = max(el.max_replicas, replicas) * per_worker
+    else:
+        min_chips = max_chips = replicas * per_worker
+    sj = SchedJob(
+        key=job.key,
+        tenant=getattr(sched, "tenant", None) or job.namespace,
+        weight=getattr(sched, "weight", 1.0),
+        workload=classify_workload(job),
+        min_chips=max(min_chips, 1 if max_chips else 0),
+        max_chips=max_chips,
+        collective_intensity=classify_intensity(job),
+        arrival_seq=arrival_seq,
+        reshardable=bool(el is not None and el.reshard_in_place),
+        current=current,
+    )
+    if measured_tok_s is not None and current is not None \
+            and current.chips > 0:
+        sj.measured_tok_s = measured_tok_s
+        sj.tok_s_per_chip = measured_tok_s / (
+            current.chips * scale_efficiency(current.chips))
+    return sj
+
+
+# --------------------------------------------------------------------------
+# Live loop: plans over the controller's store and actuates through the
+# reconciler's reshard-in-place / resize machinery.
+# --------------------------------------------------------------------------
+class ClusterScheduler:
+    """Periodic scheduling rounds against a live ``JobController``.
+
+    Each round (``sched.round`` span): collect jobs whose elastic policy
+    opted in (``scheduler_managed=True``) plus every other live job (for
+    capacity/contention modeling), read their measured tok/s, run the
+    policy, and actuate chip-count changes on managed jobs by setting the
+    runtime's ``resize_to`` -- the reconciler then routes the resize
+    through ``_initiate_reshard_in_place`` (live gang, no respawn) with
+    the checkpoint-restart fallback latched exactly as for metric-driven
+    resizes. Unmanaged jobs are modeled but never actuated: their own
+    metric scaler (gated off for managed jobs) stays the single writer,
+    so the two paths can never issue concurrent resizes for one job.
+    """
+
+    def __init__(self, controller, domains: Optional[Sequence[Domain]] = None,
+                 config: Optional[PolicyConfig] = None,
+                 throughput_metric: str = "tokens_per_sec") -> None:
+        self.controller = controller
+        self.domains = (list(domains) if domains
+                        else [Domain("d0", controller.gang.total_chips)])
+        self.policy = MultiTenantPolicy(self.domains, config)
+        self.throughput_metric = throughput_metric
+        self._arrival_seq: Dict[str, int] = {}
+        self._solo_baseline: Dict[str, float] = {}  # key -> tok/s/chip
+        self.rounds = 0
+
+    # -- collection -------------------------------------------------------
+
+    def _jobs(self) -> List[Tuple[str, "object"]]:
+        from kubeflow_tpu.controller.reconciler import JOB_KINDS
+        from kubeflow_tpu.api.types import TrainJob
+
+        out = []
+        for kind in JOB_KINDS:
+            for obj in self.controller.store.list(kind):
+                job = TrainJob.from_dict(obj)
+                if job.status.phase.value in ("Succeeded", "Failed",
+                                              "Suspended"):
+                    continue
+                out.append((kind, job))
+        return out
+
+    def collect(self) -> List[SchedJob]:
+        """Scheduler view of every live/pending job, with measured
+        throughput where the gang emits KFTPU-METRIC lines."""
+        from kubeflow_tpu.api.types import ReplicaType
+
+        jobs: List[SchedJob] = []
+        for kind, job in self._jobs():
+            seq = self._arrival_seq.setdefault(
+                job.key, len(self._arrival_seq))
+            rt = self.controller._runtimes.get(job.key)
+            current = None
+            measured = None
+            if rt is not None and rt.workers:
+                spec = job.spec.replica_specs.get(ReplicaType.Worker)
+                per_worker = spec.resources.tpu if spec else 0
+                workers = rt.formed_replicas or sum(
+                    1 for t, _ in rt.formed_world
+                    if t == ReplicaType.Worker.value)
+                current = Placement(self.domains[0].name,
+                                    workers * per_worker)
+                measured = self.controller._read_worker_metric(
+                    rt, self.throughput_metric)
+            sj = sched_job_from_spec(job, seq, current, measured)
+            if measured is not None and job.key not in self._solo_baseline:
+                # First sample = the solo baseline the goodput gauge
+                # normalizes against (the job was just formed; later
+                # samples reflect whatever contention it sits in).
+                self._solo_baseline[job.key] = sj.tok_s_per_chip
+            jobs.append(sj)
+        return jobs
+
+    # -- actuation --------------------------------------------------------
+
+    def _managed(self, job) -> bool:
+        el = job.spec.elastic
+        return bool(el is not None and el.scheduler_managed)
+
+    def run_round(self) -> Plan:
+        """One plan->actuate round. Must run on the controller's event
+        loop (it touches runtimes and the reconcile queue)."""
+        self.rounds += 1
+        with trace.span("sched.round", plane="controller",
+                        track="scheduler", round=self.rounds) as sp:
+            sched_jobs = self.collect()
+            plan = self.policy.plan(sched_jobs)
+            sp.annotate(jobs=len(sched_jobs), summary=plan.summary())
+            self._export_goodput(sched_jobs)
+            self._actuate(plan)
+        return plan
+
+    def _actuate(self, plan: Plan) -> None:
+        from kubeflow_tpu.api.types import ReplicaType, TrainJob
+
+        by_key = {}
+        for kind, job in self._jobs():
+            by_key[job.key] = (kind, job)
+        for dec in plan.decisions:
+            entry = by_key.get(dec.job)
+            if entry is None:
+                continue
+            kind, job = entry
+            if not self._managed(job):
+                continue  # modeled only; its own scaler is the writer
+            rt = self.controller._runtimes.get(dec.job)
+            if rt is None or not rt.workers:
+                continue
+            if dec.action not in ("grow", "shrink"):
+                continue
+            spec = job.spec.replica_specs.get(ReplicaType.Worker)
+            per_worker = spec.resources.tpu if spec else 1
+            target = max(dec.placement.chips // max(per_worker, 1), 1)
+            current = rt.formed_replicas or sum(
+                1 for t, _ in rt.formed_world
+                if t == ReplicaType.Worker.value)
+            if target == current or rt.resize_to is not None \
+                    or rt.reshard_pending is not None:
+                continue  # a resize is already in flight; never stack
+            with trace.span("sched.decision", plane="controller",
+                            track="scheduler", job=dec.job,
+                            action=dec.action, target=target,
+                            cost_s=dec.cost_seconds):
+                rt.resize_to = target
+                ns, name = dec.job.split("/", 1)
+                self.controller._enqueue(kind, ns, name)
+                REGISTRY.counter("kftpu_sched_migrations_total").inc()
+        if plan.preemptions:
+            REGISTRY.counter("kftpu_sched_preemptions_total").inc(
+                plan.preemptions)
+
+    def _export_goodput(self, jobs: Sequence[SchedJob]) -> None:
+        """Per-job normalized throughput (measured tok/s vs the solo
+        baseline at the current chip count): the ``kftpu_sched_goodput``
+        gauge serving /metrics and the fairness policies read."""
+        for j in jobs:
+            if j.measured_tok_s is None or j.current is None:
+                continue
+            base = self._solo_baseline.get(j.key, j.tok_s_per_chip)
+            solo = base * j.current.chips * scale_efficiency(
+                j.current.chips)
+            norm = j.measured_tok_s / solo if solo > 0 else 0.0
+            REGISTRY.gauge(
+                "kftpu_sched_goodput", {"job": j.key}
+            ).set(round(norm, 4))
+
+
+def jains_index(values: Sequence[float]) -> float:
+    """Jain's fairness index over normalized shares: 1.0 = perfectly
+    even, 1/n = one job has everything."""
+    vals = [v for v in values if v == v]
+    if not vals:
+        return 1.0
+    s = sum(vals)
+    sq = sum(v * v for v in vals)
+    if sq <= 0:
+        return 1.0
+    return (s * s) / (len(vals) * sq)
+
+
+def weighted_fairness_index(rates: Dict[str, float],
+                            weights: Dict[str, float]) -> float:
+    """Jain's index over weight-normalized service rates -- the bench's
+    fairness metric (1.0 when every tenant's goodput is proportional to
+    its weight)."""
+    return jains_index([
+        rates[k] / max(weights.get(k, 1.0), 1e-9) for k in sorted(rates)
+    ])
+
+
+def estimate_solo_rate(job: SchedJob, chips: Optional[int] = None) -> float:
+    """Contention-free modeled rate (the normalization denominator)."""
+    c = chips if chips is not None else (
+        job.current.chips if job.current else job.max_chips)
+    return job.tok_s_per_chip * c * scale_efficiency(c)
+
+
+__all__ = [
+    "ANN_COLLECTIVE_PROFILE", "ANN_WORKLOAD_CLASS", "CENSUS_INTENSITY",
+    "ClusterScheduler", "Decision", "Domain", "MultiTenantPolicy",
+    "Placement", "Plan", "PolicyConfig", "SchedJob", "WORKLOAD_CLASSES",
+    "classify_intensity", "classify_workload", "contention_factor",
+    "estimate_solo_rate", "fair_shares", "jains_index", "job_rate",
+    "place", "preemption_rank", "scale_efficiency", "sched_job_from_spec",
+    "select_preemptions", "waterfill", "weighted_fairness_index",
+]

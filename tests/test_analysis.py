@@ -432,6 +432,7 @@ def test_perf_shipped_baseline_passes_shipped_artifacts():
     assert any(k.startswith("serving.tok_s.slots") for k in measured)
     assert any(k.startswith("fleet.") for k in measured)
     assert any(k.startswith("reshard.") for k in measured)
+    assert any(k.startswith("sched.") for k in measured)
 
 
 def test_perf_planted_mfu_regression_exits_one(monkeypatch, capsys, tmp_path):
@@ -458,6 +459,19 @@ def test_perf_planted_serving_regression_exits_one(monkeypatch, capsys,
                        ["--strict", "--json", "--perf-baseline", str(p)])
     assert rc == 1
     assert any(f["rule"] == "KT-PERF-TOKS"
+               for f in json.loads(out)["new"])
+
+
+def test_perf_planted_sched_regression_exits_one(monkeypatch, capsys,
+                                                 tmp_path):
+    bad = analysis.load_perf_baseline()
+    bad["sched"]["goodput_vs_fifo_floor"] = 99.0
+    p = tmp_path / "perf.json"
+    p.write_text(json.dumps(bad))
+    rc, out = _run_cli(monkeypatch, capsys, [], {},
+                       ["--strict", "--json", "--perf-baseline", str(p)])
+    assert rc == 1
+    assert any(f["rule"] == "KT-PERF-SCHED" and f["hard"]
                for f in json.loads(out)["new"])
 
 

@@ -48,6 +48,7 @@ class TestGeometry:
 
 
 class TestTraining:
+    @pytest.mark.slow
     def test_bert_mlm_decreases_loss_sharded(self):
         task = get_task("bert", preset="bert-tiny", batch_size=8,
                         seq_len=32, lr=3e-3)
@@ -62,6 +63,7 @@ class TestTraining:
                 losses.append(float(m["loss"]))
         assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses[::8]
 
+    @pytest.mark.slow
     def test_vit_learns_synthetic_signal_sharded(self):
         task = get_task("vit", preset="vit-tiny", batch_size=16, lr=3e-3)
         mesh = build_mesh(MeshConfig(data=-1, fsdp=2, tensor=2))

@@ -269,6 +269,7 @@ print("done", flush=True)
     asyncio.run(run())
 
 
+@pytest.mark.slow
 def test_hang_detection_catches_nonprogress_spam(tmp_path):
     """SURVEY.md 5.3 step heartbeats: a worker spinning in a warning loop
     keeps its log mtime fresh forever -- mtime-based liveness would never

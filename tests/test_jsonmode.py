@@ -145,6 +145,7 @@ class TestEngineConstrained:
         obj = json.loads(bytes(out).decode())
         assert isinstance(obj, dict)
 
+    @pytest.mark.slow
     def test_unconstrained_greedy_token_identical(self, engine):
         """The verdict's contract: adding the feature must not move the
         unconstrained path -- same seed, fresh engine, no constraint ->

@@ -51,6 +51,7 @@ class TestModel:
             np.asarray(logits1[:, -1]), np.asarray(logits2[:, -1])
         )
 
+    @pytest.mark.slow
     def test_scan_equals_unrolled(self):
         """nn.scan over layers must compute the same function as a loop."""
         cfg = LlamaConfig(
@@ -208,6 +209,7 @@ def test_chunked_loss_train_step_runs():
     assert float(m["loss"]) == float(m["loss"])  # not NaN
 
 
+@pytest.mark.slow
 def test_chunked_cross_entropy_moe():
     import jax
 
@@ -220,6 +222,7 @@ def test_chunked_cross_entropy_moe():
     assert float(m["loss"]) == float(m["loss"])  # not NaN
 
 
+@pytest.mark.slow
 def test_chunked_loss_on_pipelined_mesh():
     """loss_chunk must also apply on pipe>1 meshes (the long-sequence
     memory knob must not silently drop on the pipelined path)."""

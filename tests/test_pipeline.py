@@ -100,11 +100,13 @@ class TestPipelinedLlama:
         assert abs(pp[0] - ref[0]) < 0.02, (pp, ref)
         assert abs(pp[1] - ref[1]) < 0.05, (pp, ref)
 
+    @pytest.mark.slow
     def test_pipe_composes_with_tensor(self):
         ref = self._one_step(MeshConfig(data=-1))
         pp = self._one_step(MeshConfig(data=-1, pipe=2, tensor=2))
         assert abs(pp[0] - ref[0]) < 0.02, (pp, ref)
 
+    @pytest.mark.slow
     def test_pipe_composes_with_sequence(self):
         """Ring attention's own shard_map cannot nest inside the manual
         pipe region; auto dispatch must fall back to GSPMD attention

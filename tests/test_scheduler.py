@@ -1,0 +1,415 @@
+"""Multi-tenant cluster scheduler (controller/scheduler.py).
+
+Policy units (water-filling, preemption ordering, contention-aware
+placement, the migration gate), the live ClusterScheduler loop driving a
+shrink through the reshard-in-place path with zero restarts, the
+scheduler_managed gate that keeps the metric scaler and the cluster
+scheduler from issuing concurrent resizes, the sched observability
+surface (gauges + spans), and the KT-PERF-SCHED ratchet's honesty
+checks against planted artifacts.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from kubeflow_tpu.controller.scheduler import (
+    Domain,
+    MultiTenantPolicy,
+    Placement,
+    PolicyConfig,
+    SchedJob,
+    fair_shares,
+    preemption_rank,
+    select_preemptions,
+    waterfill,
+)
+
+from test_controller import Harness, make_job
+
+
+def sj(key, *, tenant="t", weight=1.0, workload="train", mn=1, mx=8,
+       intensity=0.5, seq=0, reshardable=False, current=None):
+    return SchedJob(
+        key=key, tenant=tenant, weight=weight, workload=workload,
+        min_chips=mn, max_chips=mx, collective_intensity=intensity,
+        arrival_seq=seq, reshardable=reshardable, current=current,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Water-filling fairness.
+# ---------------------------------------------------------------------------
+
+class TestWaterfill:
+    def test_uneven_weights_split_proportionally(self):
+        # Progressive filling equalizes alloc/weight: 3/1/1 over 10
+        # chips lands 6/2/2 (each at a normalized share of 2).
+        alloc = waterfill(
+            [("a", 3.0, 0, 10), ("b", 1.0, 0, 10), ("c", 1.0, 0, 10)], 10)
+        assert alloc == {"a": 6, "b": 2, "c": 2}
+
+    def test_minimums_and_caps_respected(self):
+        alloc = waterfill([("a", 1.0, 4, 4), ("b", 1.0, 1, 16)], 10)
+        assert alloc == {"a": 4, "b": 6}
+
+    def test_over_committed_minimums_raise(self):
+        with pytest.raises(ValueError):
+            waterfill([("a", 1.0, 6, 8), ("b", 1.0, 6, 8)], 8)
+
+    def test_two_level_tenant_then_job(self):
+        # Tenant acme (weight 2) vs beta (weight 1): acme's two jobs
+        # split acme's 2/3 share evenly; beta's single job gets the rest.
+        jobs = [
+            sj("acme/j1", tenant="acme", weight=2.0, mn=0, mx=12),
+            sj("acme/j2", tenant="acme", weight=2.0, mn=0, mx=12),
+            sj("beta/j1", tenant="beta", weight=1.0, mn=0, mx=12),
+        ]
+        alloc = fair_shares(jobs, 12)
+        assert alloc["acme/j1"] + alloc["acme/j2"] == 8
+        assert alloc["beta/j1"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Preemption ordering: hpo before train before serving, youngest first.
+# ---------------------------------------------------------------------------
+
+class TestPreemption:
+    def test_rank_orders_classes(self):
+        assert preemption_rank(sj("s", workload="serving")) \
+            < preemption_rank(sj("t", workload="train")) \
+            < preemption_rank(sj("h", workload="hpo"))
+
+    def test_hpo_evicted_before_train_before_serving(self):
+        jobs = [
+            sj("t/serve", workload="serving", mn=4, seq=0),
+            sj("t/train", workload="train", mn=4, seq=1),
+            sj("t/hpo", workload="hpo", mn=4, seq=2),
+        ]
+        assert select_preemptions(jobs, 8) == ["t/hpo"]
+        assert select_preemptions(jobs, 4) == ["t/hpo", "t/train"]
+        assert select_preemptions(jobs, 12) == []
+
+    def test_youngest_within_class_goes_first(self):
+        jobs = [
+            sj("t/h-old", workload="hpo", mn=4, seq=0),
+            sj("t/h-new", workload="hpo", mn=4, seq=1),
+        ]
+        assert select_preemptions(jobs, 4) == ["t/h-new"]
+
+
+# ---------------------------------------------------------------------------
+# Contention-aware placement.
+# ---------------------------------------------------------------------------
+
+class TestPlacement:
+    DOMAINS = [Domain("d0", 8), Domain("d1", 8)]
+
+    def test_aware_separates_two_heavy_jobs(self):
+        # Two ring-heavy 4-chip gangs with an empty second domain: the
+        # contention-aware policy keeps them apart; the blind ablation
+        # (contention_weight=0) first-fits both into d0.
+        jobs = [sj("t/a", intensity=0.85, mn=4, mx=4, seq=0),
+                sj("t/b", intensity=0.85, mn=4, mx=4, seq=1)]
+        aware = MultiTenantPolicy(self.DOMAINS).plan(jobs).placements
+        assert {aware["t/a"].domain, aware["t/b"].domain} == {"d0", "d1"}
+        blind = MultiTenantPolicy(
+            self.DOMAINS, PolicyConfig(contention_weight=0.0)
+        ).plan(jobs).placements
+        assert blind["t/a"].domain == blind["t/b"].domain == "d0"
+
+    def test_mandated_shrink_is_never_gated(self):
+        # A running 8-chip reshardable job loses half its chips to an
+        # arriving gang: the same-domain shrink is the water-filling
+        # reclaiming capacity, so the migration gate must not revert it
+        # (reverting would deadlock the arrival behind held chips).
+        jobs = [
+            sj("t/a", mn=2, mx=8, seq=0, reshardable=True,
+               current=Placement("d0", 8)),
+            sj("t/b", mn=4, mx=4, seq=1),
+        ]
+        plan = MultiTenantPolicy([Domain("d0", 8)]).plan(jobs)
+        by = {d.job: d for d in plan.decisions}
+        assert by["t/a"].action == "shrink"
+        assert by["t/a"].placement.chips == 4
+        # The shrink rides the live-reshard path, priced as such.
+        assert by["t/a"].cost_seconds == pytest.approx(
+            PolicyConfig().reshard_seconds)
+        assert by["t/b"].action == "admit"
+        assert by["t/b"].placement.chips == 4
+
+    def test_sticky_resize_stays_in_domain(self):
+        # A fairness re-allocation must not move a gang between domains
+        # as a side effect: same-domain resize is ~0.2s, a move is ~90s.
+        jobs = [
+            sj("t/a", mn=2, mx=16, seq=0, reshardable=True,
+               current=Placement("d1", 4)),
+            sj("t/b", mn=4, mx=4, seq=1),
+        ]
+        plan = MultiTenantPolicy(self.DOMAINS).plan(jobs)
+        placed = plan.placements
+        assert placed["t/a"].domain == "d1"
+        assert placed["t/a"].chips > 4  # grew in place
+
+
+# ---------------------------------------------------------------------------
+# Live loop: scheduler-driven shrink rides reshard-in-place, zero
+# restarts, and the freed chips admit the queued gang.
+# ---------------------------------------------------------------------------
+
+def _managed_job(tmp_path, name="mtj", replicas=6, **el_kw):
+    from kubeflow_tpu.api import ElasticPolicy
+    from kubeflow_tpu.api.types import CheckpointPolicy
+
+    return make_job(
+        name, replicas=replicas, tpu=1,
+        checkpoint=CheckpointPolicy(dir=str(tmp_path / "ck")),
+        elastic=ElasticPolicy(
+            min_replicas=2, max_replicas=8, max_restarts=5,
+            reshard_in_place=True, reshard_timeout_seconds=2.0,
+            scheduler_managed=True, **el_kw,
+        ),
+    )
+
+
+class TestClusterScheduler:
+    def test_sched_shrink_resharding_admits_queued_gang(self, tmp_path):
+        async def run():
+            from kubeflow_tpu.controller import ClusterScheduler
+
+            async with Harness(total_chips=8) as h:
+                def metric(rt, m):
+                    return {"tokens_per_sec": 5400.0, "reshard_seq": 1.0,
+                            "reshard_ok": 1.0,
+                            "reshard_seconds": 0.19}.get(m)
+
+                h.ctl._read_worker_metric = metric
+                h.submit(_managed_job(tmp_path))
+                await h.wait_phase("mtj", "Running")
+                spawned_mtj = len([r for r in h.launcher.spawned
+                                   if r.job_key == "default/mtj"])
+                h.submit(make_job("arrival", replicas=4, tpu=1))
+                await h.wait(
+                    lambda: "default/arrival" in h.gang.pending(),
+                    msg="arrival queued behind the 6-chip gang",
+                )
+                sched = ClusterScheduler(h.ctl)
+                plan = sched.run_round()
+                by = {d.job: d for d in plan.decisions}
+                assert by["default/mtj"].action == "shrink"
+                # The shrink actuates through the LIVE reshard path and
+                # the reclaimed chips admit the queued arrival.
+                await h.wait(
+                    lambda: (lambda j: j is not None
+                             and j.status.formed_replicas == 4)(
+                                 h.job("mtj")),
+                    msg="scheduler-driven in-place shrink to 4",
+                )
+                await h.wait_phase("arrival", "Running")
+                reasons = [
+                    e["reason"] for e in h.store.list("Event")
+                    if e.get("involved") == "default/mtj"
+                ]
+                assert "ReshardInPlace" in reasons, reasons
+                assert "ReshardComplete" in reasons, reasons
+                assert "ElasticMetricResize" not in reasons, reasons
+                # No teardown, no re-spawn, no restart.
+                assert len([r for r in h.launcher.spawned
+                            if r.job_key == "default/mtj"]) == spawned_mtj
+                assert h.job("mtj").status.restart_count == 0
+                assert h.gang.free_chips == 0  # 4 + 4 on 8
+
+        asyncio.run(run())
+
+    def test_nack_falls_back_to_checkpoint_restart(self, tmp_path):
+        async def run():
+            from kubeflow_tpu.controller import ClusterScheduler
+
+            async with Harness(total_chips=8) as h:
+                def metric(rt, m):
+                    return {"tokens_per_sec": 5400.0, "reshard_seq": 1.0,
+                            "reshard_ok": 0.0}.get(m)
+
+                h.ctl._read_worker_metric = metric
+                h.submit(_managed_job(tmp_path))
+                await h.wait_phase("mtj", "Running")
+                h.submit(make_job("arrival", replicas=4, tpu=1))
+                await h.wait(
+                    lambda: "default/arrival" in h.gang.pending(),
+                    msg="arrival queued",
+                )
+                ClusterScheduler(h.ctl).run_round()
+                await h.wait(
+                    lambda: (lambda j: j is not None
+                             and j.status.formed_replicas == 4)(
+                                 h.job("mtj")),
+                    msg="fallback resize to 4",
+                )
+                reasons = [
+                    e["reason"] for e in h.store.list("Event")
+                    if e.get("involved") == "default/mtj"
+                ]
+                assert "ReshardFallback" in reasons, reasons
+                # The teardown-path resize event names the scheduler as
+                # the driver (there is no metric on this policy).
+                msgs = [
+                    e["message"] for e in h.store.list("Event")
+                    if e.get("involved") == "default/mtj"
+                    and e["reason"] == "ElasticMetricResize"
+                ]
+                assert msgs and "cluster scheduler" in msgs[0], msgs
+                await h.wait_phase("arrival", "Running")
+
+        asyncio.run(run())
+
+    def test_scheduler_managed_gates_metric_scaler(self, tmp_path):
+        # scheduler_managed cedes resize authority: the metric scaler
+        # must never arm for such a job even with a metric configured,
+        # so the two writers cannot issue concurrent resizes.
+        async def run():
+            async with Harness(total_chips=8) as h:
+                h.ctl._read_worker_metric = (
+                    lambda rt, m: {"queue_depth": 400.0}.get(m))
+                h.submit(_managed_job(
+                    tmp_path, replicas=2,
+                    metric="queue_depth", target_value=100.0,
+                    metric_poll_seconds=0.05,
+                ))
+                await h.wait_phase("mtj", "Running")
+                rt = h.ctl._runtimes["default/mtj"]
+                assert not rt.metrics_armed
+                # Several poll intervals: a ceil(2*4)=8 resize would
+                # have landed by now if the scaler were armed.
+                await asyncio.sleep(0.3)
+                assert h.job("mtj").status.formed_replicas in (None, 2)
+                assert rt.resize_to is None and rt.reshard_pending is None
+                reasons = [
+                    e["reason"] for e in h.store.list("Event")
+                    if e.get("involved") == "default/mtj"
+                ]
+                assert "ReshardInPlace" not in reasons, reasons
+                assert "ElasticMetricResize" not in reasons, reasons
+
+        asyncio.run(run())
+
+    def test_round_exports_gauges_and_spans(self, tmp_path):
+        async def run():
+            from kubeflow_tpu.controller import ClusterScheduler
+            from kubeflow_tpu.obs import trace
+            from kubeflow_tpu.obs.registry import REGISTRY
+
+            trace.reset()
+            trace.configure(enabled=True, plane="controller", label="test")
+            try:
+                async with Harness(total_chips=8) as h:
+                    def metric(rt, m):
+                        return {"tokens_per_sec": 5400.0,
+                                "reshard_seq": 1.0, "reshard_ok": 1.0,
+                                "reshard_seconds": 0.19}.get(m)
+
+                    h.ctl._read_worker_metric = metric
+                    h.submit(_managed_job(tmp_path))
+                    await h.wait_phase("mtj", "Running")
+                    h.submit(make_job("arrival", replicas=4, tpu=1))
+                    await h.wait(
+                        lambda: "default/arrival" in h.gang.pending(),
+                        msg="arrival queued",
+                    )
+                    before = REGISTRY.counter(
+                        "kftpu_sched_migrations_total").value
+                    ClusterScheduler(h.ctl).run_round()
+                    lines = REGISTRY.expose()
+                    assert any(
+                        line.startswith("kftpu_sched_goodput")
+                        and 'job="default/mtj"' in line for line in lines
+                    ), lines
+                    assert REGISTRY.counter(
+                        "kftpu_sched_migrations_total").value == before + 1
+                    names = [e[1] for e in trace.recorder().snapshot()]
+                    assert "sched.round" in names
+                    assert "sched.decision" in names
+            finally:
+                trace.reset()
+
+        asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# KT-PERF-SCHED ratchet honesty: planted artifacts must trip the gate.
+# ---------------------------------------------------------------------------
+
+SCHED_BASE = {
+    "goodput_vs_fifo_floor": 1.3,
+    "contention_gain_floor": 1.05,
+    "fairness_index_floor": 0.85,
+    "require_measured_migration_cost": True,
+}
+
+
+def _write_bench(tmp_path, sched, n=1):
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+        "parsed": {"extra": {"sched": sched}},
+    }))
+
+
+def _check(tmp_path):
+    from kubeflow_tpu.analysis.perf import check_perf
+
+    return check_perf({"sched": SCHED_BASE}, root=str(tmp_path))
+
+
+class TestSchedRatchet:
+    GOOD = {
+        "goodput_vs_fifo": 1.42, "contention_gain": 1.19,
+        "fairness_index": 0.96,
+        "migration": {"reshard_seconds_used": 0.185,
+                      "cost_source": "BENCH_r00.json"},
+    }
+
+    def _reshard_artifact(self, tmp_path):
+        (tmp_path / "BENCH_r00.json").write_text(json.dumps({
+            "parsed": {"extra": {"reshard": [
+                {"transition": "re-split", "reshard_seconds": 0.185},
+            ]}},
+        }))
+
+    def test_good_artifact_passes(self, tmp_path):
+        self._reshard_artifact(tmp_path)
+        _write_bench(tmp_path, self.GOOD)
+        findings, measured = _check(tmp_path)
+        assert findings == [], [f.message for f in findings]
+        assert measured["sched.goodput_vs_fifo"] == 1.42
+
+    def test_goodput_regression_is_hard_finding(self, tmp_path):
+        self._reshard_artifact(tmp_path)
+        _write_bench(tmp_path, dict(self.GOOD, goodput_vs_fifo=1.1))
+        findings, _ = _check(tmp_path)
+        assert any(f.rule == "KT-PERF-SCHED" and f.hard
+                   and "goodput_vs_fifo" in f.message for f in findings)
+
+    def test_missing_metric_is_hard_finding(self, tmp_path):
+        self._reshard_artifact(tmp_path)
+        bad = dict(self.GOOD)
+        bad.pop("fairness_index")
+        _write_bench(tmp_path, bad)
+        findings, _ = _check(tmp_path)
+        assert any("fairness_index" in f.message and f.hard
+                   for f in findings)
+
+    def test_unmeasured_migration_cost_is_hard_finding(self, tmp_path):
+        # The sim claiming a flattering migration price (or no source at
+        # all) is exactly the dishonesty the ratchet exists to catch.
+        self._reshard_artifact(tmp_path)
+        bad = dict(self.GOOD)
+        bad.pop("migration")
+        _write_bench(tmp_path, bad)
+        findings, _ = _check(tmp_path)
+        assert any("cost_source" in f.message for f in findings)
+
+        _write_bench(tmp_path, dict(
+            self.GOOD,
+            migration={"reshard_seconds_used": 0.01,
+                       "cost_source": "made-up"}), n=2)
+        findings, _ = _check(tmp_path)
+        assert any("drifted" in f.message for f in findings)

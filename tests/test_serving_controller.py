@@ -948,6 +948,7 @@ def test_explainer_component_end_to_end(cp_client, tmp_path):
     loop.run_until_complete(run())
 
 
+@pytest.mark.slow
 def test_jax_embed_isvc_end_to_end(cp_client):
     """jax-embed ISVC -> BERT-encoder replica -> OpenAI /v1/embeddings
     through the activator (S5 delta: the embeddings serving tier)."""

@@ -283,6 +283,7 @@ class TestTensorParallelServing:
             p, max_new_tokens=12
         )
 
+    @pytest.mark.slow
     def test_tp_continuous_batching_mixed_slots(self):
         """Concurrent requests through the sharded engine: slot admission,
         decode blocks, and finish/reuse all work over the mesh."""
@@ -305,6 +306,7 @@ class TestTensorParallelServing:
         for r, o in zip(reqs, outs):
             assert base.generate(r.prompt, max_new_tokens=6) == o
 
+    @pytest.mark.slow
     def test_tp_chunked_prefill_identical(self):
         """Chunked prefill composes with tensor parallelism: the TP
         engine's chunk scatter/gather over the KV-sharded cache must
@@ -327,6 +329,7 @@ class TestTensorParallelServing:
         with pytest.raises(ValueError, match="divide"):
             GenerationEngine(config=cfg, tensor_parallel=4)
 
+    @pytest.mark.slow
     def test_tp_prefix_cache_token_exact(self):
         """Prefix restore/extract over the KV-sharded cache: GSPMD must
         carry the stored prefix's sharding through scatter/gather with
@@ -345,6 +348,7 @@ class TestTensorParallelServing:
                 tp.generate(list(p), max_new_tokens=6)
         assert tp.prefix_cache.hits >= 1  # second prompt restored
 
+    @pytest.mark.slow
     def test_tp_speculative_token_exact(self):
         from kubeflow_tpu.serving.engine import make_tp_mesh
 
@@ -359,6 +363,7 @@ class TestTensorParallelServing:
 
 
 class TestShardedCheckpointRestore:
+    @pytest.mark.slow
     def test_orbax_restore_lands_sharded_and_serves(self, tmp_path):
         """8B-on-v5e-4 memory path (jax_llm_server._restore_sharded):
         checkpoint leaves must restore DIRECTLY sharded over the TP mesh
@@ -491,6 +496,7 @@ class TestChunkedPrefill:
         a2 = eng.generate([50, 60, 70], max_new_tokens=5)
         assert a1 == a2
 
+    @pytest.mark.slow
     def test_fused_mixed_batch_token_exact(self, tiny):
         """The fused chunk+decode program must not perturb either side:
         a short request decoding WHILE a long prompt prefills (mixed
@@ -557,6 +563,7 @@ class TestSampling:
                              temperature=1.0, top_k=1)
         assert topk1 == greedy  # k=1 truncates to the argmax
 
+    @pytest.mark.slow
     def test_tiny_top_p_equals_greedy(self, tiny):
         cfg, _, _, params = tiny
         eng = GenerationEngine(config=cfg, params=params, max_slots=2)
@@ -702,6 +709,7 @@ class TestPrefixCache:
         assert eng.generate(p1, max_new_tokens=6) == ref1
         assert eng.prefix_cache.hits > hits_before
 
+    @pytest.mark.slow
     def test_capture_deduped_and_growing_prefix_recaptured(self, tiny):
         cfg, _, _, params = tiny
         eng = GenerationEngine(config=cfg, params=params, max_slots=2,
@@ -765,6 +773,7 @@ class TestSpeculativeDecoding:
         # Every step emits at least the bonus token.
         assert spec.spec_emitted >= spec.spec_steps
 
+    @pytest.mark.slow
     def test_concurrent_slots_match_solo(self, tiny):
         cfg, _, _, params = tiny
         plain = GenerationEngine(config=cfg, params=params, max_slots=4)
@@ -858,6 +867,7 @@ class TestDecodeAttentionKernel:
                     np.testing.assert_allclose(out[b, kv, g], ref,
                                                atol=1e-5, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_engine_tokens_identical_with_kernel(self, tiny):
         """The kernelized decode path must not change a token vs the XLA
         full-span path (greedy, f32)."""
@@ -1024,6 +1034,7 @@ class TestQuantizedServing:
             GenerationEngine(config=cfg, params=params, quantize="fp4")
 
 
+@pytest.mark.slow
 def test_llm_model_quantize_option_plumbed():
     """ModelSpec.options.quantize reaches the engine (the serving-layer
     switch for int8 variants, reference S5 delta)."""
@@ -1105,6 +1116,7 @@ class TestKVQuantized:
         ref = np.asarray(eng._prefill(toks, len(seq))[0][0], np.float32)
         assert ref[out[-1]] >= ref.max() - 1e-1
 
+    @pytest.mark.slow
     def test_repeatable_and_tiers_compose(self, tiny):
         cfg, _, _, params = tiny
         eng = GenerationEngine(config=cfg, params=params, max_slots=2,
@@ -1165,6 +1177,7 @@ class TestKVQuantized:
         with pytest.raises(ValueError, match="kv_quant"):
             GenerationEngine(config=cfg, params=params, kv_quant="fp8")
 
+    @pytest.mark.slow
     def test_int8_kernel_matches_xla_path(self, tiny):
         """decode_attn_kernel under kv_quant routes to the int8 Pallas
         kernel (int8 DMA + VMEM dequant); its tokens must match the XLA
@@ -1241,6 +1254,7 @@ class TestDispatchPipeline:
         assert recs[1] == recs[0]  # byte-identical record ordering
         assert chained[0] == 0 and chained[1] > 0
 
+    @pytest.mark.slow
     def test_depth1_identical_spec_path(self, tiny):
         """A spec-eligible batch drains the pipeline (the chained block
         can't speculate); streams AND acceptance stats must match."""
@@ -1256,6 +1270,7 @@ class TestDispatchPipeline:
         assert got[1] == got[0]
         assert got[1][1] > 0  # the spec path actually ran
 
+    @pytest.mark.slow
     def test_midflight_finish_drains_and_slot_reuse_clean(self, tiny):
         """EOS lands mid-block while a chained block is in flight: the
         in-flight block must drain (overshoot discarded whole), the
@@ -1282,6 +1297,7 @@ class TestDispatchPipeline:
         assert got[0][0][0][-1] == eos  # the EOS really fired mid-run
         assert got[1][2] >= got[0][2] >= 0
 
+    @pytest.mark.slow
     def test_cancelled_future_midstream_does_not_corrupt_batch(self, tiny):
         """Cancelling one request's future mid-decode (stop_fn raising /
         consumer walking away) must not perturb the other lanes under
@@ -1328,6 +1344,7 @@ class TestDispatchPipeline:
         eng._dispatch_chained = counted
         return box
 
+    @pytest.mark.slow
     def test_depthN_identical_to_depth0_mixed_batch(self, tiny):
         """Depth 2 and 4 with a saturated mixed batch -- greedy, top-k,
         top-p, logprobs -- must be bit-identical to depth 0, and the
@@ -1359,6 +1376,7 @@ class TestDispatchPipeline:
             assert outs[d] == outs[0]
             assert recs[d] == recs[0]
 
+    @pytest.mark.slow
     def test_depthN_identical_spec_path(self, tiny):
         """Speculative decoding under a deep pipeline: streams AND
         acceptance stats must match depth 0 exactly."""
@@ -1434,6 +1452,7 @@ class TestDispatchPipeline:
         assert [f.rule for f in findings] == ["KT-PERF-CEIL"]
         assert all(f.hard for f in findings)
 
+    @pytest.mark.slow
     def test_vectorized_emission_matches_per_token_path(self, tiny):
         """A no-op stop_fn forces the per-token emission loop; without
         it the vectorized fast path runs. Same engine config, greedy:
@@ -1452,6 +1471,7 @@ class TestDispatchPipeline:
         fast, slow = run(False), run(True)
         assert fast == slow
 
+    @pytest.mark.slow
     def test_streaming_order_and_counts_under_pipeline(self, tiny):
         """on_token callbacks fire for every token in stream order in
         both depths (emission happens at the consume, never between two

@@ -977,6 +977,7 @@ class TestOptionalRuntimeHappyPaths:
             m.load()
 
 
+@pytest.mark.slow
 def test_stream_pacing_smooths_bursts():
     """Client-paced streaming (r4 verdict #3): block decode delivers
     tokens in dispatch bursts; the SSE drain re-times them at the
