@@ -1,25 +1,38 @@
-"""JAX-aware static analysis: AST lint + trace-time jaxpr audits.
+"""JAX-aware static analysis: AST lint, jaxpr audits, race + protocol
+checks.
 
-Two tiers, one ratcheted baseline (docs/ANALYSIS.md has the full rule
-catalog and workflow):
+Three tiers, one ratcheted baseline (docs/ANALYSIS.md has the full
+rule catalog and workflow):
 
 - Tier A (`astlint`): pure-AST rules over the package source -- host
   syncs under jit, tracer branching, silent exception swallows, mutable
-  defaults, missing donation, unused imports.
+  defaults, missing donation, unused imports, non-unique os.replace
+  staging names.
 - Tier B (`jaxpr_audit`): traces the real train steps (mnist / llama /
   bert / vit) and the serving engine's prefill / decode / insert on the
   CPU backend, asserting donation consumption, bf16-region upcast
   ceilings, shard_map collective counts, and zero steady-state
   recompiles.
+- Tier C (`racecheck` + `protocheck`): lock-discipline race detection
+  over the real threaded modules under a contended stress driver
+  (KT-RACE-ORDER / KT-GUARD01), and exhaustive small-scope model
+  checking of the control-plane protocols -- reshard command/ack, gang
+  lifecycle, single-writer rule -- with conformance replay against the
+  real command-file code (KT-PROTO-*).
 
-`kftpu analyze --strict` is the CI gate: exit 0 iff nothing regressed
-vs the committed `baseline.json`.
+Families (``kftpu analyze --only <family>``): astlint | audit | perf |
+race | proto. `kftpu analyze --strict` is the CI gate: exit 0 iff
+nothing regressed vs the committed `baseline.json`.
 """
 
 import logging
 import os
 import sys
-from typing import Dict, List, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Registered analysis families (mirrored in baseline.json so the CI
+# contract is visible next to the grandfather counts).
+FAMILIES = ("astlint", "audit", "perf", "race", "proto")
 
 from kubeflow_tpu.analysis.perf import (  # noqa: F401
     PERF_BASELINE_PATH,
@@ -63,17 +76,51 @@ def ensure_cpu_backend(n_devices: int = 8) -> None:
 def run_analysis(
     trace: bool = True,
     serving: bool = True,
+    families: Optional[Iterable[str]] = None,
 ) -> Tuple[List[Finding], Dict[str, float]]:
-    """Run Tier A (always) and Tier B (``trace=True``); returns the
-    combined findings plus ratchet metrics."""
-    from kubeflow_tpu.analysis.astlint import lint_package
+    """Run the selected analysis families; returns the combined
+    findings plus ratchet metrics.
 
-    findings = list(lint_package())
+    ``families=None`` selects everything this function owns (astlint,
+    audit, race, proto -- perf rides separately through ``check_perf``,
+    it needs no tracing). ``trace=False`` still vetoes the jaxpr audit
+    and ``serving=False`` still skips the serving-engine audit and the
+    engine stress driver, preserving the historical flag semantics."""
+    selected = (set(families) if families is not None
+                else {"astlint", "audit", "race", "proto"})
+    unknown = selected - set(FAMILIES)
+    if unknown:
+        raise ValueError(
+            f"unknown analysis families {sorted(unknown)}; "
+            f"registered: {FAMILIES}"
+        )
+    log = logging.getLogger(__name__)
+    findings: List[Finding] = []
     metrics: Dict[str, float] = {}
-    if trace:
+    if "astlint" in selected:
+        from kubeflow_tpu.analysis.astlint import lint_package
+
+        findings.extend(lint_package())
+    if "audit" in selected and trace:
         ensure_cpu_backend()
         from kubeflow_tpu.analysis.jaxpr_audit import audit_all
 
         audit_findings, metrics = audit_all(include_serving=serving)
         findings.extend(audit_findings)
+    if "race" in selected:
+        from kubeflow_tpu.analysis.racecheck import check_races
+
+        if serving:
+            ensure_cpu_backend()  # the engine stress driver compiles
+        race_findings, race_info = check_races(include_engine=serving)
+        findings.extend(race_findings)
+        # Coverage counts only: they grow with instrumentation and must
+        # never enter the higher-is-worse metrics ratchet.
+        log.info("racecheck: %s", race_info)
+    if "proto" in selected:
+        from kubeflow_tpu.analysis.protocheck import check_protocols
+
+        proto_findings, proto_info = check_protocols()
+        findings.extend(proto_findings)
+        log.info("protocheck: %s", proto_info)
     return findings, metrics

@@ -21,6 +21,11 @@ milliseconds and cannot be broken by import-time side effects. Rules
               stays live across the update and doubles HBM.
 - KT-IMPORT01 unused module-level import (ruff F401 analog; the
               container image has no ruff, so the check lives here).
+- KT-ATOMIC01 `os.replace(staging, final)` whose staging name is a
+              constant `.tmp`-style suffix with no pid/uuid component:
+              two processes staging to the same name clobber each
+              other's half-written file (the reshard command-file bug);
+              the blessed pattern is obs/trace.py's `.tmp.{os.getpid()}`.
 
 Suppression: a trailing same-line comment
     # kt-lint: disable=KT-SYNC01 -- <justification>
@@ -452,6 +457,80 @@ def _check_unused_imports(mod: _Module, out: List[Finding]) -> None:
                   f"unused import {display!r}")
 
 
+# Calls that make a staging name unique per process/attempt.
+_UNIQ_CALLS = {
+    "getpid", "mkstemp", "mkdtemp", "uuid1", "uuid4", "urandom",
+    "token_hex", "token_urlsafe", "NamedTemporaryFile",
+}
+# Identifier substrings that signal a uniqueness component (``pid`` in
+# an f-string, a precomputed ``suffix`` from uuid, ...).
+_UNIQ_NAME_RE = re.compile(r"pid|uuid|uniq|rand|token|nonce", re.I)
+_TMP_FRAGMENT_RE = re.compile(r"\.?tmp\b|\.partial\b|\.staging\b", re.I)
+
+
+def _expr_has_uniqueness(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            name = _call_target_name(n.func)
+            if name in _UNIQ_CALLS:
+                return True
+        if isinstance(n, ast.Name) and _UNIQ_NAME_RE.search(n.id):
+            return True
+        if isinstance(n, ast.Attribute) and _UNIQ_NAME_RE.search(n.attr):
+            return True
+    return False
+
+
+def _is_bare_tmp_staging(node: ast.AST) -> bool:
+    """True when ``node`` builds a path with a constant tmp-ish suffix
+    and no per-process uniqueness component -- f-strings, ``+`` concat,
+    ``%``/``.format`` all reduce to 'has a constant .tmp fragment'."""
+    frags = [
+        n.value for n in ast.walk(node)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    ]
+    if not any(_TMP_FRAGMENT_RE.search(f) for f in frags):
+        return False
+    return not _expr_has_uniqueness(node)
+
+
+def _check_atomic_staging(mod: _Module, out: List[Finding]) -> None:
+    """KT-ATOMIC01: os.replace() staging names must carry a pid/uuid
+    component. Resolution is best-effort and conservative: a Name
+    argument is resolved through its local assignments; an argument we
+    can't resolve (parameter, attribute, call result) is not flagged."""
+    # name -> assigned value exprs, per enclosing def (module = None).
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (
+            isinstance(func, ast.Attribute)
+            and func.attr in ("replace", "rename")
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "os"
+            and node.args
+        ):
+            continue
+        src = node.args[0]
+        exprs: List[ast.AST] = []
+        if isinstance(src, ast.Name):
+            owner = _innermost_def(mod.tree, src)
+            for n in ast.walk(owner):
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name) and t.id == src.id:
+                            exprs.append(n.value)
+        else:
+            exprs.append(src)
+        if exprs and all(_is_bare_tmp_staging(e) for e in exprs):
+            _emit(out, mod, "KT-ATOMIC01", node.lineno,
+                  "os.%s() staging name has no pid/uuid component: "
+                  "concurrent writers clobber each other's staging "
+                  "file (use the obs/trace.py '.tmp.{os.getpid()}' "
+                  "pattern)" % func.attr)
+
+
 # -- driver -----------------------------------------------------------------
 
 RULES = (
@@ -460,6 +539,7 @@ RULES = (
     _check_mutable_defaults,
     _check_donation,
     _check_unused_imports,
+    _check_atomic_staging,
 )
 
 

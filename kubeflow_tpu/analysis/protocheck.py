@@ -1,0 +1,500 @@
+"""Tier C (model checking): small-scope control-plane protocol checker.
+
+Three explicit state machines, exhaustively explored (BFS over every
+interleaving) with injected crash/timeout/nack/stale-file faults:
+
+- **reshard**: the command/ack protocol between the reconciler writer
+  and the worker poller (controller/reshard_protocol.py is the shared
+  wire code). Invariants: a resize seq is applied at most once
+  (KT-PROTO-DOUBLE -- a stale command file re-applied by a respawned
+  worker), the command file never outlives the gang generation
+  (KT-PROTO-RESIDUE), a completed resize leaves the worker at the
+  target width (KT-PROTO-WIDTH), and from every reachable state some
+  terminal is reachable -- nack/timeout fallback always ends in a
+  formed gang (KT-PROTO-STUCK covers both dead states and livelocks).
+- **gang**: admission -> spawn -> run lifecycle with spawn/run faults
+  and bounded restarts; the reservation must be released by terminal
+  (KT-PROTO-RESIDUE) and restarts must respect the backoff limit.
+- **writer**: the scheduler/metric-scaler single-writer rule -- for
+  one job, at most one of the two resize authorities ever actuates
+  (KT-PROTO-WRITER); explored for both scheduler_managed settings.
+
+Conformance (KT-PROTO-CONFORM): the checker replays its own explored
+schedules against the REAL file protocol in a tempdir --
+``write_resize_command`` / ``read_resize_command`` /
+``clear_resize_command``, the exact functions the reconciler and the
+worker step loop call -- and diffs each observation against the
+model's prediction, so the model cannot drift from the code.
+
+All KT-PROTO-* findings are hard: a protocol bug is never
+grandfathered. ``PLANTED_MUTATIONS`` (test hook) re-introduces known
+bug shapes (e.g. skip the unlink on fallback) to prove non-vacuity.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from collections import deque
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from kubeflow_tpu.analysis.report import Finding
+from kubeflow_tpu.controller.reshard_protocol import (
+    clear_resize_command,
+    read_resize_command,
+    write_resize_command,
+)
+
+# Test hook: names of protocol bugs to plant (consulted by the models
+# when ``check_protocols`` is called without explicit mutations).
+# Known shapes: "no_unlink_on_fallback", "no_unlink_on_teardown",
+# "no_seq_guard", "leak_reservation", "no_managed_gate".
+PLANTED_MUTATIONS: Set[str] = set()
+
+MAX_STATES = 100000
+_TRACE_CAP = 24  # longest counterexample rendered in a message
+
+
+class ExploreResult:
+    def __init__(self) -> None:
+        self.states = 0
+        self.findings: List[Finding] = []
+        self.pred: Dict[tuple, Optional[Tuple[tuple, str]]] = {}
+        self.terminals: List[tuple] = []
+
+
+def _trace_of(pred, state) -> List[str]:
+    labels: List[str] = []
+    cur = state
+    while pred.get(cur) is not None:
+        prev, label = pred[cur]
+        labels.append(label)
+        cur = prev
+    labels.reverse()
+    if len(labels) > _TRACE_CAP:
+        labels = labels[:_TRACE_CAP] + ["..."]
+    return labels
+
+
+def explore(model) -> ExploreResult:
+    """BFS over the model's full state space. One finding per violated
+    rule (the BFS-first violation has a shortest counterexample)."""
+    res = ExploreResult()
+    init = model.initial()
+    res.pred[init] = None
+    adj: Dict[tuple, List[Tuple[str, tuple]]] = {}
+    violated: Dict[str, Tuple[tuple, str]] = {}
+    stuck: Optional[tuple] = None
+    q = deque([init])
+    while q:
+        s = q.popleft()
+        bad = model.invariant(s)
+        if bad is not None:
+            rule, msg = bad
+            violated.setdefault(rule, (s, msg))
+            adj[s] = []  # don't explore past a broken state
+            continue
+        acts = list(model.actions(s))
+        adj[s] = acts
+        if not acts and not model.is_terminal(s) and stuck is None:
+            stuck = s
+        for label, s2 in acts:
+            if s2 not in res.pred:
+                if len(res.pred) >= MAX_STATES:
+                    raise RuntimeError(
+                        f"{model.name}: state space exceeded {MAX_STATES}"
+                    )
+                res.pred[s2] = (s, label)
+                q.append(s2)
+    res.states = len(adj)
+    res.terminals = [s for s in adj if model.is_terminal(s)]
+
+    for rule, (s, msg) in sorted(violated.items()):
+        res.findings.append(Finding(
+            rule=rule, path=model.path, line=0, hard=True,
+            message=(f"{model.name}: {msg}; trace: "
+                     + " -> ".join(_trace_of(res.pred, s))),
+        ))
+    if stuck is not None:
+        res.findings.append(Finding(
+            rule="KT-PROTO-STUCK", path=model.path, line=0, hard=True,
+            message=(f"{model.name}: non-terminal state with no enabled "
+                     "action; trace: "
+                     + " -> ".join(_trace_of(res.pred, stuck))),
+        ))
+    # Liveness: every non-violating state must still be able to reach a
+    # terminal (fallback always reaches a formed gang, no livelock).
+    radj: Dict[tuple, List[tuple]] = {}
+    for s, acts in adj.items():
+        for _label, s2 in acts:
+            radj.setdefault(s2, []).append(s)
+    can_reach = set(res.terminals)
+    dq = deque(res.terminals)
+    while dq:
+        s = dq.popleft()
+        for p in radj.get(s, ()):
+            if p not in can_reach:
+                can_reach.add(p)
+                dq.append(p)
+    broken = {s for s, _m in violated.values()}
+    for s in adj:
+        if stuck is not None:
+            break  # one finding per rule: the dead state already covers it
+        if s not in can_reach and s not in broken:
+            res.findings.append(Finding(
+                rule="KT-PROTO-STUCK", path=model.path, line=0, hard=True,
+                message=(f"{model.name}: no terminal reachable (livelock); "
+                         "trace: "
+                         + " -> ".join(_trace_of(res.pred, s))),
+            ))
+            break
+    return res
+
+
+# --------------------------------------------------------------------------
+# Model 1: reshard command/ack (controller writer x worker poller
+# x timeout/fallback x crash/stale-file faults).
+# --------------------------------------------------------------------------
+# State tuple:
+#   (ctrl, seq, file_seq, w_alive, w_seq, w_width, ack,
+#    applied, restarts)
+# ctrl: idle | wait | restart_wait | done | end
+# applied: per-seq apply counts, tuple indexed by seq-1 (len MAX_SEQ)
+_W, _T = 2, 4       # start width, resize target
+_MAX_SEQ = 2        # at most two resize attempts per exploration
+_MAX_RESTARTS = 1
+
+
+class ReshardModel:
+    name = "reshard"
+    path = "kubeflow_tpu/controller/reconciler.py"
+
+    def __init__(self, mutations: FrozenSet[str] = frozenset()) -> None:
+        self.mut = frozenset(mutations)
+
+    def initial(self) -> tuple:
+        return ("idle", 0, 0, True, 0, _W, None, (0,) * _MAX_SEQ, 0)
+
+    def is_terminal(self, s: tuple) -> bool:
+        return s[0] == "end"
+
+    def invariant(self, s: tuple) -> Optional[Tuple[str, str]]:
+        ctrl, seq, file_seq, w_alive, w_seq, w_width, ack, applied, _r = s
+        for i, n in enumerate(applied):
+            if n > 1:
+                return ("KT-PROTO-DOUBLE",
+                        f"resize seq {i + 1} applied {n} times (stale "
+                        "command re-applied by a fresh worker)")
+        if ctrl == "end" and file_seq:
+            return ("KT-PROTO-RESIDUE",
+                    "command file outlives the gang generation "
+                    f"(seq {file_seq} still on disk at teardown)")
+        if ctrl in ("done", "end") and w_alive and w_width != _T:
+            return ("KT-PROTO-WIDTH",
+                    f"resize declared complete but worker width is "
+                    f"{w_width}, not target {_T}")
+        return None
+
+    def actions(self, s: tuple):
+        ctrl, seq, file_seq, w_alive, w_seq, w_width, ack, applied, r = s
+        out: List[Tuple[str, tuple]] = []
+
+        # Controller: initiate a reshard-in-place (write command file).
+        if ctrl == "idle" and w_alive and w_width != _T and seq < _MAX_SEQ:
+            ns = seq + 1
+            out.append((f"initiate[seq{ns}]",
+                        ("wait", ns, ns, w_alive, w_seq, w_width, None,
+                         applied, r)))
+
+        # Worker poll: the seq guard is read_resize_command's contract.
+        sees = file_seq > (0 if "no_seq_guard" in self.mut else w_seq)
+        if w_alive and file_seq and sees:
+            ap = list(applied)
+            ap[file_seq - 1] += 1
+            new_ack = "ok" if (ctrl == "wait" and file_seq == seq) else ack
+            out.append((f"worker_apply_ok[seq{file_seq}]",
+                        (ctrl, seq, file_seq, w_alive, file_seq, _T,
+                         new_ack, tuple(ap), r)))
+            # Infeasible plan: worker nacks and keeps the old mesh.
+            new_nack = "nack" if (ctrl == "wait" and file_seq == seq) else ack
+            out.append((f"worker_nack[seq{file_seq}]",
+                        (ctrl, seq, file_seq, w_alive, file_seq, w_width,
+                         new_nack, applied, r)))
+
+        # Controller ack poll / timeout / nack fallback.
+        if ctrl == "wait" and w_alive:
+            if ack == "ok":
+                out.append(("ctrl_ack",
+                            ("done", seq, file_seq, w_alive, w_seq,
+                             w_width, None, applied, r)))
+            # The deadline can fire at ANY point in wait -- including
+            # after the worker already applied (the benign spurious-
+            # restart race, which must still converge on width T).
+            fb_file = file_seq if "no_unlink_on_fallback" in self.mut else 0
+            reason = "nack" if ack == "nack" else "timeout"
+            out.append((f"ctrl_fallback[{reason}]",
+                        ("restart_wait", seq, fb_file, w_alive, w_seq,
+                         w_width, None, applied, r)))
+
+        # Checkpoint-restart completes: fresh worker at the TARGET
+        # width, seq counter reset to 0 (fresh gang generation).
+        if ctrl == "restart_wait":
+            out.append(("restart_complete",
+                        ("done", seq, file_seq, True, 0, _T, None,
+                         applied, r)))
+
+        # Worker crash (one per exploration keeps the space tiny).
+        if w_alive and r < _MAX_RESTARTS and ctrl in ("idle", "wait",
+                                                      "done"):
+            out.append(("worker_crash",
+                        (ctrl, seq, file_seq, False, w_seq, w_width, None,
+                         applied, r)))
+
+        # Crash teardown + respawn: _teardown unlinks the command file
+        # when the runtime ever resharded (reshard_seq nonzero), THEN
+        # the gang re-forms at the pre-resize width and reconcile
+        # resumes toward the target.
+        if not w_alive and ctrl != "end":
+            td_file = (file_seq
+                       if ("no_unlink_on_teardown" in self.mut and seq)
+                       else (0 if seq else file_seq))
+            out.append(("crash_teardown_respawn",
+                        ("idle", seq, td_file, True, 0, _W, None,
+                         applied, r + 1)))
+
+        # End of job: gang teardown (same unlink-on-teardown rule). A
+        # job may also complete from idle -- e.g. after a crash-respawn
+        # that exhausted the seq budget, training just runs to the end
+        # at the current width.
+        if ctrl in ("done", "idle") and w_alive:
+            td_file = (file_seq
+                       if ("no_unlink_on_teardown" in self.mut and seq)
+                       else (0 if seq else file_seq))
+            out.append(("teardown",
+                        ("end", seq, td_file, False, w_seq, w_width, None,
+                         applied, r)))
+        return out
+
+
+# --------------------------------------------------------------------------
+# Model 2: gang lifecycle (admission -> spawn -> run, faults, backoff).
+# --------------------------------------------------------------------------
+_BACKOFF_LIMIT = 1
+
+
+class GangModel:
+    name = "gang"
+    path = "kubeflow_tpu/controller/reconciler.py"
+
+    def __init__(self, mutations: FrozenSet[str] = frozenset()) -> None:
+        self.mut = frozenset(mutations)
+
+    def initial(self) -> tuple:
+        # (phase, reserved, restarts)
+        return ("pending", False, 0)
+
+    def is_terminal(self, s: tuple) -> bool:
+        return s[0] == "end"
+
+    def invariant(self, s: tuple) -> Optional[Tuple[str, str]]:
+        phase, reserved, restarts = s
+        if phase == "end" and reserved:
+            return ("KT-PROTO-RESIDUE",
+                    "gang reservation leaked past job terminal (capacity "
+                    "never returned to the pool)")
+        if restarts > _BACKOFF_LIMIT:
+            return ("KT-PROTO-DOUBLE",
+                    f"restarted {restarts} times past backoff_limit "
+                    f"{_BACKOFF_LIMIT}")
+        return None
+
+    def actions(self, s: tuple):
+        phase, reserved, restarts = s
+        out: List[Tuple[str, tuple]] = []
+        if phase == "pending":
+            out.append(("admit_reserve", ("admitted", True, restarts)))
+        elif phase == "admitted":
+            out.append(("spawn_ok", ("running", reserved, restarts)))
+            out.append(("spawn_fail", ("failed", reserved, restarts)))
+        elif phase == "running":
+            out.append(("run_ok", ("cleanup", reserved, restarts)))
+            out.append(("worker_fail", ("failed", reserved, restarts)))
+        elif phase == "failed":
+            if restarts < _BACKOFF_LIMIT:
+                out.append(("backoff_respawn",
+                            ("admitted", reserved, restarts + 1)))
+            else:
+                out.append(("give_up", ("cleanup", reserved, restarts)))
+        elif phase == "cleanup":
+            released = reserved if "leak_reservation" in self.mut else False
+            out.append(("release", ("end", released, restarts)))
+        return out
+
+
+# --------------------------------------------------------------------------
+# Model 3: scheduler / metric-scaler single-writer rule.
+# --------------------------------------------------------------------------
+class WriterModel:
+    path = "kubeflow_tpu/controller/scheduler.py"
+
+    def __init__(self, managed: bool,
+                 mutations: FrozenSet[str] = frozenset()) -> None:
+        self.managed = managed
+        self.mut = frozenset(mutations)
+        self.name = f"writer[managed={managed}]"
+
+    def initial(self) -> tuple:
+        # (scaler_armed, writers, ended)
+        return (False, frozenset(), False)
+
+    def is_terminal(self, s: tuple) -> bool:
+        return s[2]
+
+    def invariant(self, s: tuple) -> Optional[Tuple[str, str]]:
+        _armed, writers, _ended = s
+        if len(writers) > 1:
+            return ("KT-PROTO-WRITER",
+                    f"two resize authorities actuated one job: "
+                    f"{sorted(writers)} (scheduler_managed="
+                    f"{self.managed})")
+        return None
+
+    def actions(self, s: tuple):
+        armed, writers, ended = s
+        if ended:
+            return []
+        out: List[Tuple[str, tuple]] = []
+        # _schedule_metric_scaler's gate: scheduler_managed jobs never
+        # arm the per-job scaler.
+        if not armed and (not self.managed or "no_managed_gate" in self.mut):
+            out.append(("arm_scaler", (True, writers, False)))
+        if armed and "scaler" not in writers:
+            out.append(("scaler_resize",
+                        (armed, writers | {"scaler"}, False)))
+        # Scheduler rounds only actuate managed jobs.
+        if self.managed and "sched" not in writers:
+            out.append(("sched_round_resize",
+                        (armed, writers | {"sched"}, False)))
+        out.append(("job_done", (armed, writers, True)))
+        return out
+
+
+# --------------------------------------------------------------------------
+# Conformance: replay explored schedules against the real file protocol.
+# --------------------------------------------------------------------------
+_MAX_CONFORM_TRACES = 16
+
+
+def _terminal_traces(res: ExploreResult) -> List[List[str]]:
+    traces = []
+    for t in res.terminals[:_MAX_CONFORM_TRACES]:
+        labels = []
+        cur = t
+        while res.pred.get(cur) is not None:
+            prev, label = res.pred[cur]
+            labels.append(label)
+            cur = prev
+        labels.reverse()
+        traces.append(labels)
+    return traces
+
+
+def conformance_check(tmpdir: str) -> Tuple[List[Finding], int]:
+    """Drive write/read/clear_resize_command through schedules chosen
+    by the (unmutated) reshard model and diff every observation against
+    the model's file view. This is the glue that pins the model to
+    reconciler/entry's actual seam: if either side changes semantics
+    (staging, seq guard, unlink points), the replay diverges."""
+    findings: List[Finding] = []
+    res = explore(ReshardModel(frozenset()))
+    traces = _terminal_traces(res)
+    for ti, labels in enumerate(traces):
+        path = os.path.join(tmpdir, f"ckpt-{ti}.resize.json")
+        file_seq = 0   # model's view of the file
+        w_seq = 0      # model's view of the worker's last applied seq
+
+        def diverged(step: str, detail: str) -> Finding:
+            return Finding(
+                rule="KT-PROTO-CONFORM",
+                path="kubeflow_tpu/controller/reshard_protocol.py",
+                line=0, hard=True,
+                message=(f"conformance replay diverged at {step} "
+                         f"(trace {' -> '.join(labels)}): {detail}"),
+            )
+
+        for label in labels:
+            op = label.split("[", 1)[0]
+            if op == "initiate":
+                seq = int(label.split("seq", 1)[1].rstrip("]"))
+                write_resize_command(path, seq, _T)
+                file_seq = seq
+            elif op in ("worker_apply_ok", "worker_nack"):
+                cmd = read_resize_command(path, w_seq)
+                if cmd is None:
+                    findings.append(diverged(
+                        label, "model delivered a command but "
+                        "read_resize_command returned None"))
+                    break
+                if (int(cmd["seq"]) != file_seq
+                        or int(cmd["num_slices"]) != _T):
+                    findings.append(diverged(
+                        label, f"read {cmd} but model expected "
+                        f"seq={file_seq} num_slices={_T}"))
+                    break
+                w_seq = file_seq
+            elif op in ("ctrl_fallback", "crash_teardown_respawn",
+                        "teardown"):
+                clear_resize_command(path)
+                file_seq = 0
+                if op == "crash_teardown_respawn":
+                    w_seq = 0  # fresh gang generation polls from zero
+            elif op == "restart_complete":
+                w_seq = 0  # checkpoint-restart worker polls from zero
+            # ctrl_ack / worker_crash: no file op.
+
+            # After every op: delivery parity between the real reader
+            # and the model's (file_seq, w_seq) view.
+            expect = file_seq > w_seq
+            got = read_resize_command(path, w_seq) is not None
+            if expect != got:
+                findings.append(diverged(
+                    label, f"reader says deliverable={got}, model says "
+                    f"{expect} (file_seq={file_seq}, last_seq={w_seq})"))
+                break
+            # Re-delivery guard: an applied seq must never re-deliver.
+            if w_seq and file_seq == w_seq:
+                if read_resize_command(path, w_seq) is not None:
+                    findings.append(diverged(
+                        label, "applied command re-delivered (seq guard "
+                        "broken)"))
+                    break
+    return findings, len(traces)
+
+
+def check_protocols(
+    mutations: Optional[Set[str]] = None,
+    conformance: bool = True,
+) -> Tuple[List[Finding], Dict[str, float]]:
+    """Tier C proto family. Returns (findings, info); info is
+    display/log-only (state counts grow with model fidelity and must
+    not enter the metrics ratchet). All findings are hard."""
+    mut = frozenset(PLANTED_MUTATIONS if mutations is None else mutations)
+    findings: List[Finding] = []
+    info: Dict[str, float] = {}
+    models = [
+        ReshardModel(mut),
+        GangModel(mut),
+        WriterModel(managed=True, mutations=mut),
+        WriterModel(managed=False, mutations=mut),
+    ]
+    for model in models:
+        res = explore(model)
+        findings.extend(res.findings)
+        info[f"proto.{model.name}.states"] = float(res.states)
+    if conformance:
+        with tempfile.TemporaryDirectory(prefix="kftpu-proto-") as td:
+            conform_findings, n = conformance_check(td)
+        findings.extend(conform_findings)
+        info["proto.conform.traces"] = float(n)
+    findings.sort(key=lambda f: (f.path, f.rule, f.message))
+    return findings, info

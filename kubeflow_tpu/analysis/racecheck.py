@@ -1,0 +1,810 @@
+"""Tier C (dynamic): lock-discipline race detector.
+
+Two engines behind ``check_races`` (docs/ANALYSIS.md has the catalog):
+
+- **KT-RACE-ORDER** (hard, never grandfathered): ``LockOrderWatch``
+  patches the ``threading.Lock``/``RLock`` factories for a bounded
+  window, wrapping every lock created by *repo* code (stdlib and
+  site-packages creations delegate untracked, so jax's internal locks
+  add no noise). Each acquisition records held-lock -> acquired-lock
+  edges per thread; a cycle in the resulting lock-instance graph is a
+  potential deadlock -- two threads that interleave at the wrong
+  instant wait on each other forever. Edges carry thread names and
+  creation sites, so the finding is the attribution, not a core dump.
+  The graph is over lock INSTANCES, not creation sites: two Histogram
+  locks born on the same line are distinct nodes, so per-instance
+  ordering (fine) is never confused with a real inversion.
+
+- **KT-GUARD01** (countable, suppressible): a static companion lint
+  over modules that start threads (``Thread(target=...)`` /
+  ``executor.submit(self.m, ...)``). The thread body is the target
+  plus every same-class method transitively reachable from it; an
+  instance attribute ASSIGNED both inside that body and outside it,
+  with no common ``with self.<lock>`` guard, is flagged. ``__init__``
+  writes happen-before ``Thread.start`` and are exempt; so are writes
+  lexically after a join barrier (a ``.join()`` call, or a call to a
+  same-class method that joins -- the ``close()``-after-``stop()``
+  idiom). Suppression uses the Tier A tag:
+  ``# kt-lint: disable=KT-GUARD01 -- <justification>``.
+
+The stress drivers instantiate the real threaded modules (obs/trace,
+obs/registry, store/store, hpo/obsdb, and -- gated, it compiles --
+serving/engine) under the watch and hammer them from contended
+threads. serving/model.py coordinates on asyncio primitives plus a
+thread pool; the static lint covers its classes, the dynamic watch
+sees any ``threading`` lock it creates.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+import sysconfig
+import threading
+import _thread
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from kubeflow_tpu.analysis.astlint import (
+    _Module,
+    _call_target_name,
+    _emit,
+    iter_python_files,
+)
+from kubeflow_tpu.analysis.report import Finding
+
+PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Creation sites under these prefixes are DELEGATED but not tracked:
+# third-party/stdlib internals churn locks (jax compiles under the
+# watch) and their ordering is not ours to police.
+_UNTRACKED_PREFIXES = tuple(
+    p for p in {
+        sysconfig.get_paths().get("stdlib", ""),
+        sysconfig.get_paths().get("purelib", ""),
+        sysconfig.get_paths().get("platlib", ""),
+    } if p
+)
+
+
+def _site_of_caller() -> Tuple[str, int]:
+    """(filename, line) of the frame that called the patched factory,
+    skipping racecheck's own frames."""
+    f = sys._getframe(2)
+    while f is not None and f.f_globals.get("__name__", "").endswith(
+        "analysis.racecheck"
+    ):
+        f = f.f_back
+    if f is None:
+        return "<unknown>", 0
+    return f.f_code.co_filename, f.f_lineno
+
+
+def _rel_site(filename: str) -> str:
+    root = os.path.dirname(PACKAGE_ROOT)
+    try:
+        rel = os.path.relpath(filename, root)
+    except ValueError:
+        return os.path.basename(filename)
+    return rel if not rel.startswith("..") else os.path.basename(filename)
+
+
+class _TrackedLock:
+    """Delegating wrapper around a real lock; reports acquire/release
+    to the owning watch when tracked."""
+
+    _reentrant = False
+
+    def __init__(self, watch: "LockOrderWatch", inner, site: Tuple[str, int],
+                 tracked: bool) -> None:
+        self._watch = watch
+        self._inner = inner
+        self.site = site
+        self._tracked = tracked
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got and self._tracked:
+            self._watch._note_acquire(self)
+        return got
+
+    def release(self) -> None:
+        if self._tracked:
+            self._watch._note_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def _at_fork_reinit(self) -> None:
+        self._inner._at_fork_reinit()
+
+    def __repr__(self) -> str:
+        return f"<tracked {self._inner!r} @ {self.site[0]}:{self.site[1]}>"
+
+
+class _TrackedRLock(_TrackedLock):
+    """RLock wrapper; the extra protocol methods keep ``Condition``
+    working when handed one of these (Condition probes them via
+    hasattr, so they must exist only on the reentrant wrapper)."""
+
+    _reentrant = True
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _acquire_restore(self, state):
+        self._inner._acquire_restore(state)
+        if self._tracked:
+            self._watch._note_acquire(self)
+
+    def _release_save(self):
+        if self._tracked:
+            self._watch._note_release(self)
+        return self._inner._release_save()
+
+
+class LockOrderWatch:
+    """Patch ``threading.Lock``/``RLock`` for a window; build the
+    per-thread lock-order graph; report cycles as hard findings."""
+
+    def __init__(self, track_all: bool = False) -> None:
+        self._track_all = track_all
+        # Raw _thread lock: the watch's own bookkeeping must not route
+        # through the patched factories (it would trace itself).
+        self._mu = _thread.allocate_lock()
+        self._tls = threading.local()
+        self._locks: Dict[int, _TrackedLock] = {}  # id -> wrapper (strong)
+        self._edges: Dict[int, Set[int]] = {}
+        self._edge_info: Dict[Tuple[int, int], Tuple[str, str, str]] = {}
+        self.locks_created = 0
+        self.acquires = 0
+        self._saved = None
+        self._saved_interval = None
+
+    # -- patching ----------------------------------------------------------
+    def __enter__(self) -> "LockOrderWatch":
+        watch = self
+
+        def make_lock():
+            fn, line = _site_of_caller()
+            tracked = watch._is_tracked(fn)
+            inner = watch._orig_lock()
+            w = _TrackedLock(watch, inner, (fn, line), tracked)
+            watch._register(w)
+            return w
+
+        def make_rlock():
+            fn, line = _site_of_caller()
+            tracked = watch._is_tracked(fn)
+            inner = watch._orig_rlock()
+            w = _TrackedRLock(watch, inner, (fn, line), tracked)
+            watch._register(w)
+            return w
+
+        self._saved = (threading.Lock, threading.RLock)
+        self._orig_lock, self._orig_rlock = self._saved
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        # Shrink the bytecode switch interval so the stress threads
+        # interleave aggressively inside the watch window.
+        self._saved_interval = sys.getswitchinterval()
+        sys.setswitchinterval(1e-4)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        threading.Lock, threading.RLock = self._saved
+        if self._saved_interval is not None:
+            sys.setswitchinterval(self._saved_interval)
+        return False
+
+    def _is_tracked(self, filename: str) -> bool:
+        if self._track_all:
+            return True
+        return not filename.startswith(_UNTRACKED_PREFIXES)
+
+    def _register(self, w: _TrackedLock) -> None:
+        with self._mu:
+            self.locks_created += 1
+            if w._tracked:
+                self._locks[id(w)] = w
+
+    # -- event recording ---------------------------------------------------
+    def _held(self) -> List[_TrackedLock]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _note_acquire(self, lock: _TrackedLock) -> None:
+        held = self._held()
+        if any(h is lock for h in held):
+            held.append(lock)  # reentrant re-entry: no new ordering edge
+            return
+        if held:
+            thread = threading.current_thread().name
+            with self._mu:
+                self.acquires += 1
+                for h in held:
+                    key = (id(h), id(lock))
+                    if key not in self._edge_info:
+                        self._edges.setdefault(id(h), set()).add(id(lock))
+                        self._edge_info[key] = (
+                            thread,
+                            f"{_rel_site(h.site[0])}:{h.site[1]}",
+                            f"{_rel_site(lock.site[0])}:{lock.site[1]}",
+                        )
+        else:
+            with self._mu:
+                self.acquires += 1
+        held.append(lock)
+
+    def _note_release(self, lock: _TrackedLock) -> None:
+        held = getattr(self._tls, "held", None)
+        if not held:
+            return
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    # -- cycle detection ---------------------------------------------------
+    def _sccs(self) -> List[List[int]]:
+        """Tarjan, iterative (the graph is tiny but recursion depth is
+        not worth betting on)."""
+        index: Dict[int, int] = {}
+        low: Dict[int, int] = {}
+        on: Set[int] = set()
+        stack: List[int] = []
+        out: List[List[int]] = []
+        counter = [0]
+
+        for root in list(self._edges):
+            if root in index:
+                continue
+            work = [(root, iter(sorted(self._edges.get(root, ()))))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on.add(root)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt not in index:
+                        index[nxt] = low[nxt] = counter[0]
+                        counter[0] += 1
+                        stack.append(nxt)
+                        on.add(nxt)
+                        work.append(
+                            (nxt, iter(sorted(self._edges.get(nxt, ()))))
+                        )
+                        advanced = True
+                        break
+                    if nxt in on:
+                        low[node] = min(low[node], index[nxt])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        n = stack.pop()
+                        on.discard(n)
+                        scc.append(n)
+                        if n == node:
+                            break
+                    if len(scc) > 1:
+                        out.append(scc)
+        return out
+
+    def _cycle_path(self, scc: List[int]) -> List[Tuple[int, int]]:
+        """One concrete edge cycle inside an SCC (DFS back to start)."""
+        members = set(scc)
+        start = scc[0]
+        path: List[int] = [start]
+        seen = {start}
+        edges: List[Tuple[int, int]] = []
+
+        def dfs(node: int) -> bool:
+            for nxt in sorted(self._edges.get(node, ())):
+                if nxt not in members:
+                    continue
+                if nxt == start:
+                    edges.append((node, nxt))
+                    return True
+                if nxt in seen:
+                    continue
+                seen.add(nxt)
+                edges.append((node, nxt))
+                if dfs(nxt):
+                    return True
+                edges.pop()
+            return False
+
+        dfs(start)
+        return edges
+
+    def findings(self) -> List[Finding]:
+        out: List[Finding] = []
+        with self._mu:
+            sccs = self._sccs()
+            for scc in sccs:
+                cycle = self._cycle_path(scc)
+                if not cycle:
+                    continue
+                hops = []
+                for a, b in cycle:
+                    thread, sa, sb = self._edge_info[(a, b)]
+                    hops.append(f"{sa} -> {sb} [thread {thread}]")
+                first = self._locks[cycle[0][0]]
+                rel = _rel_site(first.site[0])
+                out.append(Finding(
+                    rule="KT-RACE-ORDER", path=rel, line=first.site[1],
+                    hard=True,
+                    message=("lock-order cycle (potential deadlock): "
+                             + "; ".join(hops)),
+                ))
+        out.sort(key=lambda f: (f.path, f.line))
+        return out
+
+    def stats(self) -> Dict[str, float]:
+        with self._mu:
+            return {
+                "race.locks_tracked": float(len(self._locks)),
+                "race.locks_created": float(self.locks_created),
+                "race.order_edges": float(len(self._edge_info)),
+                "race.acquires": float(self.acquires),
+            }
+
+
+# --------------------------------------------------------------------------
+# KT-GUARD01: static unguarded-shared-write lint.
+# --------------------------------------------------------------------------
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+# Attributes whose values are themselves synchronization/atomic objects:
+# writing them is establishing the guard, not racing through it.
+_SYNC_CTORS = _LOCK_CTORS | {
+    "Event", "Semaphore", "BoundedSemaphore", "Barrier", "Queue",
+    "SimpleQueue", "LifoQueue", "PriorityQueue", "count", "local",
+    "ExitStack", "ContextVar", "Thread",
+}
+
+
+class _Write:
+    __slots__ = ("attr", "line", "fn", "guards", "barriered", "value")
+
+    def __init__(self, attr: str, line: int, fn: ast.AST,
+                 guards: FrozenSet[str], barriered: bool,
+                 value: Optional[ast.AST]) -> None:
+        self.attr = attr
+        self.line = line
+        self.fn = fn
+        self.guards = guards
+        self.barriered = barriered
+        self.value = value
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _self_method_call(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        return _self_attr(node.func)
+    return None
+
+
+def _direct_methods(cls: ast.ClassDef) -> Dict[str, ast.AST]:
+    return {
+        n.name: n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _thread_seeds(cls: ast.ClassDef, methods: Dict[str, ast.AST]
+                  ) -> List[ast.AST]:
+    """Defs that become thread bodies: Thread(target=...) and
+    executor ``.submit(self.m, ...)`` seen anywhere in the class."""
+    seeds: List[ast.AST] = []
+    # method name -> nested defs by name (Thread targets are often
+    # closures like ``loop`` in engine.start()).
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_target_name(node.func)
+        target: Optional[ast.AST] = None
+        if name == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+        elif name == "submit" and node.args:
+            target = node.args[0]
+        if target is None:
+            continue
+        m = _self_attr(target)
+        if m and m in methods:
+            seeds.append(methods[m])
+        elif isinstance(target, ast.Name):
+            # Nested def in the same class body with that name.
+            for meth in methods.values():
+                for sub in ast.walk(meth):
+                    if (isinstance(sub, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+                            and sub.name == target.id):
+                        seeds.append(sub)
+    return seeds
+
+
+def _thread_closure(seeds: Iterable[ast.AST],
+                    methods: Dict[str, ast.AST]) -> Set[ast.AST]:
+    """Seeds plus every same-class method transitively called via
+    ``self.m(...)`` (and their nested defs)."""
+    closure: Set[ast.AST] = set()
+    work = list(seeds)
+    while work:
+        fn = work.pop()
+        if fn in closure:
+            continue
+        closure.add(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                closure.add(node)
+            m = _self_method_call(node)
+            if m and m in methods and methods[m] not in closure:
+                work.append(methods[m])
+    return closure
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Call)
+                and _call_target_name(node.value.func) in _LOCK_CTORS):
+            continue
+        for t in node.targets:
+            a = _self_attr(t)
+            if a:
+                out.add(a)
+    return out
+
+
+def _join_methods(methods: Dict[str, ast.AST]) -> Set[str]:
+    """Methods whose body joins a thread (``.join(...)`` on anything):
+    calling one is a happens-after barrier for the thread body."""
+    out: Set[str] = set()
+    for name, fn in methods.items():
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and _call_target_name(node.func) == "join"):
+                out.add(name)
+                break
+    return out
+
+
+def _collect_writes(fn: ast.AST, lock_attrs: Set[str],
+                    joiners: Set[str]) -> List[_Write]:
+    """Attribute writes in ``fn`` (excluding nested defs -- they are
+    visited as their own fn), each annotated with the guard set of
+    enclosing ``with self.<lock>`` blocks and whether a join barrier
+    precedes it lexically in this body."""
+    writes: List[_Write] = []
+
+    def visit(node: ast.AST, guards: FrozenSet[str],
+              barriered: List[bool]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            g = guards
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                extra = {
+                    _self_attr(item.context_expr)
+                    for item in child.items
+                }
+                extra &= lock_attrs
+                if extra:
+                    g = guards | frozenset(extra)
+            if isinstance(child, (ast.Assign, ast.AugAssign)):
+                targets = (child.targets
+                           if isinstance(child, ast.Assign)
+                           else [child.target])
+                for t in targets:
+                    for sub in ast.walk(t):
+                        a = _self_attr(sub)
+                        if (a and isinstance(sub, ast.Attribute)
+                                and isinstance(sub.ctx, ast.Store)):
+                            writes.append(_Write(
+                                a, child.lineno, fn, g, barriered[0],
+                                getattr(child, "value", None),
+                            ))
+            visit(child, g, barriered)
+            # Join barriers are nested in statement nodes (Expr/If/...):
+            # scan AFTER the child's own writes so a write in the same
+            # statement as the join is conservatively NOT barriered.
+            for sub in ast.walk(child):
+                if isinstance(sub, ast.Call) and (
+                    _call_target_name(sub.func) == "join"
+                    or _self_attr(sub.func) in joiners
+                ):
+                    barriered[0] = True
+                    break
+
+    visit(fn, frozenset(), [False])
+    return writes
+
+
+def _check_guard(mod: _Module, out: List[Finding]) -> None:
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = _direct_methods(cls)
+        seeds = _thread_seeds(cls, methods)
+        if not seeds:
+            continue
+        closure = _thread_closure(seeds, methods)
+        locks = _lock_attrs(cls)
+        joiners = _join_methods(methods)
+        inside: Dict[str, List[_Write]] = {}
+        outside: Dict[str, List[_Write]] = {}
+        for name, meth in methods.items():
+            defs = [meth] + [
+                n for n in ast.walk(meth)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            for fn in defs:
+                ws = _collect_writes(fn, locks, joiners)
+                bucket = inside if fn in closure else outside
+                if name == "__init__" and fn is meth:
+                    continue  # happens-before Thread.start()
+                for w in ws:
+                    if w.attr in locks:
+                        continue
+                    if (isinstance(w.value, ast.Call)
+                            and _call_target_name(w.value.func)
+                            in _SYNC_CTORS):
+                        continue
+                    bucket.setdefault(w.attr, []).append(w)
+        for attr in sorted(set(inside) & set(outside)):
+            flagged = None
+            for wi in inside[attr]:
+                for wo in outside[attr]:
+                    if wo.barriered or wi.barriered:
+                        continue  # post-join: thread is gone
+                    if wi.guards & wo.guards:
+                        continue  # common lock covers both sides
+                    flagged = (wi, wo)
+                    break
+                if flagged:
+                    break
+            if flagged:
+                wi, wo = flagged
+                _emit(out, mod, "KT-GUARD01", wo.line,
+                      f"attribute {attr!r} of {cls.name} is written in a "
+                      f"thread body (line {wi.line}) and outside it "
+                      f"(line {wo.line}) with no common lock")
+
+
+def guard_lint(package_root: Optional[str] = None) -> List[Finding]:
+    """KT-GUARD01 over every module under ``package_root`` that starts
+    threads (pure AST; milliseconds)."""
+    root = package_root or PACKAGE_ROOT
+    findings: List[Finding] = []
+    for path, rel in iter_python_files(root):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        if "Thread(" not in source and ".submit(" not in source:
+            continue
+        try:
+            mod = _Module(path, rel, source)
+        except SyntaxError:
+            continue
+        _check_guard(mod, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# Stress drivers: the real threaded modules under contention.
+# --------------------------------------------------------------------------
+_THREADS = 4
+_OPS = 150
+
+
+def _run_threads(fns: List) -> None:
+    threads = [threading.Thread(target=fn, name=f"stress-{i}")
+               for i, fn in enumerate(fns)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def _stress_trace() -> None:
+    """obs/trace.py: concurrent span recording vs export/clear/resize
+    on one recorder (the serving hot path vs /debug/trace scrapes)."""
+    from collections import deque
+
+    from kubeflow_tpu.obs.trace import Span, TraceRecorder
+
+    rec = TraceRecorder(capacity=2048)
+    rec.enabled = True
+
+    def record() -> None:
+        for i in range(_OPS):
+            with Span(rec, f"s{i % 7}", "serving", "stress", None):
+                rec._record("i", "tick", "serving", "stress", float(i), None)
+
+    def scrape() -> None:
+        for i in range(_OPS // 4):
+            rec.export()
+            len(rec)
+            _ = rec.dropped
+            if i % 8 == 3:
+                rec.clear()
+            if i % 16 == 7:
+                # configure()'s capacity swap, inlined (no global state).
+                with rec._lock:
+                    rec._events = deque(rec._events, maxlen=2048)
+
+    _run_threads([record] * (_THREADS - 1) + [scrape])
+
+
+def _stress_registry() -> None:
+    """obs/registry.py: get-or-create + inc/observe vs expose/catalog."""
+    from kubeflow_tpu.obs.registry import Registry
+
+    reg = Registry()
+
+    def mutate(n: int):
+        def body() -> None:
+            for i in range(_OPS):
+                reg.counter("kftpu_stress_total", {"t": n}).inc()
+                reg.histogram("kftpu_stress_lat", (0.01, 0.1, 1.0)).observe(
+                    (i % 10) / 10.0
+                )
+                reg.gauge("kftpu_stress_g").set(i)
+        return body
+
+    def scrape() -> None:
+        for _ in range(_OPS // 2):
+            reg.expose()
+            reg.catalog()
+
+    _run_threads([mutate(n) for n in range(_THREADS - 1)] + [scrape])
+
+
+def _stress_store() -> None:
+    """store/store.py: concurrent CRUD with a sync subscriber that
+    re-enters the store (the RLock-reentrancy path _notify relies on)."""
+    from kubeflow_tpu.store.store import ObjectStore
+
+    store = ObjectStore(":memory:")
+
+    def on_event(ev) -> None:
+        # Sync subscribers may call back into the store from inside
+        # _notify (held lock): reentrancy is part of the contract.
+        store.get(ev.kind, ev.name, ev.namespace)
+
+    store.subscribe(on_event, kind="StressJob")
+
+    def churn(n: int):
+        def body() -> None:
+            for i in range(_OPS // 2):
+                name = f"job-{n}-{i % 5}"
+                store.put("StressJob", {
+                    "metadata": {"name": name, "namespace": "race"},
+                    "spec": {"i": i},
+                })
+                store.get("StressJob", name, "race")
+                store.list("StressJob", "race")
+                if i % 3 == 2:
+                    store.delete("StressJob", name, "race")
+        return body
+
+    _run_threads([churn(n) for n in range(_THREADS)])
+    store.close()
+
+
+def _stress_obsdb() -> None:
+    """hpo/obsdb.py: concurrent report/read/delete on one WAL db."""
+    from kubeflow_tpu.hpo.obsdb import ObservationDB
+
+    db = ObservationDB(":memory:")
+
+    def churn(n: int):
+        def body() -> None:
+            key = f"race/trial-{n}"
+            for i in range(_OPS // 3):
+                db.report_observation_log(
+                    key, {"loss": [(i, 1.0 / (i + 1))],
+                          "acc": [(i, i / 100.0)]},
+                )
+                db.get_observation_log(key, "loss")
+                db.trial_keys()
+        return body
+
+    _run_threads([churn(n) for n in range(_THREADS)])
+    db.close()
+
+
+def _stress_engine() -> None:
+    """serving/engine.py: the threaded driver loop vs concurrent
+    submitters (compiles a llama-tiny engine; the expensive driver)."""
+    import dataclasses
+
+    from kubeflow_tpu.models.llama import PRESETS
+    from kubeflow_tpu.serving.engine import GenerationEngine, Request
+
+    cfg = dataclasses.replace(PRESETS["llama-tiny"], max_seq=64)
+    eng = GenerationEngine(config=cfg, max_slots=2, decode_block=4)
+    try:
+        eng.start()
+        futs: List = []
+        fut_mu = threading.Lock()
+
+        def submit(n: int):
+            def body() -> None:
+                for i in range(3):
+                    f = eng.submit(Request([2 + n, 4 + i, 6],
+                                           max_new_tokens=4))
+                    with fut_mu:
+                        futs.append(f)
+                    eng._wake.set()
+            return body
+
+        _run_threads([submit(n) for n in range(2)])
+        for f in futs:
+            f.result(timeout=120)
+        eng.stop()
+    finally:
+        eng.close()
+
+
+STRESS_DRIVERS = [
+    ("trace", _stress_trace),
+    ("registry", _stress_registry),
+    ("store", _stress_store),
+    ("obsdb", _stress_obsdb),
+]
+# Separate because it compiles (jax import + jit): --no-serving and
+# fast test paths skip it; the lock wrapper still covers its locks
+# whenever it does run.
+ENGINE_DRIVER = ("engine", _stress_engine)
+
+
+def check_races(
+    include_engine: bool = True,
+    package_root: Optional[str] = None,
+) -> Tuple[List[Finding], Dict[str, float]]:
+    """Tier C race family: KT-GUARD01 static lint + the dynamic
+    lock-order watch over the stress drivers. Returns (findings, info);
+    info is display/log-only -- the counts grow with coverage and must
+    never enter the higher-is-worse metrics ratchet."""
+    findings = guard_lint(package_root)
+    drivers = list(STRESS_DRIVERS)
+    if include_engine:
+        drivers.append(ENGINE_DRIVER)
+    with LockOrderWatch() as watch:
+        for _name, fn in drivers:
+            fn()
+    findings.extend(watch.findings())
+    info = watch.stats()
+    info["race.drivers"] = float(len(drivers))
+    return findings, info

@@ -209,15 +209,20 @@ def cmd_analyze(args, _client) -> int:
     """
     from kubeflow_tpu import analysis
 
+    only = set(args.only or [])
     findings, metrics = analysis.run_analysis(
-        trace=not args.no_trace, serving=not args.no_serving
+        trace=not args.no_trace, serving=not args.no_serving,
+        families=(only - {"perf"}) if only else None,
     )
     # Perf-curve ratchet: committed bench floors + live-metric ceilings.
     # Violations are hard findings, so they ride the same strict gate and
     # are never grandfathered by --update-baseline (hard != countable).
-    perf_findings, perf_measured = analysis.check_perf(
-        analysis.load_perf_baseline(args.perf_baseline), metrics=metrics
-    )
+    perf_findings: list = []
+    perf_measured: dict = {}
+    if not only or "perf" in only:
+        perf_findings, perf_measured = analysis.check_perf(
+            analysis.load_perf_baseline(args.perf_baseline), metrics=metrics
+        )
     findings.extend(perf_findings)
     baseline = analysis.load_baseline(args.baseline)
     cmp = analysis.compare(findings, metrics, baseline)
@@ -464,6 +469,12 @@ def main(argv=None) -> int:
                     help="tier A (AST) only; skip jaxpr audits")
     sp.add_argument("--no-serving", action="store_true",
                     help="skip the serving-engine audit (fastest trace run)")
+    sp.add_argument("--only", action="append", default=None,
+                    metavar="FAMILY",
+                    choices=("astlint", "audit", "perf", "race", "proto"),
+                    help="run only the named analysis family "
+                         "(repeatable): astlint | audit | perf | race | "
+                         "proto. Default: all families.")
     sp.add_argument("--baseline", default=None,
                     help="baseline path (default: committed baseline.json)")
     sp.add_argument("--perf-baseline", default=None,

@@ -48,6 +48,10 @@ from kubeflow_tpu.controller.envvars import (
 )
 from kubeflow_tpu.controller.gang import GangScheduler
 from kubeflow_tpu.controller.launcher import BaseLauncher, SpawnRequest, WorkerRef
+from kubeflow_tpu.controller.reshard_protocol import (
+    clear_resize_command,
+    write_resize_command,
+)
 from kubeflow_tpu.controller.restarts import should_restart
 from kubeflow_tpu.obs import trace
 from kubeflow_tpu.obs.registry import REGISTRY
@@ -673,12 +677,8 @@ class JobController:
         el = job.spec.elastic
         rt.reshard_seq += 1
         seq = rt.reshard_seq
-        path = resize_file_path(job.spec.checkpoint.dir)
-        tmp = f"{path}.tmp"
-        with open(tmp, "w") as f:
-            json.dump({"seq": seq, "num_slices": n,
-                       "target_replicas": n}, f)
-        os.replace(tmp, path)  # atomic: workers never see a torn write
+        write_resize_command(resize_file_path(job.spec.checkpoint.dir),
+                             seq, n)
         rt.reshard_pending = (
             seq, n, time.time() + el.reshard_timeout_seconds
         )
@@ -707,10 +707,7 @@ class JobController:
         def fallback(reason: str) -> None:
             rt.reshard_pending = None
             rt.reshard_fallback = True
-            try:
-                os.unlink(resize_file_path(job.spec.checkpoint.dir))
-            except OSError:
-                pass
+            clear_resize_command(resize_file_path(job.spec.checkpoint.dir))
             self._record_event(
                 job, "ReshardFallback",
                 f"{reason}; falling back to checkpoint-restart",
@@ -1280,10 +1277,7 @@ class JobController:
                         ckdir = (TrainJob.from_dict(obj)
                                  .spec.checkpoint.dir)
                         if ckdir:
-                            try:
-                                os.unlink(resize_file_path(ckdir))
-                            except OSError:
-                                pass
+                            clear_resize_command(resize_file_path(ckdir))
             if release:
                 self.gang.release(key)
                 self._backoff_until.pop(key, None)

@@ -1,0 +1,78 @@
+"""Reshard command-file protocol: the controller/worker wire format.
+
+One tiny module owns the three filesystem operations of the reshard
+command/ack protocol (docs/ELASTICITY.md) so the controller writer
+(`controller/reconciler.py`), the worker poller (`runtime/entry.py`),
+and the Tier C protocol model checker (`analysis/protocheck.py`) all
+drive the *same* code — the checker's conformance pass executes these
+functions under checker-chosen schedules, so the model can't drift
+from the implementation.
+
+Protocol summary:
+
+- ``write_resize_command`` publishes ``{"seq", "num_slices",
+  "target_replicas"}`` atomically (pid-unique staging name +
+  ``os.replace``): a polling worker never sees a torn write, and two
+  controller processes pointed at the same checkpoint dir never
+  clobber each other's staging file.
+- ``read_resize_command`` returns the command only when its ``seq``
+  advances past the caller's ``last_seq`` — re-delivery of an applied
+  command is a no-op, which is what makes the file (rather than a
+  stream) a safe transport.
+- ``clear_resize_command`` removes the file; called on nack/timeout
+  fallback and at gang teardown, because a command file must never
+  outlive its gang generation (a respawned worker restarts at seq 0
+  and would re-apply the stale command).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+
+def write_resize_command(path: str, seq: int, num_slices: int) -> None:
+    """Atomically publish a resize command for the workers polling
+    ``path``. The staging name carries the writer's pid (the
+    ``obs/trace.py`` pattern): concurrent writers — two controllers, or
+    a controller racing its own respawn — stage to distinct names, so
+    the only shared mutation is the atomic ``os.replace``."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"seq": seq, "num_slices": num_slices,
+                   "target_replicas": num_slices}, f)
+    os.replace(tmp, path)  # atomic: pollers never see a torn write
+
+
+def read_resize_command(
+    path: Optional[str], last_seq: int
+) -> Optional[Dict[str, Any]]:
+    """Return the pending resize command iff its seq advances past
+    ``last_seq``; None for absent/torn/stale/malformed files. Torn
+    reads can't happen with ``write_resize_command`` but a truncated
+    or hand-edited file must not crash the training loop."""
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            cmd = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(cmd, dict):
+        return None
+    try:
+        seq = int(cmd.get("seq", 0))
+    except (TypeError, ValueError):
+        return None
+    return cmd if seq > last_seq else None
+
+
+def clear_resize_command(path: str) -> None:
+    """Remove the command file (fallback latch / gang teardown);
+    missing file is fine — clearing is idempotent and races with a
+    worker that already consumed the command."""
+    try:
+        os.unlink(path)
+    except OSError:
+        pass

@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import argparse
 import contextlib
-import json
 import logging
 import os
 import sys
@@ -22,26 +21,15 @@ import time
 
 from kubeflow_tpu.obs import trace
 
+# The command-file reader lives in the shared protocol module (one
+# implementation for the worker poller, the controller writer, and the
+# Tier C model checker's conformance pass); re-exported here because
+# this is the seam the worker step loop and its tests import it from.
+from kubeflow_tpu.controller.reshard_protocol import (  # noqa: F401
+    read_resize_command,
+)
+
 logger = logging.getLogger(__name__)
-
-
-def read_resize_command(path, last_seq: int):
-    """Parse the controller's resize-command file (KFTPU_RESIZE_FILE,
-    written by the reconciler's reshard-in-place mode). Returns the
-    command dict when it carries a seq newer than ``last_seq``, else
-    None (missing, malformed-while-being-written, or already handled)."""
-    if not path:
-        return None
-    try:
-        with open(path) as f:
-            cmd = json.load(f)
-    except (OSError, ValueError):
-        return None
-    try:
-        seq = int(cmd.get("seq", 0))
-    except (TypeError, ValueError):
-        return None
-    return cmd if seq > last_seq else None
 
 
 def parse_args(argv=None):

@@ -2964,7 +2964,11 @@ class GenerationEngine:
             self.decode_dispatches += 1
         if self._gap_t is not None:
             self._ema_gap((time.perf_counter() - self._gap_t) * 1000.0)
-            self._gap_t = None
+            # Single-stepper invariant: step() is driven EITHER by the
+            # start() loop thread OR inline by generate() (which only
+            # waits on the future once _thread is set) -- never both,
+            # so the gap clock has one writer at a time.
+            self._gap_t = None  # kt-lint: disable=KT-GUARD01 -- single-stepper: loop thread XOR inline generate() drives step()
 
     def _ema_gap(self, ms: float) -> None:
         if self.host_gap_ms_ema is None:

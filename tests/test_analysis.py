@@ -132,6 +132,38 @@ def test_unused_import_rule_and_noqa(tmp_path):
     assert quiet == []
 
 
+def test_atomic_staging_rule(tmp_path):
+    bad = (
+        "import json, os\n"
+        "def write(path, obj):\n"
+        "    tmp = f\"{path}.tmp\"\n"
+        "    with open(tmp, 'w') as f:\n"
+        "        json.dump(obj, f)\n"
+        "    os.replace(tmp, path)\n"
+    )
+    findings = lint_source(tmp_path, bad)
+    assert [f.rule for f in findings] == ["KT-ATOMIC01"]
+    assert findings[0].line == 6
+    # The obs/trace.py idiom -- pid-suffixed staging -- is the fix.
+    good = bad.replace("{path}.tmp", "{path}.tmp.{os.getpid()}")
+    assert lint_source(tmp_path, good) == []
+    # Any uniqueness source counts, not just getpid.
+    uuid = bad.replace("import json, os\n", "import json, os, uuid\n")
+    uuid = uuid.replace("{path}.tmp", "{path}.{uuid.uuid4().hex}")
+    assert lint_source(tmp_path, uuid) == []
+
+
+def test_atomic_staging_rule_skips_unresolvable_names(tmp_path):
+    # A staging name we cannot resolve locally (function parameter) is
+    # not flagged: the rule only fires when every resolution is bare.
+    src = (
+        "import os\n"
+        "def publish(tmp, path):\n"
+        "    os.replace(tmp, path)\n"
+    )
+    assert lint_source(tmp_path, src) == []
+
+
 def test_suppression_requires_justification(tmp_path):
     base = (
         "import jax\n"
@@ -373,6 +405,62 @@ def test_cli_update_then_ratchet(monkeypatch, capsys, tmp_path):
     rc, _ = _run_cli(monkeypatch, capsys, [_soft(), _soft(line=7)], {},
                      ["--strict", "--baseline", str(base)])
     assert rc == 1
+
+
+def test_cli_only_routes_families(monkeypatch, capsys, tmp_path):
+    from kubeflow_tpu.cli import main as cli_main
+
+    base = tmp_path / "b.json"
+    base.write_text(json.dumps({"counts": {}, "metrics": {}}))
+    seen = {}
+    perf_calls = []
+    monkeypatch.setattr(
+        analysis, "run_analysis",
+        lambda **kw: (seen.update(kw), ([], {}))[1])
+    monkeypatch.setattr(
+        analysis, "check_perf",
+        lambda *a, **kw: (perf_calls.append(1), ([], {}))[1])
+
+    rc = cli_main.main(["analyze", "--only", "race", "--only", "proto",
+                        "--baseline", str(base)])
+    assert rc == 0
+    assert seen["families"] == {"race", "proto"}
+    assert not perf_calls, "--only race/proto must not run the perf ratchet"
+
+    rc = cli_main.main(["analyze", "--only", "perf",
+                        "--baseline", str(base)])
+    assert rc == 0
+    assert seen["families"] == set(), "--only perf runs no other family"
+    assert perf_calls
+
+    seen.clear()
+    rc = cli_main.main(["analyze", "--baseline", str(base)])
+    assert rc == 0
+    assert seen["families"] is None, "no --only: run_analysis default set"
+    capsys.readouterr()
+
+
+def test_run_analysis_rejects_unknown_family():
+    with pytest.raises(ValueError, match="unknown analysis families"):
+        analysis.run_analysis(families={"astlint", "fuzz"})
+
+
+def test_run_analysis_family_selection_is_exact(monkeypatch):
+    # families={} runs nothing at all; families={"astlint"} runs only
+    # the AST pass (no jax import, no stress drivers).
+    findings, metrics = analysis.run_analysis(families=set())
+    assert findings == [] and metrics == {}
+    findings, _ = analysis.run_analysis(families={"astlint"})
+    from kubeflow_tpu.analysis import astlint as astlint_mod
+
+    assert len(findings) == len(astlint_mod.lint_package())
+
+
+def test_baseline_registers_all_families():
+    data = analysis.load_baseline()
+    assert set(data["families"]) == set(analysis.FAMILIES)
+    assert data["families"]["race"]["hard_rules"] == ["KT-RACE-ORDER"]
+    assert "KT-PROTO-CONFORM" in data["families"]["proto"]["hard_rules"]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,205 @@
+"""Tier C proto family: the control-plane protocol model checker, its
+conformance replay against the real command-file code, and the shared
+wire helpers in controller/reshard_protocol.py.
+
+Each PLANTED_MUTATIONS bug shape must produce its expected KT-PROTO-*
+rule AND flip `kftpu analyze --strict --only proto` to exit 1 -- the
+checker's value is exactly the bugs it refuses to let back in.
+"""
+
+import json
+import os
+
+import pytest
+
+from kubeflow_tpu.analysis import protocheck
+from kubeflow_tpu.analysis.protocheck import (
+    GangModel,
+    ReshardModel,
+    WriterModel,
+    check_protocols,
+    conformance_check,
+    explore,
+)
+from kubeflow_tpu.controller.reshard_protocol import (
+    clear_resize_command,
+    read_resize_command,
+    write_resize_command,
+)
+
+
+# ---------------------------------------------------------------------------
+# The wire helpers (satellite fix: pid-unique staging, atomic publish).
+# ---------------------------------------------------------------------------
+
+def test_wire_roundtrip_and_seq_guard(tmp_path):
+    path = str(tmp_path / "ckpt.resize.json")
+    assert read_resize_command(path, 0) is None  # absent
+    write_resize_command(path, seq=1, num_slices=4)
+    cmd = read_resize_command(path, 0)
+    assert cmd["seq"] == 1 and cmd["num_slices"] == 4
+    # Applied seq never re-delivers; a newer one does.
+    assert read_resize_command(path, 1) is None
+    write_resize_command(path, seq=2, num_slices=4)
+    assert read_resize_command(path, 1)["seq"] == 2
+    clear_resize_command(path)
+    assert read_resize_command(path, 0) is None
+    clear_resize_command(path)  # idempotent
+
+
+def test_wire_staging_is_pid_unique(tmp_path):
+    path = str(tmp_path / "ckpt.resize.json")
+    write_resize_command(path, seq=1, num_slices=2)
+    # No bare ".tmp" staging file may survive (or even be used: the
+    # staging name embeds the pid so concurrent writers can't clobber
+    # each other -- the KT-ATOMIC01 contract).
+    assert os.listdir(tmp_path) == ["ckpt.resize.json"]
+    assert read_resize_command(f"{path}.tmp", 0) is None
+
+
+def test_wire_torn_and_malformed_files(tmp_path):
+    path = str(tmp_path / "ckpt.resize.json")
+    with open(path, "w") as f:
+        f.write('{"seq": 1, "num_sl')  # torn write
+    assert read_resize_command(path, 0) is None
+    with open(path, "w") as f:
+        json.dump(["not", "a", "dict"], f)
+    assert read_resize_command(path, 0) is None
+
+
+# ---------------------------------------------------------------------------
+# The explorer itself: stuck / livelock detection on toy models.
+# ---------------------------------------------------------------------------
+
+class _ToyModel:
+    name = "toy"
+    path = "toy"
+
+    def __init__(self, edges, terminals):
+        self.edges = edges
+        self.terminals = terminals
+
+    def initial(self):
+        return ("s0",)
+
+    def is_terminal(self, s):
+        return s[0] in self.terminals
+
+    def invariant(self, s):
+        return None
+
+    def actions(self, s):
+        return [(f"{s[0]}->{d}", (d,)) for d in self.edges.get(s[0], ())]
+
+
+def test_explorer_flags_dead_state():
+    res = explore(_ToyModel({"s0": ["dead"]}, terminals=set()))
+    assert [f.rule for f in res.findings] == ["KT-PROTO-STUCK"]
+    assert "no enabled action" in res.findings[0].message
+
+
+def test_explorer_flags_livelock():
+    # s0 <-> s1 spin forever; "end" is terminal but unreachable.
+    res = explore(_ToyModel({"s0": ["s1"], "s1": ["s0"]},
+                            terminals={"end"}))
+    assert [f.rule for f in res.findings] == ["KT-PROTO-STUCK"]
+    assert "livelock" in res.findings[0].message
+
+
+def test_explorer_clean_model_reports_terminals():
+    res = explore(_ToyModel({"s0": ["end"]}, terminals={"end"}))
+    assert res.findings == [] and res.terminals == [("end",)]
+
+
+# ---------------------------------------------------------------------------
+# The shipped protocols are clean; every planted bug shape is caught.
+# ---------------------------------------------------------------------------
+
+def test_shipped_protocols_are_clean():
+    findings, info = check_protocols(mutations=set())
+    assert findings == [], [f.format() for f in findings]
+    assert info["proto.reshard.states"] > 10, "reshard model is non-trivial"
+    assert info["proto.conform.traces"] > 0, "conformance replay ran"
+
+
+@pytest.mark.parametrize("mutation,expected_rule", [
+    # Skip the unlink in the nack/timeout fallback: the respawned
+    # worker (seq counter reset) re-applies the stale command.
+    ("no_unlink_on_fallback", "KT-PROTO-DOUBLE"),
+    # Skip the unlink in _teardown: the file outlives the generation.
+    ("no_unlink_on_teardown", "KT-PROTO-RESIDUE"),
+    # Drop read_resize_command's seq > last_seq guard: re-delivery.
+    ("no_seq_guard", "KT-PROTO-DOUBLE"),
+    # Gang cleanup forgets to return the reservation to the pool.
+    ("leak_reservation", "KT-PROTO-RESIDUE"),
+    # scheduler_managed jobs arm the per-job metric scaler anyway:
+    # two resize authorities actuate one job.
+    ("no_managed_gate", "KT-PROTO-WRITER"),
+])
+def test_planted_mutation_is_caught(mutation, expected_rule):
+    findings, _ = check_protocols(mutations={mutation}, conformance=False)
+    rules = {f.rule for f in findings}
+    assert expected_rule in rules, (mutation, sorted(rules))
+    assert all(f.hard for f in findings), "protocol bugs are never soft"
+
+
+def test_planted_mutation_flips_cli_strict(monkeypatch, capsys):
+    from kubeflow_tpu.cli import main as cli_main
+
+    monkeypatch.setattr(protocheck, "PLANTED_MUTATIONS",
+                        {"no_unlink_on_fallback"})
+    rc = cli_main.main(["analyze", "--strict", "--only", "proto", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["rule"].startswith("KT-PROTO-") for f in out["new"])
+
+
+# ---------------------------------------------------------------------------
+# Conformance: the replay pins the model to the real wire code.
+# ---------------------------------------------------------------------------
+
+def test_conformance_clean_on_real_wire_code(tmp_path):
+    findings, n_traces = conformance_check(str(tmp_path))
+    assert findings == [], [f.format() for f in findings]
+    assert n_traces > 0
+
+
+def test_conformance_catches_reader_drift(monkeypatch, tmp_path):
+    # A reader that drops the seq guard (delivers stale commands) must
+    # diverge from the model's delivery prediction.
+    real = protocheck.read_resize_command
+
+    def no_guard_reader(path, last_seq):
+        return real(path, 0)
+
+    monkeypatch.setattr(protocheck, "read_resize_command", no_guard_reader)
+    findings, _ = conformance_check(str(tmp_path))
+    assert any(f.rule == "KT-PROTO-CONFORM" for f in findings)
+    assert all(f.hard for f in findings)
+
+
+def test_conformance_catches_writer_drift(monkeypatch, tmp_path):
+    # A clear that silently stops unlinking must leave the reader
+    # delivering a file the model believes is gone.
+    monkeypatch.setattr(protocheck, "clear_resize_command",
+                        lambda path: None)
+    findings, _ = conformance_check(str(tmp_path))
+    assert any(f.rule == "KT-PROTO-CONFORM" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Model-shape regressions.
+# ---------------------------------------------------------------------------
+
+def test_reshard_model_state_space_is_bounded():
+    res = explore(ReshardModel(frozenset()))
+    assert res.states < 1000, "small-scope model blew up"
+    assert res.terminals, "some schedule must finish the job"
+
+
+def test_gang_and_writer_models_are_clean():
+    for model in (GangModel(frozenset()),
+                  WriterModel(managed=True),
+                  WriterModel(managed=False)):
+        res = explore(model)
+        assert res.findings == [], model.name

@@ -1,0 +1,265 @@
+"""Tier C race family: the lock-order watch and the KT-GUARD01 lint.
+
+Non-vacuity is the point of most of these tests: a planted lock-order
+inversion and a planted unguarded shared counter must surface as
+findings AND flip `kftpu analyze --strict` to exit 1 -- a race detector
+that never fires is indistinguishable from no race detector.
+"""
+
+import json
+import threading
+
+from kubeflow_tpu.analysis import racecheck
+from kubeflow_tpu.analysis.racecheck import (
+    LockOrderWatch,
+    check_races,
+    guard_lint,
+)
+
+
+def _run_sequential(*fns):
+    """Run each fn in its own thread, one after another (sequential
+    joins): the order GRAPH still records every inversion, with zero
+    risk of the test itself deadlocking on the planted cycle."""
+    for i, fn in enumerate(fns):
+        t = threading.Thread(target=fn, name=f"seq-{i}")
+        t.start()
+        t.join()
+
+
+# ---------------------------------------------------------------------------
+# KT-RACE-ORDER: the dynamic lock-order watch.
+# ---------------------------------------------------------------------------
+
+def test_planted_inversion_is_caught():
+    with LockOrderWatch() as w:
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        _run_sequential(ab, ba)
+    findings = w.findings()
+    assert [f.rule for f in findings] == ["KT-RACE-ORDER"]
+    assert findings[0].hard, "an ordering cycle must never be grandfathered"
+    assert "cycle" in findings[0].message
+
+
+def test_consistent_order_is_clean():
+    with LockOrderWatch() as w:
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        _run_sequential(ab, ab)
+    assert w.findings() == []
+    assert w.stats()["race.order_edges"] == 1.0
+
+
+def test_reentrant_rlock_records_no_self_edge():
+    with LockOrderWatch() as w:
+        r = threading.RLock()
+
+        def reenter():
+            with r:
+                with r:  # same lock: reentrancy, not an ordering edge
+                    pass
+
+        _run_sequential(reenter)
+    assert w.findings() == []
+    assert w.stats()["race.order_edges"] == 0.0
+
+
+def test_condition_works_under_watch():
+    # Condition wraps the patched RLock and probes _is_owned /
+    # _release_save / _acquire_restore; wait/notify must still work.
+    with LockOrderWatch() as w:
+        cond = threading.Condition()
+        ready = []
+
+        def waiter():
+            with cond:
+                while not ready:
+                    cond.wait(timeout=5)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        with cond:
+            ready.append(1)
+            cond.notify()
+        t.join(timeout=5)
+        assert not t.is_alive()
+    assert w.findings() == []
+
+
+def test_stdlib_locks_are_untracked():
+    import queue
+
+    with LockOrderWatch() as w:
+        q = queue.Queue()  # creates locks from stdlib code paths
+        q.put(1)
+        assert q.get() == 1
+    assert w.stats()["race.locks_tracked"] == 0.0
+    assert w.stats()["race.locks_created"] >= 1.0
+
+
+def test_watch_restores_factories_on_exit():
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    with LockOrderWatch():
+        assert threading.Lock is not orig_lock
+        assert threading.RLock is not orig_rlock
+    assert threading.Lock is orig_lock
+    assert threading.RLock is orig_rlock
+
+
+# ---------------------------------------------------------------------------
+# KT-GUARD01: unguarded writes shared with a thread body.
+# ---------------------------------------------------------------------------
+
+def _plant(tmp_path, source):
+    pkg = tmp_path / "plantedpkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(source)
+    return str(pkg)
+
+
+_UNGUARDED = """\
+import threading
+
+class Worker:
+    def __init__(self):
+        self.n = 0
+        self._t = None
+
+    def start(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        for _ in range(10):
+            self.n += 1
+
+    def bump(self):
+        self.n += 1
+"""
+
+
+def test_guard01_planted_unguarded_counter(tmp_path):
+    findings = guard_lint(package_root=_plant(tmp_path, _UNGUARDED))
+    assert [f.rule for f in findings] == ["KT-GUARD01"]
+    assert "'n' of Worker" in findings[0].message
+    # _t is exempt (Thread(...) is a sync ctor), __init__ is exempt
+    # (happens-before start), so exactly the counter fires.
+
+
+def test_guard01_common_lock_is_clean(tmp_path):
+    guarded = _UNGUARDED.replace(
+        "        self.n = 0\n",
+        "        self.n = 0\n        self._mu = threading.Lock()\n",
+    ).replace(
+        "            self.n += 1\n",
+        "            with self._mu:\n                self.n += 1\n",
+    ).replace(
+        "        self.n += 1\n",
+        "        with self._mu:\n            self.n += 1\n",
+    )
+    assert guard_lint(package_root=_plant(tmp_path, guarded)) == []
+
+
+def test_guard01_post_join_write_is_clean(tmp_path):
+    barriered = _UNGUARDED.replace(
+        "    def bump(self):\n        self.n += 1\n",
+        "    def stop(self):\n"
+        "        self._t.join()\n"
+        "        self.n = 0\n",
+    )
+    assert guard_lint(package_root=_plant(tmp_path, barriered)) == []
+
+
+def test_guard01_suppression_tag(tmp_path):
+    suppressed = _UNGUARDED.replace(
+        "    def bump(self):\n        self.n += 1\n",
+        "    def bump(self):\n"
+        "        self.n += 1"
+        "  # kt-lint: disable=KT-GUARD01 -- test-only: single caller\n",
+    )
+    assert guard_lint(package_root=_plant(tmp_path, suppressed)) == []
+
+
+def test_shipped_tree_is_guard_clean():
+    # The satellite contract: every KT-GUARD01 on the real tree is
+    # either fixed or carries a justified kt-lint disable tag.
+    assert guard_lint() == []
+
+
+# ---------------------------------------------------------------------------
+# check_races + the CLI strict gate (planted regressions flip exit 1).
+# ---------------------------------------------------------------------------
+
+def test_check_races_clean_without_engine():
+    findings, info = check_races(include_engine=False)
+    assert findings == []
+    assert info["race.drivers"] == float(len(racecheck.STRESS_DRIVERS))
+    assert info["race.acquires"] > 0, "stress drivers must exercise locks"
+
+
+def _planted_inversion_driver():
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    for i, fn in enumerate((ab, ba)):
+        t = threading.Thread(target=fn, name=f"planted-{i}")
+        t.start()
+        t.join()
+
+
+def test_cli_strict_catches_planted_inversion(monkeypatch, capsys):
+    from kubeflow_tpu.cli import main as cli_main
+
+    monkeypatch.setattr(
+        racecheck, "STRESS_DRIVERS",
+        [("planted", _planted_inversion_driver)],
+    )
+    rc = cli_main.main(
+        ["analyze", "--strict", "--only", "race", "--no-serving", "--json"]
+    )
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["rule"] == "KT-RACE-ORDER" for f in out["new"])
+
+
+def test_cli_strict_catches_planted_guard01(monkeypatch, capsys, tmp_path):
+    from kubeflow_tpu.cli import main as cli_main
+
+    monkeypatch.setattr(
+        racecheck, "PACKAGE_ROOT", _plant(tmp_path, _UNGUARDED)
+    )
+    monkeypatch.setattr(racecheck, "STRESS_DRIVERS", [])
+    rc = cli_main.main(
+        ["analyze", "--strict", "--only", "race", "--no-serving", "--json"]
+    )
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["rule"] == "KT-GUARD01" for f in out["new"])
