@@ -1148,7 +1148,23 @@ def _fleet_worker_main(cfg: dict) -> int:
                     time.sleep((len(prompt) - hit_plen)
                                / prefill_rate * scale)
                 ttft_ms = (time.perf_counter() - t_arr) / scale * 1000.0
-                time.sleep(ntok / decode_rate * scale)
+                stream = int(op.get("stream", 0))
+                if stream > 0:
+                    # Streamed decode: emit token-offset events as they
+                    # are produced. A SIGKILL mid-decode leaves the
+                    # parent holding a prefix of these offsets; the
+                    # retry replays the stream from offset 0 and the
+                    # chaos driver must dedup -- the same contract as
+                    # the activator's resume-by-offset SSE path.
+                    off = 0
+                    while off < ntok:
+                        n = min(stream, ntok - off)
+                        time.sleep(n / decode_rate * scale)
+                        reply({"id": op["id"], "rid": rid,
+                               "part": True, "off": off, "n": n})
+                        off += n
+                else:
+                    time.sleep(ntok / decode_rate * scale)
                 covered = (len(prompt) // block) * block
                 if covered:
                     rows = np.zeros((1, covered, 1, 1), np.int8)
@@ -1723,6 +1739,396 @@ def _fleet_disagg_arm(base64, queue_mod, np, obs_trace, rt) -> dict:
     return out
 
 
+def bench_chaos(args: dict) -> dict:
+    """Chaos-hardened fleet arm: a seeded FaultPlan SIGKILLs one sim
+    replica mid-load and the recovery machinery is MEASURED, not
+    asserted: request loss after retry re-dispatch (target: zero),
+    duplicated streamed tokens after resume-by-offset dedup (target:
+    zero), wall-clock recovery (kill -> replacement ready, the real
+    subprocess respawn), and the fault-window TTFT p99 against steady
+    state. Detection is failure-driven -- the dead replica's stats RPCs
+    break, note_poll failures trip its breaker, the ring re-syncs --
+    never "the driver knows it killed the worker". Ratcheted hard as
+    KT-PERF-CHAOS off extra.chaos (analysis/perf.py)."""
+    import queue as queue_mod
+    import random as random_mod
+    import signal as signal_mod
+    import threading
+
+    import numpy as np
+
+    from kubeflow_tpu.chaos import FaultPlan
+    from kubeflow_tpu.serving import router as rt
+
+    block = int(args.get("block", 128))
+    scale = float(args.get("time_scale", 0.05))
+    slots = int(args.get("max_slots", 8))
+    n_req = int(args.get("requests", 150))
+    n_workers = int(args.get("workers", 3))
+    stream_every = int(args.get("stream_every", 8))
+    prefill_rate = float(args.get("prefill_tok_per_s", 3000.0))
+    decode_rate = float(args.get("decode_tok_per_slot", 14.4))
+    victim = str(args.get("victim", "1"))
+    # Fires on the kill_hit-th dispatch TO the victim (~3x that many
+    # requests in, with 3 replicas) -- early enough that plenty of
+    # post-recovery arrivals remain to measure the re-admitted replica.
+    kill_hit = int(args.get("kill_hit", 12))
+
+    plan_json = json.dumps({
+        "seed": int(args.get("seed", 20260805)),
+        "faults": [{"kind": "crash", "site": "bench.dispatch",
+                    "target": victim, "at": [kill_hit]}],
+    })
+    plan = FaultPlan.from_json(plan_json)
+
+    done_q = queue_mod.Queue()
+
+    def wcfg(rid):
+        return {"backend": "sim", "rid": rid, "role": "mixed",
+                "block": block, "max_slots": slots, "time_scale": scale,
+                "prefill_tok_per_s": prefill_rate,
+                "decode_tok_per_slot": decode_rate, "cache_mb": 64}
+
+    by_rid = {str(i): _FleetWorker(wcfg(str(i)), done_q)
+              for i in range(n_workers)}
+    for w in by_rid.values():
+        w.wait_ready(timeout=300)
+    lock = threading.Lock()
+
+    router = rt.Router(rt.RouterConfig(
+        block=block, breaker_threshold=2, breaker_reset_s=0.2,
+    ), name="chaos")
+    for rid in by_rid:
+        router.add_replica(rid, max_slots=slots)
+
+    reqs = _fleet_workload("uniform", n_req, block,
+                           np.random.default_rng(29))
+    t_short = (2 * block + 32) / prefill_rate + 64.0 / decode_rate
+    rate = float(args.get("rate_rps", 1.5 * slots / t_short))
+
+    # id -> request state; "offs" is the set of DELIVERED token
+    # offsets, the parent-side image of the activator's skip-by-offset
+    # resume: a replayed offset is skipped, never re-delivered.
+    pending: dict = {}
+    fault = {"t_kill": None, "t_ready": None, "respawned": False,
+             "send_errors": 0}
+    stop_poll = threading.Event()
+
+    def poll():
+        while not stop_poll.is_set():
+            for rid in list(by_rid):
+                with lock:
+                    w = by_rid[rid]
+                try:
+                    st = w.rpc({"op": "stats"}, timeout=5).get("stats")
+                except Exception:  # noqa: BLE001 - dead replica's pipe
+                    router.note_poll(rid, ok=False)
+                    continue
+                router.note_poll(rid, ok=True)
+                if st:
+                    router.update_load(rid, st)
+            stop_poll.wait(1.0 * scale)
+
+    def respawn():
+        w = _FleetWorker(wcfg(victim), done_q)
+        w.wait_ready(timeout=300)
+        with lock:
+            by_rid[victim] = w
+        fault["t_ready"] = time.perf_counter()
+        fault["respawned"] = True
+        # The replacement answered its readiness hello: the probe
+        # success closes the breaker and re-syncs the ring, exactly the
+        # controller's _probe_ready -> record_success path.
+        router.record_success(victim)
+
+    def send_to(rid, i, st):
+        op = {"op": "gen", "id": i, "prompt": st["prompt"],
+              "new_tokens": st["ntok"]}
+        if st["stream"]:
+            op["stream"] = stream_every
+        with lock:
+            w = by_rid[rid]
+        w.send(op)
+
+    def dispatch(i, st):
+        """Route + send with breaker-aware retry; None when shed or no
+        route survived. A send onto a dead pipe feeds record_failure --
+        the request-error half of failure-driven ejection."""
+        for _ in range(n_workers + 1):
+            d = router.route(
+                rt.prefix_route_key(st["prompt"], block=block),
+                prompt_len=len(st["prompt"]))
+            if d.kind == "shed" or d.replica is None:
+                return None
+            try:
+                send_to(d.replica, i, st)
+            except Exception:  # noqa: BLE001 - dead replica's pipe
+                fault["send_errors"] += 1
+                router.record_failure(d.replica)
+                continue
+            router.start_request(d.replica)
+            st["rid"] = d.replica
+            st["attempts"] += 1
+            return d.replica
+        return None
+
+    def pump(msg):
+        st = pending.get(msg.get("id"))
+        if st is None:
+            return
+        now = time.perf_counter()
+        if msg.get("part"):
+            if st["done"]:
+                return  # late replay of an answered request: dropped
+            if st["t_first"] is None:
+                st["t_first"] = now
+            off, n = int(msg["off"]), int(msg["n"])
+            fresh = [o for o in range(off, off + n)
+                     if o not in st["offs"]]
+            st["skipped"] += n - len(fresh)
+            st["offs"].update(fresh)
+            st["delivered"] += len(fresh)
+            return
+        if st["done"]:
+            st["dup_final"] += 1  # idempotent re-dispatch: second
+            return                # completion acknowledged, not served
+        st["done"] = True
+        if st["t_first"] is None:
+            st["t_first"] = now
+        st["t_done"] = now
+        router.finish_request(msg.get("rid", st["rid"]))
+
+    def sweep_dead():
+        """Re-dispatch every in-flight request whose home replica fell
+        out of the ring -- the activator's connection-error retry."""
+        n = 0
+        live = router.ring.nodes()
+        for i2, st in list(pending.items()):
+            if st["done"] or st["rid"] is None or st["rid"] in live:
+                continue
+            router.finish_request(st["rid"])
+            if os.environ.get("KFTPU_CHAOS_DEBUG"):
+                print(f"SWEEP id={i2} stream={st['stream']} "
+                      f"delivered={st['delivered']}", file=sys.stderr)
+            if dispatch(i2, st) is not None:
+                n += 1
+        return n
+
+    def resume_probe():
+        """Deterministic stream-resume coverage: the fleet arm's kill
+        may or may not catch a stream mid-decode (routing is hashed,
+        the overlap is timing), so this probe FORCES the case -- one
+        stream known to be mid-decode when its replica dies, replayed
+        in full on a survivor, deduped by offset. The dup count feeds
+        the ratcheted stream_dup_tokens."""
+        q2 = queue_mod.Queue()
+        a = _FleetWorker(dict(wcfg("probe-a"), max_slots=1), q2)
+        b = _FleetWorker(dict(wcfg("probe-b"), max_slots=1), q2)
+        a.wait_ready(timeout=300)
+        b.wait_ready(timeout=300)
+        ntok = 256
+        op = {"op": "gen", "id": 0,
+              "prompt": list(range(1, block + 1)),
+              "new_tokens": ntok, "stream": stream_every}
+        offs: set = set()
+        delivered = skipped = 0
+        try:
+            a.send(op)
+            while delivered < 3 * stream_every:  # provably mid-decode
+                msg = q2.get(timeout=120)
+                if not msg.get("part"):
+                    continue
+                for o in range(int(msg["off"]),
+                               int(msg["off"]) + int(msg["n"])):
+                    if o in offs:
+                        skipped += 1
+                    else:
+                        offs.add(o)
+                        delivered += 1
+            os.kill(a.proc.pid, signal_mod.SIGKILL)
+            b.send(op)  # the activator's retry: full replay, dedup here
+            while True:
+                msg = q2.get(timeout=120)
+                if msg.get("part"):
+                    for o in range(int(msg["off"]),
+                                   int(msg["off"]) + int(msg["n"])):
+                        if o in offs:
+                            skipped += 1
+                        else:
+                            offs.add(o)
+                            delivered += 1
+                elif msg.get("id") == 0:
+                    break
+        finally:
+            a.stop(timeout=30)
+            b.stop(timeout=30)
+        return {
+            "new_tokens": ntok,
+            "delivered_before_kill": 3 * stream_every,
+            "tokens_delivered": delivered,
+            "tokens_skipped_on_resume": skipped,
+            "dup_tokens": max(0, delivered - ntok),
+            "resumed": skipped > 0,
+            "complete": delivered == ntok,
+        }
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    arrival_rng = random_mod.Random(4321)
+    shed = redispatched = 0
+    killed = swept = False
+    t_start = time.perf_counter()
+    t_next = t_start
+    try:
+        for i, (prompt, ntok) in enumerate(reqs):
+            t_next += arrival_rng.expovariate(rate) * scale
+            while True:
+                dt = t_next - time.perf_counter()
+                if dt <= 0:
+                    break
+                try:
+                    pump(done_q.get(timeout=dt))
+                except queue_mod.Empty:
+                    break
+            if killed and not swept and victim not in router.ring.nodes():
+                redispatched += sweep_dead()  # breaker tripped: retry
+                swept = True                  # the victim's in-flight
+            st = {"prompt": prompt, "ntok": ntok,
+                  "stream": bool(stream_every and i % 2 == 0),
+                  "rid": None, "attempts": 0,
+                  "t_sent": time.perf_counter(), "t_first": None,
+                  "t_done": None, "done": False, "offs": set(),
+                  "delivered": 0, "skipped": 0, "dup_final": 0}
+            pending[i] = st
+            rid = dispatch(i, st)
+            if rid is None:
+                shed += 1
+                del pending[i]
+                continue
+            f = plan.poke("bench.dispatch", rid)
+            if f is not None and f.kind == "crash" and not killed:
+                killed = True
+                fault["t_kill"] = time.perf_counter()
+                with lock:
+                    doomed = by_rid[rid]
+                os.kill(doomed.proc.pid, signal_mod.SIGKILL)
+                threading.Thread(target=respawn, daemon=True).start()
+        deadline = time.perf_counter() + 120.0
+        while (any(not st["done"] for st in pending.values())
+               and time.perf_counter() < deadline):
+            if killed and not swept and victim not in router.ring.nodes():
+                redispatched += sweep_dead()
+                swept = True
+            try:
+                pump(done_q.get(timeout=1.0))
+            except queue_mod.Empty:
+                # 1s wall of silence = 20 sim-seconds with nothing
+                # completing: re-dispatch stragglers (idempotent -- a
+                # duplicate completion is deduped by id in pump()).
+                redispatched += sweep_dead()
+    finally:
+        stop_poll.set()
+        poller.join(timeout=10)
+        with lock:
+            workers = list(by_rid.values())
+        for w in workers:
+            w.stop(timeout=30)
+    probe = resume_probe()
+
+    def ttft_ms(st):
+        return (st["t_first"] - st["t_sent"]) / scale * 1000.0
+
+    def e2e_ms(st):
+        return (st["t_done"] - st["t_sent"]) / scale * 1000.0
+
+    # Fault bucket: every request whose first token landed inside the
+    # kill->ready window OR that was alive across it -- any latency the
+    # fault could have stretched. Steady is everything else. TTFT is
+    # only real for STREAMED requests (a non-streamed reply's first
+    # signal IS its completion), so the TTFT percentiles -- and the
+    # ratcheted fault_ttft_p99_ms -- come from the streamed half;
+    # end-to-end latency covers everything.
+    t0 = fault["t_kill"] or float("inf")
+    t1 = fault["t_ready"] or float("inf")
+    done = [st for st in pending.values()
+            if st["done"] and st["t_first"] is not None]
+    fault_b = [st for st in done
+               if st["t_sent"] <= t1 and st["t_first"] >= t0]
+    fault_ids = {id(st) for st in fault_b}
+    steady_b = [st for st in done if id(st) not in fault_ids]
+    fault_s = [st for st in fault_b if st["stream"]]
+    steady_s = [st for st in steady_b if st["stream"]]
+    completed = sum(1 for st in pending.values() if st["done"])
+    offered = len(pending)
+    streamed = [st for st in pending.values() if st["stream"]]
+    recovery = (round(fault["t_ready"] - fault["t_kill"], 3)
+                if fault["t_kill"] and fault["t_ready"] else None)
+    rs = router.stats()
+    return {
+        "mode": "sim-calibrated",
+        "plan": json.loads(plan_json),
+        "faults_fired": [list(t) for t in plan.fired],
+        "replica_killed": victim if killed else None,
+        "respawned": fault["respawned"],
+        "recovery_seconds": recovery,
+        "requests_offered": offered,
+        "requests_completed": completed,
+        "requests_lost": offered - completed,
+        "request_loss_ratio": round(
+            (offered - completed) / max(1, offered), 4),
+        "shed": shed,
+        "redispatched": redispatched,
+        "send_errors": fault["send_errors"],
+        "duplicate_finals_ignored": sum(
+            st["dup_final"] for st in pending.values()),
+        "streamed_requests": len(streamed) + 1,
+        "streams_resumed": (sum(1 for st in streamed if st["skipped"])
+                            + int(probe["resumed"])),
+        "stream_tokens_skipped_on_resume": (
+            sum(st["skipped"] for st in streamed)
+            + probe["tokens_skipped_on_resume"]),
+        "stream_dup_tokens": (
+            sum(max(0, st["delivered"] - st["ntok"]) for st in streamed)
+            + probe["dup_tokens"]),
+        "resume_probe": probe,
+        "ttft_ms": {
+            "steady_p50": _fleet_pct([ttft_ms(s) for s in steady_s], 50),
+            "steady_p99": _fleet_pct([ttft_ms(s) for s in steady_s], 99),
+            "fault_p50": _fleet_pct([ttft_ms(s) for s in fault_s], 50),
+            "fault_p99": _fleet_pct([ttft_ms(s) for s in fault_s], 99),
+            "fault_window_streams": len(fault_s),
+        },
+        "e2e_ms": {
+            "steady_p50": _fleet_pct([e2e_ms(s) for s in steady_b], 50),
+            "steady_p99": _fleet_pct([e2e_ms(s) for s in steady_b], 99),
+            "fault_p50": _fleet_pct([e2e_ms(s) for s in fault_b], 50),
+            "fault_p99": _fleet_pct([e2e_ms(s) for s in fault_b], 99),
+            "fault_window_requests": len(fault_b),
+        },
+        "fault_ttft_p99_ms": _fleet_pct(
+            [ttft_ms(s) for s in fault_s], 99),
+        "router": {k: rs[k] for k in
+                   ("requests", "shed", "ejected", "readmitted",
+                    "probes")},
+        "workload": {
+            "arrivals": "poisson", "rate_rps": round(rate, 3),
+            "requests": n_req, "workers": n_workers,
+            "streamed_every_2nd": bool(stream_every),
+            "stream_chunk_tokens": stream_every,
+            "time_scale": scale,
+        },
+        "note": (
+            "TTFT is sim-domain ms measured parent-side over STREAMED "
+            "requests (arrival -> first delivered token, surviving "
+            "re-dispatch); e2e covers all requests. recovery_seconds "
+            "is WALL clock -- the replacement is a real subprocess "
+            "respawn, not simulated. The fault bucket is every request "
+            "whose first token the kill->ready window could have "
+            "stretched."
+        ),
+    }
+
+
 def _phase_dispatch(name: str, args: dict):
     """Run one named phase in THIS process (the subprocess side)."""
     if name == "slot":
@@ -1751,6 +2157,8 @@ def _phase_dispatch(name: str, args: dict):
         return bench_paced_itl(**args)
     if name == "fleet":
         return bench_fleet(args)
+    if name == "chaos":
+        return bench_chaos(args)
     raise SystemExit(f"unknown phase {name!r}")
 
 
@@ -1857,7 +2265,8 @@ def main() -> int:
             # multi-hour orchestrated run.
             print("usage: bench_serving.py --phase "
                   "<slot|mixed|latency|prefix|spec|quantized|pipeline|"
-                  "kv_capacity|fleet> ['<json-args>']", file=sys.stderr)
+                  "kv_capacity|fleet|chaos> ['<json-args>']",
+                  file=sys.stderr)
             return 2
         args = json.loads(sys.argv[3]) if len(sys.argv) > 3 else {}
         obs_trace.activate_from_env(
@@ -1885,6 +2294,13 @@ def main() -> int:
         "decode_tok_per_slot": round(
             best["tokens_per_sec"] / max(1, best["max_slots"]), 2),
     }, timeout=1800)
+    # Chaos arm (docs/FLEET.md failure semantics): a seeded FaultPlan
+    # SIGKILLs one sim replica mid-load; loss/dup/recovery/fault-TTFT
+    # are ratcheted hard (KT-PERF-CHAOS).
+    chaos = _run_phase("chaos", {
+        "decode_tok_per_slot": round(
+            best["tokens_per_sec"] / max(1, best["max_slots"]), 2),
+    }, timeout=900)
     lat = dict(prefill_chunk=PREFILL_CHUNK,
                decode_block=LATENCY_DECODE_BLOCK,
                n_requests=LAT_REQUESTS)
@@ -1984,6 +2400,7 @@ def main() -> int:
             ),
             "throughput_mixed": mixed,
             "fleet": fleet,
+            "chaos": chaos,
             "prompt_len": PROMPT_LEN,
             "new_tokens": NEW_TOKENS,
             "decode_block": DECODE_BLOCK,

@@ -13,16 +13,20 @@ rule catalog and workflow):
   CPU backend, asserting donation consumption, bf16-region upcast
   ceilings, shard_map collective counts, and zero steady-state
   recompiles.
-- Tier C (`racecheck` + `protocheck`): lock-discipline race detection
-  over the real threaded modules under a contended stress driver
-  (KT-RACE-ORDER / KT-GUARD01), and exhaustive small-scope model
-  checking of the control-plane protocols -- reshard command/ack, gang
-  lifecycle, single-writer rule -- with conformance replay against the
-  real command-file code (KT-PROTO-*).
+- Tier C (`racecheck` + `protocheck` + `chaoscheck`): lock-discipline
+  race detection over the real threaded modules under a contended
+  stress driver (KT-RACE-ORDER / KT-GUARD01), exhaustive small-scope
+  model checking of the control-plane protocols -- reshard command/ack,
+  gang lifecycle, single-writer rule -- with conformance replay against
+  the real command-file code (KT-PROTO-*), and chaos conformance: the
+  fault-injection harness replays deterministically, the circuit
+  breaker honors its state machine, the router survives ejection /
+  re-admission / empty rings, and the checkpoint checksum manifests
+  catch corruption (KT-CHAOS-*).
 
 Families (``kftpu analyze --only <family>``): astlint | audit | perf |
-race | proto. `kftpu analyze --strict` is the CI gate: exit 0 iff
-nothing regressed vs the committed `baseline.json`.
+race | proto | chaos. `kftpu analyze --strict` is the CI gate: exit 0
+iff nothing regressed vs the committed `baseline.json`.
 """
 
 import logging
@@ -32,7 +36,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 # Registered analysis families (mirrored in baseline.json so the CI
 # contract is visible next to the grandfather counts).
-FAMILIES = ("astlint", "audit", "perf", "race", "proto")
+FAMILIES = ("astlint", "audit", "perf", "race", "proto", "chaos")
 
 from kubeflow_tpu.analysis.perf import (  # noqa: F401
     PERF_BASELINE_PATH,
@@ -87,7 +91,7 @@ def run_analysis(
     and ``serving=False`` still skips the serving-engine audit and the
     engine stress driver, preserving the historical flag semantics."""
     selected = (set(families) if families is not None
-                else {"astlint", "audit", "race", "proto"})
+                else {"astlint", "audit", "race", "proto", "chaos"})
     unknown = selected - set(FAMILIES)
     if unknown:
         raise ValueError(
@@ -123,4 +127,10 @@ def run_analysis(
         proto_findings, proto_info = check_protocols()
         findings.extend(proto_findings)
         log.info("protocheck: %s", proto_info)
+    if "chaos" in selected:
+        from kubeflow_tpu.analysis.chaoscheck import check_chaos
+
+        chaos_findings, chaos_info = check_chaos()
+        findings.extend(chaos_findings)
+        log.info("chaoscheck: %s", chaos_info)
     return findings, metrics

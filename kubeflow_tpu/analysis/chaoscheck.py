@@ -1,0 +1,313 @@
+"""Tier C chaos conformance: the fault-injection and recovery machinery
+is itself checked every ``kftpu analyze`` run.
+
+Four rule families, all driven in-process against the REAL code (no
+live fleet, no sleeps -- injectable clocks and synthetic call
+sequences), so a refactor that silently breaks replayability or the
+breaker contract fails --strict the same run it lands:
+
+- KT-CHAOS-DETERMINISM: a FaultPlan replayed over the same call
+  sequence fires at the same (site, target, hit, kind) tuples, for
+  both ``at``-indexed and probability faults. Replayability is the
+  whole value of the chaos harness -- a nondeterministic plan can't
+  reproduce the failure it found.
+- KT-CHAOS-BREAKER: the CircuitBreaker state machine honors its
+  contract under a scripted schedule: trip at the threshold (not
+  before), half-open admits exactly one probe, a failed probe re-opens
+  with the timeout doubled (capped), a successful probe closes fully.
+- KT-CHAOS-RECOVERY: a Router with a tripped replica pulls it from
+  the ring (survivors keep routing), re-admits it through the
+  half-open probe after the timeout, and sheds with a jittered
+  Retry-After -- never errors -- on an empty ring.
+- KT-CHAOS-CKPT: the checkpoint checksum manifest detects a flipped
+  byte and a truncation (verify False), accepts the intact layout
+  (verify True), and reports None -- caller's judgment -- when no
+  manifest exists.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Dict, List, Tuple
+
+from kubeflow_tpu.analysis.report import Finding
+from kubeflow_tpu.chaos import FaultPlan
+from kubeflow_tpu.controller.reshard_protocol import write_json_atomic
+from kubeflow_tpu.serving.router import CircuitBreaker, Router, RouterConfig
+
+_SELF = "kubeflow_tpu/analysis/chaoscheck.py"
+
+
+def _finding(rule: str, message: str) -> Finding:
+    return Finding(rule=rule, path=_SELF, line=0, hard=True,
+                   message=message)
+
+
+# -- KT-CHAOS-DETERMINISM ----------------------------------------------------
+
+_PLAN_JSON = json.dumps({
+    "seed": 1234,
+    "faults": [
+        {"kind": "straggler", "site": "engine.decode", "at": [3, 7],
+         "seconds": 0.0},
+        {"kind": "drop_poll", "site": "router.load_poll", "target": "1",
+         "at": [2]},
+        {"kind": "corrupt_packet", "site": "kv.packet", "prob": 0.25},
+        {"kind": "torn_ckpt", "site": "ckpt.write", "at": [1]},
+    ],
+})
+
+# The synthetic call sequence the plan is replayed over: interleaved
+# sites/targets, enough hits that the prob fault gets real coverage.
+_SEQUENCE: List[Tuple[str, str]] = (
+    [("engine.decode", "")] * 10
+    + [("router.load_poll", str(i % 3)) for i in range(9)]
+    + [("kv.packet", "")] * 20
+    + [("ckpt.write", str(s)) for s in range(4)]
+)
+
+
+def _replay() -> List[Tuple[str, str, int, str]]:
+    plan = FaultPlan.from_json(_PLAN_JSON)
+    for site, target in _SEQUENCE:
+        plan.poke(site, target)
+    return list(plan.fired)
+
+
+def check_determinism() -> List[Finding]:
+    findings: List[Finding] = []
+    first, second = _replay(), _replay()
+    if first != second:
+        findings.append(_finding(
+            "KT-CHAOS-DETERMINISM",
+            f"identical plans over identical call sequences fired "
+            f"differently: {first} vs {second}",
+        ))
+    if not first:
+        findings.append(_finding(
+            "KT-CHAOS-DETERMINISM",
+            "reference plan fired zero faults over the reference "
+            "sequence -- the harness is inert",
+        ))
+    # In-run replay: reset_state on ONE plan object must reproduce too
+    # (the bench replays without re-parsing).
+    plan = FaultPlan.from_json(_PLAN_JSON)
+    for site, target in _SEQUENCE:
+        plan.poke(site, target)
+    once = list(plan.fired)
+    plan.reset_state()
+    for site, target in _SEQUENCE:
+        plan.poke(site, target)
+    if once != list(plan.fired):
+        findings.append(_finding(
+            "KT-CHAOS-DETERMINISM",
+            "reset_state() replay diverged from the first pass",
+        ))
+    return findings
+
+
+# -- KT-CHAOS-BREAKER --------------------------------------------------------
+
+class _Clock:
+    def __init__(self) -> None:
+        self.t = 1000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def check_breaker() -> List[Finding]:
+    findings: List[Finding] = []
+    clk = _Clock()
+    br = CircuitBreaker(failure_threshold=3, reset_timeout_s=1.0,
+                        backoff_factor=2.0, max_reset_timeout_s=8.0,
+                        now=clk)
+    br.record_failure()
+    br.record_failure()
+    if br.state != CircuitBreaker.CLOSED:
+        findings.append(_finding(
+            "KT-CHAOS-BREAKER",
+            f"tripped after 2 failures with threshold 3 ({br.state})"))
+    br.record_failure()
+    if br.state != CircuitBreaker.OPEN:
+        findings.append(_finding(
+            "KT-CHAOS-BREAKER",
+            f"not open after 3 consecutive failures ({br.state})"))
+    if br.allow():
+        findings.append(_finding(
+            "KT-CHAOS-BREAKER", "open breaker admitted a request "
+            "before its reset timeout"))
+    clk.t += 1.01
+    admitted = [br.allow(), br.allow(), br.allow()]
+    if admitted != [True, False, False]:
+        findings.append(_finding(
+            "KT-CHAOS-BREAKER",
+            f"half-open admitted {sum(admitted)} probes, want exactly "
+            f"one ({admitted})"))
+    br.record_failure()  # failed probe: re-open, timeout doubles
+    if br.state != CircuitBreaker.OPEN or br.timeout_s != 2.0:
+        findings.append(_finding(
+            "KT-CHAOS-BREAKER",
+            f"failed probe: state={br.state} timeout={br.timeout_s}, "
+            "want open with timeout doubled to 2.0"))
+    clk.t += 1.5
+    if br.allow():
+        findings.append(_finding(
+            "KT-CHAOS-BREAKER",
+            "re-opened breaker ignored its doubled timeout"))
+    clk.t += 0.51
+    if not br.allow():
+        findings.append(_finding(
+            "KT-CHAOS-BREAKER",
+            "second half-open window refused its one probe"))
+    br.record_success()
+    if (br.state != CircuitBreaker.CLOSED or br.trips != 0
+            or br.timeout_s != 1.0):
+        findings.append(_finding(
+            "KT-CHAOS-BREAKER",
+            f"successful probe must fully close (state={br.state}, "
+            f"trips={br.trips}, timeout={br.timeout_s})"))
+    # Timeout cap: repeated trips never exceed max_reset_timeout_s.
+    for _ in range(10):
+        br.record_failure()
+        br.record_failure()
+        br.record_failure()
+        clk.t += 100.0
+        br.allow()
+    if br.timeout_s > 8.0:
+        findings.append(_finding(
+            "KT-CHAOS-BREAKER",
+            f"backoff escaped its cap: timeout {br.timeout_s} > 8.0"))
+    return findings
+
+
+# -- KT-CHAOS-RECOVERY -------------------------------------------------------
+
+def check_recovery() -> List[Finding]:
+    findings: List[Finding] = []
+    clk = _Clock()
+    cfg = RouterConfig(breaker_threshold=2, breaker_reset_s=1.0)
+    router = Router(cfg, name="chaoscheck", now=clk)
+    for rid in ("0", "1", "2"):
+        router.add_replica(rid)
+    victim = "1"
+    router.note_poll(victim, ok=False)
+    router.note_poll(victim, ok=False)
+    if victim in router.ring.nodes() or len(router.ring) != 2:
+        findings.append(_finding(
+            "KT-CHAOS-RECOVERY",
+            f"tripped replica not ejected from the ring "
+            f"(nodes={sorted(router.ring.nodes())})"))
+    for i in range(16):
+        d = router.route(b"chaos-key-%d" % i)
+        if d.kind != "direct" or d.replica == victim:
+            findings.append(_finding(
+                "KT-CHAOS-RECOVERY",
+                f"request {i} landed on {d.kind}/{d.replica} with the "
+                f"victim ejected"))
+            break
+    clk.t += 1.01
+    d = router.route(b"probe-key")
+    if not (d.kind == "direct" and d.replica == victim and d.probed):
+        findings.append(_finding(
+            "KT-CHAOS-RECOVERY",
+            f"half-open probe did not steal the next request "
+            f"({d.kind}/{d.replica} probed={d.probed})"))
+    router.record_success(victim)
+    if victim not in router.ring.nodes():
+        findings.append(_finding(
+            "KT-CHAOS-RECOVERY",
+            "probe success did not re-sync the victim into the ring"))
+    # Empty ring: shed with jittered Retry-After, never an exception.
+    empty = Router(RouterConfig(), name="chaoscheck-empty", now=clk)
+    decisions = [empty.route(b"k%d" % i) for i in range(6)]
+    if any(d.kind != "shed" or not d.retry_after_s for d in decisions):
+        findings.append(_finding(
+            "KT-CHAOS-RECOVERY",
+            "empty-ring route did not shed with a Retry-After"))
+    elif len({d.retry_after_s for d in decisions}) < 2:
+        findings.append(_finding(
+            "KT-CHAOS-RECOVERY",
+            "empty-ring Retry-After is constant -- shed retries will "
+            "thundering-herd"))
+    return findings
+
+
+# -- KT-CHAOS-CKPT -----------------------------------------------------------
+
+def check_ckpt_manifest() -> List[Finding]:
+    from kubeflow_tpu.runtime.checkpoint import (
+        MANIFEST_PREFIX,
+        Checkpointer,
+        _hash_file,
+    )
+
+    findings: List[Finding] = []
+    root = tempfile.mkdtemp(prefix="kftpu-chaoscheck-")
+    try:
+        # Hand-built step layout: the verify path needs no orbax.
+        ck = Checkpointer.__new__(Checkpointer)
+        ck.directory = root
+        ck._mgr = None
+        sdir = os.path.join(root, "7")
+        os.makedirs(os.path.join(sdir, "default"))
+        payload = os.path.join(sdir, "default", "payload.bin")
+        with open(payload, "wb") as f:
+            f.write(bytes(range(256)) * 64)
+        meta = os.path.join(sdir, "meta.json")
+        with open(meta, "w") as f:
+            json.dump({"step": 7}, f)
+        files: Dict[str, dict] = {}
+        for full in (payload, meta):
+            rel = os.path.relpath(full, sdir)
+            files[rel] = {"size": os.path.getsize(full),
+                          "blake2b": _hash_file(full)}
+        write_json_atomic(
+            os.path.join(root, f"{MANIFEST_PREFIX}7.json"),
+            {"version": 1, "step": 7, "files": files},
+        )
+        if ck.verify_step(7) is not True:
+            findings.append(_finding(
+                "KT-CHAOS-CKPT", "intact step failed verification"))
+        if ck.verify_step(8) is not None:
+            findings.append(_finding(
+                "KT-CHAOS-CKPT",
+                "manifest-less step must verify as None (caller's "
+                "judgment), not True/False"))
+        with open(payload, "r+b") as f:
+            f.seek(100)
+            b = f.read(1)
+            f.seek(100)
+            f.write(bytes([b[0] ^ 0x01]))
+        if ck.verify_step(7) is not False:
+            findings.append(_finding(
+                "KT-CHAOS-CKPT", "flipped payload byte not detected"))
+        with open(payload, "r+b") as f:  # restore the byte, then truncate
+            f.seek(100)
+            f.write(bytes([b[0]]))
+        with open(payload, "r+b") as f:
+            f.truncate(os.path.getsize(payload) // 2)
+        if ck.verify_step(7) is not False:
+            findings.append(_finding(
+                "KT-CHAOS-CKPT", "truncated payload not detected"))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return findings
+
+
+def check_chaos() -> Tuple[List[Finding], Dict[str, int]]:
+    """Entry point mirroring check_races/check_protocols: returns
+    (findings, coverage info)."""
+    findings: List[Finding] = []
+    findings.extend(check_determinism())
+    findings.extend(check_breaker())
+    findings.extend(check_recovery())
+    findings.extend(check_ckpt_manifest())
+    info = {
+        "determinism_hits": len(_SEQUENCE),
+        "rules": 4,
+    }
+    return findings, info

@@ -7,7 +7,7 @@ curve regression fails --strict the same way a dropped donation does
 instead of landing silently and surfacing three rounds later as "why is
 8192 slow again".
 
-Four check families, one baseline file:
+The check families, one baseline file:
 
 - ``train.mfu_floor_by_seq``: per-sequence-length MFU floors over the
   newest committed train bench round (headline row + seq_sweep rows).
@@ -22,6 +22,11 @@ Four check families, one baseline file:
   floor, overload shed-rate sanity range, and required disaggregation
   invariants (KV-handoff token parity, complete cross-process span
   chain). Rule KT-PERF-FLEET.
+- ``chaos``: bounds over the fault-injected fleet bench
+  (``SERVING_BENCH.json`` extra.chaos -- bench_serving.py's chaos
+  phase, which SIGKILLs a replica mid-load): request-loss and
+  duplicated-stream-token maxima (both 0), recovery-seconds and
+  fault-window TTFT p99 ceilings. Rule KT-PERF-CHAOS.
 - ``ceilings``: upper bounds on live analysis metrics -- the per-depth
   steady-state host-sync bound (``serve.host_syncs_per_block[.dN]``)
   and the worst per-drain queued-lane discard
@@ -247,6 +252,61 @@ def _check_fleet(fleet_base: dict, fleet: dict, artifact: str,
                     f"fleet.disagg.{key} = {val!r}, expected true: the "
                     f"prefill->decode handoff lost bit-exactness or its "
                     f"span chain ({artifact})"
+                ),
+            ))
+    return findings
+
+
+def _check_chaos(cbase: dict, ch: dict, artifact: str,
+                 measured: Dict[str, float]) -> List[Finding]:
+    """KT-PERF-CHAOS: the fault-injected fleet bench (bench_serving.py
+    chaos phase -- a replica SIGKILLed mid-load, controller respawn,
+    activator retry/resume).
+
+    The recovery contract: zero non-streamed request loss, zero
+    duplicated streamed tokens, recovery (kill -> replacement ready)
+    under the ceiling, and the fault-window TTFT p99 bounded -- a fleet
+    that survives the kill but stalls every in-flight client did not
+    recover. A bound whose metric vanished from the artifact is a
+    finding (same shrunk-curve rule as every other family)."""
+    findings: List[Finding] = []
+
+    def _bound(mkey: str, bkey: str) -> None:
+        limit = cbase.get(bkey)
+        if limit is None:
+            return
+        val = ch.get(mkey)
+        if val is None:
+            findings.append(Finding(
+                rule="KT-PERF-CHAOS", path=artifact, line=0, hard=True,
+                message=(
+                    f"chaos.{mkey}: missing from {artifact} "
+                    f"({bkey}={limit}) -- the chaos curve shrank"
+                ),
+            ))
+            return
+        measured[f"chaos.{mkey}"] = float(val)
+        if val > limit:
+            findings.append(Finding(
+                rule="KT-PERF-CHAOS", path=artifact, line=0, hard=True,
+                message=(
+                    f"chaos.{mkey} = {val} exceeds ceiling {limit} "
+                    f"({artifact})"
+                ),
+            ))
+
+    _bound("request_loss_ratio", "request_loss_ratio_max")
+    _bound("stream_dup_tokens", "stream_dup_tokens_max")
+    _bound("recovery_seconds", "recovery_seconds_ceiling")
+    _bound("fault_ttft_p99_ms", "fault_ttft_p99_ms_ceiling")
+    for req in cbase.get("required") or []:
+        if not ch.get(req):
+            findings.append(Finding(
+                rule="KT-PERF-CHAOS", path=artifact, line=0, hard=True,
+                message=(
+                    f"chaos.{req} = {ch.get(req)!r}, expected true: the "
+                    f"bench did not actually exercise the fault "
+                    f"({artifact})"
                 ),
             ))
     return findings
@@ -516,6 +576,24 @@ def check_perf(
                 ))
             else:
                 findings.extend(_check_fleet(fleet_base, fleet, artifact,
+                                             measured))
+
+    # -- chaos (fault-injected fleet) bounds --------------------------------
+    cbase = baseline.get("chaos") or {}
+    if cbase:
+        doc, artifact = serving_bench(root)
+        if doc is not None:
+            ch = doc["extra"].get("chaos")
+            if not isinstance(ch, dict):
+                findings.append(Finding(
+                    rule="KT-PERF-CHAOS", path=artifact, line=0, hard=True,
+                    message=(
+                        f"no extra.chaos section in {artifact} (chaos "
+                        f"bounds set) -- the chaos bench vanished"
+                    ),
+                ))
+            else:
+                findings.extend(_check_chaos(cbase, ch, artifact,
                                              measured))
 
     # -- live-reshard (elasticity) curve -----------------------------------

@@ -1,0 +1,22 @@
+"""Deterministic fault injection for both planes (docs/FLEET.md,
+failure semantics).
+
+``inject.py`` holds the seeded :class:`FaultPlan` and the env-gated
+hooks (``KFTPU_CHAOS_PLAN``) that the real seams call: controller
+spawn, router load-poll, engine decode loop, checkpoint write, and the
+KV-handoff transport. The same plan replays bit-identically -- firing
+is a pure function of (plan, per-site hit counters), never of wall
+clock or process RNG state.
+"""
+
+from kubeflow_tpu.chaos.inject import (  # noqa: F401
+    ENV_CHAOS_PLAN,
+    Fault,
+    FaultPlan,
+    active_plan,
+    apply,
+    corrupt_bytes,
+    enabled,
+    reset,
+    should,
+)

@@ -1,0 +1,286 @@
+"""Seeded, replayable fault injection actuated at the real seams.
+
+A :class:`FaultPlan` is JSON (inline or a file path) in
+``KFTPU_CHAOS_PLAN``:
+
+    {"seed": 7, "faults": [
+        {"kind": "crash",     "site": "engine.decode",    "at": [40]},
+        {"kind": "straggler", "site": "engine.decode",    "at": [5, 9],
+         "seconds": 0.2},
+        {"kind": "wedge",     "site": "engine.decode",    "at": [60]},
+        {"kind": "drop_poll", "site": "router.load_poll", "target": "1",
+         "at": [2, 3, 4]},
+        {"kind": "corrupt_packet", "site": "kv.packet",   "at": [0]},
+        {"kind": "torn_ckpt", "site": "ckpt.write",       "at": [1],
+         "mode": "flip"}
+    ]}
+
+Sites are the hook names the code calls (``controller.spawn``,
+``router.load_poll``, ``engine.decode``, ``ckpt.write``,
+``kv.packet``); ``site``/``target`` match with fnmatch globs. Firing is
+decided ONLY by the per-(site, target) hit counter: hit index ``i``
+fires a fault when ``i`` is in its ``at`` list, or -- with ``prob`` set
+instead -- when a blake2b of (seed, site, target, i) lands under the
+probability. Both are pure functions of the plan and the call sequence,
+so the same plan over the same execution replays bit-identically; no
+wall clock, no process RNG.
+
+Every hook is free when no plan is loaded (one cached None check), so
+the seams stay hot-path safe in production.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import hashlib
+import json
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+ENV_CHAOS_PLAN = "KFTPU_CHAOS_PLAN"
+
+KINDS = ("crash", "wedge", "straggler", "drop_poll", "corrupt_packet",
+         "torn_ckpt", "spawn_env")
+
+# Wedge "forever": long enough that every watchdog in the repo (hang
+# detection, drain timeouts, bench budgets) fires first.
+WEDGE_SECONDS = 3600.0
+
+
+@dataclasses.dataclass
+class Fault:
+    """One fault spec; see the module docstring for the JSON shape."""
+
+    kind: str
+    site: str = "*"
+    target: str = "*"
+    at: Optional[Tuple[int, ...]] = None   # hit indices that fire
+    prob: Optional[float] = None           # else seeded per-hit coin
+    seconds: float = 0.0                   # straggler/wedge duration
+    exit_code: int = 137                   # crash (SIGKILL's wait code)
+    offset: Optional[int] = None           # corrupt: byte to flip
+    mode: str = "flip"                     # torn_ckpt: flip | truncate
+    env: Optional[Dict[str, str]] = None   # spawn_env: injected child env
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Fault":
+        kind = d.get("kind")
+        if kind not in KINDS:
+            raise ValueError(f"chaos fault kind {kind!r} not in {KINDS}")
+        at = d.get("at")
+        if at is not None:
+            at = tuple(int(a) for a in (at if isinstance(at, list) else [at]))
+        return cls(
+            kind=kind,
+            site=str(d.get("site", "*")),
+            target=str(d.get("target", "*")),
+            at=at,
+            prob=(float(d["prob"]) if d.get("prob") is not None else None),
+            seconds=float(d.get("seconds", 0.0)),
+            exit_code=int(d.get("exit_code", 137)),
+            offset=(int(d["offset"]) if d.get("offset") is not None
+                    else None),
+            mode=str(d.get("mode", "flip")),
+            env=(dict(d["env"]) if d.get("env") else None),
+        )
+
+    def matches(self, site: str, target: str) -> bool:
+        return (fnmatch.fnmatchcase(site, self.site)
+                and fnmatch.fnmatchcase(target, self.target))
+
+    def fires_at(self, seed: int, site: str, target: str, hit: int) -> bool:
+        if self.at is not None:
+            return hit in self.at
+        if self.prob is not None:
+            d = hashlib.blake2b(
+                f"{seed}|{self.kind}|{site}|{target}|{hit}".encode(),
+                digest_size=4,
+            ).digest()
+            return int.from_bytes(d, "big") < self.prob * (1 << 32)
+        return False
+
+
+class FaultPlan:
+    """Parsed plan plus the mutable replay state (hit counters and the
+    fired log). Thread-safe: seams fire from engine threads, asyncio
+    callbacks, and the bench driver at once."""
+
+    def __init__(self, seed: int, faults: List[Fault]) -> None:
+        self.seed = int(seed)
+        self.faults = list(faults)
+        self._hits: Dict[Tuple[str, str], int] = {}
+        # (site, target, hit, kind) in firing order -- the determinism
+        # witness chaoscheck replays.
+        self.fired: List[Tuple[str, str, int, str]] = []
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        d = json.loads(text)
+        return cls(
+            seed=int(d.get("seed", 0)),
+            faults=[Fault.from_dict(f) for f in d.get("faults", [])],
+        )
+
+    @classmethod
+    def from_env(cls, value: str) -> "FaultPlan":
+        value = value.strip()
+        if not value.startswith("{") and os.path.exists(value):
+            with open(value) as f:
+                value = f.read()
+        return cls.from_json(value)
+
+    def poke(self, site: str, target: str = "") -> Optional[Fault]:
+        """Advance the (site, target) hit counter by one and return the
+        first fault that fires at it, if any."""
+        with self._lock:
+            key = (site, target)
+            hit = self._hits.get(key, 0)
+            self._hits[key] = hit + 1
+            for f in self.faults:
+                if f.matches(site, target) and f.fires_at(
+                        self.seed, site, target, hit):
+                    self.fired.append((site, target, hit, f.kind))
+                    return f
+        return None
+
+    def reset_state(self) -> None:
+        with self._lock:
+            self._hits.clear()
+            self.fired.clear()
+
+
+# -- process-global plan (env-gated) ----------------------------------------
+
+_plan: Optional[FaultPlan] = None
+_plan_env: Optional[str] = None
+_plan_lock = threading.Lock()
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The process's plan, parsed once per distinct env value. None
+    (the overwhelmingly common case) costs one env read."""
+    global _plan, _plan_env
+    raw = os.environ.get(ENV_CHAOS_PLAN) or None
+    if raw == _plan_env:
+        return _plan
+    with _plan_lock:
+        raw = os.environ.get(ENV_CHAOS_PLAN) or None
+        if raw != _plan_env:
+            _plan_env = raw
+            if raw is None:
+                _plan = None
+            else:
+                try:
+                    _plan = FaultPlan.from_env(raw)
+                    logger.warning(
+                        "chaos: plan armed (seed=%d, %d fault(s))",
+                        _plan.seed, len(_plan.faults),
+                    )
+                except (ValueError, OSError, json.JSONDecodeError) as e:
+                    # A broken plan must not take the process down with
+                    # it -- chaos is a test input, not a dependency.
+                    logger.error("chaos: unparsable %s (%s); disabled",
+                                 ENV_CHAOS_PLAN, e)
+                    _plan = None
+    return _plan
+
+
+def enabled() -> bool:
+    return active_plan() is not None
+
+
+def reset() -> None:
+    """Drop the cached plan and its counters (tests re-arm via env)."""
+    global _plan, _plan_env
+    with _plan_lock:
+        _plan = None
+        _plan_env = None
+
+
+def should(site: str, target: str = "") -> Optional[Fault]:
+    """The raw hook: advance the site's counter, return a firing fault
+    or None. Callers that need custom actuation (dropping a poll,
+    corrupting a buffer, failing a spawn) branch on the result."""
+    plan = active_plan()
+    if plan is None:
+        return None
+    return plan.poke(site, str(target))
+
+
+def apply(site: str, target: str = "") -> Optional[str]:
+    """Inline actuation for in-process faults. ``straggler`` and
+    ``wedge`` sleep here; ``crash`` SIGKILLs the process (exactly the
+    signal a preempted or OOM-killed replica dies by). Returns the kind
+    fired for log/bench accounting, None when nothing fired. Other
+    kinds are caller-actuated and pass through as a return value."""
+    f = should(site, target)
+    if f is None:
+        return None
+    logger.warning("chaos: firing %s at %s[%s]", f.kind, site, target)
+    if f.kind == "straggler":
+        time.sleep(f.seconds or 0.1)
+    elif f.kind == "wedge":
+        time.sleep(f.seconds or WEDGE_SECONDS)
+    elif f.kind == "crash":
+        os.kill(os.getpid(), signal.SIGKILL)
+        os._exit(f.exit_code)  # unreachable fallback for exotic platforms
+    return f.kind
+
+
+def corrupt_bytes(buf: bytes, site: str = "kv.packet",
+                  target: str = "") -> bytes:
+    """Flip one byte of ``buf`` when a corrupt_packet fault fires at
+    this hit (deterministic offset: the fault's, else seeded from the
+    hit index). Identity otherwise."""
+    f = should(site, target)
+    if f is None or f.kind != "corrupt_packet" or not buf:
+        return buf
+    if f.offset is not None:
+        off = f.offset % len(buf)
+    else:
+        plan = active_plan()
+        d = hashlib.blake2b(
+            f"{plan.seed if plan else 0}|corrupt|{site}|{target}".encode(),
+            digest_size=8,
+        ).digest()
+        off = int.from_bytes(d, "big") % len(buf)
+    out = bytearray(buf)
+    out[off] ^= 0xFF
+    logger.warning("chaos: corrupted packet byte %d at %s[%s]",
+                   off, site, target)
+    return bytes(out)
+
+
+def mangle_file(path: str, fault: Fault) -> bool:
+    """Actuate a torn_ckpt fault against one file: flip a byte
+    (``mode: flip``) or truncate to half (``mode: truncate``). Returns
+    True when the file was touched. Caller decides WHICH file (the
+    checkpoint hook picks the newest step's largest payload)."""
+    try:
+        size = os.path.getsize(path)
+        if size <= 0:
+            return False
+        if fault.mode == "truncate":
+            with open(path, "r+b") as f:
+                f.truncate(max(1, size // 2))
+        else:
+            off = (fault.offset if fault.offset is not None
+                   else size // 2) % size
+            with open(path, "r+b") as f:
+                f.seek(off)
+                b = f.read(1)
+                f.seek(off)
+                f.write(bytes([b[0] ^ 0xFF]))
+        logger.warning("chaos: tore %s (%s)", path, fault.mode)
+        return True
+    except OSError as e:
+        logger.error("chaos: torn_ckpt on %s failed: %s", path, e)
+        return False

@@ -471,10 +471,11 @@ def main(argv=None) -> int:
                     help="skip the serving-engine audit (fastest trace run)")
     sp.add_argument("--only", action="append", default=None,
                     metavar="FAMILY",
-                    choices=("astlint", "audit", "perf", "race", "proto"),
+                    choices=("astlint", "audit", "perf", "race", "proto",
+                             "chaos"),
                     help="run only the named analysis family "
                          "(repeatable): astlint | audit | perf | race | "
-                         "proto. Default: all families.")
+                         "proto | chaos. Default: all families.")
     sp.add_argument("--baseline", default=None,
                     help="baseline path (default: committed baseline.json)")
     sp.add_argument("--perf-baseline", default=None,

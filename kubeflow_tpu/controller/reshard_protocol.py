@@ -32,17 +32,28 @@ import os
 from typing import Any, Dict, Optional
 
 
-def write_resize_command(path: str, seq: int, num_slices: int) -> None:
-    """Atomically publish a resize command for the workers polling
-    ``path``. The staging name carries the writer's pid (the
-    ``obs/trace.py`` pattern): concurrent writers — two controllers, or
-    a controller racing its own respawn — stage to distinct names, so
-    the only shared mutation is the atomic ``os.replace``."""
+def write_json_atomic(path: str, payload: Dict[str, Any]) -> None:
+    """KT-ATOMIC01 discipline, factored for every control-plane JSON
+    file: stage under a pid-unique name (concurrent writers — two
+    controllers, or a controller racing its own respawn — stage to
+    distinct names) and ``os.replace`` so readers never observe a torn
+    write. Used by the resize command below and by the checkpoint
+    checksum manifests (runtime/checkpoint.py) — a crashed writer
+    leaves at most a stale ``.tmp.<pid>``, never a half-written file
+    the reader would have to special-case."""
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
-        json.dump({"seq": seq, "num_slices": num_slices,
-                   "target_replicas": num_slices}, f)
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)  # atomic: pollers never see a torn write
+
+
+def write_resize_command(path: str, seq: int, num_slices: int) -> None:
+    """Atomically publish a resize command for the workers polling
+    ``path`` (see ``write_json_atomic`` for the staging discipline)."""
+    write_json_atomic(path, {"seq": seq, "num_slices": num_slices,
+                             "target_replicas": num_slices})
 
 
 def read_resize_command(

@@ -46,6 +46,7 @@ in ``analysis/perf.py``.
 
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -691,6 +692,28 @@ class ClusterScheduler:
                 continue  # modeled only; its own scaler is the writer
             rt = self.controller._runtimes.get(dec.job)
             if rt is None or not rt.workers:
+                continue
+            if dec.action == "preempt":
+                # Actuate the preemption through the live eviction path
+                # (ROADMAP item 2): quiesce -> teardown -> reservation
+                # release -> re-queue at its own priority; the victim
+                # resumes from its latest checkpoint when capacity
+                # frees. Guarded by the same rule as resizes: never
+                # stack on top of an in-flight reconfiguration.
+                if rt.resize_to is not None or rt.reshard_pending is not None:
+                    continue
+                try:
+                    asyncio.get_running_loop()
+                except RuntimeError:
+                    continue  # policy-only caller: modeled, not actuated
+                with trace.span("sched.decision", plane="controller",
+                                track="scheduler", job=dec.job,
+                                action="preempt",
+                                cost_s=dec.cost_seconds):
+                    asyncio.create_task(self.controller._evict(
+                        dec.job, by="scheduler plan"))
+                    REGISTRY.counter(
+                        "kftpu_sched_preempt_actuated_total").inc()
                 continue
             if dec.action not in ("grow", "shrink"):
                 continue

@@ -9,13 +9,28 @@ resize (orbax restores to whatever sharding the new mesh dictates).
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 import os
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
+from kubeflow_tpu import chaos
+from kubeflow_tpu.controller.reshard_protocol import write_json_atomic
+from kubeflow_tpu.obs import registry as obs_registry
 from kubeflow_tpu.obs import trace
 
 logger = logging.getLogger(__name__)
+
+MANIFEST_PREFIX = "manifest-"
+
+
+def _hash_file(path: str) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 class ReshardHandoff:
@@ -96,23 +111,196 @@ class Checkpointer:
                 step, args=ocp.args.StandardSave(state), force=force
             )
             sp.annotate(saved=bool(saved))
+        if saved:
+            # The manager admits one outstanding async save: dispatching
+            # THIS one means every earlier step is durable -- checksum
+            # them now so a crash never leaves an unmanifested step.
+            self._flush_manifests(exclude=int(step))
+            fault = chaos.should("ckpt.write", str(step))
+            if fault is not None and fault.kind == "torn_ckpt":
+                # Deterministic torn/corrupted write: finalize this step
+                # (manifest records the GOOD hashes), then mangle the
+                # payload -- exactly the bitrot/torn-write shape the
+                # verified restore must catch and fall back from.
+                self.wait()
+                self._mangle_step(int(step), fault)
         return saved
+
+    # -- checksum manifests (corruption-safe restore) --------------------
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(os.path.abspath(self.directory),
+                            f"{MANIFEST_PREFIX}{int(step)}.json")
+
+    def _step_dir(self, step: int) -> Optional[str]:
+        root = os.path.abspath(self.directory)
+        cand = os.path.join(root, str(int(step)))
+        if os.path.isdir(cand):
+            return cand
+        # Step-name formats vary across orbax versions (zero padding);
+        # fall back to scanning for a dir whose name parses to ``step``.
+        try:
+            for name in os.listdir(root):
+                full = os.path.join(root, name)
+                if os.path.isdir(full):
+                    try:
+                        if int(name) == int(step):
+                            return full
+                    except ValueError:
+                        continue
+        except OSError:
+            pass
+        return None
+
+    def _flush_manifests(self, exclude: Optional[int] = None) -> None:
+        """Write ``manifest-<step>.json`` (per-file size + blake2b,
+        KT-ATOMIC01 staged write) for every durable step that lacks
+        one, and drop manifests whose step was garbage-collected."""
+        if not self._mgr:
+            return
+        live = {int(s) for s in (self._mgr.all_steps() or [])}
+        root = os.path.abspath(self.directory)
+        try:
+            names = os.listdir(root)
+        except OSError:
+            return
+        for name in names:
+            if name.startswith(MANIFEST_PREFIX) and name.endswith(".json"):
+                try:
+                    s = int(name[len(MANIFEST_PREFIX):-len(".json")])
+                except ValueError:
+                    continue
+                if s not in live:
+                    try:
+                        os.unlink(os.path.join(root, name))
+                    except OSError:
+                        pass
+        for s in sorted(live):
+            if exclude is not None and s == exclude:
+                continue
+            mpath = self._manifest_path(s)
+            if os.path.exists(mpath):
+                continue
+            sdir = self._step_dir(s)
+            if sdir is None:
+                continue
+            files: Dict[str, Dict[str, Any]] = {}
+            for dirpath, _dirs, fnames in os.walk(sdir):
+                for fn in sorted(fnames):
+                    full = os.path.join(dirpath, fn)
+                    rel = os.path.relpath(full, sdir)
+                    try:
+                        files[rel] = {
+                            "size": os.path.getsize(full),
+                            "blake2b": _hash_file(full),
+                        }
+                    except OSError:
+                        # A file vanishing mid-walk means the step is
+                        # being GC'd; skip the manifest this round.
+                        files = {}
+                        break
+                if not files:
+                    break
+            if files:
+                write_json_atomic(
+                    mpath, {"version": 1, "step": s, "files": files}
+                )
+
+    def verify_step(self, step: int) -> Optional[bool]:
+        """True: manifest present and every file matches (size + hash).
+        False: corruption detected (missing/resized/bit-flipped file).
+        None: no manifest to judge by (pre-manifest checkpoint or a
+        save that never finalized) -- the caller decides trust."""
+        mpath = self._manifest_path(step)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return None
+        sdir = self._step_dir(step)
+        if sdir is None:
+            return False
+        for rel, meta in (manifest.get("files") or {}).items():
+            full = os.path.join(sdir, rel)
+            try:
+                if os.path.getsize(full) != int(meta["size"]):
+                    return False
+                if _hash_file(full) != meta["blake2b"]:
+                    return False
+            except (OSError, KeyError, TypeError, ValueError):
+                return False
+        return True
+
+    def _mangle_step(self, step: int, fault: Any) -> None:
+        sdir = self._step_dir(step)
+        if sdir is None:
+            return
+        best, best_size = None, -1
+        for dirpath, _dirs, fnames in os.walk(sdir):
+            for fn in fnames:
+                full = os.path.join(dirpath, fn)
+                try:
+                    size = os.path.getsize(full)
+                except OSError:
+                    continue
+                if size > best_size:
+                    best, best_size = full, size
+        if best is not None:
+            chaos.inject.mangle_file(best, fault)
 
     def restore(self, step: Optional[int], target: Any) -> Any:
         """Restore ``step`` (or latest) into the sharding/structure of
-        ``target`` -- the resharding path for elastic resize."""
+        ``target`` -- the resharding path for elastic resize.
+
+        Every candidate is verified against its checksum manifest
+        first; a corrupt step logs the event and FALLS BACK to the next
+        newest intact step instead of crashing mid-restore or silently
+        loading garbage. All candidates corrupt raises -- resuming from
+        a fabricated state is worse than an honest failure."""
         if not self._mgr:
             return target
+        self.wait()  # finalize any in-flight save + its manifest
         step = self.latest_step() if step is None else step
         if step is None:
             return target
         import orbax.checkpoint as ocp
 
-        logger.info("restoring checkpoint step=%d from %s", step, self.directory)
-        with trace.span("ckpt.restore", plane="runtime", step=int(step)):
-            return self._mgr.restore(
-                step, args=ocp.args.StandardRestore(target)
-            )
+        steps = sorted(
+            {int(s) for s in (self._mgr.all_steps() or [])} | {int(step)},
+            reverse=True,
+        )
+        candidates = [s for s in steps if s <= int(step)]
+        corrupt: list = []
+        for s in candidates:
+            ok = self.verify_step(s)
+            if ok is False:
+                corrupt.append(s)
+                obs_registry.REGISTRY.counter(
+                    "kftpu_ckpt_corrupt_total").inc()
+                logger.error(
+                    "checkpoint step=%d in %s FAILED checksum "
+                    "verification; falling back to the next intact step",
+                    s, self.directory,
+                )
+                trace.instant("ckpt.corrupt-fallback", plane="runtime",
+                              step=s)
+                continue
+            if ok is None:
+                logger.warning(
+                    "checkpoint step=%d has no checksum manifest; "
+                    "restoring unverified", s,
+                )
+            logger.info("restoring checkpoint step=%d from %s",
+                        s, self.directory)
+            with trace.span("ckpt.restore", plane="runtime", step=s,
+                            verified=bool(ok), fallback=bool(corrupt)):
+                return self._mgr.restore(
+                    s, args=ocp.args.StandardRestore(target)
+                )
+        raise ValueError(
+            f"no intact checkpoint in {self.directory}: steps "
+            f"{corrupt} all failed checksum verification"
+        )
 
     def restore_or_handoff(self, step: Optional[int], target: Any,
                            mesh=None) -> tuple[Any, Optional[int]]:
@@ -156,8 +344,11 @@ class Checkpointer:
     def wait(self) -> None:
         if self._mgr:
             self._mgr.wait_until_finished()
+            # Everything is durable now -- including the newest step,
+            # whose manifest maybe_save deliberately deferred.
+            self._flush_manifests()
 
     def close(self) -> None:
         if self._mgr:
-            self._mgr.wait_until_finished()
+            self.wait()
             self._mgr.close()

@@ -26,6 +26,7 @@ slot) and proceeds when capacity frees.
 from __future__ import annotations
 
 import asyncio
+import collections
 import itertools
 import json
 import logging
@@ -38,6 +39,7 @@ from typing import Dict, List, Optional
 import aiohttp
 from aiohttp import web
 
+from kubeflow_tpu import chaos
 from kubeflow_tpu.controller.launcher import BaseLauncher, SpawnRequest, WorkerRef
 from kubeflow_tpu.obs import trace
 from kubeflow_tpu.serving.router import (
@@ -177,6 +179,12 @@ class _Service:
 
 class ISVCController:
     CRASH_LOOP_LIMIT = 5
+    # Respawn backoff after a replica exit: the FIRST respawn is
+    # immediate (recovery time is the fleet's headline number), repeats
+    # back off exponentially so a crash-looping binary can't peg the
+    # reconcile loop before CRASH_LOOP_LIMIT ends it.
+    RESPAWN_BACKOFF_S = 0.5
+    RESPAWN_BACKOFF_MAX_S = 8.0
 
     def __init__(
         self,
@@ -222,6 +230,10 @@ class ISVCController:
         # that asked for another round while one was running.
         self._placement_tasks: Dict[str, asyncio.Task] = {}
         self._placement_pending: set = set()
+        # Called with (key, replica) when a replica turns ready -- the
+        # activator registers its prefix-cache re-warm here so a
+        # respawned replica doesn't start every prefix cold.
+        self.rewarm_hooks: List = []
 
     # -- loop -------------------------------------------------------------
 
@@ -1057,6 +1069,12 @@ class ISVCController:
             args += ["--logger-json", json.dumps(
                 {"sink": comp.logger.sink, "mode": comp.logger.mode}
             )]
+        fault = chaos.should("controller.spawn", f"{service_key}#{index}")
+        if fault is not None and fault.kind == "spawn_env" and fault.env:
+            # Chaos seam: plant env (typically a child KFTPU_CHAOS_PLAN)
+            # into exactly the replica the plan names -- how the chaos
+            # bench arms an in-replica crash without touching its code.
+            env.update(fault.env)
         return SpawnRequest(
             job_key=service_key,
             replica_type="server",
@@ -1085,6 +1103,10 @@ class ISVCController:
                         svc.failure_count = 0
                         svc.ready_event.set()
                         self._enqueue(*_key_parts(key))
+                        for hook in self.rewarm_hooks:
+                            # Fire-and-forget: a failed re-warm only
+                            # costs the new replica cold prefixes.
+                            asyncio.create_task(hook(key, rep))
                         return
             except Exception as e:  # noqa: BLE001 -- not-ready is normal
                 # while the replica boots, but a swallowed probe error
@@ -1136,7 +1158,24 @@ class ISVCController:
         # Crash-looping guard: stop respawning after repeated failures;
         # the status shows Failed with the failure count.
         if svc.failure_count < self.CRASH_LOOP_LIMIT:
-            self._enqueue(*_key_parts(key))
+            if svc.failure_count <= 1:
+                self._enqueue(*_key_parts(key))
+            else:
+                delay = min(
+                    self.RESPAWN_BACKOFF_S * 2 ** (svc.failure_count - 2),
+                    self.RESPAWN_BACKOFF_MAX_S,
+                )
+                logger.info("isvc %s: respawn of replica %d backed off "
+                            "%.1fs", key, index, delay)
+
+                async def _respawn(key=key, delay=delay):
+                    await asyncio.sleep(delay)
+                    if not self._stopped.is_set():
+                        self._enqueue(*_key_parts(key))
+
+                self._probe_tasks[
+                    f"respawn#{key}#{ref.generation}"
+                ] = asyncio.create_task(_respawn())
         elif svc.failure_count == self.CRASH_LOOP_LIMIT:
             ns, name = _key_parts(key)
             # Canary-ness is decided by the service's CURRENT role, not
@@ -1357,6 +1396,13 @@ class Activator:
     does), then replays.
     """
 
+    # In-flight retry budget: a request that dies with its replica is
+    # re-dispatched onto a survivor (inference is idempotent: no state
+    # outlives the exchange). 2 = the original attempt plus two more.
+    MAX_RETRIES = 2
+    # Prefixes re-warmed into a respawned replica (newest first).
+    REWARM_PREFIXES = 8
+
     def __init__(self, controller: ISVCController,
                  cold_start_timeout: float = 180.0) -> None:
         self.controller = controller
@@ -1367,6 +1413,11 @@ class Activator:
         # _probe_tasks map so the run loop's shutdown path cancels them.
         self._routers: Dict[str, Router] = {}
         self._router_fps: Dict[str, str] = {}
+        # (model, prompt) of recent routed requests, per service key --
+        # the donor material for re-warming a respawned replica's
+        # prefix cache over the PR 7 KV-handoff endpoints.
+        self._recent_texts: Dict[str, "collections.OrderedDict"] = {}
+        controller.rewarm_hooks.append(self._rewarm_replica)
 
     @staticmethod
     async def _wants_stream(req: web.Request) -> bool:
@@ -1419,59 +1470,95 @@ class Activator:
         transformer's whole-payload pre/postprocess contract)."""
         ns, name = req.match_info["ns"], req.match_info["name"]
         body = await req.read()
-        err, svc, replica = await self._route(ns, name, tail,
-                                              component=PRIMARY, body=body)
-        if err is not None:
-            status, payload, ctype = err
-            headers = {}
-            if status == 429:
-                try:
-                    ra = json.loads(payload).get("retry_after_s")
-                    if ra is not None:
-                        headers["Retry-After"] = str(max(1, math.ceil(ra)))
-                except Exception as e:  # noqa: BLE001
-                    logger.debug("429 payload without retry_after_s: %s", e)
-            return web.Response(body=payload, status=status,
-                                content_type=ctype, headers=headers)
         out: Optional[web.StreamResponse] = None
-        try:
-            url = f"http://127.0.0.1:{replica.port}/{tail}"
-            if req.query_string:
-                url += f"?{req.query_string}"
-            async with self.controller._http.request(
-                "POST", url, data=body if body else None,
-                headers={"Content-Type":
-                         req.content_type or "application/json"},
-            ) as upstream:
-                out = web.StreamResponse(status=upstream.status)
-                out.headers["Content-Type"] = upstream.headers.get(
-                    "Content-Type", "text/event-stream"
-                )
-                out.headers["Cache-Control"] = "no-cache"
-                await out.prepare(req)
-                async for chunk in upstream.content.iter_any():
-                    await out.write(chunk)
-                await out.write_eof()
-                return out
-        except aiohttp.ClientError as e:
-            if out is None:
-                return web.json_response({"error": f"upstream: {e}"},
-                                         status=502)
-            # Headers already sent (replica died mid-stream): the only
-            # honest move is an in-band error event + EOF -- a second
-            # response object can't be prepared on this connection.
+        emitted = 0  # SSE events already written to the client
+        tried: set = set()
+        last_exc: Optional[BaseException] = None
+        for attempt in range(self.MAX_RETRIES + 1):
+            err, svc, replica = await self._route(
+                ns, name, tail, component=PRIMARY, body=body,
+                exclude=tried or None,
+            )
+            if err is not None:
+                if out is not None or last_exc is not None:
+                    break  # no survivor to resume on
+                status, payload, ctype = err
+                headers = {}
+                if status == 429:
+                    try:
+                        ra = json.loads(payload).get("retry_after_s")
+                        if ra is not None:
+                            headers["Retry-After"] = str(
+                                max(1, math.ceil(ra)))
+                    except Exception as e:  # noqa: BLE001
+                        logger.debug(
+                            "429 payload without retry_after_s: %s", e)
+                return web.Response(body=payload, status=status,
+                                    content_type=ctype, headers=headers)
             try:
-                await out.write(
-                    b"data: " + json.dumps(
-                        {"error": f"upstream: {e}"}
-                    ).encode() + b"\n\ndata: [DONE]\n\n"
+                url = f"http://127.0.0.1:{replica.port}/{tail}"
+                if req.query_string:
+                    url += f"?{req.query_string}"
+                async with self.controller._http.request(
+                    "POST", url, data=body if body else None,
+                    headers={"Content-Type":
+                             req.content_type or "application/json"},
+                ) as upstream:
+                    if out is None:
+                        out = web.StreamResponse(status=upstream.status)
+                        out.headers["Content-Type"] = upstream.headers.get(
+                            "Content-Type", "text/event-stream"
+                        )
+                        out.headers["Cache-Control"] = "no-cache"
+                        await out.prepare(req)
+                    # Resume-by-offset: on a replay after a mid-stream
+                    # death, drop the first ``emitted`` events -- the
+                    # client already has them; forwarding them again
+                    # would duplicate tokens. Chunk boundaries are not
+                    # event boundaries, so split on the SSE delimiter.
+                    skip = emitted
+                    buf = b""
+                    async for chunk in upstream.content.iter_any():
+                        buf += chunk
+                        while b"\n\n" in buf:
+                            event, buf = buf.split(b"\n\n", 1)
+                            if skip > 0:
+                                skip -= 1
+                                continue
+                            await out.write(event + b"\n\n")
+                            emitted += 1
+                    if buf and skip <= 0:
+                        await out.write(buf)
+                    await out.write_eof()
+                    self._note_result(svc, replica, ok=True)
+                    return out
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                self._note_result(svc, replica, ok=False)
+                tried.add(replica.index)
+                last_exc = e
+                logger.warning(
+                    "activator %s/%s: stream died on replica %d after "
+                    "%d event(s) (%s); resuming on a survivor", ns, name,
+                    replica.index, emitted, e,
                 )
-                await out.write_eof()
-            except (ConnectionResetError, aiohttp.ClientError):
-                pass
-            return out
-        finally:
-            self._release(svc, replica)
+            finally:
+                self._release(svc, replica)
+        if out is None:
+            return web.json_response({"error": f"upstream: {last_exc}"},
+                                     status=502)
+        # Headers already sent and no survivor: the only honest move is
+        # an in-band error event + EOF -- a second response object can't
+        # be prepared on this connection.
+        try:
+            await out.write(
+                b"data: " + json.dumps(
+                    {"error": f"upstream: {last_exc}"}
+                ).encode() + b"\n\ndata: [DONE]\n\n"
+            )
+            await out.write_eof()
+        except (ConnectionResetError, aiohttp.ClientError):
+            pass
+        return out
 
     async def proxy(
         self,
@@ -1489,24 +1576,48 @@ class Activator:
         the ingress component, cold-starting if needed. Returns
         (status, payload bytes, content type)."""
 
-        err, svc, replica = await self._route(ns, name, tail, component,
-                                              body=body)
-        if err is not None:
-            return err
-        try:
-            url = f"http://127.0.0.1:{replica.port}/{tail}"
-            if query_string:
-                url += f"?{query_string}"
-            async with self.controller._http.request(
-                method, url, data=body if body else None,
-                headers={"Content-Type": content_type},
-            ) as resp:
-                return (resp.status, await resp.read(), resp.content_type)
-        except aiohttp.ClientError as e:
-            return (502, json.dumps({"error": f"upstream: {e}"}).encode(),
-                    "application/json")
-        finally:
-            self._release(svc, replica)
+        tried: set = set()
+        last_exc: Optional[BaseException] = None
+        for attempt in range(self.MAX_RETRIES + 1):
+            err, svc, replica = await self._route(
+                ns, name, tail, component, body=body,
+                exclude=tried or None,
+            )
+            if err is not None:
+                # No (further) replica: a shed/cold-start error on the
+                # first attempt is the answer; after a failed attempt it
+                # means no survivor -- report the upstream failure.
+                if last_exc is None:
+                    return err
+                break
+            try:
+                url = f"http://127.0.0.1:{replica.port}/{tail}"
+                if query_string:
+                    url += f"?{query_string}"
+                async with self.controller._http.request(
+                    method, url, data=body if body else None,
+                    headers={"Content-Type": content_type},
+                ) as resp:
+                    payload = await resp.read()
+                    self._note_result(svc, replica, ok=resp.status < 500)
+                    return (resp.status, payload, resp.content_type)
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                # Connection-level failure: the replica died under the
+                # request. Trip breaker accounting and re-dispatch onto
+                # a survivor -- idempotent for inference, which keeps no
+                # state past the exchange.
+                self._note_result(svc, replica, ok=False)
+                tried.add(replica.index)
+                last_exc = e
+                logger.warning(
+                    "activator %s/%s: replica %d failed mid-request "
+                    "(%s); retry %d/%d", ns, name, replica.index, e,
+                    attempt + 1, self.MAX_RETRIES,
+                )
+            finally:
+                self._release(svc, replica)
+        return (502, json.dumps({"error": f"upstream: {last_exc}"}).encode(),
+                "application/json")
 
     def _release(self, svc: "_Service",
                  replica: Optional["_Replica"]) -> None:
@@ -1515,16 +1626,40 @@ class Activator:
         svc.in_flight -= 1
         svc.last_request = time.time()
 
+    def _note_result(self, svc: "_Service", replica: "_Replica",
+                     ok: bool) -> None:
+        """Feed a request outcome into the service's router breaker (a
+        no-op for services without a prefix-routing block). Consecutive
+        failures trip the per-replica circuit and pull it from the
+        ring; a success while non-closed re-admits it."""
+        key = next(
+            (k for k, s in self.controller.services.items() if s is svc),
+            None,
+        )
+        router = self._routers.get(key) if key is not None else None
+        if router is None:
+            return
+        rid = str(replica.index)
+        if rid not in router.replicas:
+            return
+        if ok:
+            router.record_success(rid)
+        else:
+            router.record_failure(rid)
+
     async def _route(
         self, ns: str, name: str, tail: str, component: str = "",
-        body: Optional[bytes] = None,
+        body: Optional[bytes] = None, exclude: Optional[set] = None,
     ) -> tuple:
         """Routing + replica reservation shared by the buffered and
         streaming paths: canary split, transformer ingress, multi-model
         placement, cold-start wait. Returns (err, svc, replica); on
         success err is None and BOTH svc.in_flight and replica.in_flight
         are already incremented -- the caller MUST _release(svc, replica)
-        when the exchange ends. On error, nothing is left reserved."""
+        when the exchange ends. On error, nothing is left reserved.
+        ``exclude`` holds replica indices a retrying caller already
+        watched fail for THIS request -- they stay out of consideration
+        even before their breaker trips."""
 
         def err(status: int, message: str) -> tuple:
             return ((status, json.dumps({"error": message}).encode(),
@@ -1636,7 +1771,7 @@ class Activator:
             # owns the wait-and-replay dance, and an empty ring has no
             # affinity to offer anyway.
             shed_err, replica = await self._router_route(
-                key, svc, routing_raw, ns, tail, body
+                key, svc, routing_raw, ns, tail, body, exclude=exclude
             )
             if shed_err is not None:
                 self._release(svc, None)
@@ -1646,7 +1781,8 @@ class Activator:
                 return None, svc, replica
             # fall through (router had no healthy candidate)
         try:
-            replica = await self._get_replica(key, svc, prefer)
+            replica = await self._get_replica(key, svc, prefer,
+                                              exclude=exclude)
         except BaseException:
             # Client disconnect during the cold-start wait cancels us
             # here; a leaked in_flight would pin the autoscaler's
@@ -1660,17 +1796,26 @@ class Activator:
         return None, svc, replica
 
     async def _get_replica(self, key: str, svc: _Service,
-                           prefer: Optional[int] = None) -> Optional[_Replica]:
+                           prefer: Optional[int] = None,
+                           exclude: Optional[set] = None,
+                           ) -> Optional[_Replica]:
         if prefer is not None:
             # Model-aware routing: only the preferred replica holds the
             # model. Falling back to an arbitrary replica would turn a
             # transient relocation into a misleading 404 — return "no
             # replica" (503, retryable) and let placement converge.
             rep = svc.replicas.get(prefer)
-            if rep is not None and rep.ready:
+            if rep is not None and rep.ready and not (
+                    exclude and prefer in exclude):
                 return rep
             return None
         ready = svc.ready_replicas()
+        if ready and exclude:
+            ready = [r for r in ready if r.index not in exclude]
+            if not ready:
+                # Every ready replica already failed this request; a
+                # cold-start wait would re-offer the same set.
+                return None
         if not ready:
             # Cold start: ask for at least one replica and hold the request.
             if svc.desired < 1:
@@ -1683,6 +1828,8 @@ class Activator:
             except asyncio.TimeoutError:
                 return None
             ready = svc.ready_replicas()
+            if exclude:
+                ready = [r for r in ready if r.index not in exclude]
             if not ready:
                 return None
         svc.rr = (svc.rr + 1) % len(ready)
@@ -1743,6 +1890,7 @@ class Activator:
     async def _router_route(
         self, key: str, svc: _Service, routing_raw: dict,
         ns: str, tail: str, body: Optional[bytes],
+        exclude: Optional[set] = None,
     ) -> tuple:
         """Returns (shed_err3 | None, replica | None). (None, None)
         means the router abstained -- caller falls back to round-robin.
@@ -1765,6 +1913,16 @@ class Activator:
         self._ensure_load_poll(key, float(
             routing_raw.get("load_poll_seconds", 2.0)))
         text = self._affinity_text(body)
+        m = re.match(r"v[12]/models/([^/:]+)", tail)
+        if m is not None and text:
+            # Remember what flowed through recently: the donor material
+            # for re-warming a respawned replica's prefix cache.
+            recent = self._recent_texts.setdefault(
+                key, collections.OrderedDict())
+            recent[(m.group(1), text)] = None
+            recent.move_to_end((m.group(1), text))
+            while len(recent) > 4 * self.REWARM_PREFIXES:
+                recent.popitem(last=False)
         decision = router.route(
             prefix_route_key(text), prompt_len=len(text)
         )
@@ -1778,6 +1936,10 @@ class Activator:
         if decision.kind == "none" or decision.replica not in by_rid:
             return None, None
         replica = by_rid[decision.replica]
+        if exclude and replica.index in exclude:
+            # Already failed for this request: abstain so the RR
+            # fallback (which honors ``exclude``) picks a survivor.
+            return None, None
         if decision.kind == "disagg":
             pre = by_rid.get(decision.prefill_replica or "")
             if pre is None:
@@ -1813,6 +1975,12 @@ class Activator:
                     if resp.status != 200:
                         return  # 204: under one block; 4xx/5xx: skip
                     packet = await resp.read()
+                if chaos.enabled():
+                    # Chaos seam: a corrupt_packet fault flips one byte
+                    # in flight; the import side must fail closed (the
+                    # decode replica then prefills locally).
+                    packet = chaos.corrupt_bytes(
+                        packet, "kv.packet", str(dec.index))
                 async with http.post(
                     f"http://127.0.0.1:{dec.port}/v2/models/{mname}"
                     "/prefix/import",
@@ -1848,6 +2016,13 @@ class Activator:
             if svc is None or router is None or not svc.replicas:
                 return
             for rep in svc.ready_replicas():
+                rid = str(rep.index)
+                fault = chaos.should("router.load_poll", rid)
+                if fault is not None and fault.kind == "drop_poll":
+                    # Chaos seam: the poll never happened -- exactly a
+                    # dropped health response on the wire.
+                    router.note_poll(rid, ok=False)
+                    continue
                 try:
                     async with ctrl._http.get(
                         f"http://127.0.0.1:{rep.port}/healthz",
@@ -1857,7 +2032,9 @@ class Activator:
                 except Exception as e:  # noqa: BLE001 - replica churn
                     logger.debug("load poll %s replica %s: %s",
                                  key, rep.index, e)
+                    router.note_poll(rid, ok=False)
                     continue
+                router.note_poll(rid, ok=True)
                 load = (data or {}).get("load") or {}
                 agg = {"queue_depth": 0, "slots_active": 0, "max_slots": 0}
                 ema = 0.0
@@ -1875,3 +2052,55 @@ class Activator:
                 await asyncio.sleep(interval)
             except asyncio.CancelledError:
                 return
+
+    async def _rewarm_replica(self, key: str, rep: "_Replica") -> None:
+        """Prefix-cache re-warm for a (re)spawned replica: export the
+        recently routed prompts' KV packets from a surviving donor and
+        import them into the newcomer over the PR 7 handoff endpoints.
+        Best-effort -- every failure just leaves that prefix cold."""
+        recent = self._recent_texts.get(key)
+        if not recent:
+            return
+        ctrl = self.controller
+        svc = ctrl.services.get(key)
+        if svc is None:
+            return
+        donors = [r for r in svc.ready_replicas()
+                  if r.index != rep.index]
+        if not donors:
+            return
+        pairs = list(recent.keys())[-self.REWARM_PREFIXES:]
+        warmed = 0
+        with trace.span("replica-rewarm", plane="serving", track="router",
+                        replica=rep.index, prefixes=len(pairs)):
+            for mname, text in pairs:
+                for donor in donors:
+                    try:
+                        async with ctrl._http.post(
+                            f"http://127.0.0.1:{donor.port}/v2/models/"
+                            f"{mname}/prefix/export",
+                            json={"prompt": text},
+                            timeout=aiohttp.ClientTimeout(total=5),
+                        ) as resp:
+                            if resp.status != 200:
+                                break  # donor has no packet; next prefix
+                            packet = await resp.read()
+                        async with ctrl._http.post(
+                            f"http://127.0.0.1:{rep.port}/v2/models/"
+                            f"{mname}/prefix/import",
+                            data=packet,
+                            headers={"Content-Type":
+                                     "application/octet-stream"},
+                            timeout=aiohttp.ClientTimeout(total=5),
+                        ) as resp:
+                            if resp.status == 200:
+                                warmed += 1
+                        break
+                    except (aiohttp.ClientError,
+                            asyncio.TimeoutError) as e:
+                        logger.debug("rewarm %s[%d] via donor %d: %s",
+                                     key, rep.index, donor.index, e)
+                        continue
+        if warmed:
+            logger.info("isvc %s: re-warmed %d/%d prefixes into "
+                        "replica %d", key, warmed, len(pairs), rep.index)

@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubeflow_tpu import chaos
 from kubeflow_tpu.models.llama import (
     LlamaConfig,
     PRESETS,
@@ -2722,6 +2723,12 @@ class GenerationEngine:
         overlaps the queued blocks' device time; queued blocks are left
         in flight for later steps. Returns True if work ran."""
 
+        if chaos.enabled():
+            # Chaos seam (hot-path free when unarmed: one cached env
+            # read). crash SIGKILLs the replica mid-decode; straggler /
+            # wedge stall this step exactly where a slow or hung device
+            # program would.
+            chaos.apply("engine.decode")
         if self._inflight:
             return self._pipeline_step()
         self._admit()

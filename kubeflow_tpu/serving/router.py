@@ -173,6 +173,98 @@ class ConsistentHashRing:
 # ---------------------------------------------------------------------------
 
 
+class CircuitBreaker:
+    """Per-replica failure-driven ejection (docs/FLEET.md, failure
+    semantics).
+
+    closed -> open after ``failure_threshold`` CONSECUTIVE failures
+    (any success resets the count). open -> half-open once the current
+    reset timeout elapses; half-open admits EXACTLY ONE probe request.
+    The probe's success closes the breaker fully (count and backoff
+    reset); its failure re-opens with the timeout doubled (capped), so
+    a still-dead replica is retried at 1s, 2s, 4s ... never hammered.
+
+    Pure host state machine, injectable clock (``now``) so the unit
+    tests drive it without sleeping. Thread-compatible the way the
+    Router is: single attribute ops, no cross-statement invariants.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+    def __init__(self, failure_threshold: int = 3,
+                 reset_timeout_s: float = 1.0,
+                 backoff_factor: float = 2.0,
+                 max_reset_timeout_s: float = 30.0,
+                 probe_timeout_s: float = 30.0,
+                 now=time.monotonic) -> None:
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.reset_timeout_s = float(reset_timeout_s)
+        self.backoff_factor = float(backoff_factor)
+        self.max_reset_timeout_s = float(max_reset_timeout_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self._now = now
+        self.state = self.CLOSED
+        self.failures = 0        # consecutive failures while closed
+        self.trips = 0           # opens since the last full close
+        self.opened_at = 0.0
+        self.timeout_s = self.reset_timeout_s
+        self.probe_inflight = False
+        self.probe_started = 0.0
+
+    def _trip(self) -> None:
+        self.trips += 1
+        self.timeout_s = min(
+            self.reset_timeout_s
+            * self.backoff_factor ** (self.trips - 1),
+            self.max_reset_timeout_s,
+        )
+        self.opened_at = self._now()
+        self.state = self.OPEN
+        self.probe_inflight = False
+
+    def allow(self) -> bool:
+        """May a request be routed here now? Open breakers refuse until
+        their timeout, then transition to half-open and admit exactly
+        one probe (this call claims the probe slot -- the caller MUST
+        report the outcome via record_success/record_failure; a probe
+        with no outcome frees after probe_timeout_s)."""
+        if self.state == self.CLOSED:
+            return True
+        now = self._now()
+        if self.state == self.OPEN:
+            if now < self.opened_at + self.timeout_s:
+                return False
+            self.state = self.HALF_OPEN
+            self.probe_inflight = False
+        # half-open: one probe slot.
+        if self.probe_inflight:
+            if now - self.probe_started > self.probe_timeout_s:
+                self.probe_inflight = False  # lost outcome: free the slot
+            else:
+                return False
+        self.probe_inflight = True
+        self.probe_started = self._now()
+        return True
+
+    def record_success(self) -> None:
+        self.failures = 0
+        if self.state != self.CLOSED:
+            self.state = self.CLOSED
+            self.trips = 0
+            self.timeout_s = self.reset_timeout_s
+            self.probe_inflight = False
+
+    def record_failure(self) -> None:
+        if self.state == self.HALF_OPEN:
+            self._trip()
+            return
+        if self.state == self.OPEN:
+            return  # already ejected; don't extend the window
+        self.failures += 1
+        if self.failures >= self.failure_threshold:
+            self._trip()
+
+
 @dataclasses.dataclass
 class ReplicaLoad:
     """Router-side view of one replica (fed by /healthz ``load`` or by
@@ -189,6 +281,7 @@ class ReplicaLoad:
     ttft_ema_ms: Optional[float] = None
     healthy: bool = True
     last_load_t: float = 0.0
+    breaker: Optional[CircuitBreaker] = None
 
     def pressure(self) -> float:
         """Demand over capacity, in units of 'full engines'. 0 = idle,
@@ -226,6 +319,18 @@ class RouterConfig:
     # Retry-After clamp (seconds) for shed responses.
     retry_after_min_s: float = 0.25
     retry_after_max_s: float = 8.0
+    # Failure-driven ejection (CircuitBreaker): this many CONSECUTIVE
+    # probe/request failures trip the replica out of the ring; re-entry
+    # goes through exponential-backoff half-open probes.
+    breaker_threshold: int = 3
+    breaker_reset_s: float = 1.0
+    breaker_backoff: float = 2.0
+    breaker_max_reset_s: float = 30.0
+    # Empty candidate set (every replica ejected/dead): shed with a
+    # JITTERED Retry-After inside the clamp window so synchronized
+    # clients don't thundering-herd the recovering fleet. False falls
+    # back to the legacy kind="none" abstention.
+    shed_on_empty: bool = True
 
 
 @dataclasses.dataclass
@@ -235,6 +340,7 @@ class RouteDecision:
     prefill_replica: Optional[str] = None  # disagg only
     spilled: bool = False          # second choice taken
     steered: bool = False          # long-prompt steering taken
+    probed: bool = False           # half-open breaker probe admission
     est_ttft_ms: float = 0.0
     retry_after_s: float = 0.0     # shed only
 
@@ -250,11 +356,13 @@ class Router:
     """
 
     def __init__(self, config: Optional[RouterConfig] = None,
-                 name: str = "default") -> None:
+                 name: str = "default", now=time.monotonic) -> None:
         self.cfg = config or RouterConfig()
         self.name = name
+        self._now = now
         self.ring = ConsistentHashRing(self.cfg.vnodes)
         self.replicas: Dict[str, ReplicaLoad] = {}
+        self._shed_seq = 0  # jitter sequence for empty-ring sheds
         reg = obs_registry.REGISTRY
         lab = {"router": name}
         self.c_requests = reg.counter("kftpu_router_requests_total", lab)
@@ -262,6 +370,9 @@ class Router:
         self.c_steered = reg.counter("kftpu_router_steered_total", lab)
         self.c_shed = reg.counter("kftpu_router_shed_total", lab)
         self.c_disagg = reg.counter("kftpu_router_disagg_total", lab)
+        self.c_ejected = reg.counter("kftpu_router_ejected_total", lab)
+        self.c_readmit = reg.counter("kftpu_router_readmitted_total", lab)
+        self.c_probes = reg.counter("kftpu_router_probes_total", lab)
 
     # -- membership ------------------------------------------------------
 
@@ -271,8 +382,16 @@ class Router:
         queries but never join the ring (no decode traffic lands there
         by hash)."""
         rid = str(rid)
+        cfg = self.cfg
         self.replicas[rid] = ReplicaLoad(
-            rid=rid, role=role, max_slots=max(1, int(max_slots))
+            rid=rid, role=role, max_slots=max(1, int(max_slots)),
+            breaker=CircuitBreaker(
+                failure_threshold=cfg.breaker_threshold,
+                reset_timeout_s=cfg.breaker_reset_s,
+                backoff_factor=cfg.breaker_backoff,
+                max_reset_timeout_s=cfg.breaker_max_reset_s,
+                now=self._now,
+            ),
         )
         if role != "prefill":
             self.ring.add(rid)
@@ -337,6 +456,88 @@ class Router:
         if ttft_ms is not None:
             self.observe_ttft(rid, ttft_ms)
 
+    # -- failure-driven ejection (CircuitBreaker) ------------------------
+
+    def record_failure(self, rid: str) -> None:
+        """One probe/request failure against ``rid``. Consecutive
+        failures trip the replica's breaker; tripping removes it from
+        the ring (ring re-sync: its keyspace rehomes onto survivors,
+        and only its keys move -- tested ConsistentHashRing property),
+        so retries and new traffic land elsewhere immediately."""
+        rep = self.replicas.get(str(rid))
+        if rep is None or rep.breaker is None:
+            return
+        was_open = rep.breaker.state == CircuitBreaker.OPEN
+        rep.breaker.record_failure()
+        if rep.breaker.state == CircuitBreaker.OPEN and not was_open:
+            self.ring.remove(rep.rid)
+            self.c_ejected.inc()
+            if trace.enabled():
+                trace.instant(
+                    "breaker-open", plane="serving", track="router",
+                    replica=rep.rid, trips=rep.breaker.trips,
+                    timeout_s=round(rep.breaker.timeout_s, 3),
+                )
+
+    def record_success(self, rid: str) -> None:
+        """One successful exchange with ``rid``: resets the consecutive
+        failure count; a half-open probe's success closes the breaker
+        fully and re-adds the replica to the ring."""
+        rep = self.replicas.get(str(rid))
+        if rep is None or rep.breaker is None:
+            return
+        was = rep.breaker.state
+        rep.breaker.record_success()
+        if was != CircuitBreaker.CLOSED:
+            if rep.role != "prefill":
+                self.ring.add(rep.rid)
+            self.c_readmit.inc()
+            if trace.enabled():
+                trace.instant("breaker-close", plane="serving",
+                              track="router", replica=rep.rid)
+
+    def note_poll(self, rid: str, ok: bool) -> None:
+        """Health-poll outcome. Failures count toward ejection exactly
+        like request errors; successes only reset the consecutive count
+        while the breaker is CLOSED -- a wedged engine still answers
+        /healthz, so a poll success must never close an open breaker
+        (only a real request's success, the half-open probe, does)."""
+        rep = self.replicas.get(str(rid))
+        if rep is None or rep.breaker is None:
+            return
+        if ok:
+            if rep.breaker.state == CircuitBreaker.CLOSED:
+                rep.breaker.record_success()
+        else:
+            self.record_failure(rid)
+
+    def _half_open_probe(self) -> Optional[ReplicaLoad]:
+        """A replica whose breaker is due for (and wins) its single
+        half-open probe admission, or None. Claiming is the one-probe
+        gate: a second concurrent route() gets False from allow()."""
+        for rep in self.replicas.values():
+            b = rep.breaker
+            if (b is not None and rep.healthy and rep.role != "prefill"
+                    and b.state != CircuitBreaker.CLOSED and b.allow()):
+                return rep
+        return None
+
+    def _empty_shed(self) -> RouteDecision:
+        """Every candidate ejected/dead: a clean shed with a Retry-After
+        jittered deterministically (per-router shed sequence) across the
+        clamp window -- synchronized clients get spread retry times, and
+        a chaos replay still sees identical decisions."""
+        cfg = self.cfg
+        self._shed_seq += 1
+        d = hashlib.blake2b(
+            f"{self.name}|shed|{self._shed_seq}".encode(), digest_size=8
+        ).digest()
+        frac = int.from_bytes(d, "big") / float(1 << 64)
+        retry = (cfg.retry_after_min_s
+                 + frac * (cfg.retry_after_max_s - cfg.retry_after_min_s))
+        self.c_shed.inc()
+        return RouteDecision(kind="shed", retry_after_s=round(retry, 3))
+
     # -- policy ----------------------------------------------------------
 
     def route(self, key: bytes, prompt_len: int = 0) -> RouteDecision:
@@ -344,13 +545,44 @@ class Router:
         caller pairs start_request/finish_request around transport)."""
         cfg = self.cfg
         self.c_requests.inc()
-        cands = [
-            self.replicas[r]
-            for r in self.ring.candidates(key, 2)
-            if r in self.replicas and self.replicas[r].healthy
-        ]
+        # Recovery first: a breaker due for its half-open probe gets
+        # this request (exactly one -- allow() claims the single slot;
+        # concurrent routes fall through to the normal candidates).
+        probe = self._half_open_probe()
+        if probe is not None:
+            self.c_probes.inc()
+            decision = RouteDecision(
+                kind="direct", replica=probe.rid, probed=True,
+                est_ttft_ms=probe.est_ttft_ms(cfg.default_ttft_ms),
+            )
+            if trace.enabled():
+                trace.instant("route", plane="serving", track="router",
+                              kind="direct", replica=probe.rid,
+                              probed=True, spilled=False, steered=False,
+                              est_ttft_ms=round(decision.est_ttft_ms, 2))
+            return decision
+        # Walk past unhealthy/ejected entries: the ring may momentarily
+        # hold replicas whose breaker just opened (trip removes them,
+        # but the breaker state is the authority), and candidates() caps
+        # at the distinct-replica count anyway.
+        cands = []
+        for r in self.ring.candidates(key, max(2, len(self.ring))):
+            rep = self.replicas.get(r)
+            if (rep is not None and rep.healthy
+                    and (rep.breaker is None
+                         or rep.breaker.state == CircuitBreaker.CLOSED)):
+                cands.append(rep)
+                if len(cands) >= 2:
+                    break
         if not cands:
-            return RouteDecision(kind="none")
+            if not cfg.shed_on_empty:
+                return RouteDecision(kind="none")
+            decision = self._empty_shed()
+            if trace.enabled():
+                trace.instant("route", plane="serving", track="router",
+                              kind="shed", replica="", spilled=False,
+                              steered=False, est_ttft_ms=0.0)
+            return decision
         long_prompt = (
             cfg.long_prompt_threshold is not None
             and prompt_len >= cfg.long_prompt_threshold
@@ -439,6 +671,8 @@ class Router:
                     "ttft_ema_ms": (
                         round(r.ttft_ema_ms, 3) if r.ttft_ema_ms else 0.0
                     ),
+                    "breaker": (r.breaker.state if r.breaker is not None
+                                else "closed"),
                 }
                 for r in self.replicas.values()
             },
@@ -447,6 +681,9 @@ class Router:
             "steered": self.c_steered.value,
             "shed": self.c_shed.value,
             "disagg": self.c_disagg.value,
+            "ejected": self.c_ejected.value,
+            "readmitted": self.c_readmit.value,
+            "probes": self.c_probes.value,
         }
 
 
@@ -510,37 +747,75 @@ def pack_kv_packet(tokens: Sequence[int], k_rows: Any, v_rows: Any, *,
             _add(prefix + ".s", rows["s"])
         else:
             _add(prefix, rows)
+    payload = b"".join(blobs)
     header = {
-        "version": 1,
+        "version": 2,
         "block": block,
         "plen": len(tokens),
         "layout": ("int8-lane[L,KV,Smax]" if quantized
                    else "bf16[L,P,KV,D]"),
         "chain_hash": h.hex(),
+        # Whole-payload checksum: the chain hash proves token identity,
+        # this proves the TENSOR bytes arrived intact (a flipped KV byte
+        # would otherwise import cleanly and poison every later hit).
+        "payload_blake2b": hashlib.blake2b(
+            payload, digest_size=16).hexdigest(),
         "trace_id": trace_id or trace.trace_id() or "",
         "tensors": tensors,
     }
     if extra:
         header.update(extra)
     hdr = json.dumps(header).encode()
-    return b"".join([PACKET_MAGIC, _HDR_LEN.pack(len(hdr)), hdr] + blobs)
+    return b"".join([PACKET_MAGIC, _HDR_LEN.pack(len(hdr)), hdr, payload])
 
 
 def unpack_kv_packet(buf: bytes) -> dict:
-    """Inverse of pack_kv_packet; verifies magic and the chain hash
-    (corrupt or re-tokenized packets fail closed -- a wrong prefix in a
-    decode replica's cache would silently poison every later hit)."""
+    """Inverse of pack_kv_packet. Fails CLOSED on anything short of a
+    bit-exact packet -- bad magic, a header length pointing outside the
+    buffer, truncated/oversized payload, a chain-hash mismatch on the
+    tokens, or a payload-checksum mismatch on the tensor bytes (a wrong
+    prefix or flipped KV byte in a decode replica's cache would
+    silently poison every later hit). Raises before ANY array reaches
+    the caller, so a partial cache insert is impossible."""
+    if len(buf) < len(PACKET_MAGIC) + _HDR_LEN.size:
+        raise ValueError("truncated KV handoff packet")
     if buf[:len(PACKET_MAGIC)] != PACKET_MAGIC:
         raise ValueError("not a KV handoff packet (bad magic)")
     off = len(PACKET_MAGIC)
     (hlen,) = _HDR_LEN.unpack_from(buf, off)
     off += _HDR_LEN.size
-    header = json.loads(buf[off:off + hlen].decode())
+    if hlen <= 0 or off + hlen > len(buf):
+        raise ValueError(
+            f"KV packet header length {hlen} exceeds buffer ({len(buf)}B)"
+        )
+    try:
+        header = json.loads(buf[off:off + hlen].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"KV packet header is not valid JSON: {e}")
     off += hlen
-    arrays: Dict[str, np.ndarray] = {}
-    for t in header["tensors"]:
+    payload_start = off
+    # Validate declared sizes against the actual buffer BEFORE touching
+    # any bytes: a lying header must not drive reads (or giant
+    # allocations) past the payload.
+    sizes: List[int] = []
+    total = 0
+    for t in header.get("tensors", []):
         dt = _np_dtype(t["dtype"])
-        n = int(np.prod(t["shape"])) * dt.itemsize if t["shape"] else dt.itemsize
+        n = dt.itemsize
+        for s in t["shape"]:
+            if int(s) < 0:
+                raise ValueError("KV packet tensor shape is negative")
+            n *= int(s)
+        sizes.append(n)
+        total += n
+    if payload_start + total != len(buf):
+        raise ValueError(
+            f"KV packet payload length mismatch: header declares "
+            f"{total}B, buffer carries {len(buf) - payload_start}B"
+        )
+    arrays: Dict[str, np.ndarray] = {}
+    for t, n in zip(header["tensors"], sizes):
+        dt = _np_dtype(t["dtype"])
         arr = np.frombuffer(buf[off:off + n], dtype=dt)
         arrays[t["name"]] = arr.reshape(t["shape"])
         off += n
@@ -548,6 +823,9 @@ def unpack_kv_packet(buf: bytes) -> dict:
     n_cov, h = chain_hash(tokens, header["block"])
     if n_cov != header["plen"] or h.hex() != header["chain_hash"]:
         raise ValueError("KV packet chain-hash mismatch")
+    digest = hashlib.blake2b(buf[payload_start:], digest_size=16).hexdigest()
+    if digest != header.get("payload_blake2b"):
+        raise ValueError("KV packet payload checksum mismatch")
     if "k.q" in arrays:
         k_rows: Any = {"q": arrays["k.q"], "s": arrays["k.s"]}
         v_rows: Any = {"q": arrays["v.q"], "s": arrays["v.s"]}

@@ -4,12 +4,16 @@ Plays the role of the reference's "custom predictor" example images in
 e2e tests -- a trivially fast model so tests exercise the serving path
 (storage init, readiness, V1/V2, batching, scaling) without model weights.
 Options: ``delay_ms`` (sleep per batch, for autoscale tests), ``fail``
-(predict raises, for failure-path tests).
+(predict raises, for failure-path tests), ``stream_tokens`` +
+``token_delay_ms`` (deterministic SSE token stream, for the activator's
+stream-resume chaos tests).
 """
 
 from __future__ import annotations
 
+import threading
 import time
+from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Sequence
 
 from kubeflow_tpu.serving.model import InferenceError, Model
@@ -38,6 +42,31 @@ class EchoModel(Model):
             for o in out:
                 o["tag"] = self.options["tag"]
         return out
+
+    def submit_stream(self, instance: Any, on_token) -> tuple:
+        """Deterministic token stream: ids 0..stream_tokens-1, one per
+        token_delay_ms. Two replicas produce byte-identical streams, so
+        a resumed stream must concatenate seamlessly -- the property the
+        activator's resume-by-offset chaos e2e asserts."""
+        n = int(self.options.get("stream_tokens", 0))
+        if n <= 0:
+            raise InferenceError(
+                f"model {self.name} does not support streaming "
+                "generation", 501)
+        delay = float(self.options.get("token_delay_ms", 0)) / 1000.0
+        fut: Future = Future()
+
+        def run() -> None:
+            ids: List[int] = []
+            for i in range(n):
+                if delay:
+                    time.sleep(delay)
+                ids.append(i)
+                on_token(i)
+            fut.set_result(ids)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut, lambda ids: "".join(f"<{t}>" for t in ids)
 
 
 def main(argv=None) -> int:

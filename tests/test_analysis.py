@@ -632,6 +632,58 @@ def test_perf_fleet_shed_rate_sanity_range(tmp_path):
     assert "never fired" in findings[0].message
 
 
+def test_perf_planted_chaos_regression_exits_one(monkeypatch, capsys,
+                                                 tmp_path):
+    bad = analysis.load_perf_baseline()
+    bad["chaos"]["recovery_seconds_ceiling"] = 0.001
+    p = tmp_path / "perf.json"
+    p.write_text(json.dumps(bad))
+    rc, out = _run_cli(monkeypatch, capsys, [], {},
+                       ["--strict", "--json", "--perf-baseline", str(p)])
+    assert rc == 1
+    assert any(f["rule"] == "KT-PERF-CHAOS" and f["hard"]
+               for f in json.loads(out)["new"])
+
+
+def test_perf_chaos_section_vanishing_is_a_finding(tmp_path):
+    # Chaos bounds set but the bench's extra.chaos section dropped out
+    # of the orchestrated run: hard finding, not a silent pass.
+    (tmp_path / "SERVING_BENCH.json").write_text(json.dumps({
+        "extra": {"sweep": []},
+    }))
+    baseline = {"chaos": {"request_loss_ratio_max": 0.0}}
+    findings, _ = analysis.check_perf(baseline, root=str(tmp_path))
+    assert [f.rule for f in findings] == ["KT-PERF-CHAOS"]
+    assert "vanished" in findings[0].message
+
+
+def test_perf_chaos_bounds_required_flags_and_shrunk_curve(tmp_path):
+    doc = {"extra": {"sweep": [], "chaos": {
+        "request_loss_ratio": 0.02,   # over the max: lost requests
+        "stream_dup_tokens": 0,
+        "recovery_seconds": 1.0,
+        # fault_ttft_p99_ms missing entirely: the curve shrank
+        "replica_killed": True,
+        "respawned": False,           # required flag not true
+    }}}
+    (tmp_path / "SERVING_BENCH.json").write_text(json.dumps(doc))
+    baseline = {"chaos": {
+        "request_loss_ratio_max": 0.0,
+        "stream_dup_tokens_max": 0,
+        "recovery_seconds_ceiling": 15.0,
+        "fault_ttft_p99_ms_ceiling": 10000.0,
+        "required": ["replica_killed", "respawned"],
+    }}
+    findings, measured = analysis.check_perf(baseline, root=str(tmp_path))
+    assert measured["chaos.recovery_seconds"] == 1.0
+    assert len(findings) == 3 and all(
+        f.rule == "KT-PERF-CHAOS" and f.hard for f in findings)
+    msgs = [f.message for f in findings]
+    assert any("request_loss_ratio = 0.02 exceeds" in m for m in msgs)
+    assert any("fault_ttft_p99_ms: missing" in m for m in msgs)
+    assert any("respawned" in m and "expected true" in m for m in msgs)
+
+
 def _reshard_row(transition, **kw):
     row = {"transition": transition, "reshard_seconds": 0.1,
            "host_staged_bytes": 0, "checkpoint_restart_seconds": 1.0,

@@ -1,0 +1,693 @@
+"""Chaos-hardening tests (docs/FLEET.md failure semantics,
+docs/ELASTICITY.md corruption recovery).
+
+Layers, fast tier unless marked slow:
+
+- FaultPlan determinism: at-list and prob firing are pure functions of
+  (plan, call sequence); a broken plan disables injection, never the
+  process; corrupt_bytes/mangle_file actuate exactly the advertised
+  mutation; a crash fault really SIGKILLs (subprocess witness).
+- CircuitBreaker state machine with a fake clock: trips at the
+  threshold and not before, half-open admits exactly one probe, a
+  failed probe doubles the backoff (capped), success closes fully.
+- Router recovery: ejection re-syncs the ring, half-open probes win the
+  next route, re-admission restores membership, and an empty candidate
+  set sheds with a jittered-but-deterministic Retry-After.
+- Checkpoint integrity: checksum manifests catch byte flips and
+  truncation; restore falls back to the newest intact step bit-exactly
+  and raises when nothing intact remains; the env-gated torn_ckpt hook
+  drives the same path end to end.
+- Scheduler preemption actuation (ROADMAP item 2): a preempt decision
+  on a managed job routes through controller._evict on the event loop,
+  and is modeled-only outside one or under an in-flight reshard.
+- Activator streaming: the echo runtime's deterministic token stream
+  completes through the proxy; the slow e2e SIGKILLs the serving
+  replica mid-stream and asserts the resume-by-offset replay delivers
+  every token exactly once.
+- The `chaos` analysis family: clean on the real modules, non-vacuous
+  (a broken breaker implementation is caught).
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.chaos import inject
+from kubeflow_tpu.chaos.inject import Fault, FaultPlan
+from kubeflow_tpu.serving.router import CircuitBreaker, Router, RouterConfig
+
+from test_serving_controller import (  # noqa: F401  (cp_client is a fixture)
+    _status,
+    cp_client,
+    isvc,
+    wait_for,
+)
+
+REPO_ROOT = __file__.rsplit("/tests/", 1)[0]
+
+
+@pytest.fixture
+def chaos_plan(monkeypatch):
+    """Arm KFTPU_CHAOS_PLAN for one test and guarantee the process-wide
+    cached plan is dropped afterwards (and before: a prior test may have
+    left the env clean but the cache armed)."""
+    def arm(plan):
+        raw = plan if isinstance(plan, str) else json.dumps(plan)
+        monkeypatch.setenv(inject.ENV_CHAOS_PLAN, raw)
+        inject.reset()
+        return inject.active_plan()
+
+    inject.reset()
+    yield arm
+    inject.reset()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan determinism + actuators
+# ---------------------------------------------------------------------------
+
+class TestFaultPlan:
+    def test_at_list_replays_bit_identically(self):
+        plan = FaultPlan.from_json(json.dumps({"seed": 7, "faults": [
+            {"kind": "straggler", "site": "engine.decode", "at": [2, 5]},
+        ]}))
+        runs = []
+        for _ in range(2):
+            plan.reset_state()
+            for _ in range(8):
+                plan.poke("engine.decode", "0")
+            runs.append(list(plan.fired))
+        assert runs[0] == runs[1]
+        assert [h for (_s, _t, h, _k) in runs[0]] == [2, 5]
+
+    def test_prob_coin_is_seeded_not_process_rng(self):
+        text = json.dumps({"seed": 20260805, "faults": [
+            {"kind": "drop_poll", "site": "router.load_poll",
+             "prob": 0.5},
+        ]})
+        fired = []
+        for _ in range(2):
+            plan = FaultPlan.from_json(text)
+            for _ in range(64):
+                plan.poke("router.load_poll", "r1")
+            fired.append(list(plan.fired))
+        assert fired[0] == fired[1]
+        # A 0.5 coin over 64 hits fires sometimes and not always.
+        assert 0 < len(fired[0]) < 64
+
+    def test_hit_counters_are_per_site_and_target(self):
+        plan = FaultPlan.from_json(json.dumps({"faults": [
+            {"kind": "wedge", "site": "engine.*", "target": "a",
+             "at": [0]},
+        ]}))
+        assert plan.poke("engine.decode", "b") is None
+        assert plan.poke("other.site", "a") is None
+        f = plan.poke("engine.decode", "a")
+        assert f is not None and f.kind == "wedge"
+        # hit 1 for (engine.decode, a): no longer in the at-list.
+        assert plan.poke("engine.decode", "a") is None
+
+    def test_from_env_accepts_inline_json_and_file(self, tmp_path):
+        doc = {"seed": 3, "faults": [{"kind": "crash", "at": [0]}]}
+        inline = FaultPlan.from_env(json.dumps(doc))
+        p = tmp_path / "plan.json"
+        p.write_text(json.dumps(doc))
+        from_file = FaultPlan.from_env(str(p))
+        assert inline.seed == from_file.seed == 3
+        assert from_file.faults[0].kind == "crash"
+
+    def test_unknown_kind_is_rejected(self):
+        with pytest.raises(ValueError, match="not in"):
+            Fault.from_dict({"kind": "meteor"})
+
+    def test_broken_plan_disables_injection_not_the_process(
+            self, chaos_plan):
+        assert chaos_plan("{this is not json") is None
+        assert not inject.enabled()
+        assert inject.should("engine.decode") is None
+
+    def test_active_plan_caches_per_env_value(self, chaos_plan):
+        p1 = chaos_plan({"faults": [{"kind": "wedge", "at": [99]}]})
+        assert inject.active_plan() is p1  # same env -> same object
+        p2 = chaos_plan({"faults": []})
+        assert p2 is not p1
+
+    def test_corrupt_bytes_flips_exactly_one_byte(self, chaos_plan):
+        chaos_plan({"faults": [
+            {"kind": "corrupt_packet", "site": "kv.packet", "at": [0],
+             "offset": 5},
+        ]})
+        buf = bytes(range(64))
+        out = inject.corrupt_bytes(buf)
+        diffs = [i for i in range(64) if out[i] != buf[i]]
+        assert diffs == [5] and out[5] == buf[5] ^ 0xFF
+        # hit 1: no fault -> identity (and not the same mutated buffer).
+        assert inject.corrupt_bytes(buf) == buf
+
+    def test_mangle_file_flip_and_truncate(self, tmp_path):
+        p = tmp_path / "payload.bin"
+        p.write_bytes(bytes(100))
+        assert inject.mangle_file(
+            str(p), Fault(kind="torn_ckpt", offset=3))
+        data = p.read_bytes()
+        assert len(data) == 100 and data[3] == 0xFF
+        assert inject.mangle_file(
+            str(p), Fault(kind="torn_ckpt", mode="truncate"))
+        assert p.stat().st_size == 50
+
+    def test_crash_fault_sigkills_the_process(self, tmp_path):
+        # The one kind that can't be unit-tested in-process: witness it
+        # from outside. The child arms a plan, pokes the site past the
+        # firing hit, and must die by SIGKILL before printing.
+        code = (
+            "from kubeflow_tpu.chaos import inject\n"
+            "for _ in range(3):\n"
+            "    inject.apply('test.site')\n"
+            "print('survived')\n"
+        )
+        env = dict(os.environ)
+        env[inject.ENV_CHAOS_PLAN] = json.dumps(
+            {"faults": [{"kind": "crash", "site": "test.site",
+                         "at": [1]}]})
+        env["PYTHONPATH"] = REPO_ROOT
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True, timeout=60)
+        assert proc.returncode == -signal.SIGKILL, proc.stderr
+        assert "survived" not in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker state machine (fake clock; no sleeps)
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _breaker(clock, **kw):
+    kw.setdefault("failure_threshold", 3)
+    kw.setdefault("reset_timeout_s", 1.0)
+    kw.setdefault("backoff_factor", 2.0)
+    kw.setdefault("max_reset_timeout_s", 4.0)
+    return CircuitBreaker(now=clock, **kw)
+
+
+class TestCircuitBreaker:
+    def test_trips_at_threshold_not_before(self):
+        b = _breaker(_Clock())
+        for _ in range(2):
+            b.record_failure()
+            assert b.state == CircuitBreaker.CLOSED and b.allow()
+        b.record_failure()
+        assert b.state == CircuitBreaker.OPEN
+        assert not b.allow()
+
+    def test_success_resets_the_consecutive_count(self):
+        b = _breaker(_Clock())
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == CircuitBreaker.CLOSED
+
+    def test_half_open_admits_exactly_one_probe(self):
+        clock = _Clock()
+        b = _breaker(clock)
+        for _ in range(3):
+            b.record_failure()
+        clock.advance(1.01)
+        assert b.allow()  # claims the single probe slot
+        assert b.state == CircuitBreaker.HALF_OPEN
+        assert not b.allow()  # concurrent route: refused
+        b.record_success()
+        assert b.state == CircuitBreaker.CLOSED
+        assert b.trips == 0 and b.timeout_s == b.reset_timeout_s
+
+    def test_failed_probe_doubles_the_timeout_capped(self):
+        clock = _Clock()
+        b = _breaker(clock)  # reset 1s, factor 2, cap 4s
+        for _ in range(3):
+            b.record_failure()
+        assert b.timeout_s == 1.0
+        for expect in (2.0, 4.0, 4.0):  # doubled, then capped
+            clock.advance(b.timeout_s + 0.01)
+            assert b.allow()
+            b.record_failure()  # probe outcome: still dead
+            assert b.state == CircuitBreaker.OPEN
+            assert b.timeout_s == expect
+
+    def test_open_failures_do_not_extend_the_window(self):
+        clock = _Clock()
+        b = _breaker(clock)
+        for _ in range(3):
+            b.record_failure()
+        opened, timeout, trips = b.opened_at, b.timeout_s, b.trips
+        clock.advance(0.5)
+        b.record_failure()  # more traffic against an ejected replica
+        assert (b.opened_at, b.timeout_s, b.trips) == (
+            opened, timeout, trips)
+
+    def test_lost_probe_outcome_frees_the_slot(self):
+        clock = _Clock()
+        b = _breaker(clock, probe_timeout_s=5.0)
+        for _ in range(3):
+            b.record_failure()
+        clock.advance(1.01)
+        assert b.allow()
+        assert not b.allow()  # slot held
+        clock.advance(5.01)   # probe outcome never reported
+        assert b.allow()
+
+
+# ---------------------------------------------------------------------------
+# Router recovery: ejection, probe, re-admission, empty-ring shed
+# ---------------------------------------------------------------------------
+
+def _router(clock, **cfg):
+    cfg.setdefault("breaker_threshold", 2)
+    cfg.setdefault("breaker_reset_s", 1.0)
+    r = Router(RouterConfig(**cfg), name="t", now=clock)
+    for rid in ("0", "1", "2"):
+        r.add_replica(rid)
+    return r
+
+
+class TestRouterRecovery:
+    def test_ejection_resyncs_ring_and_probe_readmits(self):
+        clock = _Clock()
+        r = _router(clock)
+        assert "1" in r.ring.nodes()
+        r.record_failure("1")
+        assert "1" in r.ring.nodes()  # threshold 2: one is not enough
+        r.record_failure("1")
+        assert "1" not in r.ring.nodes()
+        # Ejected: no decision may land on it.
+        for i in range(32):
+            d = r.route(f"k{i}".encode())
+            assert d.replica != "1"
+        # Past the reset timeout the next route IS the half-open probe.
+        clock.advance(1.01)
+        d = r.route(b"anything")
+        assert d.probed and d.replica == "1"
+        # Probe succeeded: fully re-admitted, ring membership restored.
+        r.record_success("1")
+        assert "1" in r.ring.nodes()
+        s = r.stats()
+        assert s["ejected"] == 1 and s["readmitted"] == 1
+        assert s["probes"] == 1
+        assert s["replicas"]["1"]["breaker"] == "closed"
+
+    def test_poll_success_never_closes_an_open_breaker(self):
+        # A wedged engine still answers /healthz: poll successes must
+        # not re-admit; only a real request's success (the probe) does.
+        clock = _Clock()
+        r = _router(clock)
+        r.note_poll("1", ok=False)
+        r.note_poll("1", ok=False)
+        assert "1" not in r.ring.nodes()
+        r.note_poll("1", ok=True)
+        assert "1" not in r.ring.nodes()
+        assert r.stats()["replicas"]["1"]["breaker"] != "closed"
+
+    def test_empty_ring_sheds_with_jittered_retry_after(self):
+        clock = _Clock()
+        r = _router(clock, retry_after_min_s=0.25, retry_after_max_s=8.0)
+        for rid in ("0", "1", "2"):
+            r.record_failure(rid)
+            r.record_failure(rid)
+        assert len(r.ring.nodes()) == 0
+        decs = [r.route(f"k{i}".encode()) for i in range(8)]
+        assert all(d.kind == "shed" for d in decs)
+        retries = [d.retry_after_s for d in decs]
+        assert all(0.25 <= ra <= 8.0 for ra in retries)
+        assert len(set(retries)) > 1, "Retry-After must be jittered"
+        # ... but deterministically: a replay sees the same sequence.
+        r2 = _router(_Clock(), retry_after_min_s=0.25,
+                     retry_after_max_s=8.0)
+        for rid in ("0", "1", "2"):
+            r2.record_failure(rid)
+            r2.record_failure(rid)
+        assert [r2.route(f"k{i}".encode()).retry_after_s
+                for i in range(8)] == retries
+
+    def test_empty_shed_can_fall_back_to_legacy_none(self):
+        clock = _Clock()
+        r = _router(clock, shed_on_empty=False)
+        for rid in ("0", "1", "2"):
+            r.record_failure(rid)
+            r.record_failure(rid)
+        assert r.route(b"k").kind == "none"
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint integrity: manifests, fallback restore, torn-write hook
+# ---------------------------------------------------------------------------
+
+def _ckpt(tmp_path, **kw):
+    from kubeflow_tpu.runtime.checkpoint import Checkpointer
+
+    kw.setdefault("interval_steps", 1)
+    kw.setdefault("enable_async", False)
+    return Checkpointer(str(tmp_path / "ckpt"), **kw)
+
+
+def _state(mult: float):
+    return {"w": np.arange(8, dtype=np.float32) * mult,
+            "step": np.array([mult], dtype=np.int32)}
+
+
+def _largest_payload(ck, step):
+    sdir = ck._step_dir(step)
+    best, best_size = None, -1
+    for dirpath, _dirs, fnames in os.walk(sdir):
+        for fn in fnames:
+            full = os.path.join(dirpath, fn)
+            size = os.path.getsize(full)
+            if size > best_size:
+                best, best_size = full, size
+    return best
+
+
+class TestCheckpointIntegrity:
+    def test_verify_detects_flip_and_truncation(self, tmp_path):
+        ck = _ckpt(tmp_path)
+        assert ck.maybe_save(1, _state(1.0), force=True)
+        ck.wait()
+        assert ck.verify_step(1) is True
+        target = _largest_payload(ck, 1)
+        inject.mangle_file(target, Fault(kind="torn_ckpt", mode="flip"))
+        assert ck.verify_step(1) is False
+        inject.mangle_file(target, Fault(kind="torn_ckpt", mode="flip"))
+        assert ck.verify_step(1) is True  # flip is its own inverse
+        inject.mangle_file(
+            target, Fault(kind="torn_ckpt", mode="truncate"))
+        assert ck.verify_step(1) is False
+        ck.close()
+
+    def test_restore_falls_back_to_newest_intact_step(
+            self, tmp_path, caplog):
+        ck = _ckpt(tmp_path)
+        ck.maybe_save(1, _state(1.0), force=True)
+        ck.maybe_save(2, _state(2.0), force=True)
+        ck.wait()
+        inject.mangle_file(_largest_payload(ck, 2),
+                           Fault(kind="torn_ckpt", mode="flip"))
+        with caplog.at_level("ERROR"):
+            out = ck.restore(None, _state(0.0))
+        # Bit-exact continuation from the surviving step, and the
+        # corruption is logged -- never silently absorbed.
+        np.testing.assert_array_equal(out["w"], _state(1.0)["w"])
+        assert int(out["step"][0]) == 1
+        assert any("FAILED checksum" in r.message for r in caplog.records)
+        ck.close()
+
+    def test_all_candidates_corrupt_raises(self, tmp_path):
+        ck = _ckpt(tmp_path)
+        ck.maybe_save(1, _state(1.0), force=True)
+        ck.maybe_save(2, _state(2.0), force=True)
+        ck.wait()
+        for step in (1, 2):
+            inject.mangle_file(_largest_payload(ck, step),
+                               Fault(kind="torn_ckpt", mode="truncate"))
+        with pytest.raises(ValueError, match="no intact checkpoint"):
+            ck.restore(None, _state(0.0))
+        ck.close()
+
+    def test_torn_ckpt_env_hook_drives_fallback_end_to_end(
+            self, tmp_path, chaos_plan):
+        # The seam itself: KFTPU_CHAOS_PLAN tears step 2's payload at
+        # write time (after the manifest recorded the GOOD hashes), and
+        # the verified restore falls back to step 1 bit-exactly.
+        chaos_plan({"faults": [
+            {"kind": "torn_ckpt", "site": "ckpt.write", "target": "2",
+             "at": [0], "mode": "flip"},
+        ]})
+        ck = _ckpt(tmp_path)
+        ck.maybe_save(1, _state(1.0), force=True)
+        ck.maybe_save(2, _state(2.0), force=True)
+        ck.wait()
+        plan = inject.active_plan()
+        assert ("ckpt.write", "2", 0, "torn_ckpt") in plan.fired
+        assert ck.verify_step(2) is False
+        out = ck.restore(None, _state(0.0))
+        np.testing.assert_array_equal(out["w"], _state(1.0)["w"])
+        ck.close()
+
+
+# ---------------------------------------------------------------------------
+# Scheduler preemption actuation (ROADMAP item 2)
+# ---------------------------------------------------------------------------
+
+def _preempt_rig(managed=True, resize_to=None, reshard_pending=None):
+    import types
+
+    from kubeflow_tpu.controller.scheduler import (
+        ClusterScheduler, Decision, Plan)
+
+    evictions = []
+
+    class _Ctl:
+        def __init__(self):
+            self.gang = types.SimpleNamespace(total_chips=8)
+            self._runtimes = {"default/j": types.SimpleNamespace(
+                workers=[object()], resize_to=resize_to,
+                reshard_pending=reshard_pending, formed_replicas=1,
+                formed_world=[])}
+
+        async def _evict(self, key, by):
+            evictions.append((key, by))
+
+    sched = ClusterScheduler(_Ctl())
+    job = types.SimpleNamespace(
+        key="default/j",
+        spec=types.SimpleNamespace(
+            elastic=types.SimpleNamespace(scheduler_managed=managed)))
+    sched._jobs = lambda: [("TrainJob", job)]
+    plan = Plan(decisions=[Decision(job="default/j", action="preempt",
+                                    placement=None, cost_seconds=2.0)])
+    return sched, plan, evictions
+
+
+def _counter_value(name):
+    from kubeflow_tpu.obs.registry import REGISTRY
+
+    return REGISTRY.counter(name).value
+
+
+class TestPreemptActuation:
+    def test_preempt_decision_routes_through_evict_on_the_loop(self):
+        sched, plan, evictions = _preempt_rig()
+        before = _counter_value("kftpu_sched_preempt_actuated_total")
+
+        async def drive():
+            sched._actuate(plan)
+            for _ in range(3):
+                await asyncio.sleep(0)  # let the eviction task run
+
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(drive())
+        finally:
+            loop.close()
+        assert evictions == [("default/j", "scheduler plan")]
+        assert _counter_value(
+            "kftpu_sched_preempt_actuated_total") == before + 1
+
+    def test_policy_only_caller_models_but_does_not_actuate(self):
+        # No running loop (pure planning contexts, e.g. the bench).
+        sched, plan, evictions = _preempt_rig()
+        sched._actuate(plan)
+        assert evictions == []
+
+    def test_never_stacks_on_an_inflight_reconfiguration(self):
+        sched, plan, evictions = _preempt_rig(resize_to=4)
+
+        async def drive():
+            sched._actuate(plan)
+            await asyncio.sleep(0)
+
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(drive())
+        finally:
+            loop.close()
+        assert evictions == []
+
+    def test_unmanaged_jobs_are_modeled_only(self):
+        sched, plan, evictions = _preempt_rig(managed=False)
+
+        async def drive():
+            sched._actuate(plan)
+            await asyncio.sleep(0)
+
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(drive())
+        finally:
+            loop.close()
+        assert evictions == []
+
+
+# ---------------------------------------------------------------------------
+# Activator streaming: completion (fast) and mid-stream replica kill (slow)
+# ---------------------------------------------------------------------------
+
+async def _read_sse_tokens(resp, until=None):
+    """Collect token_ids off an SSE stream; stop early after ``until``
+    events when set (leaving the stream open for the caller)."""
+    tokens, buf, done = [], b"", False
+    while not done:
+        chunk = await resp.content.readany()
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            event, buf = buf.split(b"\n\n", 1)
+            line = event.strip()
+            if not line.startswith(b"data:"):
+                continue
+            payload = line[len(b"data:"):].strip()
+            if payload == b"[DONE]":
+                done = True
+                break
+            doc = json.loads(payload)
+            if "token_id" in doc:
+                tokens.append(doc["token_id"])
+            if until is not None and len(tokens) >= until:
+                return tokens, False
+    return tokens, done
+
+
+def test_stream_generate_completes_through_activator(cp_client):
+    cp, client, loop = cp_client
+
+    async def run():
+        spec = isvc("echo", options={"stream_tokens": 6})
+        r = await client.post("/apis/InferenceService", json=spec)
+        assert r.status == 200, await r.text()
+        await wait_for(
+            lambda: _status(cp, "echo").get("predictor", {}).get(
+                "ready_replicas"), msg="replica ready")
+        resp = await client.post(
+            "/serving/default/echo/v2/models/echo/generate_stream",
+            json={"text_input": "hi", "stream_pacing": False})
+        assert resp.status == 200, await resp.text()
+        tokens, done = await _read_sse_tokens(resp)
+        assert done and tokens == list(range(6))
+
+    loop.run_until_complete(run())
+
+
+@pytest.mark.slow
+def test_stream_resumes_after_replica_sigkill(cp_client):
+    """The chaos e2e for the activator's resume-by-offset path: kill
+    the serving replica mid-stream; the replay on the survivor must
+    deliver every token exactly once (no gap, no duplicate)."""
+    cp, client, loop = cp_client
+    n_tok = 40
+
+    async def run():
+        spec = isvc("echo", min_r=2, max_r=2,
+                    options={"stream_tokens": n_tok,
+                             "token_delay_ms": 60})
+        r = await client.post("/apis/InferenceService", json=spec)
+        assert r.status == 200, await r.text()
+        await wait_for(
+            lambda: (_status(cp, "echo").get("predictor", {}).get(
+                "ready_replicas") or 0) >= 2,
+            msg="both replicas ready")
+        resp = await client.post(
+            "/serving/default/echo/v2/models/echo/generate_stream",
+            json={"text_input": "hi", "stream_pacing": False})
+        assert resp.status == 200, await resp.text()
+        head, _ = await _read_sse_tokens(resp, until=5)
+        assert head == list(range(5))
+        svc = cp.isvc.services["default/echo"]
+        busy = [rep for rep in svc.replicas.values() if rep.in_flight > 0]
+        assert len(busy) == 1, "exactly one replica holds the stream"
+        os.kill(busy[0].ref.pid, signal.SIGKILL)
+        tail, done = await _read_sse_tokens(resp)
+        assert done, "stream must finish on the survivor"
+        tokens = head + tail
+        assert tokens == list(range(n_tok)), (
+            f"resume must be gap- and duplicate-free, got {tokens}")
+
+    loop.run_until_complete(run())
+
+
+# ---------------------------------------------------------------------------
+# Bench chaos phase (slow e2e) -- the measured arm behind KT-PERF-CHAOS
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_bench_chaos_phase_zero_loss_and_recovery():
+    args = {"requests": 60, "workers": 3, "time_scale": 0.05,
+            "kill_hit": 6}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench_serving.py"),
+         "--phase", "chaos", json.dumps(args)],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert doc["replica_killed"] and doc["respawned"]
+    assert doc["request_loss_ratio"] == 0.0
+    assert doc["stream_dup_tokens"] == 0
+    assert doc["streams_resumed"] >= 1
+    assert 0.0 < doc["recovery_seconds"] < 60.0
+    assert doc["router"]["ejected"] >= 1
+    assert doc["router"]["readmitted"] >= 1
+    assert doc["resume_probe"]["complete"]
+
+
+# ---------------------------------------------------------------------------
+# The `chaos` analysis family
+# ---------------------------------------------------------------------------
+
+class TestChaosAnalysisFamily:
+    def test_chaos_family_is_clean_on_the_real_modules(self):
+        from kubeflow_tpu.analysis.chaoscheck import check_chaos
+
+        findings, info = check_chaos()
+        assert findings == [], [f.message for f in findings]
+        assert info["rules"] == 4
+
+    def test_chaoscheck_catches_a_broken_breaker(self, monkeypatch):
+        # Non-vacuity: a breaker that never trips must be reported.
+        from kubeflow_tpu.analysis import chaoscheck
+        from kubeflow_tpu.serving import router as router_mod
+
+        monkeypatch.setattr(router_mod.CircuitBreaker, "record_failure",
+                            lambda self: None)
+        monkeypatch.setattr(chaoscheck.CircuitBreaker, "record_failure",
+                            lambda self: None, raising=False)
+        findings, _info = chaoscheck.check_chaos()
+        assert any(f.rule.startswith("KT-CHAOS") for f in findings)
+
+    def test_run_analysis_routes_the_chaos_family(self, monkeypatch):
+        from kubeflow_tpu import analysis
+        from kubeflow_tpu.analysis import chaoscheck
+        from kubeflow_tpu.analysis.report import Finding
+
+        sentinel = Finding(rule="KT-CHAOS-TEST", path="x", line=1,
+                           message="sentinel", hard=True)
+        monkeypatch.setattr(chaoscheck, "check_chaos",
+                            lambda: ([sentinel], {"rules": 1}))
+        findings, _ = analysis.run_analysis(families={"chaos"})
+        assert findings == [sentinel]

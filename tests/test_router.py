@@ -217,11 +217,25 @@ def test_sync_replicas_and_unhealthy_and_empty():
                      "r2": {"role": "mixed", "max_slots": 4}})
     assert set(r.replicas) == {"r1", "r2"}
     assert r.replicas["r2"].max_slots == 4
+    # Every replica dead: a CLEAN shed (429 + Retry-After), never an
+    # exception out of ConsistentHashRing.candidates, and never "none"
+    # (which would fall back to blind round-robin onto dead replicas).
     r.update_load("r1", {"healthy": False})
     r.update_load("r2", {"healthy": False})
-    assert r.route(b"x" * 16).kind == "none"
+    d = r.route(b"x" * 16)
+    assert d.kind == "shed" and d.replica is None
+    assert (r.cfg.retry_after_min_s <= d.retry_after_s
+            <= r.cfg.retry_after_max_s)
     r.sync_replicas({})
-    assert r.route(b"x" * 16).kind == "none"
+    assert len(r.ring) == 0
+    sheds = [r.route(b"x" * 16) for _ in range(8)]
+    assert all(s.kind == "shed" for s in sheds)
+    # Jittered Retry-After: synchronized clients get SPREAD retry
+    # times (deterministic per shed sequence, so chaos replays match).
+    assert len({s.retry_after_s for s in sheds}) > 1
+    # Legacy abstention stays available for callers that own fallback.
+    r2 = Router(RouterConfig(shed_on_empty=False), name="test-none")
+    assert r2.route(b"x" * 16).kind == "none"
 
 
 def test_update_load_ignores_falsy_gauges():
@@ -306,6 +320,73 @@ def test_packet_rejects_corruption():
     # Non-block-multiple token count never packs.
     with pytest.raises(ValueError, match="multiple"):
         pack_kv_packet(tokens[:10], k, v, block=8)
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_packet_fuzz_truncation_fails_closed(quantized):
+    # EVERY truncation point must raise ValueError -- never return a
+    # partial packet (which import_prefix would insert) and never
+    # escape as a different exception type.
+    tokens, k, v = _packet_arrays(quantized)
+    buf = pack_kv_packet(tokens, k, v, block=8)
+    rng = np.random.default_rng(7)
+    cuts = sorted({0, 1, 7, 8, 11, 12, len(buf) - 1,
+                   *rng.integers(0, len(buf), 40).tolist()})
+    for cut in cuts:
+        with pytest.raises(ValueError):
+            unpack_kv_packet(buf[:cut])
+    # Trailing garbage is also a length mismatch, not a silent accept.
+    with pytest.raises(ValueError, match="length mismatch"):
+        unpack_kv_packet(buf + b"\x00" * 3)
+
+
+def test_packet_fuzz_oversized_header_length_fails_closed():
+    tokens, k, v = _packet_arrays(False)
+    buf = bytearray(pack_kv_packet(tokens, k, v, block=8))
+    import struct
+
+    for hlen in (len(buf), 2**31 - 1, 2**32 - 1):
+        evil = bytearray(buf)
+        struct.pack_into("<I", evil, 8, hlen)
+        with pytest.raises(ValueError, match="header length"):
+            unpack_kv_packet(bytes(evil))
+    # Zero-length header is equally closed.
+    struct.pack_into("<I", buf, 8, 0)
+    with pytest.raises(ValueError, match="header length"):
+        unpack_kv_packet(bytes(buf))
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_packet_fuzz_flipped_tensor_bytes_fail_closed(quantized):
+    # Flipped KV-tensor bytes leave the token chain hash intact -- the
+    # payload checksum is what must catch them (a corrupt KV row that
+    # imported cleanly would poison every later cache hit).
+    tokens, k, v = _packet_arrays(quantized)
+    buf = pack_kv_packet(tokens, k, v, block=8)
+    tok_bytes = np.asarray(tokens, np.int32).tobytes()
+    tensor_start = buf.index(tok_bytes) + len(tok_bytes)
+    rng = np.random.default_rng(11)
+    for off in rng.integers(tensor_start, len(buf), 16).tolist():
+        corrupt = bytearray(buf)
+        corrupt[off] ^= 0x01
+        with pytest.raises(ValueError, match="checksum|chain-hash"):
+            unpack_kv_packet(bytes(corrupt))
+
+
+def test_packet_fuzz_never_partial_cache_insert():
+    # End to end fail-closed: a corrupted packet must leave the
+    # importing cache byte-for-byte EMPTY, not partially populated.
+    from kubeflow_tpu.serving.engine import PrefixCache
+
+    tokens, k, v = _packet_arrays(False)
+    buf = pack_kv_packet(tokens, k, v, block=8)
+    pc = PrefixCache(block=8, capacity_bytes=1 << 20)
+    corrupt = bytearray(buf)
+    corrupt[-1] ^= 0xFF
+    with pytest.raises(ValueError):
+        got = unpack_kv_packet(bytes(corrupt))
+        pc.insert(got["tokens"], got["k"], got["v"])  # pragma: no cover
+    assert pc.entries == {} and pc.by_prefix == {} and pc.bytes == 0
 
 
 # ---------------------------------------------------------------------------
