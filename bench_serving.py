@@ -1091,9 +1091,9 @@ FRONTIER_BLOCKS = tuple(
 
 def _fleet_worker_main(cfg: dict) -> int:
     """Subprocess side of the fleet phase: one replica, JSON-line RPC on
-    stdin/stdout. Ops: gen / stats / export_prefix / import_prefix /
-    stop. Sync replies carry no "id"; gen replies do (the parent routes
-    on that)."""
+    stdin/stdout. Ops: gen / stats / inventory / export_prefix /
+    import_prefix / stop. Sync replies carry no "id"; gen replies do
+    (the parent routes on that)."""
     import base64
     import queue as queue_mod
     import threading
@@ -1114,6 +1114,7 @@ def _fleet_worker_main(cfg: dict) -> int:
     if cfg["backend"] == "sim":
         import numpy as np
 
+        from kubeflow_tpu.serving import router as rt
         from kubeflow_tpu.serving.engine import PrefixCache
 
         block = int(cfg.get("block", 128))
@@ -1208,6 +1209,33 @@ def _fleet_worker_main(cfg: dict) -> int:
                 with pc_lock:
                     st["cache"] = pc.stats()
                 reply({"stats": st})
+            elif op["op"] == "inventory":
+                # Migration-planner input (serving/kv_reshard): the
+                # hottest-first entry metadata incl. the covered tokens
+                # needed to re-key entries on another replica.
+                with pc_lock:
+                    rows = pc.hot_entries(int(op.get("top_k", 0)))
+                reply({"entries": rows})
+            elif op["op"] == "export_prefix":
+                # Sim entries carry placeholder rows, but the transfer
+                # still runs the REAL wire format (pack/unpack, chain
+                # hash + checksum) -- what the resize arm exercises.
+                prompt = list(op["prompt"])
+                with pc_lock:
+                    plen, entry = pc.lookup(prompt, len(prompt))
+                if not plen or entry is None:
+                    reply({"packet_b64": None})
+                else:
+                    buf = rt.pack_kv_packet(entry["tokens"], entry["k"],
+                                            entry["v"], block=block)
+                    reply({"packet_b64":
+                           base64.b64encode(buf).decode()})
+            elif op["op"] == "import_prefix":
+                got = rt.unpack_kv_packet(
+                    base64.b64decode(op["packet_b64"]))
+                with pc_lock:
+                    pc.insert(got["tokens"], got["k"], got["v"])
+                reply({"plen": got["plen"]})
             elif op["op"] == "stop":
                 break
         for _ in threads:
@@ -2129,6 +2157,265 @@ def bench_chaos(args: dict) -> dict:
     }
 
 
+def bench_resize_bitexact(args: dict) -> dict:
+    """Engine TP-resplit parity probe (serving/kv_reshard): a request
+    is MID-DECODE when the engine live-resplits from tp=1 onto a 2-way
+    mesh; its full token stream must equal an unresized run's,
+    token-for-token (f32 config: argmax is robust to the TP reduction
+    reorder, the PR 8 bitwise_parity_vs_restore standard)."""
+    import dataclasses
+    import threading
+
+    import jax
+
+    from kubeflow_tpu.models.llama import PRESETS as LLAMA_PRESETS
+    from kubeflow_tpu.serving.engine import (
+        GenerationEngine,
+        Request,
+        tp_cache_sharding,
+    )
+
+    if len(jax.devices()) < 2:
+        return {"skipped": f"needs >= 2 devices, have "
+                           f"{len(jax.devices())}"}
+    cfg = dataclasses.replace(LLAMA_PRESETS["llama-tiny"],
+                              dtype="float32", remat=False)
+    prompt = list(range(40))
+    new_tokens = int(args.get("new_tokens", 48))
+
+    ref = GenerationEngine(config=cfg, seed=3, max_slots=2,
+                           decode_block=4)
+    ref_toks = list(ref.generate(prompt, new_tokens))
+    ref.close()
+
+    eng = GenerationEngine(config=cfg, seed=3, max_slots=2,
+                           decode_block=4)
+    eng.start()
+    seen = threading.Event()
+    got: list = []
+
+    def on_tok(t):
+        got.append(t)
+        if len(got) >= 6:
+            seen.set()
+
+    fut = eng.submit(Request(prompt=list(prompt),
+                             max_new_tokens=new_tokens,
+                             temperature=0.0, on_token=on_tok))
+    seen.wait(timeout=300)
+    mid_flight = not fut.done()
+    plan = eng.resplit_tp(2)
+    toks = list(fut.result(timeout=300))
+    cache_sharded = eng.cache_k.sharding.is_equivalent_to(
+        tp_cache_sharding(eng.mesh), eng.cache_k.ndim)
+    eng.close()
+    return {
+        "bit_exact_decode_resume": bool(toks == ref_toks),
+        "resplit_mid_flight": bool(mid_flight),
+        "cache_on_tp_mesh": bool(cache_sharded),
+        "tokens": len(toks),
+        "plan": {k: plan[k] for k in ("transition", "bytes_moved",
+                                      "feasible", "seconds")},
+    }
+
+
+def bench_resize(args: dict) -> dict:
+    """Live fleet resize A/B (docs/ELASTICITY.md serving plane): 3 sim
+    replicas serve a prefix-heavy steady load, then a 4th joins.
+
+    Arm A (migrate) runs the serving/kv_reshard path: donor
+    inventories -> ring-diff migration manifest -> hottest moved
+    entries shipped over the real pack/unpack wire -- all BEFORE the
+    newcomer enters the ring. Arm B (cold) adds it with an empty
+    cache, the pre-PR-14 behavior. Both arms then serve an identical
+    post-resize window; TTFT p99 and fleet prefix-hit-rate against the
+    steady window are the ratcheted KT-PERF-KVRESHARD signals. A
+    subprocess probe (resize_bitexact phase, 2 fake CPU devices)
+    additionally proves the engine TP-resplit resumes decode
+    bit-exactly mid-request."""
+    import base64
+    import queue as queue_mod
+    import subprocess
+
+    import numpy as np
+
+    from kubeflow_tpu.serving import kv_reshard
+    from kubeflow_tpu.serving import router as rt
+
+    block = int(args.get("block", 128))
+    scale = float(args.get("time_scale", 0.1))
+    slots = int(args.get("max_slots", 8))
+    # Slow prefill (vs the fleet phase's 3000): the resize signal IS
+    # the miss-vs-hit prefill gap, so the hit cost must dominate sleep
+    # jitter and the miss cost must dominate everything else.
+    prefill_rate = float(args.get("prefill_tok_per_s", 300.0))
+    decode_rate = float(args.get("decode_tok_per_slot") or 14.4)
+    n_fams = int(args.get("families", 24))
+    vnodes = int(args.get("vnodes", 64))
+    shared_blocks = 4   # 512-token shared prefix + 32-token unique tail
+
+    rng = np.random.default_rng(7)
+    fams = [rng.integers(1, 1000, shared_blocks * block).tolist()
+            for _ in range(n_fams)]
+
+    def workload(per_fam: int, seed: int):
+        r = np.random.default_rng(seed)
+        return [
+            (fams[i % n_fams] + r.integers(1, 1000, 32).tolist(), 64)
+            for i in range(per_fam * n_fams)
+        ]
+
+    # How many family homes the 3->4 ring change ACTUALLY moves --
+    # deterministic (blake2b over fixed tokens/rids), recorded so the
+    # A/B can't silently go vacuous.
+    fam_keys = [rt.prefix_route_key(f, block) for f in fams]
+    moved = rt.ring_diff(["0", "1", "2"], ["0", "1", "2", "3"],
+                         fam_keys, vnodes)
+    t_req = ((shared_blocks * block + 32) / prefill_rate
+             + 64.0 / decode_rate)
+    rate = float(args.get("rate_rps") or 1.5 * slots / t_req)
+
+    def spawn(rids, done_q):
+        ws = [_FleetWorker({
+            "backend": "sim", "rid": rid, "role": "mixed",
+            "block": block, "max_slots": slots, "time_scale": scale,
+            "prefill_tok_per_s": prefill_rate,
+            "decode_tok_per_slot": decode_rate, "cache_mb": 64,
+        }, done_q) for rid in rids]
+        for w in ws:
+            w.wait_ready(timeout=300)
+        return ws
+
+    def run_arm(migrate: bool) -> dict:
+        done_q = queue_mod.Queue()
+        ws = spawn(["0", "1", "2"], done_q)
+        migration: dict = {}
+        try:
+            router = rt.Router(rt.RouterConfig(block=block,
+                                               vnodes=vnodes),
+                               name="resize")
+            for w in ws:
+                router.add_replica(w.rid, role=w.role, max_slots=slots)
+            # Warm pass populates every family's home cache; steady
+            # pass is the measured baseline window.
+            _drive_fleet(ws, workload(2, 101), rate, scale,
+                         router=router)
+            steady = _drive_fleet(ws, workload(2, 102), rate, scale,
+                                  router=router)
+            newcomer = spawn(["3"], done_q)[0]
+            if migrate:
+                by_rid = {w.rid: w for w in ws + [newcomer]}
+                invs = {
+                    w.rid: w.rpc({"op": "inventory"}).get("entries", [])
+                    for w in ws
+                }
+                manifest = kv_reshard.plan_prefix_migration(
+                    [w.rid for w in ws],
+                    [w.rid for w in ws] + [newcomer.rid],
+                    invs, block=block, vnodes=vnodes)
+
+                def export_fn(src, tokens):
+                    b64 = by_rid[src].rpc(
+                        {"op": "export_prefix",
+                         "prompt": tokens}).get("packet_b64")
+                    return base64.b64decode(b64) if b64 else None
+
+                def import_fn(dst, packet):
+                    return by_rid[dst].rpc(
+                        {"op": "import_prefix",
+                         "packet_b64": base64.b64encode(
+                             packet).decode()}).get("plen", 0)
+
+                migration = kv_reshard.migrate_prefixes(
+                    manifest, export_fn, import_fn)
+                migration["planned"] = len(manifest["moves"])
+            # Only now does the newcomer take traffic -- the warming
+            # gate the controller applies (_warming) in miniature.
+            ws.append(newcomer)
+            router.add_replica(newcomer.rid, role=newcomer.role,
+                               max_slots=slots)
+            post = _drive_fleet(ws, workload(1, 103), rate, scale,
+                                router=router)
+            return {"steady": steady, "post": post,
+                    "migration": migration}
+        finally:
+            for w in ws:
+                w.stop()
+
+    arm_migrate = run_arm(migrate=True)
+    arm_cold = run_arm(migrate=False)
+
+    def ratios(arm):
+        s, p = arm["steady"], arm["post"]
+        return {
+            "post_ttft_p99_over_steady": round(
+                p["ttft_ms"]["p99"] / max(1e-9, s["ttft_ms"]["p99"]),
+                3),
+            "post_hit_rate_over_steady": round(
+                p["prefix_hit_rate"] / max(1e-9, s["prefix_hit_rate"]),
+                3),
+        }
+
+    # Engine TP-resplit parity, on 2 faked CPU devices in its own
+    # process (this one may be pinned to a real single chip).
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2")
+    bitexact: dict = {"error": "no JSON from resize_bitexact probe"}
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--phase",
+             "resize_bitexact", "{}"],
+            capture_output=True, text=True, timeout=1200, env=env)
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                bitexact = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    except Exception as e:  # noqa: BLE001 - probe must not kill the A/B
+        bitexact = {"error": _clean_error(f"{type(e).__name__}: {e}")}
+
+    return {
+        "mode": "sim-calibrated",
+        "workload": {
+            "arrivals": "poisson", "rate_rps": round(rate, 3),
+            "families": n_fams,
+            "shared_prefix_tokens": shared_blocks * block,
+            "moved_families": len(moved),
+            "time_scale": scale,
+            "prefill_tok_per_s": prefill_rate,
+            "decode_tok_per_slot": round(decode_rate, 2),
+        },
+        "migrate": {**arm_migrate, "ratios": ratios(arm_migrate)},
+        "cold": {**arm_cold, "ratios": ratios(arm_cold)},
+        "post_ttft_p99_ratio": ratios(arm_migrate)[
+            "post_ttft_p99_over_steady"],
+        "retained_hit_rate_ratio": ratios(arm_migrate)[
+            "post_hit_rate_over_steady"],
+        "migration_seconds": arm_migrate["migration"].get("seconds"),
+        "entries_migrated": arm_migrate["migration"].get("shipped", 0),
+        "cold_arm_regressed": bool(
+            ratios(arm_cold)["post_ttft_p99_over_steady"]
+            > ratios(arm_migrate)["post_ttft_p99_over_steady"]
+            and ratios(arm_cold)["post_hit_rate_over_steady"]
+            < ratios(arm_migrate)["post_hit_rate_over_steady"]),
+        "bit_exact_decode_resume": bool(
+            bitexact.get("bit_exact_decode_resume", False)),
+        "bitexact_probe": bitexact,
+        "note": (
+            "3->4 replica live resize; identical post window per arm "
+            "(one request per family, so every ring-moved family is "
+            "sampled). migrate ships ring-moved hottest entries into "
+            "the newcomer BEFORE it joins the ring (the controller's "
+            "_warming gate in miniature); cold is the pre-PR-14 "
+            "behavior. Times are sim-domain ms; migration_seconds is "
+            "wall clock over the subprocess RPC wire."
+        ),
+    }
+
+
 def _phase_dispatch(name: str, args: dict):
     """Run one named phase in THIS process (the subprocess side)."""
     if name == "slot":
@@ -2159,6 +2446,10 @@ def _phase_dispatch(name: str, args: dict):
         return bench_fleet(args)
     if name == "chaos":
         return bench_chaos(args)
+    if name == "resize":
+        return bench_resize(args)
+    if name == "resize_bitexact":
+        return bench_resize_bitexact(args)
     raise SystemExit(f"unknown phase {name!r}")
 
 
@@ -2265,7 +2556,8 @@ def main() -> int:
             # multi-hour orchestrated run.
             print("usage: bench_serving.py --phase "
                   "<slot|mixed|latency|prefix|spec|quantized|pipeline|"
-                  "kv_capacity|fleet|chaos> ['<json-args>']",
+                  "kv_capacity|fleet|chaos|resize|resize_bitexact> "
+                  "['<json-args>']",
                   file=sys.stderr)
             return 2
         args = json.loads(sys.argv[3]) if len(sys.argv) > 3 else {}
@@ -2301,6 +2593,13 @@ def main() -> int:
         "decode_tok_per_slot": round(
             best["tokens_per_sec"] / max(1, best["max_slots"]), 2),
     }, timeout=900)
+    # Live fleet resize (docs/ELASTICITY.md serving plane): migrate-vs-
+    # cold A/B on a 3->4 scale-out plus the engine TP-resplit parity
+    # probe; ratcheted hard as KT-PERF-KVRESHARD.
+    resize = _run_phase("resize", {
+        "decode_tok_per_slot": round(
+            best["tokens_per_sec"] / max(1, best["max_slots"]), 2),
+    }, timeout=1800)
     lat = dict(prefill_chunk=PREFILL_CHUNK,
                decode_block=LATENCY_DECODE_BLOCK,
                n_requests=LAT_REQUESTS)
@@ -2401,6 +2700,7 @@ def main() -> int:
             "throughput_mixed": mixed,
             "fleet": fleet,
             "chaos": chaos,
+            "kv_reshard": resize,
             "prompt_len": PROMPT_LEN,
             "new_tokens": NEW_TOKENS,
             "decode_block": DECODE_BLOCK,

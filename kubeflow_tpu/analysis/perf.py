@@ -312,6 +312,68 @@ def _check_chaos(cbase: dict, ch: dict, artifact: str,
     return findings
 
 
+def _check_kv_reshard(kbase: dict, kv: dict, artifact: str,
+                      measured: Dict[str, float]) -> List[Finding]:
+    """KT-PERF-KVRESHARD: the serving-plane live resize A/B
+    (bench_serving.py resize phase -- 3->4 replica scale-out with
+    ring-moved prefix entries migrated into the newcomer, vs a
+    cold-cache control arm, plus the engine TP-resplit parity probe).
+
+    The elasticity contract: post-resize TTFT p99 within the ceiling
+    ratio of the steady window, the fleet's prefix-hit-rate retained
+    above the floor ratio, the migration itself cheap, decode resuming
+    bit-exactly after a TP resplit, and the cold arm actually worse on
+    both signals (a migrate arm that merely ties a healthy cold arm
+    measured nothing). A bound whose metric vanished is a finding --
+    the same shrunk-curve rule as every other family."""
+    findings: List[Finding] = []
+
+    def _check(mkey: str, bkey: str, *, floor: bool = False) -> None:
+        limit = kbase.get(bkey)
+        if limit is None:
+            return
+        val = kv.get(mkey)
+        if val is None:
+            findings.append(Finding(
+                rule="KT-PERF-KVRESHARD", path=artifact, line=0,
+                hard=True,
+                message=(
+                    f"kv_reshard.{mkey}: missing from {artifact} "
+                    f"({bkey}={limit}) -- the resize curve shrank"
+                ),
+            ))
+            return
+        measured[f"kv_reshard.{mkey}"] = float(val)
+        bad = val < limit if floor else val > limit
+        if bad:
+            findings.append(Finding(
+                rule="KT-PERF-KVRESHARD", path=artifact, line=0,
+                hard=True,
+                message=(
+                    f"kv_reshard.{mkey} = {val} "
+                    f"{'below floor' if floor else 'exceeds ceiling'} "
+                    f"{limit} ({artifact})"
+                ),
+            ))
+
+    _check("post_ttft_p99_ratio", "post_ttft_p99_ratio_ceiling")
+    _check("retained_hit_rate_ratio", "retained_hit_rate_ratio_floor",
+           floor=True)
+    _check("migration_seconds", "migration_seconds_ceiling")
+    for req in kbase.get("required") or []:
+        if not kv.get(req):
+            findings.append(Finding(
+                rule="KT-PERF-KVRESHARD", path=artifact, line=0,
+                hard=True,
+                message=(
+                    f"kv_reshard.{req} = {kv.get(req)!r}, expected "
+                    f"true: the resize bench did not prove the "
+                    f"migration actually helped ({artifact})"
+                ),
+            ))
+    return findings
+
+
 def _check_reshard(rbase: dict, rows: List[dict], artifact: str,
                    measured: Dict[str, float]) -> List[Finding]:
     """KT-PERF-RESHARD: the live-reshard curve (bench.py --reshard).
@@ -595,6 +657,26 @@ def check_perf(
             else:
                 findings.extend(_check_chaos(cbase, ch, artifact,
                                              measured))
+
+    # -- serving-plane kv/prefix reshard (resize A/B) bounds ----------------
+    kbase = baseline.get("kv_reshard") or {}
+    if kbase:
+        doc, artifact = serving_bench(root)
+        if doc is not None:
+            kv = doc["extra"].get("kv_reshard")
+            if not isinstance(kv, dict):
+                findings.append(Finding(
+                    rule="KT-PERF-KVRESHARD", path=artifact, line=0,
+                    hard=True,
+                    message=(
+                        f"no extra.kv_reshard section in {artifact} "
+                        f"(kv_reshard bounds set) -- the resize bench "
+                        f"vanished"
+                    ),
+                ))
+            else:
+                findings.extend(_check_kv_reshard(kbase, kv, artifact,
+                                                  measured))
 
     # -- live-reshard (elasticity) curve -----------------------------------
     rbase = baseline.get("reshard") or {}

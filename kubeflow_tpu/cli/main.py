@@ -304,6 +304,14 @@ def cmd_trace(args, _client) -> int:
                   f"ttft_ema={eng['ttft_ema_ms']}ms "
                   f"tokens={eng['tokens_generated']} "
                   f"finished={eng['requests_finished']}")
+        mig = summ.get("kv_migration")
+        if mig:
+            pairs = " ".join(f"{k}={v}" for k, v in
+                             sorted(mig["pairs"].items()))
+            print(f"    kv-migration: {mig['entries']} entr"
+                  f"{'y' if mig['entries'] == 1 else 'ies'} shipped, "
+                  f"{mig['bytes']} bytes"
+                  + (f" ({pairs})" if pairs else ""))
     print("view: https://ui.perfetto.dev -> Open trace file")
     return 0
 

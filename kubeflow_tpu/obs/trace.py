@@ -476,7 +476,20 @@ def plane_summaries(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     for ev in doc.get("traceEvents", []):
         ph = ev.get("ph")
         if ph == "B":
-            plane_of(ev)["spans"] += 1
+            p = plane_of(ev)
+            p["spans"] += 1
+            if ev.get("name") == "kv.migrate":
+                # Prefix-cache migration roll-up (serving/kv_reshard):
+                # span-open args carry src/dst/bytes, so the summary
+                # works on truncated traces too (no E event needed).
+                args = ev.get("args") or {}
+                mig = p.setdefault(
+                    "kv_migration",
+                    {"entries": 0, "bytes": 0, "pairs": {}})
+                mig["entries"] += 1
+                mig["bytes"] += int(args.get("bytes", 0) or 0)
+                pair = f"{args.get('src', '?')}->{args.get('dst', '?')}"
+                mig["pairs"][pair] = mig["pairs"].get(pair, 0) + 1
         elif ph in ("i", "I"):
             p = plane_of(ev)
             p["instants"] += 1

@@ -105,6 +105,14 @@ class ReshardPlan:
     hbm_bytes: Optional[int]
     feasible: bool
     infeasible_reason: str = ""
+    #: Per-host transfer schedule: src process_index -> dst process_index
+    #: -> bytes. Execution stays process-local today, but the schedule is
+    #: the input a cross-host transfer engine needs (ROADMAP item 3's
+    #: multi-process headroom): row sums are what each source host must
+    #: send, column sums what each target host must ingest, and the grand
+    #: total equals ``bytes_moved`` exactly.
+    host_transfer_matrix: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict, compare=False)
 
     def summary(self) -> Dict[str, Any]:
         """JSON-ready roll-up (what the bench and events record)."""
@@ -121,6 +129,7 @@ class ReshardPlan:
             "peak_transfer_bytes": self.peak_transfer_bytes,
             "feasible": self.feasible,
             "infeasible_reason": self.infeasible_reason,
+            "host_transfer_matrix": self.host_transfer_matrix,
         }
 
 
@@ -259,6 +268,10 @@ def plan_reshard(
         # model (source not yet freed + target already materialized).
         per_leaf_src: List[Dict[int, int]] = []
         per_leaf_dst: List[Dict[int, int]] = []
+        # src host -> dst host -> bytes (the multi-process transfer
+        # schedule; on a single-process backend it collapses to one
+        # cell whose value is still exactly bytes_moved).
+        host_matrix: Dict[str, Dict[str, int]] = {}
 
         for name, leaf, dst_sh in zip(paths, leaves_src, dst_flat):
             if not hasattr(leaf, "shape") or not hasattr(leaf, "dtype") \
@@ -309,6 +322,34 @@ def plan_reshard(
                     local = _overlap(region, have) if have is not None \
                         else 0
                     moved += (need - local) * dtype.itemsize
+                    # Attribute the non-local bytes source-region by
+                    # source-region: src regions partition the array, so
+                    # the per-region overlaps sum to exactly need-local.
+                    dst_host = str(getattr(dev, "process_index", 0))
+                    for sregion, sdevs in src_map.items():
+                        if sregion == have:
+                            continue  # already resident on this device
+                        ov = _overlap(region, sregion)
+                        if not ov:
+                            continue
+                        live = [d for d in sdevs
+                                if d.id not in lost_ids]
+                        if not live:
+                            continue  # infeasible path noted above
+                        src_dev = min(
+                            live,
+                            key=lambda d: (
+                                getattr(d, "process_index", 0)
+                                != getattr(dev, "process_index", 0),
+                                d.id not in dst_devs,
+                                d.id,
+                            ),
+                        )
+                        src_host = str(
+                            getattr(src_dev, "process_index", 0))
+                        row = host_matrix.setdefault(src_host, {})
+                        row[dst_host] = row.get(dst_host, 0) \
+                            + ov * dtype.itemsize
 
             host_staged = staged_elems * dtype.itemsize
             bytes_logical = math.prod(shape) * dtype.itemsize \
@@ -369,6 +410,7 @@ def plan_reshard(
             hbm_bytes=hbm_bytes,
             feasible=feasible,
             infeasible_reason=infeasible_reason,
+            host_transfer_matrix=host_matrix,
         )
         sp.annotate(transition=transition,
                     bytes_moved=plan.bytes_moved,

@@ -1417,6 +1417,12 @@ class Activator:
         # the donor material for re-warming a respawned replica's
         # prefix cache over the PR 7 KV-handoff endpoints.
         self._recent_texts: Dict[str, "collections.OrderedDict"] = {}
+        # Replicas mid-warm-up: ready (probe passed) but still importing
+        # migrated prefix entries. Excluded from the affinity ring until
+        # the transfer lands, so the first requests a newcomer sees are
+        # hits, not a cold-cache TTFT spike. RR fallback ignores this
+        # set -- with every replica warming, availability wins.
+        self._warming: Dict[str, set] = {}
         controller.rewarm_hooks.append(self._rewarm_replica)
 
     @staticmethod
@@ -1898,6 +1904,13 @@ class Activator:
         releases it."""
         router = self._router_for(key, routing_raw)
         ready = svc.ready_replicas()
+        # Keep mid-warm-up newcomers out of the ring: their prefix
+        # migration is still landing (serving/kv_reshard). Unless they
+        # are ALL warming -- then availability beats warm caches.
+        warming = self._warming.get(key) or set()
+        warm_ready = [r for r in ready if r.index not in warming]
+        if warm_ready:
+            ready = warm_ready
         router.sync_replicas({
             str(r.index): {"role": getattr(r, "role", "mixed")}
             for r in ready
@@ -2054,13 +2067,19 @@ class Activator:
                 return
 
     async def _rewarm_replica(self, key: str, rep: "_Replica") -> None:
-        """Prefix-cache re-warm for a (re)spawned replica: export the
-        recently routed prompts' KV packets from a surviving donor and
-        import them into the newcomer over the PR 7 handoff endpoints.
-        Best-effort -- every failure just leaves that prefix cold."""
-        recent = self._recent_texts.get(key)
-        if not recent:
-            return
+        """Warm a (re)spawned replica through the real migration path
+        (serving/kv_reshard): poll the surviving donors' hottest-entry
+        inventories, plan exactly the entries whose ring home the
+        newcomer's arrival moves (router.ring_diff -- nothing else is
+        worth shipping), and transfer each top-K entry from its
+        least-pressured donor over the PR 7 export/import wire. The
+        newcomer sits in ``_warming`` (out of the affinity ring) until
+        the transfer lands, so its first routed requests hit a warm
+        cache. Falls back to the recent-prompt re-warm when donors
+        predate the inventory route. Best-effort throughout -- every
+        failure just leaves that prefix cold."""
+        from kubeflow_tpu.serving import kv_reshard
+
         ctrl = self.controller
         svc = ctrl.services.get(key)
         if svc is None:
@@ -2069,6 +2088,130 @@ class Activator:
                   if r.index != rep.index]
         if not donors:
             return
+        self._warming.setdefault(key, set()).add(rep.index)
+        try:
+            warmed = await self._migrate_into(key, rep, donors, kv_reshard)
+            if warmed == 0:
+                # Donors without /prefix/inventory (older image) still
+                # speak export/import: re-warm from recent prompts.
+                warmed = await self._rewarm_from_recent(key, rep, donors)
+            if warmed:
+                logger.info("isvc %s: re-warmed %d prefix entries into "
+                            "replica %d", key, warmed, rep.index)
+        finally:
+            w = self._warming.get(key)
+            if w is not None:
+                w.discard(rep.index)
+                if not w:
+                    self._warming.pop(key, None)
+
+    async def _migrate_into(self, key: str, rep: "_Replica",
+                            donors: list, kv_reshard) -> int:
+        """Plan + execute the ring-moved prefix transfer into ``rep``.
+        Returns entries landed (0 when inventories are unavailable)."""
+        ctrl = self.controller
+        router = self._routers.get(key)
+        vnodes = router.cfg.vnodes if router is not None else 64
+        block = (router.cfg.block if router is not None
+                 else kv_reshard.DEFAULT_BLOCK)
+        pressures: Dict[str, float] = {}
+        if router is not None:
+            for rid, load in router.replicas.items():
+                pressures[rid] = float(load.pressure())
+        mnames: list = []
+        for donor in donors:
+            try:
+                async with ctrl._http.get(
+                    f"http://127.0.0.1:{donor.port}/healthz",
+                    timeout=aiohttp.ClientTimeout(total=2),
+                ) as resp:
+                    mnames = list((await resp.json()).get("models") or [])
+                break
+            except Exception as e:  # noqa: BLE001 - donor churn
+                logger.debug("rewarm %s: healthz donor %d: %s",
+                             key, donor.index, e)
+        before = [str(r.index) for r in donors]
+        after = before + [str(rep.index)]
+        by_rid = {str(r.index): r for r in donors}
+        warmed = 0
+        for mname in mnames:
+            inventories: Dict[str, list] = {}
+            for donor in donors:
+                try:
+                    async with ctrl._http.get(
+                        f"http://127.0.0.1:{donor.port}/v2/models/"
+                        f"{mname}/prefix/inventory",
+                        params={"top_k": str(4 * self.REWARM_PREFIXES)},
+                        timeout=aiohttp.ClientTimeout(total=5),
+                    ) as resp:
+                        if resp.status != 200:
+                            continue
+                        rows = (await resp.json()).get("entries") or []
+                except (aiohttp.ClientError, asyncio.TimeoutError):
+                    continue
+                if rows:
+                    inventories[str(donor.index)] = rows
+            if not inventories:
+                continue
+            manifest = kv_reshard.plan_prefix_migration(
+                before, after, inventories, block=block, vnodes=vnodes,
+                top_k=self.REWARM_PREFIXES, pressures=pressures or None,
+            )
+            for move in manifest["moves"]:
+                if move["dst"] != str(rep.index):
+                    continue  # this hook only warms the newcomer
+                donor = by_rid.get(move["src"])
+                if donor is None:
+                    continue
+                with trace.span("kv.migrate", plane="serving",
+                                track="kv-migrate", src=move["src"],
+                                dst=move["dst"],
+                                bytes=int(move.get("bytes", 0)),
+                                plen=int(move.get("plen", 0))) as sp:
+                    try:
+                        async with ctrl._http.post(
+                            f"http://127.0.0.1:{donor.port}/v2/models/"
+                            f"{mname}/prefix/export",
+                            json={"token_ids": move["tokens"],
+                                  "ensure": False},
+                            timeout=aiohttp.ClientTimeout(total=5),
+                        ) as resp:
+                            if resp.status != 200:
+                                sp.annotate(outcome="miss")
+                                continue
+                            packet = await resp.read()
+                        async with ctrl._http.post(
+                            f"http://127.0.0.1:{rep.port}/v2/models/"
+                            f"{mname}/prefix/import",
+                            data=packet,
+                            headers={"Content-Type":
+                                     "application/octet-stream"},
+                            timeout=aiohttp.ClientTimeout(total=5),
+                        ) as resp:
+                            ok = resp.status == 200
+                    except (aiohttp.ClientError,
+                            asyncio.TimeoutError) as e:
+                        sp.annotate(outcome="error",
+                                    error=type(e).__name__)
+                        logger.debug("rewarm %s[%d] via donor %s: %s",
+                                     key, rep.index, move["src"], e)
+                        continue
+                    if ok:
+                        warmed += 1
+                        sp.annotate(outcome="ok")
+                    else:
+                        sp.annotate(outcome="error")
+        return warmed
+
+    async def _rewarm_from_recent(self, key: str, rep: "_Replica",
+                                  donors: list) -> int:
+        """Legacy re-warm: replay recently routed prompts through any
+        donor's export route (donor tokenizes). Used only when the
+        inventory-driven migration shipped nothing."""
+        ctrl = self.controller
+        recent = self._recent_texts.get(key)
+        if not recent:
+            return 0
         pairs = list(recent.keys())[-self.REWARM_PREFIXES:]
         warmed = 0
         with trace.span("replica-rewarm", plane="serving", track="router",
@@ -2101,6 +2244,4 @@ class Activator:
                         logger.debug("rewarm %s[%d] via donor %d: %s",
                                      key, rep.index, donor.index, e)
                         continue
-        if warmed:
-            logger.info("isvc %s: re-warmed %d/%d prefixes into "
-                        "replica %d", key, warmed, len(pairs), rep.index)
+        return warmed

@@ -1362,8 +1362,13 @@ class PrefixCache:
         if size > self.capacity:
             return
         self._tick += 1
+        # tokens: the covered prompt prefix, host ints. Needed to re-key
+        # the entry on another replica (migration re-derives the chain
+        # hashes there) and to re-pack it through the router wire format;
+        # a few KB of host RAM against MBs of device rows.
         entry = {"k": k_rows, "v": v_rows, "plen": plen,
-                 "keys": [], "tick": self._tick, "bytes": size}
+                 "keys": [], "tick": self._tick, "bytes": size,
+                 "tokens": list(prompt[:plen])}
         for _plen, h in hashes:
             # First writer wins for shorter prefixes (it is the LRU-hot
             # one); the full-length key is ours by the check above.
@@ -1387,6 +1392,20 @@ class PrefixCache:
     def stats(self) -> dict:
         return {"entries": len(self.entries), "bytes": self.bytes,
                 "hits": self.hits, "misses": self.misses}
+
+    def hot_entries(self, top_k: int = 0) -> List[dict]:
+        """Hottest-first inventory of cached entries (LRU tick order),
+        host metadata only -- no device buffers. ``top_k`` 0 = all.
+        The unit the serving-plane migration path ships: a recipient
+        re-derives every chain-hash key from ``tokens``, so the hash is
+        advisory (matching the router's affinity key for this entry)."""
+        rows = sorted(self.entries.items(), key=lambda kv: -kv[1]["tick"])
+        if top_k > 0:
+            rows = rows[:top_k]
+        return [{
+            "hash": full.hex(), "plen": e["plen"], "bytes": e["bytes"],
+            "tick": e["tick"], "tokens": list(e.get("tokens", ())),
+        } for full, e in rows]
 
 
 # ---------------------------------------------------------------------------
@@ -1714,6 +1733,81 @@ class GenerationEngine:
         self.pending: "queue.Queue[Request]" = queue.Queue()
         self._rng = jax.random.PRNGKey(seed + 1)
 
+        self._build_dispatch()
+
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self.tokens_generated = 0
+        self.requests_finished = 0
+        self.ttft_hist = LatencyHistogram()
+        self.itl_hist = LatencyHistogram()
+        # Live TTFT EMA (ms): the router's load signal (docs/FLEET.md).
+        # The histogram answers distribution questions after the fact;
+        # routing needs one current number per replica, cheap to read
+        # from the scrape thread.
+        self.ttft_ms_ema: Optional[float] = None
+        # -- overlapped dispatch pipeline ------------------------------
+        # 0 = fully sequential (dispatch, sync, consume); N >= 1 keeps
+        # up to N decode blocks in flight behind the one being consumed,
+        # each chained off the previous block's device-resident carry.
+        # Depth 1 hides one block's host consume; deeper lanes cover
+        # consumes that occasionally outlast a block (logprob-heavy
+        # batches, slow stream callbacks, dispatch-tunnel jitter) at the
+        # cost of more discarded overshoot when a drain hits -- which
+        # drain_overshoot_bound caps.
+        self.pipeline_depth = max(0, int(pipeline_depth))
+        # Device-computed tokens at risk BEYOND the block being consumed
+        # (what a mid-flight finish throws away per freed lane, per
+        # drain). _pipeline_fill shrinks chained blocks to fit the
+        # remaining budget, so deep pipelines queue smaller blocks near
+        # the bound instead of stalling. None -> 2 * decode_block (depth
+        # 1 is never clamped: one queued block always fits); <= 0
+        # disables the bound -- visible in overshoot_max_per_drain,
+        # which the perf ratchet (analysis/perf_baseline.json) caps.
+        if drain_overshoot_bound is None:
+            drain_overshoot_bound = 2 * self.decode_block
+        self.drain_overshoot_bound = int(drain_overshoot_bound)
+        # Per-request sampling nonces (see _decode_block): a plain
+        # itertools counter -- CPython-atomic, so submit() needs no lock.
+        self._req_counter = itertools.count()
+        # Base key for per-row decode sampling; distinct from the
+        # _next_rng chain (which admissions/fused/spec keep consuming)
+        # so an extra in-flight dispatch can never shift that chain.
+        self._decode_rng = jax.random.fold_in(
+            jax.random.PRNGKey(seed), 0xDEC0DE
+        )
+        # Queued in-flight lanes, oldest first (consumed FIFO). Length
+        # is bounded by pipeline_depth; stats() exports it live as
+        # dispatch_inflight.
+        self._inflight: collections.deque = collections.deque()
+        self._drain_reason = ""  # why _pipeline_next last returned 0
+        self._gap_t: Optional[float] = None
+        self.decode_dispatches = 0
+        # Blocks whose outputs were materialized on the host. Trails
+        # decode_dispatches by len(_inflight); the host-sync audit's
+        # steady-state denominator (a window can consume blocks that
+        # were dispatched before it opened).
+        self.decode_blocks_consumed = 0
+        self.host_gap_ms_ema: Optional[float] = None
+        self.overshoot_tokens_discarded = 0
+        # Largest queued-lane discard of any single drain event (the
+        # depth-dependent part of overshoot; head-block overshoot exists
+        # at depth 0 too and is excluded).
+        self.overshoot_max_per_drain = 0
+
+
+    def _build_dispatch(self) -> None:
+        """(Re)build every jit dispatch closure against the CURRENT
+        mesh / weights / caches. ``__init__`` calls this once; the
+        serving-plane reshard (serving/kv_reshard.py) calls it again
+        after moving the engine's state onto a different TP mesh -- the
+        old compiled programs close over the old shardings and must be
+        dropped wholesale. Host scheduler state (slots, lengths, RNG
+        chains, in-flight requests) is untouched, which is what lets a
+        quiesced resplit resume decode bit-exactly."""
+        cfg = self.cfg
+        mesh = self.mesh
         # Pin cache outputs to the KV-head sharding under TP: without the
         # constraint GSPMD may pick a different (e.g. head-dim) layout for
         # the donated outputs, leaving the cache off its intended layout.
@@ -1906,66 +2000,6 @@ class GenerationEngine:
             "extract": extract_jits,
             "restore": restore_jits,
         }
-        self._thread: Optional[threading.Thread] = None
-        self._stop = threading.Event()
-        self._wake = threading.Event()
-        self.tokens_generated = 0
-        self.requests_finished = 0
-        self.ttft_hist = LatencyHistogram()
-        self.itl_hist = LatencyHistogram()
-        # Live TTFT EMA (ms): the router's load signal (docs/FLEET.md).
-        # The histogram answers distribution questions after the fact;
-        # routing needs one current number per replica, cheap to read
-        # from the scrape thread.
-        self.ttft_ms_ema: Optional[float] = None
-        # -- overlapped dispatch pipeline ------------------------------
-        # 0 = fully sequential (dispatch, sync, consume); N >= 1 keeps
-        # up to N decode blocks in flight behind the one being consumed,
-        # each chained off the previous block's device-resident carry.
-        # Depth 1 hides one block's host consume; deeper lanes cover
-        # consumes that occasionally outlast a block (logprob-heavy
-        # batches, slow stream callbacks, dispatch-tunnel jitter) at the
-        # cost of more discarded overshoot when a drain hits -- which
-        # drain_overshoot_bound caps.
-        self.pipeline_depth = max(0, int(pipeline_depth))
-        # Device-computed tokens at risk BEYOND the block being consumed
-        # (what a mid-flight finish throws away per freed lane, per
-        # drain). _pipeline_fill shrinks chained blocks to fit the
-        # remaining budget, so deep pipelines queue smaller blocks near
-        # the bound instead of stalling. None -> 2 * decode_block (depth
-        # 1 is never clamped: one queued block always fits); <= 0
-        # disables the bound -- visible in overshoot_max_per_drain,
-        # which the perf ratchet (analysis/perf_baseline.json) caps.
-        if drain_overshoot_bound is None:
-            drain_overshoot_bound = 2 * self.decode_block
-        self.drain_overshoot_bound = int(drain_overshoot_bound)
-        # Per-request sampling nonces (see _decode_block): a plain
-        # itertools counter -- CPython-atomic, so submit() needs no lock.
-        self._req_counter = itertools.count()
-        # Base key for per-row decode sampling; distinct from the
-        # _next_rng chain (which admissions/fused/spec keep consuming)
-        # so an extra in-flight dispatch can never shift that chain.
-        self._decode_rng = jax.random.fold_in(
-            jax.random.PRNGKey(seed), 0xDEC0DE
-        )
-        # Queued in-flight lanes, oldest first (consumed FIFO). Length
-        # is bounded by pipeline_depth; stats() exports it live as
-        # dispatch_inflight.
-        self._inflight: collections.deque = collections.deque()
-        self._drain_reason = ""  # why _pipeline_next last returned 0
-        self._gap_t: Optional[float] = None
-        self.decode_dispatches = 0
-        # Blocks whose outputs were materialized on the host. Trails
-        # decode_dispatches by len(_inflight); the host-sync audit's
-        # steady-state denominator (a window can consume blocks that
-        # were dispatched before it opened).
-        self.decode_blocks_consumed = 0
-        self.host_gap_ms_ema: Optional[float] = None
-        self.overshoot_tokens_discarded = 0
-        # Largest queued-lane discard of any single drain event (the
-        # depth-dependent part of overshoot; head-block overshoot exists
-        # at depth 0 too and is excluded).
-        self.overshoot_max_per_drain = 0
 
     # -- scheduling core ---------------------------------------------------
 
@@ -3081,6 +3115,49 @@ class GenerationEngine:
             self._wake.set()
             self._thread.join(timeout=5)
             self._thread = None
+
+    def quiesce(self, reason: str = "kv-reshard") -> bool:
+        """Halt dispatch at a block boundary: stop the scheduler thread
+        (if one is running) and drain every in-flight pipeline lane so
+        the host bookkeeping (lengths, generated tokens) and the device
+        cache agree exactly. Active requests KEEP their slots and their
+        KV rows -- quiesce is a pause, not an abort. Returns whether the
+        engine thread was running (pass it back to ``resume``)."""
+        was_running = self._thread is not None
+        if was_running:
+            self.stop()
+        self._drain_inflight(reason)
+        for c in (self.cache_k, self.cache_v):
+            for leaf in jax.tree_util.tree_leaves(c):
+                if hasattr(leaf, "block_until_ready"):
+                    leaf.block_until_ready()
+        return was_running
+
+    def resume(self, was_running: bool) -> None:
+        """Undo ``quiesce``: restart the scheduler thread when one was
+        running before. The decode loop picks up exactly where it
+        drained -- same slots, same lengths, same RNG chains."""
+        if was_running:
+            self.start()
+            self._wake.set()
+
+    def prefix_inventory(self, top_k: int = 0) -> List[dict]:
+        """Hottest-first metadata for this engine's prefix-cache
+        entries (see PrefixCache.hot_entries); [] with no cache."""
+        pc = self.prefix_cache
+        return pc.hot_entries(top_k) if pc is not None else []
+
+    def resplit_tp(self, tensor_parallel: int, *, devices=None,
+                   hbm_bytes: Optional[int] = None) -> dict:
+        """Live-resplit this engine onto a ``tensor_parallel``-way mesh:
+        quiesce at a block boundary, move weights + in-place KV cache +
+        prefix-cache entries through parallel/reshard.py's plan/execute
+        machinery, rebuild the jit dispatch closures, resume. Returns
+        the plan summary (serving/kv_reshard.py owns the mechanics)."""
+        from kubeflow_tpu.serving import kv_reshard
+
+        return kv_reshard.resplit_engine_tp(
+            self, tensor_parallel, devices=devices, hbm_bytes=hbm_bytes)
 
     def close(self) -> None:
         """Release device memory (weights + KV cache) and the compiled

@@ -1,0 +1,309 @@
+"""Serving-plane live reshard: one elasticity story for both planes.
+
+PR 8 made *training* resizes cheap by planning and executing in-memory
+state movement (parallel/reshard.py); this module points the same
+plan/execute/feasibility core at serving-plane state, in two moves:
+
+1. **TP resplit** (`resplit_engine_tp`): a live engine's weights,
+   in-place KV cache (incl. int8 lane-aligned scales), and resident
+   prefix-cache entries move onto a different ``tensor``-axis mesh
+   through `plan_reshard`/`execute_plan` -- same d2d/host/noop leaf
+   modes, same `reshard_peak_bytes` feasibility gate. The decode loop
+   is quiesced at a block boundary first and resumed after the jit
+   dispatch closures are rebuilt, so generation continues bit-exactly:
+   host scheduler state (slots, lengths, RNG chains, in-flight
+   requests) never moves, only device buffers do.
+
+2. **Prefix migration** (`plan_prefix_migration` / `migrate_prefixes`):
+   when fleet membership changes, the router's `ring_diff` names
+   exactly the affinity keys whose home moved; the hottest cache
+   entries behind those keys ship donor -> new-home over the existing
+   ``/v2/.../prefix/export|import`` wire (PR 7's pack/unpack_kv_packet
+   format), so an autoscale event stops being a fleet-wide cold start.
+
+The manifest format (one row per shipped entry)::
+
+    {"key": <route-key hex>, "tokens": [...], "plen": int,
+     "bytes": int, "src": rid, "dst": rid, "tick": int}
+
+Every executed move emits a ``kv.migrate`` span whose open-args carry
+(src, dst, bytes, plen) -- `obs.trace.plane_summaries` rolls these up
+into the kv-migration row `kftpu trace dump` prints.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+
+from kubeflow_tpu.obs import trace
+from kubeflow_tpu.parallel.reshard import (
+    InfeasibleReshardError,
+    execute_plan,
+    plan_reshard,
+)
+from kubeflow_tpu.serving.router import (
+    DEFAULT_BLOCK,
+    prefix_route_key,
+    ring_diff,
+)
+
+__all__ = [
+    "resplit_engine_tp",
+    "plan_prefix_migration",
+    "migrate_prefixes",
+    "InfeasibleReshardError",
+]
+
+
+# ---------------------------------------------------------------------------
+# (1) Live TP resplit of an engine's device state
+# ---------------------------------------------------------------------------
+
+
+def _prefix_entry_shardings(mesh, entry_kv: Any):
+    """Dst shardings for one prefix entry's k or v rows.
+
+    Entries store EXTRACTED rows: bf16 [L, plen, KV, D] (KV heads at
+    axis 2), or under int8 kv_quant a {"q": [L, plen, KV, D] int8,
+    "s": [L, KV, plen] f32} dict -- note the scale's KV axis sits at
+    axis 1 in extracted (row) form, unlike the lane-aligned in-place
+    cache slab. Heads shard over ``tensor`` exactly as the cache they
+    restore into, so restore's scatter stays shard-local.
+    """
+    P = jax.sharding.PartitionSpec
+    rows = jax.sharding.NamedSharding(mesh, P(None, None, "tensor", None))
+    if isinstance(entry_kv, dict):
+        scales = jax.sharding.NamedSharding(mesh, P(None, "tensor", None))
+        return {"q": rows, "s": scales}
+    return rows
+
+
+def resplit_engine_tp(engine, tensor_parallel: int, *, devices=None,
+                      hbm_bytes: Optional[int] = None) -> dict:
+    """Move a live engine onto a ``tensor_parallel``-way mesh in place.
+
+    Quiesces the decode loop at a block boundary, plans the transfer of
+    {weights, cache_k, cache_v, prefix entries} onto the new mesh with
+    `plan_reshard` (feasibility-gated by ``hbm_bytes``), executes it
+    with donation (the old shards free as the new ones land), swaps the
+    engine's device state, rebuilds the jit dispatch closures, and
+    resumes. Raises InfeasibleReshardError -- with the engine resumed
+    on its ORIGINAL mesh, untouched -- when the plan doesn't fit.
+
+    Returns the plan summary plus resplit bookkeeping (tensor_parallel,
+    prefix_entries moved, seconds).
+    """
+    from kubeflow_tpu.serving.engine import (  # circular-at-import-time
+        _validate_tp,
+        make_tp_mesh,
+        tp_cache_sharding,
+        tp_kv_scale_sharding,
+        tp_weight_shardings,
+    )
+
+    cfg = engine.cfg
+    _validate_tp(cfg, tensor_parallel)
+    dst_mesh = make_tp_mesh(tensor_parallel, devices)
+
+    t0 = time.perf_counter()
+    was_running = engine.quiesce("tp-resplit")
+    try:
+        # State pytree: everything device-resident that must land on
+        # the new mesh. Prefix entries ride along keyed by their full
+        # chain hash so the moved buffers can be written back in place.
+        pc = engine.prefix_cache
+        prefix_state: Dict[str, dict] = {}
+        if pc is not None:
+            for full, entry in pc.entries.items():
+                prefix_state[full.hex()] = {
+                    "k": entry["k"], "v": entry["v"],
+                }
+        state = {
+            "weights": engine.weights,
+            "cache_k": engine.cache_k,
+            "cache_v": engine.cache_v,
+            "prefix": prefix_state,
+        }
+
+        cache_sh = tp_cache_sharding(dst_mesh)
+        if isinstance(engine.cache_k, dict):  # int8 kv_quant slabs
+            scale_sh = tp_kv_scale_sharding(dst_mesh)
+            cache_shardings: Any = {"q": cache_sh, "s": scale_sh}
+        else:
+            cache_shardings = cache_sh
+        shardings = {
+            "weights": tp_weight_shardings(dst_mesh, engine.weights),
+            "cache_k": cache_shardings,
+            "cache_v": cache_shardings,
+            "prefix": {
+                hx: {"k": _prefix_entry_shardings(dst_mesh, kv["k"]),
+                     "v": _prefix_entry_shardings(dst_mesh, kv["v"])}
+                for hx, kv in prefix_state.items()
+            },
+        }
+
+        with trace.span("kv.resplit", plane="serving", track="kv-reshard",
+                        tensor_parallel=int(tensor_parallel)) as sp:
+            plan = plan_reshard(state, dst_mesh, dst_shardings=shardings,
+                                hbm_bytes=hbm_bytes)
+            # Infeasible plans raise out of execute_plan before any
+            # buffer moves; the finally below resumes on the old mesh.
+            new_state = execute_plan(state, plan, donate=True)
+            sp.annotate(bytes_moved=plan.bytes_moved,
+                        transition=plan.transition)
+
+        engine.mesh = dst_mesh
+        engine.weights = new_state["weights"]
+        engine.cache_k = new_state["cache_k"]
+        engine.cache_v = new_state["cache_v"]
+        if pc is not None:
+            for full, entry in pc.entries.items():
+                moved = new_state["prefix"][full.hex()]
+                entry["k"] = moved["k"]
+                entry["v"] = moved["v"]
+        # Old compiled programs close over the old shardings; rebuild
+        # every dispatch closure against the new mesh before resuming.
+        engine._build_dispatch()
+    finally:
+        engine.resume(was_running)
+
+    out = plan.summary()
+    out.update({
+        "tensor_parallel": int(tensor_parallel),
+        "prefix_entries": len(prefix_state),
+        "seconds": time.perf_counter() - t0,
+    })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# (2) Fleet prefix-cache migration on ring changes
+# ---------------------------------------------------------------------------
+
+
+def plan_prefix_migration(before: Sequence[str], after: Sequence[str],
+                          inventories: Dict[str, List[dict]], *,
+                          block: int = DEFAULT_BLOCK,
+                          vnodes: int = 64,
+                          top_k: int = 0,
+                          pressures: Optional[Dict[str, float]] = None,
+                          ) -> dict:
+    """Turn a ring membership change into a migration manifest.
+
+    ``inventories`` maps replica id -> that replica's hottest-first
+    prefix inventory (engine.prefix_inventory rows: hash/plen/bytes/
+    tick/tokens). Only entries whose affinity key the ring ACTUALLY
+    moved (router.ring_diff) and whose new home doesn't already hold
+    them are shipped; when several replicas hold copies of one entry
+    the least-pressured donor wins (``pressures``: rid -> load, lower
+    is freer). ``top_k`` > 0 caps moves per recipient to its hottest K
+    -- the respawn re-warm path uses this so a returning replica warms
+    with its best entries first instead of a full cache transfer.
+
+    Returns ``{"moves": [manifest rows], "moved_keys": n,
+    "total_bytes": n}`` with moves ordered hottest-first.
+    """
+    # Route key per candidate entry: hottest row wins for ordering,
+    # but every replica holding a copy stays a donor candidate. Entries
+    # without tokens (pre-PR-14 inventories) can't be re-keyed -> skip.
+    hottest: Dict[bytes, dict] = {}  # route key -> hottest inventory row
+    holders: Dict[bytes, Dict[str, dict]] = {}  # key -> rid -> row
+    for rid, rows in inventories.items():
+        for row in rows:
+            toks = row.get("tokens") or []
+            if len(toks) < block:
+                continue  # under one block: never cached, never routed
+            key = prefix_route_key(toks, block)
+            holders.setdefault(key, {})[rid] = row
+            best = hottest.get(key)
+            if best is None or row.get("tick", 0) > best.get("tick", 0):
+                hottest[key] = row
+
+    moved = ring_diff(before, after, list(hottest.keys()), vnodes)
+
+    per_dst: Dict[str, int] = {}
+    moves: List[dict] = []
+    ordered = sorted(hottest.items(),
+                     key=lambda kv: -kv[1].get("tick", 0))
+    for key, row in ordered:
+        if key not in moved:
+            continue
+        _, new_home = moved[key]
+        who = holders[key]
+        if new_home is None or new_home in who:
+            continue  # nowhere to go / recipient already holds a copy
+        if top_k > 0 and per_dst.get(new_home, 0) >= top_k:
+            continue
+        # Donor: least-pressured replica holding the entry (any holder
+        # serves identical bytes -- a hit implies token-exact equality).
+        if pressures:
+            src = min(who, key=lambda r: pressures.get(r, float("inf")))
+        else:
+            src = next(iter(sorted(who)))
+        per_dst[new_home] = per_dst.get(new_home, 0) + 1
+        moves.append({
+            "key": key.hex(),
+            "tokens": list(row.get("tokens", ())),
+            "plen": int(row.get("plen", 0)),
+            "bytes": int(row.get("bytes", 0)),
+            "tick": int(row.get("tick", 0)),
+            "src": src,
+            "dst": new_home,
+        })
+    return {
+        "moves": moves,
+        "moved_keys": len(moved),
+        "total_bytes": sum(m["bytes"] for m in moves),
+    }
+
+
+def migrate_prefixes(manifest: dict,
+                     export_fn: Callable[[str, List[int]], Optional[bytes]],
+                     import_fn: Callable[[str, bytes], int]) -> dict:
+    """Execute a migration manifest over caller-supplied transports.
+
+    ``export_fn(src_rid, tokens)`` returns the packed KV packet bytes
+    (router wire format) or None on a donor-side miss; ``import_fn(
+    dst_rid, packet)`` lands it and returns the covered length. Each
+    shipped entry runs under a ``kv.migrate`` span carrying src/dst/
+    bytes/plen, which the trace plane summary aggregates. A failed or
+    missing export skips that entry (counted), never aborts the batch:
+    migration is an optimization, the cold path stays correct.
+    """
+    t0 = time.perf_counter()
+    shipped = 0
+    failed = 0
+    total_bytes = 0
+    pairs: Dict[str, int] = {}
+    for move in manifest.get("moves", ()):
+        src, dst = move["src"], move["dst"]
+        with trace.span("kv.migrate", plane="serving", track="kv-migrate",
+                        src=str(src), dst=str(dst),
+                        bytes=int(move.get("bytes", 0)),
+                        plen=int(move.get("plen", 0))) as sp:
+            try:
+                packet = export_fn(src, list(move.get("tokens", ())))
+                if not packet:
+                    failed += 1
+                    sp.annotate(outcome="miss")
+                    continue
+                covered = import_fn(dst, packet)
+            except Exception as exc:  # transport errors skip, not abort
+                failed += 1
+                sp.annotate(outcome="error", error=type(exc).__name__)
+                continue
+            shipped += 1
+            total_bytes += int(move.get("bytes", 0)) or len(packet)
+            pair = f"{src}->{dst}"
+            pairs[pair] = pairs.get(pair, 0) + 1
+            sp.annotate(outcome="ok", covered=int(covered or 0))
+    return {
+        "shipped": shipped,
+        "failed": failed,
+        "bytes": total_bytes,
+        "pairs": pairs,
+        "seconds": time.perf_counter() - t0,
+    }

@@ -168,6 +168,35 @@ class ConsistentHashRing:
         return out
 
 
+def ring_diff(before: Sequence[str], after: Sequence[str],
+              keys: Sequence[bytes],
+              vnodes: int = 64) -> Dict[bytes, tuple]:
+    """Affinity homes that a membership change actually moved.
+
+    Builds the two rings (``before`` / ``after`` replica-id sets, same
+    vnode count the Router uses) and returns ``{key: (old_home,
+    new_home)}`` for exactly the keys whose primary changed. This is
+    the serving-plane migration planner's input: consistent hashing
+    guarantees the moved set is ~changed/N of the keyspace, and a
+    simultaneous add+remove moves precisely the union of the two
+    single-change victim sets -- no key bounces through a third replica
+    (tested in tests/test_router.py)."""
+    ra, rb = ConsistentHashRing(vnodes), ConsistentHashRing(vnodes)
+    for rid in before:
+        ra.add(str(rid))
+    for rid in after:
+        rb.add(str(rid))
+    moved: Dict[bytes, tuple] = {}
+    for key in keys:
+        old = ra.candidates(key, 1)
+        new = rb.candidates(key, 1)
+        old_home = old[0] if old else None
+        new_home = new[0] if new else None
+        if old_home != new_home:
+            moved[key] = (old_home, new_home)
+    return moved
+
+
 # ---------------------------------------------------------------------------
 # Replica load + routing policy
 # ---------------------------------------------------------------------------

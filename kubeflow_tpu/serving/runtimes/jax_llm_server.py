@@ -507,6 +507,14 @@ class JaxLLMModel(Model):
         except ValueError as e:
             raise InferenceError(f"bad KV packet: {e}", 400)
 
+    def prefix_inventory(self, top_k: int = 0) -> List[dict]:
+        """Hottest-first prefix-cache inventory for the migration
+        planner (serving/kv_reshard.plan_prefix_migration); [] when
+        the engine runs without a prefix cache."""
+        if self.engine is None:
+            return []
+        return self.engine.prefix_inventory(int(top_k))
+
     def _json_masks(self):
         """Token-mask table for json_object constrained decoding, built
         once per model from the live tokenizer (byte or BPE) and shared

@@ -90,6 +90,8 @@ class ModelServer:
                      self.h_v2_prefix_export),
             web.post("/v2/models/{m}/prefix/import",
                      self.h_v2_prefix_import),
+            web.get("/v2/models/{m}/prefix/inventory",
+                    self.h_v2_prefix_inventory),
             web.post("/v2/models/{m}/generate_stream",
                      self.h_v2_generate_stream),
             web.post("/v2/repository/models/{m}/load", self.h_v2_load),
@@ -219,6 +221,31 @@ class ModelServer:
         except InferenceError as e:
             return self._err(e)
         return web.json_response({"plen": plen})
+
+    async def h_v2_prefix_inventory(self, req: web.Request) -> web.Response:
+        """Hottest-first prefix-cache inventory (hash/plen/bytes/tick/
+        tokens rows) -- what the migration planner (serving/kv_reshard)
+        feeds ring_diff to decide which entries to ship on a fleet
+        topology change. ``?top_k=N`` caps the listing."""
+        name = req.match_info["m"]
+        try:
+            model = self.repository.get(name)
+            if not model.ready:
+                raise InferenceError(f"model {name} is not ready", 503)
+            fn = getattr(model, "prefix_inventory", None)
+            if fn is None:
+                raise InferenceError(
+                    f"model {name} does not support KV handoff", 501
+                )
+            try:
+                top_k = int(req.query.get("top_k", 0))
+            except ValueError:
+                return web.json_response(
+                    {"error": "top_k must be an integer"}, status=400)
+            rows = await asyncio.to_thread(fn, top_k)
+        except InferenceError as e:
+            return self._err(e)
+        return web.json_response({"entries": rows})
 
     async def h_metrics(self, req: web.Request) -> web.Response:
         m = self.metrics

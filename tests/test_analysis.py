@@ -521,6 +521,7 @@ def test_perf_shipped_baseline_passes_shipped_artifacts():
     assert any(k.startswith("fleet.") for k in measured)
     assert any(k.startswith("reshard.") for k in measured)
     assert any(k.startswith("sched.") for k in measured)
+    assert any(k.startswith("kv_reshard.") for k in measured)
 
 
 def test_perf_planted_mfu_regression_exits_one(monkeypatch, capsys, tmp_path):
@@ -682,6 +683,73 @@ def test_perf_chaos_bounds_required_flags_and_shrunk_curve(tmp_path):
     assert any("request_loss_ratio = 0.02 exceeds" in m for m in msgs)
     assert any("fault_ttft_p99_ms: missing" in m for m in msgs)
     assert any("respawned" in m and "expected true" in m for m in msgs)
+
+
+def test_perf_planted_kv_reshard_regression_exits_one(monkeypatch, capsys,
+                                                      tmp_path):
+    bad = analysis.load_perf_baseline()
+    bad["kv_reshard"]["post_ttft_p99_ratio_ceiling"] = 0.01
+    p = tmp_path / "perf.json"
+    p.write_text(json.dumps(bad))
+    rc, out = _run_cli(monkeypatch, capsys, [], {},
+                       ["--strict", "--json", "--perf-baseline", str(p)])
+    assert rc == 1
+    assert any(f["rule"] == "KT-PERF-KVRESHARD" and f["hard"]
+               for f in json.loads(out)["new"])
+
+
+def test_perf_planted_kv_reshard_hit_rate_floor_exits_one(monkeypatch,
+                                                          capsys, tmp_path):
+    # Hit-rate is a FLOOR, not a ceiling: raising it above the measured
+    # retained ratio must fail, proving the bound points the right way.
+    bad = analysis.load_perf_baseline()
+    bad["kv_reshard"]["retained_hit_rate_ratio_floor"] = 1.5
+    p = tmp_path / "perf.json"
+    p.write_text(json.dumps(bad))
+    rc, out = _run_cli(monkeypatch, capsys, [], {},
+                       ["--strict", "--json", "--perf-baseline", str(p)])
+    assert rc == 1
+    assert any(f["rule"] == "KT-PERF-KVRESHARD" and f["hard"]
+               and "below floor" in f["message"]
+               for f in json.loads(out)["new"])
+
+
+def test_perf_kv_reshard_section_vanishing_is_a_finding(tmp_path):
+    (tmp_path / "SERVING_BENCH.json").write_text(json.dumps({
+        "extra": {"sweep": []},
+    }))
+    baseline = {"kv_reshard": {"post_ttft_p99_ratio_ceiling": 1.5}}
+    findings, _ = analysis.check_perf(baseline, root=str(tmp_path))
+    assert [f.rule for f in findings] == ["KT-PERF-KVRESHARD"]
+    assert "vanished" in findings[0].message
+
+
+def test_perf_kv_reshard_bounds_required_flags_and_shrunk_curve(tmp_path):
+    doc = {"extra": {"sweep": [], "kv_reshard": {
+        "post_ttft_p99_ratio": 2.0,     # over the ceiling: TTFT spiked
+        "retained_hit_rate_ratio": 0.5,  # under the floor: caches went cold
+        # migration_seconds missing entirely: the curve shrank
+        "bit_exact_decode_resume": True,
+        "cold_arm_regressed": False,     # required flag not true
+    }}}
+    (tmp_path / "SERVING_BENCH.json").write_text(json.dumps(doc))
+    baseline = {"kv_reshard": {
+        "post_ttft_p99_ratio_ceiling": 1.5,
+        "retained_hit_rate_ratio_floor": 0.9,
+        "migration_seconds_ceiling": 10.0,
+        "required": ["bit_exact_decode_resume", "cold_arm_regressed"],
+    }}
+    findings, measured = analysis.check_perf(baseline, root=str(tmp_path))
+    assert measured["kv_reshard.post_ttft_p99_ratio"] == 2.0
+    assert len(findings) == 4 and all(
+        f.rule == "KT-PERF-KVRESHARD" and f.hard for f in findings)
+    msgs = [f.message for f in findings]
+    assert any("post_ttft_p99_ratio = 2.0 exceeds" in m for m in msgs)
+    assert any("retained_hit_rate_ratio = 0.5 below floor" in m
+               for m in msgs)
+    assert any("migration_seconds: missing" in m for m in msgs)
+    assert any("cold_arm_regressed" in m and "expected true" in m
+               for m in msgs)
 
 
 def _reshard_row(transition, **kw):

@@ -1,0 +1,314 @@
+"""Serving-plane live reshard (serving/kv_reshard.py).
+
+Two contracts from PR 14's tentpole: (1) a live engine's TP resplit
+through parallel/reshard's plan/execute core resumes decode bit-exactly
+-- token parity vs an unresized engine, the PR 8 standard -- with the
+KV cache and prefix entries landed on the new mesh; (2) a ring
+membership change turns into a migration manifest that ships EXACTLY
+the moved-and-missing hottest entries, executed fail-open over the
+router wire format with kv.migrate spans the trace plane summary rolls
+up. CPU; resplit tests need 2 virtual devices, planner tests need none.
+"""
+
+import dataclasses
+import threading
+
+import pytest
+
+import jax
+
+from kubeflow_tpu.models.llama import PRESETS
+from kubeflow_tpu.obs import trace as obs_trace
+from kubeflow_tpu.serving import kv_reshard
+from kubeflow_tpu.serving.engine import (
+    GenerationEngine,
+    Request,
+    tp_cache_sharding,
+)
+from kubeflow_tpu.serving.router import (
+    ConsistentHashRing,
+    pack_kv_packet,
+    prefix_route_key,
+    ring_diff,
+    unpack_kv_packet,
+)
+
+
+def _f32(preset="llama-tiny"):
+    # f32 activations make greedy argmax robust to TP reduction reorder
+    # (test_serving_engine.py TestTensorParallel convention).
+    return dataclasses.replace(PRESETS[preset], dtype="float32",
+                               remat=False)
+
+
+# ---------------------------------------------------------------------------
+# (1) Live TP resplit: bit-exact decode resume
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 devices")
+class TestResplitTP:
+    def test_mid_flight_resplit_token_parity(self):
+        """Resplit tp1->tp2 WHILE a request decodes; the finished stream
+        must match an unresized engine token-for-token."""
+        cfg = _f32()
+        prompt = list(range(2, 30))
+        ref = GenerationEngine(config=cfg, max_slots=2, decode_block=4)
+        expected = ref.generate(prompt, max_new_tokens=24)
+
+        eng = GenerationEngine(config=cfg, max_slots=2, decode_block=4)
+        eng.start()
+        try:
+            seen = threading.Event()
+            got = []
+
+            def on_tok(t):
+                got.append(t)
+                if len(got) >= 4:
+                    seen.set()
+
+            fut = eng.submit(Request(prompt, max_new_tokens=24,
+                                     temperature=0.0, on_token=on_tok))
+            assert seen.wait(300), "engine produced no tokens"
+            mid_flight = not fut.done()
+            out = eng.resplit_tp(2)
+            toks = list(fut.result(300))
+        finally:
+            eng.stop()
+
+        assert mid_flight, "request finished before the resplit fired"
+        assert toks == expected
+        assert out["feasible"] and out["tensor_parallel"] == 2
+        assert out["bytes_moved"] > 0
+        # Device state actually landed sharded on the new mesh.
+        assert eng.mesh is not None and eng.mesh.shape["tensor"] == 2
+        assert eng.cache_k.sharding.is_equivalent_to(
+            tp_cache_sharding(eng.mesh), eng.cache_k.ndim)
+        # And the engine keeps working after: fresh request, same parity.
+        p2 = [7, 3, 11, 19]
+        assert eng.generate(p2, max_new_tokens=8) == ref.generate(
+            p2, max_new_tokens=8)
+
+    def test_resplit_moves_prefix_entries_onto_new_mesh(self):
+        cfg = _f32()
+        eng = GenerationEngine(config=cfg, max_slots=2, decode_block=4,
+                               prefix_cache_mb=8, prefix_block=8)
+        prompt = list(range(1, 25))  # 3 cache blocks
+        first = eng.generate(prompt, max_new_tokens=6)
+        pc = eng.prefix_cache
+        assert pc.entries, "warm-up did not populate the prefix cache"
+        eng.resplit_tp(2)
+        # Entries were resharded in place: rows live on the TP mesh with
+        # KV heads split, and a lookup still hits byte-for-byte.
+        for entry in pc.entries.values():
+            spec = entry["k"].sharding.spec
+            assert "tensor" in str(spec)
+        plen, entry = pc.lookup(prompt, len(prompt))
+        assert plen > 0 and entry is not None
+        assert eng.generate(prompt, max_new_tokens=6) == first
+
+    def test_infeasible_resplit_leaves_engine_untouched(self):
+        cfg = _f32()
+        eng = GenerationEngine(config=cfg, max_slots=2, decode_block=4)
+        prompt = [5, 9, 17, 250, 3]
+        before = eng.generate(prompt, max_new_tokens=8)
+        old_mesh = eng.mesh
+        with pytest.raises(kv_reshard.InfeasibleReshardError):
+            eng.resplit_tp(2, hbm_bytes=1024)  # nothing fits in 1 KiB
+        # Engine resumed on its ORIGINAL mesh, still correct.
+        assert eng.mesh is old_mesh
+        assert eng.generate(prompt, max_new_tokens=8) == before
+
+
+# ---------------------------------------------------------------------------
+# (2) Migration planner: manifest correctness (no devices needed)
+# ---------------------------------------------------------------------------
+
+
+BLOCK = 8
+
+
+def _row(tokens, tick, plen=None, nbytes=100):
+    return {"hash": "%032x" % tick, "tokens": list(tokens),
+            "plen": plen if plen is not None else len(tokens),
+            "bytes": nbytes, "tick": tick}
+
+
+def _fams(n, length=2 * BLOCK):
+    # Deterministic distinct token families, each >= one route block.
+    return [[(1000 * i + j) % 30000 + 1 for j in range(length)]
+            for i in range(n)]
+
+
+class TestPlanPrefixMigration:
+    def test_ships_only_ring_moved_keys_to_their_new_home(self):
+        fams = _fams(40)
+        before, after = ["0", "1", "2"], ["0", "1", "2", "3"]
+        moved = ring_diff(before, after,
+                          [prefix_route_key(f, BLOCK) for f in fams])
+        assert moved  # non-vacuous topology change
+        inv = {"0": [_row(f, tick=i) for i, f in enumerate(fams)]}
+        plan = kv_reshard.plan_prefix_migration(
+            before, after, inv, block=BLOCK)
+        assert plan["moved_keys"] == len(moved)
+        assert len(plan["moves"]) == len(moved)
+        for mv in plan["moves"]:
+            key = bytes.fromhex(mv["key"])
+            assert key in moved and mv["dst"] == moved[key][1] == "3"
+            assert mv["src"] == "0"
+        # Hottest-first ordering and the byte roll-up.
+        ticks = [m["tick"] for m in plan["moves"]]
+        assert ticks == sorted(ticks, reverse=True)
+        assert plan["total_bytes"] == 100 * len(plan["moves"])
+
+    def test_top_k_caps_moves_per_recipient_to_hottest(self):
+        fams = _fams(60)
+        before, after = ["0", "1", "2"], ["0", "1", "2", "3"]
+        inv = {"0": [_row(f, tick=i) for i, f in enumerate(fams)]}
+        full = kv_reshard.plan_prefix_migration(
+            before, after, inv, block=BLOCK)
+        assert len(full["moves"]) > 2
+        capped = kv_reshard.plan_prefix_migration(
+            before, after, inv, block=BLOCK, top_k=2)
+        assert len(capped["moves"]) == 2
+        # The cap keeps the HOTTEST ones, not an arbitrary pair.
+        assert [m["key"] for m in capped["moves"]] == \
+            [m["key"] for m in full["moves"][:2]]
+
+    def test_least_pressured_donor_wins_among_holders(self):
+        fams = _fams(40)
+        before, after = ["0", "1", "2"], ["0", "1", "2", "3"]
+        rows0 = [_row(f, tick=i) for i, f in enumerate(fams)]
+        rows1 = [_row(f, tick=i + 1000) for i, f in enumerate(fams)]
+        inv = {"0": rows0, "1": rows1}
+        plan = kv_reshard.plan_prefix_migration(
+            before, after, inv, block=BLOCK,
+            pressures={"0": 0.9, "1": 0.1})
+        assert plan["moves"]
+        assert all(m["src"] == "1" for m in plan["moves"])
+        # Without pressures: deterministic lexicographic-first holder.
+        plan2 = kv_reshard.plan_prefix_migration(
+            before, after, inv, block=BLOCK)
+        assert all(m["src"] == "0" for m in plan2["moves"])
+
+    def test_recipient_already_holding_copy_is_skipped(self):
+        fams = _fams(40)
+        before, after = ["0", "1", "2"], ["0", "1", "2", "3"]
+        rows = [_row(f, tick=i) for i, f in enumerate(fams)]
+        # The newcomer already holds EVERY entry (e.g. it re-joined with
+        # a warm cache): nothing ships, even though keys moved.
+        inv = {"0": rows, "3": rows}
+        plan = kv_reshard.plan_prefix_migration(
+            before, after, inv, block=BLOCK)
+        assert plan["moved_keys"] > 0
+        assert plan["moves"] == []
+
+    def test_sub_block_entries_never_ship(self):
+        before, after = ["0", "1"], ["0", "1", "2"]
+        inv = {"0": [_row(list(range(1, BLOCK)), tick=1)]}  # < one block
+        plan = kv_reshard.plan_prefix_migration(
+            before, after, inv, block=BLOCK)
+        assert plan["moves"] == [] and plan["moved_keys"] == 0
+
+
+# ---------------------------------------------------------------------------
+# (3) Migration executor + kv.migrate trace roll-up
+# ---------------------------------------------------------------------------
+
+
+def _manifest():
+    fams = _fams(3)
+    return {"moves": [
+        {"key": prefix_route_key(f, BLOCK).hex(), "tokens": f,
+         "plen": len(f), "bytes": 64, "tick": i, "src": "0", "dst": "3"}
+        for i, f in enumerate(fams)
+    ]}
+
+
+class TestMigratePrefixes:
+    def test_executor_ships_over_real_wire_format(self):
+        import numpy as np
+
+        store = {tuple(m["tokens"]): None for m in _manifest()["moves"]}
+        landed = {}
+
+        def export_fn(src, tokens):
+            assert src == "0"
+            rows = np.zeros((1, len(tokens), 1, 4), np.float32)
+            return pack_kv_packet(tokens, rows, rows, block=BLOCK)
+
+        def import_fn(dst, packet):
+            assert dst == "3"
+            got = unpack_kv_packet(packet)  # fail-closed checksum path
+            landed[tuple(got["tokens"])] = got["plen"]
+            return got["plen"]
+
+        out = kv_reshard.migrate_prefixes(_manifest(), export_fn,
+                                          import_fn)
+        assert out["shipped"] == 3 and out["failed"] == 0
+        assert out["pairs"] == {"0->3": 3}
+        assert out["bytes"] == 3 * 64
+        assert set(landed) == set(store)
+
+    def test_miss_and_error_skip_not_abort(self):
+        calls = []
+
+        def export_fn(src, tokens):
+            calls.append(tokens[0])
+            if len(calls) == 1:
+                return None  # donor-side miss (LRU evicted it)
+            if len(calls) == 2:
+                raise ConnectionError("donor went away")
+            return b"not-a-packet"
+
+        def import_fn(dst, packet):
+            if packet == b"not-a-packet":
+                raise ValueError("bad magic")  # import-side reject
+            return 0
+
+        out = kv_reshard.migrate_prefixes(_manifest(), export_fn,
+                                          import_fn)
+        # All three moves attempted, none shipped, batch never aborted.
+        assert len(calls) == 3
+        assert out == {**out, "shipped": 0, "failed": 3, "pairs": {}}
+
+    def test_kv_migrate_spans_roll_up_in_plane_summary(self):
+        rec = obs_trace.recorder()
+        was = rec.enabled
+        rec.enabled = True
+        rec.clear()
+        try:
+            kv_reshard.migrate_prefixes(
+                _manifest(),
+                lambda src, toks: b"x",  # opaque packet is fine here:
+                lambda dst, pkt: 1)      # the transport is the contract
+            doc = rec.export()
+        finally:
+            rec.enabled = was
+            rec.clear()
+        mig = obs_trace.plane_summaries(doc)["serving"]["kv_migration"]
+        assert mig["entries"] == 3
+        assert mig["bytes"] == 3 * 64
+        assert mig["pairs"] == {"0->3": 3}
+
+
+# ---------------------------------------------------------------------------
+# ring_diff itself (the planner's moved-key oracle)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_diff_matches_manual_ring_walk():
+    keys = [prefix_route_key(f, BLOCK) for f in _fams(50)]
+    before, after = ["a", "b", "c"], ["a", "b", "c", "d"]
+    diff = ring_diff(before, after, keys)
+    rb, ra = ConsistentHashRing(vnodes=64), ConsistentHashRing(vnodes=64)
+    for r in before:
+        rb.add(r)
+    for r in after:
+        ra.add(r)
+    for k in keys:
+        old, new = rb.candidates(k, 1)[0], ra.candidates(k, 1)[0]
+        if old != new:
+            assert diff[k] == (old, new)
+        else:
+            assert k not in diff

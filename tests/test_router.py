@@ -20,6 +20,7 @@ from kubeflow_tpu.serving.router import (
     chain_hash,
     pack_kv_packet,
     prefix_route_key,
+    ring_diff,
     unpack_kv_packet,
 )
 
@@ -106,6 +107,42 @@ def test_ring_remove_only_moves_victims_keys():
             assert after[k] == before[k]
         else:
             assert after[k] != "r3"
+
+
+def test_ring_simultaneous_add_remove_moves_exactly_union_of_victims():
+    # One topology event that both adds r8 and removes r3 must move
+    # EXACTLY the union of the two single-change victim sets: keys the
+    # add alone would steal, plus keys the remove alone would orphan.
+    # No third key bounces between surviving replicas.
+    base = [f"r{i}" for i in range(8)]
+    keys = _keys(2000)
+    add_only = ring_diff(base, base + ["r8"], keys)
+    rm_only = ring_diff(base, [r for r in base if r != "r3"], keys)
+    both = ring_diff(base, [r for r in base if r != "r3"] + ["r8"], keys)
+    assert add_only and rm_only  # non-vacuous: both events moved keys
+    # Keys moved by BOTH single changes exist only when r8 steals from
+    # r3; the union is over keys, and the combined destination wins.
+    assert set(both) == set(add_only) | set(rm_only)
+    for k, (old, new) in both.items():
+        if k in add_only:
+            # The newcomer stole it (possibly FROM the departing r3).
+            assert new == "r8"
+        else:
+            # Orphaned by r3's departure; rehomed either to the SAME
+            # survivor the remove-only world picked, or to the newcomer
+            # when an r8 vnode landed between r3's and that survivor's.
+            # Never to some third replica neither world chose.
+            assert old == "r3" and new != "r3"
+            assert new in ("r8", rm_only[k][1])
+    # Survivor-to-survivor bounce is impossible: every untouched key
+    # keeps its home (ring_diff returns only changed keys, so absence
+    # IS the assertion -- spot-check via a fresh ring pair).
+    ring_before = ConsistentHashRing(vnodes=64)
+    for r in base:
+        ring_before.add(r)
+    for k in keys:
+        if k not in both:
+            assert ring_before.candidates(k, 1)[0] not in ("r3",)
 
 
 def test_ring_candidates_distinct_and_deterministic():
