@@ -46,7 +46,9 @@ from kubeflow_tpu.controller.scheduler import (
     Placement,
     PolicyConfig,
     SchedJob,
+    comm_bytes_for_intensity,
     contention_factor,
+    intensity_from_comm_bytes,
     jains_index,
     scale_efficiency,
 )
@@ -73,6 +75,12 @@ class SimJob:
     arrival: float
     reshardable: bool = False
     spec_chips: int = 0      # FIFO arm's fixed gang size
+    # Measured per-step wire bytes (the shard analysis family's
+    # comm.bytes_per_step, stamped as kftpu.io/comm-bytes-per-step in a
+    # real deployment). None = the job never got audited: the census
+    # prior applies. Measured jobs resolve intensity through the same
+    # log ramp the live scheduler uses.
+    comm_bytes: Optional[float] = None
 
     # mutable sim state
     done: float = 0.0
@@ -95,11 +103,19 @@ def job_mix() -> List[SimJob]:
     collective-light trials arriving over time; two serving scale-ups
     arriving mid-run whose minimums force preemption of HPO trials.
     """
+    # The long-lived train/serving jobs carry MEASURED comm bytes (as a
+    # shard-audited deployment would); the short HPO trials never get
+    # audited and keep the census prior. comm_bytes_for_intensity is the
+    # exact ramp inverse, so measured jobs land on the same intensities
+    # as before -- the arms' physics are unchanged and the bench only
+    # ADDS provenance accounting.
     jobs = [
         SimJob("acme/train-a", "acme", 2.0, "train", 4, 12, 0.85,
-               1000.0, 3_200_000, 0.0, reshardable=True, spec_chips=8),
+               1000.0, 3_200_000, 0.0, reshardable=True, spec_chips=8,
+               comm_bytes=comm_bytes_for_intensity(0.85)),
         SimJob("beta/train-b", "beta", 1.0, "train", 4, 12, 0.85,
-               1000.0, 2_800_000, 0.0, reshardable=True, spec_chips=8),
+               1000.0, 2_800_000, 0.0, reshardable=True, spec_chips=8,
+               comm_bytes=comm_bytes_for_intensity(0.85)),
     ]
     for i, arrival in enumerate((0.0, 0.0, 0.0, 0.0, 60.0, 80.0)):
         jobs.append(SimJob(
@@ -110,10 +126,27 @@ def job_mix() -> List[SimJob]:
     # at elastic minimum and the live HPO trials, minimums exceed the
     # 32-chip cluster -> SLO preemption fires.
     jobs.append(SimJob("acme/serve-a", "acme", 2.0, "serving", 8, 8,
-                       0.15, 1500.0, 900_000, 120.0, spec_chips=8))
+                       0.15, 1500.0, 900_000, 120.0, spec_chips=8,
+                       comm_bytes=comm_bytes_for_intensity(0.15)))
     jobs.append(SimJob("beta/serve-b", "beta", 1.0, "serving", 8, 8,
-                       0.15, 1500.0, 700_000, 150.0, spec_chips=8))
+                       0.15, 1500.0, 700_000, 150.0, spec_chips=8,
+                       comm_bytes=comm_bytes_for_intensity(0.15)))
     return jobs
+
+
+def resolve_sim_intensity(j: SimJob) -> tuple:
+    """(intensity, source) exactly as the live scheduler resolves it."""
+    if j.comm_bytes is not None:
+        return intensity_from_comm_bytes(j.comm_bytes), "measured"
+    return j.intensity, "prior"
+
+
+def intensity_sources(jobs) -> dict:
+    tally: dict = {}
+    for j in jobs:
+        src = resolve_sim_intensity(j)[1]
+        tally[src] = tally.get(src, 0) + 1
+    return tally
 
 
 def domains() -> List[Domain]:
@@ -250,7 +283,8 @@ def run_policy(alpha: float, contention_weight: float,
                 key=j.key, tenant=j.tenant, weight=j.weight,
                 workload=j.workload, min_chips=j.min_chips,
                 max_chips=j.max_chips,
-                collective_intensity=j.intensity,
+                collective_intensity=resolve_sim_intensity(j)[0],
+                intensity_source=resolve_sim_intensity(j)[1],
                 arrival_seq=seq[j.key], reshardable=j.reshardable,
                 current=j.placement, tok_s_per_chip=j.per_chip,
             ) for j in sorted(live, key=lambda j: seq[j.key])]
@@ -362,6 +396,11 @@ def main() -> int:
                     "restart_seconds_used": RESTART_SECONDS,
                     "cost_source": cost_source,
                 },
+                # Which jobs resolved collective intensity from measured
+                # shard-audit bytes (kftpu.io/comm-bytes-per-step) vs the
+                # census prior. The ramp inverse is exact, so measured
+                # jobs land on identical intensities -- provenance only.
+                "intensity": {"sources": intensity_sources(job_mix())},
                 "sim": {
                     "dt_s": DT,
                     "replan_every_s": REPLAN_EVERY,

@@ -13,6 +13,13 @@ rule catalog and workflow):
   CPU backend, asserting donation consumption, bf16-region upcast
   ceilings, shard_map collective counts, and zero steady-state
   recompiles.
+- Tier B.2 (`shardcheck`): sharding-consistency audit over the same
+  entry points plus ring=2 / ulysses=4 sequence meshes and the tp=2
+  serving engine -- KT-SHARD-IMPLICIT (hard) fires when the compiled
+  module moves data through a collective kind the entry's declared
+  sharding plan does not contain (the hidden all-gather an implicit
+  reshard produces), and every collective is priced in wire bytes,
+  ratcheted per entry as ``comm.bytes_per_step.*`` metrics.
 - Tier C (`racecheck` + `protocheck` + `chaoscheck`): lock-discipline
   race detection over the real threaded modules under a contended
   stress driver (KT-RACE-ORDER / KT-GUARD01), exhaustive small-scope
@@ -24,9 +31,9 @@ rule catalog and workflow):
   re-admission / empty rings, and the checkpoint checksum manifests
   catch corruption (KT-CHAOS-*).
 
-Families (``kftpu analyze --only <family>``): astlint | audit | perf |
-race | proto | chaos. `kftpu analyze --strict` is the CI gate: exit 0
-iff nothing regressed vs the committed `baseline.json`.
+Families (``kftpu analyze --only <family>``): astlint | audit | shard |
+perf | race | proto | chaos. `kftpu analyze --strict` is the CI gate:
+exit 0 iff nothing regressed vs the committed `baseline.json`.
 """
 
 import logging
@@ -36,7 +43,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 # Registered analysis families (mirrored in baseline.json so the CI
 # contract is visible next to the grandfather counts).
-FAMILIES = ("astlint", "audit", "perf", "race", "proto", "chaos")
+FAMILIES = ("astlint", "audit", "shard", "perf", "race", "proto", "chaos")
 
 from kubeflow_tpu.analysis.perf import (  # noqa: F401
     PERF_BASELINE_PATH,
@@ -53,6 +60,7 @@ from kubeflow_tpu.analysis.report import (  # noqa: F401
     compare,
     load_baseline,
     render_report,
+    to_sarif,
     write_baseline,
 )
 
@@ -91,7 +99,8 @@ def run_analysis(
     and ``serving=False`` still skips the serving-engine audit and the
     engine stress driver, preserving the historical flag semantics."""
     selected = (set(families) if families is not None
-                else {"astlint", "audit", "race", "proto", "chaos"})
+                else {"astlint", "audit", "shard", "race", "proto",
+                      "chaos"})
     unknown = selected - set(FAMILIES)
     if unknown:
         raise ValueError(
@@ -109,8 +118,17 @@ def run_analysis(
         ensure_cpu_backend()
         from kubeflow_tpu.analysis.jaxpr_audit import audit_all
 
-        audit_findings, metrics = audit_all(include_serving=serving)
+        audit_findings, audit_metrics = audit_all(include_serving=serving)
         findings.extend(audit_findings)
+        metrics.update(audit_metrics)
+    if "shard" in selected and trace:
+        ensure_cpu_backend()
+        from kubeflow_tpu.analysis.shardcheck import shardcheck_all
+
+        shard_findings, shard_metrics = shardcheck_all(
+            include_serving=serving)
+        findings.extend(shard_findings)
+        metrics.update(shard_metrics)
     if "race" in selected:
         from kubeflow_tpu.analysis.racecheck import check_races
 

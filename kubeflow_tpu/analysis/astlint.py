@@ -26,6 +26,22 @@ milliseconds and cannot be broken by import-time side effects. Rules
               two processes staging to the same name clobber each
               other's half-written file (the reshard command-file bug);
               the blessed pattern is obs/trace.py's `.tmp.{os.getpid()}`.
+- KT-SHARD01  `P(...)`/`PartitionSpec(...)` naming a mesh axis that no
+              mesh constructed anywhere in the repo declares -- checked
+              against a repo-wide axis table harvested by AST (Mesh
+              axis_names, MeshConfig keywords, AXES tuples). A typo'd
+              axis name silently means "replicated" at runtime.
+- KT-SHARD02  `reshape`/`flatten`/`ravel` applied, inside traced code,
+              to a value that was explicitly annotated with a sharded
+              PartitionSpec: merging or splitting a sharded dimension
+              forces GSPMD to re-lay the value out (hidden all-gather)
+              -- re-constrain after reshaping instead.
+- KT-ASYNC01  blocking call (`time.sleep`, `subprocess.run`, `open`,
+              `requests.*`, `urlopen`) directly inside an `async def`:
+              it stalls the whole event loop -- every reconcile loop,
+              watch stream, and HTTP handler sharing it -- for the
+              call's full duration (use asyncio.sleep / to_thread /
+              create_subprocess_exec).
 
 Suppression: a trailing same-line comment
     # kt-lint: disable=KT-SYNC01 -- <justification>
@@ -98,12 +114,18 @@ def _resolve_fn_arg(node: ast.AST) -> Optional[str]:
 
 
 class _Module:
-    def __init__(self, path: str, rel: str, source: str) -> None:
+    def __init__(self, path: str, rel: str, source: str,
+                 mesh_axes: Optional[Set[str]] = None) -> None:
         self.path = path
         self.rel = rel
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
+        # Known mesh axis names for KT-SHARD01. None = harvest from this
+        # module alone; lint_package passes the repo-wide table so specs
+        # may legitimately reference axes a *different* module declares.
+        self.mesh_axes = (harvest_mesh_axes([self.tree])
+                          if mesh_axes is None else mesh_axes)
         # name -> FunctionDef nodes (same name in different scopes all
         # recorded; trace-root resolution is best-effort by name).
         self.defs: Dict[str, List[ast.AST]] = {}
@@ -531,6 +553,183 @@ def _check_atomic_staging(mod: _Module, out: List[Finding]) -> None:
                   "pattern)" % func.attr)
 
 
+# -- sharding rules (KT-SHARD01 / KT-SHARD02) -------------------------------
+
+_MESH_CTORS = ("Mesh", "AbstractMesh", "make_mesh", "create_device_mesh")
+_AXES_NAME_RE = re.compile(r"(^|_)AXES$")
+
+
+def _str_constants(node: ast.AST) -> List[str]:
+    return [
+        n.value for n in ast.walk(node)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    ]
+
+
+def harvest_mesh_axes(trees: Iterable[ast.AST]) -> Set[str]:
+    """Repo-wide mesh-axis table: every axis name any reachable mesh
+    construction declares -- ``Mesh(devs, axis_names=...)`` (kwarg or
+    2nd positional), ``MeshConfig(data=..., sequence=...)`` keywords,
+    and ``AXES = ("data", ...)``-style tuple assignments."""
+    axes: Set[str] = set()
+    for tree in trees:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = _call_target_name(node.func)
+                if name in _MESH_CTORS:
+                    for kw in node.keywords:
+                        if kw.arg == "axis_names":
+                            axes.update(_str_constants(kw.value))
+                    if len(node.args) >= 2:
+                        axes.update(_str_constants(node.args[1]))
+                elif name == "MeshConfig":
+                    axes.update(kw.arg for kw in node.keywords
+                                if kw.arg is not None)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Name)
+                            and _AXES_NAME_RE.search(t.id)
+                            and isinstance(node.value, (ast.Tuple,
+                                                        ast.List))):
+                        axes.update(_str_constants(node.value))
+    return axes
+
+
+def _check_partition_axes(mod: _Module, out: List[Finding]) -> None:
+    """KT-SHARD01: every axis name a PartitionSpec references must be
+    declared by SOME mesh construction in the repo; an unknown name is
+    silently treated as replicated by JAX's spec resolution paths."""
+    if not mod.mesh_axes:
+        return  # no mesh table to validate against: stay conservative
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _call_target_name(node.func) not in ("P", "PartitionSpec"):
+            continue
+        for arg in node.args:
+            for name in _str_constants(arg):
+                if name not in mod.mesh_axes:
+                    _emit(out, mod, "KT-SHARD01", node.lineno,
+                          f"PartitionSpec axis {name!r} is not declared "
+                          f"by any mesh in the repo (known axes: "
+                          f"{sorted(mod.mesh_axes)}); a typo'd axis "
+                          f"silently means 'replicated'")
+
+
+_RESHAPERS = ("reshape", "flatten", "ravel")
+_CONSTRAINT_FNS = ("with_sharding_constraint", "with_logical_constraint")
+
+
+def _spec_is_sharded(call: ast.Call) -> bool:
+    """A constraint call whose spec carries any axis-name string is a
+    sharded annotation (P() / P(None, None) are replication hints)."""
+    return any(bool(_str_constants(a)) for a in call.args[1:])
+
+
+def _check_shard_reshape(mod: _Module, out: List[Finding]) -> None:
+    """KT-SHARD02: reshape/flatten/ravel of a value that carries an
+    explicit sharded-spec annotation, inside traced code. The reshape
+    discards the constraint and GSPMD re-lays the operand out however
+    propagation likes -- re-apply the constraint on the reshaped value."""
+    for fn in _traced_defs(mod):
+        sharded: Set[str] = set()
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and _call_target_name(node.value.func)
+                    in _CONSTRAINT_FNS
+                    and _spec_is_sharded(node.value)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        sharded.add(t.id)
+        if not sharded:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            hit = None
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _RESHAPERS
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in sharded):
+                hit = (func.value.id, func.attr)
+            elif (isinstance(func, ast.Attribute)
+                    and func.attr == "reshape"
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in _NUMPY_NAMES | {"jnp"}
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in sharded):
+                hit = (node.args[0].id, f"{func.value.id}.reshape")
+            if hit:
+                _emit(out, mod, "KT-SHARD02", node.lineno,
+                      f".{hit[1]}() of {hit[0]!r}, which carries an "
+                      f"explicit sharded PartitionSpec: the reshape "
+                      f"drops the constraint and invites a hidden "
+                      f"re-layout -- re-constrain the reshaped value")
+
+
+# -- async blocking calls (KT-ASYNC01) --------------------------------------
+
+_BLOCKING_ATTRS = {
+    ("time", "sleep"): "asyncio.sleep",
+    ("subprocess", "run"): "asyncio.create_subprocess_exec",
+    ("subprocess", "call"): "asyncio.create_subprocess_exec",
+    ("subprocess", "check_call"): "asyncio.create_subprocess_exec",
+    ("subprocess", "check_output"): "asyncio.create_subprocess_exec",
+    ("subprocess", "Popen"): "asyncio.create_subprocess_exec",
+    ("request", "urlopen"): "an async HTTP client or asyncio.to_thread",
+}
+_BLOCKING_NAMES = {
+    "open": "asyncio.to_thread",
+    "urlopen": "an async HTTP client or asyncio.to_thread",
+}
+
+
+def _walk_own_statements(fn: ast.AST):
+    """Yield nodes of ``fn`` without descending into nested defs (a
+    nested sync def is typically shipped to an executor, which is the
+    fix this rule recommends)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_async_blocking(mod: _Module, out: List[Finding]) -> None:
+    for nodes in mod.defs.values():
+        for fn in nodes:
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in _walk_own_statements(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                label = fix = None
+                if (isinstance(func, ast.Attribute)
+                        and isinstance(func.value, ast.Name)):
+                    key = (func.value.id, func.attr)
+                    if key in _BLOCKING_ATTRS:
+                        label = f"{key[0]}.{key[1]}"
+                        fix = _BLOCKING_ATTRS[key]
+                    elif func.value.id == "requests":
+                        label = f"requests.{func.attr}"
+                        fix = "an async HTTP client or asyncio.to_thread"
+                elif (isinstance(func, ast.Name)
+                      and func.id in _BLOCKING_NAMES):
+                    label = f"{func.id}"
+                    fix = _BLOCKING_NAMES[func.id]
+                if label:
+                    _emit(out, mod, "KT-ASYNC01", node.lineno,
+                          f"blocking {label}() inside async def "
+                          f"{fn.name!r} stalls the event loop for its "
+                          f"full duration (use {fix})")
+
+
 # -- driver -----------------------------------------------------------------
 
 RULES = (
@@ -540,13 +739,17 @@ RULES = (
     _check_donation,
     _check_unused_imports,
     _check_atomic_staging,
+    _check_partition_axes,
+    _check_shard_reshape,
+    _check_async_blocking,
 )
 
 
-def lint_file(path: str, rel: Optional[str] = None) -> List[Finding]:
+def lint_file(path: str, rel: Optional[str] = None,
+              mesh_axes: Optional[Set[str]] = None) -> List[Finding]:
     with open(path, encoding="utf-8") as f:
         source = f.read()
-    mod = _Module(path, rel or path, source)
+    mod = _Module(path, rel or path, source, mesh_axes=mesh_axes)
     out: List[Finding] = []
     for rule in RULES:
         rule(mod, out)
@@ -566,13 +769,56 @@ def iter_python_files(root: str) -> Iterable[Tuple[str, str]]:
             yield path, os.path.relpath(path, os.path.dirname(root))
 
 
+def package_mesh_axes(package_root: str) -> Set[str]:
+    """First lint pass: the repo-wide mesh-axis table KT-SHARD01
+    validates PartitionSpecs against."""
+    trees = []
+    for path, _rel in iter_python_files(package_root):
+        with open(path, encoding="utf-8") as f:
+            trees.append(ast.parse(f.read(), filename=path))
+    return harvest_mesh_axes(trees)
+
+
 def lint_package(package_root: Optional[str] = None) -> List[Finding]:
     """Lint every .py under the kubeflow_tpu package (generated _pb2
     files excluded)."""
     if package_root is None:
         package_root = os.path.dirname(os.path.dirname(__file__))
+    mesh_axes = package_mesh_axes(package_root)
     findings: List[Finding] = []
     for path, rel in iter_python_files(package_root):
-        findings.extend(lint_file(path, rel))
+        findings.extend(lint_file(path, rel, mesh_axes=mesh_axes))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def lint_diff(rev: str, package_root: Optional[str] = None) -> List[Finding]:
+    """Tier A lint restricted to package files changed vs a git rev --
+    the fast pre-push path (``kftpu analyze --diff <rev>``); the full
+    tree remains the CI default. The mesh-axis table is still harvested
+    repo-wide so KT-SHARD01 stays cross-module."""
+    import subprocess
+
+    if package_root is None:
+        package_root = os.path.dirname(os.path.dirname(__file__))
+    repo_root = os.path.dirname(package_root)
+    proc = subprocess.run(
+        ["git", "diff", "--name-only", rev, "--", "*.py"],
+        cwd=repo_root, capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"git diff --name-only {rev} failed: "
+            f"{proc.stderr.strip() or proc.stdout.strip()}"
+        )
+    prefix = os.path.basename(package_root) + os.sep
+    mesh_axes = package_mesh_axes(package_root)
+    findings: List[Finding] = []
+    for rel in sorted(set(proc.stdout.split())):
+        if not rel.startswith(prefix) or _PB2_RE.search(rel):
+            continue
+        path = os.path.join(repo_root, rel)
+        if os.path.exists(path):
+            findings.extend(lint_file(path, rel, mesh_axes=mesh_axes))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

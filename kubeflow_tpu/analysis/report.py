@@ -145,6 +145,52 @@ def compare(
     return Comparison(new=new, fixed=fixed, regressed_metrics=regressed)
 
 
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(
+    findings: List[Finding],
+    cmp: Optional[Comparison] = None,
+    tool_name: str = "kftpu-analyze",
+) -> dict:
+    """SARIF 2.1.0 document for CI line annotations. Hard findings map
+    to ``error``, ratcheted (countable) ones to ``warning``; when a
+    ``Comparison`` is given, each result carries ``baselineState`` so
+    viewers can collapse grandfathered findings and surface only the
+    regressions the strict gate would fail on."""
+    new_ids = {id(f) for f in cmp.new} if cmp is not None else set()
+    rules = sorted({f.rule for f in findings})
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "error" if f.hard else "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+        }
+        if cmp is not None:
+            result["baselineState"] = ("new" if id(f) in new_ids
+                                       else "unchanged")
+        results.append(result)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": tool_name,
+                "rules": [{"id": r} for r in rules],
+            }},
+            "results": results,
+        }],
+    }
+
+
 def render_report(
     findings: List[Finding],
     metrics: Dict[str, float],

@@ -1,0 +1,569 @@
+"""Tier B.2: sharding-consistency audit + byte-accurate collective
+traffic model (the ``shard`` analysis family).
+
+The Tier B census (``jaxpr_audit.count_collectives``) counts collective
+*ops*; this module prices their *bytes* and cross-checks the compiled
+module against each entry point's declared sharding plan. Two
+mechanisms, both running the repo's REAL entry points (train steps,
+sequence-parallel attention, the TP serving engine) on the CPU backend:
+
+1. **KT-SHARD-IMPLICIT** (hard): an entry's compiled HLO (or jaxpr)
+   contains a collective KIND absent from the entry's declared plan.
+   JAX raises at ``lower()`` time when explicit ``in_shardings``
+   disagree with committed arguments, so the *silent* failure mode is
+   sharding propagation reconciling a disagreement by inserting
+   collectives -- a ``with_sharding_constraint`` that fights the input
+   layout materializes as a hidden ``all-gather`` (replication) the
+   author never wrote. Each entry declares the collective kinds its
+   plan calls for (DP train = gradient ``all-reduce``; ring adds
+   ``collective-permute``; ulysses adds ``all-to-all``; TP insert =
+   none at all); anything else is an implicit reshard and fails
+   ``kftpu analyze --strict`` unconditionally.
+
+2. **Byte model** (ratcheted): every collective is priced in wire
+   bytes -- total bytes crossing links, summed over participants,
+   assuming the standard ring algorithms -- and rolled up per entry
+   into ``comm.bytes_per_step.<entry>`` metrics that ratchet in
+   ``baseline.json`` exactly like the host-sync bound: a PR that
+   doubles DP all-reduce bytes fails strict instead of landing
+   silently.
+
+Pricing conventions (E = participant count, b = per-device operand or
+result bytes; see docs/ANALYSIS.md for derivations):
+
+=====================  =======================================
+collective             wire bytes
+=====================  =======================================
+all-reduce             2 * (E - 1) * b     (ring: RS + AG phase)
+all-gather             E * (E - 1) * b_shard  (jaxpr operand is
+                       the shard; HLO result r = E*b_shard gives
+                       (E - 1) * r)
+reduce-scatter         (E - 1) * b_full    (HLO result r = b/E
+                       gives E * (E - 1) * r)
+all-to-all             (E - 1) * b         (each device keeps 1/E)
+collective-permute     len(pairs) * b      (one buffer per pair)
+=====================  =======================================
+
+Trip multipliers: a collective under ``scan`` is multiplied by the
+static ``length`` (``fori_loop`` with static bounds lowers to scan);
+``cond`` prices the max-bytes branch (a deterministic upper bound --
+ring attention's skip-last-hop cond always prices the rotating
+branch); a collective under a data-dependent ``while`` is priced for
+ONE iteration and the model is annotated, because the trip count is
+unknowable statically.
+
+Explicit collectives (shard_map bodies) are priced from the jaxpr,
+where per-shard operand shapes and static trip counts are exact.
+GSPMD-*inserted* collectives (DP gradient sync, propagation reshards)
+never appear in the jaxpr, so a second pass parses the compiled
+optimized HLO text and prices every collective whose KIND the jaxpr
+walk did not already cover (kind-disjoint, so nothing double-counts).
+HLO-origin collectives inside ``while`` bodies are counted once per
+appearance -- post-optimization trip counts are unrecoverable -- which
+is exact for the top-level gradient all-reduce this pass exists for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from kubeflow_tpu.analysis.jaxpr_audit import _as_jaxprs
+from kubeflow_tpu.analysis.report import Finding
+
+# jaxpr collective primitive -> HLO-style kind. ``psum2`` is the
+# shard_map-region spelling of psum; pbroadcast is bookkeeping (zero
+# bytes) and deliberately absent.
+JAXPR_KIND = {
+    "psum": "all-reduce",
+    "psum2": "all-reduce",
+    "pmax": "all-reduce",
+    "pmin": "all-reduce",
+    "ppermute": "collective-permute",
+    "all_gather": "all-gather",
+    "all_to_all": "all-to-all",
+    "reduce_scatter": "reduce-scatter",
+    "psum_scatter": "reduce-scatter",
+}
+
+HLO_KINDS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s4": 1, "u4": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveCost:
+    """One priced collective site (trip multipliers already applied)."""
+
+    kind: str        # HLO-style kind (all-reduce / all-gather / ...)
+    primitive: str   # jaxpr primitive or HLO opcode that produced it
+    count: float     # executions per step (scan length folded in)
+    bytes: float     # wire bytes per step
+    origin: str      # "jaxpr" | "hlo"
+
+
+@dataclasses.dataclass
+class CommModel:
+    """Per-entry collective traffic model."""
+
+    entry: str
+    costs: List[CollectiveCost] = dataclasses.field(default_factory=list)
+    notes: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def total_bytes(self) -> float:
+        return sum(c.bytes for c in self.costs)
+
+    def kinds(self) -> Set[str]:
+        return {c.kind for c in self.costs}
+
+    def kind_bytes(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for c in self.costs:
+            out[c.kind] = out.get(c.kind, 0.0) + c.bytes
+        return out
+
+
+# -- jaxpr-level pricing ----------------------------------------------------
+
+def _operand_bytes(eqn) -> int:
+    total = 0
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is not None and hasattr(aval, "size"):
+            total += int(aval.size) * int(aval.dtype.itemsize)
+    return total
+
+
+def _price_eqn(eqn, mult: float, axis_sizes: Dict[str, int],
+               notes: List[str]) -> CollectiveCost:
+    prim = eqn.primitive.name
+    kind = JAXPR_KIND[prim]
+    b = _operand_bytes(eqn)
+    p = eqn.params
+    if prim == "ppermute":
+        wire = len(p.get("perm", ())) * b
+    else:
+        names = p.get("axes") or p.get("axis_name") or ()
+        if not isinstance(names, (tuple, list)):
+            names = (names,)
+        extent = 1
+        for name in names:
+            if name not in axis_sizes:
+                notes.append(
+                    f"axis {name!r} of {prim} not bound by an enclosing "
+                    f"shard_map; extent defaulted to 1"
+                )
+            extent *= int(axis_sizes.get(name, 1))
+        if kind == "all-reduce":
+            wire = 2 * (extent - 1) * b
+        elif kind == "all-to-all":
+            wire = (extent - 1) * b
+        elif kind == "all-gather":
+            wire = extent * (extent - 1) * b
+        else:  # reduce-scatter
+            wire = (extent - 1) * b
+    return CollectiveCost(kind=kind, primitive=prim, count=mult,
+                          bytes=mult * wire, origin="jaxpr")
+
+
+def _walk_jaxpr(jaxpr, mult: float, axis_sizes: Dict[str, int],
+                costs: List[CollectiveCost], notes: List[str]) -> None:
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in inner.eqns:
+        prim = eqn.primitive.name
+        if prim in JAXPR_KIND:
+            costs.append(_price_eqn(eqn, mult, axis_sizes, notes))
+        elif prim == "scan":
+            length = int(eqn.params.get("length", 1))
+            for sub in _as_jaxprs(eqn.params.get("jaxpr")):
+                _walk_jaxpr(sub, mult * length, axis_sizes, costs, notes)
+        elif prim == "cond":
+            best: List[CollectiveCost] = []
+            best_bytes = -1.0
+            for branch in eqn.params.get("branches", ()):
+                sub_costs: List[CollectiveCost] = []
+                _walk_jaxpr(branch, mult, axis_sizes, sub_costs, notes)
+                branch_bytes = sum(c.bytes for c in sub_costs)
+                if branch_bytes > best_bytes:
+                    best, best_bytes = sub_costs, branch_bytes
+            costs.extend(best)
+        elif prim == "while":
+            before = len(costs)
+            for key in ("cond_jaxpr", "body_jaxpr"):
+                for sub in _as_jaxprs(eqn.params.get(key)):
+                    _walk_jaxpr(sub, mult, axis_sizes, costs, notes)
+            if len(costs) > before:
+                notes.append(
+                    "collective under a data-dependent while loop priced "
+                    "for ONE iteration (trip count unknown statically)"
+                )
+        elif prim == "shard_map":
+            mesh = eqn.params.get("mesh")
+            sizes = dict(axis_sizes)
+            sizes.update({str(k): int(v)
+                          for k, v in dict(getattr(mesh, "shape", {}) or
+                                           {}).items()})
+            for val in eqn.params.values():
+                for sub in _as_jaxprs(val):
+                    _walk_jaxpr(sub, mult, sizes, costs, notes)
+        else:
+            for val in eqn.params.values():
+                for sub in _as_jaxprs(val):
+                    _walk_jaxpr(sub, mult, axis_sizes, costs, notes)
+
+
+def jaxpr_comm_model(fn, args, entry: str) -> CommModel:
+    """Price the EXPLICIT collectives (shard_map bodies) in fn's jaxpr:
+    per-shard operand shapes and static trip counts are exact there."""
+    import jax
+
+    model = CommModel(entry=entry)
+    closed = jax.make_jaxpr(fn)(*args)
+    _walk_jaxpr(closed, 1.0, {}, model.costs, model.notes)
+    return model
+
+
+# -- compiled-HLO pricing ---------------------------------------------------
+
+_HLO_OP_RE = re.compile(
+    r"=\s+(?P<shape>[^=]+?)\s"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|all-to-all|"
+    r"collective-permute)(?P<form>-start|-done)?\("
+)
+_SHAPE_TOKEN_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_IOTA_GROUPS_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[\d+\]")
+_LIST_GROUPS_RE = re.compile(r"replica_groups=\{\{([0-9, ]*)\}")
+_PAIR_RE = re.compile(r"\{(\d+),(\d+)\}")
+_PAIRS_ATTR_RE = re.compile(r"source_target_pairs=\{(.*?)\}\}")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def _shape_tokens_bytes(shape_text: str) -> List[int]:
+    out = []
+    for dtype, dims in _SHAPE_TOKEN_RE.findall(shape_text):
+        if dtype not in _DTYPE_BYTES:
+            continue  # layout annotations etc.
+        size = 1
+        for d in dims.split(","):
+            if d.strip():
+                size *= int(d)
+        out.append(size * _DTYPE_BYTES[dtype])
+    return out
+
+
+def _group_extent(line: str) -> int:
+    m = _IOTA_GROUPS_RE.search(line)
+    if m:
+        return int(m.group(2))
+    m = _LIST_GROUPS_RE.search(line)
+    if m:
+        ids = [t for t in m.group(1).split(",") if t.strip()]
+        return max(len(ids), 1)
+    return 1
+
+
+def hlo_comm_costs(
+    hlo_text: str,
+    skip_kinds: Sequence[str] = (),
+) -> Tuple[List[CollectiveCost], Dict[str, List[str]]]:
+    """Price every collective instruction in compiled HLO text whose
+    kind is not in ``skip_kinds``. Returns (costs, op_names-per-kind)
+    -- the op_name metadata names the jax source op that produced an
+    inserted collective (e.g. ``sharding_constraint``)."""
+    costs: List[CollectiveCost] = []
+    op_names: Dict[str, List[str]] = {}
+    for line in hlo_text.splitlines():
+        m = _HLO_OP_RE.search(line)
+        if m is None:
+            continue
+        kind, form = m.group("op"), m.group("form")
+        if form == "-done" or kind in skip_kinds:
+            continue
+        tokens = _shape_tokens_bytes(m.group("shape"))
+        if not tokens:
+            continue
+        # Async -start results are tuples holding source and destination
+        # buffers: the max token is the payload. Sync tuple shapes are
+        # combined collectives: the payload is the sum.
+        b = max(tokens) if form == "-start" else sum(tokens)
+        if kind == "collective-permute":
+            pairs_m = _PAIRS_ATTR_RE.search(line)
+            pairs = (len(_PAIR_RE.findall(pairs_m.group(0)))
+                     if pairs_m else 1)
+            wire = pairs * b
+        else:
+            extent = _group_extent(line)
+            if kind == "all-reduce":
+                wire = 2 * (extent - 1) * b
+            elif kind == "all-gather":
+                # b is the gathered result; the shard is b / extent.
+                wire = (extent - 1) * b
+            elif kind == "reduce-scatter":
+                # b is the scattered result; the full input is b * extent.
+                wire = extent * (extent - 1) * b
+            else:  # all-to-all
+                wire = (extent - 1) * b
+        costs.append(CollectiveCost(kind=kind, primitive=kind, count=1.0,
+                                    bytes=float(wire), origin="hlo"))
+        name_m = _OPNAME_RE.search(line)
+        if name_m:
+            names = op_names.setdefault(kind, [])
+            tail = name_m.group(1).rsplit("/", 1)[-1]
+            if tail not in names:
+                names.append(tail)
+    return costs, op_names
+
+
+# -- per-entry driver -------------------------------------------------------
+
+def audit_entry(
+    fn,
+    args: Sequence,
+    entry: str,
+    allowed_kinds: Optional[Sequence[str]] = None,
+    hlo: bool = True,
+    jitted=None,
+) -> Tuple[List[Finding], CommModel]:
+    """Full shard audit of one entry point: jaxpr pricing of explicit
+    collectives, HLO pricing of GSPMD-inserted kinds, and the
+    KT-SHARD-IMPLICIT declared-plan check. ``jitted`` (default ``fn``)
+    is what gets ``.lower(*args).compile()``; ``fn`` is traced."""
+    model = jaxpr_comm_model(fn, args, entry)
+    findings: List[Finding] = []
+    op_names: Dict[str, List[str]] = {}
+    if hlo:
+        compiled = (jitted if jitted is not None else fn).lower(
+            *args).compile()
+        hlo_costs, op_names = hlo_comm_costs(
+            compiled.as_text(), skip_kinds=sorted(model.kinds()))
+        model.costs.extend(hlo_costs)
+    if allowed_kinds is not None:
+        per_kind = model.kind_bytes()
+        for kind in sorted(model.kinds() - set(allowed_kinds)):
+            origin = ("sharding propagation inserted"
+                      if any(c.kind == kind and c.origin == "hlo"
+                             for c in model.costs)
+                      else "explicit plan contains")
+            names = op_names.get(kind)
+            via = f" via {', '.join(names[:3])}" if names else ""
+            findings.append(Finding(
+                rule="KT-SHARD-IMPLICIT", path=entry, line=0, hard=True,
+                message=(
+                    f"{origin} {kind} ({int(per_kind[kind])} wire bytes"
+                    f"/step{via}) but the entry's declared plan allows "
+                    f"only {sorted(allowed_kinds) or 'no collectives'}: "
+                    f"an implicit reshard (hidden replication) is "
+                    f"moving data the sharding spec never asked for"
+                ),
+            ))
+    return findings, model
+
+
+# -- repo entry inventory ---------------------------------------------------
+
+# Declared collective plans per entry family. DP train steps carry the
+# gradient all-reduce (plus loss/metric reductions, same kind); the
+# sequence-mesh variants add their attention collective; TP serving
+# prefill is row-parallel all-reduce only, insert writes cache shards
+# locally (NO collective is legitimate), and decode additionally
+# gathers the vocab-sharded logits for sampling (XLA lowers that
+# redistribution through all-gather + collective-permute).
+ALLOWED = {
+    "train": ("all-reduce",),
+    "train.ring": ("all-reduce", "collective-permute"),
+    "train.ulysses": ("all-reduce", "all-to-all"),
+    "ops.ring_attention": ("collective-permute",),
+    "ops.ulysses_attention": ("all-to-all",),
+    "serve.tp2.prefill": ("all-reduce",),
+    "serve.tp2.insert": (),
+    "serve.tp2.decode": ("all-reduce", "all-gather", "collective-permute"),
+}
+
+METRIC_PREFIX = "comm.bytes_per_step."
+
+
+def _metric(metrics: Dict[str, float], entry: str, model: CommModel) -> None:
+    metrics[METRIC_PREFIX + entry] = float(int(model.total_bytes))
+
+
+def shardcheck_train_steps(
+    tasks: Optional[Sequence[str]] = None,
+) -> Tuple[List[Finding], Dict[str, float]]:
+    """DP train steps on the default (data=8) mesh: all traffic is
+    GSPMD-inserted gradient/loss all-reduce; anything else is an
+    implicit reshard."""
+    import jax
+
+    from kubeflow_tpu.analysis.jaxpr_audit import TRAIN_TASKS, _mesh
+    from kubeflow_tpu.models import get_task
+
+    findings: List[Finding] = []
+    metrics: Dict[str, float] = {}
+    mesh = _mesh()
+    for name in tasks or sorted(TRAIN_TASKS):
+        entry = f"train.{name}"
+        task = get_task(name, **TRAIN_TASKS[name])
+        state = task.init_state(jax.random.PRNGKey(0), mesh)
+        step = task.train_step_fn(mesh)
+        jitted = getattr(step, "jitted", step)
+        batch = next(iter(task.data_iter(1, 0, mesh)))
+        entry_findings, model = audit_entry(
+            jitted, (state, *batch), entry, allowed_kinds=ALLOWED["train"])
+        findings.extend(entry_findings)
+        _metric(metrics, entry, model)
+    return findings, metrics
+
+
+def shardcheck_seq_variants() -> Tuple[List[Finding], Dict[str, float]]:
+    """llama train step on ring=2 and ulysses=4 sequence meshes: the
+    full forward+backward pricing of the sequence-parallel plans."""
+    import jax
+
+    from kubeflow_tpu.models import get_task
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh, \
+        mesh_context
+
+    findings: List[Finding] = []
+    metrics: Dict[str, float] = {}
+    n_dev = len(jax.devices())
+    for impl, seq in (("ring", 2), ("ulysses", 4)):
+        if n_dev < seq:
+            continue
+        entry = f"train.llama.{impl}{seq}"
+        task = get_task("llama", preset="llama-tiny", batch_size=8,
+                        seq_len=16, attention_impl=impl)
+        mesh = build_mesh(MeshConfig(data=-1, sequence=seq))
+        with mesh_context(mesh):
+            state = task.init_state(jax.random.PRNGKey(0), mesh)
+            step = task.train_step_fn(mesh)
+            jitted = getattr(step, "jitted", step)
+            batch = next(iter(task.data_iter(1, 0, mesh)))
+            entry_findings, model = audit_entry(
+                jitted, (state, *batch), entry,
+                allowed_kinds=ALLOWED[f"train.{impl}"])
+        findings.extend(entry_findings)
+        _metric(metrics, entry, model)
+    return findings, metrics
+
+
+def shardcheck_ops() -> Tuple[List[Finding], Dict[str, float]]:
+    """Standalone ring (seq=2) / ulysses (seq=4) shard_map plans -- the
+    census cases whose wire bytes are computable by hand, pricing the
+    jaxpr layer alone (inputs are uncommitted, so compiled-side input
+    layouts are propagation's free choice, not a declared plan)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.ops.ring_attention import ring_attention_sharded
+    from kubeflow_tpu.ops.ulysses import ulysses_attention_sharded
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    findings: List[Finding] = []
+    metrics: Dict[str, float] = {}
+    n_dev = len(jax.devices())
+    q = jnp.zeros((2, 16, 4, 8), jnp.float32)
+    for name, fn, seq in (
+        ("ring_attention", ring_attention_sharded, 2),
+        ("ulysses_attention", ulysses_attention_sharded, 4),
+    ):
+        if n_dev < seq:
+            continue
+        entry = f"ops.{name}"
+        mesh = build_mesh(MeshConfig(data=1, sequence=seq),
+                          devices=jax.devices()[:seq])
+        entry_findings, model = audit_entry(
+            partial(fn, mesh=mesh, causal=True), (q, q, q), entry,
+            allowed_kinds=ALLOWED[entry], hlo=False)
+        findings.extend(entry_findings)
+        _metric(metrics, entry, model)
+    return findings, metrics
+
+
+def shardcheck_serving() -> Tuple[List[Finding], Dict[str, float]]:
+    """Tensor-parallel (tp=2) engine jits: the serving plane's sharded
+    surfaces. Insert's empty allowed set is the sharpest invariant --
+    cache writes are shard-local by construction, so ANY collective
+    there is an implicit reshard of the KV cache."""
+    import dataclasses as dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.llama import PRESETS
+    from kubeflow_tpu.serving.engine import GenerationEngine
+
+    findings: List[Finding] = []
+    metrics: Dict[str, float] = {}
+    if len(jax.devices()) < 2:
+        return findings, metrics
+    cfg = dc.replace(PRESETS["llama-tiny"], max_seq=64)
+    eng = GenerationEngine(config=cfg, max_slots=2, decode_block=4,
+                           tensor_parallel=2)
+    # Warmup populates the per-key decode jit cache.
+    eng.generate([3, 5, 7], max_new_tokens=6)
+    reg = eng._jit_registry
+
+    tokens = jnp.zeros((1, 32), jnp.int32)
+    lengths = jnp.asarray([5], jnp.int32)
+    entry_findings, model = audit_entry(
+        reg["prefill"], (eng.weights, tokens, lengths),
+        "serve.tp2.prefill", allowed_kinds=ALLOWED["serve.tp2.prefill"])
+    findings.extend(entry_findings)
+    _metric(metrics, "serve.tp2.prefill", model)
+
+    _, k_seq, v_seq = eng._prefill(tokens, lengths)
+    slots = jnp.asarray([0], jnp.int32)
+    entry_findings, model = audit_entry(
+        reg["insert"], (eng.cache_k, eng.cache_v, k_seq, v_seq, slots),
+        "serve.tp2.insert", allowed_kinds=ALLOWED["serve.tp2.insert"])
+    findings.extend(entry_findings)
+    _metric(metrics, "serve.tp2.insert", model)
+
+    b = eng.max_slots
+    toks = jnp.zeros((b,), jnp.int32)
+    lens = jnp.zeros((b,), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+    temps = jnp.zeros((b,), jnp.float32)
+    tks = jnp.zeros((b,), jnp.int32)
+    tps = jnp.ones((b,), jnp.float32)
+    nonces = jnp.zeros((b,), jnp.int32)
+    for key, jfn in sorted(reg["decode_block"].items(), key=repr):
+        n, _filtered, _want_lp, masked = key
+        if masked:
+            continue
+        args = (eng.weights, eng.cache_k, eng.cache_v, toks, lens, rng,
+                temps, tks, tps, nonces)
+        entry_findings, model = audit_entry(
+            jfn, args, "serve.tp2.decode",
+            allowed_kinds=ALLOWED["serve.tp2.decode"])
+        findings.extend(entry_findings)
+        _metric(metrics, "serve.tp2.decode", model)
+        break  # one representative block variant prices the decode plan
+    return findings, metrics
+
+
+def shardcheck_all(
+    include_serving: bool = True,
+) -> Tuple[List[Finding], Dict[str, float]]:
+    findings: List[Finding] = []
+    metrics: Dict[str, float] = {}
+    for fn in ([shardcheck_train_steps, shardcheck_seq_variants,
+                shardcheck_ops]
+               + ([shardcheck_serving] if include_serving else [])):
+        f, m = fn()
+        findings.extend(f)
+        metrics.update(m)
+    return findings, metrics

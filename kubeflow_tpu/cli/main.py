@@ -210,22 +210,37 @@ def cmd_analyze(args, _client) -> int:
     from kubeflow_tpu import analysis
 
     only = set(args.only or [])
-    findings, metrics = analysis.run_analysis(
-        trace=not args.no_trace, serving=not args.no_serving,
-        families=(only - {"perf"}) if only else None,
-    )
-    # Perf-curve ratchet: committed bench floors + live-metric ceilings.
-    # Violations are hard findings, so they ride the same strict gate and
-    # are never grandfathered by --update-baseline (hard != countable).
     perf_findings: list = []
     perf_measured: dict = {}
-    if not only or "perf" in only:
-        perf_findings, perf_measured = analysis.check_perf(
-            analysis.load_perf_baseline(args.perf_baseline), metrics=metrics
+    if args.diff:
+        # Fast pre-push path: Tier A lint over files changed vs the rev
+        # only (full tree + trace families remain the CI default).
+        from kubeflow_tpu.analysis.astlint import lint_diff
+
+        findings = lint_diff(args.diff)
+        metrics = {}
+    else:
+        findings, metrics = analysis.run_analysis(
+            trace=not args.no_trace, serving=not args.no_serving,
+            families=(only - {"perf"}) if only else None,
         )
+        # Perf-curve ratchet: committed bench floors + live-metric
+        # ceilings. Violations are hard findings, so they ride the same
+        # strict gate and are never grandfathered by --update-baseline
+        # (hard != countable).
+        if not only or "perf" in only:
+            perf_findings, perf_measured = analysis.check_perf(
+                analysis.load_perf_baseline(args.perf_baseline),
+                metrics=metrics,
+            )
     findings.extend(perf_findings)
     baseline = analysis.load_baseline(args.baseline)
     cmp = analysis.compare(findings, metrics, baseline)
+    if args.sarif:
+        with open(args.sarif, "w") as f:
+            json.dump(analysis.to_sarif(findings, cmp), f, indent=2)
+            f.write("\n")
+        print(f"sarif: {len(findings)} result(s) -> {args.sarif}")
     if args.update_baseline:
         # Raw metrics only: perf_measured values are floor-checked (lower
         # is worse) and must not enter the higher-is-worse metric ratchet.
@@ -479,11 +494,18 @@ def main(argv=None) -> int:
                     help="skip the serving-engine audit (fastest trace run)")
     sp.add_argument("--only", action="append", default=None,
                     metavar="FAMILY",
-                    choices=("astlint", "audit", "perf", "race", "proto",
-                             "chaos"),
+                    choices=("astlint", "audit", "shard", "perf", "race",
+                             "proto", "chaos"),
                     help="run only the named analysis family "
-                         "(repeatable): astlint | audit | perf | race | "
-                         "proto | chaos. Default: all families.")
+                         "(repeatable): astlint | audit | shard | perf | "
+                         "race | proto | chaos. Default: all families.")
+    sp.add_argument("--diff", default=None, metavar="REV",
+                    help="Tier A lint restricted to package files "
+                         "changed vs this git rev (fast pre-push mode; "
+                         "skips trace families and the perf ratchet)")
+    sp.add_argument("--sarif", default=None, metavar="PATH",
+                    help="also write findings as a SARIF 2.1.0 document "
+                         "for CI line annotations")
     sp.add_argument("--baseline", default=None,
                     help="baseline path (default: committed baseline.json)")
     sp.add_argument("--perf-baseline", default=None,

@@ -125,7 +125,7 @@ class ProcessLauncher(BaseLauncher):
             log_path = os.path.join(self.log_dir, f"{safe}.log")
         if log_path:
             os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
-            out = open(log_path, "ab")
+            out = open(log_path, "ab")  # kt-lint: disable=KT-ASYNC01 -- O(1) fd creation handed straight to create_subprocess_exec; no read/write ever happens on the event loop
         else:
             out = None
 

@@ -76,6 +76,50 @@ CENSUS_INTENSITY = {
 # Job-spec annotations the classifier honors (metadata.annotations).
 ANN_COLLECTIVE_PROFILE = "kftpu.io/collective-profile"
 ANN_WORKLOAD_CLASS = "kftpu.io/workload-class"
+# MEASURED per-step wire bytes from the shard analysis family
+# (``kftpu analyze --only shard`` prices every collective of the job's
+# actual train step; CI stamps the ``comm.bytes_per_step.*`` number
+# here). When present it REPLACES the census priors above -- measured
+# wins over annotation guesses (ISSUE 15 / ROADMAP item 2's "online
+# intensity estimation" headroom, closed from the analysis side).
+ANN_COMM_BYTES = "kftpu.io/comm-bytes-per-step"
+
+# Measured-bytes -> 0..1 intensity ramp, linear in log2 space between
+# the census extremes: <=1 MiB/step is negligible traffic (the "none"
+# prior's regime) and >=1 GiB/step saturates an ICI link every step
+# (the ring prior's regime). Kept deliberately coarse -- the scheduler
+# consumes intensity ordinally (contention products), not absolutely.
+_COMM_FLOOR_BYTES = float(1 << 20)
+_COMM_CEIL_BYTES = float(1 << 30)
+_COMM_FLOOR_INTENSITY = 0.1
+_COMM_CEIL_INTENSITY = 0.9
+
+
+def intensity_from_comm_bytes(bytes_per_step: float) -> float:
+    """Map measured per-step wire bytes onto the 0..1 intensity scale
+    the contention model consumes (log-linear between the ramp ends)."""
+    import math
+
+    b = max(float(bytes_per_step), 1.0)
+    lo, hi = math.log2(_COMM_FLOOR_BYTES), math.log2(_COMM_CEIL_BYTES)
+    frac = (math.log2(b) - lo) / (hi - lo)
+    span = _COMM_CEIL_INTENSITY - _COMM_FLOOR_INTENSITY
+    raw = _COMM_FLOOR_INTENSITY + span * frac
+    return round(min(max(raw, _COMM_FLOOR_INTENSITY),
+                     _COMM_CEIL_INTENSITY), 4)
+
+
+def comm_bytes_for_intensity(intensity: float) -> float:
+    """Inverse of ``intensity_from_comm_bytes`` (ramp interior): what a
+    bench or test must stamp into ``kftpu.io/comm-bytes-per-step`` to
+    land on a given intensity."""
+    import math
+
+    i = min(max(intensity, _COMM_FLOOR_INTENSITY), _COMM_CEIL_INTENSITY)
+    span = _COMM_CEIL_INTENSITY - _COMM_FLOOR_INTENSITY
+    frac = (i - _COMM_FLOOR_INTENSITY) / span
+    lo, hi = math.log2(_COMM_FLOOR_BYTES), math.log2(_COMM_CEIL_BYTES)
+    return 2.0 ** (lo + frac * (hi - lo))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,6 +148,11 @@ class SchedJob:
     min_chips: int = 1
     max_chips: int = 1
     collective_intensity: float = 0.1
+    # Where collective_intensity came from: "measured" (the shard
+    # family's comm.bytes_per_step stamped on the job) or "prior"
+    # (census-profile annotation / workload-class fallback). Benches
+    # record the split so the measured path's coverage is auditable.
+    intensity_source: str = "prior"
     arrival_seq: int = 0             # FIFO tiebreak (youngest = largest)
     reshardable: bool = False        # ElasticPolicy.reshard_in_place
     current: Optional[Placement] = None
@@ -518,28 +567,43 @@ def classify_workload(job) -> str:
     return "train"
 
 
-def classify_intensity(job) -> float:
-    """Collective intensity of a TrainJob from the census priors: the
-    ``kftpu.io/collective-profile`` annotation names a census row (or a
-    literal 0..1 float); otherwise the workload class prior applies
-    (multi-worker train jobs carry at least the DP all-reduce)."""
+def resolve_intensity(job) -> Tuple[float, str]:
+    """Collective intensity of a TrainJob plus its provenance.
+
+    Precedence: (1) MEASURED ``kftpu.io/comm-bytes-per-step`` wire
+    bytes (the shard analysis family's per-step pricing, mapped through
+    the log ramp) -> ``"measured"``; (2) the ``collective-profile``
+    annotation naming a census row or a literal 0..1 float; (3) the
+    workload-class prior (multi-worker train jobs carry at least the
+    DP all-reduce) -> both ``"prior"``."""
+    measured = job.metadata.annotations.get(ANN_COMM_BYTES)
+    if measured:
+        try:
+            return intensity_from_comm_bytes(float(measured)), "measured"
+        except ValueError:
+            pass  # malformed annotation: fall through to the priors
     ann = job.metadata.annotations.get(ANN_COLLECTIVE_PROFILE)
     if ann:
         if ann in CENSUS_INTENSITY:
-            return CENSUS_INTENSITY[ann]
+            return CENSUS_INTENSITY[ann], "prior"
         try:
-            return min(max(float(ann), 0.0), 1.0)
+            return min(max(float(ann), 0.0), 1.0), "prior"
         except ValueError:
             pass
     workload = classify_workload(job)
     if workload == "serving":
-        return CENSUS_INTENSITY["serving"]
+        return CENSUS_INTENSITY["serving"], "prior"
     from kubeflow_tpu.api.types import ReplicaType
 
     spec = job.spec.replica_specs.get(ReplicaType.Worker)
     if workload == "train" and spec is not None and spec.replicas > 1:
-        return CENSUS_INTENSITY["allreduce"]
-    return CENSUS_INTENSITY["none"]
+        return CENSUS_INTENSITY["allreduce"], "prior"
+    return CENSUS_INTENSITY["none"], "prior"
+
+
+def classify_intensity(job) -> float:
+    """Back-compat shim: intensity only (see ``resolve_intensity``)."""
+    return resolve_intensity(job)[0]
 
 
 def sched_job_from_spec(job, arrival_seq: int = 0,
@@ -560,6 +624,7 @@ def sched_job_from_spec(job, arrival_seq: int = 0,
         max_chips = max(el.max_replicas, replicas) * per_worker
     else:
         min_chips = max_chips = replicas * per_worker
+    intensity, intensity_source = resolve_intensity(job)
     sj = SchedJob(
         key=job.key,
         tenant=getattr(sched, "tenant", None) or job.namespace,
@@ -567,7 +632,8 @@ def sched_job_from_spec(job, arrival_seq: int = 0,
         workload=classify_workload(job),
         min_chips=max(min_chips, 1 if max_chips else 0),
         max_chips=max_chips,
-        collective_intensity=classify_intensity(job),
+        collective_intensity=intensity,
+        intensity_source=intensity_source,
         arrival_seq=arrival_seq,
         reshardable=bool(el is not None and el.reshard_in_place),
         current=current,
@@ -785,11 +851,15 @@ def estimate_solo_rate(job: SchedJob, chips: Optional[int] = None) -> float:
 
 
 __all__ = [
-    "ANN_COLLECTIVE_PROFILE", "ANN_WORKLOAD_CLASS", "CENSUS_INTENSITY",
+    "ANN_COLLECTIVE_PROFILE", "ANN_COMM_BYTES", "ANN_WORKLOAD_CLASS",
+    "CENSUS_INTENSITY",
     "ClusterScheduler", "Decision", "Domain", "MultiTenantPolicy",
     "Placement", "Plan", "PolicyConfig", "SchedJob", "WORKLOAD_CLASSES",
-    "classify_intensity", "classify_workload", "contention_factor",
-    "estimate_solo_rate", "fair_shares", "jains_index", "job_rate",
-    "place", "preemption_rank", "scale_efficiency", "sched_job_from_spec",
+    "classify_intensity", "classify_workload", "comm_bytes_for_intensity",
+    "contention_factor",
+    "estimate_solo_rate", "fair_shares", "intensity_from_comm_bytes",
+    "jains_index", "job_rate",
+    "place", "preemption_rank", "resolve_intensity", "scale_efficiency",
+    "sched_job_from_spec",
     "select_preemptions", "waterfill", "weighted_fairness_index",
 ]

@@ -387,8 +387,14 @@ class ControlPlane:
                 {"error": f"no log for {ns}/{name}/{replica}"}, status=404
             )
         tail = int(req.query.get("tail", "0"))
-        with open(path, "r", errors="replace") as f:
-            text = f.read()
+
+        def _read() -> str:
+            with open(path, "r", errors="replace") as f:
+                return f.read()
+
+        # Worker logs grow unbounded; a sync read here would stall every
+        # other handler and watch stream for the whole file's duration.
+        text = await asyncio.to_thread(_read)
         if tail:
             text = "\n".join(text.splitlines()[-tail:])
         return web.Response(text=text)

@@ -182,6 +182,88 @@ def test_suppression_requires_justification(tmp_path):
     assert "KT-BRANCH01" in rules_of(lint_source(tmp_path, bare))
 
 
+def test_partition_axis_rule_checks_declared_mesh_axes(tmp_path):
+    # The snippet declares its own mesh, so the harvested table is
+    # ("data", "model"); the typo'd spec axis fires, the real one not.
+    src = (
+        "from jax.sharding import Mesh, PartitionSpec as P\n"
+        "mesh = Mesh(devs, ('data', 'model'))\n"
+        "good = P('data', None)\n"
+        "bad = P('modle')\n"
+    )
+    findings = lint_source(tmp_path, src)
+    assert [f.rule for f in findings] == ["KT-SHARD01"]
+    assert findings[0].line == 4 and "modle" in findings[0].message
+
+
+def test_partition_axis_rule_quiet_without_mesh_table(tmp_path):
+    # No mesh construction in scope -> no table -> stay conservative.
+    findings = lint_source(tmp_path, (
+        "from jax.sharding import PartitionSpec as P\n"
+        "spec = P('anything')\n"
+    ))
+    assert "KT-SHARD01" not in rules_of(findings)
+
+
+def test_partition_axis_rule_sees_meshconfig_and_axes_tuples(tmp_path):
+    src = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "AXES = ('data', 'sequence')\n"
+        "cfg = MeshConfig(data=-1, tensor=2)\n"
+        "ok = P('sequence', 'tensor')\n"
+        "bad = P('pipeline')\n"
+    )
+    findings = lint_source(tmp_path, src)
+    assert [f.rule for f in findings] == ["KT-SHARD01"]
+    assert "pipeline" in findings[0].message
+
+
+def test_shard_reshape_rule_fires_inside_jit(tmp_path):
+    base = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    y = jax.lax.with_sharding_constraint(x, P('data', None))\n"
+        "    return {use}\n"
+    )
+    bad = lint_source(tmp_path, base.format(use="y.reshape(-1)"))
+    assert "KT-SHARD02" in rules_of(bad)
+    via_jnp = lint_source(tmp_path, base.format(use="jnp.reshape(y, (-1,))"))
+    assert "KT-SHARD02" in rules_of(via_jnp)
+    # Replication hints carry no layout to lose; elementwise use is fine.
+    quiet = lint_source(tmp_path, base.replace("P('data', None)", "P()")
+                        .format(use="y.reshape(-1)"))
+    assert "KT-SHARD02" not in rules_of(quiet)
+    used = lint_source(tmp_path, base.format(use="y * 2.0"))
+    assert "KT-SHARD02" not in rules_of(used)
+
+
+def test_async_blocking_rule_fires_and_spares_sync_defs(tmp_path):
+    bad = lint_source(tmp_path, (
+        "import time\n"
+        "async def h(req):\n"
+        "    time.sleep(1.0)\n"
+        "    return open('f').read()\n"
+    ))
+    assert [f.rule for f in bad] == ["KT-ASYNC01", "KT-ASYNC01"]
+    assert any("asyncio.sleep" in f.message for f in bad)
+    assert any("asyncio.to_thread" in f.message for f in bad)
+    # Same calls in a sync def (or a nested sync def handed to an
+    # executor -- the recommended fix) are not the event loop's problem.
+    quiet = lint_source(tmp_path, (
+        "import time\n"
+        "def h(req):\n"
+        "    time.sleep(1.0)\n"
+        "async def g(req):\n"
+        "    def _read():\n"
+        "        return open('f').read()\n"
+        "    return _read\n"
+    ))
+    assert "KT-ASYNC01" not in rules_of(quiet)
+
+
 # ---------------------------------------------------------------------------
 # Tier B non-vacuity: deliberately-broken programs must be caught.
 # ---------------------------------------------------------------------------
@@ -437,6 +519,97 @@ def test_cli_only_routes_families(monkeypatch, capsys, tmp_path):
     rc = cli_main.main(["analyze", "--baseline", str(base)])
     assert rc == 0
     assert seen["families"] is None, "no --only: run_analysis default set"
+    capsys.readouterr()
+
+
+def test_cli_sarif_output_matches_golden(monkeypatch, capsys, tmp_path):
+    """SARIF 2.1.0 is an interchange contract: the emitted document is
+    pinned byte-for-byte (modulo JSON parse) against a committed golden
+    so a silent schema drift cannot ship. Hard findings map to error +
+    baselineState=new; grandfathered soft ones to warning + unchanged."""
+    import pathlib
+
+    hard = Finding(
+        rule="KT-SHARD-IMPLICIT", path="serve.tp2.insert", line=0,
+        hard=True,
+        message=("sharding propagation inserted all-gather (4096 wire "
+                 "bytes/step) but the entry's declared plan allows only "
+                 "no collectives"),
+    )
+    soft = Finding(rule="KT-IMPORT01", path="kubeflow_tpu/util.py",
+                   line=3, message="unused import 'os'")
+    base = tmp_path / "b.json"
+    base.write_text(json.dumps({
+        "counts": {"KT-IMPORT01:kubeflow_tpu/util.py": 1}, "metrics": {},
+    }))
+    out = tmp_path / "out.sarif.json"
+    rc, stdout = _run_cli(
+        monkeypatch, capsys, [hard, soft], {},
+        ["--only", "astlint", "--baseline", str(base),
+         "--sarif", str(out)])
+    assert rc == 0 and "2 result(s)" in stdout
+    golden = pathlib.Path(REPO_ROOT, "tests", "data",
+                          "analyze_sarif_golden.json")
+    assert json.loads(out.read_text()) == json.loads(golden.read_text())
+
+
+def _git(tmp_path, *argv):
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *argv],
+        cwd=tmp_path, check=True, capture_output=True)
+
+
+def test_lint_diff_lints_only_changed_package_files(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "clean.py").write_text("X = 1\n")
+    (pkg / "dirty.py").write_text("Y = 2\n")
+    (tmp_path / "outside.py").write_text("import os\n")  # not in pkg
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    # clean.py has a finding but is UNCHANGED: --diff must skip it.
+    (pkg / "clean.py").write_text("import sys\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "later")
+    (pkg / "dirty.py").write_text("def f(a, acc=[]):\n    return acc\n")
+    (tmp_path / "outside.py").write_text("import json\n")
+    findings = astlint.lint_diff("HEAD", package_root=str(pkg))
+    assert [(f.rule, f.path) for f in findings] == [
+        ("KT-MUTDEF01", "pkg/dirty.py")]
+
+
+def test_lint_diff_bad_rev_raises(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    _git(tmp_path, "init", "-q")
+    with pytest.raises(RuntimeError, match="git diff"):
+        astlint.lint_diff("no-such-rev", package_root=str(pkg))
+
+
+def test_cli_diff_skips_trace_families_and_keeps_strict(monkeypatch,
+                                                        capsys, tmp_path):
+    from kubeflow_tpu.cli import main as cli_main
+
+    base = tmp_path / "b.json"
+    base.write_text(json.dumps({"counts": {}, "metrics": {}}))
+
+    def _boom(**kw):
+        raise AssertionError("--diff must not run the trace families")
+
+    monkeypatch.setattr(analysis, "run_analysis", _boom)
+    monkeypatch.setattr(
+        analysis, "check_perf",
+        lambda *a, **kw: (_ for _ in ()).throw(
+            AssertionError("--diff must not run the perf ratchet")))
+    monkeypatch.setattr(astlint, "lint_diff", lambda rev: [])
+    rc = cli_main.main(["analyze", "--diff", "main", "--strict",
+                        "--baseline", str(base)])
+    assert rc == 0
+    monkeypatch.setattr(astlint, "lint_diff", lambda rev: [_soft()])
+    rc = cli_main.main(["analyze", "--diff", "main", "--strict",
+                        "--baseline", str(base)])
+    assert rc == 1
     capsys.readouterr()
 
 

@@ -339,6 +339,76 @@ class TestClusterScheduler:
 # KT-PERF-SCHED ratchet honesty: planted artifacts must trip the gate.
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# Measured-intensity resolution (ISSUE 15: shard-audit bytes beat priors).
+# ---------------------------------------------------------------------------
+
+class TestIntensityResolution:
+    def test_measured_comm_bytes_beat_census_priors(self):
+        from kubeflow_tpu.controller.scheduler import (
+            ANN_COLLECTIVE_PROFILE,
+            ANN_COMM_BYTES,
+            comm_bytes_for_intensity,
+            resolve_intensity,
+        )
+
+        job = make_job(replicas=4)
+        job.metadata.annotations[ANN_COLLECTIVE_PROFILE] = "ring"
+        assert resolve_intensity(job) == (0.9, "prior")
+        # The shard family measured the job's actual step: measured wins
+        # even when an (over-)confident profile annotation disagrees.
+        job.metadata.annotations[ANN_COMM_BYTES] = str(
+            comm_bytes_for_intensity(0.6))
+        assert resolve_intensity(job) == (0.6, "measured")
+
+    def test_malformed_measured_annotation_falls_through(self):
+        from kubeflow_tpu.controller.scheduler import (
+            ANN_COMM_BYTES,
+            resolve_intensity,
+        )
+
+        job = make_job(replicas=4)  # multi-worker train: allreduce prior
+        job.metadata.annotations[ANN_COMM_BYTES] = "not-a-number"
+        assert resolve_intensity(job) == (0.6, "prior")
+
+    def test_ramp_round_trips_and_clamps(self):
+        from kubeflow_tpu.controller.scheduler import (
+            comm_bytes_for_intensity,
+            intensity_from_comm_bytes,
+        )
+
+        for i in (0.1, 0.15, 0.2, 0.6, 0.85, 0.9):
+            assert intensity_from_comm_bytes(
+                comm_bytes_for_intensity(i)) == i
+        assert intensity_from_comm_bytes(1.0) == 0.1       # sub-floor
+        assert intensity_from_comm_bytes(float(1 << 40)) == 0.9
+        assert intensity_from_comm_bytes(float(1 << 25)) == 0.5
+
+    def test_sched_job_carries_intensity_source(self):
+        from kubeflow_tpu.controller.scheduler import (
+            ANN_COMM_BYTES,
+            sched_job_from_spec,
+        )
+
+        prior = sched_job_from_spec(make_job(replicas=4))
+        assert prior.intensity_source == "prior"
+        assert prior.collective_intensity == 0.6
+        measured = make_job(name="m", replicas=4)
+        measured.metadata.annotations[ANN_COMM_BYTES] = str(1 << 25)
+        sj2 = sched_job_from_spec(measured)
+        assert sj2.intensity_source == "measured"
+        assert sj2.collective_intensity == 0.5
+
+    def test_classify_intensity_shim_matches_resolution(self):
+        from kubeflow_tpu.controller.scheduler import (
+            classify_intensity,
+            resolve_intensity,
+        )
+
+        job = make_job(replicas=4)
+        assert classify_intensity(job) == resolve_intensity(job)[0]
+
+
 SCHED_BASE = {
     "goodput_vs_fifo_floor": 1.3,
     "contention_gain_floor": 1.05,

@@ -1,0 +1,310 @@
+"""Tier B.2 shard family: byte-model hand validation + non-vacuity.
+
+Three layers, mirroring tests/test_analysis.py:
+
+1. Hand validation: the wire-byte model must reproduce the two census
+   cases whose traffic is computable on paper -- ring attention on a
+   sequence=2 mesh and ulysses on sequence=4 -- exactly, not
+   approximately. A byte model nobody can check by hand is a ratchet
+   on noise.
+2. Non-vacuity: a deliberately mis-sharded toy (committed sharded
+   input fighting a replicated constraint inside jit) must produce a
+   hard KT-SHARD-IMPLICIT, and an inflated bytes baseline must trip
+   the metric ratchet with exit 1. A gate that cannot fail is no gate.
+3. Model conventions: scan multiplies by static length, cond prices
+   the max-bytes branch, while prices one iteration and says so, and
+   the HLO text parser reads both replica_groups encodings plus the
+   async -start/-done pairing without double counting.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu import analysis
+from kubeflow_tpu.analysis import shardcheck
+from kubeflow_tpu.compat import shard_map
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+
+def _mesh4():
+    return build_mesh(MeshConfig(data=4), devices=jax.devices()[:4])
+
+
+# ---------------------------------------------------------------------------
+# Hand validation: the acceptance cases, priced exactly.
+# ---------------------------------------------------------------------------
+
+def test_ring_and_ulysses_bytes_match_hand_computation():
+    # ring seq=2: q=(2,16,4,8) f32 -> per-shard kv block is
+    # 2*8*4*8*4 B = 2048 B per ppermute operand; the skip-last-hop cond
+    # rotates k and v (2 ppermutes) with 2 source-target pairs each,
+    # inside a scan of length seq=2:
+    #   2 iters * 2 ppermutes * 2 pairs * 2048 B = 16384 B.
+    # ulysses seq=4: 4 all_to_all eqns (q, k, v in; out back) each on a
+    # (2,4,1,8) f32 shard = 1024 B; (E-1)*b = 3*1024 = 3072 B each:
+    #   4 * 3072 = 12288 B.
+    findings, metrics = shardcheck.shardcheck_ops()
+    assert findings == [], [f.message for f in findings]
+    assert metrics["comm.bytes_per_step.ops.ring_attention"] == 16384.0
+    assert metrics["comm.bytes_per_step.ops.ulysses_attention"] == 12288.0
+
+
+def test_shipped_baseline_carries_the_hand_checked_bytes():
+    base = analysis.load_baseline()["metrics"]
+    assert base["comm.bytes_per_step.ops.ring_attention"] == 16384.0
+    assert base["comm.bytes_per_step.ops.ulysses_attention"] == 12288.0
+    assert base["comm.bytes_per_step.serve.tp2.insert"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Non-vacuity: the mis-sharded toy and the ratchet trip.
+# ---------------------------------------------------------------------------
+
+def test_planted_implicit_reshard_is_caught():
+    """A committed sharded input fighting a replicated constraint makes
+    GSPMD insert an all-gather the author never wrote -- the silent
+    failure mode KT-SHARD-IMPLICIT exists for (explicit in_shardings
+    disagreements raise at lower() and never get this far)."""
+    mesh = _mesh4()
+    x = jax.device_put(jnp.zeros((8, 4), jnp.float32),
+                       NamedSharding(mesh, P("data")))
+
+    @jax.jit
+    def step(v):
+        forced = jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh, P()))
+        return forced * 2.0
+
+    findings, model = shardcheck.audit_entry(
+        step, (x,), "toy.missharded", allowed_kinds=())
+    assert any(f.rule == "KT-SHARD-IMPLICIT" and f.hard for f in findings)
+    msg = " ".join(f.message for f in findings)
+    assert "all-gather" in msg and "implicit reshard" in msg
+    assert model.total_bytes > 0
+
+
+def test_consistent_toy_passes_clean():
+    mesh = _mesh4()
+    x = jax.device_put(jnp.zeros((8, 4), jnp.float32),
+                       NamedSharding(mesh, P("data")))
+
+    @jax.jit
+    def step(v):
+        kept = jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh, P("data")))
+        return kept * 2.0
+
+    findings, model = shardcheck.audit_entry(
+        step, (x,), "toy.consistent", allowed_kinds=())
+    assert findings == [], [f.message for f in findings]
+    assert model.total_bytes == 0
+
+
+def test_inflated_bytes_baseline_trips_ratchet_exit_one(
+        monkeypatch, capsys, tmp_path):
+    """The comm metrics ride the same higher-is-worse ratchet as the
+    upcast counts: a PR that doubles a step's wire bytes fails strict."""
+    from kubeflow_tpu.cli import main as cli_main
+
+    base = tmp_path / "b.json"
+    base.write_text(json.dumps({
+        "counts": {},
+        "metrics": {"comm.bytes_per_step.train.mnist": 16384.0},
+    }))
+    monkeypatch.setattr(
+        analysis, "run_analysis",
+        lambda **kw: ([], {"comm.bytes_per_step.train.mnist": 32768.0}))
+    rc = cli_main.main(["analyze", "--strict", "--json",
+                        "--only", "shard", "--baseline", str(base)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["clean"] is False
+    assert "comm.bytes_per_step.train.mnist" in doc["regressed_metrics"]
+
+
+# ---------------------------------------------------------------------------
+# Model conventions: extents, multipliers, and control flow.
+# ---------------------------------------------------------------------------
+
+def _sharded_call(body, mesh, x, out_specs=P("data")):
+    return shard_map(body, mesh=mesh, in_specs=P("data"),
+                     out_specs=out_specs, check_vma=False)(x)
+
+
+def test_psum_priced_as_ring_allreduce():
+    # shard of (8,4) f32 over 4 devices = (2,4) = 32 B;
+    # ring all-reduce wire = 2 * (4-1) * 32 = 192 B.
+    mesh = _mesh4()
+    x = jnp.zeros((8, 4), jnp.float32)
+
+    def f(v):
+        return _sharded_call(lambda s: jax.lax.psum(s, "data"), mesh, v,
+                             out_specs=P())
+
+    model = shardcheck.jaxpr_comm_model(f, (x,), "toy.psum")
+    assert model.kinds() == {"all-reduce"}
+    assert model.total_bytes == 192.0
+
+
+def test_scan_multiplies_by_static_length():
+    mesh = _mesh4()
+    x = jnp.zeros((8, 4), jnp.float32)
+    perm = [(i, (i + 1) % 4) for i in range(4)]
+
+    def body(s):
+        def it(c, _):
+            return jax.lax.ppermute(c, "data", perm), None
+        out, _ = jax.lax.scan(it, s, None, length=3)
+        return out
+
+    model = shardcheck.jaxpr_comm_model(
+        lambda v: _sharded_call(body, mesh, v), (x,), "toy.scan")
+    # one ppermute of the 32 B shard across 4 pairs, 3 scan trips:
+    # 3 * 4 * 32 = 384 B.
+    assert [c.kind for c in model.costs] == ["collective-permute"]
+    assert model.costs[0].count == 3.0
+    assert model.total_bytes == 384.0
+
+
+def test_cond_prices_the_max_bytes_branch():
+    mesh = _mesh4()
+    x = jnp.zeros((8, 4), jnp.float32)
+    perm = [(i, (i + 1) % 4) for i in range(4)]
+
+    def body(s):
+        return jax.lax.cond(
+            s.sum() > 0.0,
+            lambda c: jax.lax.ppermute(c, "data", perm),  # 4*32 = 128 B
+            lambda c: c * 1.0,                            # free
+            s,
+        )
+
+    model = shardcheck.jaxpr_comm_model(
+        lambda v: _sharded_call(body, mesh, v), (x,), "toy.cond")
+    assert model.total_bytes == 128.0
+
+
+def test_while_priced_once_with_a_note():
+    mesh = _mesh4()
+    x = jnp.zeros((8, 4), jnp.float32)
+
+    def body(s):
+        def cond(carry):
+            i, _ = carry
+            return i < 3
+
+        def step(carry):
+            i, c = carry
+            return i + 1, jax.lax.psum(c, "data")
+
+        _, out = jax.lax.while_loop(cond, step, (0, s))
+        return out
+
+    model = shardcheck.jaxpr_comm_model(
+        lambda v: _sharded_call(body, mesh, v, out_specs=P()),
+        (x,), "toy.while")
+    # one iteration of the 192 B all-reduce, with the limitation named.
+    assert model.total_bytes == 192.0
+    assert any("ONE iteration" in n for n in model.notes)
+
+
+def test_unbound_axis_defaults_to_extent_one_with_note():
+    import numpy as np
+
+    class _Eqn:
+        class primitive:
+            name = "psum"
+
+        params = {"axes": ("ghost",)}
+        invars = ()
+
+    notes = []
+    cost = shardcheck._price_eqn(_Eqn, 1.0, {}, notes)
+    assert cost.bytes == 0.0  # extent 1 -> 2*(1-1)*b
+    assert any("ghost" in n for n in notes)
+    del np
+
+
+# ---------------------------------------------------------------------------
+# HLO text parser: canned lines, both group encodings, async forms.
+# ---------------------------------------------------------------------------
+
+_AR = ('  %ar = f32[64,8]{1,0} all-reduce(f32[64,8]{1,0} %p), '
+       'replica_groups=[1,8]<=[8], to_apply=%add, '
+       'metadata={op_name="jit(step)/transpose(jvp(fn))/psum"}')
+_AG_START = ('  %ags = (f32[4,8]{1,0}, f32[16,8]{1,0}) '
+             'all-gather-start(f32[4,8]{1,0} %p), replica_groups={{0,1,2,3}}, '
+             'dimensions={0}')
+_AG_DONE = ('  %agd = f32[16,8]{1,0} all-gather-done((f32[4,8]{1,0}, '
+            'f32[16,8]{1,0}) %ags)')
+_CP = ('  %cp = f32[8,4]{1,0} collective-permute(f32[8,4]{1,0} %p), '
+       'source_target_pairs={{0,1},{1,0}}')
+_RS = ('  %rs = f32[8]{0} reduce-scatter(f32[32]{0} %p), '
+       'replica_groups=[1,4]<=[4], dimensions={0}, to_apply=%add')
+
+
+def test_hlo_allreduce_iota_groups_and_opname():
+    costs, names = shardcheck.hlo_comm_costs(_AR)
+    assert len(costs) == 1 and costs[0].kind == "all-reduce"
+    # 64*8*4 B = 2048 B operand; 2*(8-1)*2048 = 28672.
+    assert costs[0].bytes == 28672.0
+    assert names["all-reduce"] == ["psum"]
+
+
+def test_hlo_async_start_done_counted_once():
+    costs, _ = shardcheck.hlo_comm_costs(_AG_START + "\n" + _AG_DONE)
+    assert len(costs) == 1 and costs[0].kind == "all-gather"
+    # -start tuple: max token (the gathered f32[16,8] = 512 B result);
+    # (E-1) * r = 3 * 512 = 1536.
+    assert costs[0].bytes == 1536.0
+
+
+def test_hlo_collective_permute_pairs():
+    costs, _ = shardcheck.hlo_comm_costs(_CP)
+    # 2 pairs * 128 B buffer.
+    assert costs[0].kind == "collective-permute"
+    assert costs[0].bytes == 256.0
+
+
+def test_hlo_reduce_scatter_result_form():
+    costs, _ = shardcheck.hlo_comm_costs(_RS)
+    # result r = 32 B; E*(E-1)*r = 4*3*32 = 384 = (E-1) * full input.
+    assert costs[0].bytes == 384.0
+
+
+def test_hlo_skip_kinds_is_kind_disjoint():
+    text = "\n".join([_AR, _CP])
+    costs, _ = shardcheck.hlo_comm_costs(text, skip_kinds=("all-reduce",))
+    assert [c.kind for c in costs] == ["collective-permute"]
+
+
+# ---------------------------------------------------------------------------
+# Family wiring.
+# ---------------------------------------------------------------------------
+
+def test_shard_family_is_registered_and_selected_by_default():
+    assert "shard" in analysis.FAMILIES
+    fams = analysis.load_baseline()["families"]
+    assert fams["shard"]["hard_rules"] == ["KT-SHARD-IMPLICIT"]
+
+
+def test_only_shard_runs_only_shardcheck(monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        shardcheck, "shardcheck_all",
+        lambda include_serving=True: (calls.append(include_serving),
+                                      ([], {"comm.bytes_per_step.t": 1.0})
+                                      )[1])
+    findings, metrics = analysis.run_analysis(
+        families={"shard"}, serving=False)
+    assert findings == [] and metrics == {"comm.bytes_per_step.t": 1.0}
+    assert calls == [False]  # serving veto reaches the shard family
+
+
+@pytest.mark.parametrize("entry", sorted(shardcheck.ALLOWED))
+def test_allowed_plans_use_known_kinds(entry):
+    assert set(shardcheck.ALLOWED[entry]) <= set(shardcheck.HLO_KINDS)
