@@ -51,6 +51,7 @@ from kubeflow_tpu.controller.scheduler import (
     intensity_from_comm_bytes,
     jains_index,
     scale_efficiency,
+    static_hbm_peak,
 )
 
 DT = 0.5                 # sim tick (s)
@@ -149,6 +150,25 @@ def intensity_sources(jobs) -> dict:
     return tally
 
 
+def resolve_sim_hbm_peak(j: SimJob) -> tuple:
+    """(peak_bytes, fit_source) exactly as the live scheduler resolves
+    it: no job in the mix carries a measured kftpu.io/hbm-peak-bytes
+    sample, so each falls back to the audited mem.peak_bytes baseline
+    for its workload class (the mem analysis family's ratchet)."""
+    est = static_hbm_peak(j.workload)
+    if est is not None:
+        return est, "static"
+    return None, "none"
+
+
+def fit_sources(jobs) -> dict:
+    tally: dict = {}
+    for j in jobs:
+        src = resolve_sim_hbm_peak(j)[1]
+        tally[src] = tally.get(src, 0) + 1
+    return tally
+
+
 def domains() -> List[Domain]:
     # Two interconnect domains of 16 chips: large enough that two train
     # gangs CAN share one (which is exactly the contention-blind
@@ -184,6 +204,9 @@ class ArmResult:
     migrations: int
     migration_seconds: float
     per_job: List[dict] = field(default_factory=list)
+    # Placements the memory-feasibility mask refused (job's audited
+    # HBM peak fits no domain) across all scheduling rounds.
+    mem_rejections: int = 0
 
 
 def finalize(jobs: List[SimJob], t: float, preemptions: int,
@@ -272,7 +295,7 @@ def run_policy(alpha: float, contention_weight: float,
     policy = MultiTenantPolicy(doms, cfg)
     t = 0.0
     next_round = 0.0
-    preemptions = migrations = 0
+    preemptions = migrations = mem_rejections = 0
     migration_seconds = 0.0
     seq = {j.key: i for i, j in enumerate(jobs)}
     while any(j.finish is None for j in jobs) and t < HORIZON:
@@ -287,8 +310,11 @@ def run_policy(alpha: float, contention_weight: float,
                 intensity_source=resolve_sim_intensity(j)[1],
                 arrival_seq=seq[j.key], reshardable=j.reshardable,
                 current=j.placement, tok_s_per_chip=j.per_chip,
+                hbm_peak_bytes=resolve_sim_hbm_peak(j)[0],
+                fit_source=resolve_sim_hbm_peak(j)[1],
             ) for j in sorted(live, key=lambda j: seq[j.key])]
             plan = policy.plan(view)
+            mem_rejections += plan.mem_rejections
             by_key = {j.key: j for j in live}
             for dec in plan.decisions:
                 j = by_key[dec.job]
@@ -330,7 +356,9 @@ def run_policy(alpha: float, contention_weight: float,
         if any(j.arrival > t and j.arrival <= t + DT for j in jobs):
             next_round = t + DT  # replan on arrival
         t += DT
-    return finalize(jobs, t, preemptions, migrations, migration_seconds)
+    res = finalize(jobs, t, preemptions, migrations, migration_seconds)
+    res.mem_rejections = mem_rejections
+    return res
 
 
 # --------------------------------------------------------------------------
@@ -366,6 +394,7 @@ def main() -> int:
             "preemptions": a.preemptions,
             "migrations": a.migrations,
             "migration_seconds": a.migration_seconds,
+            "mem_rejections": a.mem_rejections,
             "per_job": a.per_job,
         }
 
@@ -401,6 +430,17 @@ def main() -> int:
                 # census prior. The ramp inverse is exact, so measured
                 # jobs land on identical intensities -- provenance only.
                 "intensity": {"sources": intensity_sources(job_mix())},
+                # Memory-feasibility mask report: which jobs resolved a
+                # per-device HBM peak (all "static" here -- the audited
+                # mem.peak_bytes baseline; no measured samples in the
+                # mix) and how many placements the mask refused. The
+                # audited peaks are MBs against 16 GiB/chip v5e
+                # domains, so rejections stay 0 -- the counter proves
+                # the gate is wired without perturbing the arms.
+                "memory": {
+                    "rejections": sched.mem_rejections,
+                    "fit_sources": fit_sources(job_mix()),
+                },
                 "sim": {
                     "dt_s": DT,
                     "replan_every_s": REPLAN_EVERY,

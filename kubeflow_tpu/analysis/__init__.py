@@ -20,6 +20,14 @@ rule catalog and workflow):
   sharding plan does not contain (the hidden all-gather an implicit
   reshard produces), and every collective is priced in wire bytes,
   ratcheted per entry as ``comm.bytes_per_step.*`` metrics.
+- Tier B.3 (`memcheck`): static HBM peak-residency audit -- a
+  live-range walk over the same entries' jaxprs prices per-device peak
+  bytes (tile-padded, sharding divided out, donation credited only when
+  the lowering proves the aliasing), ratcheted per entry as
+  ``mem.peak_bytes.*`` metrics; KT-MEM-RESHARD (hard) fires when a
+  planned resplit's staged peak exceeds the declared HBM budget. The
+  audited peaks feed the scheduler's placement feasibility mask
+  (``controller/scheduler.py:resolve_hbm_peak``).
 - Tier C (`racecheck` + `protocheck` + `chaoscheck`): lock-discipline
   race detection over the real threaded modules under a contended
   stress driver (KT-RACE-ORDER / KT-GUARD01), exhaustive small-scope
@@ -32,7 +40,8 @@ rule catalog and workflow):
   catch corruption (KT-CHAOS-*).
 
 Families (``kftpu analyze --only <family>``): astlint | audit | shard |
-perf | race | proto | chaos. `kftpu analyze --strict` is the CI gate:
+mem | perf | race | proto | chaos. `kftpu analyze --strict` is the CI
+gate:
 exit 0 iff nothing regressed vs the committed `baseline.json`.
 """
 
@@ -43,7 +52,8 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 # Registered analysis families (mirrored in baseline.json so the CI
 # contract is visible next to the grandfather counts).
-FAMILIES = ("astlint", "audit", "shard", "perf", "race", "proto", "chaos")
+FAMILIES = ("astlint", "audit", "shard", "mem", "perf", "race", "proto",
+            "chaos")
 
 from kubeflow_tpu.analysis.perf import (  # noqa: F401
     PERF_BASELINE_PATH,
@@ -99,8 +109,8 @@ def run_analysis(
     and ``serving=False`` still skips the serving-engine audit and the
     engine stress driver, preserving the historical flag semantics."""
     selected = (set(families) if families is not None
-                else {"astlint", "audit", "shard", "race", "proto",
-                      "chaos"})
+                else {"astlint", "audit", "shard", "mem", "race",
+                      "proto", "chaos"})
     unknown = selected - set(FAMILIES)
     if unknown:
         raise ValueError(
@@ -129,6 +139,13 @@ def run_analysis(
             include_serving=serving)
         findings.extend(shard_findings)
         metrics.update(shard_metrics)
+    if "mem" in selected and trace:
+        ensure_cpu_backend()
+        from kubeflow_tpu.analysis.memcheck import memcheck_all
+
+        mem_findings, mem_metrics = memcheck_all(include_serving=serving)
+        findings.extend(mem_findings)
+        metrics.update(mem_metrics)
     if "race" in selected:
         from kubeflow_tpu.analysis.racecheck import check_races
 

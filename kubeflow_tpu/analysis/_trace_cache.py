@@ -1,0 +1,94 @@
+"""Process-wide cache of the traced fixtures shared by the Tier B
+families (audit / shard / mem).
+
+The three families price the SAME repo entry points: the four train
+tasks on the default data mesh, the llama ring/ulysses sequence
+variants, and the tp=2 serving engine. Building those fixtures is what
+dominates analyze wall-clock -- ``init_state`` compiles the init jit,
+the engine warmup compiles prefill/insert/decode -- while the families
+themselves only trace and lower, never execute the step. The built
+(task, state, step, batch, mesh) tuples are therefore safe to share:
+one build serves every family in the process, both under ``kftpu
+analyze`` and across the analysis test files.
+
+Deliberately NOT cached: the audit family's tp=1 serving engine. Its
+DonationWatch/CompileWatch wrappers must observe a fresh build -- the
+warmup's donation and compile events ARE the thing under audit.
+
+``train_setup`` keys on the task kwargs as well as the name, so tests
+that monkeypatch ``jaxpr_audit.TRAIN_TASKS`` with different settings
+never see a stale fixture for the same task name.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Any, Optional, Tuple
+
+TrainSetup = Tuple[Any, Any, Any, Any, Any, Any]
+
+
+@lru_cache(maxsize=None)
+def _train_setup(name: str, kwargs_key: tuple) -> TrainSetup:
+    import jax
+
+    from kubeflow_tpu.analysis.jaxpr_audit import _mesh
+    from kubeflow_tpu.models import get_task
+
+    task = get_task(name, **dict(kwargs_key))
+    mesh = _mesh()
+    state = task.init_state(jax.random.PRNGKey(0), mesh)
+    step = task.train_step_fn(mesh)
+    jitted = getattr(step, "jitted", step)
+    batch = next(iter(task.data_iter(1, 0, mesh)))
+    return task, state, step, jitted, batch, mesh
+
+
+def train_setup(name: str) -> TrainSetup:
+    """(task, state, step, jitted, batch, mesh) for a TRAIN_TASKS entry
+    on the default data mesh. Trace-only consumers share one build."""
+    from kubeflow_tpu.analysis.jaxpr_audit import TRAIN_TASKS
+
+    return _train_setup(name, tuple(sorted(TRAIN_TASKS[name].items())))
+
+
+@lru_cache(maxsize=None)
+def seq_setup(impl: str, seq: int) -> TrainSetup:
+    """llama-tiny train setup on a sequence mesh (ring=2 / ulysses=4).
+    Re-enter ``mesh_context(mesh)`` before tracing against it."""
+    import jax
+
+    from kubeflow_tpu.models import get_task
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh, \
+        mesh_context
+
+    task = get_task("llama", preset="llama-tiny", batch_size=8,
+                    seq_len=16, attention_impl=impl)
+    mesh = build_mesh(MeshConfig(data=-1, sequence=seq))
+    with mesh_context(mesh):
+        state = task.init_state(jax.random.PRNGKey(0), mesh)
+        step = task.train_step_fn(mesh)
+        jitted = getattr(step, "jitted", step)
+        batch = next(iter(task.data_iter(1, 0, mesh)))
+    return task, state, step, jitted, batch, mesh
+
+
+@lru_cache(maxsize=None)
+def tp2_engine() -> Optional[Any]:
+    """Warmed tensor-parallel (tp=2) serving engine, or None when the
+    process has fewer than 2 devices. The warmup generate() populates
+    the per-key decode jit cache both shard and mem families price."""
+    import dataclasses as dc
+
+    import jax
+
+    from kubeflow_tpu.models.llama import PRESETS
+    from kubeflow_tpu.serving.engine import GenerationEngine
+
+    if len(jax.devices()) < 2:
+        return None
+    cfg = dc.replace(PRESETS["llama-tiny"], max_seq=64)
+    eng = GenerationEngine(config=cfg, max_slots=2, decode_block=4,
+                           tensor_parallel=2)
+    eng.generate([3, 5, 7], max_new_tokens=6)
+    return eng

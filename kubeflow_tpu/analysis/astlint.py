@@ -42,6 +42,18 @@ milliseconds and cannot be broken by import-time side effects. Rules
               watch stream, and HTTP handler sharing it -- for the
               call's full duration (use asyncio.sleep / to_thread /
               create_subprocess_exec).
+- KT-MEM01    device-array allocation (`jnp.zeros/ones/full/empty` and
+              `_like` variants) inside a Python `for`/`while` in a
+              decode/step/prefill-shaped hot path: a fresh HBM buffer
+              every iteration defeats donation/reuse and churns the
+              allocator -- hoist the allocation out of the loop or
+              carry one buffer updated with `.at[]`.
+- KT-MEM02    appending device values (`jnp.`/`jax.`-rooted
+              expressions) to a module- or class-level container that
+              never shrinks anywhere in the module: each retained
+              Python reference pins an HBM buffer forever, the
+              host-side HBM leak -- bound the container or drop the
+              references after use.
 
 Suppression: a trailing same-line comment
     # kt-lint: disable=KT-SYNC01 -- <justification>
@@ -730,6 +742,149 @@ def _check_async_blocking(mod: _Module, out: List[Finding]) -> None:
                           f"full duration (use {fix})")
 
 
+# KT-MEM01: hot-path shapes whose loops run every step/block -- an
+# allocation inside them churns HBM at dispatch rate.
+_HOT_PATH_RE = re.compile(
+    r"step|decode|prefill|sample|generate|dispatch|block|loop", re.I
+)
+_ALLOC_FNS = frozenset((
+    "zeros", "ones", "full", "empty",
+    "zeros_like", "ones_like", "full_like", "empty_like",
+))
+
+
+def _device_alloc_label(call: ast.Call) -> Optional[str]:
+    """'jnp.zeros'-style label when ``call`` allocates a device array
+    via jnp/jax.numpy, else None."""
+    func = call.func
+    if not isinstance(func, ast.Attribute) or func.attr not in _ALLOC_FNS:
+        return None
+    v = func.value
+    if isinstance(v, ast.Name) and v.id == "jnp":
+        return f"jnp.{func.attr}"
+    if (isinstance(v, ast.Attribute) and v.attr == "numpy"
+            and isinstance(v.value, ast.Name) and v.value.id == "jax"):
+        return f"jax.numpy.{func.attr}"
+    return None
+
+
+def _check_loop_alloc(mod: _Module, out: List[Finding]) -> None:
+    for nodes in mod.defs.values():
+        for fn in nodes:
+            if not _HOT_PATH_RE.search(fn.name):
+                continue
+            seen: Set[int] = set()
+            for node in _walk_own_statements(fn):
+                if not isinstance(node, (ast.For, ast.While)):
+                    continue
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call) or id(sub) in seen:
+                        continue
+                    seen.add(id(sub))
+                    label = _device_alloc_label(sub)
+                    if label:
+                        _emit(out, mod, "KT-MEM01", sub.lineno,
+                              f"{label}() inside a Python loop in hot "
+                              f"path {fn.name!r} allocates a fresh HBM "
+                              f"buffer every iteration -- hoist it out "
+                              f"of the loop or carry one buffer updated "
+                              f"with .at[]")
+
+
+# KT-MEM02: growth/shrink vocabularies for container-leak detection.
+_GROW_METHODS = frozenset(("append", "add", "extend", "insert"))
+_SHRINK_METHODS = frozenset((
+    "clear", "pop", "popleft", "popitem", "remove", "discard",
+))
+
+
+def _jax_rooted(expr: ast.AST) -> bool:
+    """True when the expression mentions a jnp/jax-rooted value -- the
+    static signal that what is being retained is a device buffer."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in ("jnp", "jax"):
+            return True
+    return False
+
+
+def _check_container_leak(mod: _Module, out: List[Finding]) -> None:
+    # Module-level and class-body container bindings: X = [] / {} /
+    # set() / dict() / list() / deque().
+    containers: Set[str] = set()
+    scopes = [mod.tree] + [
+        n for n in ast.walk(mod.tree) if isinstance(n, ast.ClassDef)
+    ]
+    for scope in scopes:
+        for stmt in scope.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+                value = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets = [stmt.target]
+                value = stmt.value
+            else:
+                continue
+            is_container = isinstance(value, (ast.List, ast.Dict, ast.Set))
+            if (isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Name)
+                    and value.func.id in ("list", "dict", "set", "deque")):
+                is_container = True
+            if not is_container:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    containers.add(t.id)
+    if not containers:
+        return
+
+    def _base_name(value: ast.AST) -> Optional[str]:
+        # X.append / self.X.append / Cls.X.append all resolve to X.
+        if isinstance(value, ast.Name):
+            return value.id
+        if isinstance(value, ast.Attribute):
+            return value.attr
+        return None
+
+    shrunk: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SHRINK_METHODS):
+            name = _base_name(node.func.value)
+            if name in containers:
+                shrunk.add(name)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                name = _base_name(
+                    t.value if isinstance(t, ast.Subscript) else t)
+                if name in containers:
+                    shrunk.add(name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                # X[:] = ... or X[k] = ... rewrites entries; a plain
+                # function-local rebinding X = ... also resets it.
+                if isinstance(t, ast.Subscript):
+                    name = _base_name(t.value)
+                    if name in containers:
+                        shrunk.add(name)
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _GROW_METHODS):
+            continue
+        name = _base_name(node.func.value)
+        if name not in containers or name in shrunk:
+            continue
+        if not any(_jax_rooted(a) for a in node.args):
+            continue
+        _emit(out, mod, "KT-MEM02", node.lineno,
+              f"device value appended to module/class-level container "
+              f"{name!r} that never shrinks in this module: each "
+              f"retained reference pins an HBM buffer forever -- bound "
+              f"the container or drop references after use")
+
+
 # -- driver -----------------------------------------------------------------
 
 RULES = (
@@ -742,6 +897,8 @@ RULES = (
     _check_partition_axes,
     _check_shard_reshape,
     _check_async_blocking,
+    _check_loop_alloc,
+    _check_container_leak,
 )
 
 

@@ -390,17 +390,13 @@ def audit_train_steps(
 ) -> Tuple[List[Finding], Dict[str, float]]:
     import jax
 
-    from kubeflow_tpu.models import get_task
+    from kubeflow_tpu.analysis._trace_cache import train_setup
 
     findings: List[Finding] = []
     metrics: Dict[str, float] = {}
-    mesh = _mesh()
     for name in tasks or sorted(TRAIN_TASKS):
         entry = f"train.{name}"
-        task = get_task(name, **TRAIN_TASKS[name])
-        state = task.init_state(jax.random.PRNGKey(0), mesh)
-        step = task.train_step_fn(mesh)
-        jitted = getattr(step, "jitted", step)
+        _task, state, _step, jitted, batch, _mesh_ = train_setup(name)
         if not hasattr(jitted, "lower"):
             findings.append(Finding(
                 rule="KT-AUDIT-DONATE", path=entry, line=0, hard=True,
@@ -408,7 +404,6 @@ def audit_train_steps(
                         "verify donation",
             ))
             continue
-        batch = next(iter(task.data_iter(1, 0, mesh)))
         # Every array leaf of the donated state must come back aliased:
         # a train step that double-buffers its TrainState doubles the
         # optimizer+param HBM footprint (PR 1's bug class).

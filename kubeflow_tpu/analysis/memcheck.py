@@ -1,0 +1,540 @@
+"""Tier B.3: static HBM peak-residency audit (the ``mem`` analysis
+family).
+
+The shard family (Tier B.2) prices what an entry point moves over the
+interconnect; this module prices what it must HOLD: per-device peak HBM
+residency, computed by a live-range walk over the entry's jaxpr. The
+same real entry points shardcheck traces (DP train steps, the ring /
+ulysses sequence variants, the tp=2 serving engine jits) are walked on
+the CPU backend and each peak ratchets in ``baseline.json`` as
+``mem.peak_bytes.<entry>`` -- a PR that drops a donation or doubles a
+workspace fails ``kftpu analyze --strict`` instead of OOMing a slice.
+
+The residency model (deliberately simple, every convention explicit):
+
+- **Buffer birth/death over eqn order.** A value is born at its
+  defining equation and dies after its last use; the peak is the
+  largest sum of live bytes at any equation. Inputs and outputs of one
+  equation coexist (no buffer-reuse guess) -- the conservative side for
+  an OOM gate.
+- **Donation credit.** Entry ARGUMENTS are caller-owned and resident
+  for the whole step -- unless donated AND the lowering proves the
+  aliasing (``tf.aliasing_output`` in the lowered module, the same
+  machinery ``jaxpr_audit.check_donation`` asserts). A credited donated
+  buffer is consumed in place at its last use, so a donated TrainState
+  prices ~1x while an un-donated one prices ~2x (old + new state live
+  together) -- exactly the PR 1 bug class, now a ratchet trip. When the
+  donation-unusable warning fires, credit is withheld.
+- **Tile padding.** Every buffer is priced with
+  ``parallel/memory.py:padded_bytes`` -- the collapsed-2D (8,128)-tile
+  model locked to the round-5 device measurements -- not its data
+  bytes; the 16x f32-scale blowup class is visible to the walker.
+- **Sharding divided out.** Argument leaves carry their real committed
+  shardings: each is priced at its padded SHARD bytes, with the
+  per-leaf divisor cross-checked through
+  ``parallel/memory.py:per_device_state_bytes`` (the one layout model
+  both planners share). Intermediates have no static sharding, so they
+  follow the entry's dominant plan: the leading (batch/slot) axis is
+  assumed sharded across the entry's mesh when divisible, else padded
+  bytes are divided evenly -- the propagation truth for every audited
+  entry.
+- **Control flow.** A sub-jaxpr's boundary values alias its equation's
+  operands/results (already counted); only its internal temporaries
+  add, as a transient at that equation. ``cond`` prices the max
+  branch; ``while``/``scan`` price one iteration's body (residency is
+  reused across trips, unlike wire bytes); ``remat`` bodies appear
+  once in the forward and again at their backward recompute site, so
+  their workspace is correctly double-counted where it really
+  re-materializes.
+
+**KT-MEM-RESHARD** (hard): a planned resplit whose
+``reshard_peak_bytes`` (staged source+target residency, the
+``parallel/memory.py`` model the live executors gate on) exceeds the
+declared per-device HBM budget would OOM mid-migration -- the
+Tenplex-style failure elasticity must catch BEFORE actuating. The
+serving audit prices the tp=2 -> tp=1 consolidation of weights + KV
+cache against the default chip budget.
+
+The audited peaks close the loop in the control plane:
+``controller/scheduler.py`` consumes them (annotation
+``kftpu.io/hbm-peak-bytes`` when a measured sample exists, these
+baseline metrics otherwise) as a per-(job, chip-type) placement
+feasibility mask -- see ``resolve_hbm_peak`` / ``job_fits_domain``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import warnings
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from kubeflow_tpu.analysis.jaxpr_audit import DONATION_WARNING, _as_jaxprs
+from kubeflow_tpu.analysis.report import Finding
+from kubeflow_tpu.parallel.memory import (
+    HBM_BYTES,
+    kv_cache_plan,
+    padded_bytes,
+    per_device_state_bytes,
+    reshard_peak_bytes,
+)
+
+METRIC_PREFIX = "mem.peak_bytes."
+
+# Chip generation whose HBM budget gates the audited reshard plans
+# (the fleet's default generation; Domain.chip_type mirrors it).
+DEFAULT_CHIP_TYPE = "v5e"
+
+# Sequence-parallel llama variants the train audit walks, mirroring
+# shardcheck_seq_variants. Module-level so tests can trim it.
+SEQ_VARIANTS = (("ring", 2), ("ulysses", 4))
+
+
+@dataclasses.dataclass
+class MemModel:
+    """Per-entry peak-residency model (all byte figures per device)."""
+
+    entry: str
+    peak_bytes: int = 0
+    # Padded per-device bytes of the boundary (argument + closure
+    # const) buffers -- the closed-form-checkable component.
+    arg_bytes: int = 0
+    # Invars credited with in-place consumption (donation proven via
+    # tf.aliasing_output); 0 means every argument stays resident.
+    donated_credited: int = 0
+    notes: List[str] = dataclasses.field(default_factory=list)
+
+
+# -- byte pricing -----------------------------------------------------------
+
+def _is_literal(v) -> bool:
+    return hasattr(v, "val")  # jax.core.Literal; Vars carry no .val
+
+
+def _aval_shape_dtype(aval) -> Optional[Tuple[Tuple[int, ...], object]]:
+    import numpy as np
+
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return None  # tokens / abstract effects: no HBM footprint
+    try:
+        np.dtype(dtype)
+    except TypeError:
+        return None  # extended dtypes (PRNG keys): negligible bytes
+    return tuple(int(d) for d in shape), dtype
+
+
+def _intermediate_bytes(aval, divisor: int) -> int:
+    """Per-device padded bytes of an intermediate value: the leading
+    (batch/slot) axis is assumed sharded across the entry's ``divisor``
+    devices when divisible -- the dominant propagation layout of every
+    audited entry -- else the padded global bytes are divided evenly."""
+    sd = _aval_shape_dtype(aval)
+    if sd is None:
+        return 0
+    shape, dtype = sd
+    if divisor > 1 and shape and shape[0] % divisor == 0:
+        return int(padded_bytes((shape[0] // divisor,) + shape[1:], dtype))
+    b = int(padded_bytes(shape, dtype))
+    return b if divisor <= 1 else max(b // divisor, 1)
+
+
+def _leaf_device_bytes(aval, leaf, divisor: int) -> int:
+    """Per-device padded bytes of one argument leaf under its REAL
+    committed sharding: padded shard bytes, with the per-leaf divisor
+    routed through ``per_device_state_bytes`` (the shared layout model)
+    as the fallback when the sharding cannot name a shard shape."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    sd = _aval_shape_dtype(aval)
+    if sd is None:
+        return 0
+    shape, dtype = sd
+    sh = getattr(leaf, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return _intermediate_bytes(aval, divisor)
+    try:
+        shard = tuple(int(d) for d in sh.shard_shape(shape))
+        return int(padded_bytes(shard, dtype))
+    except (TypeError, ValueError):
+        struct = jax.ShapeDtypeStruct(shape, dtype)
+        data = max(math.prod(shape), 1) * struct.dtype.itemsize
+        per_dev = max(int(per_device_state_bytes(struct, sh)), 1)
+        div = max(data // per_dev, 1)
+        return max(int(padded_bytes(shape, dtype)) // div, 1)
+
+
+# -- live-range walker ------------------------------------------------------
+
+def _walk_peak(
+    jaxpr_like,
+    divisor: int,
+    notes: List[str],
+    boundary: Optional[Dict] = None,
+    mortal: Optional[Set] = None,
+    boundary_free: bool = False,
+    out_prices: Optional[Dict] = None,
+) -> int:
+    """Peak live bytes over one jaxpr's equation order.
+
+    ``boundary`` prices the invars/constvars (top level: real shard
+    bytes). ``boundary_free`` prices ALL boundary values -- invars,
+    constvars, and the jaxpr's own outvars -- at zero: inner jaxprs'
+    boundary buffers alias their equation's operands/results, which the
+    enclosing walk already counts. ``mortal`` invars (credited donated
+    arguments) are consumed in place at their last use; every other
+    boundary value is caller-owned and lives for the whole walk.
+    """
+    inner = getattr(jaxpr_like, "jaxpr", jaxpr_like)
+    eqns = inner.eqns
+    mortal = mortal or set()
+    last: Dict = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if not _is_literal(v):
+                last[v] = i
+    n = len(eqns)
+    free_outs: Set = set()
+    for v in inner.outvars:
+        if _is_literal(v):
+            continue
+        if boundary_free:
+            free_outs.add(v)
+        last[v] = n  # results stay resident past the final equation
+
+    live: Dict = {}
+    for v in list(inner.constvars) + list(inner.invars):
+        if boundary_free:
+            live[v] = 0
+        elif boundary is not None and v in boundary:
+            live[v] = boundary[v]
+        else:
+            live[v] = _intermediate_bytes(v.aval, divisor)
+        if v not in mortal:
+            last[v] = n  # caller-owned: resident for the whole step
+    cur = sum(live.values())
+    peak = cur
+
+    for i, eqn in enumerate(eqns):
+        # Donation alias credit: a credited buffer reaching its last
+        # use is consumed in place (its bytes become the output's).
+        for v in eqn.invars:
+            if _is_literal(v):
+                continue
+            if v in mortal and v in live and last.get(v) == i:
+                cur -= live.pop(v)
+        for v in eqn.outvars:
+            if _is_literal(v) or v in live:
+                continue
+            if v in free_outs:
+                b = 0
+            elif out_prices is not None and v in out_prices:
+                b = out_prices[v]
+            else:
+                b = _intermediate_bytes(v.aval, divisor)
+            live[v] = b
+            cur += b
+        transient = 0
+        for val in eqn.params.values():
+            for sub in _as_jaxprs(val):
+                transient = max(
+                    transient,
+                    _walk_peak(sub, divisor, notes, boundary_free=True),
+                )
+        if transient and eqn.primitive.name == "while":
+            notes.append(
+                "data-dependent while body priced for one iteration's "
+                "residency (buffers are reused across trips)"
+            )
+        peak = max(peak, cur + transient)
+        for v in eqn.invars:
+            if _is_literal(v):
+                continue
+            if v in live and last.get(v) == i:
+                cur -= live.pop(v)
+        for v in eqn.outvars:
+            if v in live and last.get(v, -1) <= i:
+                cur -= live.pop(v)  # never used (DropVar): freed at once
+    return peak
+
+
+# -- donation credit --------------------------------------------------------
+
+def _donated_mask(jitted, args: Sequence, notes: List[str]) -> List[bool]:
+    """Per-invar donation flags, credited only when the lowered module
+    carries ``tf.aliasing_output`` proof and no donation-unusable
+    warning fired -- the exact evidence check_donation asserts on."""
+    import jax
+
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            lowered = jitted.lower(*args)
+            text = lowered.as_text()
+        info = jax.tree_util.tree_leaves(
+            lowered.args_info,
+            is_leaf=lambda x: hasattr(x, "donated"),
+        )
+        mask = [bool(getattr(x, "donated", False)) for x in info]
+    except Exception as e:  # kt-lint: disable=KT-SWALLOW01 -- best-effort:
+        # an entry without .lower/.args_info is priced without credit,
+        # which only ever errs toward a HIGHER (safer) peak.
+        notes.append(f"donation introspection unavailable ({e}); "
+                     f"peak priced without alias credit")
+        return []
+    if not any(mask):
+        return mask
+    unusable = any(DONATION_WARNING in str(w.message) for w in caught)
+    aliased = text.count("tf.aliasing_output")
+    if unusable or aliased == 0:
+        notes.append(
+            "declared donation not consumed by the compiler "
+            "(no tf.aliasing_output); alias credit withheld"
+        )
+        return [False] * len(mask)
+    return mask
+
+
+def jaxpr_mem_model(
+    fn,
+    args: Sequence,
+    entry: str,
+    jitted=None,
+    divisor: int = 1,
+) -> MemModel:
+    """Live-range peak-residency model of one entry point. ``jitted``
+    (default ``fn``) is lowered for donation evidence; ``fn`` is
+    traced. ``divisor`` is the entry's participating device count, the
+    intermediate-sharding assumption documented on the module."""
+    import jax
+
+    model = MemModel(entry=entry)
+    closed = jax.make_jaxpr(fn)(*args)
+    inner = closed.jaxpr
+    leaves = jax.tree_util.tree_leaves(args)
+    boundary: Dict = {}
+    if len(leaves) == len(inner.invars):
+        for v, leaf in zip(inner.invars, leaves):
+            boundary[v] = _leaf_device_bytes(v.aval, leaf, divisor)
+    else:
+        model.notes.append(
+            f"{len(leaves)} arg leaves vs {len(inner.invars)} invars; "
+            f"boundary priced from avals under the entry divisor"
+        )
+        for v in inner.invars:
+            boundary[v] = _intermediate_bytes(v.aval, divisor)
+    for v in inner.constvars:
+        boundary[v] = _intermediate_bytes(v.aval, divisor)
+
+    mortal: Set = set()
+    mask = _donated_mask(jitted if jitted is not None else fn, args,
+                         model.notes)
+    if len(mask) == len(inner.invars):
+        mortal = {v for v, d in zip(inner.invars, mask) if d}
+    elif mask and any(mask):
+        model.notes.append(
+            f"donation mask covers {len(mask)} leaves vs "
+            f"{len(inner.invars)} invars; alias credit withheld"
+        )
+    model.donated_credited = len(mortal)
+    model.arg_bytes = int(sum(boundary.values()))
+    # Top-level outputs mirror the entry's input state/caches (new
+    # TrainState out for TrainState in, cache out for cache in): price
+    # each outvar like the argument leaf with the same (shape, dtype)
+    # when one exists, so replicated outputs are not mistaken for
+    # batch-sharded intermediates.
+    pool: Dict = {}
+    for v, b in boundary.items():
+        sd = _aval_shape_dtype(v.aval)
+        if sd is not None:
+            pool.setdefault((sd[0], str(sd[1])), b)
+    out_prices: Dict = {}
+    for v in inner.outvars:
+        if _is_literal(v):
+            continue
+        sd = _aval_shape_dtype(v.aval)
+        if sd is not None and (sd[0], str(sd[1])) in pool:
+            out_prices[v] = pool[(sd[0], str(sd[1]))]
+    model.peak_bytes = int(_walk_peak(
+        closed, divisor, model.notes, boundary=boundary, mortal=mortal,
+        out_prices=out_prices))
+    return model
+
+
+# -- reshard budget (KT-MEM-RESHARD) ----------------------------------------
+
+def check_reshard_budget(
+    per_leaf_src: List[Dict[int, int]],
+    per_leaf_dst: List[Dict[int, int]],
+    entry: str,
+    hbm_budget_bytes: int,
+    in_place: bool = False,
+) -> Tuple[List[Finding], int]:
+    """Hard-gate a planned resplit: its staged peak residency
+    (``reshard_peak_bytes``) must fit the declared per-device HBM
+    budget, or the migration OOMs mid-flight instead of being rejected
+    up front."""
+    peak = reshard_peak_bytes(per_leaf_src, per_leaf_dst,
+                              in_place=in_place)
+    findings: List[Finding] = []
+    if peak > hbm_budget_bytes:
+        findings.append(Finding(
+            rule="KT-MEM-RESHARD", path=entry, line=0, hard=True,
+            message=(
+                f"planned resplit peaks at {peak} bytes/device but the "
+                f"declared HBM budget is {hbm_budget_bytes}: the "
+                f"migration would OOM mid-flight -- shrink the plan or "
+                f"stage through a bigger chip type"
+            ),
+        ))
+    return findings, int(peak)
+
+
+def _leaf_device_map(leaf) -> Dict[int, int]:
+    """device id -> padded shard bytes for one committed array."""
+    out: Dict[int, int] = {}
+    for s in leaf.addressable_shards:
+        out[int(s.device.id)] = int(
+            padded_bytes(tuple(s.data.shape), leaf.dtype))
+    return out
+
+
+# -- repo entry drivers -----------------------------------------------------
+
+def _metric(metrics: Dict[str, float], entry: str, model: MemModel) -> None:
+    metrics[METRIC_PREFIX + entry] = float(int(model.peak_bytes))
+
+
+def memcheck_train_steps(
+    tasks: Optional[Sequence[str]] = None,
+) -> Tuple[List[Finding], Dict[str, float]]:
+    """Peak residency of the DP train steps on the default (data=8)
+    mesh: donated TrainState priced in place, activations assumed
+    batch-sharded."""
+    from kubeflow_tpu.analysis._trace_cache import train_setup
+    from kubeflow_tpu.analysis.jaxpr_audit import TRAIN_TASKS
+
+    findings: List[Finding] = []
+    metrics: Dict[str, float] = {}
+    for name in tasks or sorted(TRAIN_TASKS):
+        entry = f"train.{name}"
+        _task, state, _step, jitted, batch, mesh = train_setup(name)
+        divisor = math.prod(dict(mesh.shape).values()) or 1
+        model = jaxpr_mem_model(jitted, (state, *batch), entry,
+                                jitted=jitted, divisor=divisor)
+        _metric(metrics, entry, model)
+    return findings, metrics
+
+
+def memcheck_seq_variants() -> Tuple[List[Finding], Dict[str, float]]:
+    """llama train step on the ring=2 / ulysses=4 sequence meshes --
+    the entries whose collectives shardcheck prices get their residency
+    priced on the same meshes."""
+    import jax
+
+    from kubeflow_tpu.analysis._trace_cache import seq_setup
+    from kubeflow_tpu.parallel.mesh import mesh_context
+
+    findings: List[Finding] = []
+    metrics: Dict[str, float] = {}
+    n_dev = len(jax.devices())
+    for impl, seq in SEQ_VARIANTS:
+        if n_dev < seq:
+            continue
+        entry = f"train.llama.{impl}{seq}"
+        _task, state, _step, jitted, batch, mesh = seq_setup(impl, seq)
+        divisor = math.prod(dict(mesh.shape).values()) or 1
+        with mesh_context(mesh):
+            model = jaxpr_mem_model(jitted, (state, *batch), entry,
+                                    jitted=jitted, divisor=divisor)
+        _metric(metrics, entry, model)
+    return findings, metrics
+
+
+def memcheck_serving(
+    hbm_budget_bytes: Optional[int] = None,
+) -> Tuple[List[Finding], Dict[str, float]]:
+    """Peak residency of the tp=2 serving jits (prefill / insert /
+    decode), the kv_cache_plan padded total those jits must hold, and
+    the KT-MEM-RESHARD budget gate over the tp=2 -> tp=1 consolidation
+    resplit of weights + KV cache."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.analysis._trace_cache import tp2_engine
+
+    findings: List[Finding] = []
+    metrics: Dict[str, float] = {}
+    eng = tp2_engine()
+    if eng is None:
+        return findings, metrics
+    budget = (HBM_BYTES[DEFAULT_CHIP_TYPE]
+              if hbm_budget_bytes is None else hbm_budget_bytes)
+    reg = eng._jit_registry
+
+    tokens = jnp.zeros((1, 32), jnp.int32)
+    lengths = jnp.asarray([5], jnp.int32)
+    model = jaxpr_mem_model(
+        reg["prefill"], (eng.weights, tokens, lengths),
+        "serve.tp2.prefill", jitted=reg["prefill"], divisor=2)
+    _metric(metrics, "serve.tp2.prefill", model)
+
+    _, k_seq, v_seq = eng._prefill(tokens, lengths)
+    slots = jnp.asarray([0], jnp.int32)
+    model = jaxpr_mem_model(
+        reg["insert"], (eng.cache_k, eng.cache_v, k_seq, v_seq, slots),
+        "serve.tp2.insert", jitted=reg["insert"], divisor=2)
+    _metric(metrics, "serve.tp2.insert", model)
+
+    b = eng.max_slots
+    toks = jnp.zeros((b,), jnp.int32)
+    lens = jnp.zeros((b,), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+    temps = jnp.zeros((b,), jnp.float32)
+    tks = jnp.zeros((b,), jnp.int32)
+    tps = jnp.ones((b,), jnp.float32)
+    nonces = jnp.zeros((b,), jnp.int32)
+    for key, jfn in sorted(reg["decode_block"].items(), key=repr):
+        _n, _filtered, _want_lp, masked = key
+        if masked:
+            continue
+        args = (eng.weights, eng.cache_k, eng.cache_v, toks, lens, rng,
+                temps, tks, tps, nonces)
+        model = jaxpr_mem_model(jfn, args, "serve.tp2.decode",
+                                jitted=jfn, divisor=2)
+        _metric(metrics, "serve.tp2.decode", model)
+        break  # one representative block variant prices the decode plan
+
+    # The engine's KV allocation, from the same tile-padded plan the
+    # capacity planner uses -- per device at tp=2.
+    plan = kv_cache_plan(eng.cfg, eng.max_slots, tensor_parallel=2)
+    metrics[METRIC_PREFIX + "serve.tp2.kv_cache"] = float(
+        plan["padded_bytes"])
+
+    # KT-MEM-RESHARD: tp=2 -> tp=1 consolidation (the shrink arm of
+    # PR 14's live resplit) staged onto device 0.
+    leaves = jax.tree_util.tree_leaves(
+        (eng.weights, eng.cache_k, eng.cache_v))
+    arrays = [x for x in leaves if hasattr(x, "addressable_shards")]
+    src = [_leaf_device_map(x) for x in arrays]
+    dst = [{0: int(padded_bytes(tuple(x.shape), x.dtype))} for x in arrays]
+    reshard_findings, peak = check_reshard_budget(
+        src, dst, "serve.tp2.reshard_tp1", budget)
+    findings.extend(reshard_findings)
+    metrics[METRIC_PREFIX + "serve.tp2.reshard_tp1"] = float(peak)
+    return findings, metrics
+
+
+def memcheck_all(
+    include_serving: bool = True,
+) -> Tuple[List[Finding], Dict[str, float]]:
+    findings: List[Finding] = []
+    metrics: Dict[str, float] = {}
+    for fn in ([memcheck_train_steps, memcheck_seq_variants]
+               + ([memcheck_serving] if include_serving else [])):
+        f, m = fn()
+        findings.extend(f)
+        metrics.update(m)
+    return findings, metrics

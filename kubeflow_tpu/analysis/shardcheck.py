@@ -405,21 +405,14 @@ def shardcheck_train_steps(
     """DP train steps on the default (data=8) mesh: all traffic is
     GSPMD-inserted gradient/loss all-reduce; anything else is an
     implicit reshard."""
-    import jax
-
-    from kubeflow_tpu.analysis.jaxpr_audit import TRAIN_TASKS, _mesh
-    from kubeflow_tpu.models import get_task
+    from kubeflow_tpu.analysis._trace_cache import train_setup
+    from kubeflow_tpu.analysis.jaxpr_audit import TRAIN_TASKS
 
     findings: List[Finding] = []
     metrics: Dict[str, float] = {}
-    mesh = _mesh()
     for name in tasks or sorted(TRAIN_TASKS):
         entry = f"train.{name}"
-        task = get_task(name, **TRAIN_TASKS[name])
-        state = task.init_state(jax.random.PRNGKey(0), mesh)
-        step = task.train_step_fn(mesh)
-        jitted = getattr(step, "jitted", step)
-        batch = next(iter(task.data_iter(1, 0, mesh)))
+        _task, state, _step, jitted, batch, _mesh = train_setup(name)
         entry_findings, model = audit_entry(
             jitted, (state, *batch), entry, allowed_kinds=ALLOWED["train"])
         findings.extend(entry_findings)
@@ -432,9 +425,8 @@ def shardcheck_seq_variants() -> Tuple[List[Finding], Dict[str, float]]:
     full forward+backward pricing of the sequence-parallel plans."""
     import jax
 
-    from kubeflow_tpu.models import get_task
-    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh, \
-        mesh_context
+    from kubeflow_tpu.analysis._trace_cache import seq_setup
+    from kubeflow_tpu.parallel.mesh import mesh_context
 
     findings: List[Finding] = []
     metrics: Dict[str, float] = {}
@@ -443,14 +435,8 @@ def shardcheck_seq_variants() -> Tuple[List[Finding], Dict[str, float]]:
         if n_dev < seq:
             continue
         entry = f"train.llama.{impl}{seq}"
-        task = get_task("llama", preset="llama-tiny", batch_size=8,
-                        seq_len=16, attention_impl=impl)
-        mesh = build_mesh(MeshConfig(data=-1, sequence=seq))
+        _task, state, _step, jitted, batch, mesh = seq_setup(impl, seq)
         with mesh_context(mesh):
-            state = task.init_state(jax.random.PRNGKey(0), mesh)
-            step = task.train_step_fn(mesh)
-            jitted = getattr(step, "jitted", step)
-            batch = next(iter(task.data_iter(1, 0, mesh)))
             entry_findings, model = audit_entry(
                 jitted, (state, *batch), entry,
                 allowed_kinds=ALLOWED[f"train.{impl}"])
@@ -497,23 +483,16 @@ def shardcheck_serving() -> Tuple[List[Finding], Dict[str, float]]:
     surfaces. Insert's empty allowed set is the sharpest invariant --
     cache writes are shard-local by construction, so ANY collective
     there is an implicit reshard of the KV cache."""
-    import dataclasses as dc
-
     import jax
     import jax.numpy as jnp
 
-    from kubeflow_tpu.models.llama import PRESETS
-    from kubeflow_tpu.serving.engine import GenerationEngine
+    from kubeflow_tpu.analysis._trace_cache import tp2_engine
 
     findings: List[Finding] = []
     metrics: Dict[str, float] = {}
-    if len(jax.devices()) < 2:
+    eng = tp2_engine()
+    if eng is None:
         return findings, metrics
-    cfg = dc.replace(PRESETS["llama-tiny"], max_seq=64)
-    eng = GenerationEngine(config=cfg, max_slots=2, decode_block=4,
-                           tensor_parallel=2)
-    # Warmup populates the per-key decode jit cache.
-    eng.generate([3, 5, 7], max_new_tokens=6)
     reg = eng._jit_registry
 
     tokens = jnp.zeros((1, 32), jnp.int32)

@@ -1,0 +1,17 @@
+"""Chip-generation capacity table, importable without jax.
+
+The control plane (``controller/scheduler.py``) consults per-chip HBM
+on every planning round to run the memory-feasibility mask, and the
+controller/server processes are deliberately jax-free — importing
+``parallel/memory.py`` (which needs jax for shape evaluation) from the
+scheduler would pull a multi-second jax init into every spawned
+control-plane process. The one shared capacity table therefore lives
+at the package top (outside ``parallel/``, whose __init__ builds
+on jax); ``parallel/memory.py`` re-exports it for the planners.
+"""
+
+HBM_BYTES = {
+    "v5e": 16 * 1024**3,
+    "v5p": 95 * 1024**3,
+    "v4": 32 * 1024**3,
+}

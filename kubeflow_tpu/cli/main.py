@@ -351,12 +351,15 @@ def cmd_sched(args, _client) -> int:
 
     domains = []
     for part in args.domains.split(","):
-        name, _, chips = part.partition("=")
+        name, _, spec = part.partition("=")
+        chips, _, chip_type = spec.partition(":")
         try:
-            domains.append(Domain(name.strip(), int(chips)))
+            domains.append(Domain(name.strip(), int(chips),
+                                  chip_type=chip_type.strip() or "v5e"))
         except ValueError:
             raise SystemExit(
-                f"error: bad --domains entry {part!r} (want name=chips)")
+                f"error: bad --domains entry {part!r} "
+                f"(want name=chips or name=chips:chip_type)")
 
     jobs = []
     if args.filename:
@@ -408,15 +411,17 @@ def cmd_sched(args, _client) -> int:
                if sj.current else "-")
         tgt = f"{new.chips}@{new.domain}" if new else "-"
         rows.append((sj.key, sj.tenant, sj.workload, cur, tgt, dec.action,
+                     new.fit_source if new else sj.fit_source,
                      f"{dec.cost_seconds:g}", dec.reason))
     header = ("JOB", "TENANT", "CLASS", "CURRENT", "PLANNED", "ACTION",
-              "COST_S", "REASON")
+              "FIT", "COST_S", "REASON")
     widths = [max(len(str(r[i])) for r in [header] + rows)
               for i in range(len(header))]
     for r in [header] + rows:
         print("  ".join(str(v).ljust(w) for v, w in zip(r, widths)).rstrip())
     print(f"plan: {plan.summary()}  preemptions={plan.preemptions} "
-          f"migrations={plan.migrations}  "
+          f"migrations={plan.migrations} "
+          f"mem_rejections={plan.mem_rejections}  "
           f"capacity={sum(d.chips for d in domains)} chips "
           f"across {len(domains)} domain(s)")
     if not args.dry_run:
@@ -492,13 +497,17 @@ def main(argv=None) -> int:
                     help="tier A (AST) only; skip jaxpr audits")
     sp.add_argument("--no-serving", action="store_true",
                     help="skip the serving-engine audit (fastest trace run)")
+    # Choices come from the one family registry so an unknown name
+    # exits 2 with the valid list and new families can never drift out
+    # of the CLI contract.
+    from kubeflow_tpu.analysis import FAMILIES as _families
+
     sp.add_argument("--only", action="append", default=None,
                     metavar="FAMILY",
-                    choices=("astlint", "audit", "shard", "perf", "race",
-                             "proto", "chaos"),
+                    choices=_families,
                     help="run only the named analysis family "
-                         "(repeatable): astlint | audit | shard | perf | "
-                         "race | proto | chaos. Default: all families.")
+                         "(repeatable): " + " | ".join(_families) +
+                         ". Default: all families.")
     sp.add_argument("--diff", default=None, metavar="REV",
                     help="Tier A lint restricted to package files "
                          "changed vs this git rev (fast pre-push mode; "
@@ -540,7 +549,9 @@ def main(argv=None) -> int:
                          "instead of the live server's jobs (repeatable)")
     sp.add_argument("-n", "--namespace", default="default")
     sp.add_argument("--domains", default="d0=16,d1=16",
-                    help="comma-separated name=chips interconnect domains "
+                    help="comma-separated name=chips[:chip_type] "
+                         "interconnect domains; chip_type (v5e/v5p/v4) "
+                         "sets per-chip HBM for the memory-fit mask "
                          "(default: d0=16,d1=16)")
     sp.add_argument("--dry-run", action="store_true",
                     help="explicit no-actuation marker (plan is always "

@@ -83,6 +83,56 @@ ANN_WORKLOAD_CLASS = "kftpu.io/workload-class"
 # wins over annotation guesses (ISSUE 15 / ROADMAP item 2's "online
 # intensity estimation" headroom, closed from the analysis side).
 ANN_COMM_BYTES = "kftpu.io/comm-bytes-per-step"
+# MEASURED per-device peak HBM bytes (a live allocator sample or the
+# mem analysis family's audited ``mem.peak_bytes.*`` ratchet stamped by
+# CI). When present it REPLACES the static audited estimate below --
+# the same measured-beats-prior contract as ANN_COMM_BYTES.
+ANN_HBM_PEAK = "kftpu.io/hbm-peak-bytes"
+
+# Audited peaks feeding the static side of the memory-fit mask: the
+# committed analysis baseline's mem.peak_bytes.* metrics, loaded once.
+_MEM_PREFIX = "mem.peak_bytes."
+_MEM_METRICS: Optional[Dict[str, float]] = None
+
+
+def chip_hbm_bytes(chip_type: str) -> Optional[int]:
+    """Per-chip HBM bytes for a chip generation (None when unknown).
+    ``chips.py`` is jax-free on purpose: this runs on every planning
+    round in the control-plane processes."""
+    from kubeflow_tpu.chips import HBM_BYTES
+
+    return HBM_BYTES.get(chip_type)
+
+
+def _audited_mem_metrics() -> Dict[str, float]:
+    global _MEM_METRICS
+    if _MEM_METRICS is None:
+        try:
+            from kubeflow_tpu.analysis.report import load_baseline
+
+            metrics = load_baseline(None).get("metrics", {})
+        except Exception:  # kt-lint: disable=KT-SWALLOW01 -- best-effort:
+            # no committed baseline (fresh checkout) just means no
+            # static estimate; the mask stays permissive.
+            metrics = {}
+        _MEM_METRICS = {
+            k: float(v) for k, v in metrics.items()
+            if k.startswith(_MEM_PREFIX)
+        }
+    return _MEM_METRICS
+
+
+def static_hbm_peak(workload: str) -> Optional[float]:
+    """Static per-device HBM peak estimate for a workload class: the
+    worst audited entry of that class in the committed baseline
+    (serving entries include the ``kv_cache_plan`` padded total the
+    engine must hold). None when the mem family has never run."""
+    metrics = _audited_mem_metrics()
+    prefix = _MEM_PREFIX + ("serve." if workload == "serving"
+                            else "train.")
+    vals = [v for k, v in metrics.items() if k.startswith(prefix)]
+    return max(vals) if vals else None
+
 
 # Measured-bytes -> 0..1 intensity ramp, linear in log2 space between
 # the census extremes: <=1 MiB/step is negligible traffic (the "none"
@@ -125,16 +175,46 @@ def comm_bytes_for_intensity(intensity: float) -> float:
 @dataclasses.dataclass(frozen=True)
 class Domain:
     """One interconnect domain (an ICI pod / slice): jobs placed on the
-    same domain share its interconnect and contend on collectives."""
+    same domain share its interconnect and contend on collectives.
+    ``chip_type`` names the generation (per-chip HBM from the shared
+    capacity table); ``hbm_bytes`` overrides it for synthetic or
+    non-catalog hardware."""
 
     name: str
     chips: int
+    chip_type: str = "v5e"
+    hbm_bytes: Optional[int] = None
+
+    @property
+    def hbm_per_chip(self) -> Optional[int]:
+        if self.hbm_bytes is not None:
+            return self.hbm_bytes
+        return chip_hbm_bytes(self.chip_type)
+
+
+def job_fits_domain(job: "SchedJob", domain: Domain) -> bool:
+    """Memory-feasibility mask: the audited/measured per-device peak
+    must fit the domain's per-chip HBM -- adding chips never shrinks a
+    per-device peak, so an over-HBM job fails on this generation at ANY
+    chip count. Permissive when either side is unknown."""
+    if job.hbm_peak_bytes is None:
+        return True
+    hbm = domain.hbm_per_chip
+    if hbm is None:
+        return True
+    return job.hbm_peak_bytes <= hbm
 
 
 @dataclasses.dataclass(frozen=True)
 class Placement:
     domain: str
     chips: int
+    # Provenance of the memory-fit evidence this placement passed:
+    # "measured" (ANN_HBM_PEAK sample), "static" (audited baseline
+    # estimate), or "none" (no peak known; mask was permissive).
+    # Excluded from equality so stamping provenance can never read as a
+    # placement change to the keep/migrate logic.
+    fit_source: str = dataclasses.field(default="none", compare=False)
 
 
 @dataclasses.dataclass
@@ -161,6 +241,12 @@ class SchedJob:
     tok_s_per_chip: float = 1000.0
     # Latest measured aggregate tok/s (None = no sample yet).
     measured_tok_s: Optional[float] = None
+    # Per-device peak HBM bytes the job must hold (None = unknown; the
+    # memory mask is permissive) and its provenance: "measured"
+    # (ANN_HBM_PEAK) beats "static" (audited mem.peak_bytes baseline)
+    # beats "none" -- see resolve_hbm_peak.
+    hbm_peak_bytes: Optional[float] = None
+    fit_source: str = "none"
 
 
 @dataclasses.dataclass
@@ -181,6 +267,9 @@ class Plan:
     decisions: List[Decision]
     preemptions: int = 0
     migrations: int = 0
+    # Jobs left unplaced this round because their HBM peak exceeds
+    # every domain's per-chip HBM (the memory-feasibility mask).
+    mem_rejections: int = 0
 
     @property
     def placements(self) -> Dict[str, Optional[Placement]]:
@@ -274,12 +363,33 @@ def waterfill(demands: Sequence[Tuple[str, float, int, int]],
     return alloc
 
 
-def fair_shares(jobs: Sequence[SchedJob], capacity: int) -> Dict[str, int]:
+def fair_shares(jobs: Sequence[SchedJob], capacity: int,
+                domains: Optional[Sequence[Domain]] = None
+                ) -> Dict[str, int]:
     """Two-level weighted max-min: chips across TENANTS by tenant
     weight, then across each tenant's jobs by job weight. Tenant weight
     is the max of its members' weights (one spec field, ``scheduling.
     weight``, doubles as the tenant's share when tenants are 1:1 with
-    jobs -- the common case in tests and the bench)."""
+    jobs -- the common case in tests and the bench).
+
+    With ``domains``, each job's demand is capped by the total chips of
+    the domains it memory-fits (``job_fits_domain``): chips a job can
+    never hold on any feasible generation are not withheld from its
+    tenant peers, and a job fitting nowhere water-fills to zero."""
+    fit_cap: Dict[str, int] = {}
+    if domains is not None:
+        for j in jobs:
+            fit_cap[j.key] = sum(
+                d.chips for d in domains if job_fits_domain(j, d))
+
+    def _min_chips(m: SchedJob) -> int:
+        return (m.min_chips if m.key not in fit_cap
+                else min(m.min_chips, fit_cap[m.key]))
+
+    def _max_chips(m: SchedJob) -> int:
+        return (m.max_chips if m.key not in fit_cap
+                else min(m.max_chips, fit_cap[m.key]))
+
     by_tenant: Dict[str, List[SchedJob]] = {}
     for j in jobs:
         by_tenant.setdefault(j.tenant, []).append(j)
@@ -289,14 +399,14 @@ def fair_shares(jobs: Sequence[SchedJob], capacity: int) -> Dict[str, int]:
         tenant_rows.append((
             tenant,
             max(m.weight for m in members),
-            sum(m.min_chips for m in members),
-            sum(m.max_chips for m in members),
+            sum(_min_chips(m) for m in members),
+            sum(_max_chips(m) for m in members),
         ))
     tenant_alloc = waterfill(tenant_rows, capacity)
     alloc: Dict[str, int] = {}
     for tenant in sorted(by_tenant):
         members = by_tenant[tenant]
-        rows = [(m.key, m.weight, m.min_chips, m.max_chips)
+        rows = [(m.key, m.weight, _min_chips(m), _max_chips(m))
                 for m in sorted(members, key=lambda m: m.key)]
         alloc.update(waterfill(rows, tenant_alloc[tenant]))
     return alloc
@@ -332,7 +442,11 @@ def place(jobs: Sequence[SchedJob], alloc: Dict[str, int],
           domains: Sequence[Domain],
           config: PolicyConfig) -> Dict[str, Placement]:
     """Assign each allocated job to ONE interconnect domain (slice
-    atomicity: a gang never straddles domains here).
+    atomicity: a gang never straddles domains here). Both the sticky
+    and the loose path honor the memory-feasibility mask
+    (``job_fits_domain``): a domain whose per-chip HBM the job's
+    audited/measured peak exceeds is never a candidate, and each
+    placement carries the job's ``fit_source`` provenance.
 
     Candidate layouts are built largest-allocation-first and compared by
     (chips placed, lower pairwise contention cost, jobs kept in their
@@ -358,6 +472,7 @@ def place(jobs: Sequence[SchedJob], alloc: Dict[str, int],
     )
     biggest = max(d.chips for d in domains)
     dom_index = {d.name: i for i, d in enumerate(domains)}
+    dom_by_name = {d.name: d for d in domains}
 
     def build(sticky: bool, weight: float):
         free = {d.name: d.chips for d in domains}
@@ -369,8 +484,11 @@ def place(jobs: Sequence[SchedJob], alloc: Dict[str, int],
             for j in order:
                 chips = min(alloc[j.key], biggest)
                 if (j.current is not None and j.current.domain in free
-                        and free[j.current.domain] >= chips):
-                    pl[j.key] = Placement(j.current.domain, chips)
+                        and free[j.current.domain] >= chips
+                        and job_fits_domain(
+                            j, dom_by_name[j.current.domain])):
+                    pl[j.key] = Placement(j.current.domain, chips,
+                                          fit_source=j.fit_source)
                     free[j.current.domain] -= chips
                     pair_cost += (j.collective_intensity
                                   * load[j.current.domain])
@@ -381,13 +499,15 @@ def place(jobs: Sequence[SchedJob], alloc: Dict[str, int],
             loose = list(order)
         for j in loose:
             chips = min(alloc[j.key], biggest)
-            fits = [d for d in domains if free[d.name] >= chips]
+            fits = [d for d in domains
+                    if free[d.name] >= chips and job_fits_domain(j, d)]
             if not fits:
                 continue  # stays queued this round; capacity fragmented
             best = min(fits, key=lambda d: (
                 weight * j.collective_intensity * load[d.name],
                 dom_index[d.name]))
-            pl[j.key] = Placement(best.name, chips)
+            pl[j.key] = Placement(best.name, chips,
+                                  fit_source=j.fit_source)
             free[best.name] -= chips
             pair_cost += j.collective_intensity * load[best.name]
             load[best.name] += j.collective_intensity
@@ -444,7 +564,7 @@ class MultiTenantPolicy:
         jobs = sorted(jobs, key=lambda j: (j.arrival_seq, j.key))
         victims = set(select_preemptions(jobs, self.capacity))
         runnable = [j for j in jobs if j.key not in victims]
-        alloc = fair_shares(runnable, self.capacity)
+        alloc = fair_shares(runnable, self.capacity, self.domains)
         placements = place(runnable, alloc, self.domains, cfg)
 
         # Reshard-aware gating: revert changes whose expected token gain
@@ -499,7 +619,7 @@ class MultiTenantPolicy:
                     free[new.domain] -= new.chips
 
         decisions: List[Decision] = []
-        preemptions = migrations = 0
+        preemptions = migrations = mem_rejections = 0
         for j in jobs:
             if j.key in victims:
                 if j.current is not None:
@@ -516,11 +636,19 @@ class MultiTenantPolicy:
             new = placements.get(j.key)
             cur = j.current
             if new is None:
+                reason = "no domain fits the allocation"
+                if not any(job_fits_domain(j, d) for d in self.domains):
+                    mem_rejections += 1
+                    reason = (
+                        f"{j.fit_source} HBM peak "
+                        f"{int(j.hbm_peak_bytes or 0)} B exceeds every "
+                        f"domain's per-chip HBM (memory infeasible)"
+                    )
                 decisions.append(Decision(
                     j.key, "preempt" if cur is not None else "queue",
                     None,
                     cost_seconds=cfg.restart_seconds if cur else 0.0,
-                    reason="no domain fits the allocation",
+                    reason=reason,
                 ))
                 if cur is not None:
                     preemptions += 1
@@ -545,7 +673,8 @@ class MultiTenantPolicy:
                            else "checkpoint-restart resize",
                 ))
         return Plan(decisions, preemptions=preemptions,
-                    migrations=migrations)
+                    migrations=migrations,
+                    mem_rejections=mem_rejections)
 
 
 # --------------------------------------------------------------------------
@@ -606,6 +735,29 @@ def classify_intensity(job) -> float:
     return resolve_intensity(job)[0]
 
 
+def resolve_hbm_peak(job) -> Tuple[Optional[float], str]:
+    """Per-device peak HBM bytes of a TrainJob plus provenance, feeding
+    the placement feasibility mask (``job_fits_domain``).
+
+    Precedence mirrors ``resolve_intensity``: (1) MEASURED
+    ``kftpu.io/hbm-peak-bytes`` annotation (a live allocator sample, or
+    the job's own audited ratchet value stamped by CI) ->
+    ``"measured"``; (2) the committed mem-family baseline's worst
+    audited entry for the job's workload class -- for serving jobs that
+    set includes the ``kv_cache_plan`` padded total -> ``"static"``;
+    (3) nothing known -> ``(None, "none")``, the permissive mask."""
+    measured = job.metadata.annotations.get(ANN_HBM_PEAK)
+    if measured:
+        try:
+            return float(measured), "measured"
+        except ValueError:
+            pass  # malformed annotation: fall through to the audit
+    est = static_hbm_peak(classify_workload(job))
+    if est is not None:
+        return est, "static"
+    return None, "none"
+
+
 def sched_job_from_spec(job, arrival_seq: int = 0,
                         current: Optional[Placement] = None,
                         measured_tok_s: Optional[float] = None) -> SchedJob:
@@ -625,6 +777,7 @@ def sched_job_from_spec(job, arrival_seq: int = 0,
     else:
         min_chips = max_chips = replicas * per_worker
     intensity, intensity_source = resolve_intensity(job)
+    hbm_peak, fit_source = resolve_hbm_peak(job)
     sj = SchedJob(
         key=job.key,
         tenant=getattr(sched, "tenant", None) or job.namespace,
@@ -637,6 +790,8 @@ def sched_job_from_spec(job, arrival_seq: int = 0,
         arrival_seq=arrival_seq,
         reshardable=bool(el is not None and el.reshard_in_place),
         current=current,
+        hbm_peak_bytes=hbm_peak,
+        fit_source=fit_source,
     )
     if measured_tok_s is not None and current is not None \
             and current.chips > 0:
@@ -851,15 +1006,18 @@ def estimate_solo_rate(job: SchedJob, chips: Optional[int] = None) -> float:
 
 
 __all__ = [
-    "ANN_COLLECTIVE_PROFILE", "ANN_COMM_BYTES", "ANN_WORKLOAD_CLASS",
+    "ANN_COLLECTIVE_PROFILE", "ANN_COMM_BYTES", "ANN_HBM_PEAK",
+    "ANN_WORKLOAD_CLASS",
     "CENSUS_INTENSITY",
     "ClusterScheduler", "Decision", "Domain", "MultiTenantPolicy",
     "Placement", "Plan", "PolicyConfig", "SchedJob", "WORKLOAD_CLASSES",
+    "chip_hbm_bytes",
     "classify_intensity", "classify_workload", "comm_bytes_for_intensity",
     "contention_factor",
     "estimate_solo_rate", "fair_shares", "intensity_from_comm_bytes",
-    "jains_index", "job_rate",
-    "place", "preemption_rank", "resolve_intensity", "scale_efficiency",
-    "sched_job_from_spec",
-    "select_preemptions", "waterfill", "weighted_fairness_index",
+    "jains_index", "job_fits_domain", "job_rate",
+    "place", "preemption_rank", "resolve_hbm_peak", "resolve_intensity",
+    "scale_efficiency", "sched_job_from_spec",
+    "select_preemptions", "static_hbm_peak", "waterfill",
+    "weighted_fairness_index",
 ]

@@ -20,11 +20,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding
 
-HBM_BYTES = {
-    "v5e": 16 * 1024**3,
-    "v5p": 95 * 1024**3,
-    "v4": 32 * 1024**3,
-}
+from kubeflow_tpu.chips import HBM_BYTES  # noqa: F401
 
 
 def _axes_size(mesh, entry) -> int:

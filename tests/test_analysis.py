@@ -264,6 +264,90 @@ def test_async_blocking_rule_fires_and_spares_sync_defs(tmp_path):
     assert "KT-ASYNC01" not in rules_of(quiet)
 
 
+def test_loop_alloc_rule_fires_in_hot_path(tmp_path):
+    findings = lint_source(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "def decode_step(toks):\n"
+        "    outs = None\n"
+        "    for t in toks:\n"
+        "        scratch = jnp.zeros((8, 128))\n"
+        "        outs = scratch\n"
+        "    return outs\n"
+    ))
+    assert "KT-MEM01" in rules_of(findings)
+    assert any("hoist" in f.message for f in findings)
+
+
+def test_loop_alloc_rule_quiet_outside_hot_paths_and_loops(tmp_path):
+    quiet = lint_source(tmp_path, (
+        "import jax.numpy as jnp\n"
+        # Setup code: not a decode/step hot path, loop allocs are fine.
+        "def build_tables(n):\n"
+        "    for i in range(n):\n"
+        "        t = jnp.zeros((8,))\n"
+        # Hot path, but the buffer is hoisted out of the loop.
+        "def decode_step(toks):\n"
+        "    buf = jnp.zeros((8, 128))\n"
+        "    for t in toks:\n"
+        "        buf = buf.at[0].add(t)\n"
+        "    return buf\n"
+    ))
+    assert "KT-MEM01" not in rules_of(quiet)
+
+
+def test_container_leak_rule_fires_on_unbounded_device_append(tmp_path):
+    findings = lint_source(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "_TRACE_BUFFERS = []\n"
+        "def record(x):\n"
+        "    _TRACE_BUFFERS.append(jnp.asarray(x))\n"
+    ))
+    assert "KT-MEM01" not in rules_of(findings)
+    assert "KT-MEM02" in rules_of(findings)
+    assert any("_TRACE_BUFFERS" in f.message for f in findings)
+
+
+def test_container_leak_rule_quiet_when_bounded_or_host_values(tmp_path):
+    quiet = lint_source(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "_SAMPLES = []\n"
+        "_RING = []\n"
+        # Host scalar appended: nothing pins HBM.
+        "def record(x):\n"
+        "    _SAMPLES.append(float(x))\n"
+        # Device values, but the container shrinks in this module.
+        "def push(x):\n"
+        "    _RING.append(jnp.asarray(x))\n"
+        "    if len(_RING) > 8:\n"
+        "        _RING.pop(0)\n"
+    ))
+    assert "KT-MEM02" not in rules_of(quiet)
+
+
+def test_mem_rules_disable_requires_justification(tmp_path):
+    loop = (
+        "import jax.numpy as jnp\n"
+        "def decode_step(toks):\n"
+        "    for t in toks:\n"
+        "        s = jnp.zeros((8,)){tag}\n"
+    )
+    ok = loop.format(tag="  # kt-lint: disable=KT-MEM01 -- warmup only")
+    assert "KT-MEM01" not in rules_of(lint_source(tmp_path, ok))
+    bare = loop.format(tag="  # kt-lint: disable=KT-MEM01")
+    assert "KT-MEM01" in rules_of(lint_source(tmp_path, bare))
+
+    leak = (
+        "import jax.numpy as jnp\n"
+        "_BUF = []\n"
+        "def record(x):\n"
+        "    _BUF.append(jnp.asarray(x)){tag}\n"
+    )
+    ok = leak.format(tag="  # kt-lint: disable=KT-MEM02 -- test fixture")
+    assert "KT-MEM02" not in rules_of(lint_source(tmp_path, ok))
+    bare = leak.format(tag="  # kt-lint: disable=KT-MEM02")
+    assert "KT-MEM02" in rules_of(lint_source(tmp_path, bare))
+
+
 # ---------------------------------------------------------------------------
 # Tier B non-vacuity: deliberately-broken programs must be caught.
 # ---------------------------------------------------------------------------
@@ -522,6 +606,57 @@ def test_cli_only_routes_families(monkeypatch, capsys, tmp_path):
     capsys.readouterr()
 
 
+def test_cli_only_unknown_family_exits_two(capsys):
+    # `--only` validates against the known family set at the argparse
+    # layer: exit code 2 and the valid names in the usage message.
+    from kubeflow_tpu.cli import main as cli_main
+
+    with pytest.raises(SystemExit) as exc:
+        cli_main.main(["analyze", "--only", "bogus"])
+    assert exc.value.code == 2
+    err = capsys.readouterr().err
+    for family in analysis.FAMILIES:
+        assert family in err
+
+
+def test_cli_only_mem_smoke(monkeypatch, capsys):
+    # Real end-to-end `--only mem` run, slimmed to the mnist entry (no
+    # seq variants, no serving engine) so tier-1 stays fast.  The peak
+    # must land exactly on the committed ratchet.
+    from kubeflow_tpu.analysis import memcheck
+    from kubeflow_tpu.cli import main as cli_main
+
+    monkeypatch.setattr(
+        jaxpr_audit, "TRAIN_TASKS",
+        {"mnist": jaxpr_audit.TRAIN_TASKS["mnist"]})
+    monkeypatch.setattr(memcheck, "SEQ_VARIANTS", ())
+    rc = cli_main.main(["analyze", "--only", "mem", "--no-serving",
+                        "--strict", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0 and doc["clean"] is True
+    assert doc["metrics"] == {"mem.peak_bytes.train.mnist": 7486976.0}
+
+
+def test_cli_inflated_mem_peak_trips_ratchet(monkeypatch, capsys, tmp_path):
+    # The planted un-donated step from test_memcheck doubles the mnist
+    # peak; here the same number fails the strict CLI gate.
+    from kubeflow_tpu.cli import main as cli_main
+
+    base = tmp_path / "b.json"
+    base.write_text(json.dumps({
+        "counts": {},
+        "metrics": {"mem.peak_bytes.train.mnist": 7486976.0},
+    }))
+    monkeypatch.setattr(
+        analysis, "run_analysis",
+        lambda **kw: ([], {"mem.peak_bytes.train.mnist": 13024768.0}))
+    rc = cli_main.main(["analyze", "--strict", "--json", "--only", "mem",
+                        "--baseline", str(base)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["clean"] is False
+    assert "mem.peak_bytes.train.mnist" in doc["regressed_metrics"]
+
+
 def test_cli_sarif_output_matches_golden(monkeypatch, capsys, tmp_path):
     """SARIF 2.1.0 is an interchange contract: the emitted document is
     pinned byte-for-byte (modulo JSON parse) against a committed golden
@@ -538,16 +673,41 @@ def test_cli_sarif_output_matches_golden(monkeypatch, capsys, tmp_path):
     )
     soft = Finding(rule="KT-IMPORT01", path="kubeflow_tpu/util.py",
                    line=3, message="unused import 'os'")
+    mem_hard = Finding(
+        rule="KT-MEM-RESHARD", path="serve.tp2.reshard_tp1", line=0,
+        hard=True,
+        message=("planned resplit peaks at 1269760 bytes/device but the "
+                 "declared HBM budget is 1048576: the migration would "
+                 "OOM mid-flight -- shrink the plan or stage through a "
+                 "bigger chip type"),
+    )
+    mem_loop = Finding(
+        rule="KT-MEM01", path="kubeflow_tpu/serving/engine.py", line=42,
+        message=("jnp.zeros() inside a Python loop in hot path "
+                 "'decode_step' allocates a fresh HBM buffer every "
+                 "iteration -- hoist it out of the loop or carry one "
+                 "buffer updated with .at[]"),
+    )
+    mem_leak = Finding(
+        rule="KT-MEM02", path="kubeflow_tpu/obs/metrics.py", line=7,
+        message=("device value appended to module/class-level container "
+                 "'_SAMPLES' that never shrinks in this module: each "
+                 "retained reference pins an HBM buffer forever -- "
+                 "bound the container or drop references after use"),
+    )
     base = tmp_path / "b.json"
     base.write_text(json.dumps({
-        "counts": {"KT-IMPORT01:kubeflow_tpu/util.py": 1}, "metrics": {},
+        "counts": {"KT-IMPORT01:kubeflow_tpu/util.py": 1,
+                   "KT-MEM01:kubeflow_tpu/serving/engine.py": 1},
+        "metrics": {},
     }))
     out = tmp_path / "out.sarif.json"
     rc, stdout = _run_cli(
-        monkeypatch, capsys, [hard, soft], {},
+        monkeypatch, capsys, [hard, soft, mem_hard, mem_loop, mem_leak],
+        {},
         ["--only", "astlint", "--baseline", str(base),
          "--sarif", str(out)])
-    assert rc == 0 and "2 result(s)" in stdout
+    assert rc == 0 and "5 result(s)" in stdout
     golden = pathlib.Path(REPO_ROOT, "tests", "data",
                           "analyze_sarif_golden.json")
     assert json.loads(out.read_text()) == json.loads(golden.read_text())

@@ -1,0 +1,201 @@
+"""Tier B.3 memcheck: the jaxpr live-range walker's peak-residency
+model, hand-validated against closed-form byte counts (ISSUE 17).
+
+Covers the walker conventions on synthetic programs (immortal
+non-donated arguments, donation credit, output pricing), the two
+hand-validated real entry points the acceptance criteria name (the
+mnist train step and the tp=1 KV insert path), the planted un-donated
+regression that must trip the ``mem.peak_bytes.*`` ratchet, and the
+KT-MEM-RESHARD budget gate.
+"""
+
+import dataclasses as dc
+import math
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.analysis import report
+from kubeflow_tpu.analysis.memcheck import (
+    METRIC_PREFIX,
+    check_reshard_budget,
+    jaxpr_mem_model,
+)
+from kubeflow_tpu.parallel.memory import kv_cache_plan, reshard_peak_bytes
+
+TILE = 8 * 128 * 4  # one padded (8, 128) f32 tile
+
+
+# ---------------------------------------------------------------------------
+# Walker conventions on synthetic programs (closed-form, milliseconds).
+# ---------------------------------------------------------------------------
+
+def test_chain_peak_holds_immortal_args_plus_live_intermediates():
+    # x -> a -> out with x non-donated: the caller still owns x, so it
+    # stays resident for the whole walk.  Peak is the add, where x, a
+    # and the output tile are all live at once.
+    def f(x):
+        a = x * 2.0
+        return a + 1.0
+
+    x = jnp.zeros((8, 128), jnp.float32)
+    m = jaxpr_mem_model(f, (x,), "syn.chain")
+    assert m.arg_bytes == TILE
+    assert m.peak_bytes == 3 * TILE
+    # Plain functions expose no lowering: credit is withheld, noted.
+    assert m.donated_credited == 0
+    assert any("donation" in n for n in m.notes)
+
+
+def test_donation_credit_saves_exactly_one_buffer():
+    # buf * 0.5 + y: donating buf lets the output reuse its pages, so
+    # the donated walk peaks one tile lower than the un-donated one.
+    def upd(buf, y):
+        return buf * 0.5 + y
+
+    tile = 128 * 128 * 4
+    buf = jnp.zeros((128, 128), jnp.float32)
+    y = jnp.zeros((128, 128), jnp.float32)
+    donated = jax.jit(upd, donate_argnums=(0,))
+    plain = jax.jit(upd)
+    md = jaxpr_mem_model(donated, (buf, y), "syn.don", jitted=donated)
+    mp = jaxpr_mem_model(plain, (buf, y), "syn.plain", jitted=plain)
+    assert md.donated_credited == 1 and mp.donated_credited == 0
+    assert md.peak_bytes == 3 * tile      # y + out + transient
+    assert mp.peak_bytes == 4 * tile      # buf held live as well
+    assert mp.peak_bytes - md.peak_bytes == tile
+
+
+# ---------------------------------------------------------------------------
+# Hand-validation 1: the mnist train step (acceptance criterion).
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mnist_model():
+    from kubeflow_tpu.analysis._trace_cache import train_setup
+
+    _task, state, step, jitted, batch, mesh = train_setup("mnist")
+    divisor = math.prod(dict(mesh.shape).values()) or 1
+    return {
+        "step": step,
+        "jitted": jitted,
+        "args": (state, *batch),
+        "divisor": divisor,
+        "model": jaxpr_mem_model(jitted, (state, *batch),
+                                 "train.mnist", jitted=jitted,
+                                 divisor=divisor),
+    }
+
+
+def test_mnist_arg_bytes_closed_form(mnist_model):
+    # Per-device boundary bytes, from the model shapes alone.  Params,
+    # opt state and step counter are replicated; each f32 leaf pads the
+    # minor dim to 128 lanes and collapsed majors to the 8-row sublane
+    # tile, so every small leaf floors at 4096 bytes.
+    param_group = (
+        4096        # conv1 b (32,)
+        + 8192      # conv1 w (3,3,1,32) -> (9, 32) -> (16, 128)
+        + 4096      # conv2 b (64,)
+        + 147456    # conv2 w (3,3,32,64) -> (288, 64) -> (288, 128)
+        + 4096      # dense1 b (128,)
+        + 1605632   # dense1 w (3136, 128)
+        + 4096      # dense2 b (10,)
+        + 65536     # dense2 w (128, 10) -> (128, 128)
+    )
+    assert param_group == 1843200
+    state_bytes = (
+        2 * 4096            # step counter + loss scale (scalars)
+        + 3 * param_group   # params + adam mu + adam nu
+    )
+    batch_bytes = (
+        401408   # images (8,28,28,1) f32, batch-sharded 8 ways -> (1,28,28,1)
+        + 4096   # labels (8,) int32 -> (1,) per device
+    )
+    assert mnist_model["model"].arg_bytes == state_bytes + batch_bytes
+    assert mnist_model["model"].arg_bytes == 5943296
+
+
+def test_mnist_peak_matches_committed_baseline(mnist_model):
+    base = report.load_baseline(None)["metrics"]
+    key = METRIC_PREFIX + "train.mnist"
+    assert mnist_model["model"].peak_bytes == base[key] == 7486976
+    # Every TrainState leaf is donated: 2 scalars + 3 * 8 param-tree
+    # leaves credited against the new state's residency.
+    assert mnist_model["model"].donated_credited == 26
+
+
+def test_undonated_train_step_trips_peak_ratchet(mnist_model):
+    # Planted regression: strip donation from the same step.  The old
+    # TrainState can no longer be consumed in place, so the walker holds
+    # both generations live and the peak must exceed the ratchet.
+    jitted = mnist_model["jitted"]
+    fn = getattr(jitted, "__wrapped__", mnist_model["step"])
+    undonated = jax.jit(fn)
+    m = jaxpr_mem_model(undonated, mnist_model["args"], "train.mnist",
+                        jitted=undonated,
+                        divisor=mnist_model["divisor"])
+    assert m.donated_credited == 0
+    assert m.peak_bytes > mnist_model["model"].peak_bytes
+    key = METRIC_PREFIX + "train.mnist"
+    cmp = report.compare([], {key: float(m.peak_bytes)},
+                         report.load_baseline(None))
+    assert not cmp.clean and key in cmp.regressed_metrics
+    assert cmp.regressed_metrics[key] == (7486976.0, float(m.peak_bytes))
+
+
+# ---------------------------------------------------------------------------
+# Hand-validation 2: the KV insert path (acceptance criterion).
+# ---------------------------------------------------------------------------
+
+def test_kv_insert_arg_bytes_closed_form():
+    from kubeflow_tpu.models.llama import PRESETS
+    from kubeflow_tpu.serving.engine import GenerationEngine
+
+    cfg = dc.replace(PRESETS["llama-tiny"], max_seq=64)
+    eng = GenerationEngine(config=cfg, max_slots=1, decode_block=4)
+    eng.generate([3], max_new_tokens=2)
+    reg = eng._jit_registry
+
+    tokens = jnp.zeros((1, 32), jnp.int32)
+    _, k_seq, v_seq = eng._prefill(tokens, jnp.asarray([5], jnp.int32))
+    slots = jnp.asarray([0], jnp.int32)
+    m = jaxpr_mem_model(
+        reg["insert"], (eng.cache_k, eng.cache_v, k_seq, v_seq, slots),
+        "serve.tp1.insert", jitted=reg["insert"], divisor=1)
+
+    # llama-tiny, max_seq=64, 1 slot: caches are (layers=2, 1, 64, 2
+    # heads, 16 head_dim) bf16 -> majors collapse to 256, head_dim pads
+    # 16 -> 128 lanes: 256*128*2 bytes.  The prefill k/v stripes are
+    # (2, 1, 32, 2, 16) -> 128*128*2.  Slot ids are one padded int32
+    # vector.
+    cache = 256 * 128 * 2
+    stripe = 128 * 128 * 2
+    assert m.arg_bytes == 2 * cache + 2 * stripe + 4096 == 200704
+    # Both caches are donated (updated in place slot-wise).
+    assert m.donated_credited == 2
+    assert m.peak_bytes == 212992
+    # kv_cache_plan and the walker agree on the padded cache total.
+    assert kv_cache_plan(cfg, 1)["padded_bytes"] == 2 * cache
+
+
+# ---------------------------------------------------------------------------
+# KT-MEM-RESHARD: the resplit budget gate.
+# ---------------------------------------------------------------------------
+
+def test_reshard_over_budget_is_a_hard_finding():
+    src = [{0: 600, 1: 600}]
+    dst = [{0: 1200}]
+    # Staged consolidation: device 0 holds its source shard plus the
+    # full destination copy mid-flight.
+    assert reshard_peak_bytes(src, dst) == 1800
+    findings, peak = check_reshard_budget(src, dst, "serve.tp2.to_tp1",
+                                          hbm_budget_bytes=1000)
+    assert peak == 1800
+    assert [f.rule for f in findings] == ["KT-MEM-RESHARD"]
+    assert findings[0].hard
+    assert "OOM mid-flight" in findings[0].message
+
+    clean, _ = check_reshard_budget(src, dst, "serve.tp2.to_tp1",
+                                    hbm_budget_bytes=1 << 30)
+    assert clean == []

@@ -409,6 +409,107 @@ class TestIntensityResolution:
         assert classify_intensity(job) == resolve_intensity(job)[0]
 
 
+# ---------------------------------------------------------------------------
+# Memory-feasibility mask (ISSUE 17: memcheck peaks gate placement).
+# ---------------------------------------------------------------------------
+
+class TestMemoryFeasibility:
+    def small(self):
+        # Synthetic small-HBM generation: 1 KiB per chip.
+        return Domain("small", 8, chip_type="toy-1k", hbm_bytes=1 << 10)
+
+    def big(self):
+        return Domain("big", 8, hbm_bytes=1 << 30)
+
+    def mj(self, key, peak, **kw):
+        import dataclasses
+
+        return dataclasses.replace(
+            sj(key, **kw), hbm_peak_bytes=float(peak),
+            fit_source="measured")
+
+    def test_job_fits_domain_mask_and_permissive_defaults(self):
+        from kubeflow_tpu.controller.scheduler import (
+            chip_hbm_bytes,
+            job_fits_domain,
+        )
+
+        assert not job_fits_domain(self.mj("a", 2048), self.small())
+        assert job_fits_domain(self.mj("a", 2048), self.big())
+        # Unaudited job / unknown chip type: the mask stays permissive.
+        assert job_fits_domain(sj("a"), self.small())
+        unknown = Domain("d", 8, chip_type="no-such-chip")
+        assert unknown.hbm_per_chip is None
+        assert job_fits_domain(self.mj("a", 1 << 50), unknown)
+        # Typed domains inherit the chip table: a v5e chip is 16 GiB.
+        assert chip_hbm_bytes("v5e") == 16 * (1 << 30)
+        assert Domain("d", 8).hbm_per_chip == 16 * (1 << 30)
+
+    def test_fair_shares_zero_for_job_fitting_nowhere(self):
+        import dataclasses
+
+        # a's chips are not withheld from its peer: b water-fills to
+        # the full domain while a (fits nowhere) gets zero.
+        a = self.mj("a", 2048, tenant="ta")
+        b = sj("b", tenant="tb")
+        alloc = fair_shares([a, b], 8, domains=[self.small()])
+        assert alloc == {"a": 0, "b": 8}
+        # Same pair without the mask splits evenly.
+        plain = dataclasses.replace(a, hbm_peak_bytes=None)
+        assert fair_shares([plain, b], 8,
+                           domains=[self.small()]) == {"a": 4, "b": 4}
+
+    def test_plan_rejects_overweight_job_as_memory_infeasible(self):
+        plan = MultiTenantPolicy([self.small()]).plan(
+            [self.mj("a", 2048, tenant="ta"), sj("b", tenant="tb")])
+        assert plan.mem_rejections == 1
+        assert plan.placements["a"] is None
+        assert plan.placements["b"].chips == 8
+        (queue,) = [d for d in plan.decisions if d.job == "a"]
+        assert queue.action == "queue"
+        assert "memory infeasible" in queue.reason
+        assert queue.reason.startswith("measured HBM peak 2048 B")
+
+    def test_place_skips_infeasible_domain_and_stamps_fit_source(self):
+        plan = MultiTenantPolicy([self.small(), self.big()]).plan(
+            [self.mj("a", 2048)])
+        assert plan.mem_rejections == 0
+        placement = plan.placements["a"]
+        assert placement.domain == "big"
+        assert placement.fit_source == "measured"
+
+    def test_resolve_hbm_peak_measured_beats_static(self):
+        from kubeflow_tpu.controller.scheduler import (
+            ANN_HBM_PEAK,
+            resolve_hbm_peak,
+            sched_job_from_spec,
+            static_hbm_peak,
+        )
+
+        job = make_job(replicas=4)
+        static = static_hbm_peak("train")
+        assert static is not None and static > 0
+        assert resolve_hbm_peak(job) == (static, "static")
+        # A live allocator sample (or CI-stamped audit) wins.
+        job.metadata.annotations[ANN_HBM_PEAK] = str(6 << 20)
+        assert resolve_hbm_peak(job) == (float(6 << 20), "measured")
+        view = sched_job_from_spec(job)
+        assert view.hbm_peak_bytes == float(6 << 20)
+        assert view.fit_source == "measured"
+
+    def test_malformed_hbm_annotation_falls_to_static(self):
+        from kubeflow_tpu.controller.scheduler import (
+            ANN_HBM_PEAK,
+            resolve_hbm_peak,
+            static_hbm_peak,
+        )
+
+        job = make_job(replicas=4)
+        job.metadata.annotations[ANN_HBM_PEAK] = "lots"
+        assert resolve_hbm_peak(job) == (static_hbm_peak("train"),
+                                         "static")
+
+
 SCHED_BASE = {
     "goodput_vs_fifo_floor": 1.3,
     "contention_gain_floor": 1.05,
