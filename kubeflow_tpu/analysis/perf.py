@@ -548,6 +548,63 @@ def _check_sched(sbase: dict, sched: dict, artifact: str,
     return findings
 
 
+def _check_spec(pbase: dict, spec: dict, artifact: str,
+                measured: Dict[str, float]) -> List[Finding]:
+    """KT-PERF-SPEC: the trained-draft speculative-decoding A/B
+    (bench_serving.py --phase spec_ab).
+
+    The speculation contract: the distilled draft's acceptance rate on
+    the decode-bound arm stays above ``acceptance_floor``, the
+    end-to-end speedup of the draft arm over the spec-off arm stays
+    above ``speedup_floor``, and -- non-negotiably -- the greedy parity
+    probe holds (``require_token_parity``): speculation that changes
+    sampled tokens is a correctness bug wearing a perf hat, and no
+    speedup excuses it."""
+    findings: List[Finding] = []
+
+    def _floor(metric: str, key: str) -> None:
+        limit = pbase.get(key)
+        if limit is None:
+            return
+        val = spec.get(metric)
+        if val is None:
+            findings.append(Finding(
+                rule="KT-PERF-SPEC", path=artifact, line=0, hard=True,
+                message=(
+                    f"spec_ab.{metric}: missing from {artifact} "
+                    f"({key}={limit})"
+                ),
+            ))
+            return
+        measured[f"spec.{metric}"] = float(val)
+        if val < limit:
+            findings.append(Finding(
+                rule="KT-PERF-SPEC", path=artifact, line=0, hard=True,
+                message=(
+                    f"spec_ab.{metric} = {val} below ratchet floor "
+                    f"{limit} ({artifact})"
+                ),
+            ))
+
+    _floor("acceptance", "acceptance_floor")
+    _floor("speedup", "speedup_floor")
+
+    if pbase.get("require_token_parity"):
+        parity = spec.get("token_parity")
+        if parity is not True:
+            findings.append(Finding(
+                rule="KT-PERF-SPEC", path=artifact, line=0, hard=True,
+                message=(
+                    f"spec_ab.token_parity = {parity!r} in {artifact}: "
+                    f"the draft arm's greedy outputs diverged from the "
+                    f"spec-off engine -- speculation must be lossless"
+                ),
+            ))
+        else:
+            measured["spec.token_parity"] = 1.0
+    return findings
+
+
 def check_perf(
     baseline: dict,
     *,
@@ -622,6 +679,52 @@ def check_perf(
                         ),
                     ))
 
+    # -- mixed-workload tok/s floor (continuous chunked prefill) -----------
+    mixed_floor = (baseline.get("serving") or {}).get("tok_s_floor_mixed")
+    if mixed_floor is not None:
+        doc, artifact = serving_bench(root)
+        if doc is not None:
+            mixed = doc["extra"].get("throughput_mixed")
+            toks = (mixed or {}).get("tokens_per_sec") \
+                if isinstance(mixed, dict) else None
+            if toks is None:
+                findings.append(Finding(
+                    rule="KT-PERF-TOKS", path=artifact, line=0, hard=True,
+                    message=(
+                        f"no extra.throughput_mixed row in {artifact} "
+                        f"(mixed floor {mixed_floor}) -- the mixed bench "
+                        f"vanished"
+                    ),
+                ))
+            else:
+                measured["serving.tok_s.mixed"] = float(toks)
+                if toks < mixed_floor:
+                    findings.append(Finding(
+                        rule="KT-PERF-TOKS", path=artifact, line=0, hard=True,
+                        message=(
+                            f"mixed workload: {toks} tok/s below ratchet "
+                            f"floor {mixed_floor} ({artifact}) -- the "
+                            f"chunked-prefill continuous-batching win "
+                            f"regressed"
+                        ),
+                    ))
+                itl_ceiling = (baseline.get("serving") or {}).get(
+                    "mixed_itl_p99_ceiling_ms")
+                itl = (mixed or {}).get("itl_p99_ms")
+                if itl_ceiling is not None and itl is not None:
+                    measured["serving.itl_p99.mixed"] = float(itl)
+                    if itl > itl_ceiling:
+                        findings.append(Finding(
+                            rule="KT-PERF-TOKS", path=artifact, line=0,
+                            hard=True,
+                            message=(
+                                f"mixed workload: decode itl_p99 {itl} ms "
+                                f"above ceiling {itl_ceiling} ms "
+                                f"({artifact}) -- admission is stalling "
+                                f"decode slots (chunk budget regressed)"
+                            ),
+                        ))
+
     # -- fleet (multi-replica data plane) floors ---------------------------
     fleet_base = baseline.get("fleet") or {}
     if fleet_base:
@@ -657,6 +760,24 @@ def check_perf(
             else:
                 findings.extend(_check_chaos(cbase, ch, artifact,
                                              measured))
+
+    # -- trained-draft speculative decoding (spec_ab A/B) -------------------
+    pbase = baseline.get("spec") or {}
+    if pbase:
+        doc, artifact = serving_bench(root)
+        if doc is not None:
+            spec = doc["extra"].get("spec_ab")
+            if not isinstance(spec, dict):
+                findings.append(Finding(
+                    rule="KT-PERF-SPEC", path=artifact, line=0, hard=True,
+                    message=(
+                        f"no extra.spec_ab section in {artifact} (spec "
+                        f"floors set) -- the spec-decode A/B vanished"
+                    ),
+                ))
+            else:
+                findings.extend(_check_spec(pbase, spec, artifact,
+                                            measured))
 
     # -- serving-plane kv/prefix reshard (resize A/B) bounds ----------------
     kbase = baseline.get("kv_reshard") or {}

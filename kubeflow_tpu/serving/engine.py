@@ -864,7 +864,7 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
                  klen: int, filtered: bool, want_lp: bool, w: dict,
                  cache_k, cache_v, tokens, lengths, chunk_toks,
                  chunk_offs, chunk_clens, chunk_slots, rng, temps,
-                 top_ks, top_ps, mask=None):
+                 top_ks, top_ps, nonces, mask=None):
     """Mixed batch in ONE device program (vLLM's chunked prefill, shaped
     for XLA): n_steps decode steps each fused with one prefill chunk,
     then m_tail chunk-only steps that finish the prompts without
@@ -914,8 +914,20 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
     shaped hot loop. Any change to the shared math (RoPE, GQA reshape,
     write-then-attend order, norm placement) must land in all three.
 
+    Decode-lane sampling keys are derived per row and per position --
+    fold_in(fold_in(rng, nonces[b]), position), the same scheme as
+    _decode_block -- so a decode token's draw is a pure function of
+    (request, position): identical whether the step ran in a pure
+    decode block, a fused dispatch, or any chunk partitioning of the
+    prompt stream. That invariance is what lets the continuous
+    chunked-prefill scheduler chain fused dispatches through the lane
+    deque while staying bit-identical to the sequential path.
+
     Returns (dec_outs [n_steps, B] or logprob tuple, chunk_logits
-    [K, V] f32, caches).
+    [K, V] f32, caches, last_tokens [B], last_positions [B]); the
+    final decode carry rides back as DEVICE arrays so a chained next
+    block (fused or pure decode) consumes them without a host round
+    trip.
     """
 
     b = tokens.shape[0]
@@ -956,7 +968,7 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
 
     def mixed_step(carry, xs):
         ck0, cv0, toks, lens, offs, fin_logits = carry
-        step_rng, ctoks, cclens = xs
+        ctoks, cclens = xs
         dec_pos = lens[:, None]                                  # [B,1]
         dec_mask = jnp.arange(smax)[None, None, :] <= dec_pos[:, :, None]
         c_pos = offs[:, None] + jnp.arange(c)[None, :]           # [K,C]
@@ -994,11 +1006,16 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
         )
         x_d = _rms(x_d, w["final_scale"], cfg.norm_eps)
         d_logits = _lm_logits(x_d[:, 0].astype(jnp.float32), w["lm_head"])
+        keys = jax.vmap(
+            lambda nonce, pos: jax.random.fold_in(
+                jax.random.fold_in(rng, nonce), pos
+            )
+        )(nonces, lens)
         # Like _decode_block: mask only sound at n_steps=1 (caller
         # enforces when constrained lanes are active).
-        nxt = _sample(d_logits, step_rng, temps,
-                      top_ks if filtered else None,
-                      top_ps if filtered else None, mask)
+        nxt = _sample_rows(d_logits, keys, temps,
+                           top_ks if filtered else None,
+                           top_ps if filtered else None, mask)
         fin_logits = chunk_logits_latch(x_c, cclens, fin_logits)
         out = (nxt, *_logprob_outputs(d_logits, nxt)) if want_lp else nxt
         return (ck1, cv1, nxt, lens + 1, offs + cclens, fin_logits), out
@@ -1023,12 +1040,11 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
         fin_logits = chunk_logits_latch(x_c, cclens, fin_logits)
         return (ck1, cv1, offs + cclens, fin_logits), None
 
-    rngs = jax.random.split(rng, n_steps)
     fin0 = jnp.zeros((k_rows, cfg.vocab_size), jnp.float32)
-    (ck, cv, _, _, offs, fin_logits), outs = jax.lax.scan(
+    (ck, cv, last, lens, offs, fin_logits), outs = jax.lax.scan(
         mixed_step,
         (cache_k, cache_v, tokens, lengths, chunk_offs, fin0),
-        (rngs, chunk_toks[:n_steps], chunk_clens[:n_steps]),
+        (chunk_toks[:n_steps], chunk_clens[:n_steps]),
     )
     if m_tail:
         (ck, cv, _, fin_logits), _ = jax.lax.scan(
@@ -1036,7 +1052,7 @@ def _fused_block(cfg: LlamaConfig, n_steps: int, m_tail: int, c: int,
             (ck, cv, offs, fin_logits),
             (chunk_toks[n_steps:], chunk_clens[n_steps:]),
         )
-    return outs, fin_logits, ck, cv
+    return outs, fin_logits, ck, cv, last, lens
 
 
 # ---------------------------------------------------------------------------
@@ -1175,14 +1191,96 @@ def _ngram_draft(hist, lens, k: int):
     return jnp.take_along_axis(hist, jnp.minimum(gpos, smax - 1), axis=1)
 
 
+def _draft_forward(dcfg: LlamaConfig, dw: dict, toks, positions, valid):
+    """One full forward of the DRAFT model over a [B, W] token window,
+    returning the last position's logits [B, V]. Cache-free: the window
+    is tiny and the draft is small, so recomputing self-attention per
+    draft step costs less than keeping a second KV cache consistent
+    with speculative rollbacks (a rejected draft would strand wrong
+    rows in it). ``positions`` [B, W] are ABSOLUTE (RoPE matches how
+    the draft was trained on absolute positions); ``valid`` [B, W]
+    masks left-padding for rows shorter than the window."""
+    b, wlen = toks.shape
+    freqs = rope_frequencies(dcfg.head_dim, dcfg.max_seq, dcfg.rope_theta)
+    x = _embed_rows(dw, toks, jnp.dtype(dcfg.dtype))          # [B,W,H]
+    causal = jnp.arange(wlen)[None, :] <= jnp.arange(wlen)[:, None]
+    mask = causal[None, :, :] & valid[:, None, :]             # [B,W,W]
+
+    def layer_body(x, xs):
+        lp, _ = xs
+        attn = lp["attn"]
+        h = _rms(x, lp["attn_norm"]["scale"], dcfg.norm_eps)
+        q = _pj("bsh,hnd->bsnd", h, attn["q_proj"]["kernel"])
+        k = _pj("bsh,hnd->bsnd", h, attn["k_proj"]["kernel"])
+        v = _pj("bsh,hnd->bsnd", h, attn["v_proj"]["kernel"])
+        q = _rope(q, freqs, positions)
+        k = _rope(k, freqs, positions)
+        out = _gqa_attend(q, k, v, mask)
+        out = _pj("bsnd,ndh->bsh", out, attn["o_proj"]["kernel"])
+        x = x + out
+        h = _rms(x, lp["mlp_norm"]["scale"], dcfg.norm_eps)
+        return x + _ffn(dcfg, lp, h), None
+
+    x, _ = jax.lax.scan(
+        layer_body, x, (dw["layers"], jnp.arange(dcfg.n_layers))
+    )
+    x = _rms(x[:, -1], dw["final_scale"], dcfg.norm_eps)
+    return _lm_logits(x.astype(jnp.float32), dw["lm_head"])
+
+
+def _draft_model_draft(dcfg: LlamaConfig, dw: dict, window: int, k: int,
+                       hist, lens):
+    """Trained-draft speculation: k greedy tokens from the DRAFT model,
+    conditioned on the last ``window`` tokens of each row's history.
+    The window is right-aligned (the newest token sits at index W-1),
+    shorter rows left-pad with masked zeros, and each of the k chained
+    draft steps rolls the window one token left and re-runs the tiny
+    forward -- k small forwards inside the same device program, no
+    draft KV cache to keep consistent with rejections.
+
+    hist [B, Smax] valid to ``lens`` (which INCLUDES the pending last
+    sample, same contract as _ngram_draft). Returns draft [B, k].
+    """
+    b, smax = hist.shape
+    base = lens[:, None] - window + jnp.arange(window)[None, :]  # [B,W]
+    valid = base >= 0
+    toks = jnp.take_along_axis(
+        hist, jnp.clip(base, 0, smax - 1), axis=1
+    )
+    toks = jnp.where(valid, toks, 0)
+    pos = jnp.clip(base, 0, dcfg.max_seq - 1)
+
+    def body(carry, _):
+        toks, pos, valid = carry
+        logits = _draft_forward(dcfg, dw, toks, pos, valid)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        toks = jnp.concatenate([toks[:, 1:], nxt[:, None]], axis=1)
+        pos = jnp.concatenate(
+            [pos[:, 1:],
+             jnp.minimum(pos[:, -1:] + 1, dcfg.max_seq - 1)], axis=1
+        )
+        valid = jnp.concatenate(
+            [valid[:, 1:], jnp.ones((b, 1), bool)], axis=1
+        )
+        return (toks, pos, valid), nxt
+
+    _, drafts = jax.lax.scan(body, (toks, pos, valid), None, length=k)
+    return jnp.transpose(drafts)                              # [B,k]
+
+
 def _spec_block(cfg: LlamaConfig, m_steps: int, k_draft: int, w: dict,
-                cache_k, cache_v, tokens, lengths, hist):
+                cache_k, cache_v, tokens, lengths, hist, draft=None,
+                draft_w=None):
     """m_steps SPECULATIVE decode iterations in ONE device program
     (greedy path only; the scheduler falls back to _decode_block for
     sampled/filterered/logprob batches).
 
-    Each step: draft k tokens per slot by prompt lookup (_ngram_draft),
-    verify [last, d1..dk] in one (k+1)-wide forward over the cache --
+    Each step: draft k tokens per slot -- by prompt lookup
+    (_ngram_draft) or, when ``draft`` = (draft_cfg, window) and
+    ``draft_w`` carry a distilled DRAFT model, by k chained greedy
+    forwards of that model over the history window
+    (_draft_model_draft) -- then verify [last, d1..dk] in one
+    (k+1)-wide forward over the cache --
     decode is HBM-bandwidth bound, so the (k+1)x FLOPs ride the SAME
     weight stream a 1-token step pays for -- then accept the longest
     matched prefix plus the model's bonus token. Per step a slot emits
@@ -1199,8 +1297,11 @@ def _spec_block(cfg: LlamaConfig, m_steps: int, k_draft: int, w: dict,
 
     tokens [B] last sampled; lengths [B] total tokens incl. it (cache
     holds lengths-1). hist [B, Smax] token history, valid to lengths.
-    Returns (out_tokens [m, B, k+1], counts [m, B], ck, cv); rows of
-    out_tokens past counts are zero-padding the host discards.
+    Returns (out_tokens [m, B, k+1], counts [m, B], ck, cv, last [B],
+    lens [B], hist [B, Smax]); rows of out_tokens past counts are
+    zero-padding the host discards, and the trailing carries ride back
+    as DEVICE arrays so a chained next spec block (depth-N pipeline)
+    consumes them -- history included -- without a host round trip.
     """
 
     b = tokens.shape[0]
@@ -1212,8 +1313,13 @@ def _spec_block(cfg: LlamaConfig, m_steps: int, k_draft: int, w: dict,
 
     def step_body(carry, _):
         ck0, cv0, toks, lens, hist = carry
-        draft = _ngram_draft(hist, lens, k_draft)            # [B,k]
-        tokens_in = jnp.concatenate([toks[:, None], draft], axis=1)
+        if draft is not None:
+            dcfg, window = draft
+            drafted = _draft_model_draft(dcfg, draft_w, window,
+                                         k_draft, hist, lens)  # [B,k]
+        else:
+            drafted = _ngram_draft(hist, lens, k_draft)        # [B,k]
+        tokens_in = jnp.concatenate([toks[:, None], drafted], axis=1)
         positions = (lens - 1)[:, None] + j                  # [B,S]
         mask = jnp.arange(smax)[None, None, :] <= positions[:, :, None]
         x = _embed_rows(w, tokens_in, jnp.dtype(cfg.dtype))  # [B,S,H]
@@ -1247,10 +1353,10 @@ def _spec_block(cfg: LlamaConfig, m_steps: int, k_draft: int, w: dict,
         g = jnp.argmax(
             _lm_logits(x.astype(jnp.float32), w["lm_head"]), axis=-1
         )                                                    # [B,S]
-        eq = draft == g[:, :-1]
+        eq = drafted == g[:, :-1]
         a = jnp.cumprod(eq.astype(jnp.int32), axis=1).sum(axis=1)  # [B]
         bonus = jnp.take_along_axis(g, a[:, None], axis=1)[:, 0]
-        padded_draft = jnp.pad(draft, ((0, 0), (0, 1)))
+        padded_draft = jnp.pad(drafted, ((0, 0), (0, 1)))
         out = jnp.where(j < a[:, None], padded_draft,
                         jnp.where(j == a[:, None], bonus[:, None], 0))
         count = a + 1
@@ -1258,11 +1364,11 @@ def _spec_block(cfg: LlamaConfig, m_steps: int, k_draft: int, w: dict,
         hist = hist.at[batch_idx, wpos].set(out, mode="drop")
         return (ck1, cv1, bonus, lens + count, hist), (out, count)
 
-    (ck, cv, _, _, _), (outs, counts) = jax.lax.scan(
+    (ck, cv, last, lens, hist), (outs, counts) = jax.lax.scan(
         step_body, (cache_k, cache_v, tokens, lengths, hist),
         None, length=m_steps,
     )
-    return outs, counts, ck, cv
+    return outs, counts, ck, cv, last, lens, hist
 
 
 # ---------------------------------------------------------------------------
@@ -1469,8 +1575,26 @@ class Request:
 
 
 @dataclasses.dataclass
+class _FusedMeta:
+    """Host-side bookkeeping for one FUSED (chunk-carrying) pipeline
+    lane: which prefilling rows rode the dispatch, whether each one's
+    prompt finished inside it, and the device prompt-end logits buffer
+    plus the per-row sampling params/keys the consume needs to emit
+    first tokens. ``rows`` entries are (chunk_row_index, slot, req,
+    completed)."""
+
+    rows: list
+    fin_logits: Any
+    nonces: np.ndarray
+    positions: np.ndarray
+    temps: np.ndarray
+    top_ks: np.ndarray
+    top_ps: np.ndarray
+
+
+@dataclasses.dataclass
 class _Inflight:
-    """One dispatched-but-unconsumed decode block (a pipeline lane).
+    """One dispatched-but-unconsumed block (a pipeline lane).
 
     ``outs`` are DEVICE arrays still streaming home; ``last``/``lens``
     are the block's final token/position carry, kept on device so the
@@ -1480,6 +1604,14 @@ class _Inflight:
     so re-packing would produce identical arrays anyway. At
     pipeline_depth=N up to N of these sit queued in the engine's lane
     deque (oldest first) behind the block being consumed.
+
+    Three lane kinds share the deque: pure decode blocks, FUSED
+    chunk+decode blocks (``fused`` carries the chunk bookkeeping;
+    ``n`` counts their decode steps), and SPECULATIVE blocks
+    (``spec_m`` > 0; ``outs`` is the (tokens, counts) pair, ``n`` is
+    the worst-case m*(k+1) token exposure, and ``hist_dev`` carries
+    the device-resident token history a chained spec block drafts
+    from).
     """
 
     n: int
@@ -1493,6 +1625,9 @@ class _Inflight:
     filtered: bool
     want_lp: bool
     slots: tuple
+    fused: Optional[_FusedMeta] = None
+    spec_m: int = 0
+    hist_dev: Any = None
 
 
 class GenerationEngine:
@@ -1526,6 +1661,10 @@ class GenerationEngine:
         streaming_init: bool = False,
         pipeline_depth: int = 1,
         drain_overshoot_bound: Optional[int] = None,
+        continuous_batching: bool = True,
+        draft_config: Optional[LlamaConfig] = None,
+        draft_params: Optional[dict] = None,
+        draft_window: int = 64,
     ) -> None:
         # Max decode steps fused into one device program (power-of-2
         # sub-blocks keep the compile count bounded); 1 = per-token
@@ -1569,10 +1708,42 @@ class GenerationEngine:
             if prefix_cache_mb > 0 else None
         )
         self._chunk = self.prefill_chunk or 256
-        # Self-speculative decoding (prompt-lookup drafting): k draft
-        # tokens verified per step when every active slot is greedy and
-        # logprob-free; 0 disables. See _spec_block.
+        # Continuous chunked-prefill batching (Sarathi-style): fused
+        # dispatches carry a BOUNDED chunk budget (the tail shrinks
+        # with decode occupancy -- see _dispatch_fused) so long prompts
+        # prefill incrementally ACROSS pipelined decode dispatches
+        # instead of finishing inside one barrier dispatch, and the
+        # lane deque chains fused blocks without host round trips.
+        # False restores the one-dispatch-per-prompt barrier (the A/B
+        # baseline arm in bench_serving's mixed-continuous phase).
+        self.continuous = bool(continuous_batching)
+        # Speculative decoding: k draft tokens verified per step when
+        # every active slot is greedy and logprob-free; 0 disables.
+        # Drafting is prompt-lookup (_ngram_draft) by default, or a
+        # distilled DRAFT MODEL when draft_config (+ optionally
+        # draft_params; random init otherwise, for tests) is given --
+        # see _spec_block / _draft_model_draft.
         self.speculative_k = max(0, int(speculative_k))
+        self.draft_cfg = draft_config
+        self.draft_weights = None
+        self.draft_window = 0
+        if draft_config is not None:
+            if not self.speculative_k:
+                raise ValueError("draft_config requires speculative_k > 0")
+            if draft_params is None:
+                import flax.linen as nn
+
+                dmodel = Llama(
+                    dataclasses.replace(draft_config, remat=False)
+                )
+                draft_params = nn.meta.unbox(jax.jit(dmodel.init)(
+                    jax.random.PRNGKey(seed + 2),
+                    jnp.zeros((1, 8), jnp.int32),
+                ))
+            self.draft_weights = pack_weights(draft_params, draft_config)
+            self.draft_window = max(
+                2, min(int(draft_window), draft_config.max_seq)
+            )
         self.spec_steps = 0       # verify steps run
         self.spec_emitted = 0     # tokens those steps produced
         # Pallas bounded-span decode attention (ops/decode_attention.py).
@@ -1771,9 +1942,13 @@ class GenerationEngine:
         # Per-request sampling nonces (see _decode_block): a plain
         # itertools counter -- CPython-atomic, so submit() needs no lock.
         self._req_counter = itertools.count()
-        # Base key for per-row decode sampling; distinct from the
-        # _next_rng chain (which admissions/fused/spec keep consuming)
-        # so an extra in-flight dispatch can never shift that chain.
+        # Base key for ALL per-row sampling (decode steps AND first
+        # tokens): every draw is keyed by (request nonce, position)
+        # folded into this one constant, so a token's value is
+        # independent of batch composition, chunking, pipelining, and
+        # dispatch count. The stateful _next_rng split chain is no
+        # longer consumed by any sampling path (kept for external
+        # callers that want a fresh engine-seeded key).
         self._decode_rng = jax.random.fold_in(
             jax.random.PRNGKey(seed), 0xDEC0DE
         )
@@ -1795,6 +1970,11 @@ class GenerationEngine:
         # depth-dependent part of overshoot; head-block overshoot exists
         # at depth 0 too and is excluded).
         self.overshoot_max_per_drain = 0
+        # Prompts whose chunked prefill completed (the row moved
+        # prefilling -> active at a fused-lane consume). A bump during
+        # a pipelined consume triggers a drain so the fresh row joins
+        # the decode lanes at the very next dispatch.
+        self.prefill_activations = 0
 
 
     def _build_dispatch(self) -> None:
@@ -1880,43 +2060,89 @@ class GenerationEngine:
 
         def fused_call(n, m, klen, filtered, want_lp, ck, cv, toks,
                        lens, ctoks, coffs, cclens, cslots, rng, temps,
-                       top_ks, top_ps, mask=None):
+                       top_ks, top_ps, nonces, mask=None):
             self._note_dispatch(decode=False)
             masked = mask is not None
             key = (n, m, klen, ctoks.shape[1], filtered, want_lp, masked)
             if key not in fused_jits:
                 def fn(w, ck, cv, toks, lens, ctoks, coffs, cclens,
-                       cslots, rng, temps, top_ks, top_ps, *mk):
-                    outs, fin, ck, cv = _fused_block(
+                       cslots, rng, temps, top_ks, top_ps, nonces, *mk):
+                    outs, fin, ck, cv, last, lens = _fused_block(
                         cfg, n, m, self._chunk, klen, filtered,
                         want_lp, w, ck, cv, toks, lens, ctoks, coffs,
                         cclens, cslots, rng, temps, top_ks, top_ps,
-                        mask=mk[0] if masked else None,
+                        nonces, mask=mk[0] if masked else None,
                     )
-                    return outs, fin, _pin(ck), _pin(cv)
+                    return outs, fin, _pin(ck), _pin(cv), last, lens
                 fused_jits[key] = jax.jit(fn, donate_argnums=(1, 2))
             extra = (jnp.asarray(mask),) if masked else ()
             return fused_jits[key](self.weights, ck, cv, toks, lens,
                                    ctoks, coffs, cclens, cslots, rng,
-                                   temps, top_ks, top_ps, *extra)
+                                   temps, top_ks, top_ps, nonces,
+                                   *extra)
 
         self._fused_call = fused_call
 
         spec_jits = {}
+        draft_static = (
+            (self.draft_cfg, self.draft_window)
+            if self.draft_weights is not None else None
+        )
 
         def spec_call(m, ck, cv, toks, lens, hist):
             self._note_dispatch(decode=False)
             if m not in spec_jits:
-                def fn(w, ck, cv, toks, lens, hist):
-                    outs, counts, ck, cv = _spec_block(
+                def fn(w, dw, ck, cv, toks, lens, hist):
+                    outs, counts, ck, cv, last, lens, hist = _spec_block(
                         cfg, m, self.speculative_k, w, ck, cv, toks,
-                        lens, hist,
+                        lens, hist, draft=draft_static, draft_w=dw,
                     )
-                    return outs, counts, _pin(ck), _pin(cv)
-                spec_jits[m] = jax.jit(fn, donate_argnums=(1, 2))
-            return spec_jits[m](self.weights, ck, cv, toks, lens, hist)
+                    return (outs, counts, _pin(ck), _pin(cv), last,
+                            lens, hist)
+                spec_jits[m] = jax.jit(fn, donate_argnums=(2, 3))
+            return spec_jits[m](self.weights, self.draft_weights, ck,
+                                cv, toks, lens, hist)
 
         self._spec_call = spec_call
+
+        # First-token sampling for prefill completions (batched and
+        # chunked): per-row keys fold_in(fold_in(base, nonce),
+        # prompt_len - 1) -- the position of the prompt-end logits row,
+        # one below the first decode step's key, so a request's draws
+        # depend only on (request, position) from its very first token.
+        # That closes the last batch-composition dependence: chunked,
+        # batched, and prefix-restored admissions all sample the same
+        # first token for the same request.
+        first_jits = {}
+
+        def first_tokens_call(logits, nonces, positions, temps,
+                              top_ks, top_ps):
+            filtered = bool(
+                (np.asarray(top_ks) > 0).any()
+                or (np.asarray(top_ps) < 1.0).any()
+            )
+            if filtered not in first_jits:
+                def fn(rng, lg, nonces, poss, temps, tks, tps,
+                       filt=filtered):
+                    keys = jax.vmap(
+                        lambda nc, p: jax.random.fold_in(
+                            jax.random.fold_in(rng, nc), p
+                        )
+                    )(nonces, poss)
+                    return _sample_rows(lg, keys, temps,
+                                        tks if filt else None,
+                                        tps if filt else None)
+                first_jits[filtered] = jax.jit(fn)
+            return first_jits[filtered](
+                self._decode_rng, logits,
+                jnp.asarray(nonces, jnp.int32),
+                jnp.asarray(positions, jnp.int32),
+                jnp.asarray(temps, jnp.float32),
+                jnp.asarray(top_ks, jnp.int32),
+                jnp.asarray(top_ps, jnp.float32),
+            )
+
+        self._first_tokens = first_tokens_call
 
         def _insert_pinned(cache_k, cache_v, k_seq, v_seq, slots):
             ck, cv = _insert(cache_k, cache_v, k_seq, v_seq, slots)
@@ -1999,6 +2225,7 @@ class GenerationEngine:
             "spec": spec_jits,
             "extract": extract_jits,
             "restore": restore_jits,
+            "first_tokens": first_jits,
         }
 
     # -- scheduling core ---------------------------------------------------
@@ -2156,13 +2383,19 @@ class GenerationEngine:
                 temps = np.zeros(kbucket, np.float32)
                 top_ks = np.zeros(kbucket, np.int32)
                 top_ps = np.ones(kbucket, np.float32)
+                nonces = np.zeros(kbucket, np.int32)
+                poss = np.zeros(kbucket, np.int32)
                 for j, r in enumerate(reqs):
                     temps[j] = r.temperature
                     top_ks[j] = r.top_k
                     top_ps[j] = r.top_p
-                first = np.asarray(self._sample(
-                    logits, self._next_rng(), jnp.asarray(temps),
-                    top_ks, top_ps,
+                    nonces[j] = r.nonce
+                    poss[j] = len(r.prompt) - 1
+                # Per-(nonce, position) keys, NOT the _next_rng chain:
+                # the same request draws the same first token whether it
+                # admits batched here or chunked through _fused_block.
+                first = np.asarray(self._first_tokens(
+                    logits, nonces, poss, temps, top_ks, top_ps,
                 ))
                 logits_np = None
                 for j, (req, slot) in enumerate(zip(reqs, slots)):
@@ -2487,17 +2720,57 @@ class GenerationEngine:
             self.overshoot_tokens_discarded += n - k
 
     def _fused_step(self) -> None:
-        """One mixed dispatch: n decode steps fused with prefill chunks,
-        plus a chunk-only tail that finishes every mid-prefill prompt
-        (_fused_block). Rows finishing their prompt sample their first
-        token when the dispatch returns and join the decode lanes next
-        dispatch, so TTFT ~= one mixed dispatch that carries at most
-        prefill_decode_steps of decode work."""
+        """One mixed dispatch: n decode steps fused with prefill chunks
+        (_fused_block). In continuous mode the chunk tail is BOUNDED by
+        decode occupancy and the dispatch enters the lane deque like
+        any decode block -- further fused blocks chain off its device
+        carry (_pipeline_fill), so long prompts prefill incrementally
+        across pipelined dispatches. With continuous_batching=False the
+        whole prompt finishes inside this one dispatch (the prefill
+        barrier) and the pipeline drains, the pre-continuous behavior."""
         with trace.span("prefill.fused", plane="serving", track="engine",
                         rows=len(self.prefilling)) as sp:
             self._fused_step_inner(sp)
 
     def _fused_step_inner(self, sp=trace._NULL_SPAN) -> None:
+        mask = self._pack_constraint_mask()
+        fl = self._dispatch_fused(mask=mask, sp=sp)
+        if mask is not None:
+            self._consume_block(fl, behind=False, drain="constraint-mask")
+            return
+        self._pipeline_advance(fl)
+
+    def _dispatch_fused(self, tail: Optional[_Inflight] = None,
+                        n_cap: Optional[int] = None, mask=None,
+                        sp=trace._NULL_SPAN) -> _Inflight:
+        """Build and dispatch ONE fused chunk+decode block over the
+        current prefilling set. ``tail=None`` packs the decode lanes
+        from host state (a fresh dispatch); otherwise the new block
+        chains off ``tail``'s device-resident carry -- tokens and
+        positions never touch the host, only the (host-known) chunk
+        schedule is fresh. ``req.prefilled`` advances AT DISPATCH TIME:
+        the chunk writes are unconditionally executed device work, so a
+        later chained dispatch must schedule the NEXT chunks; only the
+        prefilling->active transition (and first-token emission) waits
+        for the consume (_consume_fused)."""
+        if tail is None:
+            (tokens, temps, top_ks, top_ps, positions, nonces,
+             filtered) = self._pack_decode_lanes()
+            want_lp = any(r.logprobs for r in self.active.values())
+            toks_dev = jnp.asarray(tokens)
+            pos_dev = jnp.asarray(positions)
+            temps_dev = jnp.asarray(temps)
+            tks_dev = jnp.asarray(top_ks)
+            tps_dev = jnp.asarray(top_ps)
+            nonces_dev = jnp.asarray(nonces)
+            slots = tuple(self.active)
+        else:
+            toks_dev, pos_dev = tail.last, tail.lens
+            temps_dev, tks_dev, tps_dev = (tail.temps, tail.top_ks,
+                                           tail.top_ps)
+            nonces_dev = tail.nonces
+            filtered, want_lp = tail.filtered, tail.want_lp
+            slots = tail.slots
         items = list(self.prefilling.items())
         c = self._chunk
         # Chunk-lane admission budget, same spirit (and knob) as the
@@ -2519,22 +2792,41 @@ class GenerationEngine:
         # the last scheduled chunk run a garbage c-token chunk each).
         # The decode-budget bound is deliberately absent: chunk rows
         # need the steps regardless, and decode overshoot is discarded
-        # host-side.
+        # host-side. Chained dispatches pass ``n_cap`` instead: host
+        # lengths trail the device mid-pipeline, so the caller
+        # (_pipeline_next) already discounted the in-flight tokens.
         cap = min(self.decode_block, self.prefill_decode_steps)
-        if self.active:
+        if n_cap is not None:
+            cap = min(cap, max(n_cap, 1))
+        elif self.active:
             cap = min(cap, max(1, min(
                 self.cfg.max_seq - int(self.lengths[slot])
                 for slot in self.active
             )))
-        mask = self._pack_constraint_mask()
         if mask is not None:
             cap = 1  # constrained decode lanes: single-step dispatches
         n = 1
         while n * 2 <= cap and n < need:
             n *= 2
-        # Chunks beyond the mixed scan ride the chunk-only tail
-        # (pow2-bucketed step count; trailing steps are garbage lanes).
-        m = _pow2_bucket(need - n) if need > n else 0
+        # Chunk-only tail sizing is where continuous batching happens.
+        # Legacy (continuous=False): the tail always covers the whole
+        # remaining prompt -- one dispatch, the prefill barrier. In
+        # continuous mode the tail budget SCALES WITH IDLE CAPACITY:
+        # an idle engine still prefills whole prompts in one dispatch
+        # (pure-TTFT, nothing to starve), but with decode slots active
+        # each fused block only spends ~the idle fraction of the fleet
+        # on extra chunk-only steps and the rest of the prompt rides
+        # later (chained) fused blocks, so decode lanes keep emitting
+        # every ~n steps instead of stalling for the whole prompt.
+        rem = need - n
+        if rem <= 0:
+            m = 0
+        elif self.continuous and self.active:
+            idle = self.max_slots - len(self.active)
+            allow = rem * idle // self.max_slots
+            m = _pow2_bucket(min(allow, rem)) if allow > 0 else 0
+        else:
+            m = _pow2_bucket(rem)
         total = n + m
         kbucket = _pow2_bucket(len(items))
         ctoks = np.zeros((total, kbucket, c), np.int32)
@@ -2544,6 +2836,9 @@ class GenerationEngine:
         ctemps = np.zeros(kbucket, np.float32)
         ctop_ks = np.zeros(kbucket, np.int32)
         ctop_ps = np.ones(kbucket, np.float32)
+        cnonces = np.zeros(kbucket, np.int32)
+        cpos = np.zeros(kbucket, np.int32)
+        rows = []
         max_end = 1
         for j, (slot, req) in enumerate(items):
             pos = req.prefilled
@@ -2552,6 +2847,10 @@ class GenerationEngine:
             ctemps[j] = req.temperature
             ctop_ks[j] = req.top_k
             ctop_ps[j] = req.top_p
+            cnonces[j] = req.nonce
+            # Prompt-end logits row position: the first-token sampling
+            # key (consume side) pairs it with the request nonce.
+            cpos[j] = len(req.prompt) - 1
             for s in range(total):
                 take = min(c, len(req.prompt) - pos)
                 if take <= 0:
@@ -2562,36 +2861,56 @@ class GenerationEngine:
             # Real tokens bound klen; padding lanes attend garbage that's
             # discarded, so they don't need covering.
             max_end = max(max_end, pos)
+            completed = pos >= len(req.prompt)
+            rows.append((j, slot, req, completed))
+            # Dispatch-time chunk progress: the scheduled writes WILL
+            # execute (queued lanes are never cancelled), so the next
+            # dispatch -- possibly chained before this one lands --
+            # must schedule from ``pos``. Activation waits for consume.
+            req.prefilled = pos
+            if completed:
+                del self.prefilling[slot]
         klen = self._bucket(max_end)
         # Chunk-shape annotations: mixed decode steps, chunk-only tail
         # steps, chunk size, attention klen bucket for this dispatch.
         sp.annotate(mixed_steps=n, tail_steps=m, chunk=c, klen=klen)
-        # (nonces unused: the fused path samples from the _next_rng
-        # chain -- it never pipelines, so chain order is stable.)
-        tokens, temps, top_ks, top_ps, positions, _nonces, filtered = (
-            self._pack_decode_lanes()
+        outs, fin_logits, self.cache_k, self.cache_v, last, lens = (
+            self._fused_call(
+                n, m, klen, filtered, want_lp, self.cache_k,
+                self.cache_v, toks_dev, pos_dev, jnp.asarray(ctoks),
+                jnp.asarray(coffs), jnp.asarray(cclens),
+                jnp.asarray(cslots), self._decode_rng, temps_dev,
+                tks_dev, tps_dev, nonces_dev, mask,
+            )
         )
-        want_lp = any(req.logprobs for req in self.active.values())
-        outs, fin_logits, self.cache_k, self.cache_v = self._fused_call(
-            n, m, klen, filtered, want_lp, self.cache_k, self.cache_v,
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(ctoks), jnp.asarray(coffs), jnp.asarray(cclens),
-            jnp.asarray(cslots), self._next_rng(), jnp.asarray(temps),
-            jnp.asarray(top_ks), jnp.asarray(top_ps), mask,
-        )
-        self._emit_decode_outs(outs, want_lp)
-        first = None  # sampled lazily: not every dispatch finishes a row
+        meta = _FusedMeta(rows, fin_logits, cnonces, cpos, ctemps,
+                          ctop_ks, ctop_ps)
+        return _Inflight(n, outs, last, lens, temps_dev, tks_dev,
+                         tps_dev, nonces_dev, filtered, want_lp, slots,
+                         fused=meta)
+
+    def _consume_fused(self, meta: _FusedMeta) -> None:
+        """Activate the rows whose prompt completed inside a consumed
+        fused block: sample first tokens from the latched prompt-end
+        logits with per-(nonce, position) keys -- the same draw the
+        batched-prefill path makes for the same request, whatever the
+        chunking -- then move them prefilling->active and emit. The
+        ``prefill_activations`` bump tells _pipeline_advance to drain:
+        queued lanes predate the activation and keep the new row
+        parked, so the pipeline collapses one step and the next fresh
+        dispatch folds the row into the decode lanes."""
+        done = [(j, slot, req)
+                for j, slot, req, completed in meta.rows if completed]
+        if not done:
+            return
+        first = None  # sampled lazily: logits stay on device otherwise
         fin_np = None
-        for j, (slot, req) in enumerate(items):
-            req.prefilled += int(cclens[:, j].sum())
-            if req.prefilled < len(req.prompt):
-                continue
+        for j, slot, req in done:
             if first is None:
-                first = np.asarray(self._sample(
-                    fin_logits, self._next_rng(), jnp.asarray(ctemps),
-                    ctop_ks, ctop_ps,
+                first = np.asarray(self._first_tokens(
+                    meta.fin_logits, meta.nonces, meta.positions,
+                    meta.temps, meta.top_ks, meta.top_ps,
                 ))
-            del self.prefilling[slot]
             self.lengths[slot] = len(req.prompt)
             if self.hist is not None:
                 self.hist[slot, :len(req.prompt)] = req.prompt
@@ -2599,7 +2918,7 @@ class GenerationEngine:
             self._maybe_capture_prefix(req)
             if req.logprobs or req.constraint is not None:
                 if fin_np is None:
-                    fin_np = np.asarray(fin_logits, np.float32)
+                    fin_np = np.asarray(meta.fin_logits, np.float32)
             tok = (self._host_first_token(fin_np[j], req)
                    if req.constraint is not None else int(first[j]))
             if req.logprobs:
@@ -2607,6 +2926,7 @@ class GenerationEngine:
                     _host_logprobs(fin_np[j], tok, req.logprobs)
                 )
             self._emit(req, tok)
+            self.prefill_activations += 1
 
     def _emit(self, req: Request, token: int) -> None:
         req.generated.append(token)
@@ -2718,6 +3038,19 @@ class GenerationEngine:
                 round(self.ttft_ms_ema, 3)
                 if self.ttft_ms_ema is not None else 0.0
             ),
+            # Continuous chunked-prefill gauges: whether incremental
+            # admission is on, the chunk grain, how many prompts have
+            # activated out of chunked prefill, and how many MORE
+            # chunked prompts this engine could absorb right now (free
+            # slots when chunked admission is available, else 0) -- the
+            # router's long-prompt steering keys off chunk_headroom.
+            "continuous_batching": self.continuous,
+            "prefill_chunk": self.prefill_chunk,
+            "prefill_activations": self.prefill_activations,
+            "chunk_headroom": (
+                len(self.free_slots)
+                if (self.prefill_chunk and self.continuous) else 0
+            ),
         }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
@@ -2744,6 +3077,8 @@ class GenerationEngine:
                     (self.spec_emitted - self.spec_steps)
                     / (self.spec_steps * self.speculative_k), 4,
                 ) if self.spec_steps else 0.0,
+                "drafter": ("model" if self.draft_weights is not None
+                            else "ngram"),
             }
         return out
 
@@ -2845,11 +3180,18 @@ class GenerationEngine:
                                 drain=self._drain_reason)
             return
         fins = self.requests_finished
+        acts = self.prefill_activations
         self._consume_block(fl, behind=True)
         if self.requests_finished != fins:
             # Mid-flight finish (EOS before the predicted budget):
             # drain now; the freed lane's overshoot is discarded whole.
             self._drain_inflight("mid-flight-finish")
+        elif self.prefill_activations != acts:
+            # A chunked prompt just activated: queued lanes predate it
+            # and keep its decode lane parked, so drain -- the next
+            # fresh dispatch folds the new row into the batch. Nothing
+            # is discarded; the queued lanes' tokens all emit.
+            self._drain_inflight("prefill-activation")
 
     def _pipeline_fill(self, fl: _Inflight) -> None:
         """Chain blocks off the deepest in-flight carry until the lane
@@ -2860,17 +3202,33 @@ class GenerationEngine:
         queued at reduced block size instead of collapsing to depth 1."""
         while len(self._inflight) < self.pipeline_depth:
             queued = sum(b.n for b in self._inflight)
-            n = self._pipeline_next(fl.n + queued)
+            tail = self._inflight[-1] if self._inflight else fl
+            kind, n = self._pipeline_next(fl.n + queued, tail)
             if n == 0:
                 return
             if self.drain_overshoot_bound > 0:
-                while n > self.drain_overshoot_bound - queued:
-                    n //= 2
+                lim = self.drain_overshoot_bound - queued
+                if kind == "spec":
+                    # Spec exposure shrinks in whole verify steps of
+                    # k+1 tokens each, not single tokens.
+                    unit = self.speculative_k + 1
+                    m = n // unit
+                    while m and m * unit > lim:
+                        m //= 2
+                    n = m * unit
+                else:
+                    while n > lim:
+                        n //= 2
                 if n < 1:
                     self._drain_reason = "overshoot-bound"
                     return
-            tail = self._inflight[-1] if self._inflight else fl
-            nxt = self._dispatch_chained(tail, n)
+            if kind == "fused":
+                nxt = self._dispatch_fused(tail=tail, n_cap=n)
+            elif kind == "spec":
+                nxt = self._dispatch_spec(
+                    tail=tail, m=n // (self.speculative_k + 1))
+            else:
+                nxt = self._dispatch_chained(tail, n)
             self._copy_async(nxt)
             self._inflight.append(nxt)
 
@@ -2892,19 +3250,29 @@ class GenerationEngine:
         if delta > self.overshoot_max_per_drain:
             self.overshoot_max_per_drain = delta
 
-    def _pipeline_next(self, n_pending: int) -> int:
-        """Size of the next block to chain, or 0 to drain. Mirrors
-        step()'s own block-size choice under the PREDICTED state after
-        every in-flight block lands (host lengths/generated trail the
-        device by ``n_pending`` tokens until the consumes); any event a
-        chained dispatch couldn't honor -- an admission, a constraint
-        turning on, spec eligibility, a predicted in-block finish --
-        forces a drain back to the sequential path."""
-        if self.pipeline_depth < 1 or not self.active or self.prefilling:
-            self._drain_reason = ("prefilling" if self.prefilling
-                                  else "idle" if not self.active
-                                  else "depth-0")
-            return 0
+    def _pipeline_next(self, n_pending: int, tail: _Inflight):
+        """(kind, n) of the next block to chain off ``tail``, or
+        (kind, 0) to drain. Mirrors the fresh-dispatch choices under
+        the PREDICTED state after every in-flight block lands (host
+        lengths/generated trail the device by up to ``n_pending``
+        tokens until the consumes); any event a chained dispatch
+        couldn't honor -- an admission, a constraint turning on, a
+        predicted in-block finish, a lane-kind switch the device carry
+        can't express -- forces a drain back to the sequential path.
+
+        Chain-compatibility matrix: fused->fused while prompts remain
+        mid-prefill (continuous mode), fused->decode once the chunk
+        work is done (identical token/position carry convention),
+        decode->decode; spec->spec only (a spec carry is TOTAL lengths
+        plus a device hist no other kind maintains); nothing chains
+        INTO spec -- the "spec-eligible" drain hands the batch to
+        _spec_step instead."""
+        if self.pipeline_depth < 1:
+            self._drain_reason = "depth-0"
+            return "decode", 0
+        if not self.active and not self.prefilling:
+            self._drain_reason = "idle"
+            return "decode", 0
         if self.free_slots:
             # A free slot means an admission could arrive between steps
             # (submit() is async); a block held in flight would delay it
@@ -2912,40 +3280,92 @@ class GenerationEngine:
             # saturation, where it pays for itself and no admission can
             # proceed anyway.
             self._drain_reason = "free-slots"
-            return 0
+            return "decode", 0
         if any(r.constraint is not None for r in self.active.values()):
             self._drain_reason = "constraint"
-            return 0
+            return "decode", 0
+        if tail.spec_m:
+            return self._pipeline_next_spec(n_pending)
+        if self.prefilling and not self.continuous:
+            self._drain_reason = "prefilling"
+            return "decode", 0
+        n_prev = n_pending
+        if self.active:
+            rem_pred = min(
+                self.cfg.max_seq - int(self.lengths[slot]) - n_prev
+                for slot in self.active
+            )
+            if rem_pred < 1:
+                self._drain_reason = "cache-headroom"
+                return "decode", 0
+            if min(
+                req.max_new_tokens - len(req.generated) - n_prev
+                for req in self.active.values()
+            ) <= 0:
+                self._drain_reason = "budget-exhausted"
+                return "decode", 0  # a budget exhausts in flight: drain
+            budget_pred = max(
+                req.max_new_tokens - len(req.generated) - n_prev
+                for req in self.active.values()
+            )
+            cap = min(self.decode_block, rem_pred, max(budget_pred, 1))
+        else:
+            # Pure-prefill pipeline (every slot mid-prompt): decode
+            # lanes are all parked, so only the fused caps below bound
+            # the block.
+            cap = self.decode_block
+        if self.prefilling:
+            # Chunk work remains: chain another fused block off the
+            # decode carry. Rows that completed in flight already left
+            # self.prefilling (dispatch-time progress), so this
+            # schedules exactly the not-yet-dispatched chunks.
+            return "fused", max(min(cap, self.prefill_decode_steps), 1)
+        if not self.active:
+            self._drain_reason = "idle"
+            return "decode", 0
         if self.speculative_k and all(
             r.temperature <= 0 and r.top_k == 0 and r.top_p >= 1.0
             and not r.logprobs and r.constraint is None
             for r in self.active.values()
         ):
             self._drain_reason = "spec-eligible"
-            return 0  # the drained batch takes the spec path instead
-        n_prev = n_pending
+            return "decode", 0  # the drained batch takes the spec path
+        n = 1
+        while n * 2 <= cap:
+            n *= 2
+        return "decode", n
+
+    def _pipeline_next_spec(self, n_pending: int):
+        """Predicted sizing for a spec->spec chain: host lengths and
+        budgets trail the device by up to ``n_pending`` tokens (the
+        worst case -- every draft of every queued step accepted), so
+        bounds mirror _spec_step's under that pessimistic state.
+        Eligibility itself can't lapse mid-pipeline: per-request
+        sampling params are immutable and set changes drain first."""
+        k = self.speculative_k
         rem_pred = min(
-            self.cfg.max_seq - int(self.lengths[slot]) - n_prev
+            self.cfg.max_seq - int(self.lengths[slot]) - n_pending
             for slot in self.active
         )
-        if rem_pred < 1:
+        if rem_pred < k + 1:
             self._drain_reason = "cache-headroom"
-            return 0
+            return "decode", 0
         if min(
-            req.max_new_tokens - len(req.generated) - n_prev
+            req.max_new_tokens - len(req.generated) - n_pending
             for req in self.active.values()
         ) <= 0:
             self._drain_reason = "budget-exhausted"
-            return 0  # someone exhausts their budget in flight: drain
+            return "decode", 0
         budget_pred = max(
-            req.max_new_tokens - len(req.generated) - n_prev
+            req.max_new_tokens - len(req.generated) - n_pending
             for req in self.active.values()
         )
-        n = 1
-        while n * 2 <= min(self.decode_block, rem_pred,
+        m = 1
+        while m * 2 <= min(self.decode_block,
+                           max(rem_pred // (k + 1), 1),
                            max(budget_pred, 1)):
-            n *= 2
-        return n
+            m *= 2
+        return "spec", m * (k + 1)
 
     def _dispatch_chained(self, fl: _Inflight, n: int) -> _Inflight:
         """Dispatch block N+1 straight off block N's device carry --
@@ -2982,8 +3402,12 @@ class GenerationEngine:
         with trace.span("decode-block.consume", plane="serving",
                         track="engine", n=fl.n,
                         depth=len(self._inflight), drain=drain):
-            self.decode_blocks_consumed += 1
-            if fl.want_lp:
+            if fl.fused is None and not fl.spec_m:
+                # PURE decode blocks only: this is the denominator of
+                # the host-syncs-per-block audit (jaxpr_audit), whose
+                # steady state is decode-only traffic.
+                self.decode_blocks_consumed += 1
+            if fl.spec_m or fl.want_lp:
                 outs = tuple(np.asarray(o) for o in fl.outs)
             else:
                 outs = np.asarray(fl.outs)
@@ -2991,7 +3415,13 @@ class GenerationEngine:
                 self._ema_gap(0.0)
             else:
                 self._gap_t = time.perf_counter()
-            self._emit_decode_outs(outs, fl.want_lp, dispatch_slots=fl.slots)
+            if fl.spec_m:
+                self._emit_spec_outs(fl, *outs)
+            else:
+                self._emit_decode_outs(outs, fl.want_lp,
+                                       dispatch_slots=fl.slots)
+                if fl.fused is not None:
+                    self._consume_fused(fl.fused)
             if not self.active:
                 # Going idle: time to the next dispatch is queue wait, not
                 # pipeline bubble -- don't count it.
@@ -3023,47 +3453,80 @@ class GenerationEngine:
 
     def _spec_step(self) -> None:
         """One speculative dispatch: m verify steps of k drafts each
-        (_spec_block). Emission mirrors _emit_decode_outs -- tokens in
-        step order, overshoot discarded when a slot finishes."""
+        (_spec_block), entering the lane deque like a decode block so
+        chained spec blocks draft+verify on device while this one's
+        outputs stream home."""
+        fl = self._dispatch_spec()
+        self._pipeline_advance(fl)
+
+    def _dispatch_spec(self, tail: Optional[_Inflight] = None,
+                       m: Optional[int] = None) -> _Inflight:
+        """Dispatch one speculative verify block. Fresh (``tail`` is
+        None): token/length/hist state uploads from host bookkeeping.
+        Chained: spec lanes carry TOTAL lengths (pending tokens
+        included) plus the device-resident hist the drafter reads, so
+        the next block drafts straight off the previous one's carry
+        without materializing its outputs."""
         k = self.speculative_k
-        remaining = min(
-            self.cfg.max_seq - int(self.lengths[slot])
-            for slot in self.active
+        if m is None:
+            remaining = min(
+                self.cfg.max_seq - int(self.lengths[slot])
+                for slot in self.active
+            )
+            budget = max(
+                req.max_new_tokens - len(req.generated)
+                for req in self.active.values()
+            )
+            # Steps are pow2-bounded like decode blocks; each step emits
+            # 1..k+1 tokens, so headroom divides by the worst-case
+            # growth and the budget bound uses the guaranteed-min 1.
+            m = 1
+            while m * 2 <= min(self.decode_block,
+                               max(remaining // (k + 1), 1),
+                               max(budget, 1)):
+                m *= 2
+        if tail is None:
+            tokens = np.zeros(self.max_slots, np.int32)
+            lens = np.full(self.max_slots, self.cfg.max_seq, np.int32)
+            for slot, req in self.active.items():
+                tokens[slot] = req.generated[-1]
+                lens[slot] = max(int(self.lengths[slot]), 1)
+            toks_dev = jnp.asarray(tokens)
+            lens_dev = jnp.asarray(lens)
+            hist_dev = jnp.asarray(self.hist)
+            slots = tuple(self.active)
+        else:
+            toks_dev, lens_dev = tail.last, tail.lens
+            hist_dev = tail.hist_dev
+            slots = tail.slots
+        outs, counts, self.cache_k, self.cache_v, last, lens_out, hist = (
+            self._spec_call(m, self.cache_k, self.cache_v, toks_dev,
+                            lens_dev, hist_dev)
         )
-        budget = max(
-            req.max_new_tokens - len(req.generated)
-            for req in self.active.values()
-        )
-        # Steps are pow2-bounded like decode blocks; each step emits
-        # 1..k+1 tokens, so headroom divides by the worst-case growth
-        # and the budget bound uses the guaranteed-minimum 1/step.
-        m = 1
-        while m * 2 <= min(self.decode_block,
-                           max(remaining // (k + 1), 1),
-                           max(budget, 1)):
-            m *= 2
-        tokens = np.zeros(self.max_slots, np.int32)
-        lens = np.full(self.max_slots, self.cfg.max_seq, np.int32)
-        for slot, req in self.active.items():
-            tokens[slot] = req.generated[-1]
-            lens[slot] = max(int(self.lengths[slot]), 1)
-        outs, counts, self.cache_k, self.cache_v = self._spec_call(
-            m, self.cache_k, self.cache_v, jnp.asarray(tokens),
-            jnp.asarray(lens), jnp.asarray(self.hist),
-        )
-        outs = np.asarray(outs)      # [m, B, k+1]
-        counts = np.asarray(counts)  # [m, B]
-        width = outs.shape[2]
-        for slot in list(self.active):
-            req = self.active[slot]
-            self.spec_steps += m
+        return _Inflight(m * (k + 1), (outs, counts), last, lens_out,
+                         None, None, None, None, False, False, slots,
+                         spec_m=m, hist_dev=hist)
+
+    def _emit_spec_outs(self, fl: _Inflight, outs: np.ndarray,
+                        counts: np.ndarray) -> None:
+        """Emit a consumed spec block: per slot, the accepted drafts of
+        each step flattened row-major -- exactly the per-(step, draft)
+        order sequential verification would emit in. A slot freed while
+        the block was in flight discards its lane whole (parked-row
+        invariant, same as decode)."""
+        width = outs.shape[2]  # k+1
+        for slot in fl.slots:
+            req = self.active.get(slot)
+            if req is None:  # freed mid-flight
+                self.overshoot_tokens_discarded += int(
+                    counts[:, slot].sum())
+                continue
+            self.spec_steps += fl.spec_m
             self.spec_emitted += int(counts[:, slot].sum())
-            # Accepted drafts per step, flattened row-major == exactly
-            # the per-(step, draft) order the nested loop emitted in.
             keep = np.arange(width)[None, :] < counts[:, slot][:, None]
             run = outs[:, slot, :][keep]
-            k = self._emit_run(req, run)
-            self.overshoot_tokens_discarded += run.size - k
+            acc = self._emit_run(req, run)
+            self.overshoot_tokens_discarded += run.size - acc
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 64,
                  temperature: float = 0.0,
@@ -3179,4 +3642,6 @@ class GenerationEngine:
         self._extract_call = None
         self._restore_call = None
         self._spec_call = None
+        self._first_tokens = None
+        self.draft_weights = None  # distilled drafts are HBM buffers too
         self.hist = None

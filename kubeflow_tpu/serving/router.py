@@ -307,6 +307,13 @@ class ReplicaLoad:
     queue_depth: int = 0
     slots_active: int = 0
     in_flight: int = 0
+    # Free slots on an engine that admits prompts chunk-at-a-time
+    # inside decode blocks (continuous chunked prefill); 0 when the
+    # engine runs the prefill barrier or never reported the gauge.
+    # Long-prompt steering only fires when the affinity home lacks
+    # chunk headroom -- a chunked engine absorbs the prompt without
+    # stalling decode, so steering away is pure affinity loss.
+    chunk_headroom: int = 0
     ttft_ema_ms: Optional[float] = None
     healthy: bool = True
     last_load_t: float = 0.0
@@ -452,6 +459,8 @@ class Router:
             return
         rep.queue_depth = int(stats.get("queue_depth", rep.queue_depth))
         rep.slots_active = int(stats.get("slots_active", rep.slots_active))
+        rep.chunk_headroom = int(stats.get("chunk_headroom",
+                                           rep.chunk_headroom))
         if stats.get("max_slots"):
             rep.max_slots = int(stats["max_slots"])
         ema = stats.get("ttft_ema_ms")
@@ -615,6 +624,15 @@ class Router:
         long_prompt = (
             cfg.long_prompt_threshold is not None
             and prompt_len >= cfg.long_prompt_threshold
+            # Continuous chunked prefill makes long-prompt admission
+            # non-blocking: when the affinity home reports chunk
+            # headroom it folds the prompt into its decode blocks a
+            # chunk at a time, so the 386-tok/s stall this steering
+            # guards against can't happen there -- keep the affinity
+            # hit instead of shipping the request (or its KV) across
+            # the fleet. Replicas that never report the gauge (barrier
+            # engines, stale fleets) read 0 and steer as before.
+            and cands[0].chunk_headroom <= 0
         )
         prefill_pool = [
             r for r in self.replicas.values()

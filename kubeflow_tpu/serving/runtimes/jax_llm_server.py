@@ -373,6 +373,15 @@ class JaxLLMModel(Model):
             "dispatch_depth": eng.pipeline_depth,
             "dispatch_inflight": len(eng._inflight),
             "decode_dispatches": eng.decode_dispatches,
+            # Free slots IF this engine admits prompts chunk-at-a-time
+            # inside decode blocks (continuous chunked prefill), else 0.
+            # The router's long-prompt steering keys off this: a replica
+            # with chunk headroom absorbs a long prompt without stalling
+            # its decode lanes, so steering away is pure affinity loss.
+            "chunk_headroom": (
+                len(eng.free_slots)
+                if (eng.prefill_chunk and eng.continuous) else 0
+            ),
             "host_gap_ms_ema": round(gap, 3) if gap is not None else 0.0,
             "overshoot_tokens_discarded": eng.overshoot_tokens_discarded,
             "overshoot_max_per_drain": eng.overshoot_max_per_drain,
@@ -423,6 +432,13 @@ class JaxLLMModel(Model):
             # (docs/FLEET.md) -- the histogram gives the distribution,
             # this gives the router's one current number.
             ("kftpu_engine_ttft_ema_ms", "ttft_ema_ms"),
+            # Continuous chunked prefill: prompts activated mid-decode
+            # (chunked admissions that never stalled the batch) and the
+            # live chunk headroom the router's long-prompt steering
+            # reads (0 when continuous batching is off).
+            ("kftpu_engine_prefill_activations_total",
+             "prefill_activations"),
+            ("kftpu_engine_chunk_headroom", "chunk_headroom"),
         ):
             reg.gauge(key, lab).set(s[stat])
         if "weight_bytes" in s:
@@ -444,6 +460,11 @@ class JaxLLMModel(Model):
                       lab).set(sp["emitted"])
             reg.gauge("kftpu_engine_spec_acceptance",
                       lab).set(sp["acceptance"])
+            # Info-style gauge: which drafter is live (trained draft
+            # model vs n-gram fallback) rides the label, value is 1.
+            reg.gauge("kftpu_engine_spec_drafter_info",
+                      {"model": self.name,
+                       "drafter": sp["drafter"]}).set(1)
         pc = s.get("prefix_cache")
         if pc is not None:
             reg.gauge("kftpu_engine_prefix_cache_entries",

@@ -169,7 +169,8 @@ class ModelServer:
                 continue
             g = gauges()
             load[n] = {k: g[k] for k in (
-                "queue_depth", "slots_active", "max_slots", "ttft_ema_ms"
+                "queue_depth", "slots_active", "max_slots", "ttft_ema_ms",
+                "chunk_headroom",
             ) if k in g}
         if load:
             out["load"] = load

@@ -421,6 +421,7 @@ def test_host_transfer_watch_counts_device_arrays_only():
     assert np.asarray(x).shape == (4,)
 
 
+@pytest.mark.slow  # tier-1 sibling: test_traced_host_sync_audit_catches_sync_inside_span
 def test_host_sync_audit_catches_midloop_sync():
     """Non-vacuity for the steady-state sync bound: an engine whose
     step blocks on an EXTRA device->host transfer per block must be
@@ -778,6 +779,7 @@ def test_run_analysis_rejects_unknown_family():
         analysis.run_analysis(families={"astlint", "fuzz"})
 
 
+@pytest.mark.slow  # tier-1 sibling: test_run_analysis_rejects_unknown_family + test_cli_only_routes_families
 def test_run_analysis_family_selection_is_exact(monkeypatch):
     # families={} runs nothing at all; families={"astlint"} runs only
     # the AST pass (no jax import, no stress drivers).
@@ -806,6 +808,7 @@ def test_lint_package_clean_vs_baseline():
     assert cmp.clean, f"new lint findings: {cmp.new}"
 
 
+@pytest.mark.slow  # tier-1 sibling: test_lint_package_clean_vs_baseline + per-family tests
 def test_full_audit_clean_vs_baseline():
     findings, metrics = analysis.run_analysis(trace=True, serving=True)
     cmp = compare(findings, metrics, analysis.load_baseline())
@@ -851,6 +854,8 @@ def test_perf_shipped_baseline_passes_shipped_artifacts():
     # The floors actually looked at data (non-vacuous skip detection).
     assert any(k.startswith("train.mfu.seq") for k in measured)
     assert any(k.startswith("serving.tok_s.slots") for k in measured)
+    assert "serving.tok_s.mixed" in measured
+    assert any(k.startswith("spec.") for k in measured)
     assert any(k.startswith("fleet.") for k in measured)
     assert any(k.startswith("reshard.") for k in measured)
     assert any(k.startswith("sched.") for k in measured)
@@ -882,6 +887,81 @@ def test_perf_planted_serving_regression_exits_one(monkeypatch, capsys,
     assert rc == 1
     assert any(f["rule"] == "KT-PERF-TOKS"
                for f in json.loads(out)["new"])
+
+
+def test_perf_planted_mixed_floor_regression_exits_one(monkeypatch, capsys,
+                                                       tmp_path):
+    # The continuous-chunked-prefill win: extra.throughput_mixed under
+    # its ratcheted floor must exit 1 (the 9.6x gap must not reopen).
+    bad = analysis.load_perf_baseline()
+    bad["serving"]["tok_s_floor_mixed"] = 1e9
+    p = tmp_path / "perf.json"
+    p.write_text(json.dumps(bad))
+    rc, out = _run_cli(monkeypatch, capsys, [], {},
+                       ["--strict", "--json", "--perf-baseline", str(p)])
+    assert rc == 1
+    assert any(f["rule"] == "KT-PERF-TOKS" and "mixed" in f["message"]
+               for f in json.loads(out)["new"])
+
+
+def test_perf_planted_mixed_itl_ceiling_regression_exits_one(
+        monkeypatch, capsys, tmp_path):
+    # The admission-stall guard: the mixed row's decode-ITL p99 over
+    # its ceiling must exit 1 (a broken chunk budget blows the tail
+    # before it moves the median).
+    bad = analysis.load_perf_baseline()
+    bad["serving"]["mixed_itl_p99_ceiling_ms"] = 0.001
+    p = tmp_path / "perf.json"
+    p.write_text(json.dumps(bad))
+    rc, out = _run_cli(monkeypatch, capsys, [], {},
+                       ["--strict", "--json", "--perf-baseline", str(p)])
+    assert rc == 1
+    assert any(f["rule"] == "KT-PERF-TOKS" and "itl_p99" in f["message"]
+               for f in json.loads(out)["new"])
+
+
+def test_perf_planted_spec_regression_exits_one(monkeypatch, capsys,
+                                                tmp_path):
+    bad = analysis.load_perf_baseline()
+    bad["spec"]["speedup_floor"] = 99.0
+    p = tmp_path / "perf.json"
+    p.write_text(json.dumps(bad))
+    rc, out = _run_cli(monkeypatch, capsys, [], {},
+                       ["--strict", "--json", "--perf-baseline", str(p)])
+    assert rc == 1
+    assert any(f["rule"] == "KT-PERF-SPEC" and f["hard"]
+               for f in json.loads(out)["new"])
+
+
+def test_perf_spec_section_vanishing_is_a_finding(tmp_path):
+    # Spec floors set but the spec_ab A/B dropped out of the artifact:
+    # hard finding, not a silent pass.
+    (tmp_path / "SERVING_BENCH.json").write_text(json.dumps({
+        "extra": {"sweep": []},
+    }))
+    baseline = {"spec": {"acceptance_floor": 0.8}}
+    findings, _ = analysis.check_perf(baseline, root=str(tmp_path))
+    assert [f.rule for f in findings] == ["KT-PERF-SPEC"]
+    assert "vanished" in findings[0].message
+
+
+def test_perf_spec_token_parity_and_floors(tmp_path):
+    # Speculation that changes greedy tokens is a correctness bug: the
+    # parity bit is required, and a broken acceptance trips its floor.
+    doc = {"extra": {"sweep": [], "spec_ab": {
+        "acceptance": 0.3, "speedup": 1.6, "token_parity": False,
+    }}}
+    (tmp_path / "SERVING_BENCH.json").write_text(json.dumps(doc))
+    baseline = {"spec": {
+        "acceptance_floor": 0.8, "speedup_floor": 1.3,
+        "require_token_parity": True,
+    }}
+    findings, measured = analysis.check_perf(baseline, root=str(tmp_path))
+    assert measured["spec.speedup"] == 1.6
+    assert all(f.rule == "KT-PERF-SPEC" for f in findings)
+    msgs = [f.message for f in findings]
+    assert any("acceptance" in m for m in msgs)
+    assert any("token_parity" in m for m in msgs)
 
 
 def test_perf_planted_sched_regression_exits_one(monkeypatch, capsys,

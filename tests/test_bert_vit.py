@@ -84,6 +84,7 @@ class TestTraining:
 
 
 @pytest.mark.e2e
+@pytest.mark.slow  # tier-1 sibling: test_mnist_job_end_to_end covers the PyTorchJob e2e path
 def test_config3_bert_pytorchjob_end_to_end(tmp_path):
     """BASELINE config #3: BERT as a PyTorchJob-shaped job (the reference's
     kind; MASTER_ADDR-style env contract) on the native runtime."""

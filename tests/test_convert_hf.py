@@ -37,6 +37,7 @@ def hf_dir(tmp_path_factory):
     return str(d), m
 
 
+@pytest.mark.slow  # hf_dir fixture builds a real HF checkpoint (~17s torch setup)
 def test_config_mapping(hf_dir):
     path, m = hf_dir
     cfg = config_from_hf(m.config)
@@ -44,6 +45,7 @@ def test_config_mapping(hf_dir):
     assert (cfg.n_heads, cfg.n_kv_heads, cfg.intermediate) == (4, 2, 128)
 
 
+@pytest.mark.slow  # tier-1 sibling: test_preset_auto_without_checkpoint_is_clean_error
 def test_logits_match_hf_forward(hf_dir):
     """The oracle: converted weights + our forward == HF fp64 forward,
     covering the rope un-permutation, GQA mapping, and every transpose."""
@@ -62,6 +64,7 @@ def test_logits_match_hf_forward(hf_dir):
     )
 
 
+@pytest.mark.slow  # offline conversion tool; covered nightly with the full suite
 def test_orbax_roundtrip_into_serving_engine(hf_dir, tmp_path):
     """convert -> save_as_orbax -> jax_llm_server's loader -> engine
     greedy decode == HF greedy decode (fp32, CPU)."""

@@ -54,6 +54,7 @@ class TestJaxEmbedRuntime:
         )[0]
         np.testing.assert_allclose(alone, batched, atol=1e-5)
 
+    @pytest.mark.slow  # tier-1 sibling: test_vectors_unit_norm_and_deterministic
     def test_cls_pooling_differs(self):
         m = JaxEmbedModel("emb-cls", None, dict(TINY, pooling="cls"))
         m.load()

@@ -206,6 +206,7 @@ class TestEngineConstrained:
             eng.close()
 
 
+@pytest.mark.slow  # tier-1 sibling: test_v1_path_response_format_normalized
 def test_openai_response_format_route():
     """POST /openai/v1/completions with response_format json_object:
     text parses as a JSON object; bad type -> 400; absent -> unchanged."""

@@ -89,6 +89,7 @@ class TestResplitTP:
         assert eng.generate(p2, max_new_tokens=8) == ref.generate(
             p2, max_new_tokens=8)
 
+    @pytest.mark.slow  # tier-1 sibling: test_mid_flight_resplit_token_parity
     def test_resplit_moves_prefix_entries_onto_new_mesh(self):
         cfg = _f32()
         eng = GenerationEngine(config=cfg, max_slots=2, decode_block=4,

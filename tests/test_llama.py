@@ -89,6 +89,7 @@ class TestModel:
 
 
 class TestTraining:
+    @pytest.mark.slow  # tier-1 sibling: test_chunked_loss_train_step_runs + test_param_shardings
     def test_sharded_training_decreases_loss(self):
         task = get_task(
             "llama", preset="llama-tiny", batch_size=8, seq_len=32, lr=3e-3
@@ -124,6 +125,7 @@ class TestTraining:
         )
 
 
+@pytest.mark.slow  # tier-1 sibling: test_chunked_cross_entropy_ragged_tail_exact
 def test_chunked_cross_entropy_matches_straight():
     """chunked_cross_entropy must match the straight path on loss AND
     gradients (it is a memory layout change, not a math change; bf16

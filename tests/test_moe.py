@@ -113,6 +113,7 @@ class TestMoELayer:
 
 
 class TestExpertParallelTraining:
+    @pytest.mark.slow  # tier-1 sibling: test_matches_dense_per_token_reference
     def test_training_decreases_loss_on_expert_mesh(self):
         task = get_task(
             "llama", preset="llama-tiny-moe", batch_size=8, seq_len=32,

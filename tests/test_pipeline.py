@@ -94,6 +94,7 @@ class TestPipelinedLlama:
             state, m2 = step(state, *next(it))
         return float(m["loss"]), float(m2["loss"])
 
+    @pytest.mark.slow  # tier-1 sibling: test_forward/backward_matches_sequential
     def test_pipe_matches_plain(self):
         ref = self._one_step(MeshConfig(data=-1))
         pp = self._one_step(MeshConfig(data=-1, pipe=4))

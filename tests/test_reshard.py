@@ -224,6 +224,7 @@ class TestValuePreservation:
 
 
 class TestBitExactContinuation:
+    @pytest.mark.slow  # tier-1 sibling: test_round_trip_is_bitwise_identity
     def test_live_reshard_matches_checkpoint_restart_bitwise(self, tmp_path):
         """The acceptance claim: train N -> live-reshard -> train M is
         BIT-EXACT against train N -> checkpoint-restart (orbax resharding

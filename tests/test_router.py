@@ -207,6 +207,24 @@ def test_long_prompt_steers_to_least_loaded():
     assert r.route(key, prompt_len=10).replica == home
 
 
+def test_long_prompt_stays_home_with_chunk_headroom():
+    # An engine running continuous chunked prefill reports free slots
+    # as chunk_headroom in /healthz: it folds the long prompt into its
+    # decode blocks a chunk at a time, so steering away from the
+    # affinity home is pure cache loss. The router must NOT steer.
+    r = _router(2, long_prompt_threshold=512)
+    key = prefix_route_key(list(range(128)))
+    home = r.route(key, prompt_len=10).replica
+    r.update_load(home, {"slots_active": 6, "chunk_headroom": 2})
+    d = r.route(key, prompt_len=2048)
+    assert d.kind == "direct" and not d.steered and d.replica == home
+    # Headroom exhausted (all slots busy): the stall is back, steer.
+    other = ({"r0", "r1"} - {home}).pop()
+    r.update_load(home, {"slots_active": 6, "chunk_headroom": 0})
+    d = r.route(key, prompt_len=2048)
+    assert d.steered and d.replica == other
+
+
 def test_prefill_replica_never_in_ring_and_disagg_route():
     r = Router(RouterConfig(long_prompt_threshold=512), name="test")
     r.add_replica("pre0", role="prefill", max_slots=8)

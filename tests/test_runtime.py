@@ -207,6 +207,7 @@ class TestProfiling:
 
 
 class TestMultislice:
+    @pytest.mark.slow  # tier-1 sibling: TestShardingRules.test_sharded_matmul_runs
     def test_multislice_mesh_layout_and_training(self):
         """data axis spans slices (emulated: slice-major device blocks);
         a sharded train step runs on the resulting mesh."""

@@ -63,6 +63,7 @@ def kftpu(server, *args, check=True):
 
 @pytest.mark.e2e
 class TestCliFlow:
+    @pytest.mark.slow  # tier-1 sibling: test_apply_manifests_directory + test_train_one_call
     def test_apply_get_logs_delete(self, server, tmp_path):
         spec = tmp_path / "job.yaml"
         spec.write_text(
