@@ -1,0 +1,326 @@
+"""Controller-crash HA bench (KT-PERF-CTRLHA family).
+
+Certifies the ISSUE-19 contract end to end with REAL processes: a
+child controller (``--serve`` mode of this same file) admits two
+JAXJobs and spawns real training workers, then the ``controller.crash``
+chaos seam SIGKILLs that controller at a deterministic reconcile hit.
+The workers must not notice: they keep stepping through the outage
+(verified from their metric logs), and a successor controller -- same
+store file, fresh process -- must take over the actuation lease and
+ADOPT them from the runtime journal: same pids, zero respawns,
+restart_count unchanged.
+
+Measured (ratcheted by ``analysis/perf.py::_check_ctrlha``):
+
+- ``worker_deaths``        -- journaled pids that died with the
+                              controller (must be 0)
+- ``duplicate_spawns``     -- new pids/log files after adoption
+                              (must be 0: adoption, not respawn)
+- ``restart_count_delta``  -- per-job restart_count movement (must be
+                              0: adoption is not a gang restart)
+- ``adoption_seconds``     -- successor start -> last GangAdopted
+                              event (includes the lease-expiry wait)
+
+Replicas are 1 per job (cross-process SPMD is unimplemented on the XLA
+CPU backend); the adoption machinery is identical for wider gangs.
+
+Run:  python bench_ctrlha.py            # JSON line to stdout
+      python bench_ctrlha.py --serve --store S --logs D   # (internal)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+LEASE_SECONDS = 2.0
+TOTAL_CHIPS = 8
+JOB_NAMES = ("ha1", "ha2")
+NAMESPACE = "default"
+
+# Crash the first controller at the SECOND reconcile of the second
+# job: its first reconcile spawned (and journaled) its gang, and the
+# resulting status persist re-enqueues it, so hit 1 is guaranteed to
+# occur -- after BOTH jobs' workers are journaled.
+CRASH_PLAN = json.dumps({
+    "seed": 19,
+    "faults": [
+        {"kind": "crash", "site": "controller.crash",
+         "target": f"{NAMESPACE}/{JOB_NAMES[-1]}", "at": [1]},
+    ],
+})
+
+
+def _base_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    env["KFTPU_LEASE_SECONDS"] = str(LEASE_SECONDS)
+    return env
+
+
+# -- child: a plain controller over a shared store file ----------------------
+
+def serve(store_path: str, log_dir: str) -> None:
+    from kubeflow_tpu.controller import (
+        ControllerLease,
+        GangScheduler,
+        JobController,
+        ProcessLauncher,
+        RuntimeJournal,
+    )
+    from kubeflow_tpu.store import ObjectStore
+
+    store = ObjectStore(store_path)
+    ctl = JobController(
+        store,
+        ProcessLauncher(log_dir=log_dir),
+        GangScheduler(total_chips=TOTAL_CHIPS),
+        journal=RuntimeJournal(store),
+        lease=ControllerLease(
+            store,
+            duration_seconds=float(
+                os.environ.get("KFTPU_LEASE_SECONDS", LEASE_SECONDS)),
+        ),
+    )
+    asyncio.run(ctl.run())
+
+
+# -- parent: orchestrate kill + adoption and measure -------------------------
+
+def _make_job(name: str):
+    from kubeflow_tpu.api import (
+        JobKind,
+        JobSpec,
+        ProcessTemplate,
+        ReplicaSpec,
+        ReplicaType,
+        Resources,
+        TrainJob,
+        apply_defaults,
+    )
+    from kubeflow_tpu.api.types import ObjectMeta
+
+    return apply_defaults(TrainJob(
+        kind=JobKind.JAXJob,
+        metadata=ObjectMeta(name=name, namespace=NAMESPACE),
+        spec=JobSpec(
+            replica_specs={
+                ReplicaType.Worker: ReplicaSpec(
+                    replicas=1,
+                    template=ProcessTemplate(
+                        entrypoint="kubeflow_tpu.runtime.entry",
+                        args=["--model", "llama", "--steps", "200000",
+                              "--log-every", "5",
+                              "--arg", "preset=llama-tiny",
+                              "--arg", "batch_size=8",
+                              "--arg", "seq_len=16"],
+                    ),
+                    resources=Resources(tpu=4),
+                )
+            }
+        ),
+    ))
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def _journal_pids(store) -> dict:
+    """{job_key: {worker_id: pid}} from the runtime journal."""
+    from kubeflow_tpu.controller.journal import JOURNAL_KIND
+
+    out: dict = {}
+    for rec in store.list(JOURNAL_KIND):
+        md = rec.get("metadata") or {}
+        key = f"{md.get('namespace')}/{md.get('name')}"
+        out[key] = {
+            wid: int(ent["pid"])
+            for wid, ent in (rec.get("workers") or {}).items()
+        }
+    return out
+
+
+def _steps_in_log(path: str) -> int:
+    from kubeflow_tpu.runtime.metrics import parse_metric_line
+
+    n = 0
+    try:
+        with open(path, errors="replace") as f:
+            for line in f:
+                m = parse_metric_line(line)
+                if m and "step" in m:
+                    n = max(n, int(float(m["step"])) + 1)
+    except OSError:
+        pass
+    return n
+
+
+def _spawn_controller(store_path: str, log_dir: str,
+                      chaos_plan: str | None) -> subprocess.Popen:
+    env = _base_env()
+    if chaos_plan:
+        env["KFTPU_CHAOS_PLAN"] = chaos_plan
+    else:
+        env.pop("KFTPU_CHAOS_PLAN", None)
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--serve",
+         "--store", store_path, "--logs", log_dir],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+
+
+def _wait(pred, timeout: float, interval: float = 0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(interval)
+    return None
+
+
+def run_bench(workdir: str) -> dict:
+    from kubeflow_tpu.api import TrainJob
+    from kubeflow_tpu.store import ObjectStore
+
+    store_path = os.path.join(workdir, "store.db")
+    log_dir = os.path.join(workdir, "logs")
+    os.makedirs(log_dir, exist_ok=True)
+
+    store = ObjectStore(store_path)
+    jobs = [_make_job(n) for n in JOB_NAMES]
+    for job in jobs:
+        store.put(job.kind.value, job.to_dict())
+    job_keys = [f"{NAMESPACE}/{n}" for n in JOB_NAMES]
+
+    victim_pids: dict = {}
+    ha: dict = {}
+    worker_pids: set = set()
+    ctl_b = None
+    try:
+        # -- phase 1: controller A spawns the gangs, chaos kills it.
+        ctl_a = _spawn_controller(store_path, log_dir, CRASH_PLAN)
+        rc = _wait(lambda: ctl_a.poll(), timeout=180.0)
+        if rc is None:
+            ctl_a.kill()
+            raise RuntimeError("controller A outlived its crash plan")
+        ha["controller_killed"] = (rc == -signal.SIGKILL)
+        t_kill = time.monotonic()
+
+        victim_pids = _journal_pids(store)
+        worker_pids = {p for ws in victim_pids.values()
+                       for p in ws.values()}
+        if sorted(victim_pids) != sorted(job_keys) or not worker_pids:
+            raise RuntimeError(
+                f"journal incomplete at crash: {victim_pids}")
+
+        # -- phase 2: the outage. Workers must keep stepping with no
+        # controller alive at all.
+        logs = sorted(os.listdir(log_dir))
+        before = {f: _steps_in_log(os.path.join(log_dir, f)) for f in logs}
+        progressed = _wait(
+            lambda: all(
+                _steps_in_log(os.path.join(log_dir, f)) > before[f]
+                for f in logs),
+            timeout=60.0, interval=0.25)
+        ha["workers_progressed_during_outage"] = bool(progressed)
+        ha["outage_seconds_observed"] = round(time.monotonic() - t_kill, 3)
+
+        # -- phase 3: successor adopts.
+        t_b = time.monotonic()
+        ctl_b = _spawn_controller(store_path, log_dir, None)
+
+        def adopted_all():
+            reasons: dict = {}
+            for ev in store.list("Event"):
+                if ev.get("reason") in ("GangAdopted", "GangAdoptionFailed"):
+                    reasons.setdefault(ev.get("involved"), ev["reason"])
+            if all(reasons.get(k) for k in job_keys):
+                return reasons
+            return None
+
+        reasons = _wait(adopted_all, timeout=60.0)
+        if reasons is None:
+            raise RuntimeError("successor never adopted the gangs")
+        ha["adopted"] = all(
+            reasons.get(k) == "GangAdopted" for k in job_keys)
+        ha["adoption_seconds"] = round(time.monotonic() - t_b, 3)
+
+        # -- phase 4: the contract.
+        after_pids = _journal_pids(store)
+        new = {p for ws in after_pids.values() for p in ws.values()}
+        ha["worker_deaths"] = sum(
+            1 for p in worker_pids if not _pid_alive(p))
+        ha["duplicate_spawns"] = (
+            len(new - worker_pids)
+            + max(0, len(os.listdir(log_dir)) - len(logs)))
+        ha["pid_set_unchanged"] = (new == worker_pids)
+        restarts = 0
+        for job in jobs:
+            obj = store.get(job.kind.value, job.name, job.namespace)
+            restarts += TrainJob.from_dict(obj).status.restart_count
+        ha["restart_count_delta"] = restarts
+        ha["lease_seconds"] = LEASE_SECONDS
+        ha["jobs"] = len(jobs)
+        ha["workers"] = len(worker_pids)
+    finally:
+        if ctl_b is not None:
+            ctl_b.terminate()
+            try:
+                ctl_b.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                ctl_b.kill()
+        for pid in worker_pids:
+            for sig in (signal.SIGTERM, signal.SIGKILL):
+                try:
+                    os.killpg(pid, sig)
+                except (ProcessLookupError, PermissionError, OSError):
+                    pass
+        store.close()
+
+    return {
+        "metric": "ctrlha_adoption_seconds",
+        "value": ha.get("adoption_seconds"),
+        "unit": "s (successor start -> last GangAdopted, incl. lease expiry)",
+        "vs_baseline": None,
+        "extra": {"ctrlha": ha},
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--serve", action="store_true")
+    ap.add_argument("--store")
+    ap.add_argument("--logs")
+    ap.add_argument("--workdir")
+    args = ap.parse_args()
+    if args.serve:
+        serve(args.store, args.logs)
+        return
+    if args.workdir:
+        os.makedirs(args.workdir, exist_ok=True)
+        print(json.dumps(run_bench(args.workdir)))
+        return
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="kftpu-ctrlha-") as td:
+        print(json.dumps(run_bench(td)))
+
+
+if __name__ == "__main__":
+    main()

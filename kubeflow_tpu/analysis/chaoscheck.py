@@ -1,7 +1,7 @@
 """Tier C chaos conformance: the fault-injection and recovery machinery
 is itself checked every ``kftpu analyze`` run.
 
-Four rule families, all driven in-process against the REAL code (no
+Five rule families, all driven in-process against the REAL code (no
 live fleet, no sleeps -- injectable clocks and synthetic call
 sequences), so a refactor that silently breaks replayability or the
 breaker contract fails --strict the same run it lands:
@@ -23,6 +23,11 @@ breaker contract fails --strict the same run it lands:
   byte and a truncation (verify False), accepts the intact layout
   (verify True), and reports None -- caller's judgment -- when no
   manifest exists.
+- KT-CHAOS-CTRLCRASH: the ``controller.crash`` seam the crash-HA
+  bench SIGKILLs through is certified at poke level (the check cannot
+  SIGKILL itself): the seam exists in the reconciler, a crash plan
+  fires exactly once at the configured reconcile hit for the targeted
+  job only, replays bit-identically, and carries SIGKILL's wait code.
 """
 
 from __future__ import annotations
@@ -298,6 +303,73 @@ def check_ckpt_manifest() -> List[Finding]:
     return findings
 
 
+# -- KT-CHAOS-CTRLCRASH ------------------------------------------------------
+
+_CRASH_PLAN_JSON = json.dumps({
+    "seed": 7,
+    "faults": [
+        {"kind": "crash", "site": "controller.crash",
+         "target": "default/victim", "at": [3]},
+    ],
+})
+
+# Two jobs' reconcile hits interleaved, as the real loop produces them.
+_CRASH_SEQUENCE: List[Tuple[str, str]] = [
+    ("controller.crash", f"default/{name}")
+    for _ in range(6) for name in ("victim", "bystander")
+]
+
+
+def check_controller_crash() -> List[Finding]:
+    """Certify the controller-crash chaos site at poke level (an
+    in-process check cannot survive the real ``apply`` actuation --
+    that path is exercised by the crash-HA bench, which SIGKILLs a
+    child controller and ratchets the recovery as KT-PERF-CTRLHA)."""
+    findings: List[Finding] = []
+    # The seam must exist: the reconciler pokes controller.crash at the
+    # top of every reconcile, which is what makes a crash plan's hit
+    # index a deterministic reconcile count.
+    import kubeflow_tpu.controller.reconciler as _rec
+    try:
+        with open(_rec.__file__) as f:
+            src = f.read()
+    except OSError:
+        src = ""
+    if 'chaos.apply("controller.crash"' not in src:
+        findings.append(_finding(
+            "KT-CHAOS-CTRLCRASH",
+            "reconciler no longer actuates the controller.crash seam; "
+            "the crash-HA bench cannot kill the controller at a "
+            "deterministic reconcile hit"))
+        return findings
+
+    def replay() -> List[Tuple[str, str, int, str]]:
+        plan = FaultPlan.from_json(_CRASH_PLAN_JSON)
+        fault = None
+        for site, target in _CRASH_SEQUENCE:
+            fault = plan.poke(site, target) or fault
+        if fault is not None and fault.exit_code != 137:
+            findings.append(_finding(
+                "KT-CHAOS-CTRLCRASH",
+                f"crash fault carries exit_code {fault.exit_code}, "
+                "want SIGKILL's wait code 137"))
+        return list(plan.fired)
+
+    first, second = replay(), replay()
+    want = [("controller.crash", "default/victim", 3, "crash")]
+    if first != want:
+        findings.append(_finding(
+            "KT-CHAOS-CTRLCRASH",
+            f"crash plan at=[3] over interleaved reconcile hits fired "
+            f"{first}, want exactly {want} (bystander job must not "
+            "advance the victim's hit counter)"))
+    if first != second:
+        findings.append(_finding(
+            "KT-CHAOS-CTRLCRASH",
+            f"crash plan replay diverged: {first} vs {second}"))
+    return findings
+
+
 def check_chaos() -> Tuple[List[Finding], Dict[str, int]]:
     """Entry point mirroring check_races/check_protocols: returns
     (findings, coverage info)."""
@@ -306,8 +378,9 @@ def check_chaos() -> Tuple[List[Finding], Dict[str, int]]:
     findings.extend(check_breaker())
     findings.extend(check_recovery())
     findings.extend(check_ckpt_manifest())
+    findings.extend(check_controller_crash())
     info = {
-        "determinism_hits": len(_SEQUENCE),
-        "rules": 4,
+        "determinism_hits": len(_SEQUENCE) + len(_CRASH_SEQUENCE),
+        "rules": 5,
     }
     return findings, info

@@ -121,6 +121,11 @@ def latest_sched_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str]
     return _latest_bench_with(root, ("sched",))
 
 
+def latest_ctrlha_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str]:
+    """Newest committed ``bench_ctrlha.py`` round (extra.ctrlha)."""
+    return _latest_bench_with(root, ("ctrlha",))
+
+
 def serving_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str]:
     root = root or _REPO_ROOT
     path = os.path.join(root, "SERVING_BENCH.json")
@@ -369,6 +374,62 @@ def _check_kv_reshard(kbase: dict, kv: dict, artifact: str,
                     f"kv_reshard.{req} = {kv.get(req)!r}, expected "
                     f"true: the resize bench did not prove the "
                     f"migration actually helped ({artifact})"
+                ),
+            ))
+    return findings
+
+
+def _check_ctrlha(hbase: dict, ha: dict, artifact: str,
+                  measured: Dict[str, float]) -> List[Finding]:
+    """KT-PERF-CTRLHA: the controller-crash HA bench (bench_ctrlha.py
+    -- a child controller SIGKILLed by the ``controller.crash`` chaos
+    seam mid-reconcile, its workers left orphaned, a successor
+    controller adopting them from the runtime journal).
+
+    The crash-resilience contract: controller death is a non-event for
+    running jobs -- zero workers die with it, the successor adopts
+    (never respawns, so zero duplicate spawns and restart_count
+    unchanged), and adoption completes under the ceiling. A bound whose
+    metric vanished from the artifact is a finding (same shrunk-curve
+    rule as every other family)."""
+    findings: List[Finding] = []
+
+    def _bound(mkey: str, bkey: str) -> None:
+        limit = hbase.get(bkey)
+        if limit is None:
+            return
+        val = ha.get(mkey)
+        if val is None:
+            findings.append(Finding(
+                rule="KT-PERF-CTRLHA", path=artifact, line=0, hard=True,
+                message=(
+                    f"ctrlha.{mkey}: missing from {artifact} "
+                    f"({bkey}={limit}) -- the crash-HA curve shrank"
+                ),
+            ))
+            return
+        measured[f"ctrlha.{mkey}"] = float(val)
+        if val > limit:
+            findings.append(Finding(
+                rule="KT-PERF-CTRLHA", path=artifact, line=0, hard=True,
+                message=(
+                    f"ctrlha.{mkey} = {val} exceeds ceiling {limit} "
+                    f"({artifact})"
+                ),
+            ))
+
+    _bound("worker_deaths", "worker_deaths_max")
+    _bound("duplicate_spawns", "duplicate_spawns_max")
+    _bound("restart_count_delta", "restart_count_delta_max")
+    _bound("adoption_seconds", "adoption_seconds_ceiling")
+    for req in hbase.get("required") or []:
+        if not ha.get(req):
+            findings.append(Finding(
+                rule="KT-PERF-CTRLHA", path=artifact, line=0, hard=True,
+                message=(
+                    f"ctrlha.{req} = {ha.get(req)!r}, expected true: "
+                    f"the bench did not actually kill and succeed the "
+                    f"controller ({artifact})"
                 ),
             ))
     return findings
@@ -824,6 +885,41 @@ def check_perf(
             else:
                 findings.extend(_check_sched(sbase, sched, artifact,
                                              measured, root))
+
+    # -- controller-crash HA (journal adoption) bounds ----------------------
+    hbase = baseline.get("ctrlha") or {}
+    if hbase:
+        parsed, artifact = latest_ctrlha_bench(root)
+        if parsed is None:
+            # Distinguish the installed-package case (no bench history
+            # at all: quiet skip, like every other family) from a
+            # checkout whose OTHER rounds survived while the ctrlha one
+            # vanished -- deleting BENCH_r09 must not un-ratchet.
+            if glob.glob(os.path.join(root or _REPO_ROOT,
+                                      "BENCH_r*.json")):
+                findings.append(Finding(
+                    rule="KT-PERF-CTRLHA", path="BENCH_r*.json", line=0,
+                    hard=True,
+                    message=(
+                        "ctrlha bounds set but no committed bench round "
+                        "carries extra.ctrlha -- the crash-HA bench "
+                        "vanished"
+                    ),
+                ))
+        else:
+            ha = (parsed.get("extra") or {}).get("ctrlha")
+            if not isinstance(ha, dict):
+                findings.append(Finding(
+                    rule="KT-PERF-CTRLHA", path=artifact, line=0,
+                    hard=True,
+                    message=(
+                        f"no extra.ctrlha section in {artifact} (ctrlha "
+                        f"bounds set) -- the crash-HA bench vanished"
+                    ),
+                ))
+            else:
+                findings.extend(_check_ctrlha(hbase, ha, artifact,
+                                              measured))
 
     # -- live-metric ceilings ----------------------------------------------
     # Checked against THIS analyze run's Tier-B metrics; a ceiling whose

@@ -18,17 +18,28 @@ interleaving) with injected crash/timeout/nack/stale-file faults:
 - **writer**: the scheduler/metric-scaler single-writer rule -- for
   one job, at most one of the two resize authorities ever actuates
   (KT-PROTO-WRITER); explored for both scheduler_managed settings.
+- **lease**: the cross-process extension of the single-writer rule
+  (controller/lease.py): two controller processes race for the
+  store-backed actuation lease with crashes and expiry interleaved.
+  Invariants: no controller actuates outside a currently-valid lease
+  it holds (KT-PROTO-LEASE), and two controllers never actuate
+  concurrently (KT-PROTO-WRITER, now across processes). The model's
+  margin abstraction -- a held lease does not expire mid-actuation --
+  mirrors the real ``held`` check performed immediately before each
+  actuation plus the per-reconcile renewal.
 
 Conformance (KT-PROTO-CONFORM): the checker replays its own explored
-schedules against the REAL file protocol in a tempdir --
-``write_resize_command`` / ``read_resize_command`` /
-``clear_resize_command``, the exact functions the reconciler and the
-worker step loop call -- and diffs each observation against the
-model's prediction, so the model cannot drift from the code.
+schedules against the REAL code in a tempdir -- the file protocol
+(``write_resize_command`` / ``read_resize_command`` /
+``clear_resize_command``) for the reshard model, and two live
+``ControllerLease`` instances over one store (fake clock) for the
+lease model -- and diffs each observation against the model's
+prediction, so the models cannot drift from the code.
 
 All KT-PROTO-* findings are hard: a protocol bug is never
 grandfathered. ``PLANTED_MUTATIONS`` (test hook) re-introduces known
-bug shapes (e.g. skip the unlink on fallback) to prove non-vacuity.
+bug shapes (e.g. skip the unlink on fallback, actuate on an expired
+lease) to prove non-vacuity.
 """
 
 from __future__ import annotations
@@ -48,7 +59,8 @@ from kubeflow_tpu.controller.reshard_protocol import (
 # Test hook: names of protocol bugs to plant (consulted by the models
 # when ``check_protocols`` is called without explicit mutations).
 # Known shapes: "no_unlink_on_fallback", "no_unlink_on_teardown",
-# "no_seq_guard", "leak_reservation", "no_managed_gate".
+# "no_seq_guard", "leak_reservation", "no_managed_gate",
+# "expired_lease_actuation", "double_holder".
 PLANTED_MUTATIONS: Set[str] = set()
 
 MAX_STATES = 100000
@@ -380,6 +392,110 @@ class WriterModel:
 
 
 # --------------------------------------------------------------------------
+# Model 4: controller actuation lease (cross-process single-writer).
+# --------------------------------------------------------------------------
+class LeaseModel:
+    """Two controller processes A/B racing for the store-backed
+    actuation lease (controller/lease.py), with crashes and expiry.
+
+    State: (holder, valid, bel_a, bel_b, a_acting, b_acting, ended).
+    ``holder``/``valid`` are the store row's truth; ``bel_x`` is
+    controller X's local belief that it holds the lease (the real
+    ``ControllerLease.held``: holding flag AND local clock before the
+    expiry it wrote).  Because the local expiry equals the stored
+    expiry, local belief is a lower bound on store validity -- that is
+    the safety argument, and "expired_lease_actuation" breaks exactly
+    it.  Margin abstraction: a lease never lapses mid-actuation; the
+    real loop renews every reconcile and re-checks ``held`` right
+    before each actuation, so an actuation races only the renewal
+    margin, not the full duration.
+    """
+
+    path = "kubeflow_tpu/controller/lease.py"
+    name = "lease"
+
+    def __init__(self, mutations: FrozenSet[str] = frozenset()) -> None:
+        self.mut = frozenset(mutations)
+
+    def initial(self) -> tuple:
+        return ("-", False, False, False, False, False, False)
+
+    def is_terminal(self, s: tuple) -> bool:
+        return s[6]
+
+    def invariant(self, s: tuple) -> Optional[Tuple[str, str]]:
+        holder, valid, bel_a, bel_b, a_act, b_act, _ended = s
+        if a_act and b_act:
+            return ("KT-PROTO-WRITER",
+                    "two controller processes actuated concurrently "
+                    "(lease fence broken)")
+        for x, acting in (("A", a_act), ("B", b_act)):
+            if acting and not (holder == x and valid):
+                return ("KT-PROTO-LEASE",
+                        f"controller {x} actuated without a currently "
+                        f"valid lease (store holder={holder}, "
+                        f"valid={valid})")
+        return None
+
+    def actions(self, s: tuple):
+        holder, valid, ended = s[0], s[1], s[6]
+        bel = {"A": s[2], "B": s[3]}
+        act = {"A": s[4], "B": s[5]}
+        if ended:
+            return []
+
+        def pack(h, v, bel2, act2, e=False) -> tuple:
+            return (h, v, bel2["A"], bel2["B"], act2["A"], act2["B"], e)
+
+        out: List[Tuple[str, tuple]] = []
+        for x in ("A", "B"):
+            # Acquire/takeover: the CAS succeeds only when the row is
+            # absent or expired.  "double_holder" breaks the CAS and
+            # lets a rival steal a live lease.
+            can = (holder == "-" or not valid
+                   or "double_holder" in self.mut)
+            if can and not bel[x]:
+                bel2 = dict(bel)
+                bel2[x] = True
+                out.append((f"acquire_{x}", pack(x, True, bel2, act)))
+            # Lapse: the holder misses renewals past the expiry.  The
+            # local belief dies with the stored validity (same
+            # timestamp) -- unless "expired_lease_actuation" plants the
+            # stale-belief bug (impl keeps acting past its expiry).
+            if holder == x and valid and not act[x]:
+                bel2 = dict(bel)
+                if "expired_lease_actuation" not in self.mut:
+                    bel2[x] = False
+                out.append((f"expire_{x}", pack(x, False, bel2, act)))
+            # A fenced controller's next renew fails and drops belief.
+            if bel[x] and not (holder == x and valid):
+                bel2 = dict(bel)
+                bel2[x] = False
+                out.append((f"renew_fail_{x}",
+                            pack(holder, valid, bel2, act)))
+            # Crash: the process vanishes mid-anything; the store row
+            # lingers until expiry (takeover latency).
+            if bel[x] or act[x]:
+                bel2, act2 = dict(bel), dict(act)
+                bel2[x] = act2[x] = False
+                out.append((f"crash_{x}",
+                            pack(holder, valid, bel2, act2)))
+            if bel[x] and not act[x]:
+                act2 = dict(act)
+                act2[x] = True
+                out.append((f"begin_act_{x}",
+                            pack(holder, valid, bel, act2)))
+            if act[x]:
+                act2 = dict(act)
+                act2[x] = False
+                out.append((f"end_act_{x}",
+                            pack(holder, valid, bel, act2)))
+        if not act["A"] and not act["B"]:
+            out.append(("shutdown", pack(holder, valid, bel, act, True)))
+        return out
+
+
+# --------------------------------------------------------------------------
 # Conformance: replay explored schedules against the real file protocol.
 # --------------------------------------------------------------------------
 _MAX_CONFORM_TRACES = 16
@@ -471,6 +587,87 @@ def conformance_check(tmpdir: str) -> Tuple[List[Finding], int]:
     return findings, len(traces)
 
 
+def lease_conformance_check() -> Tuple[List[Finding], int]:
+    """Replay the (unmutated) lease model's schedules against two real
+    ``ControllerLease`` instances sharing one store, on an injected
+    clock: acquire -> try_acquire() must succeed, expire -> advance the
+    clock past the written expiry and ``held`` must drop, crash ->
+    replace the instance (restarted process, fresh holder id),
+    begin_act -> the pre-actuation ``held`` fence must pass.  After
+    every step at most one instance may report ``held`` -- the
+    KT-PROTO-WRITER guarantee, pinned to the code."""
+    from kubeflow_tpu.controller.lease import ControllerLease
+    from kubeflow_tpu.store.store import ObjectStore
+
+    findings: List[Finding] = []
+    res = explore(LeaseModel(frozenset()))
+    traces = _terminal_traces(res)
+    dur = 10.0
+    for ti, labels in enumerate(traces):
+        store = ObjectStore(":memory:")
+        clock = [1000.0]
+        epoch = {"A": 0, "B": 0}
+
+        def mk(x: str) -> "ControllerLease":
+            return ControllerLease(
+                store, holder=f"ctrl-{x}-r{epoch[x]}",
+                duration_seconds=dur, now=lambda: clock[0])
+
+        leases = {"A": mk("A"), "B": mk("B")}
+
+        def diverged(step: str, detail: str) -> Finding:
+            return Finding(
+                rule="KT-PROTO-CONFORM",
+                path="kubeflow_tpu/controller/lease.py",
+                line=0, hard=True,
+                message=(f"lease conformance replay diverged at {step} "
+                         f"(trace {' -> '.join(labels)}): {detail}"),
+            )
+
+        broke = False
+        for label in labels:
+            if label == "shutdown":
+                break
+            op, _, x = label.rpartition("_")
+            if op == "acquire":
+                if not leases[x].try_acquire():
+                    findings.append(diverged(
+                        label, "model acquires but try_acquire() "
+                        "returned False"))
+                    broke = True
+            elif op == "expire":
+                clock[0] += dur + 1.0
+                if leases[x].held:
+                    findings.append(diverged(
+                        label, "clock passed the expiry but held is "
+                        "still True"))
+                    broke = True
+            elif op == "renew_fail":
+                if leases[x].renew():
+                    findings.append(diverged(
+                        label, "model loses the lease but renew() "
+                        "returned True"))
+                    broke = True
+            elif op == "crash":
+                epoch[x] += 1
+                leases[x] = mk(x)  # restarted process, empty belief
+            elif op == "begin_act":
+                if not leases[x].held:
+                    findings.append(diverged(
+                        label, "model actuates but the pre-actuation "
+                        "held fence failed"))
+                    broke = True
+            # end_act: no lease op.
+            if broke:
+                break
+            if leases["A"].held and leases["B"].held:
+                findings.append(diverged(
+                    label, "both controllers report held=True"))
+                break
+        store.close()
+    return findings, len(traces)
+
+
 def check_protocols(
     mutations: Optional[Set[str]] = None,
     conformance: bool = True,
@@ -486,6 +683,7 @@ def check_protocols(
         GangModel(mut),
         WriterModel(managed=True, mutations=mut),
         WriterModel(managed=False, mutations=mut),
+        LeaseModel(mut),
     ]
     for model in models:
         res = explore(model)
@@ -496,5 +694,8 @@ def check_protocols(
             conform_findings, n = conformance_check(td)
         findings.extend(conform_findings)
         info["proto.conform.traces"] = float(n)
+        lease_findings, ln = lease_conformance_check()
+        findings.extend(lease_findings)
+        info["proto.conform.lease_traces"] = float(ln)
     findings.sort(key=lambda f: (f.path, f.rule, f.message))
     return findings, info

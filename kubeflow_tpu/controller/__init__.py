@@ -8,6 +8,7 @@ pool.
 """
 
 from kubeflow_tpu.controller.gang import GangScheduler, Reservation  # noqa: F401
+from kubeflow_tpu.controller.journal import RuntimeJournal  # noqa: F401
 from kubeflow_tpu.controller.launcher import (  # noqa: F401
     BaseLauncher,
     FakeLauncher,
@@ -15,6 +16,7 @@ from kubeflow_tpu.controller.launcher import (  # noqa: F401
     SpawnRequest,
     WorkerRef,
 )
+from kubeflow_tpu.controller.lease import ControllerLease  # noqa: F401
 from kubeflow_tpu.controller.reconciler import JobController  # noqa: F401
 from kubeflow_tpu.controller.scheduler import (  # noqa: F401
     ClusterScheduler,

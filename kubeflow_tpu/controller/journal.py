@@ -1,0 +1,160 @@
+"""Durable runtime journal: controller memory that survives the controller.
+
+``JobController._runtimes`` holds the facts the reconciler needs to manage
+a live gang -- worker pids, the rendezvous port, the gang's reservation,
+the last reshard sequence number, which watchdog timers are armed. Those
+facts used to live only in process memory, which made the controller a
+single point of failure: SIGKILL it and every running gang was orphaned
+even though the object store underneath is SQLite-durable.
+
+The journal closes that gap the Kubernetes way (PAPER.md section 1-2: the
+API server + etcd outlive any individual controller). Each admitted gang
+gets one ``RuntimeJournal`` object in the store, keyed like its job and
+rewritten through the ordinary revisioned ``put`` path at every actuation
+(spawn, respawn, reshard initiate/ack, teardown). A restarted controller
+lists the journal kind, probes each recorded pid, and adopts healthy
+gangs without respawning them (``JobController._adopt_orphans``); the
+journal record carries everything adoption needs to rebuild a
+``_JobRuntime`` and a ``SpawnRequest`` per worker, including the spawn-env
+hash used to reject recycled pids.
+
+The journal is written only by the lease-holding controller
+(``lease.ControllerLease``), so records never race: one writer, fenced by
+the store's ``expect_generation`` CAS underneath the lease itself.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from kubeflow_tpu.controller.launcher import SpawnRequest, WorkerRef
+
+log = logging.getLogger(__name__)
+
+#: Store kind for journal records. One record per admitted gang, named and
+#: namespaced exactly like the job it shadows.
+JOURNAL_KIND = "RuntimeJournal"
+
+
+def env_hash(env: Iterable[Tuple[str, str]]) -> str:
+    """Stable digest of a spawn environment.
+
+    Adoption compares this against the journaled value reconstructed from
+    ``/proc/<pid>/environ`` to catch pid recycling: a recycled pid is alive
+    but was not spawned with this gang's rendezvous env.
+    """
+    blob = "\x00".join(f"{k}={v}" for k, v in sorted(env))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _worker_entry(ref: WorkerRef) -> Dict[str, Any]:
+    req = ref.req
+    return {
+        "pid": ref.pid,
+        "generation": ref.generation,
+        "replica_type": req.replica_type,
+        "index": req.index,
+        "entrypoint": req.entrypoint,
+        "args": list(req.args),
+        "env": [[k, v] for k, v in req.env],
+        "workdir": req.workdir,
+        "exec": bool(req.exec_),
+        "log_path": ref.log_path,
+        "spawned_at": ref.spawned_at,
+        "env_hash": env_hash(req.env),
+    }
+
+
+def spawn_request_from_entry(job_key: str, entry: Dict[str, Any]) -> SpawnRequest:
+    """Rebuild the ``SpawnRequest`` a journaled worker was launched with."""
+    return SpawnRequest(
+        job_key=job_key,
+        replica_type=str(entry["replica_type"]),
+        index=int(entry["index"]),
+        entrypoint=str(entry["entrypoint"]),
+        args=tuple(str(a) for a in entry.get("args") or ()),
+        env=tuple((str(k), str(v)) for k, v in entry.get("env") or ()),
+        workdir=entry.get("workdir"),
+        exec_=bool(entry.get("exec")),
+    )
+
+
+class RuntimeJournal:
+    """Store-backed per-gang runtime records (see module docstring)."""
+
+    KIND = JOURNAL_KIND
+
+    def __init__(self, store) -> None:
+        self.store = store
+
+    def record(
+        self,
+        job_kind: str,
+        rt,
+        reservation=None,
+        *,
+        hang_deadline: Optional[float] = None,
+        metric_deadline: Optional[float] = None,
+        updated_at: float = 0.0,
+    ) -> None:
+        """Write (or rewrite) the journal record for one live gang.
+
+        ``rt`` is the reconciler's ``_JobRuntime``; ``reservation`` the
+        gang scheduler's ``Reservation`` (both duck-typed to avoid an
+        import cycle). Timer deadlines are absolute ``time.time`` seconds
+        so a restarted controller re-arms watchdogs with the remaining
+        budget instead of silently granting a fresh one.
+        """
+        ns, name = rt.key.split("/", 1)
+        obj: Dict[str, Any] = {
+            "metadata": {"name": name, "namespace": ns},
+            "job_kind": job_kind,
+            "coordinator_port": rt.coordinator_port,
+            "spec_world": [list(w) for w in rt.spec_world],
+            "formed_world": [list(w) for w in rt.formed_world],
+            "formed_replicas": rt.formed_replicas,
+            "reshard_seq": rt.reshard_seq,
+            "reshard_pending": (list(rt.reshard_pending)
+                                if rt.reshard_pending else None),
+            "hostfile_path": rt.hostfile_path,
+            "reservation": (
+                {
+                    "chips": reservation.chips,
+                    "processes": reservation.processes,
+                    "queue": reservation.queue,
+                    "priority": reservation.priority,
+                }
+                if reservation is not None
+                else None
+            ),
+            "timers": {
+                "hang_deadline": hang_deadline,
+                "metric_deadline": metric_deadline,
+            },
+            "workers": {
+                wid: _worker_entry(ref) for wid, ref in rt.workers.items()
+            },
+            "updated_at": updated_at,
+        }
+        try:
+            self.store.put(self.KIND, obj)
+        except Exception:  # pragma: no cover - store closed during shutdown
+            log.warning("journal record failed for %s", rt.key, exc_info=True)
+
+    def remove(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        try:
+            self.store.delete(self.KIND, name, ns)
+        except Exception:  # pragma: no cover - store closed during shutdown
+            log.warning("journal remove failed for %s", key, exc_info=True)
+
+    def load_all(self) -> List[Dict[str, Any]]:
+        """All journal records, as stored dicts (adoption input)."""
+        return list(self.store.list(self.KIND))
+
+    @staticmethod
+    def key_of(rec: Dict[str, Any]) -> str:
+        md = rec.get("metadata") or {}
+        return f"{md.get('namespace', 'default')}/{md.get('name')}"

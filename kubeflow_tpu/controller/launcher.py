@@ -29,6 +29,23 @@ logger = logging.getLogger(__name__)
 
 ExitCallback = Callable[["WorkerRef", int], Awaitable[None]]
 
+#: Poll interval for adopted (non-child) workers, whose exits cannot be
+#: reaped with ``wait()``.
+ADOPT_POLL_SECONDS = 0.25
+
+
+def pid_alive(pid: int) -> bool:
+    """Signal-0 liveness probe (EPERM counts as alive)."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
 
 @dataclasses.dataclass(frozen=True)
 class SpawnRequest:
@@ -85,6 +102,16 @@ class BaseLauncher:
     async def spawn(self, req: SpawnRequest) -> WorkerRef:
         raise NotImplementedError
 
+    def adopt(
+        self,
+        req: SpawnRequest,
+        pid: int,
+        log_path: Optional[str] = None,
+        spawned_at: float = 0.0,
+    ) -> WorkerRef:
+        """Attach to an already-running worker spawned by a dead controller."""
+        raise NotImplementedError
+
     async def kill(self, ref: WorkerRef, grace_seconds: float = 5.0) -> None:
         raise NotImplementedError
 
@@ -107,6 +134,9 @@ class ProcessLauncher(BaseLauncher):
         super().__init__()
         self.log_dir = log_dir
         self._procs: dict[str, tuple[WorkerRef, asyncio.subprocess.Process]] = {}
+        # Workers inherited from a dead controller: not our children, so
+        # their exits are observed by pid polling instead of wait().
+        self._adopted: dict[str, WorkerRef] = {}
         self._waiters: set[asyncio.Task] = set()
         self._generation = 0
 
@@ -166,7 +196,94 @@ class ProcessLauncher(BaseLauncher):
         if self._exit_cb is not None:
             await self._exit_cb(ref, code)
 
+    def adopt(
+        self,
+        req: SpawnRequest,
+        pid: int,
+        log_path: Optional[str] = None,
+        spawned_at: float = 0.0,
+    ) -> WorkerRef:
+        """Attach to a worker process this launcher did not spawn.
+
+        Used by crash recovery (``JobController._adopt_orphans``): the
+        worker is a live process left behind by a dead controller, so it is
+        not our child -- ``wait()`` would raise. A poller task watches pid
+        liveness and fires the ordinary exit callback when the process
+        disappears, inferring the exit code from the worker's own
+        ``train_end`` metric line (clean completion) or assuming SIGKILL.
+        """
+        self._generation += 1
+        ref = WorkerRef(
+            req=req, pid=pid, generation=self._generation,
+            log_path=log_path, spawned_at=spawned_at,
+        )
+        self._adopted[ref.worker_id] = ref
+        logger.info("adopted %s pid=%d", ref.worker_id, pid)
+        task = asyncio.create_task(self._watch_adopted(ref))
+        self._waiters.add(task)
+        task.add_done_callback(self._waiters.discard)
+        return ref
+
+    async def _watch_adopted(self, ref: WorkerRef) -> None:
+        while ref.alive and pid_alive(ref.pid):
+            await asyncio.sleep(ADOPT_POLL_SECONDS)
+        if not ref.alive:
+            return  # killed through us; kill() already settled the ref
+        code = self._infer_adopted_exit(ref)
+        ref.alive = False
+        ref.exit_code = code
+        if self._adopted.get(ref.worker_id) is ref:
+            del self._adopted[ref.worker_id]
+        logger.info("adopted worker %s exited code=%s (inferred)",
+                    ref.worker_id, code)
+        if self._exit_cb is not None:
+            await self._exit_cb(ref, code)
+
+    @staticmethod
+    def _infer_adopted_exit(ref: WorkerRef) -> int:
+        """Adopted pids cannot be reaped, so the exit code is inferred:
+        a ``train_end`` metric line in the log tail means the worker ran
+        to completion (0); anything else is treated as a kill (137)."""
+        if not ref.log_path:
+            return 137
+        try:
+            with open(ref.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - 16384))
+                tail = f.read().decode(errors="replace")
+        except OSError:
+            return 137
+        from kubeflow_tpu.runtime.metrics import parse_metric_line
+
+        for line in reversed(tail.splitlines()):
+            kv = parse_metric_line(line)
+            if kv and kv.get("event") == "train_end":
+                return 0
+        return 137
+
+    async def _kill_adopted(self, ref: WorkerRef, grace_seconds: float) -> None:
+        ref.alive = False  # claim the exit before the poller can
+        ref.exit_code = -signal.SIGTERM
+        if self._adopted.get(ref.worker_id) is ref:
+            del self._adopted[ref.worker_id]
+        try:
+            os.killpg(ref.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            return
+        deadline = time.time() + grace_seconds
+        while time.time() < deadline:
+            if not pid_alive(ref.pid):
+                return
+            await asyncio.sleep(0.05)
+        try:
+            os.killpg(ref.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
     async def kill(self, ref: WorkerRef, grace_seconds: float = 5.0) -> None:
+        if self._adopted.get(ref.worker_id) is ref:
+            await self._kill_adopted(ref, grace_seconds)
+            return
         entry = self._procs.get(ref.worker_id)
         if entry is None or entry[0] is not ref or not ref.alive:
             return
@@ -187,6 +304,7 @@ class ProcessLauncher(BaseLauncher):
 
     async def shutdown(self) -> None:
         refs = [ref for ref, _ in self._procs.values()]
+        refs += list(self._adopted.values())
         await asyncio.gather(
             *(self.kill(r, grace_seconds=2.0) for r in refs), return_exceptions=True
         )
@@ -198,7 +316,9 @@ class ProcessLauncher(BaseLauncher):
                     t.cancel()
 
     def running(self) -> list[WorkerRef]:
-        return [ref for ref, _ in self._procs.values()]
+        return [ref for ref, _ in self._procs.values()] + list(
+            self._adopted.values()
+        )
 
 
 class FakeLauncher(BaseLauncher):
@@ -212,6 +332,7 @@ class FakeLauncher(BaseLauncher):
     def __init__(self) -> None:
         super().__init__()
         self.spawned: list[SpawnRequest] = []
+        self.adopted: list[SpawnRequest] = []
         self.killed: list[str] = []
         self._live: dict[str, WorkerRef] = {}
         self._next_pid = 1000
@@ -220,6 +341,22 @@ class FakeLauncher(BaseLauncher):
         self.spawned.append(req)
         self._next_pid += 1
         ref = WorkerRef(req=req, pid=self._next_pid, generation=self._next_pid)
+        self._live[req.worker_id] = ref
+        return ref
+
+    def adopt(
+        self,
+        req: SpawnRequest,
+        pid: int,
+        log_path: Optional[str] = None,
+        spawned_at: float = 0.0,
+    ) -> WorkerRef:
+        self.adopted.append(req)
+        self._next_pid += 1
+        ref = WorkerRef(
+            req=req, pid=pid, generation=self._next_pid,
+            log_path=log_path, spawned_at=spawned_at,
+        )
         self._live[req.worker_id] = ref
         return ref
 

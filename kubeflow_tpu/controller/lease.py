@@ -1,0 +1,158 @@
+"""Store-backed controller lease: single-writer actuation across processes.
+
+The in-process single-writer discipline (protocheck's WriterModel) keeps
+two *threads* from actuating the same gang. This module extends that
+guarantee across *processes*: all actuation (spawn / evict / resize /
+preempt) is gated on holding a ``Lease`` object in the shared SQLite
+store -- the same coordination-lease shape Kubernetes controllers use for
+leader election (``coordination.k8s.io/Lease``).
+
+Mechanics:
+
+- The lease is one store object (kind ``Lease``, a fixed name) carrying
+  ``holder`` and an absolute wall-clock ``expiry``. Acquisition and
+  renewal go through ``put(expect_generation=...)``, so the store's CAS is
+  the arbiter -- two controllers racing for an expired lease produce
+  exactly one winner and one ``ConflictError``.
+- The holder renews once per reconcile iteration, extending ``expiry`` by
+  ``duration_seconds``. ``held`` is a *local* check (``now < expiry`` for
+  the last successful renewal), which is safe because a rival can only
+  take over after that same expiry passes: local validity is always a
+  lower bound on store validity.
+- A second controller blocks in ``wait_acquire`` until the incumbent's
+  expiry passes (crash takeover) or the lease is released (clean
+  handoff), then adopts the incumbent's journaled gangs
+  (``journal.RuntimeJournal``).
+
+The small-scope model of this protocol -- including the two planted
+mutations ``expired_lease_actuation`` (act on stale local belief) and
+``double_holder`` (acquire ignores a live rival) -- is
+``analysis/protocheck.py:LeaseModel``; ``lease_conformance_check`` replays
+its terminal traces against this real implementation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import socket
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+from kubeflow_tpu.store.store import ConflictError
+
+log = logging.getLogger(__name__)
+
+LEASE_KIND = "Lease"
+LEASE_NAME = "controller"
+LEASE_NAMESPACE = "kftpu-system"
+
+
+def default_holder() -> str:
+    return f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+
+
+class ControllerLease:
+    """One controller's handle on the shared actuation lease."""
+
+    KIND = LEASE_KIND
+    NAME = LEASE_NAME
+    NAMESPACE = LEASE_NAMESPACE
+
+    def __init__(
+        self,
+        store,
+        holder: Optional[str] = None,
+        duration_seconds: float = 15.0,
+        now: Callable[[], float] = time.time,
+    ) -> None:
+        self.store = store
+        self.holder = holder or default_holder()
+        self.duration = float(duration_seconds)
+        self._now = now
+        self._expiry = 0.0  # local view of our last successful renewal
+        self._holding = False
+        #: Fencing token: the lease object's generation at our last
+        #: successful acquire/renew. Strictly increases across takeovers.
+        self.token = 0
+
+    # ------------------------------------------------------------------
+    @property
+    def held(self) -> bool:
+        """Local validity: acquired, and our renewal has not expired.
+
+        This is the predicate every actuation site checks. It never
+        consults the store -- a stalled controller whose renewal lapsed
+        sees ``held == False`` from its own clock, which is exactly when a
+        rival may have taken over (KT-PROTO-LEASE in the model).
+        """
+        return self._holding and self._now() < self._expiry
+
+    def read(self) -> Optional[Dict[str, Any]]:
+        return self.store.get(self.KIND, self.NAME, self.NAMESPACE)
+
+    # ------------------------------------------------------------------
+    def try_acquire(self) -> bool:
+        """One CAS attempt at acquiring (or renewing) the lease.
+
+        Succeeds iff the lease is absent, already ours, or expired.
+        Returns False when a rival holds a live lease or we lose the CAS
+        race -- never raises on contention.
+        """
+        now = self._now()
+        obj = self.read()
+        if obj is not None and obj.get("holder") != self.holder and \
+                float(obj.get("expiry") or 0.0) > now:
+            self._holding = False
+            return False
+        expect = (obj.get("metadata", {}).get("generation")
+                  if obj is not None else 0)
+        body = {
+            "metadata": {"name": self.NAME, "namespace": self.NAMESPACE},
+            "holder": self.holder,
+            "expiry": now + self.duration,
+            "acquired_at": (obj.get("acquired_at") if obj is not None
+                            and obj.get("holder") == self.holder
+                            else now),
+            "duration_seconds": self.duration,
+        }
+        try:
+            saved = self.store.put(self.KIND, body, expect_generation=expect)
+        except ConflictError:
+            # Lost the race; the winner's lease is live.
+            self._holding = False
+            return False
+        prev = obj.get("holder") if obj is not None else None
+        if prev != self.holder:
+            log.info("lease %s/%s acquired by %s (from %s)",
+                     self.NAMESPACE, self.NAME, self.holder, prev)
+        self._expiry = now + self.duration
+        self._holding = True
+        self.token = int(saved["metadata"]["generation"])
+        return True
+
+    def renew(self) -> bool:
+        """Extend our lease; returns False when we lost it."""
+        return self.try_acquire()
+
+    async def wait_acquire(self, poll_seconds: float = 0.2) -> None:
+        """Block until we hold the lease (second-controller standby)."""
+        while not self.try_acquire():
+            obj = self.read()
+            remaining = (float(obj.get("expiry") or 0.0) - self._now()
+                         if obj is not None else 0.0)
+            await asyncio.sleep(min(max(remaining, 0.02), poll_seconds))
+
+    def release(self) -> None:
+        """Clean handoff: drop the lease so a standby takes over now."""
+        if not self._holding:
+            return
+        self._holding = False
+        try:
+            obj = self.read()
+            if obj is not None and obj.get("holder") == self.holder:
+                self.store.delete(self.KIND, self.NAME, self.NAMESPACE)
+        except Exception:  # pragma: no cover - store closed during shutdown
+            log.debug("lease release failed", exc_info=True)

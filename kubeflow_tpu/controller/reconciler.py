@@ -41,15 +41,29 @@ from kubeflow_tpu.api.types import (
     TrainJob,
 )
 from kubeflow_tpu.api.validation import SUCCESS_POLICY_REPLICA
+from kubeflow_tpu import chaos
 from kubeflow_tpu.controller.envvars import (
+    ENV_RESIZE_FILE,
     mpi_hostfile_content,
     rendezvous_env,
     resize_file_path,
 )
 from kubeflow_tpu.controller.gang import GangScheduler
-from kubeflow_tpu.controller.launcher import BaseLauncher, SpawnRequest, WorkerRef
+from kubeflow_tpu.controller.journal import (
+    RuntimeJournal,
+    env_hash,
+    spawn_request_from_entry,
+)
+from kubeflow_tpu.controller.launcher import (
+    BaseLauncher,
+    SpawnRequest,
+    WorkerRef,
+    pid_alive,
+)
+from kubeflow_tpu.controller.lease import ControllerLease
 from kubeflow_tpu.controller.reshard_protocol import (
     clear_resize_command,
+    read_resize_command,
     write_resize_command,
 )
 from kubeflow_tpu.controller.restarts import should_restart
@@ -73,7 +87,12 @@ GANG_RESTART_KINDS = {
 
 @dataclass
 class _JobRuntime:
-    """Controller-side state for one live job (never persisted)."""
+    """Controller-side state for one live job.
+
+    In-memory only, but shadowed by a durable ``RuntimeJournal`` store
+    object when journaling is enabled: every actuation rewrites the
+    journal, and a restarted controller rebuilds this structure from it
+    (``_adopt_orphans``) without touching the worker processes."""
 
     key: str
     coordinator_port: int
@@ -108,6 +127,12 @@ class _JobRuntime:
     reshard_fallback: bool = False
     # On-disk MPI hostfile for this gang generation; removed at teardown.
     hostfile_path: Optional[str] = None
+    # Wall-clock deadlines of the next hang-check / metric-scaler fire,
+    # journaled so a restarted controller re-arms watchdogs with the
+    # REMAINING budget (a restart must not silently grant a wedged gang
+    # a fresh quiet period).
+    hang_deadline: float = 0.0
+    metric_deadline: float = 0.0
     # Hang detection's step-progress memory: worker_id -> (last KFTPU-METRIC
     # step value seen, when it last ADVANCED). Workers that emit the metric
     # protocol are judged by step advance, not log mtime (SURVEY.md 5.3:
@@ -129,11 +154,19 @@ class JobController:
         log_dir: Optional[str] = None,
         backoff_base_seconds: float = 1.0,
         backoff_max_seconds: float = 30.0,
+        journal: Optional[RuntimeJournal] = None,
+        lease: Optional[ControllerLease] = None,
     ) -> None:
         self.store = store
         self.launcher = launcher
         self.gang = gang
         self.log_dir = log_dir
+        # Crash resilience (both optional so embedded/test controllers
+        # keep their historical zero-setup behavior): the journal shadows
+        # _runtimes in the store, the lease fences actuation to a single
+        # controller process (docs/CONTROLPLANE.md).
+        self._journal = journal
+        self._lease = lease
         self.backoff_base = backoff_base_seconds
         self.backoff_max = backoff_max_seconds
         self._runtimes: dict[str, _JobRuntime] = {}
@@ -158,7 +191,16 @@ class JobController:
     # -- public lifecycle -------------------------------------------------
 
     async def run(self) -> None:
-        """Main loop: initial sync, then process watch events + requeues."""
+        """Main loop: acquire the lease, adopt orphans, initial sync, then
+        process watch events + requeues."""
+        if self._lease is not None:
+            # Single-writer fence: a standby controller parks here until
+            # the incumbent's lease expires (crash) or is released (clean
+            # handoff), then takes over by adopting its journaled gangs.
+            await self._acquire_or_stop()
+            if self._stopped.is_set():
+                return
+        await self._adopt_orphans()
         watch_q = self.store.watch()
         for kind in JOB_KINDS:
             for obj in self.store.list(kind):
@@ -177,6 +219,9 @@ class JobController:
                     item = get.result()
                     self._queued.discard(item)
                     kind, ns, name = item
+                    await self._ensure_lease()
+                    if self._stopped.is_set():
+                        break
                     try:
                         await self._reconcile(kind, ns, name)
                     except Exception:
@@ -186,9 +231,49 @@ class JobController:
             watcher.cancel()
             self.store.unwatch(watch_q)
 
+    async def _ensure_lease(self) -> None:
+        """Renew the actuation lease before each reconcile; on loss, fence
+        ourselves (abandon runtimes WITHOUT killing their processes -- the
+        new holder has adopted them), block until we re-acquire, then adopt
+        back whatever is still journaled."""
+        if self._lease is None:
+            return
+        if self._lease.renew():
+            return
+        logger.warning(
+            "actuation lease lost to %s; fencing %d runtimes",
+            (self._lease.read() or {}).get("holder"), len(self._runtimes),
+        )
+        for key in list(self._runtimes):
+            self._runtimes.pop(key, None)
+            self.gang.release(key)
+        await self._acquire_or_stop()
+        if not self._stopped.is_set():
+            await self._adopt_orphans()
+
+    async def _acquire_or_stop(self) -> None:
+        """Block on lease acquisition, but yield to stop() -- a standby
+        that is shut down must not wedge waiting for a live incumbent."""
+        acq = asyncio.create_task(self._lease.wait_acquire())
+        stop = asyncio.create_task(self._stopped.wait())
+        _, pending = await asyncio.wait(
+            {acq, stop}, return_when=asyncio.FIRST_COMPLETED
+        )
+        for t in pending:
+            t.cancel()
+
+    def _fenced(self) -> bool:
+        """True when actuation is forbidden: a lease is configured but not
+        currently held. Timer callbacks that touch the world directly
+        (reshard command files, reservations) check this; the reconcile
+        loop itself renews before every item."""
+        return self._lease is not None and not self._lease.held
+
     async def stop(self) -> None:
         self._stopped.set()
         await self.launcher.shutdown()
+        if self._lease is not None:
+            self._lease.release()
         if self._hostfile_dir is not None:
             shutil.rmtree(self._hostfile_dir, ignore_errors=True)
             self._hostfile_dir = None
@@ -209,6 +294,266 @@ class JobController:
         asyncio.get_running_loop().call_later(
             delay, self._enqueue, kind, namespace, name
         )
+
+    # -- runtime journal + orphan adoption --------------------------------
+
+    def _journal_record(self, rt: _JobRuntime) -> None:
+        """Shadow one runtime into the durable journal (no-op when
+        journaling is off or the runtime is already superseded)."""
+        if self._journal is None or self._runtimes.get(rt.key) is not rt:
+            return
+        ns, name = rt.key.split("/", 1)
+        kind, _ = self._find_job(ns, name)
+        self._journal.record(
+            kind or "", rt, self.gang.reservation(rt.key),
+            hang_deadline=rt.hang_deadline or None,
+            metric_deadline=rt.metric_deadline or None,
+            updated_at=time.time(),
+        )
+
+    def _journal_remove(self, key: str) -> None:
+        if self._journal is not None:
+            self._journal.remove(key)
+
+    @staticmethod
+    def _probe_worker(ent: dict) -> bool:
+        """Is the journaled worker still OUR worker?
+
+        pid liveness (signal 0) plus spawn-env identity: the env a process
+        was started with is immutable in ``/proc/<pid>/environ``, so a
+        recycled pid -- alive, but some other program -- hashes
+        differently and is rejected. A worker whose log file vanished is
+        also rejected: its metric stream (hang detection, reshard acks,
+        scaler input) cannot be re-attached.
+        """
+        pid = int(ent.get("pid") or 0)
+        if not pid_alive(pid):
+            return False
+        lp = ent.get("log_path")
+        if lp and not os.path.exists(lp):
+            return False
+        want = ent.get("env_hash")
+        env = ent.get("env") or []
+        if want and env:
+            got = JobController._proc_env_hash(pid, env)
+            if got is not None and got != want:
+                return False
+        return True
+
+    @staticmethod
+    def _proc_env_hash(pid: int, env_entries: list) -> Optional[str]:
+        """Recompute the spawn-env hash from /proc (None when the procfs
+        read is impossible -- probe falls back to pid liveness alone)."""
+        try:
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                raw = f.read()
+        except OSError:
+            return None
+        pe: dict[str, str] = {}
+        for chunk in raw.split(b"\0"):
+            if b"=" in chunk:
+                k, _, v = chunk.partition(b"=")
+                pe[k.decode(errors="replace")] = v.decode(errors="replace")
+        pairs = []
+        for k, _v in env_entries:
+            if str(k) not in pe:
+                return "absent"  # guaranteed mismatch: not our spawn env
+            pairs.append((str(k), pe[str(k)]))
+        return env_hash(pairs)
+
+    async def _adopt_orphans(self) -> None:
+        """Startup scan: re-attach every gang the previous controller
+        journaled. Healthy gangs are adopted in place (exit watchers,
+        timers and reservations rebuilt; zero respawns, restart_count
+        untouched); gangs with dead or unrecognizable workers are routed
+        through the ORDINARY gang-restart path by recording the dead
+        workers as failures. Runs before the watch loop, so the first
+        reconcile of each job already sees its adopted runtime."""
+        if self._journal is None:
+            return
+        records = self._journal.load_all()
+        if not records:
+            return
+        t0 = time.time()
+        adopted = failed = 0
+        for rec in records:
+            key = RuntimeJournal.key_of(rec)
+            ns, name = key.split("/", 1)
+            kind, obj = self._find_job(ns, name)
+            if obj is None:
+                # Job deleted during the outage: orphans must not outlive
+                # their job.
+                await self._reap_orphans(rec)
+                self._journal.remove(key)
+                continue
+            job = TrainJob.from_dict(obj)
+            terminal = job.status.phase.value in ("Succeeded", "Failed")
+            keep_residual = (job.spec.run_policy.clean_pod_policy
+                             not in (CleanPodPolicy.Running,
+                                     CleanPodPolicy.All))
+            if job.spec.run_policy.suspend or (terminal and not keep_residual):
+                await self._reap_orphans(rec)
+                self._journal.remove(key)
+                self._enqueue(kind, ns, name)
+                continue
+            if terminal:
+                # clean_pod_policy=None residuals keep running by design;
+                # nothing to manage, drop the journal record only.
+                self._journal.remove(key)
+                self._enqueue(kind, ns, name)
+                continue
+            if await self._adopt_gang(kind, job, rec):
+                adopted += 1
+            else:
+                failed += 1
+            self._enqueue(kind, ns, name)
+        dt = time.time() - t0
+        REGISTRY.gauge("kftpu_controller_adoption_seconds").set(round(dt, 3))
+        REGISTRY.gauge("kftpu_controller_adopted_gangs").set(adopted)
+        REGISTRY.gauge("kftpu_controller_adoption_failed_gangs").set(failed)
+        logger.info("adoption: %d gangs adopted, %d routed to restart "
+                    "in %.3fs", adopted, failed, dt)
+
+    async def _adopt_gang(self, kind: str, job: TrainJob, rec: dict) -> bool:
+        key = job.key
+        entries = rec.get("workers") or {}
+        live: dict[str, dict] = {}
+        dead: dict[str, int] = {}
+        for wid, ent in sorted(entries.items()):
+            if self._probe_worker(ent):
+                live[wid] = ent
+            else:
+                # Exit code unobservable across the controller restart:
+                # assume SIGKILL, which every restart policy treats as
+                # retryable.
+                dead[wid] = 137
+
+        res_info = rec.get("reservation")
+        if res_info and self.gang.reservation(key) is None:
+            ok = self.gang.try_reserve(
+                key,
+                int(res_info.get("chips") or 0),
+                int(res_info.get("processes") or 1),
+                priority=int(res_info.get("priority") or 0),
+                queue=str(res_info.get("queue") or "training"),
+            )
+            if not ok:
+                # Capacity accounting changed underneath us (should not
+                # happen on a fresh scheduler): reap and re-admit normally.
+                await self._reap_orphans(rec)
+                self._journal.remove(key)
+                self._record_event(
+                    job, "GangAdoptionFailed",
+                    "journaled reservation no longer fits; re-admitting",
+                )
+                return False
+
+        rp = rec.get("reshard_pending")
+        rt = _JobRuntime(
+            key=key,
+            coordinator_port=int(rec.get("coordinator_port") or 0),
+            spec_world=tuple(tuple(w) for w in rec.get("spec_world") or ()),
+            formed_world=tuple(
+                tuple(w) for w in rec.get("formed_world") or ()
+            ),
+            formed_replicas=rec.get("formed_replicas"),
+            reshard_seq=int(rec.get("reshard_seq") or 0),
+            reshard_pending=tuple(rp) if rp else None,
+            hostfile_path=rec.get("hostfile_path"),
+        )
+        for wid, ent in sorted(live.items()):
+            req = spawn_request_from_entry(key, ent)
+            ref = self.launcher.adopt(
+                req, int(ent["pid"]),
+                log_path=ent.get("log_path"),
+                spawned_at=float(ent.get("spawned_at") or 0.0),
+            )
+            rt.workers[ref.worker_id] = ref
+        rt.failed.update(dead)
+        self._runtimes[key] = rt
+
+        self._fence_stale_resize(job, rt)
+
+        if dead:
+            self._record_event(
+                job, "GangAdoptionFailed",
+                f"{len(dead)}/{len(entries)} workers dead after controller "
+                "restart; routing through gang restart",
+            )
+            self._journal_record(rt)
+            return False
+
+        # Re-arm watchdogs with the REMAINING journaled budget: a restart
+        # must not silently disable hang detection or grant a fresh quiet
+        # period.
+        now = time.time()
+        timers = rec.get("timers") or {}
+        hd = timers.get("hang_deadline")
+        self._schedule_hang_check(
+            kind, job, rt,
+            first_delay=max(float(hd) - now, 0.5) if hd else None,
+        )
+        md = timers.get("metric_deadline")
+        self._schedule_metric_scaler(
+            kind, job, rt,
+            first_delay=max(float(md) - now, 0.5) if md else None,
+        )
+        if rt.reshard_pending is not None:
+            self._schedule_reshard_ack(kind, job, rt)
+        self._record_event(
+            job, "GangAdopted",
+            f"adopted {len(live)} live workers after controller restart "
+            "(no respawn)",
+        )
+        self._journal_record(rt)
+        return True
+
+    def _fence_stale_resize(self, job: TrainJob, rt: _JobRuntime) -> None:
+        """Seq-fenced cleanup of resize command files across a controller
+        restart. An in-flight command whose deadline still stands keeps
+        running (the re-armed ack timer judges it); anything else at or
+        below our journaled seq is stale residue a respawned worker
+        (which starts at seq 0) could re-apply -- clear it."""
+        if not rt.reshard_seq or not job.spec.checkpoint.dir:
+            return
+        path = resize_file_path(job.spec.checkpoint.dir)
+        pend = rt.reshard_pending
+        if pend is not None and float(pend[2]) > time.time():
+            return  # in flight and not yet overdue: the ack timer owns it
+        cmd = read_resize_command(path, 0)
+        if cmd is not None and int(cmd.get("seq") or 0) <= rt.reshard_seq:
+            clear_resize_command(path)
+            logger.info("cleared stale resize command seq=%s for %s "
+                        "(fence seq=%d)", cmd.get("seq"), rt.key,
+                        rt.reshard_seq)
+        if pend is not None:
+            # The command expired while no controller was watching: latch
+            # the checkpoint-restart fallback exactly as the ack timer
+            # would have.
+            rt.reshard_pending = None
+            rt.reshard_fallback = True
+            rt.resize_to = int(pend[1])
+
+    async def _reap_orphans(self, rec: dict) -> None:
+        """Kill journaled workers whose job is gone/finished/suspended --
+        and drop any resize command file they were polling."""
+        key = RuntimeJournal.key_of(rec)
+        for wid, ent in sorted((rec.get("workers") or {}).items()):
+            resize_file = dict(
+                (str(k), str(v)) for k, v in (ent.get("env") or [])
+            ).get(ENV_RESIZE_FILE)
+            if resize_file:
+                clear_resize_command(resize_file)
+            if not self._probe_worker(ent):
+                continue
+            req = spawn_request_from_entry(key, ent)
+            ref = self.launcher.adopt(
+                req, int(ent["pid"]),
+                log_path=ent.get("log_path"),
+                spawned_at=float(ent.get("spawned_at") or 0.0),
+            )
+            await self.launcher.kill(ref)
+            logger.info("reaped orphan %s (job gone)", wid)
 
     # -- exit callback (from launcher) ------------------------------------
 
@@ -239,6 +584,7 @@ class JobController:
             rt.succeeded.add(ref.worker_id)
         else:
             rt.failed[ref.worker_id] = code
+        self._journal_record(rt)
         ns, name = ref.req.job_key.split("/", 1)
         # Kind is recoverable from the stored object; enqueue all kinds is
         # wasteful, so look it up directly.
@@ -249,6 +595,11 @@ class JobController:
     # -- reconcile --------------------------------------------------------
 
     async def _reconcile(self, kind: str, namespace: str, name: str) -> None:
+        # Chaos seam (KFTPU_CHAOS_PLAN): a "crash" fault here SIGKILLs the
+        # whole controller at a deterministic reconcile hit -- the
+        # certification point for journal + adoption + lease failover
+        # (bench_ctrlha.py, KT-PERF-CTRLHA).
+        chaos.apply("controller.crash", f"{namespace}/{name}")
         with trace.span("reconcile", plane="controller", track="reconciler",
                         kind=kind, job=f"{namespace}/{name}"):
             await self._reconcile_inner(kind, namespace, name)
@@ -594,6 +945,7 @@ class JobController:
                 f"spawned {len(rt.workers)}/{len(world)} replicas; "
                 "launcher deferred",
             )
+            self._journal_record(rt)
             return True
         job.status.formed_replicas = len(world)
         reason = "GangAdmitted" if workers_override is None else "GangAdmittedReduced"
@@ -603,10 +955,12 @@ class JobController:
         )
         self._schedule_hang_check(kind, job, rt)
         self._schedule_metric_scaler(kind, job, rt)
+        self._journal_record(rt)
         return True
 
     def _schedule_metric_scaler(
-        self, kind: str, job: TrainJob, rt: _JobRuntime
+        self, kind: str, job: TrainJob, rt: _JobRuntime,
+        first_delay: Optional[float] = None,
     ) -> None:
         """HPA-analog metric-driven elastic resize (reference: PyTorch
         ElasticPolicy metrics drive an HPA on replica count). Polls the
@@ -644,6 +998,7 @@ class JobController:
             if not rt.workers:
                 # Per-replica-restart lull: the runtime survives; keep
                 # polling rather than silently stopping forever.
+                rt.metric_deadline = time.time() + el_now.metric_poll_seconds
                 loop.call_later(el_now.metric_poll_seconds, check)
                 return
             value = self._read_worker_metric(rt, el_now.metric)
@@ -659,9 +1014,12 @@ class JobController:
                     rt.resize_to = desired
                     self._enqueue(kind, job.namespace, job.name)
                     return
+            rt.metric_deadline = time.time() + el_now.metric_poll_seconds
             loop.call_later(el_now.metric_poll_seconds, check)
 
-        loop.call_later(el.metric_poll_seconds, check)
+        delay = el.metric_poll_seconds if first_delay is None else first_delay
+        rt.metric_deadline = time.time() + delay
+        loop.call_later(delay, check)
 
     def _initiate_reshard_in_place(
         self, kind: str, job: TrainJob, rt: _JobRuntime, n: int,
@@ -688,6 +1046,7 @@ class JobController:
             f"gang stays up",
         )
         self._schedule_reshard_ack(kind, job, rt)
+        self._journal_record(rt)
 
     def _schedule_reshard_ack(
         self, kind: str, job: TrainJob, rt: _JobRuntime
@@ -713,6 +1072,7 @@ class JobController:
                 f"{reason}; falling back to checkpoint-restart",
             )
             rt.resize_to = n
+            self._journal_record(rt)
             self._enqueue(kind, job.namespace, job.name)
 
         def check() -> None:
@@ -724,6 +1084,9 @@ class JobController:
             if (self._runtimes.get(job.key) is not rt
                     or rt.reshard_pending != (seq, n, deadline)):
                 return  # torn down / superseded
+            if self._fenced():
+                # Lease lost: the new holder owns this command file now.
+                return
             ack = self._read_worker_metric(rt, "reshard_seq")
             if ack is not None and int(ack) >= seq:
                 ok = self._read_worker_metric(rt, "reshard_ok")
@@ -759,6 +1122,7 @@ class JobController:
                         before = cur.status.model_dump(mode="json")
                         cur.status.formed_replicas = n
                         self._persist(kind, cur, before)
+                    self._journal_record(rt)
                     self._enqueue(kind, job.namespace, job.name)
                 else:
                     fallback(f"worker nacked reshard seq {seq} "
@@ -832,7 +1196,8 @@ class JobController:
         }
 
     def _schedule_hang_check(
-        self, kind: str, job: TrainJob, rt: _JobRuntime
+        self, kind: str, job: TrainJob, rt: _JobRuntime,
+        first_delay: Optional[float] = None,
     ) -> None:
         """Arm liveness monitoring for a freshly formed gang (SURVEY.md 5.3
         heartbeats). Signal: freshest mtime across worker log files — one
@@ -883,6 +1248,7 @@ class JobController:
             if not rt.workers:
                 # Mid-restart lull (per-replica respawn in flight): the
                 # runtime survives those, so keep monitoring.
+                rt.hang_deadline = time.time() + t
                 loop.call_later(t, check)
                 return
             age = self._freshest_output_age(rt)
@@ -892,9 +1258,12 @@ class JobController:
                 self._enqueue(kind, job.namespace, job.name)
                 return
             delay = t if age is None else max(t - age, 1.0)
+            rt.hang_deadline = time.time() + delay
             loop.call_later(delay, check)
 
-        loop.call_later(timeout, check)
+        delay0 = timeout if first_delay is None else first_delay
+        rt.hang_deadline = time.time() + delay0
+        loop.call_later(delay0, check)
 
     # Output-without-step-progress gets this multiple of the hang timeout
     # before counting as hung: long legitimate non-step phases (final
@@ -1153,6 +1522,7 @@ class JobController:
             del rt.failed[fwid]
             rt.workers[ref.worker_id] = ref
         job.status.set_condition(ConditionType.Running, "ReplicaRestarted")
+        self._journal_record(rt)
         self._persist(kind, job, status_before)
 
     async def _gang_restart(
@@ -1237,6 +1607,7 @@ class JobController:
             if not rt.workers:
                 self.gang.release(job.key)
                 self._runtimes.pop(job.key, None)
+                self._journal_remove(job.key)
 
     async def _handle_finished(self, kind: str, job: TrainJob, status_before: dict) -> None:
         rt = self._runtimes.get(job.key)
@@ -1257,6 +1628,12 @@ class JobController:
         with trace.span("teardown", plane="controller", track="reconciler",
                         job=key, release=release,
                         workers=len(rt.workers) if rt else 0):
+            if rt is not None:
+                # The journal must not describe a gang being torn down: a
+                # controller dying mid-teardown leaves no record, so its
+                # successor re-admits through the normal path instead of
+                # adopting half-dead workers.
+                self._journal_remove(key)
             if rt is not None:
                 refs = list(rt.workers.values())
                 rt.workers.clear()  # mark refs stale before killing

@@ -30,7 +30,13 @@ from aiohttp import web
 from kubeflow_tpu.api import TrainJob, apply_defaults, validate_job
 from kubeflow_tpu.api.types import JobKind
 from kubeflow_tpu.api.validation import ValidationError
-from kubeflow_tpu.controller import GangScheduler, JobController, ProcessLauncher
+from kubeflow_tpu.controller import (
+    ControllerLease,
+    GangScheduler,
+    JobController,
+    ProcessLauncher,
+    RuntimeJournal,
+)
 from kubeflow_tpu.hpo import HPOController
 from kubeflow_tpu.hpo.obsdb import ObservationDB
 from kubeflow_tpu.hpo.types import Experiment, validate_experiment
@@ -94,8 +100,21 @@ class ControlPlane:
         self.log_dir = os.path.join(state_dir, "logs")
         self.launcher = launcher or ProcessLauncher(log_dir=self.log_dir)
         self.gang = GangScheduler(total_chips=total_chips)
+        # Crash resilience (docs/CONTROLPLANE.md): the journal shadows live
+        # runtimes into the store so a restarted control plane adopts its
+        # orphaned workers instead of respawning them; the lease fences
+        # actuation to one controller process at a time (a standby blocks
+        # in run() until the incumbent's lease expires).
+        self.journal = RuntimeJournal(self.store)
+        self.lease = ControllerLease(
+            self.store,
+            duration_seconds=float(
+                os.environ.get("KFTPU_LEASE_SECONDS", "15")
+            ),
+        )
         self.controller = JobController(
-            self.store, self.launcher, self.gang, log_dir=self.log_dir
+            self.store, self.launcher, self.gang, log_dir=self.log_dir,
+            journal=self.journal, lease=self.lease,
         )
         self.obs_db = ObservationDB(os.path.join(state_dir, "observations.db"))
         self.hpo = HPOController(

@@ -14,6 +14,7 @@ generation mismatch, like resourceVersion conflicts in the reference.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import enum
 import json
 import logging
@@ -59,8 +60,24 @@ class ObjectStore:
     """
 
     def __init__(self, path: str = ":memory:") -> None:
-        self._db = sqlite3.connect(path, check_same_thread=False)
+        # isolation_level=None puts the connection in autocommit mode so
+        # put/delete can run their read-modify-write under an explicit
+        # BEGIN IMMEDIATE: the in-process RLock does not serialize a second
+        # *process* sharing the same db file (controller failover keeps the
+        # old and new controller briefly co-resident), and without the
+        # immediate write lock two processes could both read generation N
+        # and both "win" an expect_generation CAS.
+        self._db = sqlite3.connect(
+            path, check_same_thread=False, isolation_level=None
+        )
         self._lock = threading.RLock()
+        # Crash hardening: WAL survives a SIGKILL mid-commit with the last
+        # committed state intact (readers never see a torn page), and
+        # busy_timeout makes cross-process writers queue instead of raising
+        # "database is locked". Both are no-ops for ":memory:" stores.
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA busy_timeout=5000")
+        self._db.execute("PRAGMA synchronous=NORMAL")
         self._watchers: list[tuple[Optional[str], asyncio.Queue, asyncio.AbstractEventLoop]] = []
         self._sync_watchers: list[tuple[Optional[str], Callable[[Event], None]]] = []
         with self._lock:
@@ -79,6 +96,30 @@ class ObjectStore:
                 "CREATE TABLE IF NOT EXISTS meta (k TEXT PRIMARY KEY, v TEXT)"
             )
             self._db.commit()
+
+    # -- transactions -----------------------------------------------------
+
+    @contextlib.contextmanager
+    def _txn(self):
+        """Cross-process read-modify-write atomicity for put/delete.
+
+        BEGIN IMMEDIATE takes SQLite's write lock before the SELECT, so a
+        second process cannot interleave between our generation read and
+        our write -- this is what makes ``expect_generation`` (and the
+        controller lease CAS built on it) safe across controller failover,
+        not just across threads. Callers commit explicitly before
+        notifying watchers; this manager only rolls back on error or
+        commits a dangling transaction on early return.
+        """
+        self._db.execute("BEGIN IMMEDIATE")
+        try:
+            yield
+        except BaseException:
+            if self._db.in_transaction:
+                self._db.execute("ROLLBACK")
+            raise
+        if self._db.in_transaction:
+            self._db.execute("COMMIT")
 
     # -- revision counter -------------------------------------------------
 
@@ -108,7 +149,7 @@ class ObjectStore:
             raise ValueError("object has no metadata.name")
         namespace = meta.setdefault("namespace", "default")
 
-        with self._lock:
+        with self._lock, self._txn():
             cur = self._db.execute(
                 "SELECT generation, data FROM objects WHERE kind=? AND namespace=? AND name=?",
                 (kind, namespace, name),
@@ -180,7 +221,7 @@ class ObjectStore:
             return [json.loads(r[0]) for r in cur.fetchall()]
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> bool:
-        with self._lock:
+        with self._lock, self._txn():
             cur = self._db.execute(
                 "SELECT data FROM objects WHERE kind=? AND namespace=? AND name=?",
                 (kind, namespace, name),

@@ -860,6 +860,7 @@ def test_perf_shipped_baseline_passes_shipped_artifacts():
     assert any(k.startswith("reshard.") for k in measured)
     assert any(k.startswith("sched.") for k in measured)
     assert any(k.startswith("kv_reshard.") for k in measured)
+    assert any(k.startswith("ctrlha.") for k in measured)
 
 
 def test_perf_planted_mfu_regression_exits_one(monkeypatch, capsys, tmp_path):
@@ -1096,6 +1097,68 @@ def test_perf_chaos_bounds_required_flags_and_shrunk_curve(tmp_path):
     assert any("request_loss_ratio = 0.02 exceeds" in m for m in msgs)
     assert any("fault_ttft_p99_ms: missing" in m for m in msgs)
     assert any("respawned" in m and "expected true" in m for m in msgs)
+
+
+@pytest.mark.parametrize("bound,planted", [
+    # The zero bounds regress by tightening below the measured zeros;
+    # the ceiling regresses by dropping under the measured adoption.
+    ("worker_deaths_max", -1),
+    ("duplicate_spawns_max", -1),
+    ("restart_count_delta_max", -1),
+    ("adoption_seconds_ceiling", 0.001),
+])
+def test_perf_planted_ctrlha_regression_exits_one(monkeypatch, capsys,
+                                                  tmp_path, bound, planted):
+    bad = analysis.load_perf_baseline()
+    bad["ctrlha"][bound] = planted
+    p = tmp_path / "perf.json"
+    p.write_text(json.dumps(bad))
+    rc, out = _run_cli(monkeypatch, capsys, [], {},
+                       ["--strict", "--json", "--perf-baseline", str(p)])
+    assert rc == 1
+    assert any(f["rule"] == "KT-PERF-CTRLHA" and f["hard"]
+               for f in json.loads(out)["new"]), (bound, out)
+
+
+def test_perf_ctrlha_round_vanishing_is_a_finding(tmp_path):
+    # Bounds set, OTHER bench rounds committed, but none carries
+    # extra.ctrlha: hard finding, not a silent pass -- deleting
+    # BENCH_r09 from a checkout must not un-ratchet crash resilience.
+    # (An empty root -- the installed-package case -- skips quietly,
+    # covered by test_perf_missing_artifact_files_skip_quietly.)
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"parsed": {"extra": {"reshard": {}}}}))
+    baseline = {"ctrlha": {"worker_deaths_max": 0}}
+    findings, _ = analysis.check_perf(baseline, root=str(tmp_path))
+    assert [f.rule for f in findings] == ["KT-PERF-CTRLHA"]
+    assert "vanished" in findings[0].message
+
+
+def test_perf_ctrlha_bounds_required_flags_and_shrunk_curve(tmp_path):
+    doc = {"parsed": {"extra": {"ctrlha": {
+        "worker_deaths": 1,          # a worker died with the controller
+        "duplicate_spawns": 0,
+        "restart_count_delta": 0,
+        # adoption_seconds missing entirely: the curve shrank
+        "controller_killed": True,
+        "adopted": False,            # required flag not true
+    }}}}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(doc))
+    baseline = {"ctrlha": {
+        "worker_deaths_max": 0,
+        "duplicate_spawns_max": 0,
+        "restart_count_delta_max": 0,
+        "adoption_seconds_ceiling": 10.0,
+        "required": ["controller_killed", "adopted"],
+    }}
+    findings, measured = analysis.check_perf(baseline, root=str(tmp_path))
+    assert measured["ctrlha.duplicate_spawns"] == 0.0
+    assert len(findings) == 3 and all(
+        f.rule == "KT-PERF-CTRLHA" and f.hard for f in findings)
+    msgs = [f.message for f in findings]
+    assert any("worker_deaths = 1 exceeds" in m for m in msgs)
+    assert any("adoption_seconds: missing" in m for m in msgs)
+    assert any("adopted" in m and "expected true" in m for m in msgs)
 
 
 def test_perf_planted_kv_reshard_regression_exits_one(monkeypatch, capsys,

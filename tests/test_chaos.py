@@ -666,7 +666,7 @@ class TestChaosAnalysisFamily:
 
         findings, info = check_chaos()
         assert findings == [], [f.message for f in findings]
-        assert info["rules"] == 4
+        assert info["rules"] == 5
 
     def test_chaoscheck_catches_a_broken_breaker(self, monkeypatch):
         # Non-vacuity: a breaker that never trips must be reported.

@@ -1,0 +1,517 @@
+"""Controller crash resilience: durable journal, orphan adoption,
+lease-fenced single-writer actuation (docs/CONTROLPLANE.md).
+
+The crash idiom throughout: cancel controller A's run task WITHOUT
+calling stop() -- no teardown, no journal removal, no lease release --
+then silence its launcher callbacks and runtime map so its pending
+timers are inert, exactly as SIGKILL would leave things. Controller B
+is a fresh JobController over the same store with its own launcher and
+gang scheduler, as a restarted process would be.
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from kubeflow_tpu.api import TrainJob
+from kubeflow_tpu.api.types import RunPolicy
+from kubeflow_tpu.controller import (
+    FakeLauncher,
+    GangScheduler,
+    JobController,
+    RuntimeJournal,
+)
+from kubeflow_tpu.controller.journal import (
+    JOURNAL_KIND,
+    env_hash,
+    spawn_request_from_entry,
+)
+from kubeflow_tpu.controller.lease import ControllerLease
+from kubeflow_tpu.store import ObjectStore
+from test_controller import make_job
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# Lease: store-backed CAS with expiry; local belief is a lower bound.
+# ---------------------------------------------------------------------------
+
+class TestControllerLease:
+    def test_acquire_renew_and_mutual_exclusion(self):
+        store = ObjectStore(":memory:")
+        clk = Clock()
+        a = ControllerLease(store, holder="a", duration_seconds=5, now=clk)
+        b = ControllerLease(store, holder="b", duration_seconds=5, now=clk)
+        assert a.try_acquire() and a.held
+        assert not b.try_acquire() and not b.held
+        clk.t += 3
+        assert a.renew() and a.held  # renewal extends past the old expiry
+        clk.t += 3
+        assert a.held and not b.try_acquire()
+        store.close()
+
+    def test_takeover_only_after_expiry(self):
+        store = ObjectStore(":memory:")
+        clk = Clock()
+        a = ControllerLease(store, holder="a", duration_seconds=5, now=clk)
+        b = ControllerLease(store, holder="b", duration_seconds=5, now=clk)
+        assert a.try_acquire()
+        clk.t += 5.01  # a crashed; its lease lapses
+        assert not a.held
+        assert b.try_acquire() and b.held
+        # The old holder's next renew observes the loss and must not
+        # reclaim: the CAS sees b's row.
+        assert not a.renew() and not a.held
+        store.close()
+
+    def test_release_frees_immediately(self):
+        store = ObjectStore(":memory:")
+        clk = Clock()
+        a = ControllerLease(store, holder="a", duration_seconds=5, now=clk)
+        b = ControllerLease(store, holder="b", duration_seconds=5, now=clk)
+        assert a.try_acquire()
+        a.release()
+        assert b.try_acquire()
+        store.close()
+
+    def test_wait_acquire_blocks_until_expiry(self):
+        async def run():
+            store = ObjectStore(":memory:")
+            a = ControllerLease(store, holder="a", duration_seconds=0.4)
+            b = ControllerLease(store, holder="b", duration_seconds=0.4)
+            assert a.try_acquire()
+            t0 = time.monotonic()
+            await asyncio.wait_for(b.wait_acquire(poll_seconds=0.05), 5)
+            assert b.held
+            assert time.monotonic() - t0 >= 0.3  # not before a's expiry
+            store.close()
+
+        asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Journal: the record round-trips a SpawnRequest exactly.
+# ---------------------------------------------------------------------------
+
+class TestJournal:
+    def test_spawn_request_round_trip(self):
+        entry = {
+            "pid": 4321,
+            "replica_type": "worker",
+            "index": 2,
+            "entrypoint": "kubeflow_tpu.runtime.entry",
+            "args": ["--model", "llama"],
+            "env": [["JAX_PROCESS_ID", "2"], ["K", "V"]],
+            "workdir": "/tmp/w",
+            "exec": False,
+        }
+        req = spawn_request_from_entry("default/j1", entry)
+        assert req.job_key == "default/j1"
+        assert req.replica_type == "worker" and req.index == 2
+        assert req.args == ("--model", "llama")
+        assert req.env == (("JAX_PROCESS_ID", "2"), ("K", "V"))
+        assert req.workdir == "/tmp/w" and not req.exec_
+
+    def test_env_hash_is_order_insensitive_and_value_sensitive(self):
+        a = env_hash([("A", "1"), ("B", "2")])
+        assert a == env_hash([("B", "2"), ("A", "1")])
+        assert a != env_hash([("A", "1"), ("B", "3")])
+
+
+# ---------------------------------------------------------------------------
+# Adoption with fake launchers: the crash/restart object protocol.
+# ---------------------------------------------------------------------------
+
+class HAWorld:
+    """One shared store; controllers come and go like processes."""
+
+    def __init__(self, total_chips=8):
+        self.store = ObjectStore(":memory:")
+        self.controllers = []
+
+    def controller(self, lease_seconds=None, holder=None):
+        lease = None
+        if lease_seconds is not None:
+            lease = ControllerLease(
+                self.store, holder=holder, duration_seconds=lease_seconds)
+        ctl = JobController(
+            self.store, FakeLauncher(), GangScheduler(total_chips=8),
+            backoff_base_seconds=0.01, backoff_max_seconds=0.05,
+            journal=RuntimeJournal(self.store), lease=lease,
+        )
+        self.controllers.append(ctl)
+        return ctl
+
+    @staticmethod
+    def crash(ctl, task):
+        """SIGKILL semantics: no teardown, no lease release, and the
+        dead process's timers/callbacks can no longer touch anything."""
+        task.cancel()
+        ctl.launcher._exit_cb = None
+        ctl._runtimes.clear()
+
+    def submit(self, job):
+        self.store.put(job.kind.value, job.to_dict())
+
+    def job(self, name, kind="JAXJob", ns="default"):
+        obj = self.store.get(kind, name, ns)
+        return TrainJob.from_dict(obj) if obj else None
+
+    def events(self, key):
+        return [e["reason"] for e in self.store.list("Event")
+                if e.get("involved") == key]
+
+    async def wait(self, pred, timeout=5.0, msg="condition"):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if pred():
+                return
+            await asyncio.sleep(0.01)
+        raise AssertionError(f"timeout waiting for {msg}")
+
+
+def _probe_all_alive(monkeypatch):
+    # FakeLauncher pids are fictional; the probe is exercised for real
+    # in the e2e test below and the crash-HA bench.
+    monkeypatch.setattr(JobController, "_probe_worker",
+                        staticmethod(lambda ent: True))
+
+
+class TestAdoption:
+    def test_adopt_keeps_gang_no_respawn_no_restart(self, monkeypatch):
+        _probe_all_alive(monkeypatch)
+
+        async def run():
+            w = HAWorld()
+            a = w.controller()
+            ta = asyncio.create_task(a.run())
+            w.submit(make_job(replicas=2))
+            await w.wait(lambda: (lambda j: j and j.status.phase.value ==
+                                  "Running")(w.job("j1")), msg="running")
+            rec = w.store.get(JOURNAL_KIND, "j1")
+            assert rec and len(rec["workers"]) == 2
+            pids = sorted(e["pid"] for e in rec["workers"].values())
+
+            w.crash(a, ta)
+            b = w.controller()
+            tb = asyncio.create_task(b.run())
+            await w.wait(lambda: "default/j1" in b._runtimes,
+                         msg="adoption")
+            assert len(b.launcher.adopted) == 2
+            assert b.launcher.spawned == []  # adopted, never respawned
+            assert sorted(r.pid for r in b.launcher.running()) == pids
+            assert w.job("j1").status.restart_count == 0
+            assert "GangAdopted" in w.events("default/j1")
+            # The successor owns the gang end to end: teardown works.
+            await b.stop()
+            tb.cancel()
+            w.store.close()
+
+        asyncio.run(run())
+
+    def test_dead_workers_route_through_ordinary_gang_restart(
+            self, monkeypatch):
+        monkeypatch.setattr(JobController, "_probe_worker",
+                            staticmethod(lambda ent: False))
+
+        async def run():
+            w = HAWorld()
+            a = w.controller()
+            ta = asyncio.create_task(a.run())
+            w.submit(make_job(replicas=2))
+            await w.wait(lambda: (lambda j: j and j.status.phase.value ==
+                                  "Running")(w.job("j1")), msg="running")
+            w.crash(a, ta)
+
+            b = w.controller()
+            tb = asyncio.create_task(b.run())
+            # All journaled workers failed the probe: the gang goes
+            # through the NORMAL restart path -- respawn, restart_count
+            # increments, job is Running again.
+            await w.wait(lambda: len(b.launcher.spawned) == 2,
+                         msg="respawn")
+            await w.wait(lambda: (lambda j: j and j.status.phase.value ==
+                                  "Running")(w.job("j1")),
+                         msg="running again")
+            assert b.launcher.adopted == []
+            assert "GangAdoptionFailed" in w.events("default/j1")
+            assert w.job("j1").status.restart_count >= 1
+            await b.stop()
+            tb.cancel()
+            w.store.close()
+
+        asyncio.run(run())
+
+    def test_stale_resize_command_cleared_under_seq_fence(
+            self, monkeypatch, tmp_path):
+        _probe_all_alive(monkeypatch)
+        from kubeflow_tpu.api.types import CheckpointPolicy
+        from kubeflow_tpu.controller.envvars import resize_file_path
+        from kubeflow_tpu.controller.reshard_protocol import (
+            read_resize_command,
+            write_resize_command,
+        )
+
+        async def run():
+            w = HAWorld()
+            a = w.controller()
+            ta = asyncio.create_task(a.run())
+            ck = str(tmp_path / "ck")
+            w.submit(make_job(replicas=2,
+                              checkpoint=CheckpointPolicy(dir=ck)))
+            await w.wait(lambda: (lambda j: j and j.status.phase.value ==
+                                  "Running")(w.job("j1")), msg="running")
+            w.crash(a, ta)
+
+            # The outage left a resize command the old controller had
+            # already seen acked (seq <= journaled fence): a respawned
+            # worker polling from seq 0 would re-apply it.
+            rec = w.store.get(JOURNAL_KIND, "j1")
+            rec["reshard_seq"] = 2
+            w.store.put(JOURNAL_KIND, rec)
+            path = resize_file_path(ck)
+            write_resize_command(path, 2, 4)
+            assert read_resize_command(path, 0) is not None
+
+            b = w.controller()
+            tb = asyncio.create_task(b.run())
+            await w.wait(lambda: "default/j1" in b._runtimes,
+                         msg="adoption")
+            assert read_resize_command(path, 0) is None, (
+                "stale command survived adoption")
+            assert b._runtimes["default/j1"].reshard_seq == 2
+            await b.stop()
+            tb.cancel()
+            w.store.close()
+
+        asyncio.run(run())
+
+    def test_watchdog_rearmed_with_remaining_budget(self, monkeypatch,
+                                                    tmp_path):
+        _probe_all_alive(monkeypatch)
+
+        async def run():
+            w = HAWorld()
+            a = w.controller()
+            ta = asyncio.create_task(a.run())
+            w.submit(make_job(
+                replicas=1,
+                run_policy=RunPolicy(hang_timeout_seconds=300.0)))
+            await w.wait(lambda: (lambda j: j and j.status.phase.value ==
+                                  "Running")(w.job("j1")), msg="running")
+            w.crash(a, ta)
+
+            # The previous controller had burned most of the hang
+            # budget: 77s remained. The successor must re-arm with the
+            # REMAINING budget, not a fresh 300s.
+            log = tmp_path / "w.log"
+            log.write_text("alive\n")
+            deadline = time.time() + 77.0
+            rec = w.store.get(JOURNAL_KIND, "j1")
+            rec["timers"]["hang_deadline"] = deadline
+            for ent in rec["workers"].values():
+                ent["log_path"] = str(log)
+            w.store.put(JOURNAL_KIND, rec)
+
+            b = w.controller()
+            tb = asyncio.create_task(b.run())
+            await w.wait(lambda: "default/j1" in b._runtimes,
+                         msg="adoption")
+            rt = b._runtimes["default/j1"]
+            assert rt.hang_armed
+            assert abs(rt.hang_deadline - deadline) < 5.0, (
+                rt.hang_deadline, deadline)
+            await b.stop()
+            tb.cancel()
+            w.store.close()
+
+        asyncio.run(run())
+
+    def test_orphans_of_deleted_job_are_reaped(self, monkeypatch):
+        _probe_all_alive(monkeypatch)
+
+        async def run():
+            w = HAWorld()
+            a = w.controller()
+            ta = asyncio.create_task(a.run())
+            w.submit(make_job(replicas=2))
+            await w.wait(lambda: (lambda j: j and j.status.phase.value ==
+                                  "Running")(w.job("j1")), msg="running")
+            w.crash(a, ta)
+            w.store.delete("JAXJob", "j1")
+
+            b = w.controller()
+            tb = asyncio.create_task(b.run())
+            await w.wait(lambda: w.store.get(JOURNAL_KIND, "j1") is None,
+                         msg="journal cleanup")
+            # Reaped, not adopted: the killed orphans show up in the
+            # successor launcher's kill ledger.
+            assert len(b.launcher.killed) == 2
+            assert b._runtimes == {}
+            await b.stop()
+            tb.cancel()
+            w.store.close()
+
+        asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Lease contention: a standby controller actuates nothing until the
+# holder dies, then takes over and adopts.
+# ---------------------------------------------------------------------------
+
+class TestLeaseContention:
+    def test_standby_blocks_then_takes_over(self, monkeypatch):
+        _probe_all_alive(monkeypatch)
+
+        async def run():
+            w = HAWorld()
+            a = w.controller(lease_seconds=0.5, holder="ctrl-a")
+            ta = asyncio.create_task(a.run())
+            w.submit(make_job(replicas=2))
+            await w.wait(lambda: (lambda j: j and j.status.phase.value ==
+                                  "Running")(w.job("j1")), msg="running")
+
+            b = w.controller(lease_seconds=0.5, holder="ctrl-b")
+            tb = asyncio.create_task(b.run())
+            await asyncio.sleep(0.3)  # b is up while a renews
+            assert not b._lease.held
+            assert b.launcher.spawned == [] and b.launcher.adopted == []
+            assert b._runtimes == {}
+
+            w.crash(a, ta)  # no release: b must wait out the expiry
+            await w.wait(lambda: "default/j1" in b._runtimes, timeout=10,
+                         msg="takeover + adoption")
+            assert b._lease.held
+            assert b.launcher.spawned == []
+            assert w.job("j1").status.restart_count == 0
+            await b.stop()
+            tb.cancel()
+            w.store.close()
+
+        asyncio.run(run())
+
+    def test_stopped_standby_exits_without_acquiring(self):
+        async def run():
+            w = HAWorld()
+            a = w.controller(lease_seconds=30, holder="ctrl-a")
+            assert a._lease.try_acquire()
+            b = w.controller(lease_seconds=30, holder="ctrl-b")
+            tb = asyncio.create_task(b.run())
+            await asyncio.sleep(0.1)
+            await asyncio.wait_for(b.stop(), 2)
+            await asyncio.wait_for(tb, 2)  # must not hang on the lease
+            assert not b._lease.held
+            a._lease.release()
+            w.store.close()
+
+        asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# E2E: real workers survive a real controller handover.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.e2e
+def test_e2e_kill_controller_adopt_real_worker(tmp_path):
+    """A real spawned worker keeps running across a controller crash;
+    the successor adopts the live pid (real probe: /proc env hash, log
+    file) and restart_count stays 0."""
+    from kubeflow_tpu.api import (
+        JobKind,
+        JobSpec,
+        ProcessTemplate,
+        ReplicaSpec,
+        ReplicaType,
+        Resources,
+        apply_defaults,
+    )
+    from kubeflow_tpu.api.types import ObjectMeta
+    from kubeflow_tpu.controller import ProcessLauncher
+
+    async def run():
+        store = ObjectStore(str(tmp_path / "s.db"))
+        log_dir = str(tmp_path / "logs")
+
+        def controller():
+            return JobController(
+                store, ProcessLauncher(log_dir=log_dir),
+                GangScheduler(total_chips=8),
+                journal=RuntimeJournal(store),
+            )
+
+        job = apply_defaults(TrainJob(
+            kind=JobKind.JAXJob,
+            metadata=ObjectMeta(name="adoptee"),
+            spec=JobSpec(replica_specs={
+                ReplicaType.Worker: ReplicaSpec(
+                    replicas=1,
+                    template=ProcessTemplate(
+                        entrypoint="kubeflow_tpu.runtime.entry",
+                        args=["--model", "mnist", "--steps", "100000",
+                              "--log-every", "10"],
+                    ),
+                    resources=Resources(tpu=4),
+                )
+            }),
+        ))
+
+        a = controller()
+        ta = asyncio.create_task(a.run())
+        store.put(job.kind.value, job.to_dict())
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            rec = store.get(JOURNAL_KIND, "adoptee")
+            if rec and rec.get("workers"):
+                break
+            await asyncio.sleep(0.1)
+        rec = store.get(JOURNAL_KIND, "adoptee")
+        assert rec and rec["workers"], "worker never journaled"
+        pid = next(iter(rec["workers"].values()))["pid"]
+
+        # Crash A without any cleanup; the worker is now an orphan.
+        ta.cancel()
+        a.launcher._exit_cb = None
+        a._runtimes.clear()
+        os.kill(pid, 0)  # still alive with no controller
+
+        b = controller()
+        tb = asyncio.create_task(b.run())
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if "default/adoptee" in b._runtimes:
+                break
+            await asyncio.sleep(0.1)
+        rt = b._runtimes.get("default/adoptee")
+        assert rt is not None, "successor never adopted"
+        assert [r.pid for r in rt.workers.values()] == [pid]
+        assert not rt.failed, rt.failed
+        obj = store.get("JAXJob", "adoptee")
+        assert TrainJob.from_dict(obj).status.restart_count == 0
+        reasons = [e["reason"] for e in store.list("Event")
+                   if e.get("involved") == "default/adoptee"]
+        assert "GangAdopted" in reasons, reasons
+
+        await b.stop()  # kills the adopted worker via killpg
+        tb.cancel()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise AssertionError("adopted worker survived b.stop()")
+        store.close()
+
+    asyncio.run(run())

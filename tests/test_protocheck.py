@@ -135,6 +135,13 @@ def test_shipped_protocols_are_clean():
     # scheduler_managed jobs arm the per-job metric scaler anyway:
     # two resize authorities actuate one job.
     ("no_managed_gate", "KT-PROTO-WRITER"),
+    # A controller keeps actuating past its lease expiry (never
+    # re-checks held): a rival acquires and both write.
+    ("expired_lease_actuation", "KT-PROTO-LEASE"),
+    ("expired_lease_actuation", "KT-PROTO-WRITER"),
+    # The lease CAS admits a second holder while the first is valid.
+    ("double_holder", "KT-PROTO-LEASE"),
+    ("double_holder", "KT-PROTO-WRITER"),
 ])
 def test_planted_mutation_is_caught(mutation, expected_rule):
     findings, _ = check_protocols(mutations={mutation}, conformance=False)
@@ -174,6 +181,24 @@ def test_conformance_catches_reader_drift(monkeypatch, tmp_path):
 
     monkeypatch.setattr(protocheck, "read_resize_command", no_guard_reader)
     findings, _ = conformance_check(str(tmp_path))
+    assert any(f.rule == "KT-PROTO-CONFORM" for f in findings)
+    assert all(f.hard for f in findings)
+
+
+def test_lease_conformance_clean_on_real_lease():
+    findings, n_traces = protocheck.lease_conformance_check()
+    assert findings == [], [f.format() for f in findings]
+    assert n_traces > 0
+
+
+def test_lease_conformance_catches_fencing_drift(monkeypatch):
+    # A held property that ignores the clock (believes forever) must
+    # diverge at the expire step of some explored schedule.
+    from kubeflow_tpu.controller.lease import ControllerLease
+
+    monkeypatch.setattr(ControllerLease, "held",
+                        property(lambda self: self._holding))
+    findings, _ = protocheck.lease_conformance_check()
     assert any(f.rule == "KT-PROTO-CONFORM" for f in findings)
     assert all(f.hard for f in findings)
 
