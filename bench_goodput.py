@@ -1,0 +1,378 @@
+"""Fleet-telemetry goodput bench (KT-PERF-GOODPUT family).
+
+Certifies the ISSUE-20 chaos-plan contract with REAL processes: a
+child controller (``--serve`` mode of this same file) admits one
+JAXJob, spawns a real training worker, and drives the real telemetry
+plane -- periodic log scrape into the time-series store, goodput
+aggregation, SLO burn-rate evaluation. The parent then executes the
+chaos plan against it:
+
+1. wait for the startup burn alert (warmup is badput-dominated:
+   ``restart_recovery`` init time swamps early compute) to RESOLVE --
+   proving the fire -> resolve edge and establishing a healthy
+   baseline;
+2. SIGKILL the journaled worker mid-run -- the gang restarts, resumes
+   from checkpoint, and the crash-to-resume window lands in
+   ``restart_recovery``; the cumulative goodput fraction dips back
+   under the SLO floor and the alert must RE-FIRE.
+   ``burn_detect_seconds`` = kill observed -> SLOBurnRate event in the
+   store;
+3. publish a live resize command (half the device set) through the
+   real protocol file -- the worker reshards in place, acks over
+   KFTPU-METRIC, and the resize attempt lands in ``reshard``.
+
+Afterwards the parent replays the worker log through a FRESH
+TelemetryPlane (same scrape code, clean store) and asserts the ledger
+contract: two incarnations stitched, every attribution state priced,
+and conservation -- attributed seconds vs ledger-covered wall-clock --
+within the 2% acceptance bound.
+
+Measured (ratcheted by ``analysis/perf.py::_check_goodput``):
+
+- ``goodput_fraction``      -- compute share of attributed gang-hold
+                               time across the whole chaos run (floor)
+- ``conservation_error``    -- |attributed - wall| / wall (ceiling)
+- ``burn_detect_seconds``   -- worker death -> SLOBurnRate event
+                               (ceiling)
+- ``kill_exercised`` / ``reshard_exercised`` / ``alert_fired`` /
+  ``alert_resolved``        -- required chaos-plan coverage flags
+
+Run:  python bench_goodput.py            # JSON line to stdout
+      python bench_goodput.py --serve --store S --logs D   # (internal)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+TOTAL_CHIPS = 8
+JOB_NAME = "gp1"
+NAMESPACE = "default"
+JOB_KEY = f"{NAMESPACE}/{JOB_NAME}"
+SCRAPE_SECONDS = 0.5
+
+# SLO geometry sized for the CPU-backend timescale (probe: ~26ms steps,
+# ~3.5s worker init): burn = (1 - fraction) / (1 - floor) > threshold
+# in BOTH windows means "alert iff windowed mean goodput < 0.75".
+# Startup fires it, warmup resolves it, the mid-run kill re-fires it.
+GOODPUT_FLOOR = 0.75
+BURN_THRESHOLD = 1.0
+FAST_WINDOW = 4.0
+SLOW_WINDOW = 12.0
+
+
+def _base_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    env["KFTPU_SCRAPE_SECONDS"] = str(SCRAPE_SECONDS)
+    env.pop("KFTPU_CHAOS_PLAN", None)
+    return env
+
+
+# -- child: a controller + telemetry plane over a shared store file ----------
+
+def serve(store_path: str, log_dir: str) -> None:
+    from kubeflow_tpu.controller import (
+        GangScheduler,
+        JobController,
+        ProcessLauncher,
+        RuntimeJournal,
+        TelemetryPlane,
+    )
+    from kubeflow_tpu.store import ObjectStore
+
+    store = ObjectStore(store_path)
+    ctl = JobController(
+        store,
+        ProcessLauncher(log_dir=log_dir),
+        GangScheduler(total_chips=TOTAL_CHIPS),
+        journal=RuntimeJournal(store),
+        telemetry=TelemetryPlane(),
+    )
+    asyncio.run(ctl.run())
+
+
+# -- parent: execute the chaos plan and measure ------------------------------
+
+def _make_job(ckpt_dir: str):
+    from kubeflow_tpu.api import (
+        JobKind,
+        JobSpec,
+        ProcessTemplate,
+        ReplicaSpec,
+        ReplicaType,
+        Resources,
+        TrainJob,
+        apply_defaults,
+    )
+    from kubeflow_tpu.api.types import (
+        CheckpointPolicy,
+        ElasticPolicy,
+        ObjectMeta,
+        SLOSpec,
+    )
+
+    return apply_defaults(TrainJob(
+        kind=JobKind.JAXJob,
+        metadata=ObjectMeta(name=JOB_NAME, namespace=NAMESPACE),
+        spec=JobSpec(
+            replica_specs={
+                ReplicaType.Worker: ReplicaSpec(
+                    replicas=1,
+                    template=ProcessTemplate(
+                        entrypoint="kubeflow_tpu.runtime.entry",
+                        args=["--model", "llama", "--steps", "200000",
+                              "--log-every", "5",
+                              "--arg", "preset=llama-tiny",
+                              "--arg", "batch_size=8",
+                              "--arg", "seq_len=16"],
+                    ),
+                    resources=Resources(tpu=4),
+                )
+            },
+            checkpoint=CheckpointPolicy(
+                dir=ckpt_dir, interval_steps=100, keep=2, resume=True),
+            # metric=None keeps the autoscaler off: the only resize is
+            # the one this bench publishes through the protocol file.
+            elastic=ElasticPolicy(
+                min_replicas=1, max_replicas=1, reshard_in_place=True),
+            slo=SLOSpec(
+                goodput_floor=GOODPUT_FLOOR,
+                fast_window_seconds=FAST_WINDOW,
+                slow_window_seconds=SLOW_WINDOW,
+                burn_threshold=BURN_THRESHOLD,
+            ),
+        ),
+    ))
+
+
+def _journal_pids(store) -> set:
+    from kubeflow_tpu.controller.journal import JOURNAL_KIND
+
+    pids: set = set()
+    for rec in store.list(JOURNAL_KIND):
+        md = rec.get("metadata") or {}
+        if f"{md.get('namespace')}/{md.get('name')}" == JOB_KEY:
+            for ent in (rec.get("workers") or {}).values():
+                pids.add(int(ent["pid"]))
+    return pids
+
+
+def _event_counts(store) -> dict:
+    out: dict = {}
+    for ev in store.list("Event"):
+        if ev.get("involved") == JOB_KEY:
+            out[ev.get("reason")] = out.get(ev.get("reason"), 0) + 1
+    return out
+
+
+def _reshard_ack(log_path: str):
+    """Last reshard ack from the worker log: (ok, seconds) or None."""
+    from kubeflow_tpu.runtime.metrics import parse_metric_line
+
+    ack = None
+    try:
+        with open(log_path, errors="replace") as f:
+            for line in f:
+                kv = parse_metric_line(line)
+                if kv and kv.get("event") == "reshard":
+                    ack = (kv.get("reshard_ok") == "1",
+                           float(kv.get("reshard_seconds", 0.0)))
+    except OSError:
+        pass
+    return ack
+
+
+def _reshard_attributed(log_path: str) -> bool:
+    """True once a cumulative ledger line carries the reshard charge --
+    the resized mesh's first logged step has landed."""
+    from kubeflow_tpu.runtime.metrics import parse_metric_line
+
+    try:
+        with open(log_path, errors="replace") as f:
+            for line in f:
+                kv = parse_metric_line(line)
+                if kv and float(kv.get("gp_reshard", 0.0)) > 0:
+                    return True
+    except (OSError, ValueError):
+        pass
+    return False
+
+
+def _wait(pred, timeout: float, interval: float = 0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(interval)
+    return None
+
+
+def run_bench(workdir: str) -> dict:
+    from kubeflow_tpu.controller.envvars import resize_file_path
+    from kubeflow_tpu.controller.reshard_protocol import write_json_atomic
+    from kubeflow_tpu.controller.telemetry import TelemetryPlane
+    from kubeflow_tpu.obs.timeseries import SeriesStore
+    from kubeflow_tpu.store import ObjectStore
+
+    store_path = os.path.join(workdir, "store.db")
+    log_dir = os.path.join(workdir, "logs")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    os.makedirs(log_dir, exist_ok=True)
+
+    store = ObjectStore(store_path)
+    job = _make_job(ckpt_dir)
+    store.put(job.kind.value, job.to_dict())
+
+    gp: dict = {
+        "slo": {"goodput_floor": GOODPUT_FLOOR,
+                "burn_threshold": BURN_THRESHOLD,
+                "fast_window_seconds": FAST_WINDOW,
+                "slow_window_seconds": SLOW_WINDOW},
+        "scrape_interval_seconds": SCRAPE_SECONDS,
+    }
+    worker_pids: set = set()
+    ctl = None
+    try:
+        env = _base_env()
+        ctl = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--serve",
+             "--store", store_path, "--logs", log_dir],
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+
+        # -- phase 1: warmup. The startup burn alert must fire (init
+        # time dominates early attribution) and then resolve as compute
+        # accumulates past the SLO floor.
+        fired = _wait(lambda: _event_counts(store).get("SLOBurnRate", 0) >= 1,
+                      timeout=60.0)
+        gp["alert_fired"] = bool(fired)
+        resolved = _wait(
+            lambda: _event_counts(store).get("SLOBurnRateResolved", 0) >= 1,
+            timeout=180.0)
+        gp["alert_resolved"] = bool(resolved)
+        if not (fired and resolved):
+            raise RuntimeError(
+                f"warmup alert cycle incomplete: {_event_counts(store)}")
+
+        # -- phase 2: the kill. SIGKILL the journaled worker; the gang
+        # restarts and resumes, the recovery window is pure badput, and
+        # the alert must re-fire on the dip.
+        worker_pids = _journal_pids(store)
+        if len(worker_pids) != 1:
+            raise RuntimeError(f"expected 1 journaled worker: {worker_pids}")
+        victim = next(iter(worker_pids))
+        os.kill(victim, signal.SIGKILL)
+        t_kill = time.monotonic()
+        refire = _wait(
+            lambda: _event_counts(store).get("SLOBurnRate", 0) >= 2,
+            timeout=90.0)
+        if refire is None:
+            raise RuntimeError(
+                f"burn alert never re-fired after kill: "
+                f"{_event_counts(store)}")
+        gp["burn_detect_seconds"] = round(time.monotonic() - t_kill, 3)
+        respawned = _wait(
+            lambda: _journal_pids(store) - {victim}, timeout=30.0)
+        if not respawned:
+            raise RuntimeError("gang never respawned after the kill")
+        worker_pids |= respawned
+        gp["kill_exercised"] = True
+
+        # -- phase 3: the live reshard. Publish a resize command through
+        # the real protocol file (half the device set -- a real state
+        # transfer, not a no-op) and wait for the worker's ack.
+        logs = sorted(os.listdir(log_dir))
+        if len(logs) != 1:
+            raise RuntimeError(f"expected 1 worker log (append-mode "
+                               f"across incarnations): {logs}")
+        log_path = os.path.join(log_dir, logs[0])
+        write_json_atomic(resize_file_path(ckpt_dir),
+                          {"seq": 1, "num_slices": 1, "devices": 4,
+                           "target_replicas": 1})
+        ack = _wait(lambda: _reshard_ack(log_path), timeout=90.0)
+        if ack is None:
+            raise RuntimeError("worker never acked the resize command")
+        gp["reshard_exercised"] = bool(ack[0])
+        gp["reshard_seconds"] = round(ack[1], 3)
+        # The resized mesh's first logged step recompiles first, so wait
+        # for the ledger line that carries the reshard charge (a fixed
+        # tail would race the recompile and lose the attribution).
+        if _wait(lambda: _reshard_attributed(log_path),
+                 timeout=120.0) is None:
+            raise RuntimeError("reshard charge never reached the ledger")
+        time.sleep(1.0)
+    finally:
+        if ctl is not None:
+            ctl.terminate()
+            try:
+                ctl.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                ctl.kill()
+        for pid in worker_pids | _journal_pids(store):
+            for sig in (signal.SIGTERM, signal.SIGKILL):
+                try:
+                    os.killpg(pid, sig)
+                except (ProcessLookupError, PermissionError, OSError):
+                    pass
+
+    # -- the contract: replay the worker log through a fresh plane (same
+    # scrape code, clean store) and check the stitched job ledger.
+    plane = TelemetryPlane(series=SeriesStore(), now=time.time)
+    for fname in sorted(os.listdir(log_dir)):
+        plane.scrape_worker_log(JOB_KEY, fname,
+                                os.path.join(log_dir, fname))
+    jg = plane.goodput.get(JOB_KEY)
+    if jg is None:
+        raise RuntimeError("no ledger samples in the worker log")
+    gp["goodput_fraction"] = round(jg.goodput_fraction(), 4)
+    gp["conservation_error"] = round(jg.conservation_error(), 6)
+    gp["wall_seconds"] = round(jg.wall(), 3)
+    gp["attributed_seconds"] = {
+        s: round(v, 3) for s, v in jg.totals().items()}
+    gp["incarnations"] = jg.incarnations
+    gp["events"] = _event_counts(store)
+    store.close()
+
+    return {
+        "metric": "goodput_fraction",
+        "value": gp["goodput_fraction"],
+        "unit": ("compute share of attributed gang-hold seconds "
+                 "(chaos plan: 1 worker kill + 1 live reshard)"),
+        "vs_baseline": None,
+        "extra": {"goodput": gp},
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--serve", action="store_true")
+    ap.add_argument("--store")
+    ap.add_argument("--logs")
+    ap.add_argument("--workdir")
+    args = ap.parse_args()
+    if args.serve:
+        serve(args.store, args.logs)
+        return
+    if args.workdir:
+        os.makedirs(args.workdir, exist_ok=True)
+        print(json.dumps(run_bench(args.workdir)))
+        return
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="kftpu-goodput-") as td:
+        print(json.dumps(run_bench(td)))
+
+
+if __name__ == "__main__":
+    main()

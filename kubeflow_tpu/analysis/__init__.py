@@ -28,20 +28,25 @@ rule catalog and workflow):
   planned resplit's staged peak exceeds the declared HBM budget. The
   audited peaks feed the scheduler's placement feasibility mask
   (``controller/scheduler.py:resolve_hbm_peak``).
-- Tier C (`racecheck` + `protocheck` + `chaoscheck`): lock-discipline
-  race detection over the real threaded modules under a contended
-  stress driver (KT-RACE-ORDER / KT-GUARD01), exhaustive small-scope
-  model checking of the control-plane protocols -- reshard command/ack,
-  gang lifecycle, single-writer rule -- with conformance replay against
-  the real command-file code (KT-PROTO-*), and chaos conformance: the
-  fault-injection harness replays deterministically, the circuit
-  breaker honors its state machine, the router survives ejection /
-  re-admission / empty rings, and the checkpoint checksum manifests
-  catch corruption (KT-CHAOS-*).
+- Tier C (`racecheck` + `protocheck` + `chaoscheck` + `obscheck`):
+  lock-discipline race detection over the real threaded modules under a
+  contended stress driver (KT-RACE-ORDER / KT-GUARD01), exhaustive
+  small-scope model checking of the control-plane protocols -- reshard
+  command/ack, gang lifecycle, single-writer rule -- with conformance
+  replay against the real command-file code (KT-PROTO-*), chaos
+  conformance: the fault-injection harness replays deterministically,
+  the circuit breaker honors its state machine, the router survives
+  ejection / re-admission / empty rings, and the checkpoint checksum
+  manifests catch corruption (KT-CHAOS-*), and observability-plane
+  conformance: the goodput ledger conserves wall-clock across
+  incarnations, the series store honors its ring/downsample/staleness
+  contract, the burn-rate evaluator fires iff both windows burn, and
+  the metrics catalog in docs/OBSERVABILITY.md matches the registry
+  call sites in both directions (KT-OBS-*).
 
 Families (``kftpu analyze --only <family>``): astlint | audit | shard |
-mem | perf | race | proto | chaos. `kftpu analyze --strict` is the CI
-gate:
+mem | perf | race | proto | chaos | obsplane. `kftpu analyze --strict`
+is the CI gate:
 exit 0 iff nothing regressed vs the committed `baseline.json`.
 """
 
@@ -53,11 +58,12 @@ from typing import Dict, Iterable, List, Optional, Tuple
 # Registered analysis families (mirrored in baseline.json so the CI
 # contract is visible next to the grandfather counts).
 FAMILIES = ("astlint", "audit", "shard", "mem", "perf", "race", "proto",
-            "chaos")
+            "chaos", "obsplane")
 
 from kubeflow_tpu.analysis.perf import (  # noqa: F401
     PERF_BASELINE_PATH,
     check_perf,
+    latest_goodput_bench,
     latest_reshard_bench,
     latest_sched_bench,
     latest_train_bench,
@@ -110,7 +116,7 @@ def run_analysis(
     engine stress driver, preserving the historical flag semantics."""
     selected = (set(families) if families is not None
                 else {"astlint", "audit", "shard", "mem", "race",
-                      "proto", "chaos"})
+                      "proto", "chaos", "obsplane"})
     unknown = selected - set(FAMILIES)
     if unknown:
         raise ValueError(
@@ -168,4 +174,10 @@ def run_analysis(
         chaos_findings, chaos_info = check_chaos()
         findings.extend(chaos_findings)
         log.info("chaoscheck: %s", chaos_info)
+    if "obsplane" in selected:
+        from kubeflow_tpu.analysis.obscheck import check_obsplane
+
+        obs_findings, obs_info = check_obsplane()
+        findings.extend(obs_findings)
+        log.info("obscheck: %s", obs_info)
     return findings, metrics

@@ -1,0 +1,404 @@
+"""Tier C observability-plane conformance: the goodput ledger, the
+time-series store, the burn-rate evaluator, and the metrics catalog are
+checked every ``kftpu analyze`` run.
+
+Four rule families, all in-process against the REAL code (injectable
+clocks, synthetic samples -- no sleeps, no fleet):
+
+- KT-OBS-CONSERVE: goodput attribution conserves wall-clock. A
+  scripted GoodputLedger must attribute exactly its cursor span; its
+  emitted fields must round-trip through the KFTPU-METRIC parser; a
+  JobGoodput fed two incarnations with a kill gap must attribute the
+  gap to restart_recovery and keep the job-level conservation error at
+  zero. The runtime step loop (runtime/entry.py) must settle every
+  attribution state -- a refactor that drops a settle site silently
+  un-attributes that time and fails here, not in production.
+- KT-OBS-SERIES: the bounded ring store honors its contract --
+  capacity bounds memory, query-time downsampling buckets to the mean,
+  staleness marks clear on the next successful add, and one
+  (name, labels) pair can never split into two rings.
+- KT-OBS-BURN: the multiwindow burn-rate evaluator fires iff BOTH
+  windows burn over threshold (fast-only blips and healthy series must
+  not alert), edge-triggers exactly one event per transition, and
+  drives registered pressure callbacks both directions.
+- KT-OBS-CATALOG: metrics-catalog drift lint. Every metric name
+  registered at an ``obs.registry`` call site (or exported through
+  ``sample_line``) appears in docs/OBSERVABILITY.md, and every
+  ``kftpu_*`` name in the doc's catalog tables exists in the package
+  source -- the catalog can neither silently lag the code nor document
+  ghosts.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from kubeflow_tpu.analysis.report import Finding
+from kubeflow_tpu.obs.goodput import (
+    STATES,
+    GoodputLedger,
+    JobGoodput,
+    parse_fields,
+)
+from kubeflow_tpu.obs.timeseries import SeriesStore
+
+_SELF = "kubeflow_tpu/analysis/obscheck.py"
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+_DOC_PATH = os.path.join(_REPO_ROOT, "docs", "OBSERVABILITY.md")
+_ENTRY_PATH = os.path.join(_PKG_ROOT, "runtime", "entry.py")
+
+
+def _finding(rule: str, message: str, path: str = _SELF,
+             line: int = 0) -> Finding:
+    return Finding(rule=rule, path=path, line=line, hard=True,
+                   message=message)
+
+
+# -- KT-OBS-CONSERVE ---------------------------------------------------------
+
+# Scripted single-incarnation run: every state visited at least once.
+_SCRIPT = (
+    ("restart_recovery", 5.0),
+    ("compute", 10.0),
+    ("checkpoint", 1.5),
+    ("input_wait", 0.25),
+    ("compute", 7.0),
+    ("reshard", 2.0),
+    ("idle", 0.5),
+)
+
+
+def _run_ledger(epoch: float) -> GoodputLedger:
+    t = [0.0]
+    led = GoodputLedger(clock=lambda: t[0], epoch=epoch)
+    for state, dt in _SCRIPT:
+        t[0] += dt
+        led.settle(state)
+    return led
+
+
+def check_conservation() -> List[Finding]:
+    findings: List[Finding] = []
+    led = _run_ledger(epoch=1000.0)
+    wall = sum(dt for _, dt in _SCRIPT)
+    if abs(led.wall() - wall) > 1e-9 or led.conservation_error() > 1e-9:
+        findings.append(_finding(
+            "KT-OBS-CONSERVE",
+            f"ledger attributed {led.attributed():.6f}s of "
+            f"{led.wall():.6f}s wall ({wall:.6f}s scripted) -- "
+            f"attribution must be exact by construction",
+        ))
+    # The emitted fields must survive the KFTPU-METRIC wire format.
+    from kubeflow_tpu.runtime.metrics import parse_metric_line
+
+    line = "KFTPU-METRIC " + " ".join(
+        f"{k}={v}" for k, v in led.fields().items())
+    sample = parse_fields(parse_metric_line(line) or {})
+    if sample is None:
+        findings.append(_finding(
+            "KT-OBS-CONSERVE",
+            "ledger fields() did not round-trip through "
+            "parse_metric_line/parse_fields",
+        ))
+        return findings
+    if abs(sample["wall"] - wall) > 1e-2:
+        findings.append(_finding(
+            "KT-OBS-CONSERVE",
+            f"round-tripped wall {sample['wall']} != scripted {wall}",
+        ))
+    # Two incarnations with a 3.75s kill gap: the aggregator must charge
+    # the gap to restart_recovery and conserve at the job level.
+    gap = 3.75
+    jg = JobGoodput()
+    jg.observe(sample)
+    led2 = _run_ledger(epoch=1000.0 + wall + gap)
+    sample2 = parse_fields(parse_metric_line(
+        "KFTPU-METRIC " + " ".join(
+            f"{k}={v}" for k, v in led2.fields().items())) or {})
+    jg.observe(sample2)
+    if jg.incarnations != 2:
+        findings.append(_finding(
+            "KT-OBS-CONSERVE",
+            f"aggregator saw {jg.incarnations} incarnations, expected 2",
+        ))
+    if jg.conservation_error() > 1e-3:
+        findings.append(_finding(
+            "KT-OBS-CONSERVE",
+            f"job-level conservation error {jg.conservation_error():.6f} "
+            f"after a banked incarnation (must be ~0: the kill gap is "
+            f"charged to restart_recovery)",
+        ))
+    recovery = jg.totals().get("restart_recovery", 0.0)
+    want = 2 * 5.0 + gap  # two scripted recovery legs + the kill gap
+    if abs(recovery - want) > 1e-2:
+        findings.append(_finding(
+            "KT-OBS-CONSERVE",
+            f"restart_recovery attributed {recovery:.3f}s, expected "
+            f"{want:.3f}s (scripted legs + kill gap)",
+        ))
+    # Source scan: the step loop must settle every attribution state.
+    try:
+        src = open(_ENTRY_PATH).read()
+    except OSError:
+        src = ""
+    for state in STATES:
+        if f'settle("{state}")' not in src:
+            findings.append(_finding(
+                "KT-OBS-CONSERVE",
+                f"runtime/entry.py no longer settles {state!r} -- that "
+                f"time silently leaves the goodput attribution",
+                path="kubeflow_tpu/runtime/entry.py",
+            ))
+    return findings
+
+
+# -- KT-OBS-SERIES -----------------------------------------------------------
+
+def check_series() -> List[Finding]:
+    findings: List[Finding] = []
+    store = SeriesStore(capacity=32)
+    for i in range(200):
+        store.add("m", {"job": "j"}, float(i), ts=float(i))
+    s = store.get("m", {"job": "j"})
+    if s is None or len(s.points) != 32:
+        findings.append(_finding(
+            "KT-OBS-SERIES",
+            f"ring holds {0 if s is None else len(s.points)} points at "
+            f"capacity 32 after 200 adds -- the bound is the contract",
+        ))
+        return findings
+    # Downsample: steps 168..199 live; 10s buckets -> bucket means.
+    pts = s.query(step=10.0)
+    if not pts or any(
+            abs(v - (sum(range(b, min(b + 10, 200))) /
+                     len(range(b, min(b + 10, 200))))) > 1e-9
+            for (_, v), b in zip(pts[1:], range(170, 200, 10))):
+        findings.append(_finding(
+            "KT-OBS-SERIES",
+            "query-time downsampling did not bucket to the mean",
+        ))
+    # Staleness: mark, then a successful add clears.
+    n = store.mark_stale({"job": "j"})
+    if n != 1 or not s.stale:
+        findings.append(_finding(
+            "KT-OBS-SERIES", "mark_stale did not mark the series"))
+    store.add("m", {"job": "j"}, 1.0)
+    if s.stale:
+        findings.append(_finding(
+            "KT-OBS-SERIES", "a successful add must clear staleness"))
+    # Keying: one (name, labels) pair, one ring -- label order must not
+    # split it.
+    a = store.series("k", {"a": "1", "b": "2"})
+    b = store.series("k", {"b": "2", "a": "1"})
+    if a is not b:
+        findings.append(_finding(
+            "KT-OBS-SERIES",
+            "label ordering split one (name, labels) pair into two rings",
+        ))
+    return findings
+
+
+# -- KT-OBS-BURN -------------------------------------------------------------
+
+class _SLO:
+    goodput_floor = 0.90
+    ttft_ms = None
+    itl_ms = None
+    availability = 0.99
+    fast_window_seconds = 60.0
+    slow_window_seconds = 600.0
+    burn_threshold = 2.0
+
+
+def _plane(now: float):
+    from kubeflow_tpu.controller.telemetry import TelemetryPlane
+
+    return TelemetryPlane(series=SeriesStore(), interval_seconds=1.0,
+                          now=lambda: now)
+
+
+def check_burn() -> List[Finding]:
+    findings: List[Finding] = []
+    now = 10_000.0
+    # Healthy: goodput above floor everywhere -> no alert.
+    plane = _plane(now)
+    for ts in range(int(now) - 600, int(now), 10):
+        plane.series.add("goodput.fraction", {"job": "j"}, 0.97,
+                         ts=float(ts))
+    ev = plane.evaluate_job("j", _SLO())
+    if ev is None or ev["firing"] or "j" in plane.alerts:
+        findings.append(_finding(
+            "KT-OBS-BURN", "healthy series raised a burn-rate alert"))
+    # Fast-only blip: bad last 60s, healthy slow window -> no alert.
+    plane = _plane(now)
+    for ts in range(int(now) - 600, int(now) - 60, 10):
+        plane.series.add("goodput.fraction", {"job": "j"}, 0.99,
+                         ts=float(ts))
+    for ts in range(int(now) - 60, int(now), 10):
+        plane.series.add("goodput.fraction", {"job": "j"}, 0.10,
+                         ts=float(ts))
+    ev = plane.evaluate_job("j", _SLO())
+    if ev is None or ev["firing"]:
+        findings.append(_finding(
+            "KT-OBS-BURN",
+            "a fast-window-only blip alerted (the slow window exists "
+            "exactly to suppress this page)",
+        ))
+    # Sustained burn: bad in both windows -> alert, edge-triggered, with
+    # pressure callbacks in both directions.
+    plane = _plane(now)
+    for ts in range(int(now) - 600, int(now), 10):
+        plane.series.add("goodput.fraction", {"job": "j"}, 0.10,
+                         ts=float(ts))
+    events: List[Tuple[str, str]] = []
+    pressure: List[Tuple[str, bool]] = []
+    plane.pressure_callbacks.append(
+        lambda key, active: pressure.append((key, active)))
+    cb = lambda reason, msg: events.append((reason, msg))  # noqa: E731
+    ev = plane.evaluate_job("j", _SLO(), event_cb=cb)
+    plane.evaluate_job("j", _SLO(), event_cb=cb)  # re-eval: no re-fire
+    if ev is None or not ev["firing"] or plane.alerting().get("j") \
+            != "goodput":
+        findings.append(_finding(
+            "KT-OBS-BURN", "sustained budget burn did not alert"))
+    if [r for r, _ in events] != ["SLOBurnRate"]:
+        findings.append(_finding(
+            "KT-OBS-BURN",
+            f"expected exactly one edge-triggered SLOBurnRate event, "
+            f"got {[r for r, _ in events]}",
+        ))
+    if pressure != [("j", True)]:
+        findings.append(_finding(
+            "KT-OBS-BURN",
+            f"pressure callbacks saw {pressure}, expected [('j', True)]",
+        ))
+    # Recovery: healthy points in the fast window resolve the alert.
+    for ts in range(int(now), int(now) + 60, 5):
+        plane.series.add("goodput.fraction", {"job": "j"}, 1.0,
+                         ts=float(ts))
+    plane._now = lambda: now + 60.0
+    plane.evaluate_job("j", _SLO(), event_cb=cb)
+    if "j" in plane.alerts or events[-1][0] != "SLOBurnRateResolved" \
+            or pressure[-1] != ("j", False):
+        findings.append(_finding(
+            "KT-OBS-BURN",
+            "alert did not resolve (edge-triggered resolve event + "
+            "pressure release) once the burn stopped",
+        ))
+    return findings
+
+
+# -- KT-OBS-CATALOG ----------------------------------------------------------
+
+# Registration/emission sites whose first argument is the metric name.
+_REG_RE = re.compile(
+    r'\.(?:counter|gauge|histogram)\(\s*[fr]?"(kftpu_[A-Za-z0-9_]+)"')
+_SAMPLE_RE = re.compile(
+    r'\bsample(?:_line)?\(\s*"(kftpu_[A-Za-z0-9_]+)"')
+_DOC_NAME_RE = re.compile(r"`(kftpu_[A-Za-z0-9_]+)`")
+
+
+def _code_metrics() -> Dict[str, str]:
+    """name -> defining file, for every literal registration site in the
+    package (analysis/ excluded: its stress-driver instrumentation is
+    harness-internal, not exported product surface)."""
+    out: Dict[str, str] = {}
+    for dirpath, dirs, files in os.walk(_PKG_ROOT):
+        if "analysis" in os.path.relpath(dirpath, _PKG_ROOT).split(os.sep):
+            continue
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                src = open(path).read()
+            except OSError:
+                continue
+            # Collapse call-site line breaks so a name on its own line
+            # still matches.
+            flat = re.sub(r"\(\s*\n\s*", "(", src)
+            rel = os.path.relpath(path, _REPO_ROOT)
+            for m in _REG_RE.finditer(flat):
+                out.setdefault(m.group(1), rel)
+            for m in _SAMPLE_RE.finditer(flat):
+                out.setdefault(m.group(1), rel)
+    return out
+
+
+def _doc_catalog() -> Tuple[Set[str], str]:
+    """(names in the catalog tables, full doc text)."""
+    try:
+        text = open(_DOC_PATH).read()
+    except OSError:
+        return set(), ""
+    table_names: Set[str] = set()
+    for line in text.splitlines():
+        if line.lstrip().startswith("|"):
+            table_names.update(_DOC_NAME_RE.findall(line))
+    return table_names, text
+
+
+def _package_source() -> str:
+    chunks = []
+    for dirpath, _dirs, files in os.walk(_PKG_ROOT):
+        for fn in files:
+            if fn.endswith(".py"):
+                try:
+                    chunks.append(open(os.path.join(dirpath, fn)).read())
+                except OSError:
+                    continue
+    return "\n".join(chunks)
+
+
+def check_catalog() -> List[Finding]:
+    findings: List[Finding] = []
+    registered = _code_metrics()
+    table_names, doc_text = _doc_catalog()
+    if not doc_text:
+        findings.append(_finding(
+            "KT-OBS-CATALOG",
+            f"metrics catalog {os.path.relpath(_DOC_PATH, _REPO_ROOT)} "
+            f"is missing",
+            path="docs/OBSERVABILITY.md",
+        ))
+        return findings
+    for name, where in sorted(registered.items()):
+        if name not in doc_text:
+            findings.append(_finding(
+                "KT-OBS-CATALOG",
+                f"metric {name} (registered in {where}) is not in the "
+                f"docs/OBSERVABILITY.md catalog",
+                path=where,
+            ))
+    src = _package_source()
+    for name in sorted(table_names):
+        if name not in src:
+            findings.append(_finding(
+                "KT-OBS-CATALOG",
+                f"docs/OBSERVABILITY.md catalogs {name} but no package "
+                f"source mentions it -- ghost catalog entry",
+                path="docs/OBSERVABILITY.md",
+            ))
+    return findings
+
+
+# -- entry point -------------------------------------------------------------
+
+def check_obsplane() -> Tuple[List[Finding], Dict[str, int]]:
+    """Entry point mirroring check_races/check_protocols/check_chaos:
+    returns (findings, coverage info)."""
+    findings: List[Finding] = []
+    findings.extend(check_conservation())
+    findings.extend(check_series())
+    findings.extend(check_burn())
+    findings.extend(check_catalog())
+    info = {
+        "ledger_states": len(STATES),
+        "catalog_metrics": len(_code_metrics()),
+        "rules": 4,
+    }
+    return findings, info

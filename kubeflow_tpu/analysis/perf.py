@@ -126,6 +126,11 @@ def latest_ctrlha_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str
     return _latest_bench_with(root, ("ctrlha",))
 
 
+def latest_goodput_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str]:
+    """Newest committed ``bench_goodput.py`` round (extra.goodput)."""
+    return _latest_bench_with(root, ("goodput",))
+
+
 def serving_bench(root: Optional[str] = None) -> Tuple[Optional[dict], str]:
     root = root or _REPO_ROOT
     path = os.path.join(root, "SERVING_BENCH.json")
@@ -430,6 +435,63 @@ def _check_ctrlha(hbase: dict, ha: dict, artifact: str,
                     f"ctrlha.{req} = {ha.get(req)!r}, expected true: "
                     f"the bench did not actually kill and succeed the "
                     f"controller ({artifact})"
+                ),
+            ))
+    return findings
+
+
+def _check_goodput(gbase: dict, gp: dict, artifact: str,
+                   measured: Dict[str, float]) -> List[Finding]:
+    """KT-PERF-GOODPUT: the telemetry-plane chaos bench
+    (bench_goodput.py -- a real training gang run under the controller
+    with one worker kill and one reshard mid-run, its goodput ledger
+    scraped and aggregated by the TelemetryPlane).
+
+    The observability contract: attribution CONSERVES wall-clock
+    (conservation_error under the epsilon ceiling -- the hard invariant
+    of the ledger design), the measured goodput fraction stays above
+    its ratcheted floor, and the burn-rate engine detects the injected
+    badput within the detection-latency ceiling. A bound whose metric
+    vanished from the artifact is a finding (shrunk-curve rule)."""
+    findings: List[Finding] = []
+
+    def _bound(mkey: str, bkey: str, floor: bool = False) -> None:
+        limit = gbase.get(bkey)
+        if limit is None:
+            return
+        val = gp.get(mkey)
+        if val is None:
+            findings.append(Finding(
+                rule="KT-PERF-GOODPUT", path=artifact, line=0, hard=True,
+                message=(
+                    f"goodput.{mkey}: missing from {artifact} "
+                    f"({bkey}={limit}) -- the goodput curve shrank"
+                ),
+            ))
+            return
+        measured[f"goodput.{mkey}"] = float(val)
+        bad = val < limit if floor else val > limit
+        if bad:
+            findings.append(Finding(
+                rule="KT-PERF-GOODPUT", path=artifact, line=0, hard=True,
+                message=(
+                    f"goodput.{mkey} = {val} "
+                    f"{'below floor' if floor else 'exceeds ceiling'} "
+                    f"{limit} ({artifact})"
+                ),
+            ))
+
+    _bound("goodput_fraction", "goodput_fraction_floor", floor=True)
+    _bound("conservation_error", "conservation_error_max")
+    _bound("burn_detect_seconds", "burn_detect_seconds_ceiling")
+    for req in gbase.get("required") or []:
+        if not gp.get(req):
+            findings.append(Finding(
+                rule="KT-PERF-GOODPUT", path=artifact, line=0, hard=True,
+                message=(
+                    f"goodput.{req} = {gp.get(req)!r}, expected true: "
+                    f"the bench did not actually exercise the chaos "
+                    f"plan it attributes badput to ({artifact})"
                 ),
             ))
     return findings
@@ -920,6 +982,39 @@ def check_perf(
             else:
                 findings.extend(_check_ctrlha(hbase, ha, artifact,
                                               measured))
+
+    # -- telemetry-plane goodput (chaos-plan) bounds ------------------------
+    gbase = baseline.get("goodput") or {}
+    if gbase:
+        parsed, artifact = latest_goodput_bench(root)
+        if parsed is None:
+            # Same vanished-artifact rule as ctrlha: other rounds alive
+            # but the goodput one gone must not un-ratchet.
+            if glob.glob(os.path.join(root or _REPO_ROOT,
+                                      "BENCH_r*.json")):
+                findings.append(Finding(
+                    rule="KT-PERF-GOODPUT", path="BENCH_r*.json", line=0,
+                    hard=True,
+                    message=(
+                        "goodput bounds set but no committed bench round "
+                        "carries extra.goodput -- the telemetry bench "
+                        "vanished"
+                    ),
+                ))
+        else:
+            gp = (parsed.get("extra") or {}).get("goodput")
+            if not isinstance(gp, dict):
+                findings.append(Finding(
+                    rule="KT-PERF-GOODPUT", path=artifact, line=0,
+                    hard=True,
+                    message=(
+                        f"no extra.goodput section in {artifact} (goodput "
+                        f"bounds set) -- the telemetry bench vanished"
+                    ),
+                ))
+            else:
+                findings.extend(_check_goodput(gbase, gp, artifact,
+                                               measured))
 
     # -- live-metric ceilings ----------------------------------------------
     # Checked against THIS analyze run's Tier-B metrics; a ceiling whose

@@ -24,6 +24,7 @@ from kubeflow_tpu.api.types import (  # noqa: F401
     RestartPolicy,
     RunPolicy,
     SchedulingPolicy,
+    SLOSpec,
     TrainJob,
 )
 from kubeflow_tpu.api.validation import apply_defaults, validate_job  # noqa: F401

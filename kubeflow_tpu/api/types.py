@@ -31,7 +31,7 @@ import enum
 import time
 from typing import Any, Literal, Optional
 
-from pydantic import BaseModel, ConfigDict, Field
+from pydantic import BaseModel, ConfigDict, Field, model_validator
 
 
 class JobKind(str, enum.Enum):
@@ -243,6 +243,41 @@ class ProfilingPolicy(BaseModel):
     num_steps: int = Field(default=3, ge=1)
 
 
+class SLOSpec(BaseModel):
+    """Service-level objectives the telemetry plane's burn-rate engine
+    evaluates (multiwindow, Google SRE-workbook style): training jobs
+    declare a goodput-fraction floor, serving jobs TTFT/ITL ceilings
+    with an availability target. An alert fires only when BOTH the fast
+    and the slow window burn the error budget faster than
+    ``burn_threshold``; it lands as a store event, a pair of gauges, and
+    pressure on the router's shed threshold and the scheduler's victim
+    ordering."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    # Training: minimum acceptable goodput fraction (compute seconds /
+    # gang-hold seconds). The error budget is 1 - goodput_floor.
+    goodput_floor: Optional[float] = Field(default=None, gt=0, le=1)
+    # Serving: latency ceilings. A sample over the ceiling is "bad";
+    # the budget is 1 - availability of samples allowed to be bad.
+    ttft_ms: Optional[float] = Field(default=None, gt=0)
+    itl_ms: Optional[float] = Field(default=None, gt=0)
+    availability: float = Field(default=0.99, gt=0, lt=1)
+    # Multiwindow burn-rate evaluation: the fast window catches a cliff
+    # quickly, the slow window keeps one transient spike from paging.
+    fast_window_seconds: float = Field(default=300.0, gt=0)
+    slow_window_seconds: float = Field(default=3600.0, gt=0)
+    burn_threshold: float = Field(default=2.0, gt=0)
+
+    @model_validator(mode="after")
+    def _windows_ordered(self) -> "SLOSpec":
+        if self.fast_window_seconds > self.slow_window_seconds:
+            raise ValueError(
+                "fast_window_seconds must not exceed slow_window_seconds"
+            )
+        return self
+
+
 class RunPolicy(BaseModel):
     """Job-level lifecycle policy; same field semantics as the reference."""
 
@@ -274,6 +309,9 @@ class JobSpec(BaseModel):
     # processes (== nproc_per_node in torch terms). Almost always 1 here:
     # one process per host, all local chips visible to it.
     nproc_per_replica: int = Field(default=1, ge=1)
+    # Service-level objectives for the burn-rate engine. None = the
+    # telemetry plane scrapes the job but never alerts on it.
+    slo: Optional[SLOSpec] = None
 
 
 class Condition(BaseModel):

@@ -288,11 +288,15 @@ def cmd_trace(args, _client) -> int:
         except Exception as e:  # noqa: BLE001 - a dead replica must not
             print(f"skipping {url}: {e}", file=sys.stderr)  # kill the dump
     if not docs:
-        raise SystemExit(
-            "error: no trace documents found -- set KFTPU_TRACE_DIR (or "
-            "--dir) to a directory of trace-*.json dumps, or point "
-            "--serving at a live replica"
+        # Empty is a normal state (tracing off, nothing has run yet),
+        # not an error: exit 0 with guidance, so scripted pipelines that
+        # dump opportunistically don't fail on quiet deployments.
+        print(
+            "no trace documents found -- set KFTPU_TRACE_DIR (or --dir) "
+            "to a directory of trace-*.json dumps, or point --serving at "
+            "a live replica; nothing written"
         )
+        return 0
     merged = obs_trace.merge(docs)
     if args.out == "-":
         json.dump(merged, sys.stdout)
@@ -329,6 +333,79 @@ def cmd_trace(args, _client) -> int:
                   + (f" ({pairs})" if pairs else ""))
     print("view: https://ui.perfetto.dev -> Open trace file")
     return 0
+
+
+def _render_top(snap: dict) -> str:
+    """Table over one ``/debug/series`` snapshot: per-job goodput
+    fraction, attribution, live throughput, SLO burn state."""
+    goodput = snap.get("goodput") or {}
+    alerts = snap.get("alerts") or {}
+    series = snap.get("series") or []
+    tok: dict = {}
+    for s in series:
+        if s["name"] == "train.tokens_per_sec" and not s["stale"] \
+                and s["points"]:
+            job = s["labels"].get("job", "?")
+            tok[job] = tok.get(job, 0.0) + s["points"][-1][1]
+    header = ("JOB", "GOODPUT", "WALL_S", "TOK/S", "BADPUT(top)",
+              "CONSV_ERR", "INCARN", "SLO")
+    rows = []
+    for job in sorted(set(goodput) | set(alerts) | set(tok)):
+        g = goodput.get(job)
+        slo = f"ALERT:{alerts[job]}" if job in alerts else "ok"
+        if g is None:
+            rows.append((job, "-", "-", f"{tok.get(job, 0.0):.0f}",
+                         "-", "-", "-", slo))
+            continue
+        bad = {k: v for k, v in g["attributed_seconds"].items()
+               if k != "compute" and v > 0}
+        top_bad = (max(bad.items(), key=lambda kv: kv[1]) if bad else None)
+        rows.append((
+            job,
+            f"{g['fraction']:.3f}",
+            f"{g['wall_seconds']:.1f}",
+            f"{tok.get(job, 0.0):.0f}",
+            f"{top_bad[0]}={top_bad[1]:.1f}s" if top_bad else "-",
+            f"{g['conservation_error']:.4f}",
+            str(g["incarnations"]),
+            slo,
+        ))
+    out = []
+    if rows:
+        table = [header] + rows
+        widths = [max(len(str(r[i])) for r in table)
+                  for i in range(len(header))]
+        for r in table:
+            out.append("  ".join(
+                str(v).ljust(w) for v, w in zip(r, widths)).rstrip())
+    else:
+        out.append("no jobs reporting telemetry yet")
+    stale = sum(1 for s in series if s["stale"])
+    out.append(f"{len(series)} series ({stale} stale), "
+               f"{len(alerts)} SLO alert(s) firing")
+    return "\n".join(out)
+
+
+def cmd_top(args, _client) -> int:
+    """``kftpu top``: fleet telemetry one-pager from the control plane's
+    ``/debug/series`` -- per-job goodput fraction, badput attribution,
+    live throughput, and SLO burn-rate alert state."""
+    import urllib.request
+
+    url = (args.server.rstrip("/")
+           + f"/debug/series?since={float(args.since):g}")
+    while True:
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                snap = json.load(r)
+        except Exception as e:  # noqa: BLE001 - one message, not a trace
+            raise SystemExit(
+                f"error: cannot fetch {url}: {e}; start the control "
+                f"plane with: kftpu serve")
+        print(_render_top(snap), flush=True)
+        if not args.watch:
+            return 0
+        time.sleep(args.watch)
 
 
 def cmd_sched(args, _client) -> int:
@@ -558,6 +635,16 @@ def main(argv=None) -> int:
                          "dry; suppresses the reminder note)")
     sp.set_defaults(fn=cmd_sched)
 
+    sp = sub.add_parser(
+        "top",
+        help="fleet telemetry: per-job goodput, throughput, SLO state",
+    )
+    sp.add_argument("--since", type=float, default=600.0,
+                    help="lookback window in seconds (default: 600)")
+    sp.add_argument("--watch", type=float, default=None, metavar="SECONDS",
+                    help="refresh every SECONDS instead of one-shot")
+    sp.set_defaults(fn=cmd_top)
+
     sp = sub.add_parser("serve", help="run the control-plane server")
     sp.add_argument("--state-dir", default=os.path.expanduser("~/.kftpu"))
     sp.add_argument("--port", type=int, default=7450)
@@ -566,7 +653,7 @@ def main(argv=None) -> int:
 
     args = p.parse_args(argv)
     # No control-plane client needed (sched builds its own in server mode).
-    local_cmds = ("serve", "analyze", "trace", "sched")
+    local_cmds = ("serve", "analyze", "trace", "sched", "top")
     client = TrainingClient(args.server) if args.cmd not in local_cmds else None
     try:
         return args.fn(args, client)

@@ -18,6 +18,7 @@ from kubeflow_tpu.controller.launcher import (  # noqa: F401
 )
 from kubeflow_tpu.controller.lease import ControllerLease  # noqa: F401
 from kubeflow_tpu.controller.reconciler import JobController  # noqa: F401
+from kubeflow_tpu.controller.telemetry import TelemetryPlane  # noqa: F401
 from kubeflow_tpu.controller.scheduler import (  # noqa: F401
     ClusterScheduler,
     Domain,

@@ -41,6 +41,7 @@ import time
 import uuid
 from typing import Any, Callable, Dict, Optional
 
+from kubeflow_tpu.obs.registry import REGISTRY
 from kubeflow_tpu.store.store import ConflictError
 
 log = logging.getLogger(__name__)
@@ -131,6 +132,12 @@ class ControllerLease:
         self._expiry = now + self.duration
         self._holding = True
         self.token = int(saved["metadata"]["generation"])
+        # kube_*_labels-style info gauge: value 1 while this process
+        # holds the lease; the label carries WHO. The fencing token
+        # rides the same exposition so a scrape can order takeovers.
+        REGISTRY.gauge("kftpu_controller_lease_holder_info",
+                       {"holder": self.holder}).set(1)
+        REGISTRY.gauge("kftpu_controller_lease_token").set(self.token)
         return True
 
     def renew(self) -> bool:
@@ -150,6 +157,8 @@ class ControllerLease:
         if not self._holding:
             return
         self._holding = False
+        REGISTRY.gauge("kftpu_controller_lease_holder_info",
+                       {"holder": self.holder}).set(0)
         try:
             obj = self.read()
             if obj is not None and obj.get("holder") == self.holder:

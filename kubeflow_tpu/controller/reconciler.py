@@ -156,6 +156,7 @@ class JobController:
         backoff_max_seconds: float = 30.0,
         journal: Optional[RuntimeJournal] = None,
         lease: Optional[ControllerLease] = None,
+        telemetry=None,
     ) -> None:
         self.store = store
         self.launcher = launcher
@@ -167,6 +168,10 @@ class JobController:
         # controller process (docs/CONTROLPLANE.md).
         self._journal = journal
         self._lease = lease
+        # Optional telemetry plane (controller/telemetry.py): when set,
+        # run() drives a periodic scrape of every worker's metric log
+        # into the time-series store plus the SLO burn-rate evaluation.
+        self.telemetry = telemetry
         self.backoff_base = backoff_base_seconds
         self.backoff_max = backoff_max_seconds
         self._runtimes: dict[str, _JobRuntime] = {}
@@ -206,6 +211,8 @@ class JobController:
             for obj in self.store.list(kind):
                 self._enqueue(kind, obj["metadata"]["namespace"], obj["metadata"]["name"])
         watcher = asyncio.create_task(self._pump_watch(watch_q))
+        scraper = (asyncio.create_task(self._telemetry_loop())
+                   if self.telemetry is not None else None)
         try:
             while not self._stopped.is_set():
                 get = asyncio.create_task(self._queue.get())
@@ -229,7 +236,24 @@ class JobController:
                         self._enqueue_later(2.0, kind, ns, name)
         finally:
             watcher.cancel()
+            if scraper is not None:
+                scraper.cancel()
             self.store.unwatch(watch_q)
+
+    async def _telemetry_loop(self) -> None:
+        """Periodic scrape pass (controller/telemetry.py). Read-only
+        with respect to actuation, so it does NOT check the lease: a
+        fenced standby may keep observing, it just must not act."""
+        while not self._stopped.is_set():
+            try:
+                self.telemetry.scrape_controller(self)
+            except Exception:  # never take the controller down
+                logger.exception("telemetry scrape pass failed")
+            try:
+                await asyncio.wait_for(
+                    self._stopped.wait(), timeout=self.telemetry.interval)
+            except asyncio.TimeoutError:
+                continue
 
     async def _ensure_lease(self) -> None:
         """Renew the actuation lease before each reconcile; on loss, fence
@@ -411,6 +435,14 @@ class JobController:
         REGISTRY.gauge("kftpu_controller_adoption_seconds").set(round(dt, 3))
         REGISTRY.gauge("kftpu_controller_adopted_gangs").set(adopted)
         REGISTRY.gauge("kftpu_controller_adoption_failed_gangs").set(failed)
+        # Monotone HA counters beside the last-pass gauges: dashboards
+        # alert on adoption-failure RATE, which gauges cannot carry
+        # across repeated adoption passes (lease loss + re-acquire).
+        if adopted:
+            REGISTRY.counter("kftpu_controller_adoptions_total").inc(adopted)
+        if failed:
+            REGISTRY.counter(
+                "kftpu_controller_adoption_failures_total").inc(failed)
         logger.info("adoption: %d gangs adopted, %d routed to restart "
                     "in %.3fs", adopted, failed, dt)
 

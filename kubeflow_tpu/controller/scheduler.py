@@ -247,6 +247,11 @@ class SchedJob:
     # beats "none" -- see resolve_hbm_peak.
     hbm_peak_bytes: Optional[float] = None
     fit_source: str = "none"
+    # True while the telemetry plane's burn-rate evaluator has an active
+    # SLO alert for this job. An alerting job is already losing error
+    # budget; preempting it on top of that compounds the burn, so the
+    # victim ordering shields it (evicted last within the overflow set).
+    slo_alert: bool = False
 
 
 @dataclasses.dataclass
@@ -412,14 +417,16 @@ def fair_shares(jobs: Sequence[SchedJob], capacity: int,
     return alloc
 
 
-def preemption_rank(job: SchedJob) -> Tuple[int, int]:
+def preemption_rank(job: SchedJob) -> Tuple[int, int, int]:
     """Victim ordering under pressure: higher rank = evicted first.
-    HPO before train before serving; youngest-first within a class."""
+    Jobs under an active SLO burn-rate alert are shielded (evicted
+    last -- they are already losing error budget); otherwise HPO before
+    train before serving; youngest-first within a class."""
     try:
         cls = WORKLOAD_CLASSES.index(job.workload)
     except ValueError:
         cls = WORKLOAD_CLASSES.index("train")
-    return (cls, job.arrival_seq)
+    return (0 if job.slo_alert else 1, cls, job.arrival_seq)
 
 
 def select_preemptions(jobs: Sequence[SchedJob],
@@ -853,6 +860,8 @@ class ClusterScheduler:
         throughput where the gang emits KFTPU-METRIC lines."""
         from kubeflow_tpu.api.types import ReplicaType
 
+        telemetry = getattr(self.controller, "telemetry", None)
+        alerting = telemetry.alerting() if telemetry is not None else {}
         jobs: List[SchedJob] = []
         for kind, job in self._jobs():
             seq = self._arrival_seq.setdefault(
@@ -871,6 +880,7 @@ class ClusterScheduler:
                 measured = self.controller._read_worker_metric(
                     rt, self.throughput_metric)
             sj = sched_job_from_spec(job, seq, current, measured)
+            sj.slo_alert = job.key in alerting
             if measured is not None and job.key not in self._solo_baseline:
                 # First sample = the solo baseline the goodput gauge
                 # normalizes against (the job was just formed; later

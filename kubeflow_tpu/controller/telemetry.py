@@ -1,0 +1,330 @@
+"""Controller telemetry plane: scrape loop, goodput ledger, SLO burn rate.
+
+The reconciler already *reads* worker KFTPU-METRIC output point-in-time
+(reshard acks, hang detection, the metric scaler); this module keeps the
+*history*. A periodic scrape loop tails every live worker's log
+incrementally (byte offsets, so each line is ingested exactly once) and
+every serving replica's ``/metrics`` text, feeding the bounded
+time-series store (obs/timeseries.py). On top of the stored series:
+
+- the per-job **goodput aggregator** (obs/goodput.py JobGoodput)
+  stitches worker ledger samples across incarnations and publishes the
+  attribution breakdown as gauges + series;
+- the **SLO burn-rate evaluator** runs the classic fast/slow
+  multiwindow rule over each job's SLOSpec (api/types.py): an alert
+  fires only when BOTH windows burn error budget faster than the
+  threshold -- fast-only is a blip, slow-only is old news. Alerts land
+  as store events (``SLOBurnRate``/``SLOBurnRateResolved``), Prometheus
+  gauges, and registered pressure callbacks (the serving router tightens
+  its shed threshold; the cluster scheduler shields alerting jobs from
+  preemption).
+
+Chaos: every poll passes the ``telemetry.scrape`` site, so a seeded
+``drop_poll`` plan exercises the replica-died-mid-scrape path: the poll
+is dropped, the worker's series go stale after ``STALE_AFTER_MISSES``
+consecutive misses, and the next successful poll un-stales them.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kubeflow_tpu import chaos
+from kubeflow_tpu.obs import goodput as obs_goodput
+from kubeflow_tpu.obs import timeseries as obs_timeseries
+from kubeflow_tpu.obs.registry import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+# Numeric KFTPU-METRIC fields worth a ring (everything else -- events,
+# trace ids, transition names -- is not a time series).
+SCRAPE_FIELDS = ("step", "loss", "tokens_per_sec", "tokens_per_sec_per_chip",
+                 "step_time_ms", "mfu")
+
+# Consecutive failed polls of one worker before its series are marked
+# stale (one miss is a scheduling blip, not a death).
+STALE_AFTER_MISSES = 2
+
+CHAOS_SITE = "telemetry.scrape"
+
+DEFAULT_INTERVAL_SECONDS = 2.0
+
+
+class TelemetryPlane:
+    """Scrape + aggregate + evaluate. Pure host-side state machine: the
+    owner (JobController / ControlPlane / bench) drives ``scrape_*`` and
+    ``evaluate_job`` on its own cadence; nothing here spawns tasks."""
+
+    def __init__(self, series: Optional[obs_timeseries.SeriesStore] = None,
+                 interval_seconds: Optional[float] = None,
+                 now: Callable[[], float] = time.time) -> None:
+        self.series = series if series is not None else obs_timeseries.STORE
+        self.interval = float(
+            interval_seconds
+            if interval_seconds is not None
+            else os.environ.get("KFTPU_SCRAPE_SECONDS",
+                                DEFAULT_INTERVAL_SECONDS))
+        self._now = now
+        self.goodput: Dict[str, obs_goodput.JobGoodput] = {}
+        # (job, worker) -> byte offset of the next unread log byte.
+        self._offsets: Dict[Tuple[str, str], int] = {}
+        self._misses: Dict[Tuple[str, str], int] = {}
+        # job -> currently-alerting objective name (absent = healthy).
+        self.alerts: Dict[str, str] = {}
+        # Called with (job_key, active: bool) on every alert transition;
+        # the router shed hook and scheduler health hook register here.
+        self.pressure_callbacks: List[Callable[[str, bool], None]] = []
+
+    # -- scraping ---------------------------------------------------------
+
+    def scrape_worker_log(self, job_key: str, worker_id: str,
+                          log_path: str) -> int:
+        """Incremental poll of one worker log: ingest every NEW metric
+        line since the last poll. Returns lines ingested; a failed poll
+        (unreadable file, seeded drop_poll fault) counts a miss and
+        never raises -- a replica dying mid-scrape must not take the
+        telemetry loop down with it."""
+        from kubeflow_tpu.runtime.metrics import parse_metric_line
+
+        mkey = (job_key, worker_id)
+        fault = chaos.should(CHAOS_SITE, f"{job_key}/{worker_id}")
+        if fault is not None and fault.kind == "drop_poll":
+            self._miss(mkey)
+            return 0
+        try:
+            with open(log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                offset = self._offsets.get(mkey, 0)
+                if offset > size:  # fresh/rotated file: start over
+                    offset = 0
+                f.seek(offset)
+                chunk = f.read()
+                self._offsets[mkey] = offset + len(chunk)
+        except OSError:
+            self._miss(mkey)
+            return 0
+        REGISTRY.counter("kftpu_telemetry_scrapes_total").inc()
+        self._misses[mkey] = 0
+        ingested = 0
+        now = self._now()
+        labels = {"job": job_key, "worker": worker_id}
+        for line in chunk.decode("utf-8", errors="replace").splitlines():
+            kv = parse_metric_line(line)
+            if not kv:
+                continue
+            ingested += 1
+            for field in SCRAPE_FIELDS:
+                if field in kv:
+                    try:
+                        self.series.add("train." + field, labels,
+                                        float(kv[field]), ts=now)
+                    except ValueError:
+                        continue
+            sample = obs_goodput.parse_fields(kv)
+            if sample is not None:
+                self._observe_goodput(job_key, sample, ts=now)
+        if ingested == 0:
+            # A readable but silent log still proves the replica is
+            # reachable: touch its series so staleness stays accurate.
+            for s in self.series.all():
+                if s.labels.get("job") == job_key \
+                        and s.labels.get("worker") == worker_id:
+                    s.stale = False
+        return ingested
+
+    def _miss(self, mkey: Tuple[str, str]) -> None:
+        REGISTRY.counter("kftpu_telemetry_scrape_misses_total").inc()
+        self._misses[mkey] = self._misses.get(mkey, 0) + 1
+        if self._misses[mkey] >= STALE_AFTER_MISSES:
+            job_key, worker_id = mkey
+            self.series.mark_stale({"job": job_key, "worker": worker_id})
+
+    def ingest_prom_text(self, text: str, labels: Optional[dict] = None,
+                         ts: Optional[float] = None) -> int:
+        """Feed one ``/metrics`` exposition (a serving replica scrape)
+        into the store: every sample line becomes a point on the series
+        of the same name, labels merged with the caller's (replica
+        identity). Returns samples ingested."""
+        import re
+
+        n = 0
+        ts = ts if ts is not None else self._now()
+        line_re = re.compile(
+            r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+        pair_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+        for line in text.splitlines():
+            m = line_re.match(line.strip())
+            if not m:
+                continue
+            name, lab, value = m.groups()
+            try:
+                v = float(value)
+            except ValueError:
+                continue
+            merged = dict(pair_re.findall(lab or ""))
+            merged.update(labels or {})
+            self.series.add(name, merged, v, ts=ts)
+            n += 1
+        if n:
+            REGISTRY.counter("kftpu_telemetry_scrapes_total").inc()
+        return n
+
+    # -- goodput aggregation ----------------------------------------------
+
+    def _observe_goodput(self, job_key: str, sample: dict,
+                         ts: Optional[float] = None) -> None:
+        jg = self.goodput.setdefault(job_key, obs_goodput.JobGoodput())
+        jg.observe(sample)
+        frac = jg.goodput_fraction()
+        self.series.add("goodput.fraction", {"job": job_key}, frac, ts=ts)
+        REGISTRY.gauge("kftpu_goodput_fraction",
+                       {"job": job_key}).set(round(frac, 4))
+        for state, secs in jg.totals().items():
+            REGISTRY.gauge(
+                "kftpu_goodput_attributed_seconds",
+                {"job": job_key, "state": state},
+            ).set(round(secs, 3))
+        REGISTRY.gauge(
+            "kftpu_goodput_conservation_error",
+            {"job": job_key},
+        ).set(round(jg.conservation_error(), 6))
+
+    # -- SLO burn rate -----------------------------------------------------
+
+    def _burn(self, job_key: str, slo, window_seconds: float,
+              now: float) -> Optional[Tuple[str, float]]:
+        """Worst (objective, burn_rate) over one window; None = no data.
+
+        burn = bad_fraction / error_budget: 1.0 means "spending budget
+        exactly at the rate that exhausts it by the period's end"."""
+        worst: Optional[Tuple[str, float]] = None
+
+        def consider(objective: str, bad: float, budget: float) -> None:
+            nonlocal worst
+            burn = bad / max(budget, 1e-9)
+            if worst is None or burn > worst[1]:
+                worst = (objective, burn)
+
+        since = now - window_seconds
+        if slo.goodput_floor is not None:
+            s = self.series.get("goodput.fraction", {"job": job_key})
+            mean = s.mean(since=since) if s is not None else None
+            if mean is not None:
+                consider("goodput", max(1.0 - mean, 0.0),
+                         1.0 - slo.goodput_floor)
+        avail_budget = 1.0 - slo.availability
+        for objective, ceiling in (("ttft", slo.ttft_ms),
+                                   ("itl", slo.itl_ms)):
+            if ceiling is None:
+                continue
+            s = self.series.get(f"serving.{objective}_ms",
+                                {"job": job_key})
+            pts = s.query(since=since) if s is not None else []
+            if pts:
+                bad = sum(1 for _, v in pts if v > ceiling) / len(pts)
+                consider(objective, bad, avail_budget)
+        return worst
+
+    def evaluate_job(self, job_key: str, slo,
+                     event_cb: Optional[Callable[[str, str], None]] = None,
+                     ) -> Optional[dict]:
+        """One multiwindow burn-rate evaluation for one job. Returns the
+        evaluation dict, or None when the job has no SLOSpec. Alert
+        transitions are edge-triggered: one event per firing, one per
+        resolve."""
+        if slo is None:
+            return None
+        now = self._now()
+        fast = self._burn(job_key, slo, slo.fast_window_seconds, now)
+        slow = self._burn(job_key, slo, slo.slow_window_seconds, now)
+        lab = {"job": job_key}
+        if fast is not None:
+            REGISTRY.gauge("kftpu_slo_burn_rate",
+                           dict(lab, window="fast")).set(round(fast[1], 4))
+        if slow is not None:
+            REGISTRY.gauge("kftpu_slo_burn_rate",
+                           dict(lab, window="slow")).set(round(slow[1], 4))
+        firing = (fast is not None and slow is not None
+                  and fast[1] > slo.burn_threshold
+                  and slow[1] > slo.burn_threshold)
+        was = job_key in self.alerts
+        REGISTRY.gauge("kftpu_slo_alert", lab).set(1 if firing else 0)
+        if firing and not was:
+            objective = fast[0]
+            self.alerts[job_key] = objective
+            msg = (f"SLO burn-rate alert: {objective} burning "
+                   f"{fast[1]:.2f}x budget over {slo.fast_window_seconds:g}s"
+                   f" and {slow[1]:.2f}x over {slo.slow_window_seconds:g}s")
+            logger.warning("%s: %s", job_key, msg)
+            if event_cb is not None:
+                event_cb("SLOBurnRate", msg)
+            self._notify(job_key, True)
+        elif not firing and was:
+            self.alerts.pop(job_key, None)
+            if event_cb is not None:
+                event_cb("SLOBurnRateResolved",
+                         "burn rate back under threshold in both windows")
+            self._notify(job_key, False)
+        return {
+            "fast": fast, "slow": slow, "firing": firing,
+            "objective": self.alerts.get(job_key),
+        }
+
+    def _notify(self, job_key: str, active: bool) -> None:
+        for cb in list(self.pressure_callbacks):
+            try:
+                cb(job_key, active)
+            except Exception:
+                logger.exception("SLO pressure callback failed")
+
+    def alerting(self) -> Dict[str, str]:
+        """job -> objective for every currently-firing alert (the
+        scheduler's job-health input)."""
+        return dict(self.alerts)
+
+    # -- controller integration -------------------------------------------
+
+    def scrape_controller(self, ctl) -> int:
+        """One pass over a live JobController: poll every journaled
+        worker's log, then evaluate each job's SLOSpec. Returns lines
+        ingested. Never raises (the reconcile loop's health must not
+        depend on telemetry)."""
+        from kubeflow_tpu.api.types import TrainJob
+
+        ingested = 0
+        for key, rt in list(ctl._runtimes.items()):
+            for wid, ref in list(rt.workers.items()):
+                lp = getattr(ref, "log_path", None)
+                if lp:
+                    ingested += self.scrape_worker_log(key, wid, lp)
+        REGISTRY.gauge("kftpu_telemetry_series").set(
+            len(list(self.series.all())))
+        for key in list(ctl._runtimes):
+            ns, name = key.split("/", 1)
+            try:
+                _kind, obj = ctl._find_job(ns, name)
+            except Exception as e:
+                logger.debug("job lookup failed for %s: %s", key, e)
+                continue
+            if obj is None:
+                continue
+            try:
+                job = TrainJob.from_dict(obj)
+            except Exception as e:
+                logger.debug("stored spec for %s does not parse: %s",
+                             key, e)
+                continue
+            slo = getattr(job.spec, "slo", None)
+            if slo is None:
+                continue
+            def _record(reason: str, message: str, _job=job) -> None:
+                ctl._record_event(_job, reason, message)
+            try:
+                self.evaluate_job(key, slo, event_cb=_record)
+            except Exception:
+                logger.exception("SLO evaluation failed for %s", key)
+        return ingested

@@ -1,13 +1,15 @@
-"""Unified observability plane (ISSUE 5).
+"""Unified observability plane (ISSUE 5, ISSUE 20).
 
 ``obs.trace`` is the span recorder shared by all three planes
 (controller reconcile loop, training runtime, serving engine): bounded
 ring buffer, context-manager spans, Chrome trace-event JSON export that
 loads in Perfetto. ``obs.registry`` is the one Counter/Gauge/Histogram
 substrate behind every Prometheus exposition the repo emits -- label
-escaping lives in exactly one place.
+escaping lives in exactly one place. ``obs.timeseries`` keeps the short
+scraped history behind ``/debug/series`` and the SLO burn-rate windows;
+``obs.goodput`` is the goodput/badput attribution ledger.
 """
 
-from kubeflow_tpu.obs import registry, trace
+from kubeflow_tpu.obs import goodput, registry, timeseries, trace
 
-__all__ = ["registry", "trace"]
+__all__ = ["goodput", "registry", "timeseries", "trace"]

@@ -1,0 +1,182 @@
+"""Goodput/badput ledger: every second of gang-hold time attributed.
+
+The production question behind the north star: of every wall-clock
+second a job owned chips, how many produced tokens? The worker-side
+``GoodputLedger`` answers it by construction -- a single monotonic
+cursor walks forward through the step loop, and every ``settle(state)``
+charges the time since the last settle to exactly one attribution
+state. Nothing is ever double-charged or dropped, so
+
+    sum(seconds.values()) == cursor - start        (exactly)
+
+is an arithmetic identity, and conservation against wall-clock reduces
+to "the loop settles often enough" (the analysis family's KT-OBS-
+CONSERVE check plants a dropped charge to prove the gate trips).
+
+The worker emits cumulative per-state seconds over KFTPU-METRIC
+(``gp_compute=... gp_epoch=... gp_wall=...``); the controller-side
+``JobGoodput`` aggregator stitches incarnations together across
+restarts: an epoch change banks the dead incarnation's final counters
+and charges the gap between incarnations -- the time the gang held
+chips while nothing ran -- to ``restart_recovery``. Job-level
+conservation is then also structural:
+
+    attributed == (last_epoch + last_wall) - first_epoch
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+# The attribution states. Every second of a held gang lands in exactly
+# one. "compute" is the only goodput; the rest are priced badput.
+STATES = ("compute", "checkpoint", "reshard", "restart_recovery",
+          "input_wait", "idle")
+
+# KFTPU-METRIC field prefix for the cumulative per-state counters.
+FIELD_PREFIX = "gp_"
+
+
+class GoodputLedger:
+    """Worker-side single-cursor attribution ledger.
+
+    ``settle(state)`` charges now - cursor to ``state`` and advances the
+    cursor; ``charge(state, dt)`` books an externally measured duration
+    (also advancing the cursor, same conservation discipline).
+    """
+
+    def __init__(self, clock=time.perf_counter,
+                 epoch: Optional[float] = None) -> None:
+        self._clock = clock
+        self.epoch = float(epoch if epoch is not None else time.time())
+        self._start = clock()
+        self._cursor = self._start
+        self.seconds: Dict[str, float] = {s: 0.0 for s in STATES}
+
+    def settle(self, state: str) -> float:
+        """Attribute everything since the last settle to ``state``."""
+        if state not in self.seconds:
+            raise ValueError(f"unknown goodput state {state!r}")
+        now = self._clock()
+        dt = max(now - self._cursor, 0.0)
+        self.seconds[state] += dt
+        self._cursor = now
+        return dt
+
+    def charge(self, state: str, dt: float) -> None:
+        """Book an externally timed duration (cursor advances with it,
+        so the measured span is not re-attributed by the next settle)."""
+        if state not in self.seconds:
+            raise ValueError(f"unknown goodput state {state!r}")
+        dt = max(float(dt), 0.0)
+        self.seconds[state] += dt
+        self._cursor += dt
+
+    def wall(self) -> float:
+        """Attributed wall time: cursor - start. After a settle this is
+        also clock-now - start; between settles the unattributed tail is
+        deliberately excluded so the identity below never lies."""
+        return self._cursor - self._start
+
+    def attributed(self) -> float:
+        return sum(self.seconds.values())
+
+    def conservation_error(self) -> float:
+        """|attributed - wall| -- zero up to float rounding, by
+        construction. The analysis gate asserts this stays ~0 and that
+        a planted dropped charge breaks it."""
+        return abs(self.attributed() - self.wall())
+
+    def goodput_fraction(self) -> float:
+        att = self.attributed()
+        return self.seconds["compute"] / att if att > 0 else 0.0
+
+    def fields(self) -> Dict[str, str]:
+        """Cumulative KFTPU-METRIC fields (settle('idle') first so the
+        emitted wall equals the attributed sum at emit time)."""
+        out = {FIELD_PREFIX + s: f"{self.seconds[s]:.3f}" for s in STATES}
+        out[FIELD_PREFIX + "epoch"] = f"{self.epoch:.3f}"
+        out[FIELD_PREFIX + "wall"] = f"{self.wall():.3f}"
+        return out
+
+
+def parse_fields(sample: Dict[str, str]) -> Optional[dict]:
+    """Extract ``{state: seconds}``, epoch and wall from one parsed
+    KFTPU-METRIC line; None when the line carries no ledger fields."""
+    if FIELD_PREFIX + "epoch" not in sample:
+        return None
+    try:
+        return {
+            "epoch": float(sample[FIELD_PREFIX + "epoch"]),
+            "wall": float(sample.get(FIELD_PREFIX + "wall", 0.0)),
+            "seconds": {
+                s: float(sample.get(FIELD_PREFIX + s, 0.0)) for s in STATES
+            },
+        }
+    except (TypeError, ValueError):
+        return None
+
+
+class JobGoodput:
+    """Controller-side aggregator over one job's worker incarnations.
+
+    Feed it every scraped ledger sample (cumulative counters). The
+    current incarnation is identified by ``gp_epoch``; when the epoch
+    moves, the previous incarnation's final counters are banked and the
+    wall gap between incarnations is charged to ``restart_recovery`` --
+    the crash-to-resume window during which the gang held chips but no
+    ledger was running.
+    """
+
+    def __init__(self) -> None:
+        self.banked: Dict[str, float] = {s: 0.0 for s in STATES}
+        self.first_epoch: Optional[float] = None
+        self._cur: Optional[dict] = None  # last sample of live incarnation
+        self.incarnations = 0
+
+    def observe(self, sample: dict) -> None:
+        epoch = sample["epoch"]
+        if self.first_epoch is None:
+            self.first_epoch = epoch
+        cur = self._cur
+        if cur is not None and epoch != cur["epoch"]:
+            # Bank the dead incarnation at its last observed counters.
+            for s in STATES:
+                self.banked[s] += cur["seconds"][s]
+            gap = epoch - (cur["epoch"] + cur["wall"])
+            self.banked["restart_recovery"] += max(gap, 0.0)
+            self._cur = None
+        if self._cur is None:
+            self.incarnations += 1
+        # Cumulative counters: keep the newest sample only (monotone
+        # within an incarnation; a stale out-of-order line loses).
+        if self._cur is None or sample["wall"] >= self._cur["wall"]:
+            self._cur = dict(sample)
+
+    def totals(self) -> Dict[str, float]:
+        out = dict(self.banked)
+        if self._cur is not None:
+            for s in STATES:
+                out[s] += self._cur["seconds"][s]
+        return out
+
+    def attributed(self) -> float:
+        return sum(self.totals().values())
+
+    def wall(self) -> float:
+        """(last_epoch + last_wall) - first_epoch: the job's ledger-
+        covered wall span across every incarnation and every gap."""
+        if self._cur is None or self.first_epoch is None:
+            return 0.0
+        return (self._cur["epoch"] + self._cur["wall"]) - self.first_epoch
+
+    def conservation_error(self) -> float:
+        wall = self.wall()
+        if wall <= 0:
+            return 0.0
+        return abs(self.attributed() - wall) / wall
+
+    def goodput_fraction(self) -> float:
+        att = self.attributed()
+        return self.totals()["compute"] / att if att > 0 else 0.0

@@ -27,14 +27,16 @@ def escape_label_value(v: Any) -> str:
 
 
 def render_labels(labels: LabelArg) -> str:
-    """``k="v",k2="v2"`` (no braces).  Accepts a mapping, an already-
-    rendered string (legacy call sites), or None."""
+    """``k="v",k2="v2"`` (no braces), keys sorted so one (name, labels)
+    pair always renders -- and therefore KEYS -- identically regardless
+    of dict insertion order.  Accepts a mapping, an already-rendered
+    string (legacy call sites), or None."""
     if labels is None:
         return ""
     if isinstance(labels, str):
         return labels
     return ",".join(
-        f'{k}="{escape_label_value(v)}"' for k, v in labels.items()
+        f'{k}="{escape_label_value(labels[k])}"' for k in sorted(labels)
     )
 
 

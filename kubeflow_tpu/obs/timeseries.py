@@ -1,0 +1,165 @@
+"""Bounded in-process time-series store (the fleet telemetry plane).
+
+Per-replica ``/metrics`` and KFTPU-METRIC lines are instantaneous: they
+vanish on scrape, so nobody can ask "what was this job's goodput over
+the last ten minutes" or run a burn-rate window over them. This module
+keeps a short history: one bounded ring per (name, labels) series, fed
+by the controller's scrape loop (controller/telemetry.py), queryable
+in-process (the SLO burn-rate evaluator), over ``GET /debug/series``,
+and from ``kftpu top``.
+
+Deliberately small: append-mostly rings, O(capacity) memory per series,
+no persistence -- history dies with the controller, exactly like the
+trace recorder. Downsampling happens at query time (bucketed mean +
+last), not at ingest, so the raw short-horizon data stays exact.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from kubeflow_tpu.obs.registry import render_labels
+
+DEFAULT_CAPACITY = 512
+
+
+class Series:
+    """One bounded ring of ``(unix_ts, value)`` points.
+
+    ``stale`` marks a series whose source stopped answering (replica
+    died mid-scrape); the points stay queryable but consumers must not
+    treat the last value as current. Any successful ``add`` clears it.
+    """
+
+    __slots__ = ("name", "labels", "points", "stale", "_lock")
+
+    def __init__(self, name: str, labels: Optional[dict] = None,
+                 capacity: int = DEFAULT_CAPACITY) -> None:
+        self.name = name
+        self.labels = dict(labels or {})
+        self.points: deque = deque(maxlen=max(int(capacity), 1))
+        self.stale = False
+        self._lock = threading.Lock()
+
+    def add(self, value: float, ts: Optional[float] = None) -> None:
+        with self._lock:
+            self.points.append(
+                (float(ts if ts is not None else time.time()), float(value)))
+            self.stale = False
+
+    def mark_stale(self) -> None:
+        self.stale = True
+
+    @property
+    def last(self) -> Optional[Tuple[float, float]]:
+        with self._lock:
+            return self.points[-1] if self.points else None
+
+    def query(self, since: Optional[float] = None,
+              until: Optional[float] = None,
+              step: Optional[float] = None) -> List[Tuple[float, float]]:
+        """Points in ``[since, until]``; ``step`` buckets them (one
+        point per bucket at the bucket's last timestamp, value = mean
+        over the bucket) -- the downsampled view long windows read."""
+        with self._lock:
+            pts = [p for p in self.points
+                   if (since is None or p[0] >= since)
+                   and (until is None or p[0] <= until)]
+        if not step or step <= 0 or not pts:
+            return pts
+        out: List[Tuple[float, float]] = []
+        bucket = None
+        acc: List[Tuple[float, float]] = []
+        for ts, v in pts:
+            b = int(ts // step)
+            if bucket is None:
+                bucket = b
+            if b != bucket:
+                out.append((acc[-1][0], sum(x[1] for x in acc) / len(acc)))
+                acc = []
+                bucket = b
+            acc.append((ts, v))
+        if acc:
+            out.append((acc[-1][0], sum(x[1] for x in acc) / len(acc)))
+        return out
+
+    def mean(self, since: Optional[float] = None) -> Optional[float]:
+        pts = self.query(since=since)
+        if not pts:
+            return None
+        return sum(v for _, v in pts) / len(pts)
+
+
+class SeriesStore:
+    """Get-or-create registry of Series keyed ``(name, rendered labels)``
+    -- the same keying discipline as obs.registry so one (name, labels)
+    pair can never split into two rings."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        self.capacity = capacity
+        self._series: Dict[Tuple[str, str], Series] = {}
+        self._lock = threading.Lock()
+
+    def series(self, name: str, labels: Optional[dict] = None) -> Series:
+        key = (name, render_labels(labels))
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = Series(name, labels, capacity=self.capacity)
+                self._series[key] = s
+            return s
+
+    def add(self, name: str, labels: Optional[dict], value: float,
+            ts: Optional[float] = None) -> None:
+        self.series(name, labels).add(value, ts)
+
+    def get(self, name: str, labels: Optional[dict] = None
+            ) -> Optional[Series]:
+        return self._series.get((name, render_labels(labels)))
+
+    def all(self) -> Iterable[Series]:
+        with self._lock:
+            return list(self._series.values())
+
+    def mark_stale(self, labels_subset: Optional[dict] = None) -> int:
+        """Mark every series whose labels contain ``labels_subset`` as
+        stale (replica death: all its series at once). Returns count."""
+        n = 0
+        for s in self.all():
+            if labels_subset and not all(
+                    s.labels.get(k) == v for k, v in labels_subset.items()):
+                continue
+            s.mark_stale()
+            n += 1
+        return n
+
+    def snapshot(self, name: Optional[str] = None,
+                 since: Optional[float] = None,
+                 step: Optional[float] = None) -> dict:
+        """JSON-safe dump for ``GET /debug/series`` / ``kftpu top``."""
+        out = []
+        for s in self.all():
+            if name and s.name != name:
+                continue
+            pts = s.query(since=since, step=step)
+            out.append({
+                "name": s.name,
+                "labels": dict(s.labels),
+                "stale": bool(s.stale),
+                "points": [[round(ts, 3), v] for ts, v in pts],
+            })
+        out.sort(key=lambda d: (d["name"], render_labels(d["labels"])))
+        return {"series": out, "now": time.time()}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+
+# Process-wide store, mirroring obs.registry.REGISTRY: the controller
+# scrape loop writes it, /debug/series and the burn-rate evaluator read
+# it. Tests construct private SeriesStores instead of resetting this.
+STORE = SeriesStore()

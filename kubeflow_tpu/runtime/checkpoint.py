@@ -105,6 +105,9 @@ class Checkpointer:
 
         # Async save: this span covers the dispatch, not the background
         # write -- the visible cost the step loop actually pays.
+        import time as _time
+
+        t0 = _time.perf_counter()
         with trace.span("ckpt.save", plane="runtime", step=step,
                         force=force) as sp:
             saved = self._mgr.save(
@@ -112,6 +115,12 @@ class Checkpointer:
             )
             sp.annotate(saved=bool(saved))
         if saved:
+            # Goodput-ledger companion metrics: the scrape loop and the
+            # badput breakdown both read the save cadence from here.
+            obs_registry.REGISTRY.counter("kftpu_ckpt_saves_total").inc()
+            obs_registry.REGISTRY.gauge(
+                "kftpu_ckpt_last_save_seconds"
+            ).set(round(_time.perf_counter() - t0, 6))
             # The manager admits one outstanding async save: dispatching
             # THIS one means every earlier step is durable -- checksum
             # them now so a crash never leaves an unmanifested step.
@@ -294,6 +303,8 @@ class Checkpointer:
                         s, self.directory)
             with trace.span("ckpt.restore", plane="runtime", step=s,
                             verified=bool(ok), fallback=bool(corrupt)):
+                obs_registry.REGISTRY.counter(
+                    "kftpu_ckpt_restores_total").inc()
                 return self._mgr.restore(
                     s, args=ocp.args.StandardRestore(target)
                 )

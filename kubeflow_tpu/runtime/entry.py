@@ -20,6 +20,7 @@ import sys
 import time
 
 from kubeflow_tpu.obs import trace
+from kubeflow_tpu.obs.goodput import GoodputLedger
 
 # The command-file reader lives in the shared protocol module (one
 # implementation for the worker poller, the controller writer, and the
@@ -84,6 +85,12 @@ def _cast(v: str):
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
     args = parse_args(argv)
+    # Goodput ledger opens at process birth: bootstrap, mesh build and
+    # the checkpoint restore are all restart-recovery badput. gp_epoch
+    # (unix time) identifies this incarnation to the controller-side
+    # aggregator, which charges the gap between incarnations -- the
+    # crash-to-respawn window -- to restart_recovery as well.
+    ledger = GoodputLedger()
 
     from kubeflow_tpu.runtime import bootstrap
 
@@ -167,6 +174,7 @@ def main(argv=None) -> int:
             flops_per_token=task.flops_per_token,
             n_chips=jax.device_count(),  # global chips across the world
         )
+        ledger.settle("restart_recovery")
         mlog.emit(event="train_start", model=task.name, start_step=start_step,
                   steps=args.steps, world=ctx.num_processes)
 
@@ -244,6 +252,8 @@ def main(argv=None) -> int:
                         reshard_host_staged_bytes=plan.host_staged_bytes,
                         step=step,
                     )
+                # Ack or nack, the time went to the resize attempt.
+                ledger.settle("reshard")
             with trace.span("step", plane="runtime", step=step):
                 # >= not ==: a checkpoint resume landing inside (or past the
                 # start of) the window still traces the remaining steps.
@@ -258,6 +268,7 @@ def main(argv=None) -> int:
                 with trace.span("data-wait"):
                     batch = next(data)
                     batches_seen += 1
+                ledger.settle("input_wait")
                 # Transient-fault semantics: the injected death fires only
                 # in a fresh (non-resumed) incarnation, so restart+resume
                 # recovers -- the scenario SURVEY.md 5.3 tests. A permanent
@@ -271,6 +282,7 @@ def main(argv=None) -> int:
                     os._exit(137)
                 with trace.span("dispatch"):
                     state, metrics = step_fn(state, *batch)
+                ledger.settle("compute")
                 if (prof_active
                         and step >= ctx.profile_start + ctx.profile_steps - 1):
                     # Sync so the trace includes real device work, not just
@@ -282,6 +294,7 @@ def main(argv=None) -> int:
                     mlog.emit(event="profile_end", step=step,
                               dir=profile_dir)
                 ckpt.maybe_save(step, state)
+                ledger.settle("checkpoint")
                 if step % args.log_every == 0 or step == args.steps - 1:
                     # The float() is where the host blocks on the device
                     # step -- the device-sync share of the breakdown.
@@ -289,6 +302,11 @@ def main(argv=None) -> int:
                         loss = float(metrics["loss"])
                         extra = {k: f"{float(v):.4f}"
                                  for k, v in metrics.items() if k != "loss"}
+                    # The sync blocked on the device step: compute, not
+                    # overhead. The cumulative gp_* ledger fields ride
+                    # the same metric line the controller already tails.
+                    ledger.settle("compute")
+                    extra.update(ledger.fields())
                     mlog.log_step(step, loss, tokens=task.tokens_per_step,
                                   **extra)
         resize_cm.close()
@@ -297,10 +315,12 @@ def main(argv=None) -> int:
             mlog.emit(event="profile_end", step=args.steps - 1, dir=profile_dir)
         if ckpt.enabled:
             ckpt.maybe_save(args.steps - 1, state, force=True)
-            ckpt.close()
+            ckpt.close()  # waits for the async save to land
+            ledger.settle("checkpoint")
         final_loss = float(metrics["loss"]) if metrics else float("nan")
+        ledger.settle("idle")  # teardown tail: attributed, not dropped
         mlog.emit(event="train_end", final_step=args.steps - 1,
-                  final_loss=f"{final_loss:.6f}")
+                  final_loss=f"{final_loss:.6f}", **ledger.fields())
     # Per-process trace dump (KFTPU_TRACE_DIR): merged by `kftpu trace
     # dump` into the controller's timeline.
     trace.write_process_trace()

@@ -36,6 +36,7 @@ from kubeflow_tpu.controller import (
     JobController,
     ProcessLauncher,
     RuntimeJournal,
+    TelemetryPlane,
 )
 from kubeflow_tpu.hpo import HPOController
 from kubeflow_tpu.hpo.obsdb import ObservationDB
@@ -112,9 +113,14 @@ class ControlPlane:
                 os.environ.get("KFTPU_LEASE_SECONDS", "15")
             ),
         )
+        # Fleet telemetry plane: the controller's scrape loop feeds the
+        # bounded series store; burn-rate alerts push shed pressure onto
+        # the matching serving router (registered below, after isvc).
+        self.telemetry = TelemetryPlane()
         self.controller = JobController(
             self.store, self.launcher, self.gang, log_dir=self.log_dir,
             journal=self.journal, lease=self.lease,
+            telemetry=self.telemetry,
         )
         self.obs_db = ObservationDB(os.path.join(state_dir, "observations.db"))
         self.hpo = HPOController(
@@ -155,6 +161,16 @@ class ControlPlane:
             await self.controller._on_worker_exit(ref, code)
 
         self.launcher.set_exit_callback(dispatch_exit)
+
+        # Burn-rate alert -> router shed pressure: when the alerting job
+        # key names an InferenceService, tighten its router's effective
+        # TTFT shed threshold for the duration of the alert.
+        def slo_pressure(job_key: str, active: bool) -> None:
+            router = self.isvc._routers.get(job_key)
+            if router is not None:
+                router.set_slo_pressure(active)
+
+        self.telemetry.pressure_callbacks.append(slo_pressure)
         self.extra_controllers: list = [
             self.hpo, self.isvc, self.platform, self.pipelines,
             self.workbench,
@@ -204,6 +220,7 @@ class ControlPlane:
                 web.get("/healthz", self.h_healthz),
                 web.get("/metrics", self.h_metrics),
                 web.get("/debug/trace", self.h_debug_trace),
+                web.get("/debug/series", self.h_debug_series),
                 # Central-dashboard equivalent (P5): one page over /apis/.
                 web.get("/dashboard", self.h_dashboard),
                 web.get("/", self.h_dashboard),
@@ -934,6 +951,37 @@ class ControlPlane:
         from kubeflow_tpu.obs import trace as obs_trace
 
         return web.json_response(obs_trace.recorder().export())
+
+    async def h_debug_series(self, req: web.Request) -> web.Response:
+        """Time-series store snapshot + goodput/SLO summary (the data
+        behind ``kftpu top``). Query params: ``name`` filters series by
+        exact name, ``since`` is a lookback in seconds, ``step`` a
+        downsampling bucket in seconds."""
+        q = req.rel_url.query
+        try:
+            lookback = float(q["since"]) if "since" in q else None
+            step = float(q["step"]) if "step" in q else None
+        except ValueError:
+            return web.json_response(
+                {"error": "since/step must be numbers"}, status=400)
+        since = time.time() - lookback if lookback else None
+        tele = self.telemetry
+        snap = tele.series.snapshot(
+            name=q.get("name") or None, since=since, step=step)
+        snap["goodput"] = {
+            key: {
+                "fraction": round(jg.goodput_fraction(), 4),
+                "attributed_seconds": {
+                    st: round(s, 3) for st, s in jg.totals().items()
+                },
+                "wall_seconds": round(jg.wall(), 3),
+                "conservation_error": round(jg.conservation_error(), 6),
+                "incarnations": jg.incarnations,
+            }
+            for key, jg in sorted(tele.goodput.items())
+        }
+        snap["alerts"] = tele.alerting()
+        return web.json_response(snap)
 
     async def h_metrics(self, req: web.Request) -> web.Response:
         sample = obs_registry.sample_line

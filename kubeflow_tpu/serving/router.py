@@ -55,6 +55,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from kubeflow_tpu.obs import registry as obs_registry
+from kubeflow_tpu.obs import timeseries as obs_timeseries
 from kubeflow_tpu.obs import trace
 
 # ---------------------------------------------------------------------------
@@ -347,6 +348,12 @@ class RouterConfig:
     # estimate exceeds it (a loaded primary with a healthy second choice
     # spills instead of shedding).
     slo_ttft_ms: Optional[float] = None
+    # Under an active SLO burn-rate alert (``set_slo_pressure(True)``
+    # from the telemetry plane) the shed threshold tightens to
+    # ``slo_ttft_ms * slo_pressure_factor``: once the error budget is
+    # burning at alert rate, shedding earlier protects the budget of
+    # the requests that ARE admitted.
+    slo_pressure_factor: float = 0.5
     default_ttft_ms: float = 50.0
     # Long-prompt steering: prompts at/over this many tokens (or chars
     # for byte keys) bypass affinity -- to the prefill pool when one
@@ -399,6 +406,7 @@ class Router:
         self.ring = ConsistentHashRing(self.cfg.vnodes)
         self.replicas: Dict[str, ReplicaLoad] = {}
         self._shed_seq = 0  # jitter sequence for empty-ring sheds
+        self._slo_pressure = False
         reg = obs_registry.REGISTRY
         lab = {"router": name}
         self.c_requests = reg.counter("kftpu_router_requests_total", lab)
@@ -409,6 +417,23 @@ class Router:
         self.c_ejected = reg.counter("kftpu_router_ejected_total", lab)
         self.c_readmit = reg.counter("kftpu_router_readmitted_total", lab)
         self.c_probes = reg.counter("kftpu_router_probes_total", lab)
+        self.g_pressure = reg.gauge("kftpu_router_slo_pressure", lab)
+
+    # -- SLO pressure ----------------------------------------------------
+
+    def set_slo_pressure(self, active: bool) -> None:
+        """Telemetry-plane hook: an active burn-rate alert tightens the
+        shed threshold; resolution restores it."""
+        self._slo_pressure = bool(active)
+        self.g_pressure.set(1 if self._slo_pressure else 0)
+
+    def effective_slo_ttft_ms(self) -> Optional[float]:
+        """The shed threshold route() actually applies right now."""
+        if self.cfg.slo_ttft_ms is None:
+            return None
+        if self._slo_pressure:
+            return self.cfg.slo_ttft_ms * self.cfg.slo_pressure_factor
+        return self.cfg.slo_ttft_ms
 
     # -- membership ------------------------------------------------------
 
@@ -480,6 +505,11 @@ class Router:
             ttft_ms if rep.ttft_ema_ms is None
             else alpha * ttft_ms + (1 - alpha) * rep.ttft_ema_ms
         )
+        # Feed the telemetry plane: the burn-rate evaluator windows
+        # raw per-request TTFTs (router name == job key) against the
+        # job's SLOSpec ceiling.
+        obs_timeseries.STORE.add(
+            "serving.ttft_ms", {"job": self.name}, float(ttft_ms))
 
     def start_request(self, rid: str) -> None:
         rep = self.replicas.get(str(rid))
@@ -681,14 +711,15 @@ class Router:
             )
             if spilled:
                 self.c_spilled.inc()
-        if cfg.slo_ttft_ms is not None:
+        slo_ms = self.effective_slo_ttft_ms()
+        if slo_ms is not None:
             ests = [r.est_ttft_ms(cfg.default_ttft_ms) for r in cands]
-            if min(ests) > cfg.slo_ttft_ms:
+            if min(ests) > slo_ms:
                 # Overload everywhere the key may go: shed with a
                 # Retry-After sized to the estimated excess (how long
                 # the backlog needs to drain back under the SLO).
                 retry = min(
-                    max((min(ests) - cfg.slo_ttft_ms) / 1000.0,
+                    max((min(ests) - slo_ms) / 1000.0,
                         cfg.retry_after_min_s),
                     cfg.retry_after_max_s,
                 )

@@ -861,6 +861,7 @@ def test_perf_shipped_baseline_passes_shipped_artifacts():
     assert any(k.startswith("sched.") for k in measured)
     assert any(k.startswith("kv_reshard.") for k in measured)
     assert any(k.startswith("ctrlha.") for k in measured)
+    assert any(k.startswith("goodput.") for k in measured)
 
 
 def test_perf_planted_mfu_regression_exits_one(monkeypatch, capsys, tmp_path):
@@ -1159,6 +1160,67 @@ def test_perf_ctrlha_bounds_required_flags_and_shrunk_curve(tmp_path):
     assert any("worker_deaths = 1 exceeds" in m for m in msgs)
     assert any("adoption_seconds: missing" in m for m in msgs)
     assert any("adopted" in m and "expected true" in m for m in msgs)
+
+
+@pytest.mark.parametrize("bound,planted", [
+    ("goodput_fraction_floor", 0.999),
+    ("conservation_error_max", 1e-9),
+    ("burn_detect_seconds_ceiling", 0.001),
+])
+def test_perf_planted_goodput_regression_exits_one(monkeypatch, capsys,
+                                                   tmp_path, bound,
+                                                   planted):
+    bad = analysis.load_perf_baseline()
+    bad["goodput"][bound] = planted
+    p = tmp_path / "perf.json"
+    p.write_text(json.dumps(bad))
+    rc, out = _run_cli(monkeypatch, capsys, [], {},
+                       ["--strict", "--json", "--perf-baseline", str(p)])
+    assert rc == 1
+    assert any(f["rule"] == "KT-PERF-GOODPUT" and f["hard"]
+               for f in json.loads(out)["new"]), (bound, out)
+
+
+def test_perf_goodput_round_vanishing_is_a_finding(tmp_path):
+    # Bounds set, OTHER bench rounds committed, but none carries
+    # extra.goodput: hard finding, not a silent pass -- deleting
+    # BENCH_r10 from a checkout must not un-ratchet the telemetry
+    # conservation contract.
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"parsed": {"extra": {"ctrlha": {}}}}))
+    baseline = {"goodput": {"conservation_error_max": 0.02}}
+    findings, _ = analysis.check_perf(baseline, root=str(tmp_path))
+    assert [f.rule for f in findings] == ["KT-PERF-GOODPUT"]
+    assert "vanished" in findings[0].message
+
+
+def test_perf_goodput_bounds_required_flags_and_shrunk_curve(tmp_path):
+    doc = {"parsed": {"extra": {"goodput": {
+        "goodput_fraction": 0.3,     # below the floor
+        "conservation_error": 0.001,
+        # burn_detect_seconds missing entirely: the curve shrank
+        "kill_exercised": True,
+        "reshard_exercised": False,  # required flag not true
+        "alert_fired": True,
+        "alert_resolved": True,
+    }}}}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(doc))
+    baseline = {"goodput": {
+        "goodput_fraction_floor": 0.5,
+        "conservation_error_max": 0.02,
+        "burn_detect_seconds_ceiling": 30.0,
+        "required": ["kill_exercised", "reshard_exercised",
+                     "alert_fired", "alert_resolved"],
+    }}
+    findings, measured = analysis.check_perf(baseline, root=str(tmp_path))
+    assert measured["goodput.conservation_error"] == 0.001
+    assert len(findings) == 3 and all(
+        f.rule == "KT-PERF-GOODPUT" and f.hard for f in findings)
+    msgs = [f.message for f in findings]
+    assert any("goodput_fraction = 0.3 below floor" in m for m in msgs)
+    assert any("burn_detect_seconds: missing" in m for m in msgs)
+    assert any("reshard_exercised" in m and "expected true" in m
+               for m in msgs)
 
 
 def test_perf_planted_kv_reshard_regression_exits_one(monkeypatch, capsys,

@@ -508,11 +508,496 @@ def test_cli_trace_dump_merges_process_files(tmp_path, capsys):
     assert "2 document(s)" in printed and "perfetto" in printed.lower()
 
 
-def test_cli_trace_dump_errors_with_no_sources(tmp_path):
+def test_cli_trace_dump_exits_cleanly_with_no_sources(tmp_path, capsys):
+    """No trace sources is a normal state (tracing off), not an error:
+    exit 0 with guidance, write nothing."""
     from kubeflow_tpu.cli import main as cli_main
 
-    with pytest.raises(SystemExit):
-        cli_main.main([
-            "trace", "dump", "--dir", str(tmp_path / "empty"),
-            "--out", str(tmp_path / "never.json"),
-        ])
+    out = tmp_path / "never.json"
+    rc = cli_main.main([
+        "trace", "dump", "--dir", str(tmp_path / "empty"),
+        "--out", str(out),
+    ])
+    assert rc == 0
+    assert not out.exists()
+    printed = capsys.readouterr().out
+    assert "no trace documents found" in printed
+    assert "KFTPU_TRACE_DIR" in printed
+
+
+# ---------------------------------------------------------------------------
+# Time-series store (obs/timeseries.py): ring bound, query-time
+# downsampling, staleness, canonical (name, labels) keying.
+# ---------------------------------------------------------------------------
+
+def test_series_ring_bound_and_window_query():
+    from kubeflow_tpu.obs.timeseries import SeriesStore
+
+    store = SeriesStore(capacity=16)
+    for i in range(100):
+        store.add("m", {"job": "j"}, float(i), ts=1000.0 + i)
+    s = store.get("m", {"job": "j"})
+    assert len(s.points) == 16  # ring bound, oldest evicted
+    assert s.last == (1099.0, 99.0)
+    # Window clips to [since, until].
+    pts = s.query(since=1090.0, until=1094.0)
+    assert [v for _, v in pts] == [90.0, 91.0, 92.0, 93.0, 94.0]
+
+
+def test_series_downsample_bucket_mean_at_last_ts():
+    from kubeflow_tpu.obs.timeseries import Series
+
+    s = Series("m", capacity=64)
+    for i in range(10):
+        s.add(float(i), ts=1000.0 + i)
+    pts = s.query(step=5.0)
+    # Buckets [1000,1005) and [1005,1010): mean value, last timestamp.
+    assert pts == [(1004.0, 2.0), (1009.0, 7.0)]
+    assert s.mean(since=1005.0) == 7.0
+
+
+def test_series_staleness_cycle_and_label_canonicalization():
+    from kubeflow_tpu.obs.timeseries import SeriesStore
+
+    store = SeriesStore()
+    store.add("m", {"job": "j", "worker": "w0"}, 1.0, ts=1.0)
+    store.add("m", {"job": "j", "worker": "w1"}, 1.0, ts=1.0)
+    store.add("other", {"job": "k"}, 1.0, ts=1.0)
+    # Subset staleness: one replica's death marks only its series.
+    assert store.mark_stale({"job": "j", "worker": "w0"}) == 1
+    assert store.get("m", {"job": "j", "worker": "w0"}).stale
+    assert not store.get("m", {"job": "j", "worker": "w1"}).stale
+    # Any successful add un-stales.
+    store.add("m", {"job": "j", "worker": "w0"}, 2.0, ts=2.0)
+    assert not store.get("m", {"job": "j", "worker": "w0"}).stale
+    # Label insertion order must not split a series into two rings.
+    a = store.series("m", {"a": "1", "b": "2"})
+    b = store.series("m", {"b": "2", "a": "1"})
+    assert a is b
+
+
+def test_snapshot_is_json_safe_and_filtered():
+    from kubeflow_tpu.obs.timeseries import SeriesStore
+
+    store = SeriesStore()
+    store.add("x", {"job": "j"}, 1.5, ts=10.0)
+    store.add("y", None, 2.0, ts=11.0)
+    snap = store.snapshot(name="x")
+    json.dumps(snap)  # JSON-safe by contract
+    assert [s["name"] for s in snap["series"]] == ["x"]
+    assert snap["series"][0]["points"] == [[10.0, 1.5]]
+
+
+# ---------------------------------------------------------------------------
+# Goodput ledger (obs/goodput.py): conservation by construction, the
+# KFTPU-METRIC field round trip, incarnation stitching.
+# ---------------------------------------------------------------------------
+
+def test_ledger_conservation_is_structural():
+    from kubeflow_tpu.obs.goodput import GoodputLedger
+
+    t = [100.0]
+    led = GoodputLedger(clock=lambda: t[0], epoch=1000.0)
+    for state, dt in (("restart_recovery", 3.0), ("compute", 10.0),
+                      ("checkpoint", 0.5), ("input_wait", 0.25),
+                      ("compute", 5.0)):
+        t[0] += dt
+        led.settle(state)
+    led.charge("reshard", 2.0)
+    assert led.attributed() == pytest.approx(led.wall())
+    assert led.conservation_error() == pytest.approx(0.0, abs=1e-9)
+    assert led.seconds["compute"] == pytest.approx(15.0)
+    assert led.goodput_fraction() == pytest.approx(15.0 / 20.75)
+    with pytest.raises(ValueError):
+        led.settle("not-a-state")
+
+
+def test_ledger_fields_roundtrip_metric_line():
+    from kubeflow_tpu.obs.goodput import GoodputLedger, parse_fields
+    from kubeflow_tpu.runtime.metrics import parse_metric_line
+
+    t = [0.0]
+    led = GoodputLedger(clock=lambda: t[0], epoch=500.0)
+    t[0] += 4.0
+    led.settle("compute")
+    line = "KFTPU-METRIC step=0 loss=1.0 " + " ".join(
+        f"{k}={v}" for k, v in led.fields().items())
+    sample = parse_fields(parse_metric_line(line))
+    assert sample["epoch"] == 500.0
+    assert sample["wall"] == pytest.approx(4.0)
+    assert sample["seconds"]["compute"] == pytest.approx(4.0)
+    # Lines without ledger fields parse to None, not a crash.
+    assert parse_fields(parse_metric_line("KFTPU-METRIC step=1 loss=2")) \
+        is None
+
+
+def test_job_goodput_stitches_incarnations_and_charges_gap():
+    from kubeflow_tpu.obs.goodput import JobGoodput
+
+    def sample(epoch, wall, **sec):
+        base = {s: 0.0 for s in ("compute", "checkpoint", "reshard",
+                                 "restart_recovery", "input_wait", "idle")}
+        base.update(sec)
+        return {"epoch": epoch, "wall": wall, "seconds": base}
+
+    jg = JobGoodput()
+    # Incarnation 1: 10s, 8 compute + 2 recovery. Cumulative counters:
+    # a stale out-of-order line must lose to the newest.
+    jg.observe(sample(1000.0, 6.0, compute=5.0, restart_recovery=1.0))
+    jg.observe(sample(1000.0, 10.0, compute=8.0, restart_recovery=2.0))
+    jg.observe(sample(1000.0, 6.0, compute=5.0, restart_recovery=1.0))
+    assert jg.incarnations == 1
+    assert jg.totals()["compute"] == 8.0
+    # Incarnation 2 starts 3.5s after inc1's last sample: the gap is
+    # gang-held dead time, charged to restart_recovery.
+    jg.observe(sample(1013.5, 2.0, compute=1.0, restart_recovery=1.0))
+    assert jg.incarnations == 2
+    assert jg.totals()["restart_recovery"] == pytest.approx(2 + 3.5 + 1)
+    assert jg.wall() == pytest.approx(15.5)
+    assert jg.attributed() == pytest.approx(jg.wall())
+    assert jg.conservation_error() == pytest.approx(0.0, abs=1e-9)
+    assert jg.goodput_fraction() == pytest.approx(9.0 / 15.5)
+
+
+# ---------------------------------------------------------------------------
+# SLOSpec validation (api/types.py).
+# ---------------------------------------------------------------------------
+
+def test_slospec_validation():
+    from kubeflow_tpu.api.types import SLOSpec
+
+    spec = SLOSpec(goodput_floor=0.9)
+    assert spec.fast_window_seconds < spec.slow_window_seconds
+    assert spec.availability == 0.99 and spec.burn_threshold == 2.0
+    with pytest.raises(ValueError):
+        SLOSpec(fast_window_seconds=600.0, slow_window_seconds=60.0)
+    with pytest.raises(ValueError):
+        SLOSpec(goodput_floor=1.5)
+    with pytest.raises(ValueError):
+        SLOSpec(ttft_ms=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate evaluator (controller/telemetry.py): multiwindow rule,
+# edge-triggered events, pressure fan-out.
+# ---------------------------------------------------------------------------
+
+def _plane_with_clock(t0=1000.0):
+    from kubeflow_tpu.controller.telemetry import TelemetryPlane
+    from kubeflow_tpu.obs.timeseries import SeriesStore
+
+    t = [t0]
+    plane = TelemetryPlane(series=SeriesStore(), now=lambda: t[0])
+    return plane, t
+
+
+def test_burn_alert_requires_both_windows():
+    from kubeflow_tpu.api.types import SLOSpec
+
+    plane, t = _plane_with_clock()
+    slo = SLOSpec(goodput_floor=0.9, fast_window_seconds=10.0,
+                  slow_window_seconds=100.0, burn_threshold=2.0)
+    events, pressure = [], []
+    plane.pressure_callbacks.append(lambda j, a: pressure.append((j, a)))
+    add = plane.series.add
+
+    # Healthy history across the slow window: no burn anywhere.
+    for i in range(90):
+        add("goodput.fraction", {"job": "j"}, 0.95, ts=910.0 + i)
+    ev = plane.evaluate_job("j", slo,
+                            event_cb=lambda r, m: events.append(r))
+    assert not ev["firing"] and events == [] and plane.alerting() == {}
+
+    # Fast-window blip: recent points burn hard, slow window still
+    # healthy overall -- a blip is NOT an alert.
+    for i in range(5):
+        add("goodput.fraction", {"job": "j"}, 0.40, ts=995.0 + i)
+    ev = plane.evaluate_job("j", slo,
+                            event_cb=lambda r, m: events.append(r))
+    assert ev["fast"][1] > slo.burn_threshold
+    assert not ev["firing"] and events == []
+
+    # Sustained burn: both windows over threshold -> one edge-triggered
+    # event, pressure fan-out, alerting() reflects the objective.
+    t[0] = 1080.0
+    for i in range(70):
+        add("goodput.fraction", {"job": "j"}, 0.40, ts=1010.0 + i)
+    ev = plane.evaluate_job("j", slo,
+                            event_cb=lambda r, m: events.append(r))
+    assert ev["firing"] and ev["objective"] == "goodput"
+    plane.evaluate_job("j", slo, event_cb=lambda r, m: events.append(r))
+    assert events == ["SLOBurnRate"]  # edge, not level
+    assert pressure == [("j", True)]
+    assert plane.alerting() == {"j": "goodput"}
+
+    # Recovery: fast window healthy again -> one resolve event.
+    t[0] = 1200.0
+    for i in range(9):
+        add("goodput.fraction", {"job": "j"}, 0.95, ts=1191.0 + i)
+    plane.evaluate_job("j", slo, event_cb=lambda r, m: events.append(r))
+    assert events == ["SLOBurnRate", "SLOBurnRateResolved"]
+    assert pressure == [("j", True), ("j", False)]
+    assert plane.alerting() == {}
+
+
+def test_burn_serving_objectives_use_availability_budget():
+    from kubeflow_tpu.api.types import SLOSpec
+
+    plane, t = _plane_with_clock()
+    slo = SLOSpec(ttft_ms=100.0, availability=0.9,
+                  fast_window_seconds=10.0, slow_window_seconds=100.0,
+                  burn_threshold=2.0)
+    # 50% of TTFTs over the ceiling in both windows: bad=0.5 against a
+    # 0.1 budget = 5x burn -> firing on the ttft objective.
+    for i in range(100):
+        plane.series.add("serving.ttft_ms", {"job": "j"},
+                         200.0 if i % 2 else 50.0, ts=900.0 + i)
+    ev = plane.evaluate_job("j", slo, event_cb=lambda r, m: None)
+    assert ev["firing"] and ev["objective"] == "ttft"
+
+
+def test_evaluate_job_without_slo_is_none():
+    plane, _ = _plane_with_clock()
+    assert plane.evaluate_job("j", None) is None
+
+
+# ---------------------------------------------------------------------------
+# Scrape loop: incremental offsets, prom-text ingestion, and the
+# chaos drop_poll churn path (replica dies mid-scrape -> staleness).
+# ---------------------------------------------------------------------------
+
+def _metric_line(step, **extra):
+    kv = {"step": step, "loss": 1.0, "tokens_per_sec": 100.0}
+    kv.update(extra)
+    return "KFTPU-METRIC " + " ".join(f"{k}={v}" for k, v in kv.items())
+
+
+def test_scrape_worker_log_is_incremental(tmp_path):
+    plane, _ = _plane_with_clock()
+    log = tmp_path / "w0.log"
+    log.write_text(_metric_line(0) + "\n" + _metric_line(1) + "\n")
+    assert plane.scrape_worker_log("d/j", "w0", str(log)) == 2
+    # No new bytes: nothing re-ingested (byte-offset tailing).
+    assert plane.scrape_worker_log("d/j", "w0", str(log)) == 0
+    with open(log, "a") as f:
+        f.write(_metric_line(2) + "\n")
+    assert plane.scrape_worker_log("d/j", "w0", str(log)) == 1
+    s = plane.series.get("train.step", {"job": "d/j", "worker": "w0"})
+    assert [v for _, v in s.points] == [0.0, 1.0, 2.0]
+
+
+def test_scrape_feeds_goodput_ledger(tmp_path):
+    plane, _ = _plane_with_clock()
+    log = tmp_path / "w0.log"
+    log.write_text(_metric_line(
+        0, gp_compute="8.000", gp_checkpoint="0.000", gp_reshard="0.000",
+        gp_restart_recovery="2.000", gp_input_wait="0.000",
+        gp_idle="0.000", gp_epoch="1000.000", gp_wall="10.000") + "\n")
+    plane.scrape_worker_log("d/j", "w0", str(log))
+    jg = plane.goodput["d/j"]
+    assert jg.goodput_fraction() == pytest.approx(0.8)
+    assert plane.series.get("goodput.fraction", {"job": "d/j"}) is not None
+
+
+def test_scrape_under_churn_drop_poll_staleness(tmp_path, monkeypatch):
+    """Satellite: a seeded drop_poll plan at the telemetry.scrape site
+    exercises the replica-died-mid-scrape path -- misses counted, series
+    stale after STALE_AFTER_MISSES consecutive misses, next good poll
+    un-stales."""
+    from kubeflow_tpu import chaos
+    from kubeflow_tpu.controller import telemetry as tele_mod
+
+    plan = json.dumps({"seed": 3, "faults": [
+        {"kind": "drop_poll", "site": "telemetry.scrape",
+         "target": "d/j/w0", "at": [1, 2]},
+    ]})
+    monkeypatch.setenv("KFTPU_CHAOS_PLAN", plan)
+    chaos.reset()
+    try:
+        plane, _ = _plane_with_clock()
+        log = tmp_path / "w0.log"
+        log.write_text(_metric_line(0) + "\n")
+        misses = obs_registry.REGISTRY.counter(
+            "kftpu_telemetry_scrape_misses_total")
+        before = misses.value
+        # Hit 0: clean poll seeds the series.
+        assert plane.scrape_worker_log("d/j", "w0", str(log)) == 1
+        s = plane.series.get("train.step", {"job": "d/j", "worker": "w0"})
+        # Hit 1: dropped -- one miss is a blip, not a death.
+        assert plane.scrape_worker_log("d/j", "w0", str(log)) == 0
+        assert misses.value == before + 1 and not s.stale
+        # Hit 2: dropped -- STALE_AFTER_MISSES consecutive -> stale.
+        assert tele_mod.STALE_AFTER_MISSES == 2
+        assert plane.scrape_worker_log("d/j", "w0", str(log)) == 0
+        assert misses.value == before + 2 and s.stale
+        # Hit 3: the plan is exhausted, the poll lands (even with no new
+        # bytes the reachable replica un-stales its series).
+        assert plane.scrape_worker_log("d/j", "w0", str(log)) == 0
+        assert not s.stale
+    finally:
+        monkeypatch.delenv("KFTPU_CHAOS_PLAN")
+        chaos.reset()
+
+
+def test_scrape_missing_file_never_raises(tmp_path):
+    plane, _ = _plane_with_clock()
+    assert plane.scrape_worker_log("d/j", "w0",
+                                   str(tmp_path / "gone.log")) == 0
+
+
+def test_ingest_prom_text_merges_labels():
+    plane, _ = _plane_with_clock()
+    text = ('kftpu_engine_queue_depth{model="m"} 3\n'
+            "# HELP noise\nnot a sample\n"
+            "kftpu_engine_slots_active 2\n")
+    n = plane.ingest_prom_text(text, labels={"replica": "r0"}, ts=50.0)
+    assert n == 2
+    s = plane.series.get("kftpu_engine_queue_depth",
+                         {"model": "m", "replica": "r0"})
+    assert s.last == (50.0, 3.0)
+
+
+# ---------------------------------------------------------------------------
+# Controller integration: scrape_controller drives the whole pass over
+# a (duck-typed) live controller -- worker logs in, SLO events out.
+# ---------------------------------------------------------------------------
+
+def test_scrape_controller_end_to_end(tmp_path):
+    from kubeflow_tpu.api import (
+        JobKind,
+        JobSpec,
+        ProcessTemplate,
+        ReplicaSpec,
+        ReplicaType,
+        Resources,
+        TrainJob,
+        apply_defaults,
+    )
+    from kubeflow_tpu.api.types import ObjectMeta, SLOSpec
+
+    job = apply_defaults(TrainJob(
+        kind=JobKind.JAXJob,
+        metadata=ObjectMeta(name="j1", namespace="default"),
+        spec=JobSpec(
+            replica_specs={ReplicaType.Worker: ReplicaSpec(
+                replicas=1,
+                template=ProcessTemplate(entrypoint="x", args=[]),
+                resources=Resources(tpu=1))},
+            slo=SLOSpec(goodput_floor=0.9, fast_window_seconds=5.0,
+                        slow_window_seconds=50.0, burn_threshold=1.0),
+        ),
+    ))
+    log = tmp_path / "w0.log"
+    log.write_text(_metric_line(
+        0, gp_compute="2.000", gp_checkpoint="0.000", gp_reshard="0.000",
+        gp_restart_recovery="8.000", gp_input_wait="0.000",
+        gp_idle="0.000", gp_epoch="1000.000", gp_wall="10.000") + "\n")
+
+    class _Ref:
+        log_path = str(log)
+
+    class _RT:
+        workers = {"w0": _Ref()}
+
+    events = []
+
+    class _Ctl:
+        _runtimes = {"default/j1": _RT()}
+
+        def _find_job(self, ns, name):
+            assert (ns, name) == ("default", "j1")
+            return job.kind.value, job.to_dict()
+
+        def _record_event(self, j, reason, message):
+            events.append(reason)
+
+    plane, _ = _plane_with_clock()
+    ingested = plane.scrape_controller(_Ctl())
+    assert ingested == 1
+    # Fraction 0.2 against a 0.9 floor burns both windows at 8x: the
+    # alert fires and lands in the controller's event stream.
+    assert events == ["SLOBurnRate"]
+    assert plane.alerting() == {"default/j1": "goodput"}
+
+
+# ---------------------------------------------------------------------------
+# GET /debug/series (server/app.py) and `kftpu top` rendering.
+# ---------------------------------------------------------------------------
+
+def test_debug_series_endpoint(tmp_path):
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu.server.app import ControlPlane
+
+    async def run():
+        cp = ControlPlane(str(tmp_path / "state"), total_chips=8)
+        cp.telemetry.series.add(
+            "train.tokens_per_sec", {"job": "default/j1", "worker": "w0"},
+            123.0, ts=time.time())
+        cp.telemetry._observe_goodput("default/j1", {
+            "epoch": 1000.0, "wall": 10.0,
+            "seconds": {"compute": 8.0, "checkpoint": 0.0, "reshard": 0.0,
+                        "restart_recovery": 2.0, "input_wait": 0.0,
+                        "idle": 0.0}})
+        c = TestClient(TestServer(cp.build_app()))
+        await c.start_server()
+        try:
+            r = await c.get("/debug/series?since=600")
+            assert r.status == 200
+            snap = await r.json()
+            bad = await c.get("/debug/series?since=abc")
+            assert bad.status == 400
+            named = await c.get("/debug/series?name=train.tokens_per_sec")
+            assert (await named.json())["series"][0]["name"] \
+                == "train.tokens_per_sec"
+            return snap
+        finally:
+            await c.close()
+
+    snap = asyncio.run(run())
+    g = snap["goodput"]["default/j1"]
+    assert g["fraction"] == pytest.approx(0.8)
+    assert g["attributed_seconds"]["restart_recovery"] == 2.0
+    assert g["incarnations"] == 1
+    assert snap["alerts"] == {}
+    assert any(s["name"] == "train.tokens_per_sec"
+               for s in snap["series"])
+
+
+def test_render_top_table():
+    from kubeflow_tpu.cli.main import _render_top
+
+    snap = {
+        "series": [
+            {"name": "train.tokens_per_sec",
+             "labels": {"job": "default/j1", "worker": "w0"},
+             "stale": False, "points": [[1.0, 4000.0]]},
+            {"name": "train.tokens_per_sec",
+             "labels": {"job": "default/j1", "worker": "w1"},
+             "stale": True, "points": [[1.0, 9999.0]]},  # stale: excluded
+        ],
+        "goodput": {"default/j1": {
+            "fraction": 0.6888, "wall_seconds": 44.193,
+            "conservation_error": 0.0, "incarnations": 2,
+            "attributed_seconds": {"compute": 30.4, "checkpoint": 0.6,
+                                   "reshard": 0.0,
+                                   "restart_recovery": 11.8,
+                                   "input_wait": 1.4, "idle": 0.0}}},
+        "alerts": {"default/j1": "goodput"},
+    }
+    out = _render_top(snap)
+    lines = out.splitlines()
+    assert lines[0].split() == ["JOB", "GOODPUT", "WALL_S", "TOK/S",
+                                "BADPUT(top)", "CONSV_ERR", "INCARN",
+                                "SLO"]
+    row = lines[1]
+    assert "default/j1" in row and "0.689" in row
+    assert "4000" in row and "9999" not in row  # stale series excluded
+    assert "restart_recovery=11.8s" in row  # dominant badput state
+    assert "ALERT:goodput" in row
+    assert lines[-1] == "2 series (1 stale), 1 SLO alert(s) firing"
+    # No telemetry at all still renders (the cold-start experience).
+    empty = _render_top({"series": [], "goodput": {}, "alerts": {}})
+    assert "no jobs reporting telemetry yet" in empty

@@ -1,0 +1,98 @@
+"""Tier C obsplane family: observability conformance checking --
+ledger conservation, series-store contract, burn-rate rule, and the
+metrics-catalog drift lint (KT-OBS-*).
+
+The shipped tree must be clean (that IS the CI contract `kftpu analyze
+--strict --only obsplane` enforces); each drift shape below must
+produce its KT-OBS-CATALOG finding when planted.
+"""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.analysis import run_analysis
+from kubeflow_tpu.analysis import obscheck
+from kubeflow_tpu.analysis.obscheck import (
+    check_burn,
+    check_catalog,
+    check_conservation,
+    check_obsplane,
+    check_series,
+)
+
+
+# ---------------------------------------------------------------------------
+# The shipped tree is clean, rule by rule and end to end.
+# ---------------------------------------------------------------------------
+
+def test_conservation_series_burn_clean_on_shipped_tree():
+    assert check_conservation() == []
+    assert check_series() == []
+    assert check_burn() == []
+
+
+def test_catalog_clean_on_shipped_tree():
+    # Every registered metric is documented and the doc documents no
+    # ghosts -- the satellite contract for docs/OBSERVABILITY.md.
+    assert [f.message for f in check_catalog()] == []
+
+
+def test_check_obsplane_clean_and_reports_coverage():
+    findings, info = check_obsplane()
+    assert findings == []
+    assert info["rules"] == 4
+    assert info["ledger_states"] == 6
+    assert info["catalog_metrics"] > 20  # the registry is not empty
+
+
+def test_run_analysis_only_obsplane_routes_and_is_clean():
+    findings, metrics = run_analysis(trace=False, serving=False,
+                                     families={"obsplane"})
+    assert findings == [] and metrics == {}
+
+
+# ---------------------------------------------------------------------------
+# Catalog drift lint: both directions must actually bite.
+# ---------------------------------------------------------------------------
+
+def test_catalog_missing_doc_is_a_finding(tmp_path, monkeypatch):
+    monkeypatch.setattr(obscheck, "_DOC_PATH", str(tmp_path / "gone.md"))
+    findings = check_catalog()
+    assert len(findings) == 1 and findings[0].rule == "KT-OBS-CATALOG"
+    assert "is missing" in findings[0].message
+
+
+def test_catalog_drift_bites_both_directions(tmp_path, monkeypatch):
+    # A doc that catalogs one made-up metric and none of the real
+    # ones: every registered metric raises code->docs drift, and the
+    # fabricated row raises a docs->code ghost.
+    doc = tmp_path / "OBSERVABILITY.md"
+    doc.write_text(
+        "# Metrics\n\n"
+        "| metric | type |\n|---|---|\n"
+        "| `kftpu_made_up_metric_total` | counter |\n")
+    monkeypatch.setattr(obscheck, "_DOC_PATH", str(doc))
+    findings = check_catalog()
+    msgs = [f.message for f in findings]
+    assert any("kftpu_made_up_metric_total" in m and "ghost" in m
+               for m in msgs)
+    missing = [m for m in msgs if "is not in the" in m]
+    assert len(missing) > 20  # the whole registry went undocumented
+    assert any("kftpu_slo_burn_rate" in m for m in missing)
+    assert any("kftpu_goodput_fraction" in m for m in missing)
+
+
+def test_catalog_prose_mention_does_not_count_as_table_row(tmp_path,
+                                                          monkeypatch):
+    # docs->code lint keys on catalog TABLE rows only: prose mentioning
+    # a dead name is stale writing, not a contract violation. The
+    # code->docs direction accepts a name anywhere in the doc text.
+    registered = sorted(obscheck._code_metrics())
+    doc = tmp_path / "OBSERVABILITY.md"
+    doc.write_text(
+        "kftpu_prose_only_ghost is long gone.\n\n"
+        + "\n".join(f"| `{name}` | gauge |" for name in registered)
+        + "\n")
+    monkeypatch.setattr(obscheck, "_DOC_PATH", str(doc))
+    assert check_catalog() == []

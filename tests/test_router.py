@@ -265,6 +265,42 @@ def test_shed_when_all_candidates_over_slo():
     assert d2.kind == "shed" and d2.retry_after_s == 0.25
 
 
+def test_slo_pressure_tightens_shed_threshold():
+    from kubeflow_tpu.obs.registry import REGISTRY
+
+    r = _router(2, slo_ttft_ms=1000.0)
+    key = prefix_route_key(list(range(128)))
+    # Both replicas estimate 600ms (ema * (1 + 0/8)): under the 1000ms
+    # ceiling, traffic flows.
+    for rid in ("r0", "r1"):
+        r.update_load(rid, {"ttft_ema_ms": 600.0})
+    assert r.effective_slo_ttft_ms() == 1000.0
+    assert r.route(key).kind == "direct"
+    # An active burn-rate alert halves the threshold (default
+    # slo_pressure_factor 0.5): 600 > 500 everywhere -> shed, and the
+    # pressure gauge flips for the scrape.
+    r.set_slo_pressure(True)
+    assert r.effective_slo_ttft_ms() == 500.0
+    assert r.route(key).kind == "shed"
+    assert REGISTRY.gauge("kftpu_router_slo_pressure",
+                          {"router": "test"}).value == 1
+    # Resolution restores the configured ceiling.
+    r.set_slo_pressure(False)
+    assert r.effective_slo_ttft_ms() == 1000.0
+    assert r.route(key).kind == "direct"
+    assert REGISTRY.gauge("kftpu_router_slo_pressure",
+                          {"router": "test"}).value == 0
+
+
+def test_observe_ttft_feeds_telemetry_store():
+    from kubeflow_tpu.obs import timeseries as obs_timeseries
+
+    r = _router(1)
+    r.observe_ttft("r0", 123.0)
+    s = obs_timeseries.STORE.get("serving.ttft_ms", {"job": "test"})
+    assert s is not None and s.last[1] == 123.0
+
+
 def test_sync_replicas_and_unhealthy_and_empty():
     r = _router(2)
     assert r.route(b"x" * 16).kind == "direct"

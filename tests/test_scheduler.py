@@ -30,11 +30,13 @@ from test_controller import Harness, make_job
 
 
 def sj(key, *, tenant="t", weight=1.0, workload="train", mn=1, mx=8,
-       intensity=0.5, seq=0, reshardable=False, current=None):
+       intensity=0.5, seq=0, reshardable=False, current=None,
+       slo_alert=False):
     return SchedJob(
         key=key, tenant=tenant, weight=weight, workload=workload,
         min_chips=mn, max_chips=mx, collective_intensity=intensity,
         arrival_seq=seq, reshardable=reshardable, current=current,
+        slo_alert=slo_alert,
     )
 
 
@@ -97,6 +99,21 @@ class TestPreemption:
             sj("t/h-new", workload="hpo", mn=4, seq=1),
         ]
         assert select_preemptions(jobs, 4) == ["t/h-new"]
+
+    def test_slo_alerting_job_is_shielded(self):
+        # A firing burn-rate alert (fed from the telemetry plane) drops
+        # the job's rank below every non-alerting peer: it is the last
+        # victim within -- and even across -- its class.
+        calm = sj("t/h-calm", workload="hpo", mn=4, seq=1)
+        burning = sj("t/h-burn", workload="hpo", mn=4, seq=2,
+                     slo_alert=True)
+        assert preemption_rank(burning) < preemption_rank(calm)
+        assert select_preemptions([calm, burning], 4) == ["t/h-calm"]
+        # The shield outranks class ordering: under deeper pressure the
+        # non-alerting train job goes before the burning HPO trial.
+        train = sj("t/train", workload="train", mn=4, seq=0)
+        assert select_preemptions([train, calm, burning], 4) \
+            == ["t/h-calm", "t/train"]
 
 
 # ---------------------------------------------------------------------------
